@@ -1,0 +1,77 @@
+"""mmlspark_tpu — a TPU-native framework with the capabilities of MMLSpark.
+
+A brand-new, TPU-first rebuild of MMLSpark (``lloja/mmlspark``): SparkML-style
+``Estimator``/``Transformer`` stages whose compute engines are pure SPMD JAX
+programs (Pallas kernels, ``shard_map`` + ``psum`` over a device mesh) instead
+of JNI-wrapped native CUDA/CPU libraries.
+
+Layering (see SURVEY.md §1 and §7.1 for the reference layer map this mirrors):
+
+- ``core``      — params/pipeline/persistence contracts + the DataFrame-lite
+                  host data layer (reference: ``cms.core.{contracts,serialize,
+                  schema}`` — UPSTREAM paths, see SURVEY.md provenance banner).
+- ``ops``       — numerical building blocks: quantile binning, histogram
+                  builds, split finding, objectives, tree prediction, ONNX
+                  graph import, image ops.
+- ``engine``    — the GBDT trainer orchestration (single- and multi-device).
+- ``parallel``  — device-mesh helpers, collectives, distributed rendezvous
+                  (replaces the reference's LGBM_NetworkInit socket allreduce;
+                  SURVEY.md §5.8).
+- ``models``    — user-facing estimators/transformers: LightGBMClassifier/
+                  Regressor/Ranker, ONNXModel, CNTKModel, ImageFeaturizer,
+                  VowpalWabbit*, SAR, KNN…
+- ``stages``, ``featurize``, ``train``, ``automl``, ``explain``, ``io`` —
+  the utility surface (reference: ``cms.{stages,featurize,train,automl,lime,
+  io.http}``).
+
+Public API re-exports live here so ``from mmlspark_tpu import
+LightGBMClassifier`` works like ``from mmlspark.lightgbm import
+LightGBMClassifier`` did in the reference.
+"""
+
+__version__ = "0.1.0"
+
+from mmlspark_tpu.core.frame import DataFrame  # noqa: F401
+from mmlspark_tpu.core.pipeline import (  # noqa: F401
+    Estimator,
+    Evaluator,
+    Model,
+    Pipeline,
+    PipelineModel,
+    Transformer,
+)
+
+# Lazy convenience imports of the model surface.  Kept lazy so that importing
+# the package root stays cheap (jax import cost is paid only when an engine is
+# actually used).
+_LAZY = {
+    "LightGBMClassifier": "mmlspark_tpu.models.lightgbm",
+    "LightGBMRegressor": "mmlspark_tpu.models.lightgbm",
+    "LightGBMRanker": "mmlspark_tpu.models.lightgbm",
+    "LightGBMClassificationModel": "mmlspark_tpu.models.lightgbm",
+    "LightGBMRegressionModel": "mmlspark_tpu.models.lightgbm",
+    "LightGBMRankerModel": "mmlspark_tpu.models.lightgbm",
+    "ONNXModel": "mmlspark_tpu.models.onnx_model",
+    "CNTKModel": "mmlspark_tpu.models.cntk_model",
+    "ImageFeaturizer": "mmlspark_tpu.models.image_featurizer",
+    "ImageTransformer": "mmlspark_tpu.ops.image_ops",
+    "UnrollImage": "mmlspark_tpu.ops.image_ops",
+    "ImageSetAugmenter": "mmlspark_tpu.ops.image_ops",
+    "VowpalWabbitClassifier": "mmlspark_tpu.models.vw",
+    "VowpalWabbitRegressor": "mmlspark_tpu.models.vw",
+    "VowpalWabbitFeaturizer": "mmlspark_tpu.models.vw",
+    "VowpalWabbitInteractions": "mmlspark_tpu.models.vw",
+    "SAR": "mmlspark_tpu.models.sar",
+    "SARModel": "mmlspark_tpu.models.sar",
+    "KNN": "mmlspark_tpu.models.knn",
+    "ConditionalKNN": "mmlspark_tpu.models.knn",
+}
+
+
+def __getattr__(name):
+    mod = _LAZY.get(name)
+    if mod is None:
+        raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+    import importlib
+
+    return getattr(importlib.import_module(mod), name)

@@ -1,0 +1,8 @@
+"""Import the full stage surface (registration side effects).
+
+Used by the fuzzing harness and codegen to enumerate every public stage
+(SURVEY.md §4.2 coverage-by-construction).  Modules are added here as they
+are built; keep this list complete.
+"""
+
+import mmlspark_tpu.core.pipeline  # noqa: F401

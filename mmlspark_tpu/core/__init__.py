@@ -1,0 +1,7 @@
+"""Core contracts: params, pipeline, persistence, schema, DataFrame-lite.
+
+Reference parity: ``cms.core.{contracts,serialize,schema,env,metrics}``
+(UPSTREAM:src/main/scala/com/microsoft/ml/spark/core/ — see SURVEY.md §2.1;
+provenance banner applies: the reference mount was empty, paths are
+upstream-era expectations).
+"""

@@ -1,0 +1,289 @@
+"""DataFrame-lite: the host-side columnar data layer.
+
+The reference sits on Spark DataFrames (SURVEY.md §1 L0).  This rebuild's
+compute engines are SPMD JAX programs; what they need from the data layer is a
+host-side columnar batch with Spark-flavored ergonomics (``withColumn``,
+``select``, partition metadata for the distributed training path) — not a
+distributed query engine.  ``DataFrame`` here is an immutable wrapper over a
+``pandas.DataFrame`` plus:
+
+- ``num_partitions`` and partition boundaries (Spark's partitioning is load-
+  bearing for the reference's LightGBM orchestration — SURVEY.md §3.1 "compute
+  numWorkers = min(numTasks, df partitions)" — so we carry it faithfully);
+- per-column metadata (the reference stores categorical level↔index maps in
+  Spark column metadata — SURVEY.md §2.1 "Categoricals").
+
+When a real ``pyspark`` is importable, ``DataFrame.from_spark`` /
+``to_spark`` adapt at the boundary (gated import; pyspark is not required).
+
+Reference parity: UPSTREAM:.../core/schema/{DatasetExtensions,SparkSchema,
+Categoricals}.scala ([REF-EMPTY] — see SURVEY.md provenance banner).
+"""
+
+from __future__ import annotations
+
+import itertools
+from typing import Any, Callable, Dict, Iterable, List, Optional, Sequence, Union
+
+import numpy as np
+import pandas as pd
+
+
+class Row(dict):
+    """Dict-backed row with attribute access, à la ``pyspark.sql.Row``."""
+
+    def __getattr__(self, name):
+        try:
+            return self[name]
+        except KeyError:
+            raise AttributeError(name) from None
+
+
+class DataFrame:
+    """Immutable columnar frame with Spark-style transformations."""
+
+    def __init__(
+        self,
+        data: Union[pd.DataFrame, Dict[str, Any], List[dict]],
+        num_partitions: int = 1,
+        metadata: Optional[Dict[str, dict]] = None,
+    ):
+        if isinstance(data, DataFrame):
+            pdf = data._pdf
+            metadata = metadata or data._metadata
+            num_partitions = num_partitions or data.num_partitions
+        elif isinstance(data, pd.DataFrame):
+            pdf = data.reset_index(drop=True)
+        elif isinstance(data, dict):
+            pdf = pd.DataFrame(dict(data))
+        elif isinstance(data, list):
+            pdf = pd.DataFrame(data)
+        else:
+            raise TypeError(f"cannot build DataFrame from {type(data).__name__}")
+        self._pdf = pdf
+        self.num_partitions = max(1, int(num_partitions))
+        self._metadata: Dict[str, dict] = dict(metadata or {})
+
+    # ---- constructors ---------------------------------------------------
+    @staticmethod
+    def from_pandas(pdf: pd.DataFrame, num_partitions: int = 1) -> "DataFrame":
+        return DataFrame(pdf, num_partitions=num_partitions)
+
+    @staticmethod
+    def from_spark(sdf) -> "DataFrame":  # pragma: no cover - needs pyspark
+        return DataFrame(sdf.toPandas(), num_partitions=sdf.rdd.getNumPartitions())
+
+    def to_spark(self, spark):  # pragma: no cover - needs pyspark
+        return spark.createDataFrame(self._pdf)
+
+    # ---- basic introspection -------------------------------------------
+    @property
+    def columns(self) -> List[str]:
+        return list(self._pdf.columns)
+
+    @property
+    def dtypes(self) -> List[tuple]:
+        return [(c, str(t)) for c, t in self._pdf.dtypes.items()]
+
+    @property
+    def schema(self) -> Dict[str, str]:
+        return {c: str(t) for c, t in self._pdf.dtypes.items()}
+
+    def count(self) -> int:
+        return len(self._pdf)
+
+    def __len__(self) -> int:
+        return len(self._pdf)
+
+    def __contains__(self, col: str) -> bool:
+        return col in self._pdf.columns
+
+    def __getitem__(self, col: str) -> np.ndarray:
+        return self._pdf[col].to_numpy()
+
+    def column(self, col: str) -> pd.Series:
+        return self._pdf[col]
+
+    def metadata(self, col: str) -> dict:
+        return self._metadata.get(col, {})
+
+    def isStreaming(self) -> bool:
+        return False
+
+    # ---- transformations (all return new DataFrames) --------------------
+    def _with(self, pdf: pd.DataFrame, metadata: Optional[Dict[str, dict]] = None):
+        md = dict(self._metadata if metadata is None else metadata)
+        md = {k: v for k, v in md.items() if k in pdf.columns}
+        return DataFrame(pdf, num_partitions=self.num_partitions, metadata=md)
+
+    def select(self, *cols: str) -> "DataFrame":
+        cols = list(cols[0]) if len(cols) == 1 and isinstance(cols[0], (list, tuple)) else list(cols)
+        return self._with(self._pdf[cols])
+
+    def drop(self, *cols: str) -> "DataFrame":
+        return self._with(self._pdf.drop(columns=[c for c in cols if c in self._pdf.columns]))
+
+    def withColumn(self, name: str, values, metadata: Optional[dict] = None) -> "DataFrame":
+        pdf = self._pdf.copy(deep=False)
+        if callable(values):
+            values = [values(Row(r)) for r in self._pdf.to_dict("records")]
+        if isinstance(values, (list, np.ndarray, pd.Series)) and len(pdf) == 0 and len(values) == 0:
+            values = pd.Series(values, dtype=object)
+        try:
+            pdf[name] = values
+        except ValueError:
+            # ragged/object payloads (vectors, structs) → object column
+            s = pd.Series(list(values), dtype=object)
+            pdf[name] = s
+        md = dict(self._metadata)
+        if metadata is not None:
+            md[name] = metadata
+        return self._with(pdf, md)
+
+    def withColumnRenamed(self, old: str, new: str) -> "DataFrame":
+        md = dict(self._metadata)
+        if old in md:
+            md[new] = md.pop(old)
+        return self._with(self._pdf.rename(columns={old: new}), md)
+
+    def withMetadata(self, col: str, metadata: dict) -> "DataFrame":
+        md = dict(self._metadata)
+        md[col] = metadata
+        return self._with(self._pdf, md)
+
+    def filter(self, cond) -> "DataFrame":
+        if callable(cond):
+            mask = np.array([bool(cond(Row(r))) for r in self._pdf.to_dict("records")])
+        else:
+            mask = np.asarray(cond, dtype=bool)
+        return self._with(self._pdf[mask].reset_index(drop=True))
+
+    where = filter
+
+    def limit(self, n: int) -> "DataFrame":
+        return self._with(self._pdf.head(n).reset_index(drop=True))
+
+    def sample(self, fraction: float, seed: int = 0) -> "DataFrame":
+        return self._with(
+            self._pdf.sample(frac=fraction, random_state=seed).reset_index(drop=True)
+        )
+
+    def orderBy(self, *cols, ascending=True) -> "DataFrame":
+        return self._with(
+            self._pdf.sort_values(list(cols), ascending=ascending).reset_index(drop=True)
+        )
+
+    sort = orderBy
+
+    def distinct(self) -> "DataFrame":
+        return self._with(self._pdf.drop_duplicates().reset_index(drop=True))
+
+    def union(self, other: "DataFrame") -> "DataFrame":
+        return self._with(
+            pd.concat([self._pdf, other._pdf], ignore_index=True)
+        )
+
+    unionAll = union
+
+    def join(self, other: "DataFrame", on, how: str = "inner") -> "DataFrame":
+        return self._with(self._pdf.merge(other._pdf, on=on, how=how))
+
+    def dropna(self, subset=None) -> "DataFrame":
+        return self._with(self._pdf.dropna(subset=subset).reset_index(drop=True))
+
+    def fillna(self, value, subset=None) -> "DataFrame":
+        if subset is None:
+            return self._with(self._pdf.fillna(value))
+        pdf = self._pdf.copy(deep=False)
+        for c in subset:
+            pdf[c] = pdf[c].fillna(value)
+        return self._with(pdf)
+
+    def randomSplit(self, weights: Sequence[float], seed: int = 0):
+        weights = np.asarray(weights, dtype=float)
+        weights = weights / weights.sum()
+        rng = np.random.default_rng(seed)
+        assignment = rng.choice(len(weights), size=len(self._pdf), p=weights)
+        return [
+            self._with(self._pdf[assignment == i].reset_index(drop=True))
+            for i in range(len(weights))
+        ]
+
+    # ---- partitioning (SURVEY.md §3.1: partition count drives numWorkers) --
+    def repartition(self, n: int) -> "DataFrame":
+        return DataFrame(self._pdf, num_partitions=n, metadata=self._metadata)
+
+    def coalesce(self, n: int) -> "DataFrame":
+        return DataFrame(
+            self._pdf, num_partitions=min(n, self.num_partitions), metadata=self._metadata
+        )
+
+    def getNumPartitions(self) -> int:
+        return self.num_partitions
+
+    def partition_slices(self) -> List[slice]:
+        """Row slices for each partition (contiguous, balanced)."""
+        n = len(self._pdf)
+        k = min(self.num_partitions, max(1, n)) if n else 1
+        bounds = np.linspace(0, n, k + 1).astype(int)
+        return [slice(int(a), int(b)) for a, b in zip(bounds[:-1], bounds[1:])]
+
+    def cache(self) -> "DataFrame":
+        return self
+
+    persist = cache
+
+    def unpersist(self) -> "DataFrame":
+        return self
+
+    # ---- actions --------------------------------------------------------
+    def collect(self) -> List[Row]:
+        return [Row(r) for r in self._pdf.to_dict("records")]
+
+    def first(self) -> Optional[Row]:
+        rows = self._pdf.head(1).to_dict("records")
+        return Row(rows[0]) if rows else None
+
+    head = first
+
+    def toPandas(self) -> pd.DataFrame:
+        return self._pdf.copy()
+
+    def show(self, n: int = 20) -> None:
+        print(self._pdf.head(n).to_string())
+
+    def groupBy(self, *cols):
+        return _GroupedData(self, list(cols))
+
+    def __repr__(self):
+        return (
+            f"DataFrame[{', '.join(f'{c}: {t}' for c, t in self.dtypes)}] "
+            f"rows={len(self._pdf)} partitions={self.num_partitions}"
+        )
+
+
+class _GroupedData:
+    def __init__(self, df: DataFrame, cols: List[str]):
+        self._df = df
+        self._cols = cols
+
+    def agg(self, **aggs) -> DataFrame:
+        """aggs: output_name=(col, fn) with fn in pandas agg vocabulary."""
+        g = self._df._pdf.groupby(self._cols, sort=True)
+        out = g.agg(**{k: pd.NamedAgg(column=c, aggfunc=f) for k, (c, f) in aggs.items()})
+        return DataFrame(out.reset_index(), num_partitions=self._df.num_partitions)
+
+    def count(self) -> DataFrame:
+        g = self._df._pdf.groupby(self._cols, sort=True).size().rename("count")
+        return DataFrame(g.reset_index(), num_partitions=self._df.num_partitions)
+
+
+def find_unused_column_name(prefix: str, df: DataFrame) -> str:
+    """Reference parity: ``DatasetExtensions.findUnusedColumnName``
+    (UPSTREAM:.../core/schema/DatasetExtensions.scala — SURVEY.md §2.1)."""
+    if prefix not in df.columns:
+        return prefix
+    for i in itertools.count():
+        name = f"{prefix}_{i}"
+        if name not in df.columns:
+            return name

@@ -1,0 +1,448 @@
+"""Typed parameter system — the single source of truth for every stage's API.
+
+This is the TPU-native rebuild of the reference's params contracts
+(UPSTREAM:src/main/scala/com/microsoft/ml/spark/core/contracts/ — SURVEY.md
+§2.1 "Params contracts", §5.6 "Config / flag system"; [REF-EMPTY] provenance).
+In the reference, SparkML ``Params`` + MMLSpark's ``Wrappable``/``MMLParams``
+traits carry typed params with defaults, validation and JSON persistence, and
+the codegen layer reads them reflectively to emit PySpark/R wrappers.
+
+Here the inversion promised in SURVEY.md §2.2 happens: **Python is the source
+of truth.** ``Param`` descriptors declared on a ``Params`` subclass are
+collected by ``__init_subclass__``; Spark-style ``setX``/``getX`` methods are
+generated automatically; the codegen module (``mmlspark_tpu.codegen``) walks
+the same metadata to emit PySpark-wrapper stubs, docs and smoke tests.
+
+Design notes
+------------
+- A ``Param`` is a class-level descriptor (name, doc, default, type converter,
+  validator).  Instances store explicitly-set values in ``self._paramMap``.
+- ``ComplexParam`` handles non-JSON payloads (models, arrays, functions) with
+  pluggable save/load, mirroring the reference's ``ComplexParam`` /
+  ``ConstructorWritable`` (UPSTREAM:.../core/serialize/).
+- ``ServiceParam`` supports the value-or-column duality used by the cognitive
+  service transformers (SURVEY.md §2.6).
+"""
+
+from __future__ import annotations
+
+import copy as _copy
+from typing import Any, Callable, Dict, Iterable, List, Optional, Sequence
+
+_NO_DEFAULT = object()
+
+
+class ParamValidators:
+    """Validation combinators, mirroring SparkML ``ParamValidators``."""
+
+    @staticmethod
+    def gt(lower):
+        return lambda v: v > lower
+
+    @staticmethod
+    def gtEq(lower):
+        return lambda v: v >= lower
+
+    @staticmethod
+    def lt(upper):
+        return lambda v: v < upper
+
+    @staticmethod
+    def ltEq(upper):
+        return lambda v: v <= upper
+
+    @staticmethod
+    def inRange(lower, upper, lower_inclusive=True, upper_inclusive=True):
+        def check(v):
+            lo = v >= lower if lower_inclusive else v > lower
+            hi = v <= upper if upper_inclusive else v < upper
+            return lo and hi
+
+        return check
+
+    @staticmethod
+    def inList(allowed: Sequence[Any]):
+        allowed = list(allowed)
+        return lambda v: v in allowed
+
+    @staticmethod
+    def arrayLengthGt(lower):
+        return lambda v: len(v) > lower
+
+
+class TypeConverters:
+    """Best-effort coercion of user values into the declared param type."""
+
+    @staticmethod
+    def identity(v):
+        return v
+
+    @staticmethod
+    def toInt(v):
+        if isinstance(v, bool):
+            raise TypeError("bool is not an int param value")
+        return int(v)
+
+    @staticmethod
+    def toFloat(v):
+        return float(v)
+
+    @staticmethod
+    def toBool(v):
+        if isinstance(v, bool):
+            return v
+        raise TypeError(f"expected bool, got {type(v).__name__}")
+
+    @staticmethod
+    def toString(v):
+        if isinstance(v, str):
+            return v
+        raise TypeError(f"expected str, got {type(v).__name__}")
+
+    @staticmethod
+    def toListInt(v):
+        return [TypeConverters.toInt(x) for x in v]
+
+    @staticmethod
+    def toListFloat(v):
+        return [float(x) for x in v]
+
+    @staticmethod
+    def toListString(v):
+        return [TypeConverters.toString(x) for x in v]
+
+
+_CONVERTERS = {
+    int: TypeConverters.toInt,
+    float: TypeConverters.toFloat,
+    bool: TypeConverters.toBool,
+    str: TypeConverters.toString,
+}
+
+
+class Param:
+    """A typed, documented parameter attached to a :class:`Params` class.
+
+    Parameters
+    ----------
+    name: param name (the Spark-style camelCase name; also the kwarg name).
+    doc: one-line documentation string (surfaced by ``explainParams``).
+    default: default value, or absent (``isDefined`` False until set).
+    dtype: one of int/float/bool/str/list or None (no coercion).
+    validator: optional predicate; ``set`` raises ``ValueError`` on failure.
+    """
+
+    def __init__(
+        self,
+        name: str,
+        doc: str = "",
+        default: Any = _NO_DEFAULT,
+        dtype: Optional[type] = None,
+        validator: Optional[Callable[[Any], bool]] = None,
+    ):
+        self.name = name
+        self.doc = doc
+        self.default = default
+        self.dtype = dtype
+        self.validator = validator
+        self.parent: Optional[str] = None  # owning class name, set on collect
+
+    @property
+    def has_default(self) -> bool:
+        return self.default is not _NO_DEFAULT
+
+    def convert(self, value: Any) -> Any:
+        if value is None:
+            return None
+        conv = _CONVERTERS.get(self.dtype)
+        if conv is not None:
+            try:
+                value = conv(value)
+            except (TypeError, ValueError) as e:
+                raise TypeError(
+                    f"Param {self.name}: cannot convert {value!r} to "
+                    f"{self.dtype.__name__}: {e}"
+                ) from None
+        if self.validator is not None and not self.validator(value):
+            raise ValueError(f"Param {self.name}: invalid value {value!r}")
+        return value
+
+    # Descriptor protocol: reading the param from an *instance* returns its
+    # current value; from the class, returns the Param itself (for metadata).
+    def __get__(self, obj, objtype=None):
+        if obj is None:
+            return self
+        return obj.getOrDefault(self)
+
+    def __set__(self, obj, value):
+        obj.set(self, value)
+
+    def __repr__(self):
+        return f"Param({self.parent}.{self.name})"
+
+
+class ComplexParam(Param):
+    """A param whose value cannot round-trip through JSON.
+
+    Mirrors the reference's ``ComplexParam``/``ConstructorWritable``
+    (UPSTREAM:.../core/serialize/ — SURVEY.md §2.1).  Subclass or pass
+    ``saver``/``loader`` callables taking ``(value, path)`` / ``(path)``.
+    Default implementation pickles.
+    """
+
+    def __init__(self, name, doc="", default=_NO_DEFAULT, saver=None, loader=None):
+        super().__init__(name, doc, default=default, dtype=None)
+        self._saver = saver
+        self._loader = loader
+
+    def save_value(self, value, path: str) -> None:
+        if self._saver is not None:
+            self._saver(value, path)
+            return
+        import pickle
+
+        with open(path, "wb") as f:
+            pickle.dump(value, f)
+
+    def load_value(self, path: str):
+        if self._loader is not None:
+            return self._loader(path)
+        import pickle
+
+        with open(path, "rb") as f:
+            return pickle.load(f)
+
+
+class ServiceParam(Param):
+    """Value-or-column param for service transformers (SURVEY.md §2.6).
+
+    The stored value is a dict ``{"value": v}`` or ``{"col": name}``; helpers
+    on ``HasServiceParams`` resolve per-row values at transform time.
+    """
+
+    def __init__(self, name, doc="", default=_NO_DEFAULT, dtype=None):
+        super().__init__(name, doc, default=default, dtype=None)
+        self.value_dtype = dtype
+
+    def convert(self, value):
+        if value is None:
+            return None
+        if isinstance(value, dict) and set(value) <= {"value", "col"} and value:
+            return value
+        # Bare values are treated as literals.
+        return {"value": value}
+
+
+def _camel_to_upper(name: str) -> str:
+    return name[0].upper() + name[1:]
+
+
+class Params:
+    """Base for anything that carries :class:`Param` metadata.
+
+    Collects Param descriptors declared on the class (and bases) into
+    ``cls._params`` and auto-generates Spark-style ``setX(value)`` /
+    ``getX()`` methods (so both ``est.setNumLeaves(31)`` and
+    ``LightGBMClassifier(numLeaves=31)`` work, matching the generated PySpark
+    wrappers of the reference — SURVEY.md §2.2).
+    """
+
+    _params: Dict[str, Param] = {}
+
+    def __init_subclass__(cls, **kwargs):
+        super().__init_subclass__(**kwargs)
+        merged: Dict[str, Param] = {}
+        for base in reversed(cls.__mro__):
+            for k, v in vars(base).items():
+                if isinstance(v, Param):
+                    merged[v.name] = v
+        cls._params = merged
+        for p in merged.values():
+            if p.parent is None:
+                p.parent = cls.__name__
+            upper = _camel_to_upper(p.name)
+            setter, getter = f"set{upper}", f"get{upper}"
+            if not hasattr(cls, setter):
+                setattr(cls, setter, _make_setter(p.name))
+            if not hasattr(cls, getter):
+                setattr(cls, getter, _make_getter(p.name))
+
+    def __init__(self, **kwargs):
+        self._paramMap: Dict[str, Any] = {}
+        self.uid = f"{type(self).__name__}_{id(self):x}"
+        self.setParams(**kwargs)
+
+    # ---- core accessors -------------------------------------------------
+    def _param(self, param) -> Param:
+        if isinstance(param, Param):
+            return param
+        p = self._params.get(param)
+        if p is None:
+            raise KeyError(f"{type(self).__name__} has no param {param!r}")
+        return p
+
+    def hasParam(self, name: str) -> bool:
+        return name in self._params
+
+    def set(self, param, value) -> "Params":
+        p = self._param(param)
+        self._paramMap[p.name] = p.convert(value)
+        return self
+
+    def setParams(self, **kwargs) -> "Params":
+        for k, v in kwargs.items():
+            if k not in self._params:
+                raise KeyError(
+                    f"{type(self).__name__} has no param {k!r}; "
+                    f"known params: {sorted(self._params)}"
+                )
+            self.set(k, v)
+        return self
+
+    def isSet(self, param) -> bool:
+        return self._param(param).name in self._paramMap
+
+    def isDefined(self, param) -> bool:
+        p = self._param(param)
+        return p.name in self._paramMap or p.has_default
+
+    def getOrDefault(self, param):
+        p = self._param(param)
+        if p.name in self._paramMap:
+            return self._paramMap[p.name]
+        if p.has_default:
+            return p.default
+        raise KeyError(f"Param {p.name} is not set and has no default")
+
+    # Spark-style alias
+    def getParam(self, name: str) -> Param:
+        return self._param(name)
+
+    def get(self, param):
+        return self.getOrDefault(param)
+
+    def clear(self, param) -> "Params":
+        self._paramMap.pop(self._param(param).name, None)
+        return self
+
+    @classmethod
+    def params(cls) -> List[Param]:
+        return [cls._params[k] for k in sorted(cls._params)]
+
+    def extractParamMap(self) -> Dict[str, Any]:
+        out = {}
+        for p in self.params():
+            if self.isDefined(p):
+                out[p.name] = self.getOrDefault(p)
+        return out
+
+    def explainParam(self, param) -> str:
+        p = self._param(param)
+        default = f"default: {p.default!r}" if p.has_default else "undefined"
+        cur = (
+            f"current: {self._paramMap[p.name]!r}"
+            if p.name in self._paramMap
+            else ""
+        )
+        return f"{p.name}: {p.doc} ({default}{', ' + cur if cur else ''})"
+
+    def explainParams(self) -> str:
+        return "\n".join(self.explainParam(p) for p in self.params())
+
+    def copy(self, extra: Optional[Dict[str, Any]] = None) -> "Params":
+        new = _copy.copy(self)
+        new._paramMap = dict(self._paramMap)
+        new.uid = self.uid
+        if extra:
+            for k, v in extra.items():
+                new.set(k, v)
+        return new
+
+    def _copyValues(self, to: "Params") -> "Params":
+        """Copy shared param values from self onto ``to`` (fit → model)."""
+        for name, value in self._paramMap.items():
+            if to.hasParam(name):
+                to.set(name, value)
+        return to
+
+    def __repr__(self):
+        kv = ", ".join(f"{k}={v!r}" for k, v in sorted(self._paramMap.items()))
+        return f"{type(self).__name__}({kv})"
+
+
+def _make_setter(name):
+    def setter(self, value):
+        return self.set(name, value)
+
+    setter.__name__ = f"set{_camel_to_upper(name)}"
+    setter.__doc__ = f"Set the value of ``{name}``."
+    return setter
+
+
+def _make_getter(name):
+    def getter(self):
+        return self.getOrDefault(name)
+
+    getter.__name__ = f"get{_camel_to_upper(name)}"
+    getter.__doc__ = f"Get the value of ``{name}`` (or its default)."
+    return getter
+
+
+# --------------------------------------------------------------------------
+# Shared column-param mixins (reference: cms.core.contracts HasInputCol etc.)
+# --------------------------------------------------------------------------
+class HasInputCol(Params):
+    inputCol = Param("inputCol", "The name of the input column", dtype=str)
+
+
+class HasOutputCol(Params):
+    outputCol = Param("outputCol", "The name of the output column", dtype=str)
+
+
+class HasInputCols(Params):
+    inputCols = Param("inputCols", "The names of the input columns")
+
+
+class HasOutputCols(Params):
+    outputCols = Param("outputCols", "The names of the output columns")
+
+
+class HasLabelCol(Params):
+    labelCol = Param("labelCol", "The name of the label column", default="label", dtype=str)
+
+
+class HasFeaturesCol(Params):
+    featuresCol = Param(
+        "featuresCol", "The name of the features column", default="features", dtype=str
+    )
+
+
+class HasPredictionCol(Params):
+    predictionCol = Param(
+        "predictionCol", "The name of the prediction column", default="prediction", dtype=str
+    )
+
+
+class HasWeightCol(Params):
+    weightCol = Param("weightCol", "The name of the sample-weight column", dtype=str)
+
+
+class HasServiceParams(Params):
+    """Mixin resolving :class:`ServiceParam` values against a row/DataFrame."""
+
+    def getVectorParam(self, df, param):
+        """Resolve a ServiceParam to a per-row list (or scalar broadcast)."""
+        v = self.getOrDefault(param)
+        if v is None:
+            return None
+        if "col" in v:
+            return list(df[v["col"]])
+        return [v["value"]] * df.count()
+
+    def getScalarParam(self, param):
+        v = self.getOrDefault(param)
+        if v is None:
+            return None
+        if "col" in v:
+            raise ValueError(f"Param {param} is column-bound; use getVectorParam")
+        return v["value"]

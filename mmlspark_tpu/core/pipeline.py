@@ -1,0 +1,231 @@
+"""Estimator/Transformer/Pipeline contracts (SparkML-shaped, TPU-backed).
+
+Reference parity: Spark ML's ``Estimator.fit(df)`` / ``Transformer.
+transform(df)`` pipeline API, which every MMLSpark stage implements
+(SURVEY.md §1 L6).  The user-facing contract is identical — ``fit`` returns a
+``Model`` (a ``Transformer``), ``Pipeline`` chains stages, and everything
+persists via ``save``/``load`` — while the compute underneath is JAX/XLA.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Any, Dict, List, Optional
+
+from mmlspark_tpu.core.frame import DataFrame
+from mmlspark_tpu.core.params import ComplexParam, Param, Params
+from mmlspark_tpu.core.registry import register_stage, resolve_class
+
+
+class PipelineStage(Params):
+    """Base for all stages: params + persistence."""
+
+    # ---- persistence ----------------------------------------------------
+    def save(self, path: str, overwrite: bool = True) -> None:
+        """Persist params (JSON) + complex payloads (one file per param).
+
+        Mirrors SparkML persistence + the reference's ``ComplexParam``
+        machinery (SURVEY.md §2.1 "Complex param serialization").
+        """
+        os.makedirs(path, exist_ok=True)
+        simple, complex_names = {}, []
+        for p in self.params():
+            if p.name not in self._paramMap:
+                continue
+            value = self._paramMap[p.name]
+            if isinstance(p, ComplexParam):
+                p.save_value(value, os.path.join(path, f"param_{p.name}.bin"))
+                complex_names.append(p.name)
+            else:
+                simple[p.name] = value
+        meta = {
+            "class": f"{type(self).__module__}.{type(self).__qualname__}",
+            "timestamp": time.time(),
+            "uid": self.uid,
+            "paramMap": simple,
+            "complexParams": complex_names,
+        }
+        with open(os.path.join(path, "metadata.json"), "w") as f:
+            json.dump(meta, f, indent=2, default=_json_default)
+        self._save_extra(path)
+
+    def _save_extra(self, path: str) -> None:
+        """Hook for subclasses with state outside the param map."""
+
+    def _load_extra(self, path: str) -> None:
+        pass
+
+    @classmethod
+    def load(cls, path: str) -> "PipelineStage":
+        with open(os.path.join(path, "metadata.json")) as f:
+            meta = json.load(f)
+        klass = resolve_class(meta["class"])
+        obj = klass.__new__(klass)
+        Params.__init__(obj)
+        obj.uid = meta.get("uid", obj.uid)
+        for k, v in meta["paramMap"].items():
+            if obj.hasParam(k):
+                obj.set(k, v)
+        for name in meta.get("complexParams", []):
+            p = obj.getParam(name)
+            obj._paramMap[name] = p.load_value(os.path.join(path, f"param_{name}.bin"))
+        obj._load_extra(path)
+        return obj
+
+    def write(self):
+        return _Writer(self)
+
+    @classmethod
+    def read(cls):
+        return _Reader(cls)
+
+
+class _Writer:
+    def __init__(self, stage):
+        self._stage = stage
+        self._overwrite = True
+
+    def overwrite(self):
+        self._overwrite = True
+        return self
+
+    def save(self, path):
+        self._stage.save(path, overwrite=self._overwrite)
+
+
+class _Reader:
+    def __init__(self, cls):
+        self._cls = cls
+
+    def load(self, path):
+        return self._cls.load(path)
+
+
+class Transformer(PipelineStage):
+    def transform(self, df: DataFrame) -> DataFrame:
+        df = DataFrame(df) if not isinstance(df, DataFrame) else df
+        return self._transform(df)
+
+    def _transform(self, df: DataFrame) -> DataFrame:
+        raise NotImplementedError
+
+
+class Estimator(PipelineStage):
+    def fit(self, df: DataFrame, params: Optional[Dict[str, Any]] = None) -> "Model":
+        df = DataFrame(df) if not isinstance(df, DataFrame) else df
+        est = self.copy(params) if params else self
+        return est._fit(df)
+
+    def _fit(self, df: DataFrame) -> "Model":
+        raise NotImplementedError
+
+
+class Model(Transformer):
+    """A fitted Transformer (SparkML ``Model``)."""
+
+
+class Evaluator(Params):
+    """Metric evaluator contract (SparkML ``Evaluator``)."""
+
+    def evaluate(self, df: DataFrame) -> float:
+        raise NotImplementedError
+
+    def isLargerBetter(self) -> bool:
+        return True
+
+
+@register_stage
+class Pipeline(Estimator):
+    """Chain of stages; ``fit`` threads the DataFrame through, fitting
+    estimators and collecting the resulting transformers."""
+
+    stages = ComplexParam("stages", "The stages of the pipeline", default=None)
+
+    def _fit(self, df: DataFrame) -> "PipelineModel":
+        fitted: List[Transformer] = []
+        cur = df
+        for stage in self.getStages() or []:
+            if isinstance(stage, Estimator):
+                model = stage.fit(cur)
+                fitted.append(model)
+                cur = model.transform(cur)
+            elif isinstance(stage, Transformer):
+                fitted.append(stage)
+                cur = stage.transform(cur)
+            else:
+                raise TypeError(f"Pipeline stage {stage!r} is neither Estimator nor Transformer")
+        return PipelineModel(stages=fitted)
+
+    def _save_extra(self, path):
+        _save_stage_list(self._stages_to_save, path)
+
+    def _load_extra(self, path):
+        self._paramMap["stages"] = _load_stage_list(path)
+
+    def save(self, path, overwrite=True):
+        # Stages persist as nested stage directories, not via the param map.
+        self._stages_to_save = self.getStages() or []
+        stages = self._paramMap.pop("stages", None)
+        try:
+            super().save(path, overwrite)
+        finally:
+            if stages is not None:
+                self._paramMap["stages"] = stages
+            del self._stages_to_save
+
+
+@register_stage
+class PipelineModel(Model):
+    stages = ComplexParam("stages", "The fitted stages", default=None)
+
+    def _transform(self, df: DataFrame) -> DataFrame:
+        for stage in self.getStages() or []:
+            df = stage.transform(df)
+        return df
+
+    def _save_extra(self, path):
+        _save_stage_list(self._stages_to_save, path)
+
+    def _load_extra(self, path):
+        self._paramMap["stages"] = _load_stage_list(path)
+
+    def save(self, path, overwrite=True):
+        self._stages_to_save = self.getStages() or []
+        stages = self._paramMap.pop("stages", None)
+        try:
+            super().save(path, overwrite)
+        finally:
+            if stages is not None:
+                self._paramMap["stages"] = stages
+            del self._stages_to_save
+
+
+def _save_stage_list(stages, path):
+    os.makedirs(os.path.join(path, "stages"), exist_ok=True)
+    order = []
+    for i, st in enumerate(stages):
+        sub = os.path.join(path, "stages", f"{i:03d}")
+        st.save(sub)
+        order.append(f"{i:03d}")
+    with open(os.path.join(path, "stages", "order.json"), "w") as f:
+        json.dump(order, f)
+
+
+def _load_stage_list(path):
+    with open(os.path.join(path, "stages", "order.json")) as f:
+        order = json.load(f)
+    return [PipelineStage.load(os.path.join(path, "stages", name)) for name in order]
+
+
+def _json_default(o):
+    import numpy as np
+
+    if isinstance(o, (np.integer,)):
+        return int(o)
+    if isinstance(o, (np.floating,)):
+        return float(o)
+    if isinstance(o, np.ndarray):
+        return o.tolist()
+    raise TypeError(f"not JSON serializable: {type(o).__name__}")

@@ -1,0 +1,47 @@
+"""Stage registry: persistence class resolution + fuzzing coverage.
+
+Two jobs, both inherited from the reference's design:
+
+1. ``resolve_class`` maps the fully-qualified class name stored in persisted
+   metadata back to a Python class (SparkML's ``DefaultParamsReader`` does the
+   JVM analog).
+2. ``register_stage`` records every public stage so the fuzzing test harness
+   (SURVEY.md §4.2 — ``SerializationFuzzing``/``ExperimentFuzzing``, and the
+   meta-test asserting every ``Wrappable`` appears in a fuzzing suite) can
+   enumerate the full surface.  A class may provide a ``test_objects()``
+   classmethod returning ``[(stage, fit_df_or_None, transform_df)]`` used by
+   ``tests/test_fuzzing.py``; the meta-test flags registered stages without
+   one.
+"""
+
+from __future__ import annotations
+
+import importlib
+from typing import Dict, List, Type
+
+_STAGES: Dict[str, type] = {}
+
+
+def register_stage(cls: type) -> type:
+    """Class decorator: record a public stage for fuzzing + persistence."""
+    _STAGES[f"{cls.__module__}.{cls.__qualname__}"] = cls
+    return cls
+
+
+def all_stage_classes() -> List[type]:
+    # Import the full surface so registration side effects have happened.
+    import mmlspark_tpu.all  # noqa: F401
+
+    return [c for _, c in sorted(_STAGES.items())]
+
+
+def resolve_class(qualified: str) -> type:
+    cls = _STAGES.get(qualified)
+    if cls is not None:
+        return cls
+    module, _, name = qualified.rpartition(".")
+    mod = importlib.import_module(module)
+    obj = mod
+    for part in name.split("."):
+        obj = getattr(obj, part)
+    return obj

@@ -1,0 +1,58 @@
+"""Test configuration: force an 8-device virtual CPU mesh BEFORE jax import.
+
+Mirrors the reference's test strategy of faking a cluster in-process
+(SURVEY.md §4.3: Spark ``local[*]`` with N partitions = N "machines"); here
+the analog is ``xla_force_host_platform_device_count=8`` so distributed
+``shard_map``/``psum`` paths run for real on one host (SURVEY.md §4
+"Rebuild mapping").
+"""
+
+import os
+import sys
+
+# The session interpreter imports jax at startup (a sitecustomize registers
+# the tunneled real-TPU "axon" PJRT platform and env presets
+# JAX_PLATFORMS=axon), so env-var changes here are too late — jax captured
+# them at import.  Backends initialize lazily though, so config updates made
+# before the first backend touch still win.
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)
+
+assert jax.default_backend() == "cpu", "tests must run on the CPU backend"
+assert jax.device_count() == 8, (
+    "expected an 8-device virtual CPU mesh; backend initialized too early"
+)
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture(scope="session")
+def binary_df():
+    """Small binary-classification DataFrame (breast-cancer, offline)."""
+    from sklearn.datasets import load_breast_cancer
+
+    from mmlspark_tpu import DataFrame
+
+    X, y = load_breast_cancer(return_X_y=True)
+    data = {f"f{i}": X[:, i] for i in range(X.shape[1])}
+    data["label"] = y.astype(np.float64)
+    data["features"] = list(X.astype(np.float64))
+    return DataFrame(data, num_partitions=2)
+
+
+@pytest.fixture(scope="session")
+def regression_df():
+    from sklearn.datasets import load_diabetes
+
+    from mmlspark_tpu import DataFrame
+
+    X, y = load_diabetes(return_X_y=True)
+    data = {f"f{i}": X[:, i] for i in range(X.shape[1])}
+    data["label"] = y.astype(np.float64)
+    data["features"] = list(X.astype(np.float64))
+    return DataFrame(data, num_partitions=2)

@@ -1,0 +1,212 @@
+"""Core contracts tests: params, frame, pipeline, persistence."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from mmlspark_tpu import DataFrame, Estimator, Model, Pipeline, Transformer
+from mmlspark_tpu.core.frame import find_unused_column_name
+from mmlspark_tpu.core.params import (
+    ComplexParam,
+    Param,
+    Params,
+    ParamValidators,
+    ServiceParam,
+)
+from mmlspark_tpu.core.registry import register_stage
+
+
+class Demo(Params):
+    alpha = Param("alpha", "a float", default=0.5, dtype=float,
+                  validator=ParamValidators.inRange(0, 1))
+    name = Param("name", "a string", dtype=str)
+    svc = ServiceParam("svc", "value-or-column")
+
+
+class TestParams:
+    def test_defaults_and_set(self):
+        d = Demo()
+        assert d.getAlpha() == 0.5
+        d.setAlpha(0.25)
+        assert d.alpha == 0.25
+        assert d.getOrDefault("alpha") == 0.25
+
+    def test_kwargs_ctor(self):
+        d = Demo(alpha=0.9, name="x")
+        assert d.getName() == "x" and d.getAlpha() == 0.9
+
+    def test_unknown_kwarg(self):
+        with pytest.raises(KeyError):
+            Demo(nope=1)
+
+    def test_validator(self):
+        with pytest.raises(ValueError):
+            Demo(alpha=3.0)
+
+    def test_type_coercion(self):
+        assert Demo(alpha=1).getAlpha() == 1.0
+        with pytest.raises(TypeError):
+            Demo(name=3)
+
+    def test_copy_isolated(self):
+        a = Demo(alpha=0.1)
+        b = a.copy({"alpha": 0.2})
+        assert a.getAlpha() == 0.1 and b.getAlpha() == 0.2
+
+    def test_explain(self):
+        text = Demo(alpha=0.7).explainParams()
+        assert "alpha" in text and "0.7" in text
+
+    def test_service_param(self):
+        d = Demo(svc="literal")
+        assert d.getOrDefault("svc") == {"value": "literal"}
+        d2 = Demo(svc={"col": "c"})
+        assert d2.getOrDefault("svc") == {"col": "c"}
+
+    def test_extract_param_map(self):
+        m = Demo(alpha=0.3).extractParamMap()
+        assert m["alpha"] == 0.3 and "name" not in m
+
+
+class TestFrame:
+    def make(self):
+        return DataFrame({"a": [1, 2, 3], "b": [4.0, 5.0, 6.0]}, num_partitions=2)
+
+    def test_basic(self):
+        df = self.make()
+        assert df.count() == 3
+        assert df.columns == ["a", "b"]
+        assert df.getNumPartitions() == 2
+
+    def test_with_column_and_select(self):
+        df = self.make().withColumn("c", [7, 8, 9]).select("a", "c")
+        assert df.columns == ["a", "c"]
+        np.testing.assert_array_equal(df["c"], [7, 8, 9])
+
+    def test_with_column_callable(self):
+        df = self.make().withColumn("s", lambda r: r.a + r.b)
+        np.testing.assert_allclose(df["s"], [5.0, 7.0, 9.0])
+
+    def test_filter_and_limit(self):
+        df = self.make().filter(lambda r: r.a > 1).limit(1)
+        assert df.count() == 1 and df.first().a == 2
+
+    def test_object_columns(self):
+        df = self.make().withColumn("v", [np.zeros(2), np.ones(3), np.zeros(1)])
+        assert len(df["v"][1]) == 3
+
+    def test_partition_slices_cover(self):
+        df = self.make().repartition(2)
+        slices = df.partition_slices()
+        assert sum(s.stop - s.start for s in slices) == 3
+
+    def test_metadata_travels(self):
+        df = self.make().withMetadata("a", {"categorical": True})
+        assert df.select("a").metadata("a") == {"categorical": True}
+        assert df.drop("a").metadata("a") == {}
+
+    def test_find_unused(self):
+        df = self.make()
+        assert find_unused_column_name("z", df) == "z"
+        assert find_unused_column_name("a", df) == "a_0"
+
+    def test_random_split(self):
+        df = DataFrame({"x": np.arange(100)})
+        a, b = df.randomSplit([0.7, 0.3], seed=1)
+        assert a.count() + b.count() == 100
+        assert 50 < a.count() < 90
+
+    def test_group_by(self):
+        df = DataFrame({"k": ["x", "x", "y"], "v": [1, 2, 3]})
+        out = df.groupBy("k").agg(total=("v", "sum")).toPandas()
+        assert dict(zip(out["k"], out["total"])) == {"x": 3, "y": 3}
+
+    def test_join_union(self):
+        left = DataFrame({"k": [1, 2], "a": [10, 20]})
+        right = DataFrame({"k": [2, 3], "b": [5, 6]})
+        j = left.join(right, on="k")
+        assert j.count() == 1 and j.first().a == 20
+        assert left.union(left).count() == 4
+
+
+@register_stage
+class AddConst(Transformer):
+    inputCol = Param("inputCol", "input", dtype=str, default="x")
+    outputCol = Param("outputCol", "output", dtype=str, default="y")
+    value = Param("value", "added constant", default=1.0, dtype=float)
+
+    def _transform(self, df):
+        return df.withColumn(self.getOutputCol(), df[self.getInputCol()] + self.getValue())
+
+    @classmethod
+    def test_objects(cls):
+        df = DataFrame({"x": [1.0, 2.0]})
+        return [(cls(value=2.0), None, df)]
+
+
+@register_stage
+class MeanShift(Estimator):
+    inputCol = Param("inputCol", "input", dtype=str, default="x")
+    outputCol = Param("outputCol", "output", dtype=str, default="y")
+
+    def _fit(self, df):
+        m = MeanShiftModel(inputCol=self.getInputCol(), outputCol=self.getOutputCol())
+        m._mean = float(np.mean(df[self.getInputCol()]))
+        return m
+
+    @classmethod
+    def test_objects(cls):
+        df = DataFrame({"x": [1.0, 3.0]})
+        return [(cls(), df, df)]
+
+
+@register_stage
+class MeanShiftModel(Model):
+    inputCol = Param("inputCol", "input", dtype=str, default="x")
+    outputCol = Param("outputCol", "output", dtype=str, default="y")
+    _mean = 0.0
+
+    def _transform(self, df):
+        return df.withColumn(self.getOutputCol(), df[self.getInputCol()] - self._mean)
+
+    def _save_extra(self, path):
+        import json, os
+
+        with open(os.path.join(path, "mean.json"), "w") as f:
+            json.dump({"mean": self._mean}, f)
+
+    def _load_extra(self, path):
+        import json, os
+
+        with open(os.path.join(path, "mean.json")) as f:
+            self._mean = json.load(f)["mean"]
+
+
+class TestPipeline:
+    def test_fit_transform(self):
+        df = DataFrame({"x": [1.0, 3.0]})
+        pipe = Pipeline(stages=[MeanShift(), AddConst(inputCol="y", outputCol="z", value=10.0)])
+        model = pipe.fit(df)
+        out = model.transform(df)
+        np.testing.assert_allclose(out["z"], [9.0, 11.0])
+
+    def test_pipeline_model_roundtrip(self, tmp_path):
+        df = DataFrame({"x": [1.0, 3.0]})
+        model = Pipeline(stages=[MeanShift()]).fit(df)
+        p = str(tmp_path / "pm")
+        model.save(p)
+        from mmlspark_tpu.core.pipeline import PipelineStage
+
+        loaded = PipelineStage.load(p)
+        np.testing.assert_allclose(loaded.transform(df)["y"], [-1.0, 1.0])
+
+    def test_transformer_roundtrip(self, tmp_path):
+        t = AddConst(value=5.0)
+        p = str(tmp_path / "t")
+        t.save(p)
+        from mmlspark_tpu.core.pipeline import PipelineStage
+
+        loaded = PipelineStage.load(p)
+        assert loaded.getValue() == 5.0
+        df = DataFrame({"x": [0.0]})
+        assert loaded.transform(df)["y"][0] == 5.0
