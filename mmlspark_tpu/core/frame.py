@@ -45,13 +45,15 @@ class DataFrame:
     def __init__(
         self,
         data: Union[pd.DataFrame, Dict[str, Any], List[dict]],
-        num_partitions: int = 1,
+        num_partitions: Optional[int] = None,
         metadata: Optional[Dict[str, dict]] = None,
     ):
         if isinstance(data, DataFrame):
             pdf = data._pdf
-            metadata = metadata or data._metadata
-            num_partitions = num_partitions or data.num_partitions
+            if metadata is None:
+                metadata = data._metadata
+            if num_partitions is None:
+                num_partitions = data.num_partitions
         elif isinstance(data, pd.DataFrame):
             pdf = data.reset_index(drop=True)
         elif isinstance(data, dict):
@@ -61,7 +63,7 @@ class DataFrame:
         else:
             raise TypeError(f"cannot build DataFrame from {type(data).__name__}")
         self._pdf = pdf
-        self.num_partitions = max(1, int(num_partitions))
+        self.num_partitions = max(1, int(num_partitions if num_partitions is not None else 1))
         self._metadata: Dict[str, dict] = dict(metadata or {})
 
     # ---- constructors ---------------------------------------------------

@@ -432,6 +432,8 @@ class HasServiceParams(Params):
 
     def getVectorParam(self, df, param):
         """Resolve a ServiceParam to a per-row list (or scalar broadcast)."""
+        if not self.isDefined(param):
+            return None
         v = self.getOrDefault(param)
         if v is None:
             return None
@@ -440,6 +442,8 @@ class HasServiceParams(Params):
         return [v["value"]] * df.count()
 
     def getScalarParam(self, param):
+        if not self.isDefined(param):
+            return None
         v = self.getOrDefault(param)
         if v is None:
             return None

@@ -28,7 +28,17 @@ class PipelineStage(Params):
 
         Mirrors SparkML persistence + the reference's ``ComplexParam``
         machinery (SURVEY.md §2.1 "Complex param serialization").
+        SparkML semantics: refuse a non-empty target unless ``overwrite``;
+        with ``overwrite``, replace it wholesale (no stale files merged in).
         """
+        if os.path.isdir(path) and os.listdir(path):
+            if not overwrite:
+                raise FileExistsError(
+                    f"path {path!r} already exists; use overwrite=True"
+                )
+            import shutil
+
+            shutil.rmtree(path)
         os.makedirs(path, exist_ok=True)
         simple, complex_names = {}, []
         for p in self.params():
@@ -136,70 +146,62 @@ class Evaluator(Params):
         return True
 
 
+class _StagesPersistence:
+    """Shared stage-list persistence: stages persist as nested stage
+    directories, not via the (non-JSON) param map."""
+
+    def _save_extra(self, path):
+        _save_stage_list(self._stages_to_save, path)
+
+    def _load_extra(self, path):
+        self._paramMap["stages"] = _load_stage_list(path)
+
+    def save(self, path, overwrite=True):
+        self._stages_to_save = self.getStages() or []
+        stages = self._paramMap.pop("stages", None)
+        try:
+            super().save(path, overwrite)
+        finally:
+            if stages is not None:
+                self._paramMap["stages"] = stages
+            del self._stages_to_save
+
+
 @register_stage
-class Pipeline(Estimator):
+class Pipeline(_StagesPersistence, Estimator):
     """Chain of stages; ``fit`` threads the DataFrame through, fitting
     estimators and collecting the resulting transformers."""
 
     stages = ComplexParam("stages", "The stages of the pipeline", default=None)
 
     def _fit(self, df: DataFrame) -> "PipelineModel":
+        stages = list(self.getStages() or [])
         fitted: List[Transformer] = []
         cur = df
-        for stage in self.getStages() or []:
+        for i, stage in enumerate(stages):
+            is_last = i == len(stages) - 1
             if isinstance(stage, Estimator):
                 model = stage.fit(cur)
                 fitted.append(model)
-                cur = model.transform(cur)
+                if not is_last:  # the last stage's output feeds nothing
+                    cur = model.transform(cur)
             elif isinstance(stage, Transformer):
                 fitted.append(stage)
-                cur = stage.transform(cur)
+                if not is_last:
+                    cur = stage.transform(cur)
             else:
                 raise TypeError(f"Pipeline stage {stage!r} is neither Estimator nor Transformer")
         return PipelineModel(stages=fitted)
 
-    def _save_extra(self, path):
-        _save_stage_list(self._stages_to_save, path)
-
-    def _load_extra(self, path):
-        self._paramMap["stages"] = _load_stage_list(path)
-
-    def save(self, path, overwrite=True):
-        # Stages persist as nested stage directories, not via the param map.
-        self._stages_to_save = self.getStages() or []
-        stages = self._paramMap.pop("stages", None)
-        try:
-            super().save(path, overwrite)
-        finally:
-            if stages is not None:
-                self._paramMap["stages"] = stages
-            del self._stages_to_save
-
 
 @register_stage
-class PipelineModel(Model):
+class PipelineModel(_StagesPersistence, Model):
     stages = ComplexParam("stages", "The fitted stages", default=None)
 
     def _transform(self, df: DataFrame) -> DataFrame:
         for stage in self.getStages() or []:
             df = stage.transform(df)
         return df
-
-    def _save_extra(self, path):
-        _save_stage_list(self._stages_to_save, path)
-
-    def _load_extra(self, path):
-        self._paramMap["stages"] = _load_stage_list(path)
-
-    def save(self, path, overwrite=True):
-        self._stages_to_save = self.getStages() or []
-        stages = self._paramMap.pop("stages", None)
-        try:
-            super().save(path, overwrite)
-        finally:
-            if stages is not None:
-                self._paramMap["stages"] = stages
-            del self._stages_to_save
 
 
 def _save_stage_list(stages, path):

@@ -1,0 +1,8 @@
+"""GBDT trainer engine: jitted leaf-wise tree growth + boosting orchestration.
+
+TPU-native replacement for LightGBM's native training core (SURVEY.md §2.9
+N1/N2 and §3.1 call stack).  The reference's per-executor native loop
+(``LGBM_BoosterUpdateOneIter`` with a blocking socket allreduce inside C++)
+becomes: one jitted SPMD program per boosting iteration, histograms reduced
+with ``lax.psum`` over the mesh axis when running under ``shard_map``.
+"""

@@ -1,0 +1,652 @@
+"""Boosting orchestration: the ``fit`` loop over jitted tree growth.
+
+This module is the TPU-native analog of the reference's per-task native
+training loop (SURVEY.md §3.1: ``LGBM_BoosterCreate`` + HOT LOOP of
+``LGBM_BoosterUpdateOneIter`` / ``LGBM_BoosterGetEval`` — [REF-EMPTY],
+upstream C++ ``src/boosting/gbdt.cpp``).  Differences by design:
+
+- The per-iteration work (objective grad/hess → bagging/GOSS → leaf-wise
+  growth → score update) is one jitted JAX program; the Python loop around it
+  is control only (early stopping, metric records, DART bookkeeping) —
+  mirroring how the reference keeps its loop in Scala but the work native.
+- Boosting modes: ``gbdt``, ``rf``, ``dart``, ``goss`` (SURVEY.md §2.3.1
+  ``boostingType``).
+- ``boost_from_average`` folds the initial score into tree 0's leaf values
+  (LightGBM's ``Tree::AddBias`` behavior) so saved models predict
+  identically without a separate init-score field.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from functools import partial
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from mmlspark_tpu.engine import eval_metrics
+from mmlspark_tpu.engine.tree import (
+    GrowConfig,
+    Tree,
+    grow_tree,
+    predict_tree_binned,
+    predict_tree_leaf_binned,
+)
+from mmlspark_tpu.ops.binning import BinMapper
+from mmlspark_tpu.ops.histogram import DEFAULT_CHUNK
+from mmlspark_tpu.ops.objectives import LambdaRank, Objective, get_objective
+
+
+@dataclasses.dataclass
+class TrainConfig:
+    """LightGBM-vocabulary training config.
+
+    Field names follow LightGBM's config strings because the reference's
+    ``TrainParams`` serializes SparkML params into exactly that vocabulary
+    (SURVEY.md §5.6, §2.3.1) — keeping it preserves the param-surface
+    contract ("the native config parser is the last word").
+    """
+
+    objective: str = "regression"
+    num_iterations: int = 100
+    learning_rate: float = 0.1
+    num_leaves: int = 31
+    max_bin: int = 255
+    max_depth: int = -1
+    min_data_in_leaf: int = 20
+    min_sum_hessian_in_leaf: float = 1e-3
+    lambda_l1: float = 0.0
+    lambda_l2: float = 0.0
+    min_gain_to_split: float = 0.0
+    bagging_fraction: float = 1.0
+    bagging_freq: int = 0
+    bagging_seed: int = 3
+    feature_fraction: float = 1.0
+    feature_fraction_seed: int = 2
+    boosting: str = "gbdt"
+    top_rate: float = 0.2
+    other_rate: float = 0.1
+    drop_rate: float = 0.1
+    max_drop: int = 50
+    skip_drop: float = 0.5
+    drop_seed: int = 4
+    num_class: int = 1
+    sigmoid: float = 1.0
+    alpha: float = 0.9
+    fair_c: float = 1.0
+    poisson_max_delta_step: float = 0.7
+    tweedie_variance_power: float = 1.5
+    early_stopping_round: int = 0
+    metric: Optional[str] = None
+    is_unbalance: bool = False
+    scale_pos_weight: float = 1.0
+    boost_from_average: bool = True
+    categorical_feature: Sequence[int] = dataclasses.field(default_factory=tuple)
+    label_gain: Optional[Sequence[float]] = None
+    max_position: int = 20
+    seed: int = 0
+    tree_learner: str = "serial"
+    top_k: int = 20
+    hist_backend: str = "scatter"
+    hist_chunk: int = DEFAULT_CHUNK
+    verbosity: int = 1
+
+    _ALIASES = {
+        "num_boost_round": "num_iterations",
+        "n_iter": "num_iterations",
+        "num_trees": "num_iterations",
+        "num_round": "num_iterations",
+        "shrinkage_rate": "learning_rate",
+        "eta": "learning_rate",
+        "max_leaves": "num_leaves",
+        "num_leaf": "num_leaves",
+        "min_data": "min_data_in_leaf",
+        "min_child_samples": "min_data_in_leaf",
+        "min_sum_hessian": "min_sum_hessian_in_leaf",
+        "min_child_weight": "min_sum_hessian_in_leaf",
+        "reg_alpha": "lambda_l1",
+        "reg_lambda": "lambda_l2",
+        "sub_row": "bagging_fraction",
+        "subsample": "bagging_fraction",
+        "subsample_freq": "bagging_freq",
+        "sub_feature": "feature_fraction",
+        "colsample_bytree": "feature_fraction",
+        "boosting_type": "boosting",
+        "boost": "boosting",
+        "early_stopping_rounds": "early_stopping_round",
+        "unbalance": "is_unbalance",
+        "application": "objective",
+        "loss": "objective",
+    }
+
+    @classmethod
+    def from_params(cls, params: dict) -> "TrainConfig":
+        import warnings
+
+        fields = {f.name for f in dataclasses.fields(cls)}
+        kwargs, unknown = {}, []
+        for k, v in params.items():
+            k = cls._ALIASES.get(k, k)
+            if k in fields:
+                kwargs[k] = v
+            else:
+                unknown.append(k)
+        if unknown:
+            # LightGBM logs "Unknown parameter"; surface typos the same way.
+            warnings.warn(f"Unknown training parameter(s) ignored: {sorted(unknown)}")
+        return cls(**kwargs)
+
+    def objective_params(self) -> dict:
+        return {
+            "sigmoid": self.sigmoid,
+            "alpha": self.alpha,
+            "fair_c": self.fair_c,
+            "poisson_max_delta_step": self.poisson_max_delta_step,
+            "tweedie_variance_power": self.tweedie_variance_power,
+            "num_class": self.num_class,
+            "label_gain": self.label_gain,
+            "max_position": self.max_position,
+        }
+
+
+class Dataset:
+    """Training data container (the moral analog of LightGBM's ``Dataset``
+    built per executor task from partition rows — SURVEY.md §3.1
+    ``generateDataset``)."""
+
+    def __init__(
+        self,
+        X: np.ndarray,
+        label: np.ndarray,
+        weight: Optional[np.ndarray] = None,
+        group: Optional[np.ndarray] = None,
+        init_score: Optional[np.ndarray] = None,
+    ):
+        self.X = np.ascontiguousarray(X, dtype=np.float64)
+        self.label = np.asarray(label, dtype=np.float64)
+        self.weight = None if weight is None else np.asarray(weight, dtype=np.float64)
+        self.group = None if group is None else np.asarray(group, dtype=np.int64)
+        self.init_score = (
+            None if init_score is None else np.asarray(init_score, dtype=np.float64)
+        )
+        self.num_rows, self.num_features = self.X.shape
+
+
+def _pad_rows(arr: np.ndarray, n_pad: int, value=0):
+    if n_pad == 0:
+        return arr
+    pad_shape = (n_pad,) + arr.shape[1:]
+    return np.concatenate([arr, np.full(pad_shape, value, dtype=arr.dtype)], axis=0)
+
+
+class Booster:
+    """A trained forest: stacked tree arrays + binning state.
+
+    Parity surface: the reference's ``LightGBMBooster`` wrapper
+    (UPSTREAM:.../lightgbm/LightGBMBooster.scala — SURVEY.md §2.3: score,
+    predictLeaf, saveNativeModel, getFeatureImportances).
+    """
+
+    def __init__(
+        self,
+        trees: Tree,  # arrays with leading (T, K) axes
+        tree_weights: np.ndarray,  # (T,)
+        bin_mapper: BinMapper,
+        config: TrainConfig,
+        best_iteration: int = -1,
+        average_output: bool = False,
+    ):
+        self.trees = trees
+        self.tree_weights = np.asarray(tree_weights, dtype=np.float64)
+        self.bin_mapper = bin_mapper
+        self.config = config
+        self.best_iteration = best_iteration
+        self.average_output = average_output
+        self.objective = get_objective(config.objective, **config.objective_params())
+        self.evals_result: Dict[str, Dict[str, List[float]]] = {}
+        self._predict_cache: Dict[Tuple, callable] = {}
+
+    # -- introspection ---------------------------------------------------
+    @property
+    def num_iterations(self) -> int:
+        return int(self.trees.split_leaf.shape[0])
+
+    @property
+    def num_class(self) -> int:
+        return int(self.trees.split_leaf.shape[1])
+
+    @property
+    def num_features(self) -> int:
+        return self.bin_mapper.num_features
+
+    def _used_iters(self, num_iteration: Optional[int]) -> int:
+        if num_iteration is not None and num_iteration > 0:
+            return min(num_iteration, self.num_iterations)
+        if self.best_iteration >= 0:
+            return self.best_iteration + 1
+        return self.num_iterations
+
+    # -- prediction ------------------------------------------------------
+    def _forest_fn(self, T: int, kind: str):
+        key = (T, kind)
+        if key not in self._predict_cache:
+            nb = self.bin_mapper.num_bins
+
+            if kind == "raw":
+
+                def fn(trees, weights, bins):
+                    def per_class(tree_k):
+                        def body(acc, tw):
+                            tree, w = tw
+                            return acc + w * predict_tree_binned(tree, bins, nb), None
+
+                        out, _ = jax.lax.scan(
+                            body, jnp.zeros(bins.shape[0], jnp.float32), (tree_k, weights)
+                        )
+                        return out
+
+                    # trees arrays: (T, K, ...) → vmap over K
+                    return jax.vmap(per_class, in_axes=(1,))(trees)  # (K, n)
+
+            else:  # leaf indices
+
+                def fn(trees, weights, bins):
+                    def per_class(tree_k):
+                        def body(_, tree):
+                            return None, predict_tree_leaf_binned(tree, bins, nb)
+
+                        _, leaves = jax.lax.scan(body, None, tree_k)
+                        return leaves  # (T, n)
+
+                    return jax.vmap(per_class, in_axes=(1,))(trees)  # (K, T, n)
+
+            self._predict_cache[key] = jax.jit(fn)
+        return self._predict_cache[key]
+
+    def _slice_trees(self, T: int) -> Tree:
+        return Tree(*[a[:T] for a in self.trees])
+
+    def predict(
+        self,
+        X: np.ndarray,
+        raw_score: bool = False,
+        pred_leaf: bool = False,
+        num_iteration: Optional[int] = None,
+    ) -> np.ndarray:
+        """Batch scoring.  Replaces the reference's per-row JNI
+        ``LGBM_BoosterPredictForMat`` crossing (SURVEY.md §3.2) with one
+        jitted whole-batch program."""
+        X = np.asarray(X, dtype=np.float64)
+        bins = jnp.asarray(self.bin_mapper.transform(X))
+        T = self._used_iters(num_iteration)
+        trees = self._slice_trees(T)
+        weights = jnp.asarray(self.tree_weights[:T], dtype=jnp.float32)
+        if pred_leaf:
+            leaves = self._forest_fn(T, "leaf")(trees, weights, bins)
+            out = np.asarray(leaves)  # (K, T, n)
+            K, _, n = out.shape
+            return out.transpose(2, 1, 0).reshape(n, T * K)
+        raw = np.asarray(self._forest_fn(T, "raw")(trees, weights, bins))  # (K, n)
+        if self.average_output:
+            raw = raw / max(T, 1)
+        if raw_score:
+            return raw[0] if raw.shape[0] == 1 else raw.T
+        tr = np.asarray(self.objective.transform(jnp.asarray(raw)))
+        return tr[0] if tr.shape[0] == 1 else tr.T
+
+    def feature_importance(self, importance_type: str = "split") -> np.ndarray:
+        """Split-count or total-gain importances (parity:
+        ``LightGBMBooster.getFeatureImportances`` — SURVEY.md §2.3)."""
+        feats = np.asarray(self.trees.split_feat).reshape(-1)
+        active = np.asarray(self.trees.split_leaf).reshape(-1) >= 0
+        F = self.num_features
+        out = np.zeros(F)
+        if importance_type == "split":
+            np.add.at(out, feats[active], 1.0)
+        else:
+            gains = np.asarray(self.trees.split_gain).reshape(-1)
+            np.add.at(out, feats[active], gains[active])
+        return out
+
+    # -- persistence (LightGBM text format lives in ops/model_string) ----
+    def save_model_string(self) -> str:
+        from mmlspark_tpu.ops.model_string import booster_to_string
+
+        return booster_to_string(self)
+
+    @staticmethod
+    def from_model_string(s: str) -> "Booster":
+        from mmlspark_tpu.ops.model_string import booster_from_string
+
+        return booster_from_string(s)
+
+
+# ---------------------------------------------------------------------------
+# Sampling helpers (bagging / GOSS / feature_fraction)
+# ---------------------------------------------------------------------------
+def _bag_weights(key, cfg: TrainConfig, valid_mask, grad_abs):
+    """Per-row bag weight for this iteration (0 = excluded).
+
+    GOSS (``boosting="goss"``): keep the top ``top_rate`` fraction by
+    |gradient|, sample ``other_rate`` of the rest amplified by
+    (1-top_rate)/other_rate — LightGBM's gradient one-side sampling.
+    """
+    n = valid_mask.shape[0]
+    n_valid = jnp.sum(valid_mask)
+    if cfg.boosting == "goss":
+        a, b = cfg.top_rate, cfg.other_rate
+        k_top = jnp.maximum((n_valid * a).astype(jnp.int32), 1)
+        g = jnp.where(valid_mask, grad_abs, -1.0)
+        order = jnp.argsort(-g)
+        rank = jnp.argsort(order)
+        top = rank < k_top
+        rest = valid_mask & ~top
+        u = jax.random.uniform(key, (n,))
+        sampled = rest & (u < b)
+        amp = (1.0 - a) / max(b, 1e-12)
+        return jnp.where(top, 1.0, jnp.where(sampled, amp, 0.0))
+    frac = cfg.bagging_fraction
+    if frac < 1.0:
+        u = jax.random.uniform(key, (n,))
+        return (valid_mask & (u < frac)).astype(jnp.float32)
+    return valid_mask.astype(jnp.float32)
+
+
+def _feature_mask(key, F: int, fraction: float):
+    if fraction >= 1.0:
+        return jnp.ones(F, bool)
+    k = max(1, int(math.ceil(F * fraction)))
+    u = jax.random.uniform(key, (F,))
+    order = jnp.argsort(-u)
+    rank = jnp.argsort(order)
+    return rank < k
+
+
+# ---------------------------------------------------------------------------
+# The training loop
+# ---------------------------------------------------------------------------
+def train(
+    params: dict,
+    train_set: Dataset,
+    valid_sets: Sequence[Dataset] = (),
+    valid_names: Optional[Sequence[str]] = None,
+    bin_mapper: Optional[BinMapper] = None,
+    init_model: Optional[Booster] = None,
+) -> Booster:
+    """Single-host training entry (the distributed path wraps the same
+    grower via ``mmlspark_tpu.parallel`` — SURVEY.md §7.3.3)."""
+    cfg = params if isinstance(params, TrainConfig) else TrainConfig.from_params(params)
+    if cfg.boosting == "dart" and cfg.early_stopping_round > 0:
+        # Later DART iterations rescale earlier trees, so a truncated-at-
+        # best-iteration model cannot reproduce the selected metric.
+        # LightGBM forbids the combination for the same reason.
+        raise ValueError("early stopping is not available in dart mode")
+    if cfg.boosting == "rf" and not (cfg.bagging_freq > 0 and cfg.bagging_fraction < 1.0):
+        # Without bagging every RF tree would be identical (LightGBM raises
+        # the equivalent config check).
+        raise ValueError(
+            "boosting='rf' requires bagging_freq > 0 and bagging_fraction < 1"
+        )
+    if cfg.categorical_feature:
+        # Categorical membership splits (LightGBM's sorted-category
+        # algorithm) are not wired into the grower yet; fail loudly rather
+        # than silently degrading to ordinal splits over category ids.
+        raise NotImplementedError(
+            "categorical_feature support is not implemented yet; "
+            "one-hot or ordinal-encode categoricals explicitly for now"
+        )
+    obj = get_objective(cfg.objective, **cfg.objective_params())
+    K = obj.num_model_per_iteration
+
+    # ---- binning -------------------------------------------------------
+    if bin_mapper is None:
+        bin_mapper = BinMapper(
+            max_bin=cfg.max_bin,
+            categorical_features=tuple(cfg.categorical_feature),
+            seed=cfg.seed,
+        ).fit(train_set.X)
+    bins_np = bin_mapper.transform(train_set.X)
+    n, F = bins_np.shape
+    B = bin_mapper.num_bins
+
+    # ---- padding to the histogram chunk --------------------------------
+    chunk = cfg.hist_chunk
+    n_pad = 0 if n <= chunk else (-n) % chunk
+    bins_np = _pad_rows(bins_np, n_pad)
+    y = _pad_rows(train_set.label, n_pad)
+    valid_mask_np = np.concatenate([np.ones(n, bool), np.zeros(n_pad, bool)])
+
+    # ---- weights (is_unbalance / scale_pos_weight) ---------------------
+    w = train_set.weight
+    if cfg.objective == "binary":
+        pos = max(float((train_set.label > 0).sum()), 1.0)
+        neg = max(float((train_set.label <= 0).sum()), 1.0)
+        if cfg.is_unbalance:
+            spw = neg / pos
+        else:
+            spw = cfg.scale_pos_weight
+        if spw != 1.0:
+            base = np.ones(n) if w is None else np.asarray(w, dtype=np.float64)
+            w = np.where(train_set.label > 0, base * spw, base)
+    w_np = None if w is None else _pad_rows(np.asarray(w, dtype=np.float64), n_pad)
+
+    if isinstance(obj, LambdaRank):
+        if train_set.group is None:
+            raise ValueError("lambdarank requires group sizes")
+        obj.set_groups(train_set.group)
+
+    # ---- init score ----------------------------------------------------
+    # dart (tree rescaling would corrupt the folded bias) and rf (averaged
+    # output would divide it) keep a zero init instead of bias folding.
+    use_bfa = (
+        cfg.boost_from_average
+        and cfg.boosting not in ("dart", "rf")
+        and train_set.init_score is None
+    )
+    if use_bfa:
+        init = obj.init_score(train_set.label, train_set.weight)
+    else:
+        init = np.zeros(K) if K > 1 else 0.0
+    init_arr = np.broadcast_to(np.asarray(init, dtype=np.float32).reshape(-1, 1), (K, n + n_pad)).copy()
+    if train_set.init_score is not None:
+        init_arr = init_arr + _pad_rows(
+            train_set.init_score.astype(np.float32), n_pad
+        ).reshape(1, -1)
+    scores = jnp.asarray(init_arr)
+
+    # ---- device-resident data ------------------------------------------
+    bins_dev = jnp.asarray(bins_np)
+    y_dev = jnp.asarray(y, dtype=jnp.float32)
+    w_dev = None if w_np is None else jnp.asarray(w_np, dtype=jnp.float32)
+    valid_mask = jnp.asarray(valid_mask_np)
+
+    gcfg = GrowConfig(
+        num_bins=B,
+        num_leaves=cfg.num_leaves,
+        max_depth=cfg.max_depth,
+        min_data_in_leaf=cfg.min_data_in_leaf,
+        min_sum_hessian_in_leaf=cfg.min_sum_hessian_in_leaf,
+        lambda_l1=cfg.lambda_l1,
+        lambda_l2=cfg.lambda_l2,
+        min_gain_to_split=cfg.min_gain_to_split,
+        learning_rate=cfg.learning_rate if cfg.boosting != "rf" else 1.0,
+        hist_backend=cfg.hist_backend,
+        hist_chunk=chunk,
+    )
+
+    grow = jax.vmap(partial(grow_tree, gcfg), in_axes=(None, 0, 0, None, 0))
+
+    @jax.jit
+    def iteration(scores, key, bag_in):
+        grad, hess = obj.grad_hess(scores if K > 1 else scores[0], y_dev, w_dev)
+        if K == 1:
+            grad, hess = grad[None, :], hess[None, :]
+        gkey, fkey = jax.random.split(key)
+        # Decouple the feature-sampling stream from bagging (LightGBM has
+        # independent feature_fraction_seed / bagging_seed streams).
+        fkey = jax.random.fold_in(fkey, cfg.feature_fraction_seed)
+        if cfg.boosting == "goss":
+            # GOSS resamples every iteration from the current gradients.
+            grad_abs = jnp.sum(jnp.abs(grad), axis=0)
+            bag = _bag_weights(gkey, cfg, valid_mask, grad_abs)
+        else:
+            bag = bag_in
+        fmask = jax.vmap(lambda k: _feature_mask(k, F, cfg.feature_fraction))(
+            jax.random.split(fkey, K)
+        )
+        tree, leaf_ids = grow(bins_dev, grad, hess, bag, fmask)
+        delta = jax.vmap(lambda lv, li: lv[li])(tree.leaf_value, leaf_ids)
+        return tree, delta
+
+    # LightGBM bagging semantics: a bag is drawn at iterations where
+    # ``it % bagging_freq == 0`` and *reused* until the next draw.
+    resample_bag = jax.jit(
+        lambda key: _bag_weights(key, cfg, valid_mask, jnp.zeros(valid_mask.shape[0]))
+    )
+    do_bagging = cfg.bagging_fraction < 1.0 and cfg.bagging_freq > 0
+    full_bag = valid_mask.astype(jnp.float32)
+    current_bag = full_bag
+
+    # ---- valid sets ----------------------------------------------------
+    vsets = []
+    names = list(valid_names) if valid_names else [f"valid_{i}" for i in range(len(valid_sets))]
+    for vs in valid_sets:
+        vb = jnp.asarray(bin_mapper.transform(vs.X))
+        vscore = np.broadcast_to(
+            np.asarray(init, dtype=np.float32).reshape(-1, 1), (K, vs.num_rows)
+        ).copy()
+        if vs.init_score is not None:
+            vscore = vscore + vs.init_score.astype(np.float32).reshape(1, -1)
+        vsets.append({"bins": vb, "scores": jnp.asarray(vscore), "data": vs})
+
+    predict_v = jax.jit(
+        lambda tree, vbins: jax.vmap(lambda t: predict_tree_binned(t, vbins, B))(tree)
+    )
+
+    # ---- metrics / early stopping --------------------------------------
+    metric_name = cfg.metric or obj.default_metric
+    metric_fn, higher_better, needs_groups = eval_metrics.get_metric(
+        metric_name, alpha=cfg.alpha
+    )
+    best_score, best_iter = (-np.inf if higher_better else np.inf), -1
+
+    def eval_metric(scores_arr, dset: Dataset):
+        s = np.asarray(scores_arr)
+        s_eval = s if K > 1 else s[0]
+        kw = {}
+        if needs_groups:
+            kw["group_sizes"] = dset.group
+        return metric_fn(dset.label, s_eval[..., : dset.num_rows] if K > 1 else s_eval[: dset.num_rows], w=dset.weight, **kw)
+
+    # ---- DART / RF state ----------------------------------------------
+    trees_host: List[Tree] = []
+    tree_weights: List[float] = []
+    rng = np.random.default_rng(cfg.drop_seed)
+    evals_result: Dict[str, Dict[str, List[float]]] = {nm: {metric_name: []} for nm in names}
+    key = jax.random.PRNGKey(cfg.bagging_seed + 7919 * cfg.seed)
+
+    for it in range(cfg.num_iterations):
+        key, sub = jax.random.split(key)
+        if do_bagging and it % cfg.bagging_freq == 0:
+            key, bag_key = jax.random.split(key)
+            current_bag = resample_bag(bag_key)
+        dropped_idx: List[int] = []
+        if cfg.boosting == "dart" and trees_host and rng.random() >= cfg.skip_drop:
+            mask = rng.random(len(trees_host)) < cfg.drop_rate
+            dropped_idx = list(np.nonzero(mask)[0][: cfg.max_drop])
+            if not dropped_idx:
+                dropped_idx = [int(rng.integers(len(trees_host)))]
+        if dropped_idx:
+            drop_pred = []
+            for t_i in dropped_idx:
+                p = predict_v(trees_host[t_i], bins_dev)
+                drop_pred.append(p)
+                scores = scores - tree_weights[t_i] * p
+
+        if cfg.boosting == "rf":
+            train_scores = jnp.asarray(init_arr)  # RF: every tree fits the init residual
+        else:
+            train_scores = scores
+
+        tree, delta = iteration(train_scores, sub, current_bag)
+
+        # boost_from_average bias folding into tree 0 (LightGBM AddBias).
+        # Running scores already start at the init value, so the in-loop
+        # ``delta`` stays unbiased — only the *stored* tree gets the bias so
+        # that predict-time Σtrees reproduces init + residuals.
+        w_new = 1.0
+        if it == 0 and use_bfa:
+            bias = jnp.asarray(np.asarray(init, dtype=np.float32).reshape(K, 1))
+            active = jnp.arange(cfg.num_leaves)[None, :] < tree.num_leaves[:, None]
+            tree = tree._replace(leaf_value=jnp.where(active, tree.leaf_value + bias, 0.0))
+        if dropped_idx:
+            # DART normalization: new tree weighted 1/(k+1), dropped trees
+            # rescaled by k/(k+1) and re-added (DART paper; LightGBM
+            # ``DartBooster`` semantics with learning rate folded in leaves).
+            k = len(dropped_idx)
+            w_new = 1.0 / (k + 1.0)
+            factor = k / (k + 1.0)
+            for j, t_i in enumerate(dropped_idx):
+                tree_weights[t_i] *= factor
+                scores = scores + tree_weights[t_i] * drop_pred[j]
+        # RF keeps a running sum averaged at eval time; boosted modes add the
+        # (possibly DART-weighted) new tree.
+        scores = scores + w_new * delta
+
+        trees_host.append(jax.tree_util.tree_map(lambda a: np.asarray(a), tree))
+        tree_weights.append(w_new)
+
+        # ---- validation & early stopping -------------------------------
+        stop = False
+        for nm, vs in zip(names, vsets):
+            # Valid scores start at init; the stored tree-0 bias must not be
+            # double counted, so replay the *unbiased* growth delta.  The
+            # stored tree already includes the bias, so subtract it back out.
+            vdelta = predict_v(tree, vs["bins"])
+            if it == 0 and use_bfa:
+                vdelta = vdelta - jnp.asarray(
+                    np.asarray(init, dtype=np.float32).reshape(K, 1)
+                )
+            if dropped_idx:
+                k = len(dropped_idx)
+                factor = k / (k + 1.0)
+                for t_i in dropped_idx:
+                    vp = predict_v(trees_host[t_i], vs["bins"])
+                    # tree_weights[t_i] is already rescaled; its previous value
+                    # was tree_weights[t_i]/factor.
+                    vs["scores"] = vs["scores"] + (
+                        tree_weights[t_i] - tree_weights[t_i] / factor
+                    ) * vp
+            vs["scores"] = vs["scores"] + w_new * vdelta
+            div = (it + 1) if cfg.boosting == "rf" else 1
+            m = eval_metric(vs["scores"] / div, vs["data"])
+            evals_result[nm][metric_name].append(m)
+            if cfg.early_stopping_round > 0 and nm == names[0]:
+                improved = m > best_score if higher_better else m < best_score
+                if improved:
+                    best_score, best_iter = m, it
+                elif it - best_iter >= cfg.early_stopping_round:
+                    stop = True
+        if stop:
+            break
+
+    # ---- stack trees ----------------------------------------------------
+    stacked = Tree(
+        *[
+            np.stack([getattr(t, f) for t in trees_host], axis=0)
+            for f in Tree._fields
+        ]
+    )
+    booster = Booster(
+        trees=Tree(*[jnp.asarray(a) for a in stacked]),
+        tree_weights=np.asarray(tree_weights),
+        bin_mapper=bin_mapper,
+        config=cfg,
+        best_iteration=best_iter if cfg.early_stopping_round > 0 and best_iter >= 0 else -1,
+        average_output=cfg.boosting == "rf",
+    )
+    booster.evals_result = evals_result
+    return booster

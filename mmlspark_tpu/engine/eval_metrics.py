@@ -1,0 +1,154 @@
+"""Host-side evaluation metrics for training/early-stopping loops.
+
+Parity: LightGBM's ``metric`` vocabulary as exposed by the reference's
+``metric``/``earlyStoppingRound``/``isProvideTrainingMetric`` params
+(SURVEY.md §2.3.1).  These run on host numpy over raw scores — they sit in
+the per-iteration control loop, not in the jitted hot path.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, Optional, Tuple
+
+import numpy as np
+
+
+def _sigmoid(x):
+    return 1.0 / (1.0 + np.exp(-x))
+
+
+def _softmax(x, axis=0):
+    x = x - x.max(axis=axis, keepdims=True)
+    e = np.exp(x)
+    return e / e.sum(axis=axis, keepdims=True)
+
+
+def auc(y, score, w=None):
+    """Weighted ROC-AUC via the rank statistic (no sklearn dependency in the
+    engine; matches LightGBM's ``auc``)."""
+    y = np.asarray(y)
+    order = np.argsort(score, kind="mergesort")
+    y_s = y[order]
+    w_s = np.ones_like(y_s, dtype=np.float64) if w is None else np.asarray(w)[order]
+    s_sorted = np.asarray(score)[order]
+    pos_w, neg_w = w_s * (y_s > 0), w_s * (y_s <= 0)
+    cum_neg = np.cumsum(neg_w)
+    # Tie handling: average rank within tied score groups.
+    _, inv, counts = np.unique(s_sorted, return_inverse=True, return_counts=True)
+    grp_cumneg = np.zeros(len(counts))
+    np.add.at(grp_cumneg, inv, neg_w)
+    ends = np.cumsum(counts) - 1
+    below = cum_neg[ends][inv] - grp_cumneg[inv]
+    auc_sum = np.sum(pos_w * (below + 0.5 * grp_cumneg[inv]))
+    tp, tn = pos_w.sum(), neg_w.sum()
+    if tp == 0 or tn == 0:
+        return 0.5
+    return float(auc_sum / (tp * tn))
+
+
+def binary_logloss(y, score, w=None):
+    p = np.clip(_sigmoid(score), 1e-15, 1 - 1e-15)
+    ll = -(y * np.log(p) + (1 - y) * np.log(1 - p))
+    return float(np.average(ll, weights=w))
+
+
+def binary_error(y, score, w=None):
+    pred = (_sigmoid(score) > 0.5).astype(np.float64)
+    return float(np.average(pred != y, weights=w))
+
+
+def l2(y, score, w=None):
+    return float(np.average((y - score) ** 2, weights=w))
+
+
+def rmse(y, score, w=None):
+    return float(np.sqrt(l2(y, score, w)))
+
+
+def l1(y, score, w=None):
+    return float(np.average(np.abs(y - score), weights=w))
+
+
+def mape(y, score, w=None):
+    return float(np.average(np.abs(y - score) / np.maximum(np.abs(y), 1.0), weights=w))
+
+
+def quantile_loss(alpha):
+    def m(y, score, w=None):
+        d = y - score
+        return float(np.average(np.maximum(alpha * d, (alpha - 1) * d), weights=w))
+
+    return m
+
+
+def poisson_nll(y, score, w=None):
+    # score is raw (log link)
+    return float(np.average(np.exp(score) - y * score, weights=w))
+
+
+def multi_logloss(y, score, w=None):
+    # score (K, n)
+    p = np.clip(_softmax(score, axis=0), 1e-15, None)
+    ll = -np.log(p[np.asarray(y, dtype=np.int64), np.arange(score.shape[1])])
+    return float(np.average(ll, weights=w))
+
+
+def multi_error(y, score, w=None):
+    pred = np.argmax(score, axis=0)
+    return float(np.average(pred != np.asarray(y), weights=w))
+
+
+def ndcg_at(k):
+    def m(y, score, w=None, group_sizes=None):
+        assert group_sizes is not None, "ndcg needs query group sizes"
+        y, score = np.asarray(y, dtype=np.float64), np.asarray(score)
+        out, start = [], 0
+        for s in group_sizes:
+            ys, ss = y[start : start + s], score[start : start + s]
+            start += s
+            order = np.argsort(-ss, kind="mergesort")
+            gains = 2.0 ** ys[order] - 1.0
+            disc = 1.0 / np.log2(np.arange(2, len(ys) + 2))
+            dcg = float(np.sum((gains * disc)[:k]))
+            ideal = np.sort(ys)[::-1]
+            idcg = float(np.sum(((2.0**ideal - 1.0) * disc)[:k]))
+            out.append(dcg / idcg if idcg > 0 else 1.0)
+        return float(np.mean(out)) if out else 0.0
+
+    return m
+
+
+# name -> (fn, higher_is_better, needs_groups)
+_METRICS: Dict[str, Tuple[Callable, bool, bool]] = {
+    "auc": (auc, True, False),
+    "binary_logloss": (binary_logloss, False, False),
+    "binary_error": (binary_error, False, False),
+    "l2": (l2, False, False),
+    "mse": (l2, False, False),
+    "mean_squared_error": (l2, False, False),
+    "rmse": (rmse, False, False),
+    "l1": (l1, False, False),
+    "mae": (l1, False, False),
+    "mean_absolute_error": (l1, False, False),
+    "mape": (mape, False, False),
+    "poisson": (poisson_nll, False, False),
+    "multi_logloss": (multi_logloss, False, False),
+    "multi_error": (multi_error, False, False),
+    "quantile": (quantile_loss(0.9), False, False),
+    "huber": (l2, False, False),
+    "fair": (l1, False, False),
+    "gamma": (poisson_nll, False, False),
+    "tweedie": (poisson_nll, False, False),
+    "ndcg": (ndcg_at(5), True, True),
+}
+for _k in (1, 2, 3, 4, 5, 10, 20):
+    _METRICS[f"ndcg@{_k}"] = (ndcg_at(_k), True, True)
+
+
+def get_metric(name: str, **params):
+    name = name.lower()
+    if name == "quantile" and "alpha" in params:
+        return quantile_loss(float(params["alpha"])), False, False
+    if name not in _METRICS:
+        raise ValueError(f"unknown metric {name!r}; known: {sorted(_METRICS)}")
+    return _METRICS[name]

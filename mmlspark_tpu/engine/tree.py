@@ -1,0 +1,292 @@
+"""Leaf-wise (best-first) tree growth as a single jitted program.
+
+Reference behavior being reproduced: LightGBM's ``SerialTreeLearner`` /
+``DataParallelTreeLearner`` leaf-wise growth (upstream C++
+``src/treelearner/serial_tree_learner.cpp`` — [REF-EMPTY]; surfaced in the
+reference through ``LGBM_BoosterUpdateOneIter``, SURVEY.md §3.1 hot loop).
+
+TPU-first redesign (SURVEY.md §7.4.1 "Leaf-wise growth under XLA static
+shapes"):
+
+- The tree is a **fixed-size array program**: ``max_leaves-1`` split steps
+  run in a ``lax.fori_loop``; a ``stopped`` flag masks steps after growth
+  ends, so shapes never depend on data.
+- Row→leaf assignment is a dense ``leaf_ids`` vector updated in place —
+  leaf-id recompute instead of LightGBM's index-array data partitions
+  (gather-free; SURVEY.md §7.4.1 "prefer leaf-id recompute").
+- Split bookkeeping uses the histogram-subtraction trick: the new right
+  child's histogram is built by one masked pass; the left child's is the
+  parent's minus the right's (same trick LightGBM uses).
+- Under ``shard_map`` (``axis_name`` set), histograms are ``psum``-med, so
+  every shard computes the identical argmax split — the decision path is
+  replicated, only the row data is sharded.  This is byte-for-byte the
+  "data_parallel" tree learner semantics of the reference
+  (SURVEY.md §2 parallelism table).
+
+Leaf numbering: the root is leaf 0; the split at step ``s`` keeps the left
+child in the parent's slot and assigns the right child id ``s+1``.  This is
+exactly LightGBM's numbering, which makes the exported model string's
+``split_feature``/``leaf_value`` ordering match.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from mmlspark_tpu.ops.histogram import build_histogram
+
+
+@dataclasses.dataclass(frozen=True)
+class GrowConfig:
+    """Static (trace-time) knobs of the grower.
+
+    Field names follow LightGBM config names (the reference's ``TrainParams``
+    flattens SparkML params into this vocabulary — SURVEY.md §5.6).
+    """
+
+    num_bins: int  # total bins incl. missing bin (= BinMapper.num_bins)
+    num_leaves: int = 31
+    max_depth: int = -1
+    min_data_in_leaf: int = 20
+    min_sum_hessian_in_leaf: float = 1e-3
+    lambda_l1: float = 0.0
+    lambda_l2: float = 0.0
+    min_gain_to_split: float = 0.0
+    learning_rate: float = 0.1
+    hist_backend: str = "scatter"
+    hist_chunk: int = 16_384
+    axis_name: Optional[str] = None  # set under shard_map for psum
+
+    @property
+    def num_value_bins(self) -> int:
+        return self.num_bins - 1  # last bin is the missing bin
+
+    @property
+    def max_steps(self) -> int:
+        return self.num_leaves - 1
+
+
+class Tree(NamedTuple):
+    """One grown tree as flat arrays (S = num_leaves-1, L = num_leaves)."""
+
+    split_leaf: jnp.ndarray  # (S,) int32; leaf id split at step s; -1 = no-op
+    split_feat: jnp.ndarray  # (S,) int32
+    split_bin: jnp.ndarray  # (S,) int32; bins <= split_bin go left
+    default_left: jnp.ndarray  # (S,) bool; missing-bin direction
+    split_gain: jnp.ndarray  # (S,) float32
+    leaf_value: jnp.ndarray  # (L,) float32 (includes learning-rate shrinkage)
+    leaf_count: jnp.ndarray  # (L,) float32 (bagged row counts)
+    num_leaves: jnp.ndarray  # () int32
+
+
+def _l1_threshold(G, l1):
+    return jnp.sign(G) * jnp.maximum(jnp.abs(G) - l1, 0.0)
+
+
+def _leaf_score(G, H, l1, l2):
+    Gt = _l1_threshold(G, l1)
+    return (Gt * Gt) / (H + l2 + 1e-15)
+
+
+def _leaf_output(G, H, l1, l2, lr):
+    return -_l1_threshold(G, l1) / (H + l2 + 1e-15) * lr
+
+
+def _best_split(cfg: GrowConfig, hists, leaf_stats, leaf_depth, num_leaves, feat_mask):
+    """Scan all (leaf, feature, threshold, missing-dir) candidates.
+
+    hists: (L, F, B, 3) with channels (Σgrad, Σhess, Σcount).
+    Returns (gain, leaf, feat, bin, default_left) of the best candidate.
+    """
+    L, F, B, _ = hists.shape
+    VB = B - 1
+    cum = jnp.cumsum(hists[:, :, :VB, :], axis=2)  # (L, F, VB, 3)
+    missing = hists[:, :, B - 1, :]  # (L, F, 3)
+    total = leaf_stats[:, None, None, None, :]  # (L,1,1,1,3)
+
+    # dir 0: missing goes right; dir 1: missing goes left.
+    left0 = cum[:, :, :, None, :]
+    left1 = (cum + missing[:, :, None, :])[:, :, :, None, :]
+    left = jnp.concatenate([left0, left1], axis=3)  # (L, F, VB, 2, 3)
+    right = total - left
+
+    Gl, Hl, Cl = left[..., 0], left[..., 1], left[..., 2]
+    Gr, Hr, Cr = right[..., 0], right[..., 1], right[..., 2]
+    parent = _leaf_score(leaf_stats[:, 0], leaf_stats[:, 1], cfg.lambda_l1, cfg.lambda_l2)
+    gain = (
+        _leaf_score(Gl, Hl, cfg.lambda_l1, cfg.lambda_l2)
+        + _leaf_score(Gr, Hr, cfg.lambda_l1, cfg.lambda_l2)
+        - parent[:, None, None, None]
+    )
+
+    valid = (
+        (Cl >= cfg.min_data_in_leaf)
+        & (Cr >= cfg.min_data_in_leaf)
+        & (Hl >= cfg.min_sum_hessian_in_leaf)
+        & (Hr >= cfg.min_sum_hessian_in_leaf)
+    )
+    valid &= feat_mask[None, :, None, None]
+    leaf_ok = jnp.arange(L) < num_leaves
+    if cfg.max_depth > 0:
+        leaf_ok &= leaf_depth < cfg.max_depth
+    valid &= leaf_ok[:, None, None, None]
+
+    gain = jnp.where(valid, gain, -jnp.inf)
+    flat = gain.reshape(-1)
+    best = jnp.argmax(flat)
+    best_gain = flat[best]
+    l, rem = jnp.divmod(best, F * VB * 2)
+    f, rem = jnp.divmod(rem, VB * 2)
+    t, d = jnp.divmod(rem, 2)
+    return best_gain, l.astype(jnp.int32), f.astype(jnp.int32), t.astype(jnp.int32), d == 1
+
+
+def grow_tree(
+    cfg: GrowConfig,
+    bins: jnp.ndarray,  # (n, F) integer bins (uint8/int32)
+    grad: jnp.ndarray,  # (n,)
+    hess: jnp.ndarray,  # (n,)
+    bag_weight: jnp.ndarray,  # (n,) float; 0 = out of bag, GOSS amplification
+    feat_mask: jnp.ndarray,  # (F,) bool; feature_fraction sampling
+) -> Tuple[Tree, jnp.ndarray]:
+    """Grow one tree; returns the tree and the final per-row leaf ids.
+
+    Jit-safe and shard_map-safe: with ``cfg.axis_name`` set, ``bins``/rows are
+    the local shard and all histogram sums are globally reduced.
+    """
+    n, F = bins.shape
+    B, L, S = cfg.num_bins, cfg.num_leaves, cfg.max_steps
+    bins = bins.astype(jnp.int32)
+    in_bag = (bag_weight > 0).astype(jnp.float32)
+    vals = jnp.stack(
+        [grad * bag_weight, hess * bag_weight, in_bag], axis=-1
+    ).astype(jnp.float32)
+
+    def hist(mask):
+        return build_histogram(
+            bins, vals, mask, B,
+            backend=cfg.hist_backend, chunk=cfg.hist_chunk, axis_name=cfg.axis_name,
+        )
+
+    root_hist = hist(jnp.ones(n, bool))
+    hists = jnp.zeros((L, F, B, 3), jnp.float32).at[0].set(root_hist)
+    # Every feature's bins partition all rows, so feature 0's bin-sum is the
+    # leaf total.
+    leaf_stats = jnp.zeros((L, 3), jnp.float32).at[0].set(root_hist[0].sum(axis=0))
+    leaf_ids = jnp.zeros(n, jnp.int32)
+    leaf_depth = jnp.zeros(L, jnp.int32)
+
+    tree0 = Tree(
+        split_leaf=jnp.full(S, -1, jnp.int32),
+        split_feat=jnp.zeros(S, jnp.int32),
+        split_bin=jnp.zeros(S, jnp.int32),
+        default_left=jnp.zeros(S, bool),
+        split_gain=jnp.zeros(S, jnp.float32),
+        leaf_value=jnp.zeros(L, jnp.float32),
+        leaf_count=jnp.zeros(L, jnp.float32),
+        num_leaves=jnp.asarray(1, jnp.int32),
+    )
+
+    def step(s, carry):
+        leaf_ids, hists, leaf_stats, leaf_depth, tree, stopped = carry
+        gain, l, f, t, dleft = _best_split(
+            cfg, hists, leaf_stats, leaf_depth, tree.num_leaves, feat_mask
+        )
+        do = (gain > cfg.min_gain_to_split) & ~stopped
+
+        fcol = lax.dynamic_index_in_dim(bins, f, axis=1, keepdims=False)
+        is_missing = fcol == (B - 1)
+        goes_left = jnp.where(is_missing, dleft, fcol <= t)
+        new_id = s + 1
+        move = do & (leaf_ids == l) & ~goes_left
+        leaf_ids = jnp.where(move, new_id, leaf_ids)
+
+        right_hist = hist(leaf_ids == new_id)  # zeros when not do (no rows moved)
+        dof = do.astype(jnp.float32)
+        hists = hists.at[new_id].set(right_hist * dof)
+        hists = hists.at[l].add(-right_hist * dof)
+        right_total = right_hist[0].sum(axis=0)
+        leaf_stats = leaf_stats.at[new_id].set(right_total * dof)
+        leaf_stats = leaf_stats.at[l].add(-right_total * dof)
+        child_depth = leaf_depth[l] + 1
+        leaf_depth = leaf_depth.at[new_id].set(jnp.where(do, child_depth, 0))
+        leaf_depth = leaf_depth.at[l].set(jnp.where(do, child_depth, leaf_depth[l]))
+
+        tree = tree._replace(
+            split_leaf=tree.split_leaf.at[s].set(jnp.where(do, l, -1)),
+            split_feat=tree.split_feat.at[s].set(jnp.where(do, f, 0)),
+            split_bin=tree.split_bin.at[s].set(jnp.where(do, t, 0)),
+            default_left=tree.default_left.at[s].set(do & dleft),
+            split_gain=tree.split_gain.at[s].set(jnp.where(do, gain, 0.0)),
+            num_leaves=tree.num_leaves + do.astype(jnp.int32),
+        )
+        return (leaf_ids, hists, leaf_stats, leaf_depth, tree, stopped | ~do)
+
+    carry = (leaf_ids, hists, leaf_stats, leaf_depth, tree0, jnp.asarray(False))
+    leaf_ids, hists, leaf_stats, leaf_depth, tree, _ = lax.fori_loop(0, S, step, carry)
+
+    leaf_value = _leaf_output(
+        leaf_stats[:, 0], leaf_stats[:, 1], cfg.lambda_l1, cfg.lambda_l2, cfg.learning_rate
+    )
+    active = jnp.arange(L) < tree.num_leaves
+    tree = tree._replace(
+        leaf_value=jnp.where(active, leaf_value, 0.0),
+        leaf_count=leaf_stats[:, 2],
+    )
+    return tree, leaf_ids
+
+
+def predict_tree_binned(tree: Tree, bins: jnp.ndarray, num_bins: int) -> jnp.ndarray:
+    """Replay a tree's splits over binned rows → per-row leaf values.
+
+    Split replay keeps prediction gather-free over tree topology: rows start
+    in leaf 0 and each recorded split moves the affected rows, mirroring the
+    growth procedure exactly (same arithmetic ⇒ train/predict parity).
+    """
+    n = bins.shape[0]
+    bins = bins.astype(jnp.int32)
+    S = tree.split_leaf.shape[0]
+
+    def step(s, leaf_ids):
+        active = tree.split_leaf[s] >= 0
+        fcol = lax.dynamic_index_in_dim(bins, tree.split_feat[s], axis=1, keepdims=False)
+        is_missing = fcol == (num_bins - 1)
+        goes_left = jnp.where(is_missing, tree.default_left[s], fcol <= tree.split_bin[s])
+        move = active & (leaf_ids == tree.split_leaf[s]) & ~goes_left
+        return jnp.where(move, s + 1, leaf_ids)
+
+    leaf_ids = lax.fori_loop(0, S, step, jnp.zeros(n, jnp.int32))
+    return tree.leaf_value[leaf_ids]
+
+
+def predict_tree_leaf_binned(tree: Tree, bins: jnp.ndarray, num_bins: int) -> jnp.ndarray:
+    """Per-row leaf *index* (for ``leafPredictionCol`` — SURVEY.md §2.3.1)."""
+    n = bins.shape[0]
+    bins = bins.astype(jnp.int32)
+    S = tree.split_leaf.shape[0]
+
+    def step(s, leaf_ids):
+        active = tree.split_leaf[s] >= 0
+        fcol = lax.dynamic_index_in_dim(bins, tree.split_feat[s], axis=1, keepdims=False)
+        is_missing = fcol == (num_bins - 1)
+        goes_left = jnp.where(is_missing, tree.default_left[s], fcol <= tree.split_bin[s])
+        move = active & (leaf_ids == tree.split_leaf[s]) & ~goes_left
+        return jnp.where(move, s + 1, leaf_ids)
+
+    return lax.fori_loop(0, S, step, jnp.zeros(n, jnp.int32))
+
+
+def predict_forest_binned(trees: Tree, bins: jnp.ndarray, num_bins: int) -> jnp.ndarray:
+    """Sum of per-tree predictions for stacked trees (leading axis T)."""
+
+    def body(acc, tree):
+        return acc + predict_tree_binned(tree, bins, num_bins), None
+
+    init = jnp.zeros(bins.shape[0], jnp.float32)
+    out, _ = lax.scan(body, init, trees)
+    return out

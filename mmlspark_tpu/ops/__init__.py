@@ -1,0 +1,6 @@
+"""Numerical building blocks: binning, histograms, objectives, trees, ONNX.
+
+These are the TPU-native replacements for the reference's native engines
+(SURVEY.md §2.9 N1–N6): LightGBM's C++ histogram learner becomes JAX/Pallas
+kernels here; CNTK/ONNX evaluation becomes XLA-lowered graphs.
+"""

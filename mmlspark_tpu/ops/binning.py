@@ -1,0 +1,200 @@
+"""Quantile feature binning: float matrix → small-int bin matrix.
+
+TPU-native replacement for LightGBM's ``BinMapper`` (reference native
+component N1, SURVEY.md §2.9: upstream C++ ``src/io/bin.cpp`` — [REF-EMPTY]
+provenance; the reference repo shipped it inside the prebuilt ``lightgbmlib``
+jar).  The GBDT engine never touches raw floats on-device: features are
+quantile-binned on the host (or in the C++ native binner,
+``native/binner.cpp``) into at most ``max_bin`` integer bins per feature, and
+the uint8 binned matrix is what lives in HBM (SURVEY.md §7.2).
+
+Binning contract (kept LightGBM-compatible so AUC parity holds —
+SURVEY.md §7.4.3/§7.4.5):
+
+- Bin boundaries are chosen from a sample of distinct values so that bins get
+  roughly equal sample mass; if a feature has ≤ ``max_bin`` distinct values,
+  each distinct value gets its own bin (exact, no quantization loss).
+- ``upper_bounds[f][t]`` is the inclusive upper boundary of bin ``t``; a raw
+  value ``v`` maps to the first bin with ``v <= upper`` — and at predict time
+  a split at bin ``t`` becomes the raw-value rule ``v <= upper_bounds[f][t]``
+  (this is exactly LightGBM's threshold semantics, which makes the exported
+  model string score identically on raw features).
+- Missing values (NaN) map to the dedicated last bin index
+  (``missing_bin = num_bins - 1``); the split finder learns a per-split
+  default direction for them.
+- Categorical features are binned by category index (most-frequent categories
+  first, overflow→missing bin), and split by membership sets.
+
+The distributed variant bins from a merged multi-partition sample so every
+worker agrees on boundaries (SURVEY.md §7.4.3 "1TB binning": replicate
+LightGBM's sample-based bin finding).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+MAX_SAMPLE = 200_000  # LightGBM bin_construct_sample_cnt default
+
+
+@dataclass
+class BinMapper:
+    """Per-dataset binning state (fit once, apply to train/valid/test)."""
+
+    max_bin: int = 255
+    categorical_features: Sequence[int] = field(default_factory=list)
+    min_data_in_bin: int = 3
+    seed: int = 0
+
+    # fitted state
+    upper_bounds: List[np.ndarray] = field(default_factory=list)
+    cat_maps: Dict[int, np.ndarray] = field(default_factory=dict)  # bin -> raw category
+    num_features: int = 0
+
+    @property
+    def num_bins(self) -> int:
+        """Total bin count per feature incl. the reserved missing bin."""
+        return self.max_bin + 1
+
+    @property
+    def missing_bin(self) -> int:
+        return self.max_bin
+
+    def is_categorical(self, f: int) -> bool:
+        return f in set(self.categorical_features)
+
+    # ------------------------------------------------------------------
+    def fit(self, X: np.ndarray, sample_weight: Optional[np.ndarray] = None) -> "BinMapper":
+        X = np.asarray(X, dtype=np.float64)
+        n, F = X.shape
+        self.num_features = F
+        rng = np.random.default_rng(self.seed)
+        if n > MAX_SAMPLE:
+            idx = rng.choice(n, MAX_SAMPLE, replace=False)
+            Xs = X[idx]
+        else:
+            Xs = X
+        self.upper_bounds = []
+        cat_set = set(self.categorical_features)
+        for f in range(F):
+            col = Xs[:, f]
+            col = col[~np.isnan(col)]
+            if f in cat_set:
+                self.upper_bounds.append(self._fit_categorical(f, col))
+            else:
+                self.upper_bounds.append(self._fit_numeric(col))
+        return self
+
+    def _fit_numeric(self, col: np.ndarray) -> np.ndarray:
+        if col.size == 0:
+            return np.array([np.inf])
+        distinct, counts = np.unique(col, return_counts=True)
+        if len(distinct) <= self.max_bin:
+            # One bin per distinct value; boundary = midpoint to the next
+            # value (upper-inclusive), last bin open to +inf.
+            uppers = np.empty(len(distinct))
+            uppers[:-1] = (distinct[:-1] + distinct[1:]) / 2.0
+            uppers[-1] = np.inf
+            return uppers
+        # Equal-mass binning over the sample distribution, splitting only at
+        # distinct-value boundaries (LightGBM's greedy equal-count strategy).
+        total = counts.sum()
+        target = max(total / self.max_bin, self.min_data_in_bin)
+        uppers = []
+        acc = 0.0
+        for i in range(len(distinct) - 1):
+            acc += counts[i]
+            if acc >= target and len(uppers) < self.max_bin - 1:
+                uppers.append((distinct[i] + distinct[i + 1]) / 2.0)
+                acc = 0.0
+        uppers.append(np.inf)
+        return np.asarray(uppers)
+
+    def _fit_categorical(self, f: int, col: np.ndarray) -> np.ndarray:
+        cats, counts = np.unique(col.astype(np.int64), return_counts=True)
+        order = np.argsort(-counts, kind="stable")
+        kept = cats[order][: self.max_bin]
+        self.cat_maps[f] = np.sort(kept)
+        return np.array([np.inf])  # unused for categorical features
+
+    # ------------------------------------------------------------------
+    def transform(self, X: np.ndarray) -> np.ndarray:
+        """Raw float matrix → binned matrix (uint8 if bins fit, else int32)."""
+        X = np.asarray(X, dtype=np.float64)
+        n, F = X.shape
+        if F != self.num_features:
+            raise ValueError(f"expected {self.num_features} features, got {F}")
+        dtype = np.uint8 if self.num_bins <= 256 else np.int32
+        out = np.empty((n, F), dtype=dtype)
+        cat_set = set(self.categorical_features)
+        for f in range(F):
+            col = X[:, f]
+            nan = np.isnan(col)
+            if f in cat_set:
+                cats = self.cat_maps[f]
+                pos = np.searchsorted(cats, col.astype(np.int64), side="left")
+                pos_c = np.clip(pos, 0, len(cats) - 1)
+                hit = (pos < len(cats)) & (cats[pos_c] == col.astype(np.int64)) & ~nan
+                out[:, f] = np.where(hit, pos_c, self.missing_bin).astype(dtype)
+            else:
+                bins = np.searchsorted(self.upper_bounds[f], col, side="left")
+                out[:, f] = np.where(nan, self.missing_bin, bins).astype(dtype)
+        return out
+
+    def fit_transform(self, X: np.ndarray) -> np.ndarray:
+        return self.fit(X).transform(X)
+
+    # ------------------------------------------------------------------
+    def bin_to_threshold(self, f: int, t: int) -> float:
+        """Raw-value threshold for a numeric split at bin ``t`` (≤ goes left)."""
+        return float(self.upper_bounds[f][min(t, len(self.upper_bounds[f]) - 1)])
+
+    def num_value_bins(self, f: int) -> int:
+        if self.is_categorical(f):
+            return len(self.cat_maps[f])
+        return len(self.upper_bounds[f])
+
+    # ---- persistence ---------------------------------------------------
+    def to_dict(self) -> dict:
+        return {
+            "max_bin": self.max_bin,
+            "categorical_features": list(self.categorical_features),
+            "min_data_in_bin": self.min_data_in_bin,
+            "num_features": self.num_features,
+            "upper_bounds": [u.tolist() for u in self.upper_bounds],
+            "cat_maps": {str(k): v.tolist() for k, v in self.cat_maps.items()},
+        }
+
+    @staticmethod
+    def from_dict(d: dict) -> "BinMapper":
+        bm = BinMapper(
+            max_bin=d["max_bin"],
+            categorical_features=list(d["categorical_features"]),
+            min_data_in_bin=d["min_data_in_bin"],
+        )
+        bm.num_features = d["num_features"]
+        bm.upper_bounds = [np.asarray(u) for u in d["upper_bounds"]]
+        bm.cat_maps = {int(k): np.asarray(v) for k, v in d["cat_maps"].items()}
+        return bm
+
+
+def merge_samples_and_fit(
+    samples: Sequence[np.ndarray],
+    max_bin: int = 255,
+    categorical_features: Sequence[int] = (),
+    seed: int = 0,
+) -> BinMapper:
+    """Fit a shared BinMapper from per-partition samples.
+
+    Distributed binning parity (SURVEY.md §7.4.3): every worker samples its
+    partition, samples are concatenated (driver-side), and one mapper is fit
+    so all workers bin identically — mirroring LightGBM's global
+    ``bin_construct_sample_cnt`` sampling.
+    """
+    X = np.concatenate([np.asarray(s, dtype=np.float64) for s in samples], axis=0)
+    return BinMapper(
+        max_bin=max_bin, categorical_features=categorical_features, seed=seed
+    ).fit(X)

@@ -1,0 +1,98 @@
+"""Gradient-histogram construction — the GBDT hot loop.
+
+TPU-native replacement for LightGBM's histogram construction (reference
+native component N1, SURVEY.md §2.9: upstream C++ ``src/treelearner/*`` and
+its CUDA kernels, shipped prebuilt in the ``lightgbmlib`` jar — [REF-EMPTY]).
+
+Three interchangeable backends build the same (features, bins, 3) tensor of
+``(Σgrad, Σhess, Σcount)`` per (feature, bin):
+
+- ``scatter``  — ``jnp...at[].add`` scatter-add.  Reference semantics; the
+  backend used on the CPU test mesh.
+- ``onehot``   — blocked one-hot × values matmul: the contraction lands on
+  the MXU, with feature-blocking to bound the materialized one-hot tile.
+  This is the jit-only TPU path.
+- ``pallas``   — Pallas kernel (``mmlspark_tpu.ops.pallas_hist``) doing the
+  one-hot-matmul trick with the one-hot tile living in VMEM only.
+
+All are row-chunked with ``lax.scan`` so peak memory is bounded by the chunk,
+not the dataset (HBM holds only the uint8 binned matrix — SURVEY.md §7.2).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+# Default rows per scan chunk; callers pad row counts to a multiple.
+DEFAULT_CHUNK = 16_384
+
+
+def _scatter_hist_chunk(bins_c, vals_c, num_bins: int):
+    """(C, F) int bins, (C, 3) vals → (F, B, 3) via scatter-add."""
+    C, F = bins_c.shape
+    idx = bins_c.astype(jnp.int32) + jnp.arange(F, dtype=jnp.int32)[None, :] * num_bins
+    contrib = jnp.broadcast_to(vals_c[:, None, :], (C, F, 3)).reshape(C * F, 3)
+    flat = jnp.zeros((F * num_bins, 3), jnp.float32).at[idx.reshape(-1)].add(contrib)
+    return flat.reshape(F, num_bins, 3)
+
+
+def _onehot_hist_chunk(bins_c, vals_c, num_bins: int, feat_block: int = 8):
+    """Same contraction as ``_scatter_hist_chunk`` but as MXU matmuls."""
+    C, F = bins_c.shape
+    pad_f = (-F) % feat_block
+    if pad_f:
+        # Padded features all hit bin 0 with zero value — harmless.
+        bins_c = jnp.pad(bins_c, ((0, 0), (0, pad_f)))
+    Fp = F + pad_f
+    blocks = bins_c.reshape(C, Fp // feat_block, feat_block).transpose(1, 0, 2)
+
+    def block_hist(bl):  # (C, feat_block)
+        oh = (bl[:, :, None] == jnp.arange(num_bins, dtype=bl.dtype)[None, None, :])
+        oh = oh.astype(jnp.float32).reshape(C, feat_block * num_bins)
+        return (oh.T @ vals_c).reshape(feat_block, num_bins, 3)
+
+    hist = lax.map(block_hist, blocks)  # (Fp/fb, fb, B, 3)
+    return hist.reshape(Fp, num_bins, 3)[:F]
+
+
+def build_histogram(
+    bins: jnp.ndarray,
+    vals: jnp.ndarray,
+    mask: jnp.ndarray,
+    num_bins: int,
+    backend: str = "scatter",
+    chunk: int = DEFAULT_CHUNK,
+    axis_name: Optional[str] = None,
+) -> jnp.ndarray:
+    """Histogram of ``vals`` (n, 3) over (feature, bin), rows gated by ``mask``.
+
+    When ``axis_name`` is set (running inside ``shard_map`` over row shards),
+    the result is ``psum``-med across the mesh axis — this single line is the
+    replacement for LightGBM's socket allreduce of histograms
+    (``LGBM_NetworkInit`` + recursive-halving allreduce; SURVEY.md §3.1,
+    §5.8 native component N2).
+    """
+    n, F = bins.shape
+    vals = jnp.where(mask[:, None], vals, 0.0).astype(jnp.float32)
+    fn = _scatter_hist_chunk if backend != "onehot" else _onehot_hist_chunk
+    if n <= chunk:
+        hist = fn(bins, vals, num_bins)
+    else:
+        if n % chunk != 0:
+            raise ValueError(f"row count {n} not a multiple of chunk {chunk}")
+        bc = bins.reshape(n // chunk, chunk, F)
+        vc = vals.reshape(n // chunk, chunk, 3)
+
+        def body(acc, xs):
+            b, v = xs
+            return acc + fn(b, v, num_bins), None
+
+        hist, _ = lax.scan(body, jnp.zeros((F, num_bins, 3), jnp.float32), (bc, vc))
+    if axis_name is not None:
+        hist = lax.psum(hist, axis_name)
+    return hist

@@ -1,0 +1,321 @@
+"""GBDT engine tests: binning, histograms, tree growth, boosting quality.
+
+Quality thresholds follow the reference's benchmark-pinned test style
+(SURVEY.md §4.3–4.4: AUC-threshold asserts on small datasets), with sklearn's
+HistGradientBoosting as the offline stand-in oracle for stock LightGBM
+(BASELINE.md "Actions" item 3)."""
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu.ops.binning import BinMapper, merge_samples_and_fit
+from mmlspark_tpu.ops.objectives import get_objective
+
+
+def _toy_xy(n=400, f=8, seed=0):
+    assert f >= 4
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, f))
+    logits = X[:, 0] * 1.5 - X[:, 1] + 0.5 * X[:, 2] * X[:, 3]
+    y = (logits + rng.normal(scale=0.5, size=n) > 0).astype(np.float64)
+    return X, y
+
+
+class TestBinning:
+    def test_distinct_values_get_exact_bins(self):
+        X = np.array([[0.0], [1.0], [2.0], [1.0], [0.0]])
+        bm = BinMapper(max_bin=255).fit(X)
+        b = bm.transform(X)[:, 0]
+        assert set(b) == {0, 1, 2}
+        # raw thresholds are midpoints
+        assert bm.bin_to_threshold(0, 0) == 0.5
+
+    def test_quantile_binning_balanced(self):
+        rng = np.random.default_rng(0)
+        X = rng.normal(size=(10_000, 1))
+        bm = BinMapper(max_bin=16).fit(X)
+        b = bm.transform(X)[:, 0]
+        counts = np.bincount(b, minlength=16)
+        assert counts[:16].min() > 200  # roughly equal mass
+
+    def test_missing_goes_to_missing_bin(self):
+        X = np.array([[1.0], [np.nan], [2.0]])
+        bm = BinMapper(max_bin=8).fit(X)
+        b = bm.transform(X)[:, 0]
+        assert b[1] == bm.missing_bin
+        assert b[0] != bm.missing_bin
+
+    def test_categorical_binning(self):
+        X = np.array([[3.0], [3.0], [7.0], [9.0], [7.0], [3.0]])
+        bm = BinMapper(max_bin=8, categorical_features=[0]).fit(X)
+        b = bm.transform(X)[:, 0]
+        assert len(set(b)) == 3
+        # unseen category → missing bin
+        b2 = bm.transform(np.array([[5.0]]))[:, 0]
+        assert b2[0] == bm.missing_bin
+
+    def test_merged_sample_fit(self):
+        X, _ = _toy_xy()
+        bm = merge_samples_and_fit([X[:200], X[200:]], max_bin=32)
+        assert bm.num_features == X.shape[1]
+        assert bm.transform(X).max() < bm.num_bins
+
+    def test_roundtrip_dict(self):
+        X, _ = _toy_xy(100, 4)
+        bm = BinMapper(max_bin=16).fit(X)
+        bm2 = BinMapper.from_dict(bm.to_dict())
+        np.testing.assert_array_equal(bm.transform(X), bm2.transform(X))
+
+
+class TestHistogram:
+    def test_scatter_matches_numpy(self):
+        import jax.numpy as jnp
+
+        from mmlspark_tpu.ops.histogram import build_histogram
+
+        rng = np.random.default_rng(1)
+        n, F, B = 257, 5, 16
+        bins = rng.integers(0, B, size=(n, F))
+        grad = rng.normal(size=n)
+        hess = rng.uniform(0.1, 1, size=n)
+        mask = rng.random(n) > 0.3
+        vals = np.stack([grad, hess, np.ones(n)], -1)
+        hist = np.asarray(
+            build_histogram(jnp.asarray(bins), jnp.asarray(vals), jnp.asarray(mask), B)
+        )
+        for f in range(F):
+            for b in range(B):
+                sel = (bins[:, f] == b) & mask
+                np.testing.assert_allclose(hist[f, b, 0], grad[sel].sum(), rtol=1e-5, atol=1e-5)
+                np.testing.assert_allclose(hist[f, b, 2], sel.sum(), rtol=1e-6)
+
+    def test_onehot_matches_scatter(self):
+        import jax.numpy as jnp
+
+        from mmlspark_tpu.ops.histogram import build_histogram
+
+        rng = np.random.default_rng(2)
+        n, F, B = 128, 7, 12
+        bins = jnp.asarray(rng.integers(0, B, size=(n, F)))
+        vals = jnp.asarray(rng.normal(size=(n, 3)))
+        mask = jnp.ones(n, bool)
+        h1 = build_histogram(bins, vals, mask, B, backend="scatter")
+        h2 = build_histogram(bins, vals, mask, B, backend="onehot")
+        np.testing.assert_allclose(np.asarray(h1), np.asarray(h2), rtol=1e-4, atol=1e-4)
+
+    def test_chunked_matches_unchunked(self):
+        import jax.numpy as jnp
+
+        from mmlspark_tpu.ops.histogram import build_histogram
+
+        rng = np.random.default_rng(3)
+        n, F, B = 512, 3, 8
+        bins = jnp.asarray(rng.integers(0, B, size=(n, F)))
+        vals = jnp.asarray(rng.normal(size=(n, 3)))
+        mask = jnp.ones(n, bool)
+        h1 = build_histogram(bins, vals, mask, B, chunk=128)
+        h2 = build_histogram(bins, vals, mask, B, chunk=1024)
+        np.testing.assert_allclose(np.asarray(h1), np.asarray(h2), rtol=1e-4, atol=1e-4)
+
+
+class TestGrowTree:
+    def test_single_obvious_split(self):
+        """A perfectly separable single feature must split at the boundary."""
+        import jax.numpy as jnp
+
+        from mmlspark_tpu.engine.tree import GrowConfig, grow_tree
+
+        n = 100
+        bins = np.zeros((n, 1), np.int32)
+        bins[50:, 0] = 1
+        grad = np.where(np.arange(n) < 50, 1.0, -1.0)
+        hess = np.ones(n)
+        cfg = GrowConfig(num_bins=9, num_leaves=4, min_data_in_leaf=1, learning_rate=1.0)
+        tree, leaf_ids = grow_tree(
+            cfg,
+            jnp.asarray(bins),
+            jnp.asarray(grad, jnp.float32),
+            jnp.asarray(hess, jnp.float32),
+            jnp.ones(n, jnp.float32),
+            jnp.ones(1, bool),
+        )
+        assert int(tree.num_leaves) == 2  # second split has no gain
+        assert int(tree.split_feat[0]) == 0
+        assert int(tree.split_bin[0]) == 0
+        lv = np.asarray(tree.leaf_value)
+        # leaf values = -G/H: left leaf (bin 0) → -1, right → +1
+        np.testing.assert_allclose(sorted(lv[:2]), [-1.0, 1.0], atol=1e-5)
+        assert (np.asarray(leaf_ids)[:50] != np.asarray(leaf_ids)[50:]).all()
+
+    def test_min_data_constraint(self):
+        import jax.numpy as jnp
+
+        from mmlspark_tpu.engine.tree import GrowConfig, grow_tree
+
+        n = 20
+        bins = np.zeros((n, 1), np.int32)
+        bins[-2:, 0] = 1  # only 2 rows on the right
+        grad = np.where(bins[:, 0] == 1, -1.0, 1.0)
+        cfg = GrowConfig(num_bins=9, num_leaves=4, min_data_in_leaf=5)
+        tree, _ = grow_tree(
+            cfg,
+            jnp.asarray(bins),
+            jnp.asarray(grad, jnp.float32),
+            jnp.ones(n, jnp.float32),
+            jnp.ones(n, jnp.float32),
+            jnp.ones(1, bool),
+        )
+        assert int(tree.num_leaves) == 1  # split blocked by min_data_in_leaf
+
+    def test_predict_replay_matches_growth(self):
+        import jax.numpy as jnp
+
+        from mmlspark_tpu.engine.tree import (
+            GrowConfig,
+            grow_tree,
+            predict_tree_binned,
+        )
+
+        rng = np.random.default_rng(4)
+        n, F, B = 300, 5, 17
+        bins = rng.integers(0, B - 1, size=(n, F))
+        grad = rng.normal(size=n)
+        cfg = GrowConfig(num_bins=B, num_leaves=8, min_data_in_leaf=5, learning_rate=0.5)
+        tree, leaf_ids = grow_tree(
+            cfg,
+            jnp.asarray(bins),
+            jnp.asarray(grad, jnp.float32),
+            jnp.ones(n, jnp.float32),
+            jnp.ones(n, jnp.float32),
+            jnp.ones(F, bool),
+        )
+        pred = predict_tree_binned(tree, jnp.asarray(bins), B)
+        expect = np.asarray(tree.leaf_value)[np.asarray(leaf_ids)]
+        np.testing.assert_allclose(np.asarray(pred), expect, rtol=1e-6)
+
+
+class TestBoosterQuality:
+    def test_binary_auc_parity_with_sklearn(self, binary_df):
+        from sklearn.ensemble import HistGradientBoostingClassifier
+        from sklearn.metrics import roc_auc_score
+        from sklearn.model_selection import train_test_split
+
+        from mmlspark_tpu.engine.booster import Dataset, train
+
+        X = np.stack(binary_df["features"])
+        y = binary_df["label"]
+        Xtr, Xte, ytr, yte = train_test_split(X, y, test_size=0.3, random_state=0)
+
+        booster = train(
+            {"objective": "binary", "num_iterations": 40, "num_leaves": 15,
+             "learning_rate": 0.2, "min_data_in_leaf": 5},
+            Dataset(Xtr, ytr),
+        )
+        ours = roc_auc_score(yte, booster.predict(Xte, raw_score=True))
+
+        ref = HistGradientBoostingClassifier(
+            max_iter=40, max_leaf_nodes=15, learning_rate=0.2, min_samples_leaf=5,
+            early_stopping=False,
+        ).fit(Xtr, ytr)
+        theirs = roc_auc_score(yte, ref.decision_function(Xte))
+        assert ours > 0.97
+        assert ours > theirs - 0.01, f"ours={ours:.4f} sklearn={theirs:.4f}"
+
+    def test_regression_beats_mean_baseline(self, regression_df):
+        from sklearn.model_selection import train_test_split
+
+        from mmlspark_tpu.engine.booster import Dataset, train
+
+        X = np.stack(regression_df["features"])
+        y = regression_df["label"]
+        Xtr, Xte, ytr, yte = train_test_split(X, y, test_size=0.3, random_state=0)
+        booster = train(
+            {"objective": "regression", "num_iterations": 50, "num_leaves": 15,
+             "learning_rate": 0.1, "min_data_in_leaf": 5},
+            Dataset(Xtr, ytr),
+        )
+        pred = booster.predict(Xte)
+        mse = np.mean((pred - yte) ** 2)
+        base = np.mean((np.mean(ytr) - yte) ** 2)
+        assert mse < base  # beats the mean predictor
+
+        from sklearn.ensemble import HistGradientBoostingRegressor
+
+        ref = HistGradientBoostingRegressor(
+            max_iter=50, max_leaf_nodes=15, learning_rate=0.1, min_samples_leaf=5,
+            early_stopping=False,
+        ).fit(Xtr, ytr)
+        ref_mse = np.mean((ref.predict(Xte) - yte) ** 2)
+        assert mse < ref_mse * 1.05, f"ours={mse:.1f} sklearn={ref_mse:.1f}"
+
+    def test_early_stopping(self, binary_df):
+        from mmlspark_tpu.engine.booster import Dataset, train
+
+        X = np.stack(binary_df["features"])
+        y = binary_df["label"]
+        booster = train(
+            {"objective": "binary", "num_iterations": 200, "num_leaves": 31,
+             "early_stopping_round": 3, "metric": "auc", "min_data_in_leaf": 5},
+            Dataset(X[:300], y[:300]),
+            valid_sets=[Dataset(X[300:], y[300:])],
+        )
+        assert booster.best_iteration >= 0
+        assert booster.num_iterations < 200
+
+    def test_multiclass(self):
+        from sklearn.datasets import load_iris
+
+        from mmlspark_tpu.engine.booster import Dataset, train
+
+        X, y = load_iris(return_X_y=True)
+        booster = train(
+            {"objective": "multiclass", "num_class": 3, "num_iterations": 20,
+             "num_leaves": 7, "min_data_in_leaf": 3, "learning_rate": 0.3},
+            Dataset(X, y),
+        )
+        proba = booster.predict(X)
+        assert proba.shape == (150, 3)
+        np.testing.assert_allclose(proba.sum(axis=1), 1.0, atol=1e-5)
+        acc = (proba.argmax(axis=1) == y).mean()
+        assert acc > 0.93
+
+    def test_goss_mode(self, binary_df):
+        from sklearn.metrics import roc_auc_score
+
+        from mmlspark_tpu.engine.booster import Dataset, train
+
+        X = np.stack(binary_df["features"])
+        y = binary_df["label"]
+        booster = train(
+            {"objective": "binary", "boosting": "goss", "num_iterations": 30,
+             "num_leaves": 15, "min_data_in_leaf": 5, "learning_rate": 0.2},
+            Dataset(X, y),
+        )
+        assert roc_auc_score(y, booster.predict(X, raw_score=True)) > 0.97
+
+    def test_weights_shift_predictions(self):
+        from mmlspark_tpu.engine.booster import Dataset, train
+
+        X, y = _toy_xy(300, 4, seed=5)
+        w_hi = np.where(y > 0, 10.0, 1.0)
+        cfgd = {"objective": "binary", "num_iterations": 10, "num_leaves": 7,
+                "min_data_in_leaf": 5}
+        b0 = train(cfgd, Dataset(X, y))
+        b1 = train(cfgd, Dataset(X, y, weight=w_hi))
+        assert b1.predict(X).mean() > b0.predict(X).mean()
+
+    def test_pred_leaf_and_importance(self, binary_df):
+        from mmlspark_tpu.engine.booster import Dataset, train
+
+        X = np.stack(binary_df["features"])[:200]
+        y = binary_df["label"][:200]
+        booster = train(
+            {"objective": "binary", "num_iterations": 5, "num_leaves": 7,
+             "min_data_in_leaf": 5},
+            Dataset(X, y),
+        )
+        leaves = booster.predict(X, pred_leaf=True)
+        assert leaves.shape == (200, 5)
+        assert leaves.max() < 7
+        imp = booster.feature_importance()
+        assert imp.sum() > 0 and imp.shape == (X.shape[1],)

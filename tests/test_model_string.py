@@ -1,0 +1,74 @@
+"""LightGBM text-format round-trip tests (SURVEY.md §7.4.7 interop)."""
+
+import numpy as np
+
+from mmlspark_tpu.engine.booster import Dataset, train
+
+
+def _fit(objective="binary", **kw):
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(300, 5))
+    if objective == "binary":
+        y = (X[:, 0] + 0.5 * X[:, 1] > 0).astype(float)
+    elif objective == "multiclass":
+        y = np.digitize(X[:, 0], [-0.5, 0.5]).astype(float)
+    else:
+        y = X[:, 0] * 3 + X[:, 1]
+    params = {"objective": objective, "num_iterations": 8, "num_leaves": 7,
+              "min_data_in_leaf": 5, "learning_rate": 0.3, **kw}
+    return train(params, Dataset(X, y)), X
+
+
+class TestModelString:
+    def test_binary_roundtrip_predictions(self):
+        from mmlspark_tpu.engine.booster import Booster
+
+        b, X = _fit("binary")
+        s = b.save_model_string()
+        assert "objective=binary sigmoid:1" in s
+        assert s.count("Tree=") == 8
+        b2 = Booster.from_model_string(s)
+        np.testing.assert_allclose(
+            b.predict(X, raw_score=True), b2.predict(X, raw_score=True),
+            rtol=1e-5, atol=1e-5,
+        )
+        np.testing.assert_allclose(b.predict(X), b2.predict(X), rtol=1e-5, atol=1e-5)
+
+    def test_regression_roundtrip(self):
+        from mmlspark_tpu.engine.booster import Booster
+
+        b, X = _fit("regression")
+        b2 = Booster.from_model_string(b.save_model_string())
+        np.testing.assert_allclose(b.predict(X), b2.predict(X), rtol=1e-4, atol=1e-4)
+
+    def test_multiclass_roundtrip(self):
+        from mmlspark_tpu.engine.booster import Booster
+
+        b, X = _fit("multiclass", num_class=3)
+        s = b.save_model_string()
+        assert "num_tree_per_iteration=3" in s
+        b2 = Booster.from_model_string(s)
+        np.testing.assert_allclose(b.predict(X), b2.predict(X), rtol=1e-4, atol=1e-4)
+
+    def test_missing_default_direction_preserved(self):
+        from mmlspark_tpu.engine.booster import Booster
+
+        rng = np.random.default_rng(1)
+        X = rng.normal(size=(400, 3))
+        X[rng.random(400) < 0.3, 0] = np.nan
+        y = (np.nan_to_num(X[:, 0], nan=2.0) > 0).astype(float)
+        b = train({"objective": "binary", "num_iterations": 5, "num_leaves": 7,
+                   "min_data_in_leaf": 5}, Dataset(X, y))
+        b2 = Booster.from_model_string(b.save_model_string())
+        np.testing.assert_allclose(
+            b.predict(X, raw_score=True), b2.predict(X, raw_score=True),
+            rtol=1e-5, atol=1e-5,
+        )
+
+    def test_string_is_lightgbm_shaped(self):
+        b, _ = _fit("binary")
+        s = b.save_model_string()
+        for token in ("version=v3", "max_feature_idx=4", "feature_names=",
+                      "left_child=", "right_child=", "decision_type=",
+                      "end of trees", "shrinkage="):
+            assert token in s, token
