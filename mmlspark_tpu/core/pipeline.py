@@ -23,13 +23,14 @@ class PipelineStage(Params):
     """Base for all stages: params + persistence."""
 
     # ---- persistence ----------------------------------------------------
-    def save(self, path: str, overwrite: bool = True) -> None:
+    def save(self, path: str, overwrite: bool = False) -> None:
         """Persist params (JSON) + complex payloads (one file per param).
 
         Mirrors SparkML persistence + the reference's ``ComplexParam``
         machinery (SURVEY.md §2.1 "Complex param serialization").
-        SparkML semantics: refuse a non-empty target unless ``overwrite``;
-        with ``overwrite``, replace it wholesale (no stale files merged in).
+        SparkML semantics: refuse a non-empty target unless ``overwrite``
+        (``.write().overwrite().save(path)``); with ``overwrite``, replace
+        it wholesale (no stale files merged in).
         """
         if os.path.isdir(path) and os.listdir(path):
             if not overwrite:
@@ -95,7 +96,7 @@ class PipelineStage(Params):
 class _Writer:
     def __init__(self, stage):
         self._stage = stage
-        self._overwrite = True
+        self._overwrite = False
 
     def overwrite(self):
         self._overwrite = True
@@ -156,7 +157,7 @@ class _StagesPersistence:
     def _load_extra(self, path):
         self._paramMap["stages"] = _load_stage_list(path)
 
-    def save(self, path, overwrite=True):
+    def save(self, path, overwrite=False):
         self._stages_to_save = self.getStages() or []
         stages = self._paramMap.pop("stages", None)
         try:

@@ -182,6 +182,35 @@ def _pad_rows(arr: np.ndarray, n_pad: int, value=0):
     return np.concatenate([arr, np.full(pad_shape, value, dtype=arr.dtype)], axis=0)
 
 
+# Padding fill per Tree field when concatenating forests whose num_leaves
+# budgets differ (warm start): inactive split slots are -1, the rest 0.
+_TREE_PAD_FILL = {"split_leaf": -1}
+
+
+def _concat_forests(old: Tree, new: Tree) -> Tree:
+    """Stack two (T, K, ...) tree-array forests along T, padding the
+    split/leaf axes to the larger budget."""
+
+    def cat(field: str, a, b):
+        a, b = np.asarray(a), np.asarray(b)
+        if a.ndim == 3 and a.shape[-1] != b.shape[-1]:
+            target = max(a.shape[-1], b.shape[-1])
+            fill = _TREE_PAD_FILL.get(field, 0)
+
+            def pad(x):
+                if x.shape[-1] == target:
+                    return x
+                extra = np.full(
+                    x.shape[:-1] + (target - x.shape[-1],), fill, dtype=x.dtype
+                )
+                return np.concatenate([x, extra], axis=-1)
+
+            a, b = pad(a), pad(b)
+        return np.concatenate([a, b], axis=0)
+
+    return Tree(*[cat(f, getattr(old, f), getattr(new, f)) for f in Tree._fields])
+
+
 class Booster:
     """A trained forest: stacked tree arrays + binning state.
 
@@ -269,6 +298,20 @@ class Booster:
     def _slice_trees(self, T: int) -> Tree:
         return Tree(*[a[:T] for a in self.trees])
 
+    def _raw_scores_binned(
+        self, bins: jnp.ndarray, num_iteration: Optional[int] = None
+    ) -> jnp.ndarray:
+        """(K, n) raw scores from an already-binned matrix (skips the host
+        binning pass — used by warm start, which bins once for training and
+        reuses the same matrix here)."""
+        T = self._used_iters(num_iteration)
+        trees = self._slice_trees(T)
+        weights = jnp.asarray(self.tree_weights[:T], dtype=jnp.float32)
+        raw = self._forest_fn(T, "raw")(trees, weights, bins)
+        if self.average_output:
+            raw = raw / max(T, 1)
+        return raw
+
     def predict(
         self,
         X: np.ndarray,
@@ -282,16 +325,14 @@ class Booster:
         X = np.asarray(X, dtype=np.float64)
         bins = jnp.asarray(self.bin_mapper.transform(X))
         T = self._used_iters(num_iteration)
-        trees = self._slice_trees(T)
-        weights = jnp.asarray(self.tree_weights[:T], dtype=jnp.float32)
         if pred_leaf:
+            trees = self._slice_trees(T)
+            weights = jnp.asarray(self.tree_weights[:T], dtype=jnp.float32)
             leaves = self._forest_fn(T, "leaf")(trees, weights, bins)
             out = np.asarray(leaves)  # (K, T, n)
             K, _, n = out.shape
             return out.transpose(2, 1, 0).reshape(n, T * K)
-        raw = np.asarray(self._forest_fn(T, "raw")(trees, weights, bins))  # (K, n)
-        if self.average_output:
-            raw = raw / max(T, 1)
+        raw = np.asarray(self._raw_scores_binned(bins, num_iteration))  # (K, n)
         if raw_score:
             return raw[0] if raw.shape[0] == 1 else raw.T
         tr = np.asarray(self.objective.transform(jnp.asarray(raw)))
@@ -312,10 +353,10 @@ class Booster:
         return out
 
     # -- persistence (LightGBM text format lives in ops/model_string) ----
-    def save_model_string(self) -> str:
+    def save_model_string(self, num_iteration: Optional[int] = None) -> str:
         from mmlspark_tpu.ops.model_string import booster_to_string
 
-        return booster_to_string(self)
+        return booster_to_string(self, num_iteration)
 
     @staticmethod
     def from_model_string(s: str) -> "Booster":
@@ -368,6 +409,9 @@ def _feature_mask(key, F: int, fraction: float):
 # ---------------------------------------------------------------------------
 # The training loop
 # ---------------------------------------------------------------------------
+_PARALLEL_LEARNERS = ("data", "data_parallel", "voting", "voting_parallel")
+
+
 def train(
     params: dict,
     train_set: Dataset,
@@ -375,9 +419,18 @@ def train(
     valid_names: Optional[Sequence[str]] = None,
     bin_mapper: Optional[BinMapper] = None,
     init_model: Optional[Booster] = None,
+    mesh=None,
 ) -> Booster:
-    """Single-host training entry (the distributed path wraps the same
-    grower via ``mmlspark_tpu.parallel`` — SURVEY.md §7.3.3)."""
+    """Training entry — single-device or data-parallel over a device mesh.
+
+    With ``mesh`` set (or ``tree_learner`` in data/voting modes, which builds
+    a default mesh over all visible devices), rows are sharded over the
+    mesh's ``"data"`` axis and the grower runs under ``shard_map`` with
+    per-shard histograms ``psum``-med across the axis — the direct
+    replacement for the reference's ``LGBM_NetworkInit`` + socket histogram
+    allreduce (SURVEY.md §3.1, §5.8 N2).  Every shard then computes an
+    identical best split, exactly LightGBM's ``tree_learner=data`` semantics.
+    """
     cfg = params if isinstance(params, TrainConfig) else TrainConfig.from_params(params)
     if cfg.boosting == "dart" and cfg.early_stopping_round > 0:
         # Later DART iterations rescale earlier trees, so a truncated-at-
@@ -398,8 +451,47 @@ def train(
             "categorical_feature support is not implemented yet; "
             "one-hot or ordinal-encode categoricals explicitly for now"
         )
+    if cfg.early_stopping_round > 0 and not valid_sets:
+        # LightGBM: "For early stopping, at least one dataset ... is required".
+        raise ValueError(
+            "early_stopping_round > 0 requires at least one validation set"
+        )
     obj = get_objective(cfg.objective, **cfg.objective_params())
     K = obj.num_model_per_iteration
+
+    # ---- warm start (continued training; the reference's `modelString`
+    # param — SURVEY.md §2.3.1, §5.4) -----------------------------------
+    if init_model is not None:
+        if init_model.num_class != K:
+            raise ValueError(
+                f"init_model has {init_model.num_class} models/iteration, "
+                f"objective {cfg.objective!r} needs {K}"
+            )
+        if init_model.average_output:
+            raise ValueError("continued training from an rf booster is not supported")
+        if cfg.boosting in ("rf", "dart"):
+            # rf would average the old forest's contribution away; dart
+            # would drop/rescale trees it did not train.
+            raise ValueError(
+                f"continued training with boosting={cfg.boosting!r} is not supported"
+            )
+        if bin_mapper is not None and bin_mapper is not init_model.bin_mapper:
+            raise ValueError(
+                "bin_mapper cannot be overridden when init_model is set; "
+                "continuation replays old trees, which pins their thresholds"
+            )
+        # New trees must be replayed over the same thresholds as the old
+        # ones (one BinMapper per booster), so continuation pins the mapper.
+        bin_mapper = init_model.bin_mapper
+
+    # ---- mesh (data-parallel tree learner) -----------------------------
+    if mesh is None and cfg.tree_learner in _PARALLEL_LEARNERS:
+        from mmlspark_tpu.parallel.mesh import default_mesh
+
+        mesh = default_mesh()
+    from mmlspark_tpu.parallel.mesh import DATA_AXIS, mesh_num_devices
+
+    D = mesh_num_devices(mesh)
 
     # ---- binning -------------------------------------------------------
     if bin_mapper is None:
@@ -412,9 +504,14 @@ def train(
     n, F = bins_np.shape
     B = bin_mapper.num_bins
 
-    # ---- padding to the histogram chunk --------------------------------
+    # ---- padding: shard count × histogram chunk ------------------------
+    # Each of the D shards holds n_local rows; n_local must be one chunk or
+    # a multiple of chunks so the scan in build_histogram stays shape-static.
     chunk = cfg.hist_chunk
-    n_pad = 0 if n <= chunk else (-n) % chunk
+    n_local = (n + D - 1) // D
+    if n_local > chunk:
+        n_local = ((n_local + chunk - 1) // chunk) * chunk
+    n_pad = n_local * D - n
     bins_np = _pad_rows(bins_np, n_pad)
     y = _pad_rows(train_set.label, n_pad)
     valid_mask_np = np.concatenate([np.ones(n, bool), np.zeros(n_pad, bool)])
@@ -445,6 +542,7 @@ def train(
         cfg.boost_from_average
         and cfg.boosting not in ("dart", "rf")
         and train_set.init_score is None
+        and init_model is None  # the old forest already embeds its bias
     )
     if use_bfa:
         init = obj.init_score(train_set.label, train_set.weight)
@@ -455,13 +553,36 @@ def train(
         init_arr = init_arr + _pad_rows(
             train_set.init_score.astype(np.float32), n_pad
         ).reshape(1, -1)
-    scores = jnp.asarray(init_arr)
+    if init_model is not None:
+        # bins_np is already the pinned mapper's binning (padded rows are
+        # harmless: their gradients are zeroed by the bag mask), so score it
+        # directly instead of re-binning through predict().
+        base_raw = init_model._raw_scores_binned(jnp.asarray(bins_np))
+        init_arr = init_arr + np.asarray(base_raw, dtype=np.float32)
 
     # ---- device-resident data ------------------------------------------
-    bins_dev = jnp.asarray(bins_np)
-    y_dev = jnp.asarray(y, dtype=jnp.float32)
-    w_dev = None if w_np is None else jnp.asarray(w_np, dtype=jnp.float32)
-    valid_mask = jnp.asarray(valid_mask_np)
+    # Under a mesh, rows are sharded over the data axis up front so the
+    # binned matrix lives partitioned in HBM (SURVEY.md §7.2) and per-
+    # iteration programs never reshuffle it.
+    if mesh is not None:
+        from jax.sharding import NamedSharding
+        from jax.sharding import PartitionSpec as P
+
+        row_sh = NamedSharding(mesh, P(DATA_AXIS))
+        rowF_sh = NamedSharding(mesh, P(DATA_AXIS, None))
+        krow_sh = NamedSharding(mesh, P(None, DATA_AXIS))
+        bins_dev = jax.device_put(bins_np, rowF_sh)
+        y_dev = jax.device_put(y.astype(np.float32), row_sh)
+        w_dev = None if w_np is None else jax.device_put(w_np.astype(np.float32), row_sh)
+        valid_mask = jax.device_put(valid_mask_np, row_sh)
+        init_scores_dev = jax.device_put(init_arr, krow_sh)
+    else:
+        bins_dev = jnp.asarray(bins_np)
+        y_dev = jnp.asarray(y, dtype=jnp.float32)
+        w_dev = None if w_np is None else jnp.asarray(w_np, dtype=jnp.float32)
+        valid_mask = jnp.asarray(valid_mask_np)
+        init_scores_dev = jnp.asarray(init_arr)
+    scores = init_scores_dev
 
     gcfg = GrowConfig(
         num_bins=B,
@@ -477,7 +598,27 @@ def train(
         hist_chunk=chunk,
     )
 
-    grow = jax.vmap(partial(grow_tree, gcfg), in_axes=(None, 0, 0, None, 0))
+    if mesh is None:
+        grow = jax.vmap(partial(grow_tree, gcfg), in_axes=(None, 0, 0, None, 0))
+    else:
+        # Per-shard grower: local rows in, psum-med histograms inside
+        # (GrowConfig.axis_name), replicated tree out.  check_vma=False: the
+        # tree's replication is established by psum-determinism, which the
+        # static checker cannot see through vmap+argmax.
+        gcfg_sharded = dataclasses.replace(gcfg, axis_name=DATA_AXIS)
+        grow_local = jax.vmap(
+            partial(grow_tree, gcfg_sharded), in_axes=(None, 0, 0, None, 0)
+        )
+        from jax.sharding import PartitionSpec as P
+
+        tree_spec = Tree(*([P()] * len(Tree._fields)))
+        grow = jax.shard_map(
+            grow_local,
+            mesh=mesh,
+            in_specs=(P(DATA_AXIS, None), P(None, DATA_AXIS), P(None, DATA_AXIS), P(DATA_AXIS), P(None, None)),
+            out_specs=(tree_spec, P(None, DATA_AXIS)),
+            check_vma=False,
+        )
 
     @jax.jit
     def iteration(scores, key, bag_in):
@@ -520,6 +661,10 @@ def train(
         ).copy()
         if vs.init_score is not None:
             vscore = vscore + vs.init_score.astype(np.float32).reshape(1, -1)
+        if init_model is not None:
+            vscore = vscore + np.asarray(
+                init_model._raw_scores_binned(vb), dtype=np.float32
+            )
         vsets.append({"bins": vb, "scores": jnp.asarray(vscore), "data": vs})
 
     predict_v = jax.jit(
@@ -567,7 +712,7 @@ def train(
                 scores = scores - tree_weights[t_i] * p
 
         if cfg.boosting == "rf":
-            train_scores = jnp.asarray(init_arr)  # RF: every tree fits the init residual
+            train_scores = init_scores_dev  # RF: every tree fits the init residual
         else:
             train_scores = scores
 
@@ -633,19 +778,32 @@ def train(
         if stop:
             break
 
-    # ---- stack trees ----------------------------------------------------
+    # ---- stack trees (prepending the warm-start forest, if any) ---------
     stacked = Tree(
         *[
             np.stack([getattr(t, f) for t in trees_host], axis=0)
             for f in Tree._fields
         ]
     )
+    weights = np.asarray(tree_weights)
+    t_offset = 0
+    if init_model is not None:
+        # Keep only the iterations the base scores came from: an early-
+        # stopped init_model contributes best_iteration+1 trees, not its
+        # full (partly discarded) forest.
+        t_offset = init_model._used_iters(None)
+        stacked = _concat_forests(init_model._slice_trees(t_offset), stacked)
+        weights = np.concatenate([init_model.tree_weights[:t_offset], weights])
     booster = Booster(
         trees=Tree(*[jnp.asarray(a) for a in stacked]),
-        tree_weights=np.asarray(tree_weights),
+        tree_weights=weights,
         bin_mapper=bin_mapper,
         config=cfg,
-        best_iteration=best_iter if cfg.early_stopping_round > 0 and best_iter >= 0 else -1,
+        best_iteration=(
+            t_offset + best_iter
+            if cfg.early_stopping_round > 0 and best_iter >= 0
+            else -1
+        ),
         average_output=cfg.boosting == "rf",
     )
     booster.evals_result = evals_result

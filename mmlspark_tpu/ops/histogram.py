@@ -79,7 +79,18 @@ def build_histogram(
     """
     n, F = bins.shape
     vals = jnp.where(mask[:, None], vals, 0.0).astype(jnp.float32)
-    fn = _scatter_hist_chunk if backend != "onehot" else _onehot_hist_chunk
+    if backend == "pallas":
+        from mmlspark_tpu.ops.pallas_hist import pallas_hist_chunk
+
+        fn = pallas_hist_chunk
+    elif backend == "onehot":
+        fn = _onehot_hist_chunk
+    elif backend == "scatter":
+        fn = _scatter_hist_chunk
+    else:
+        raise ValueError(
+            f"unknown hist backend {backend!r}; expected scatter|onehot|pallas"
+        )
     if n <= chunk:
         hist = fn(bins, vals, num_bins)
     else:

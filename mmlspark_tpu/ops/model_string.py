@@ -143,11 +143,18 @@ def _objective_string(cfg) -> str:
     return obj
 
 
-def booster_to_string(booster) -> str:
+def booster_to_string(booster, num_iteration=None) -> str:
     """Serialize a trained :class:`~mmlspark_tpu.engine.booster.Booster` to
-    the LightGBM text model format."""
+    the LightGBM text model format.
+
+    ``num_iteration=None`` saves the iterations ``predict`` would use —
+    i.e. up to ``best_iteration`` after early stopping — so that a
+    save→load round trip scores identically (the text format itself has no
+    best_iteration field to carry the truncation point).
+    """
     trees = booster.trees
-    T, K = trees.split_leaf.shape[:2]
+    _, K = trees.split_leaf.shape[:2]
+    T = booster._used_iters(num_iteration)
     bm = booster.bin_mapper
     cfg = booster.config
     feature_names = [f"Column_{i}" for i in range(bm.num_features)]

@@ -1,0 +1,113 @@
+"""Pallas TPU kernel for gradient-histogram construction.
+
+The TPU-native analog of LightGBM's CUDA histogram kernels (reference native
+component N1, SURVEY.md §2.9: upstream ``src/treelearner/cuda/`` /
+``kernels/`` — [REF-EMPTY]; shipped prebuilt in the ``lightgbmlib`` jar).
+CUDA's approach — per-thread-block shared-memory scatter-adds — does not map
+to the TPU's vector/matrix units, so the kernel reformulates histogramming
+as a contraction (SURVEY.md §7.4.2):
+
+    hist[c, f, b] = Σ_rows vals[row, c] * onehot[(f, b), row]
+
+i.e. a (3, bm) × (bm, bf·B) matmul per (feature-block, row-block) grid cell
+that lands on the MXU, with the one-hot tile materialized **only in VMEM**
+(never HBM).  The grid iterates row-blocks innermost so each feature block's
+output tile stays resident in VMEM and accumulates across row blocks — the
+standard Pallas reduction pattern.
+
+Layout choices (TPU tiling wants the last dim lane-sized):
+- bins arrive transposed as (F, rows) so a block is (bf, bm) with rows on
+  the 128-lane axis;
+- the output is (3, F, B) with B on the lane axis, transposed back to the
+  engine's (F, B, 3) outside the kernel.
+
+VMEM budget per grid cell (defaults bm=512, bf=8, B=256):
+one-hot 2048×512 f32 = 4 MiB + in/out tiles ≪ 16 MiB/core.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+
+def _hist_kernel(bins_ref, vals_ref, out_ref, *, num_bins: int):
+    """One (feature-block j, row-block i) cell: out[j] += vals·onehotᵀ."""
+    i = pl.program_id(1)  # row block (innermost → accumulation is safe)
+    bins = bins_ref[...]  # (bf, bm) int32
+    vals = vals_ref[...]  # (bm, 3) f32
+    bf, bm = bins.shape
+    # One-hot over bins, rows on lanes — lives only in VMEM/registers.
+    iota = jax.lax.broadcasted_iota(jnp.int32, (bf, num_bins, bm), 1)
+    onehot = (iota == bins[:, None, :]).astype(jnp.float32)
+    onehot = onehot.reshape(bf * num_bins, bm)
+    # (3, bm) × (bm, bf*B) on the MXU.
+    # HIGHEST precision: the MXU's bf16-multiply default loses ~1e-3 per
+    # element, which corrupts split gains on near-tied candidates.  The
+    # one-hot operand is exactly representable, so f32 accumulate restores
+    # scatter-add-equivalent numerics.
+    part = jax.lax.dot_general(
+        vals, onehot,
+        dimension_numbers=(((0,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+        precision=jax.lax.Precision.HIGHEST,
+    )  # (3, bf*B)
+    part = part.reshape(3, bf, num_bins)
+
+    @pl.when(i == 0)
+    def _init():
+        out_ref[...] = part
+
+    @pl.when(i > 0)
+    def _acc():
+        out_ref[...] += part
+
+
+@functools.partial(jax.jit, static_argnames=("num_bins", "bm", "bf", "interpret"))
+def _pallas_hist(bins_t, vals, num_bins: int, bm: int, bf: int, interpret: bool):
+    F, n = bins_t.shape
+    kernel = functools.partial(_hist_kernel, num_bins=num_bins)
+    return pl.pallas_call(
+        kernel,
+        grid=(F // bf, n // bm),
+        in_specs=[
+            pl.BlockSpec((bf, bm), lambda j, i: (j, i)),
+            pl.BlockSpec((bm, 3), lambda j, i: (i, 0)),
+        ],
+        out_specs=pl.BlockSpec((3, bf, num_bins), lambda j, i: (0, j, 0)),
+        out_shape=jax.ShapeDtypeStruct((3, F, num_bins), jnp.float32),
+        interpret=interpret,
+    )(bins_t, vals)
+
+
+def pallas_hist_chunk(
+    bins_c, vals_c, num_bins: int, bm: int = 512, bf: int = 8
+) -> jnp.ndarray:
+    """(C, F) int bins + (C, 3) vals → (F, B, 3), same contract as the
+    scatter/onehot chunk builders in :mod:`mmlspark_tpu.ops.histogram`.
+
+    Pads rows/features up to block multiples (padded rows carry zero vals,
+    padded features are sliced off) and transposes the kernel's
+    lane-friendly layouts back to the engine's (F, B, 3).
+    """
+    C, F = bins_c.shape
+    bins_t = bins_c.astype(jnp.int32).T  # (F, C): rows on the lane axis
+    vals_c = vals_c.astype(jnp.float32)
+    bm = min(bm, _round_up(C, 8))
+    pad_r = (-C) % bm
+    pad_f = (-F) % bf
+    if pad_r:
+        bins_t = jnp.pad(bins_t, ((0, 0), (0, pad_r)))
+        vals_c = jnp.pad(vals_c, ((0, pad_r), (0, 0)))
+    if pad_f:
+        bins_t = jnp.pad(bins_t, ((0, pad_f), (0, 0)))
+    interpret = jax.default_backend() == "cpu"
+    out = _pallas_hist(bins_t, vals_c, num_bins, bm, bf, interpret)  # (3, Fp, B)
+    return out[:, :F, :].transpose(1, 2, 0)
+
+
+def _round_up(x: int, m: int) -> int:
+    return ((x + m - 1) // m) * m

@@ -1,0 +1,25 @@
+"""Distributed execution: device meshes, collectives, multi-host rendezvous.
+
+This package is the TPU-native replacement for the reference's two custom
+socket stacks (SURVEY.md §5.8: LightGBM's TCP ``Network`` with Bruck
+allgather / recursive-halving allreduce reached through ``LGBM_NetworkInit``,
+and VW's driver-hosted spanning tree).  Here there are no sockets to manage:
+collectives are XLA collectives (``psum``/``all_gather``/``psum_scatter``)
+over ICI, emitted by ``shard_map`` programs over a ``jax.sharding.Mesh``, and
+multi-host rendezvous is ``jax.distributed.initialize`` keyed off the
+launcher's task context (SURVEY.md §3.1 driver rendezvous → §5.8 mapping).
+"""
+
+from mmlspark_tpu.parallel.mesh import DATA_AXIS, default_mesh, mesh_num_devices
+from mmlspark_tpu.parallel.distributed import (
+    barrier_context_from_env,
+    initialize_distributed,
+)
+
+__all__ = [
+    "DATA_AXIS",
+    "default_mesh",
+    "mesh_num_devices",
+    "barrier_context_from_env",
+    "initialize_distributed",
+]

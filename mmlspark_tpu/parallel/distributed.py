@@ -1,0 +1,92 @@
+"""Multi-host process-group rendezvous.
+
+Reference mechanism being replaced (SURVEY.md §3.1, §5.8): every Spark task
+binds a port, reports ``ip:port`` to a driver ``ServerSocket``, receives the
+comma-joined machine list back, and calls ``LGBM_NetworkInit(machines, port,
+timeout, numMachines)`` so the native library can form its TCP allreduce
+ring.
+
+TPU-native replacement: ``jax.distributed.initialize(coordinator_address,
+num_processes, process_id)``.  The coordinator address plays the role of the
+driver rendezvous socket, and process ids come from the launcher (a Spark
+barrier task context, GKE/JobSet indices, or explicit arguments).  After
+initialization, ``jax.devices()`` spans all hosts and one SPMD program over a
+global mesh replaces the reference's gang-scheduled barrier stage.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+from typing import Optional
+
+
+@dataclass(frozen=True)
+class BarrierContext:
+    """The information the reference extracts from Spark's barrier stage
+    (task addresses + this task's index), normalized for jax.distributed."""
+
+    coordinator_address: str
+    num_processes: int
+    process_id: int
+
+
+_ENV_COORD = "MMLSPARK_TPU_COORDINATOR"
+_ENV_NPROC = "MMLSPARK_TPU_NUM_PROCESSES"
+_ENV_PID = "MMLSPARK_TPU_PROCESS_ID"
+
+
+def barrier_context_from_env() -> Optional[BarrierContext]:
+    """Derive rendezvous info from the environment.
+
+    Checked in order:
+    1. ``MMLSPARK_TPU_{COORDINATOR,NUM_PROCESSES,PROCESS_ID}`` — set by the
+       Spark-side integration: the barrier stage elects task 0's host as
+       coordinator (``BarrierTaskContext.getTaskInfos().head.address``) and
+       exports these before spawning the per-host Python runner, exactly
+       where the reference builds its machine list (SURVEY.md §3.1).
+    2. Cloud TPU metadata conventions (``TPU_WORKER_ID``/
+       ``TPU_WORKER_HOSTNAMES``), in which case jax's own auto-detection is
+       preferred — return None and let ``jax.distributed.initialize()``
+       no-arg autodetect.
+    """
+    coord = os.environ.get(_ENV_COORD)
+    if coord:
+        return BarrierContext(
+            coordinator_address=coord,
+            num_processes=int(os.environ.get(_ENV_NPROC, "1")),
+            process_id=int(os.environ.get(_ENV_PID, "0")),
+        )
+    return None
+
+
+_initialized = False
+
+
+def initialize_distributed(
+    context: Optional[BarrierContext] = None, timeout_s: int = 1200
+) -> bool:
+    """Form the multi-host process group (idempotent).
+
+    ``timeout_s`` mirrors the reference's ``timeout`` param (1200s default —
+    SURVEY.md §2.3.1) guarding against a hung rendezvous.  Returns True if a
+    multi-process group was initialized, False for single-process runs.
+    """
+    global _initialized
+    if _initialized:
+        return True
+    import jax
+
+    ctx = context or barrier_context_from_env()
+    if ctx is None:
+        # Single process (or TPU-pod auto-detection handled by jax itself on
+        # Cloud TPU VMs). Nothing to rendezvous.
+        return False
+    jax.distributed.initialize(
+        coordinator_address=ctx.coordinator_address,
+        num_processes=ctx.num_processes,
+        process_id=ctx.process_id,
+        initialization_timeout=timeout_s,
+    )
+    _initialized = True
+    return True

@@ -1,0 +1,48 @@
+"""Device-mesh construction helpers.
+
+The reference sizes its "cluster" as ``numWorkers = min(numTasks,
+df partitions)`` and forms a TCP ring over exactly that many native workers
+(SURVEY.md §3.1).  The TPU analog is a ``jax.sharding.Mesh`` over the chips
+visible to this process group; the data-parallel GBDT shards rows over the
+``"data"`` axis and every collective rides ICI (or DCN across slices) via the
+same mesh — no rendezvous machinery of our own.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh
+
+# The row-sharding axis used by data-parallel training (the moral equivalent
+# of LightGBM's tree_learner=data worker ring — SURVEY.md §2 parallelism).
+DATA_AXIS = "data"
+
+
+def default_mesh(
+    num_devices: Optional[int] = None,
+    axis_name: str = DATA_AXIS,
+    devices: Optional[Sequence] = None,
+) -> Mesh:
+    """A 1-D mesh over (a prefix of) the visible devices.
+
+    ``num_devices`` mirrors the reference's ``numTasks`` param (cap the
+    worker count below the cluster size); ``None`` uses every device.
+    """
+    devs = list(devices) if devices is not None else list(jax.devices())
+    if num_devices is not None:
+        if num_devices > len(devs):
+            raise ValueError(
+                f"requested {num_devices} devices but only {len(devs)} visible"
+            )
+        devs = devs[:num_devices]
+    return Mesh(np.asarray(devs), (axis_name,))
+
+
+def mesh_num_devices(mesh: Optional[Mesh]) -> int:
+    if mesh is None:
+        return 1
+    return int(np.prod(mesh.devices.shape))

@@ -117,6 +117,21 @@ class TestHistogram:
         h2 = build_histogram(bins, vals, mask, B, chunk=1024)
         np.testing.assert_allclose(np.asarray(h1), np.asarray(h2), rtol=1e-4, atol=1e-4)
 
+    def test_pallas_matches_scatter(self):
+        import jax.numpy as jnp
+
+        from mmlspark_tpu.ops.histogram import build_histogram
+
+        rng = np.random.default_rng(5)
+        for (n, F, B) in [(257, 5, 16), (1024, 9, 64)]:
+            bins = jnp.asarray(rng.integers(0, B, size=(n, F)))
+            vals = jnp.asarray(rng.normal(size=(n, 3)))
+            mask = jnp.asarray(rng.random(n) > 0.3)
+            h1 = build_histogram(bins, vals, mask, B, backend="scatter")
+            h2 = build_histogram(bins, vals, mask, B, backend="pallas")
+            np.testing.assert_allclose(np.asarray(h1), np.asarray(h2), rtol=1e-4, atol=1e-4)
+
+
 
 class TestGrowTree:
     def test_single_obvious_split(self):
@@ -319,3 +334,78 @@ class TestBoosterQuality:
         assert leaves.max() < 7
         imp = booster.feature_importance()
         assert imp.sum() > 0 and imp.shape == (X.shape[1],)
+
+
+class TestWarmStartAndGuards:
+    def test_init_model_continued_training(self):
+        from mmlspark_tpu.engine.booster import Dataset, train
+        from sklearn.metrics import log_loss
+
+        X, y = _toy_xy(600, 6, seed=9)
+        ds = Dataset(X, y)
+        cfgd = {"objective": "binary", "num_leaves": 7, "min_data_in_leaf": 5,
+                "learning_rate": 0.2}
+        b10 = train(dict(cfgd, num_iterations=10), ds)
+        b_cont = train(dict(cfgd, num_iterations=10), ds, init_model=b10)
+        assert b_cont.num_iterations == 20
+        # Continuation must actually continue: loss improves over the base
+        # model, and the first 10 trees score identically to the base.
+        assert (log_loss(y, b_cont.predict(X))
+                < log_loss(y, b10.predict(X)) + 1e-9)
+        np.testing.assert_allclose(
+            b10.predict(X, raw_score=True),
+            b_cont.predict(X, raw_score=True, num_iteration=10),
+            rtol=1e-5, atol=1e-5,
+        )
+
+    def test_init_model_num_class_mismatch_raises(self):
+        from mmlspark_tpu.engine.booster import Dataset, train
+
+        X, y = _toy_xy(200, 4, seed=2)
+        base = train({"objective": "binary", "num_iterations": 2}, Dataset(X, y))
+        import pytest
+        with pytest.raises(ValueError, match="models/iteration"):
+            train({"objective": "multiclass", "num_class": 3, "num_iterations": 2},
+                  Dataset(X, np.zeros_like(y)), init_model=base)
+
+    def test_early_stopping_without_valid_raises(self):
+        from mmlspark_tpu.engine.booster import Dataset, train
+
+        X, y = _toy_xy(100, 4, seed=1)
+        import pytest
+        with pytest.raises(ValueError, match="validation"):
+            train({"objective": "binary", "num_iterations": 5,
+                   "early_stopping_round": 2}, Dataset(X, y))
+
+    def test_unknown_hist_backend_raises(self):
+        from mmlspark_tpu.ops.histogram import build_histogram
+        import jax.numpy as jnp
+        import pytest
+
+        with pytest.raises(ValueError, match="hist backend"):
+            build_histogram(jnp.zeros((4, 2), jnp.int32), jnp.zeros((4, 3)),
+                            jnp.ones(4, bool), 4, backend="one_hot")
+
+
+class TestSaveOverwrite:
+    def test_save_refuses_existing_nonempty_dir(self, tmp_path):
+        from mmlspark_tpu.core.pipeline import Transformer
+        from mmlspark_tpu.core.params import Param
+        from mmlspark_tpu.core.registry import register_stage
+        import pytest
+
+        @register_stage
+        class _T(Transformer):
+            value = Param("value", "v", default=1.0, dtype=float)
+
+            def _transform(self, df):
+                return df
+
+        target = tmp_path / "occupied"
+        target.mkdir()
+        (target / "precious.txt").write_text("do not delete")
+        with pytest.raises(FileExistsError):
+            _T().save(str(target))
+        assert (target / "precious.txt").read_text() == "do not delete"
+        _T().save(str(target), overwrite=True)
+        assert not (target / "precious.txt").exists()

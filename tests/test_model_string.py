@@ -65,6 +65,28 @@ class TestModelString:
             rtol=1e-5, atol=1e-5,
         )
 
+    def test_early_stopped_roundtrip_uses_best_iteration(self):
+        # An early-stopped booster predicts with best_iteration+1 trees; the
+        # text format has no best_iteration field, so save must truncate to
+        # the used iterations or a round trip changes predictions.
+        from mmlspark_tpu.engine.booster import Booster
+
+        rng = np.random.default_rng(2)
+        X = rng.normal(size=(400, 5))
+        y = (X[:, 0] > 0).astype(float)
+        b = train(
+            {"objective": "binary", "num_iterations": 30, "num_leaves": 7,
+             "metric": "auc", "early_stopping_round": 2},
+            Dataset(X[:300], y[:300]), valid_sets=[Dataset(X[300:], y[300:])],
+        )
+        assert b.best_iteration >= 0
+        s = b.save_model_string()
+        assert s.count("Tree=") == b.best_iteration + 1
+        b2 = Booster.from_model_string(s)
+        np.testing.assert_allclose(b.predict(X), b2.predict(X), rtol=1e-4, atol=1e-5)
+        # Explicit num_iteration still wins.
+        assert b.save_model_string(num_iteration=3).count("Tree=") == 3
+
     def test_string_is_lightgbm_shaped(self):
         b, _ = _fit("binary")
         s = b.save_model_string()
