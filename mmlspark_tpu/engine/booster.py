@@ -553,12 +553,6 @@ def train(
         init_arr = init_arr + _pad_rows(
             train_set.init_score.astype(np.float32), n_pad
         ).reshape(1, -1)
-    if init_model is not None:
-        # bins_np is already the pinned mapper's binning (padded rows are
-        # harmless: their gradients are zeroed by the bag mask), so score it
-        # directly instead of re-binning through predict().
-        base_raw = init_model._raw_scores_binned(jnp.asarray(bins_np))
-        init_arr = init_arr + np.asarray(base_raw, dtype=np.float32)
 
     # ---- device-resident data ------------------------------------------
     # Under a mesh, rows are sharded over the data axis up front so the
@@ -582,6 +576,13 @@ def train(
         w_dev = None if w_np is None else jnp.asarray(w_np, dtype=jnp.float32)
         valid_mask = jnp.asarray(valid_mask_np)
         init_scores_dev = jnp.asarray(init_arr)
+    if init_model is not None:
+        # Replay the base forest over the already-placed binned matrix:
+        # under a mesh this runs sharded (bins_dev carries the row sharding
+        # into the jitted forest scorer), with no second binning pass and no
+        # unsharded full-matrix copy.  Padded rows score garbage, harmlessly
+        # — their gradients are zeroed by the bag mask.
+        init_scores_dev = init_scores_dev + init_model._raw_scores_binned(bins_dev)
     scores = init_scores_dev
 
     gcfg = GrowConfig(
@@ -598,22 +599,33 @@ def train(
         hist_chunk=chunk,
     )
 
+    def _grow_classes(gcfg_):
+        # One tree per class via lax.map, NOT vmap: batching the grower's
+        # pallas/scatter ops multiplies Mosaic/XLA compile time ~25x (188s
+        # observed for a 63-leaf/256-bin tree on v5e), while lax.map
+        # compiles the body once and runs the K trees sequentially — which
+        # matches real execution anyway.
+        def grow_all(bins_a, grad_a, hess_a, bag_a, fmask_a):
+            def one(args):
+                g, h, fm = args
+                return grow_tree(gcfg_, bins_a, g, h, bag_a, fm)
+
+            return jax.lax.map(one, (grad_a, hess_a, fmask_a))
+
+        return grow_all
+
     if mesh is None:
-        grow = jax.vmap(partial(grow_tree, gcfg), in_axes=(None, 0, 0, None, 0))
+        grow = _grow_classes(gcfg)
     else:
         # Per-shard grower: local rows in, psum-med histograms inside
         # (GrowConfig.axis_name), replicated tree out.  check_vma=False: the
         # tree's replication is established by psum-determinism, which the
-        # static checker cannot see through vmap+argmax.
-        gcfg_sharded = dataclasses.replace(gcfg, axis_name=DATA_AXIS)
-        grow_local = jax.vmap(
-            partial(grow_tree, gcfg_sharded), in_axes=(None, 0, 0, None, 0)
-        )
+        # static checker cannot see through argmax.
         from jax.sharding import PartitionSpec as P
 
         tree_spec = Tree(*([P()] * len(Tree._fields)))
         grow = jax.shard_map(
-            grow_local,
+            _grow_classes(dataclasses.replace(gcfg, axis_name=DATA_AXIS)),
             mesh=mesh,
             in_specs=(P(DATA_AXIS, None), P(None, DATA_AXIS), P(None, DATA_AXIS), P(DATA_AXIS), P(None, None)),
             out_specs=(tree_spec, P(None, DATA_AXIS)),
@@ -741,7 +753,10 @@ def train(
         # (possibly DART-weighted) new tree.
         scores = scores + w_new * delta
 
-        trees_host.append(jax.tree_util.tree_map(lambda a: np.asarray(a), tree))
+        # Keep the tree as device arrays: a per-iteration np.asarray would
+        # force a host sync (painful over remote-dispatch links); the single
+        # conversion happens at stacking time below.
+        trees_host.append(tree)
         tree_weights.append(w_new)
 
         # ---- validation & early stopping -------------------------------
@@ -781,7 +796,7 @@ def train(
     # ---- stack trees (prepending the warm-start forest, if any) ---------
     stacked = Tree(
         *[
-            np.stack([getattr(t, f) for t in trees_host], axis=0)
+            np.stack([np.asarray(getattr(t, f)) for t in trees_host], axis=0)
             for f in Tree._fields
         ]
     )

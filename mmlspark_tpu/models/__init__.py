@@ -1,0 +1,3 @@
+"""User-facing estimators/transformers (the reference's L6 API surface —
+SURVEY.md §1): LightGBM triple, ONNX/CNTK inference, image featurization,
+VW-style linear learners, recommenders, KNN."""

@@ -1,0 +1,56 @@
+"""CNTKModel: batched DataFrame inference for CNTK-era graphs.
+
+Reference parity (SURVEY.md §2.4 / §3.3): ``CNTKModel`` evaluates a
+broadcast CNTK graph per minibatch with input/output node selection by name
+or index (UPSTREAM:.../cntk/CNTKModel.scala — [REF-EMPTY]).
+
+The CNTK runtime is long-discontinued and its binary .model format has no
+maintained loader; SURVEY.md §2.9 N3 prescribes the interchange route:
+"support ONNX as the interchange and treat CNTK models via conversion"
+(CNTK itself shipped ONNX export).  So this transformer accepts the
+ONNX-converted graph and reproduces CNTKModel's column/node-selection API —
+``setInputNode(index | name)``, ``setOutputNode``, single input/output col —
+over the same XLA-lowered executor as :class:`ONNXModel`.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from mmlspark_tpu.core.frame import DataFrame
+from mmlspark_tpu.core.params import Param
+from mmlspark_tpu.core.registry import register_stage
+from mmlspark_tpu.models.onnx_model import _OnnxInferenceBase
+
+
+@register_stage
+class CNTKModel(_OnnxInferenceBase):
+    inputCol = Param("inputCol", "Input column of feature vectors", default="features", dtype=str)
+    outputCol = Param("outputCol", "Output column", default="output", dtype=str)
+    inputNode = Param("inputNode", "Graph input: index (int) or name (str)", default=0)
+    outputNode = Param("outputNode", "Graph output: index (int) or name (str)", default=0)
+    batchInput = Param("batchInput", "Batch rows before evaluation", default=True, dtype=bool)
+
+    def setModel(self, payload_or_path):
+        if isinstance(payload_or_path, (bytes, bytearray)):
+            return self.setModelPayload(bytes(payload_or_path))
+        return self.setModelLocation(payload_or_path)
+
+    def _resolve(self, sel, names):
+        if isinstance(sel, int):
+            return names[sel]
+        if sel in names:
+            return sel
+        raise ValueError(f"node {sel!r} not in {names}")
+
+    def _transform(self, df: DataFrame) -> DataFrame:
+        graph = self._graph()
+        in_name = self._resolve(self.getInputNode(), graph.input_names)
+        out_name = self._resolve(self.getOutputNode(), graph.output_names)
+        if df.count() == 0:
+            return df.withColumn(self.getOutputCol(), [])
+        feeds = {in_name: self._shape_input(df[self.getInputCol()], in_name)}
+        outs = self._run_batched(feeds)
+        val = outs[out_name]
+        val = val.reshape(val.shape[0], -1)  # CNTKModel emits flat vectors
+        return df.withColumn(self.getOutputCol(), list(val.astype(np.float64)))

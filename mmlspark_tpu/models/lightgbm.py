@@ -1,0 +1,481 @@
+"""LightGBM estimator facades: the flagship API surface.
+
+Reference parity (SURVEY.md §2.3): ``LightGBMClassifier`` /
+``LightGBMRegressor`` / ``LightGBMRanker`` estimators over the shared
+distributed-training base (UPSTREAM:.../lightgbm/{LightGBMClassifier,
+LightGBMRegressor,LightGBMRanker,LightGBMBase}.scala — [REF-EMPTY]), with the
+full §2.3.1 param checklist (camelCase names and defaults as in the
+reference's Scala/PySpark surface).
+
+TPU-first differences in the fit path (SURVEY.md §3.1 → §5.8 mapping):
+- ``prepareDataframe``/partition math survive: ``numWorkers = min(numTasks,
+  df partitions)``, but workers are mesh devices, not barrier tasks.
+- The driver rendezvous socket + ``LGBM_NetworkInit`` disappear entirely:
+  one SPMD program over a ``jax.sharding.Mesh`` (rows sharded, histograms
+  ``psum``-med) replaces the TCP allreduce ring.
+- ``deviceType`` accepts "tpu" (default) / "cpu"; the SPMD program is
+  backend-agnostic, so this is a placement hint, honored when such a backend
+  is visible.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import List, Optional
+
+import numpy as np
+
+from mmlspark_tpu.core.frame import DataFrame
+from mmlspark_tpu.core.params import (
+    ComplexParam,
+    HasFeaturesCol,
+    HasLabelCol,
+    HasPredictionCol,
+    HasWeightCol,
+    Param,
+    ParamValidators,
+    Params,
+)
+from mmlspark_tpu.core.pipeline import Estimator, Model
+from mmlspark_tpu.core.registry import register_stage
+
+
+# ---------------------------------------------------------------------------
+# Param surface (SURVEY.md §2.3.1 checklist)
+# ---------------------------------------------------------------------------
+class _LightGBMExecutionParams(Params):
+    """Execution/topology knobs.  Socket-era params (listen ports, timeout,
+    barrier mode) are kept for API compatibility; ports are no-ops by
+    design — there is no socket layer to configure anymore."""
+
+    numTasks = Param(
+        "numTasks",
+        "Cap on parallel workers; 0 = one per DataFrame partition "
+        "(reference: numWorkers = min(numTasks, partitions))",
+        default=0, dtype=int,
+    )
+    parallelism = Param(
+        "parallelism",
+        "Tree learner parallelism: data_parallel|voting_parallel|serial|feature_parallel",
+        default="data_parallel", dtype=str,
+        validator=ParamValidators.inList(
+            ["data_parallel", "voting_parallel", "serial", "feature_parallel"]
+        ),
+    )
+    topK = Param(
+        "topK", "Top-k features voted per worker in voting_parallel", default=20, dtype=int
+    )
+    useBarrierExecutionMode = Param(
+        "useBarrierExecutionMode",
+        "Gang-schedule training (the SPMD program launch is inherently "
+        "gang-scheduled on TPU; kept for API parity)",
+        default=False, dtype=bool,
+    )
+    defaultListenPort = Param(
+        "defaultListenPort", "Legacy socket-allreduce base port (no-op on TPU)",
+        default=12400, dtype=int,
+    )
+    driverListenPort = Param(
+        "driverListenPort", "Legacy driver rendezvous port (no-op on TPU)",
+        default=0, dtype=int,
+    )
+    timeout = Param(
+        "timeout", "Distributed initialization timeout in seconds", default=1200.0,
+        dtype=float,
+    )
+    numBatches = Param(
+        "numBatches", "Split training into sequential batches (continuation-trained)",
+        default=0, dtype=int,
+    )
+    matrixType = Param(
+        "matrixType", "auto|dense|sparse host matrix handling", default="auto",
+        dtype=str, validator=ParamValidators.inList(["auto", "dense", "sparse"]),
+    )
+    numThreads = Param(
+        "numThreads", "Host-side threads for binning (0 = default)", default=0, dtype=int
+    )
+    deviceType = Param(
+        "deviceType", "Compute placement: tpu|cpu|gpu", default="tpu", dtype=str
+    )
+
+
+class _LightGBMParams(
+    HasFeaturesCol, HasLabelCol, HasPredictionCol, HasWeightCol, _LightGBMExecutionParams
+):
+    numIterations = Param("numIterations", "Number of boosting iterations", default=100, dtype=int)
+    learningRate = Param("learningRate", "Shrinkage rate", default=0.1, dtype=float)
+    numLeaves = Param("numLeaves", "Max leaves per tree", default=31, dtype=int)
+    maxBin = Param("maxBin", "Max feature bins", default=255, dtype=int)
+    maxDepth = Param("maxDepth", "Max tree depth (-1 = unlimited)", default=-1, dtype=int)
+    baggingFraction = Param("baggingFraction", "Row subsample fraction", default=1.0, dtype=float)
+    baggingFreq = Param("baggingFreq", "Resample bag every k iterations (0 = off)", default=0, dtype=int)
+    baggingSeed = Param("baggingSeed", "Bagging random seed", default=3, dtype=int)
+    featureFraction = Param("featureFraction", "Feature subsample fraction", default=1.0, dtype=float)
+    minSumHessianInLeaf = Param("minSumHessianInLeaf", "Min leaf hessian sum", default=1e-3, dtype=float)
+    minDataInLeaf = Param("minDataInLeaf", "Min rows per leaf", default=20, dtype=int)
+    lambdaL1 = Param("lambdaL1", "L1 regularization", default=0.0, dtype=float)
+    lambdaL2 = Param("lambdaL2", "L2 regularization", default=0.0, dtype=float)
+    boostingType = Param(
+        "boostingType", "gbdt|rf|dart|goss", default="gbdt", dtype=str,
+        validator=ParamValidators.inList(["gbdt", "rf", "dart", "goss"]),
+    )
+    objective = Param("objective", "Training objective", default="regression", dtype=str)
+    metric = Param("metric", "Eval metric ('' = objective default)", default="", dtype=str)
+    isUnbalance = Param("isUnbalance", "Reweight unbalanced binary labels", default=False, dtype=bool)
+    boostFromAverage = Param("boostFromAverage", "Seed scores at the label average", default=True, dtype=bool)
+    verbosity = Param("verbosity", "Native verbosity", default=1, dtype=int)
+    categoricalSlotIndexes = Param("categoricalSlotIndexes", "Categorical feature indices", default=None)
+    categoricalSlotNames = Param("categoricalSlotNames", "Categorical feature names", default=None)
+    slotNames = Param("slotNames", "Feature vector slot names", default=None)
+    initScoreCol = Param("initScoreCol", "Initial (margin) score column", dtype=str)
+    validationIndicatorCol = Param(
+        "validationIndicatorCol", "Boolean column marking validation rows", dtype=str
+    )
+    earlyStoppingRound = Param("earlyStoppingRound", "Early stopping patience (0 = off)", default=0, dtype=int)
+    isProvideTrainingMetric = Param(
+        "isProvideTrainingMetric", "Record metrics on training data too", default=False, dtype=bool
+    )
+    leafPredictionCol = Param("leafPredictionCol", "Output column of leaf indices", default="", dtype=str)
+    modelString = Param("modelString", "Warm-start model string", default="", dtype=str)
+    seed = Param("seed", "Master random seed", default=0, dtype=int)
+
+    def _train_params(self, num_class: int = 1) -> dict:
+        """Flatten the param surface into the engine's LightGBM-vocabulary
+        config (the reference's ``TrainParams.toString`` — SURVEY.md §5.6)."""
+        p = {
+            "num_iterations": self.getNumIterations(),
+            "learning_rate": self.getLearningRate(),
+            "num_leaves": self.getNumLeaves(),
+            "max_bin": self.getMaxBin(),
+            "max_depth": self.getMaxDepth(),
+            "bagging_fraction": self.getBaggingFraction(),
+            "bagging_freq": self.getBaggingFreq(),
+            "bagging_seed": self.getBaggingSeed(),
+            "feature_fraction": self.getFeatureFraction(),
+            "min_sum_hessian_in_leaf": self.getMinSumHessianInLeaf(),
+            "min_data_in_leaf": self.getMinDataInLeaf(),
+            "lambda_l1": self.getLambdaL1(),
+            "lambda_l2": self.getLambdaL2(),
+            "boosting": self.getBoostingType(),
+            "objective": self.getObjective(),
+            "is_unbalance": self.getIsUnbalance(),
+            "boost_from_average": self.getBoostFromAverage(),
+            "early_stopping_round": self.getEarlyStoppingRound(),
+            "verbosity": self.getVerbosity(),
+            "seed": self.getSeed(),
+            "num_class": num_class,
+        }
+        if self.getMetric():
+            p["metric"] = self.getMetric()
+        cats = self.getCategoricalSlotIndexes()
+        if cats:
+            p["categorical_feature"] = [int(c) for c in cats]
+        learner = {
+            "data_parallel": "data",
+            "voting_parallel": "voting",
+            "serial": "serial",
+            "feature_parallel": "feature",
+        }[self.getParallelism()]
+        p["tree_learner"] = learner
+        p["top_k"] = self.getTopK()
+        return p
+
+    def _num_workers(self, df: DataFrame) -> int:
+        """Reference partition math: numWorkers = min(numTasks, partitions)
+        (SURVEY.md §3.1), further capped by visible devices."""
+        import jax
+
+        workers = df.num_partitions
+        if self.getNumTasks() > 0:
+            workers = min(workers, self.getNumTasks())
+        return max(1, min(workers, jax.device_count()))
+
+
+# ---------------------------------------------------------------------------
+# Shared fit machinery (the reference's LightGBMBase.train — SURVEY.md §3.1)
+# ---------------------------------------------------------------------------
+class _LightGBMEstimator(Estimator, _LightGBMParams):
+    _objective_override: Optional[str] = None
+
+    def _extract(self, df: DataFrame):
+        feats = df[self.getFeaturesCol()]
+        X = np.stack([np.asarray(v, dtype=np.float64) for v in feats])
+        y = np.asarray(df[self.getLabelCol()], dtype=np.float64)
+        w = (
+            np.asarray(df[self.getWeightCol()], dtype=np.float64)
+            if self.isSet("weightCol")
+            else None
+        )
+        init = (
+            np.asarray(df[self.getInitScoreCol()], dtype=np.float64)
+            if self.isSet("initScoreCol")
+            else None
+        )
+        return X, y, w, init
+
+    def _groups(self, df: DataFrame) -> Optional[np.ndarray]:
+        return None
+
+    def _num_class(self, y: np.ndarray) -> int:
+        return 1
+
+    def _fit(self, df: DataFrame) -> "Model":
+        from mmlspark_tpu.engine.booster import Booster, Dataset, train
+        from mmlspark_tpu.parallel.mesh import default_mesh
+
+        vcol = (
+            self.getValidationIndicatorCol()
+            if self.isSet("validationIndicatorCol")
+            else None
+        )
+        train_df, valid_df = df, None
+        if vcol is not None:
+            mask = np.asarray(df[vcol], dtype=bool)
+            train_df = df.filter(~mask)
+            valid_df = df.filter(mask)
+
+        # num_class from ALL labels: a class present only in validation
+        # rows must still get a model head.
+        y_full = np.asarray(df[self.getLabelCol()], dtype=np.float64)
+        X, y, w, init = self._extract(train_df)
+        params = self._train_params(num_class=self._num_class(y_full))
+        ds = Dataset(X, y, weight=w, group=self._groups(train_df), init_score=init)
+        valid_sets = []
+        if valid_df is not None and valid_df.count() > 0:
+            Xv, yv, wv, iv = self._extract(valid_df)
+            valid_sets = [
+                Dataset(Xv, yv, weight=wv, group=self._groups(valid_df), init_score=iv)
+            ]
+
+        workers = self._num_workers(df)
+        mesh = None
+        if workers > 1 and params["tree_learner"] in ("data", "voting"):
+            mesh = default_mesh(num_devices=workers)
+        elif workers <= 1:
+            params["tree_learner"] = "serial"
+
+        init_model = (
+            Booster.from_model_string(self.getModelString())
+            if self.getModelString()
+            else None
+        )
+        if init_model is not None:
+            params.pop("max_bin", None)  # continuation pins the mapper
+        booster = train(
+            params, ds, valid_sets=valid_sets, mesh=mesh, init_model=init_model
+        )
+        model = self._model_class()()
+        self._copyValues(model)
+        model.setBooster(booster)
+        return model
+
+    def _model_class(self):
+        raise NotImplementedError
+
+
+# ---------------------------------------------------------------------------
+# Model base (the reference's LightGBMBooster wrapper + model transformers)
+# ---------------------------------------------------------------------------
+def _save_booster(value, path: str) -> None:
+    with open(path, "w") as f:
+        f.write(value.save_model_string())
+
+
+def _load_booster(path: str):
+    from mmlspark_tpu.engine.booster import Booster
+
+    with open(path) as f:
+        return Booster.from_model_string(f.read())
+
+
+class _LightGBMModel(Model, _LightGBMParams):
+    booster = ComplexParam(
+        "booster", "The trained booster", saver=_save_booster, loader=_load_booster
+    )
+
+    def setBooster(self, b) -> "_LightGBMModel":
+        self._paramMap["booster"] = b
+        return self
+
+    def getBooster(self):
+        return self.getOrDefault("booster")
+
+    # -- reference Booster API (SURVEY.md §2.3) --------------------------
+    def getFeatureImportances(self, importance_type: str = "split") -> List[float]:
+        return list(self.getBooster().feature_importance(importance_type))
+
+    def getBoosterBestIteration(self) -> int:
+        return self.getBooster().best_iteration
+
+    def getBoosterNumTotalIterations(self) -> int:
+        return self.getBooster().num_iterations
+
+    def saveNativeModel(self, path: str, overwrite: bool = True) -> None:
+        """Write the LightGBM text model (scored identically by stock
+        LightGBM — SURVEY.md §7.4.7)."""
+        if os.path.exists(path) and not overwrite:
+            raise FileExistsError(path)
+        os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+        _save_booster(self.getBooster(), path)
+
+    @classmethod
+    def loadNativeModelFromFile(cls, path: str) -> "_LightGBMModel":
+        model = cls()
+        model.setBooster(_load_booster(path))
+        return model
+
+    @classmethod
+    def loadNativeModelFromString(cls, model_string: str) -> "_LightGBMModel":
+        from mmlspark_tpu.engine.booster import Booster
+
+        model = cls()
+        model.setBooster(Booster.from_model_string(model_string))
+        return model
+
+    def _features_matrix(self, df: DataFrame) -> np.ndarray:
+        return np.stack(
+            [np.asarray(v, dtype=np.float64) for v in df[self.getFeaturesCol()]]
+        )
+
+    def _maybe_add_leaves(self, df: DataFrame, X: np.ndarray) -> DataFrame:
+        if self.getLeafPredictionCol():
+            leaves = self.getBooster().predict(X, pred_leaf=True).astype(np.float64)
+            df = df.withColumn(self.getLeafPredictionCol(), list(leaves))
+        return df
+
+
+# ---------------------------------------------------------------------------
+# Classifier
+# ---------------------------------------------------------------------------
+class _ClassifierParams(Params):
+    rawPredictionCol = Param(
+        "rawPredictionCol", "Raw margin output column", default="rawPrediction", dtype=str
+    )
+    probabilityCol = Param(
+        "probabilityCol", "Class probability output column", default="probability", dtype=str
+    )
+    thresholds = Param("thresholds", "Per-class prediction thresholds", default=None)
+
+
+@register_stage
+class LightGBMClassifier(_LightGBMEstimator, _ClassifierParams):
+    """Binary/multiclass GBDT classifier (reference:
+    UPSTREAM:.../lightgbm/LightGBMClassifier.scala — SURVEY.md §2.3)."""
+
+    objective = Param("objective", "Training objective", default="binary", dtype=str)
+
+    def _num_class(self, y) -> int:
+        if self.getObjective() in ("multiclass", "multiclassova"):
+            return int(y.max()) + 1
+        return 1
+
+    def _model_class(self):
+        return LightGBMClassificationModel
+
+
+@register_stage
+class LightGBMClassificationModel(_LightGBMModel, _ClassifierParams):
+    def _transform(self, df: DataFrame) -> DataFrame:
+        X = self._features_matrix(df)
+        booster = self.getBooster()
+        raw = booster.predict(X, raw_score=True)
+        prob = booster.predict(X)
+        if prob.ndim == 1:  # binary → 2-class vectors (SparkML convention)
+            raw = np.stack([-raw, raw], axis=1)
+            prob = np.stack([1.0 - prob, prob], axis=1)
+        thresholds = self.getThresholds()
+        scores = prob if thresholds is None else prob / np.asarray(thresholds)[None, :]
+        pred = scores.argmax(axis=1).astype(np.float64)
+        df = (
+            df.withColumn(self.getRawPredictionCol(), list(raw))
+            .withColumn(self.getProbabilityCol(), list(prob))
+            .withColumn(self.getPredictionCol(), pred)
+        )
+        return self._maybe_add_leaves(df, X)
+
+
+# ---------------------------------------------------------------------------
+# Regressor
+# ---------------------------------------------------------------------------
+@register_stage
+class LightGBMRegressor(_LightGBMEstimator):
+    """Regression objectives incl. quantile/huber/poisson/gamma/tweedie
+    (reference: UPSTREAM:.../lightgbm/LightGBMRegressor.scala)."""
+
+    alpha = Param("alpha", "Quantile/huber alpha", default=0.9, dtype=float)
+    tweedieVariancePower = Param(
+        "tweedieVariancePower", "Tweedie variance power (1..2)", default=1.5, dtype=float
+    )
+
+    def _train_params(self, num_class: int = 1) -> dict:
+        p = super()._train_params(num_class)
+        p["alpha"] = self.getAlpha()
+        p["tweedie_variance_power"] = self.getTweedieVariancePower()
+        return p
+
+    def _model_class(self):
+        return LightGBMRegressionModel
+
+
+@register_stage
+class LightGBMRegressionModel(_LightGBMModel):
+    def _transform(self, df: DataFrame) -> DataFrame:
+        X = self._features_matrix(df)
+        pred = self.getBooster().predict(X).astype(np.float64)
+        df = df.withColumn(self.getPredictionCol(), pred)
+        return self._maybe_add_leaves(df, X)
+
+
+# ---------------------------------------------------------------------------
+# Ranker
+# ---------------------------------------------------------------------------
+@register_stage
+class LightGBMRanker(_LightGBMEstimator):
+    """LambdaRank over query groups (reference:
+    UPSTREAM:.../lightgbm/LightGBMRanker.scala — SURVEY.md §2.3)."""
+
+    objective = Param("objective", "Training objective", default="lambdarank", dtype=str)
+    groupCol = Param("groupCol", "Query group column", default="group", dtype=str)
+    evalAt = Param("evalAt", "NDCG eval positions", default=[1, 2, 3, 4, 5])
+    labelGain = Param("labelGain", "Relevance gain per label value", default=None)
+    maxPosition = Param("maxPosition", "NDCG truncation for lambdarank", default=20, dtype=int)
+    repartitionByGroupingColumn = Param(
+        "repartitionByGroupingColumn",
+        "Keep each query group within one worker shard",
+        default=True, dtype=bool,
+    )
+
+    def _fit(self, df: DataFrame) -> Model:
+        if self.getRepartitionByGroupingColumn():
+            # Groups must be contiguous so rows of one query never straddle
+            # shard boundaries (the reference repartitions by group for the
+            # same reason — SURVEY.md §2.3.1).
+            order = np.argsort(df[self.getGroupCol()], kind="stable")
+            pdf = df.toPandas().iloc[order].reset_index(drop=True)
+            df = DataFrame(pdf, num_partitions=df.num_partitions)
+        return super()._fit(df)
+
+    def _groups(self, df: DataFrame) -> Optional[np.ndarray]:
+        g = df[self.getGroupCol()]
+        # contiguous run-lengths, first-appearance order
+        change = np.flatnonzero(np.r_[True, g[1:] != g[:-1]])
+        return np.diff(np.r_[change, len(g)])
+
+    def _train_params(self, num_class: int = 1) -> dict:
+        p = super()._train_params(num_class)
+        if self.getLabelGain():
+            p["label_gain"] = [float(v) for v in self.getLabelGain()]
+        p["max_position"] = self.getMaxPosition()
+        return p
+
+    def _model_class(self):
+        return LightGBMRankerModel
+
+
+@register_stage
+class LightGBMRankerModel(_LightGBMModel):
+    def _transform(self, df: DataFrame) -> DataFrame:
+        X = self._features_matrix(df)
+        pred = self.getBooster().predict(X, raw_score=True).astype(np.float64)
+        df = df.withColumn(self.getPredictionCol(), pred)
+        return self._maybe_add_leaves(df, X)

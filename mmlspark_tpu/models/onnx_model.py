@@ -1,0 +1,170 @@
+"""ONNXModel: batched DataFrame inference over an XLA-lowered ONNX graph.
+
+Reference parity (SURVEY.md §2.4 / §3.3): ``ONNXModel`` broadcasts the model
+protobuf, opens a per-partition ``OrtSession`` singleton, maps columns to
+graph inputs via ``feedDict`` and outputs to columns via ``fetchDict``, with
+auto-minibatching and optional ``softMaxDict``/``argMaxDict`` post-ops
+(UPSTREAM(SynapseML-era):.../onnx/ONNXModel.scala — [REF-EMPTY]).
+
+TPU-first redesign: there is no session object; the graph is converted once
+to a pure JAX function (``mmlspark_tpu.onnx.OnnxFunction``) and jitted, so
+whole minibatches execute as one fused XLA program on the accelerator
+(SURVEY.md §3.3: "this whole stack becomes: decode on host → jnp batch →
+jitted XLA graph").  Minibatches are padded to a fixed size so every batch
+hits the same compiled program (no shape churn).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import numpy as np
+
+from mmlspark_tpu.core.frame import DataFrame
+from mmlspark_tpu.core.params import ComplexParam, Param, Params
+from mmlspark_tpu.core.pipeline import Model
+from mmlspark_tpu.core.registry import register_stage
+
+
+def _save_bytes(value: bytes, path: str) -> None:
+    with open(path, "wb") as f:
+        f.write(value)
+
+
+def _load_bytes(path: str) -> bytes:
+    with open(path, "rb") as f:
+        return f.read()
+
+
+class _OnnxInferenceBase(Model):
+    """Shared minibatched-inference machinery (ONNXModel + CNTKModel)."""
+
+    modelPayload = ComplexParam(
+        "modelPayload", "Serialized ONNX model bytes", saver=_save_bytes, loader=_load_bytes
+    )
+    miniBatchSize = Param(
+        "miniBatchSize", "Rows per inference minibatch", default=64, dtype=int
+    )
+
+    def setModelLocation(self, path: str):
+        self._paramMap["modelPayload"] = _load_bytes(path)
+        self._fn_cache = None
+        return self
+
+    def setModelPayload(self, payload: bytes):
+        self._paramMap["modelPayload"] = payload
+        self._fn_cache = None
+        return self
+
+    def getModelPayload(self) -> bytes:
+        return self.getOrDefault("modelPayload")
+
+    # -- lazy converted-graph singleton (reference: per-executor lazy
+    # Function.load singleton cache — SURVEY.md §2.4) --------------------
+    _fn_cache = None
+
+    def _graph(self):
+        if getattr(self, "_fn_cache", None) is None:
+            from mmlspark_tpu.onnx import OnnxFunction
+
+            self._fn_cache = OnnxFunction(self.getModelPayload())
+            self._jit_cache = self._fn_cache.jit()
+        return self._fn_cache
+
+    def _run_batched(self, feeds: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:
+        """Fixed-size minibatch loop with tail padding (one compiled shape)."""
+        graph = self._graph()
+        n = next(iter(feeds.values())).shape[0]
+        bs = min(self.getMiniBatchSize(), n)
+        outs: Dict[str, list] = {name: [] for name in graph.output_names}
+        for start in range(0, n, bs):
+            stop = min(start + bs, n)
+            batch = {}
+            for name in graph.input_names:
+                arr = feeds[name][start:stop]
+                if stop - start < bs:  # pad the tail to the compiled shape
+                    pad = np.zeros((bs - (stop - start),) + arr.shape[1:], arr.dtype)
+                    arr = np.concatenate([arr, pad], axis=0)
+                batch[name] = arr
+            result = self._jit_cache(*[batch[n2] for n2 in graph.input_names])
+            for name, val in zip(graph.output_names, result):
+                outs[name].append(np.asarray(val)[: stop - start])
+        return {k: np.concatenate(v, axis=0) for k, v in outs.items()}
+
+    def _shape_input(self, col_values, name: str) -> np.ndarray:
+        """Rows → batched input, reshaped to the graph's declared shape."""
+        arr = np.stack([np.asarray(v, dtype=np.float32) for v in col_values])
+        graph = self._graph()
+        want = graph.input_shapes.get(name)
+        if want and len(want) > 2 and arr.ndim == 2:
+            tail = [d for d in want[1:]]
+            if all(d is not None for d in tail):
+                arr = arr.reshape((arr.shape[0],) + tuple(tail))
+        return arr.astype(graph.input_dtypes.get(name, np.float32))
+
+
+@register_stage
+class ONNXModel(_OnnxInferenceBase):
+    """Generic ONNX inference transformer (feedDict / fetchDict contract)."""
+
+    feedDict = Param(
+        "feedDict", "Map of ONNX graph input name -> DataFrame column", default=None
+    )
+    fetchDict = Param(
+        "fetchDict", "Map of output DataFrame column -> ONNX graph output name",
+        default=None,
+    )
+    softMaxDict = Param(
+        "softMaxDict", "Map input col -> output col to apply softmax to", default=None
+    )
+    argMaxDict = Param(
+        "argMaxDict", "Map input col -> output col to apply argmax to", default=None
+    )
+    deviceType = Param("deviceType", "Compute placement: tpu|cpu", default="tpu", dtype=str)
+
+    def _transform(self, df: DataFrame) -> DataFrame:
+        graph = self._graph()
+        feed = self.getFeedDict() or {
+            graph.input_names[0]: "features"
+        }
+        fetch = self.getFetchDict() or {"prediction": graph.output_names[0]}
+        bad_in = sorted(set(feed) - set(graph.input_names))
+        missing = sorted(set(graph.input_names) - set(feed))
+        if bad_in or missing:
+            raise ValueError(
+                f"feedDict mismatch: unknown graph inputs {bad_in}, "
+                f"unfed graph inputs {missing}; graph inputs are "
+                f"{graph.input_names}"
+            )
+        bad_out = sorted(set(fetch.values()) - set(graph.output_names))
+        if bad_out:
+            raise ValueError(
+                f"fetchDict names {bad_out} not in graph outputs "
+                f"{graph.output_names}"
+            )
+        if df.count() == 0:  # empty partition: just add the empty columns
+            for col in list(fetch) + list(
+                (self.getSoftMaxDict() or {}).values()
+            ) + list((self.getArgMaxDict() or {}).values()):
+                df = df.withColumn(col, [])
+            return df
+        feeds = {
+            in_name: self._shape_input(df[col], in_name)
+            for in_name, col in feed.items()
+        }
+        outs = self._run_batched(feeds)
+        for col, out_name in fetch.items():
+            val = outs[out_name]
+            df = df.withColumn(
+                col, list(val) if val.ndim > 1 else val.astype(np.float64)
+            )
+        for src, dst in (self.getSoftMaxDict() or {}).items():
+            import scipy.special as sp
+
+            probs = sp.softmax(np.stack(df[src]), axis=-1)
+            df = df.withColumn(dst, list(probs))
+        for src, dst in (self.getArgMaxDict() or {}).items():
+            df = df.withColumn(
+                dst, np.stack(df[src]).argmax(axis=-1).astype(np.float64)
+            )
+        return df

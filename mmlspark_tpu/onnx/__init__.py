@@ -1,0 +1,12 @@
+"""ONNX → JAX/XLA import (reference native component N4 — SURVEY.md §2.9).
+
+The reference scores ONNX graphs through onnxruntime-java per partition
+(SURVEY.md §2.4 ONNXModel); here the graph is parsed from the protobuf wire
+format (``onnx.proto`` schema subset, compiled to ``onnx_pb2``) and lowered
+op-by-op to a pure JAX function that jit-compiles to one fused XLA program —
+batched DataFrame inference then rides the MXU instead of a CPU session.
+"""
+
+from mmlspark_tpu.onnx.importer import OnnxFunction, export_model_bytes
+
+__all__ = ["OnnxFunction", "export_model_bytes"]

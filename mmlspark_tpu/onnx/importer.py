@@ -1,0 +1,728 @@
+"""Op-by-op ONNX graph → JAX function conversion.
+
+Design (SURVEY.md §7.3.5 "ONNX→JAX importer"):
+
+- The graph executes by walking nodes in (spec-guaranteed) topological order
+  with a name→value environment.  Initializers and shape-derived values stay
+  **concrete** (numpy / eager jax arrays), so shape-plumbing subgraphs
+  (Shape → Gather → Concat → Reshape) constant-fold naturally during jit
+  tracing and never produce dynamic shapes — the XLA-friendliness hinge.
+- Covered op set: the ResNet-50 family (Conv/BatchNormalization/Relu/
+  MaxPool/GlobalAveragePool/Gemm/Add/Flatten/Softmax — SURVEY.md §7.3.5)
+  plus the common elementwise/shape algebra emitted by torch/tf exporters.
+- Layouts follow ONNX (NCHW); XLA repacks for the MXU on its own.
+
+Reference behavior being replaced: per-partition ``OrtSession`` inference
+inside ``ONNXModel.transform`` (UPSTREAM(SynapseML-era):.../onnx/
+ONNXModel.scala — [REF-EMPTY]; in scope per BASELINE.json regardless).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from mmlspark_tpu.onnx import onnx_pb2 as pb
+
+# ---------------------------------------------------------------------------
+# Tensor decoding
+# ---------------------------------------------------------------------------
+_DTYPES = {
+    1: np.float32, 2: np.uint8, 3: np.int8, 4: np.uint16, 5: np.int16,
+    6: np.int32, 7: np.int64, 9: np.bool_, 10: np.float16, 11: np.float64,
+    12: np.uint32, 13: np.uint64,
+}
+_BF16 = 16
+
+
+def tensor_to_np(t: pb.TensorProto) -> np.ndarray:
+    shape = tuple(t.dims)
+    if t.data_type == _BF16:
+        if t.raw_data:
+            u16 = np.frombuffer(t.raw_data, dtype=np.uint16)
+            return (
+                (u16.astype(np.uint32) << 16).view(np.float32).reshape(shape)
+            )
+        raise NotImplementedError("bfloat16 int32_data tensors")
+    dtype = _DTYPES.get(t.data_type)
+    if dtype is None:
+        raise NotImplementedError(f"ONNX tensor data_type {t.data_type}")
+    if t.raw_data:
+        return np.frombuffer(t.raw_data, dtype=dtype).reshape(shape).copy()
+    if t.data_type == 1:
+        return np.asarray(t.float_data, np.float32).reshape(shape)
+    if t.data_type == 11:
+        return np.asarray(t.double_data, np.float64).reshape(shape)
+    if t.data_type == 7:
+        return np.asarray(t.int64_data, np.int64).reshape(shape)
+    if t.data_type in (2, 3, 4, 5, 6, 9, 10):
+        return np.asarray(t.int32_data, np.int32).astype(dtype).reshape(shape)
+    if t.data_type in (12, 13):
+        return np.asarray(t.uint64_data, np.uint64).astype(dtype).reshape(shape)
+    raise NotImplementedError(f"tensor encoding for data_type {t.data_type}")
+
+
+def np_to_tensor(arr: np.ndarray, name: str = "") -> pb.TensorProto:
+    """Inverse of :func:`tensor_to_np` (used by tests/model builders)."""
+    t = pb.TensorProto()
+    t.name = name
+    t.dims.extend(arr.shape)
+    rev = {v: k for k, v in _DTYPES.items()}
+    t.data_type = rev[arr.dtype.type]
+    t.raw_data = np.ascontiguousarray(arr).tobytes()
+    return t
+
+
+def _attrs(node: pb.NodeProto) -> Dict[str, Any]:
+    out = {}
+    for a in node.attribute:
+        if a.type == pb.AttributeProto.FLOAT:
+            out[a.name] = a.f
+        elif a.type == pb.AttributeProto.INT:
+            out[a.name] = int(a.i)
+        elif a.type == pb.AttributeProto.STRING:
+            out[a.name] = a.s.decode()
+        elif a.type == pb.AttributeProto.TENSOR:
+            out[a.name] = tensor_to_np(a.t)
+        elif a.type == pb.AttributeProto.FLOATS:
+            out[a.name] = list(a.floats)
+        elif a.type == pb.AttributeProto.INTS:
+            out[a.name] = [int(v) for v in a.ints]
+        elif a.type == pb.AttributeProto.STRINGS:
+            out[a.name] = [s.decode() for s in a.strings]
+        else:
+            raise NotImplementedError(f"attribute type {a.type} ({a.name})")
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Op registry.  Each op: fn(attrs, opset, *inputs) -> output | tuple
+# ---------------------------------------------------------------------------
+_OPS: Dict[str, Callable] = {}
+
+
+def op(name):
+    def deco(fn):
+        _OPS[name] = fn
+        return fn
+
+    return deco
+
+
+def _int_list(v) -> List[int]:
+    return [int(x) for x in np.asarray(v).reshape(-1)]
+
+
+def _is_np(v) -> bool:
+    """Concrete host value (kept in numpy so shape algebra folds at trace
+    time — under jit, any jnp op would be staged into the graph and poison
+    downstream reshape targets with tracers)."""
+    return isinstance(v, (np.ndarray, np.generic, int, float))
+
+
+def _conv_pads(attrs, spatial, kernel, strides, dilations, in_shape):
+    """ONNX pads [x1b, x2b, ..., x1e, x2e] → lax [(lo, hi), ...]."""
+    auto = attrs.get("auto_pad", "NOTSET")
+    if auto in ("NOTSET", ""):
+        pads = attrs.get("pads", [0] * (2 * spatial))
+        return [(pads[i], pads[i + spatial]) for i in range(spatial)]
+    if auto == "VALID":
+        return [(0, 0)] * spatial
+    out = []
+    for i in range(spatial):
+        eff_k = (kernel[i] - 1) * dilations[i] + 1
+        out_dim = -(-in_shape[i] // strides[i])  # ceil
+        total = max(0, (out_dim - 1) * strides[i] + eff_k - in_shape[i])
+        half = total // 2
+        out.append((half, total - half) if auto == "SAME_UPPER" else (total - half, half))
+    return out
+
+
+@op("Conv")
+def _conv(attrs, opset, x, w, b=None):
+    spatial = x.ndim - 2
+    kernel = attrs.get("kernel_shape", list(w.shape[2:]))
+    strides = attrs.get("strides", [1] * spatial)
+    dilations = attrs.get("dilations", [1] * spatial)
+    groups = attrs.get("group", 1)
+    pads = _conv_pads(attrs, spatial, kernel, strides, dilations, x.shape[2:])
+    dims = ("NCHW", "OIHW", "NCHW") if spatial == 2 else ("NCW", "OIW", "NCW")
+    out = lax.conv_general_dilated(
+        x, w, window_strides=strides, padding=pads,
+        rhs_dilation=dilations, feature_group_count=groups,
+        dimension_numbers=dims,
+    )
+    if b is not None:
+        out = out + b.reshape((1, -1) + (1,) * spatial)
+    return out
+
+
+@op("ConvTranspose")
+def _conv_transpose(attrs, opset, x, w, b=None):
+    spatial = x.ndim - 2
+    strides = attrs.get("strides", [1] * spatial)
+    pads = attrs.get("pads", [0] * (2 * spatial))
+    out_pads = attrs.get("output_padding", [0] * spatial)
+    groups = attrs.get("group", 1)
+    if groups != 1:
+        raise NotImplementedError("grouped ConvTranspose")
+    # ONNX ConvTranspose == gradient of Conv: lax transposed conv via
+    # lhs_dilation; pads map to (k-1-pad) on each side plus output_padding.
+    k = list(w.shape[2:])
+    pad_pairs = [
+        (k[i] - 1 - pads[i], k[i] - 1 - pads[i + spatial] + out_pads[i])
+        for i in range(spatial)
+    ]
+    dims = ("NCHW", "IOHW", "NCHW") if spatial == 2 else ("NCW", "IOW", "NCW")
+    out = lax.conv_general_dilated(
+        x, w, window_strides=[1] * spatial, padding=pad_pairs,
+        lhs_dilation=strides, dimension_numbers=dims,
+    )
+    if b is not None:
+        out = out + b.reshape((1, -1) + (1,) * spatial)
+    return out
+
+
+@op("BatchNormalization")
+def _bn(attrs, opset, x, scale, bias, mean, var):
+    eps = attrs.get("epsilon", 1e-5)
+    shape = (1, -1) + (1,) * (x.ndim - 2)
+    inv = lax.rsqrt(var.astype(jnp.float32) + eps)
+    return ((x - mean.reshape(shape)) * (scale * inv).reshape(shape)) + bias.reshape(shape)
+
+
+def _pool(x, attrs, reducer, init, is_avg=False):
+    spatial = x.ndim - 2
+    kernel = attrs["kernel_shape"]
+    strides = attrs.get("strides", [1] * spatial)
+    dilations = attrs.get("dilations", [1] * spatial)
+    pads = _conv_pads(attrs, spatial, kernel, strides, dilations, x.shape[2:])
+    if attrs.get("ceil_mode", 0):
+        # extend the end-padding so the last partial window is included
+        pads = [
+            (lo, hi + s - 1) for (lo, hi), s in zip(pads, strides)
+        ]
+    window = (1, 1) + tuple(kernel)
+    strides_full = (1, 1) + tuple(strides)
+    dil_full = (1, 1) + tuple(dilations)
+    pads_full = ((0, 0), (0, 0)) + tuple(pads)
+    out = lax.reduce_window(
+        x, init, reducer, window, strides_full, pads_full, window_dilation=dil_full
+    )
+    if is_avg:
+        if attrs.get("count_include_pad", 0):
+            denom = float(np.prod(kernel))
+            out = out / denom
+        else:
+            ones = jnp.ones(x.shape[2:], x.dtype)[None, None]
+            counts = lax.reduce_window(
+                ones, 0.0, lax.add, window, strides_full, pads_full,
+                window_dilation=dil_full,
+            )
+            out = out / counts
+    return out
+
+
+@op("MaxPool")
+def _maxpool(attrs, opset, x):
+    return _pool(x, attrs, lax.max, -jnp.inf)
+
+
+@op("AveragePool")
+def _avgpool(attrs, opset, x):
+    return _pool(x, attrs, lax.add, 0.0, is_avg=True)
+
+
+@op("GlobalAveragePool")
+def _gap(attrs, opset, x):
+    return jnp.mean(x, axis=tuple(range(2, x.ndim)), keepdims=True)
+
+
+@op("GlobalMaxPool")
+def _gmp(attrs, opset, x):
+    return jnp.max(x, axis=tuple(range(2, x.ndim)), keepdims=True)
+
+
+@op("Gemm")
+def _gemm(attrs, opset, a, b, c=None):
+    if attrs.get("transA", 0):
+        a = a.T
+    if attrs.get("transB", 0):
+        b = b.T
+    out = attrs.get("alpha", 1.0) * (a @ b)
+    if c is not None:
+        out = out + attrs.get("beta", 1.0) * c
+    return out
+
+
+@op("MatMul")
+def _matmul(attrs, opset, a, b):
+    return jnp.matmul(a, b)
+
+
+@op("LRN")
+def _lrn(attrs, opset, x):
+    size = attrs["size"]
+    alpha, beta, bias = attrs.get("alpha", 1e-4), attrs.get("beta", 0.75), attrs.get("bias", 1.0)
+    sq = x * x
+    half = (size - 1) // 2
+    pad = [(0, 0), (half, size - 1 - half)] + [(0, 0)] * (x.ndim - 2)
+    window = (1, size) + (1,) * (x.ndim - 2)
+    s = lax.reduce_window(sq, 0.0, lax.add, window, (1,) * x.ndim, pad)
+    return x / jnp.power(bias + alpha / size * s, beta)
+
+
+# ---- elementwise -----------------------------------------------------------
+for _name, _fn in {
+    "Relu": lambda x: jnp.maximum(x, 0),
+    "Sigmoid": jax.nn.sigmoid,
+    "Tanh": jnp.tanh,
+    "Exp": jnp.exp,
+    "Log": jnp.log,
+    "Sqrt": jnp.sqrt,
+    "Neg": jnp.negative,
+    "Abs": jnp.abs,
+    "Floor": jnp.floor,
+    "Ceil": jnp.ceil,
+    "Round": jnp.round,
+    "Erf": jax.scipy.special.erf,
+    "Sign": jnp.sign,
+    "Reciprocal": lambda x: 1.0 / x,
+    "Softplus": jax.nn.softplus,
+    "Identity": lambda x: x,
+}.items():
+    _OPS[_name] = (lambda f: lambda attrs, opset, x: f(x))(_fn)
+
+for _name, _fn in {
+    "Add": jnp.add, "Sub": jnp.subtract, "Mul": jnp.multiply,
+    "Div": jnp.divide, "Pow": jnp.power,
+    "Greater": jnp.greater, "Less": jnp.less, "Equal": jnp.equal,
+    "GreaterOrEqual": jnp.greater_equal, "LessOrEqual": jnp.less_equal,
+    "And": jnp.logical_and, "Or": jnp.logical_or,
+}.items():
+    _OPS[_name] = (lambda f: lambda attrs, opset, a, b: f(a, b))(_fn)
+
+_OPS["Sum"] = lambda attrs, opset, *xs: functools.reduce(jnp.add, xs)
+_OPS["Min"] = lambda attrs, opset, *xs: functools.reduce(jnp.minimum, xs)
+_OPS["Max"] = lambda attrs, opset, *xs: functools.reduce(jnp.maximum, xs)
+_OPS["Where"] = lambda attrs, opset, c, a, b: jnp.where(c, a, b)
+_OPS["Not"] = lambda attrs, opset, x: jnp.logical_not(x)
+
+
+@op("LeakyRelu")
+def _leaky(attrs, opset, x):
+    return jnp.where(x >= 0, x, attrs.get("alpha", 0.01) * x)
+
+
+@op("Elu")
+def _elu(attrs, opset, x):
+    a = attrs.get("alpha", 1.0)
+    return jnp.where(x >= 0, x, a * (jnp.exp(x) - 1.0))
+
+
+@op("HardSigmoid")
+def _hard_sigmoid(attrs, opset, x):
+    return jnp.clip(attrs.get("alpha", 0.2) * x + attrs.get("beta", 0.5), 0, 1)
+
+
+@op("Gelu")
+def _gelu(attrs, opset, x):
+    return jax.nn.gelu(x, approximate=attrs.get("approximate", "none") == "tanh")
+
+
+@op("Clip")
+def _clip(attrs, opset, x, lo=None, hi=None):
+    if opset < 11:
+        lo, hi = attrs.get("min", -np.inf), attrs.get("max", np.inf)
+    lo = -jnp.inf if lo is None else lo
+    hi = jnp.inf if hi is None else hi
+    return jnp.clip(x, lo, hi)
+
+
+@op("Softmax")
+def _softmax(attrs, opset, x):
+    axis = attrs.get("axis", -1 if opset >= 13 else 1)
+    if opset >= 13:
+        return jax.nn.softmax(x, axis=axis)
+    # Pre-13 semantics: flatten to 2-D at `axis`, softmax the tail.
+    shape = x.shape
+    flat = x.reshape(int(np.prod(shape[:axis])) if axis else 1, -1)
+    return jax.nn.softmax(flat, axis=-1).reshape(shape)
+
+
+@op("LogSoftmax")
+def _log_softmax(attrs, opset, x):
+    axis = attrs.get("axis", -1 if opset >= 13 else 1)
+    return jax.nn.log_softmax(x, axis=axis)
+
+
+@op("Dropout")
+def _dropout(attrs, opset, x, *rest):
+    return x  # inference mode
+
+
+# ---- shape algebra ---------------------------------------------------------
+@op("Shape")
+def _shape(attrs, opset, x):
+    return np.asarray(x.shape, np.int64)  # concrete → folds downstream
+
+
+@op("Size")
+def _size(attrs, opset, x):
+    return np.asarray(int(np.prod(x.shape)), np.int64)
+
+
+@op("Reshape")
+def _reshape(attrs, opset, x, shape=None):
+    target = _int_list(attrs["shape"] if shape is None else shape)
+    out = []
+    for i, d in enumerate(target):
+        if d == 0 and not attrs.get("allowzero", 0):
+            out.append(x.shape[i])
+        else:
+            out.append(d)
+    return jnp.reshape(x, out)
+
+
+@op("Flatten")
+def _flatten(attrs, opset, x):
+    axis = attrs.get("axis", 1)
+    lead = int(np.prod(x.shape[:axis])) if axis else 1
+    return jnp.reshape(x, (lead, -1))
+
+
+@op("Transpose")
+def _transpose(attrs, opset, x):
+    perm = attrs.get("perm", list(range(x.ndim))[::-1])
+    return jnp.transpose(x, perm)
+
+
+@op("Concat")
+def _concat(attrs, opset, *xs):
+    if all(_is_np(v) for v in xs):
+        return np.concatenate([np.atleast_1d(np.asarray(v)) for v in xs],
+                              axis=attrs["axis"])  # stay concrete
+    return jnp.concatenate(xs, axis=attrs["axis"])
+
+
+@op("Squeeze")
+def _squeeze(attrs, opset, x, axes=None):
+    ax = attrs.get("axes") if axes is None else _int_list(axes)
+    if ax is None:
+        return jnp.squeeze(x)
+    return jnp.squeeze(x, axis=tuple(ax))
+
+
+@op("Unsqueeze")
+def _unsqueeze(attrs, opset, x, axes=None):
+    ax = attrs.get("axes") if axes is None else _int_list(axes)
+    out = np.asarray(x) if _is_np(x) else x
+    for a in sorted(int(v) for v in ax):
+        out = (np.expand_dims if _is_np(out) else jnp.expand_dims)(out, a)
+    return out
+
+
+@op("Slice")
+def _slice(attrs, opset, x, starts=None, ends=None, axes=None, steps=None):
+    if opset < 10:
+        starts, ends, axes = attrs["starts"], attrs["ends"], attrs.get("axes")
+        steps = None
+    starts, ends = _int_list(starts), _int_list(ends)
+    axes = list(range(len(starts))) if axes is None else _int_list(axes)
+    steps = [1] * len(starts) if steps is None else _int_list(steps)
+    slices = [slice(None)] * x.ndim
+    for s, e, a, st in zip(starts, ends, axes, steps):
+        slices[a] = slice(s, None if e >= np.iinfo(np.int32).max else e, st)
+    return x[tuple(slices)]
+
+
+@op("Split")
+def _split(attrs, opset, x, split=None):
+    axis = attrs.get("axis", 0)
+    sizes = attrs.get("split") if split is None else _int_list(split)
+    if sizes is None:
+        n = attrs.get("num_outputs", 2)
+        return tuple(jnp.split(x, n, axis=axis))
+    bounds = np.cumsum(sizes)[:-1]
+    return tuple(jnp.split(x, bounds, axis=axis))
+
+
+@op("Gather")
+def _gather(attrs, opset, x, idx):
+    axis = attrs.get("axis", 0)
+    if _is_np(x) and _is_np(idx):
+        return np.asarray(np.take(x, np.asarray(idx, np.int64), axis=axis))
+    return jnp.take(x, jnp.asarray(idx).astype(jnp.int32), axis=axis)
+
+
+@op("Cast")
+def _cast(attrs, opset, x):
+    to = _DTYPES.get(attrs["to"])
+    if to is None:
+        raise NotImplementedError(f"Cast to {attrs['to']}")
+    return np.asarray(x).astype(to) if _is_np(x) else x.astype(to)
+
+
+@op("Constant")
+def _constant(attrs, opset):
+    for k in ("value", "value_float", "value_int", "value_floats", "value_ints"):
+        if k in attrs:
+            return np.asarray(attrs[k])
+    raise NotImplementedError("Constant without value attribute")
+
+
+@op("ConstantOfShape")
+def _constant_of_shape(attrs, opset, shape):
+    val = attrs.get("value", np.zeros(1, np.float32))
+    return np.full(_int_list(shape), np.asarray(val).reshape(-1)[0])
+
+
+@op("Expand")
+def _expand(attrs, opset, x, shape):
+    target = _int_list(shape)
+    # ONNX Expand uses bidirectional broadcast against the current shape.
+    ndim = max(len(target), x.ndim)
+    xs = (1,) * (ndim - x.ndim) + tuple(x.shape)
+    tg = [1] * (ndim - len(target)) + target
+    full = [max(a, b) for a, b in zip(xs, tg)]
+    return jnp.broadcast_to(x.reshape(xs), full)
+
+
+@op("Range")
+def _range(attrs, opset, start, limit, delta):
+    return np.arange(int(start), int(limit), int(delta))
+
+
+@op("Pad")
+def _pad(attrs, opset, x, pads=None, value=None, axes=None):
+    if opset < 11:
+        pads, value = attrs["pads"], attrs.get("value", 0.0)
+    pads = _int_list(pads)
+    mode = attrs.get("mode", "constant")
+    n = x.ndim
+    axes_l = list(range(n)) if axes is None else _int_list(axes)
+    width = [(0, 0)] * n
+    for i, a in enumerate(axes_l):
+        width[a] = (pads[i], pads[i + len(axes_l)])
+    if mode == "constant":
+        cv = 0.0 if value is None else float(np.asarray(value).reshape(-1)[0])
+        return jnp.pad(x, width, constant_values=cv)
+    return jnp.pad(x, width, mode={"reflect": "reflect", "edge": "edge"}[mode])
+
+
+def _reduce(fn_np, fn_jnp):
+    def impl(attrs, opset, x, axes=None):
+        ax = attrs.get("axes") if axes is None else _int_list(axes)
+        keep = bool(attrs.get("keepdims", 1))
+        ax_t = None if not ax else tuple(int(a) for a in ax)
+        if ax_t is None and attrs.get("noop_with_empty_axes", 0):
+            return x
+        f = fn_np if _is_np(x) else fn_jnp
+        return f(x, axis=ax_t, keepdims=keep)
+
+    return impl
+
+
+_OPS["ReduceMean"] = _reduce(np.mean, jnp.mean)
+_OPS["ReduceSum"] = _reduce(np.sum, jnp.sum)
+_OPS["ReduceMax"] = _reduce(np.max, jnp.max)
+_OPS["ReduceMin"] = _reduce(np.min, jnp.min)
+_OPS["ReduceProd"] = _reduce(np.prod, jnp.prod)
+
+
+@op("ReduceL2")
+def _reduce_l2(attrs, opset, x, axes=None):
+    ax = attrs.get("axes") if axes is None else _int_list(axes)
+    keep = bool(attrs.get("keepdims", 1))
+    return jnp.sqrt(jnp.sum(x * x, axis=None if not ax else tuple(ax), keepdims=keep))
+
+
+@op("ArgMax")
+def _argmax(attrs, opset, x):
+    axis = attrs.get("axis", 0)
+    out = jnp.argmax(x, axis=axis)
+    if attrs.get("keepdims", 1):
+        out = jnp.expand_dims(out, axis)
+    return out.astype(jnp.int64)
+
+
+@op("ArgMin")
+def _argmin(attrs, opset, x):
+    axis = attrs.get("axis", 0)
+    out = jnp.argmin(x, axis=axis)
+    if attrs.get("keepdims", 1):
+        out = jnp.expand_dims(out, axis)
+    return out.astype(jnp.int64)
+
+
+@op("Resize")
+def _resize(attrs, opset, x, roi=None, scales=None, sizes=None):
+    mode = attrs.get("mode", "nearest")
+    if sizes is not None and np.size(sizes):
+        target = _int_list(sizes)
+    else:
+        sc = np.asarray(scales).reshape(-1)
+        target = [int(round(d * s)) for d, s in zip(x.shape, sc)]
+    method = {"nearest": "nearest", "linear": "bilinear", "cubic": "bicubic"}[mode]
+    return jax.image.resize(x, target, method=method)
+
+
+@op("InstanceNormalization")
+def _instance_norm(attrs, opset, x, scale, bias):
+    eps = attrs.get("epsilon", 1e-5)
+    ax = tuple(range(2, x.ndim))
+    mu = jnp.mean(x, axis=ax, keepdims=True)
+    var = jnp.var(x, axis=ax, keepdims=True)
+    shape = (1, -1) + (1,) * (x.ndim - 2)
+    return (x - mu) * lax.rsqrt(var + eps) * scale.reshape(shape) + bias.reshape(shape)
+
+
+# ---------------------------------------------------------------------------
+# Graph executor
+# ---------------------------------------------------------------------------
+class OnnxFunction:
+    """A parsed ONNX model, callable as a pure function of its graph inputs.
+
+    ``fn = OnnxFunction(model_bytes); out = fn({"data": batch})`` — also
+    exposes ``input_names``/``output_names``/``input_shapes`` and a
+    ``jit()`` wrapper that compiles the whole graph into one XLA program.
+    """
+
+    def __init__(self, model_bytes: bytes):
+        model = pb.ModelProto.FromString(model_bytes)
+        self.opset = 13
+        for imp in model.opset_import:
+            if imp.domain in ("", "ai.onnx"):
+                self.opset = int(imp.version)
+        g = model.graph
+        self.graph = g
+        self.initializers: Dict[str, np.ndarray] = {
+            t.name: tensor_to_np(t) for t in g.initializer
+        }
+        self.input_names = [
+            v.name for v in g.input if v.name not in self.initializers
+        ]
+        self.output_names = [v.name for v in g.output]
+        self.input_shapes: Dict[str, Tuple[Optional[int], ...]] = {}
+        self.input_dtypes: Dict[str, np.dtype] = {}
+        for v in g.input:
+            if v.name in self.initializers:
+                continue
+            tt = v.type.tensor_type
+            dims = tuple(
+                (int(d.dim_value) if d.WhichOneof("value") == "dim_value" else None)
+                for d in tt.shape.dim
+            )
+            self.input_shapes[v.name] = dims
+            self.input_dtypes[v.name] = np.dtype(_DTYPES.get(tt.elem_type, np.float32))
+        unsupported = sorted(
+            {n.op_type for n in g.node if n.op_type not in _OPS}
+        )
+        if unsupported:
+            raise NotImplementedError(
+                f"unsupported ONNX ops: {unsupported}; supported: {sorted(_OPS)}"
+            )
+
+    # -- execution -------------------------------------------------------
+    def __call__(self, feeds: Dict[str, Any]) -> Dict[str, Any]:
+        missing = [n for n in self.input_names if n not in feeds]
+        if missing:
+            raise ValueError(f"missing graph inputs: {missing}")
+        env: Dict[str, Any] = dict(self.initializers)
+        env.update({k: feeds[k] for k in self.input_names})
+        env[""] = None  # optional-input placeholder
+        for node in self.graph.node:
+            fn = _OPS[node.op_type]
+            args = [env[i] for i in node.input]
+            out = fn(_attrs(node), self.opset, *args)
+            outs = out if isinstance(out, tuple) else (out,)
+            for name, val in zip(node.output, outs):
+                if name:
+                    env[name] = val
+        return {n: env[n] for n in self.output_names}
+
+    def jit(self) -> Callable:
+        """Positional-arg jitted callable: fn(*inputs) -> tuple(outputs)."""
+
+        @jax.jit
+        def fn(*arrays):
+            out = self({n: a for n, a in zip(self.input_names, arrays)})
+            return tuple(jnp.asarray(out[n]) for n in self.output_names)
+
+        return fn
+
+    @staticmethod
+    def from_file(path: str) -> "OnnxFunction":
+        with open(path, "rb") as f:
+            return OnnxFunction(f.read())
+
+
+def export_model_bytes(
+    nodes: Sequence[pb.NodeProto],
+    inputs: Sequence[Tuple[str, Sequence[Optional[int]], int]],
+    outputs: Sequence[str],
+    initializers: Dict[str, np.ndarray],
+    opset: int = 13,
+) -> bytes:
+    """Assemble a ModelProto from parts (model-builder for tests/tools)."""
+    m = pb.ModelProto()
+    m.ir_version = 8
+    imp = m.opset_import.add()
+    imp.domain = ""
+    imp.version = opset
+    g = m.graph
+    g.name = "graph"
+    for n in nodes:
+        g.node.add().CopyFrom(n)
+    for name, shape, elem in inputs:
+        v = g.input.add()
+        v.name = name
+        v.type.tensor_type.elem_type = elem
+        for d in shape:
+            dim = v.type.tensor_type.shape.dim.add()
+            if d is None:
+                dim.dim_param = "N"
+            else:
+                dim.dim_value = d
+    for name in outputs:
+        g.output.add().name = name
+    for name, arr in initializers.items():
+        g.initializer.add().CopyFrom(np_to_tensor(arr, name))
+    return m.SerializeToString()
+
+
+def make_node(op_type: str, inputs, outputs, **attrs) -> pb.NodeProto:
+    """Tiny NodeProto builder (mirrors onnx.helper.make_node)."""
+    n = pb.NodeProto()
+    n.op_type = op_type
+    n.input.extend(inputs)
+    n.output.extend(outputs)
+    for k, v in attrs.items():
+        a = n.attribute.add()
+        a.name = k
+        if isinstance(v, float):
+            a.type = pb.AttributeProto.FLOAT
+            a.f = v
+        elif isinstance(v, bool) or isinstance(v, int):
+            a.type = pb.AttributeProto.INT
+            a.i = int(v)
+        elif isinstance(v, str):
+            a.type = pb.AttributeProto.STRING
+            a.s = v.encode()
+        elif isinstance(v, np.ndarray):
+            a.type = pb.AttributeProto.TENSOR
+            a.t.CopyFrom(np_to_tensor(v))
+        elif isinstance(v, (list, tuple)) and v and isinstance(v[0], float):
+            a.type = pb.AttributeProto.FLOATS
+            a.floats.extend(v)
+        elif isinstance(v, (list, tuple)):
+            a.type = pb.AttributeProto.INTS
+            a.ints.extend(int(x) for x in v)
+        else:
+            raise TypeError(f"attribute {k}={v!r}")
+    return n

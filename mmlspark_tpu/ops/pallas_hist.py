@@ -54,33 +54,37 @@ def _hist_kernel(bins_ref, vals_ref, out_ref, *, num_bins: int):
         dimension_numbers=(((0,), (1,)), ((), ())),
         preferred_element_type=jnp.float32,
         precision=jax.lax.Precision.HIGHEST,
-    )  # (3, bf*B)
-    part = part.reshape(3, bf, num_bins)
+    )  # (3, bf*B) — kept flat: Mosaic can't lane-split (3, bf*B)→(3, bf, B)
+    # when B < 128, so the (F, B) unflatten happens outside the kernel.
 
     @pl.when(i == 0)
     def _init():
-        out_ref[...] = part
+        out_ref[...] = part[None, :, :]
 
     @pl.when(i > 0)
     def _acc():
-        out_ref[...] += part
+        out_ref[...] += part[None, :, :]
 
 
 @functools.partial(jax.jit, static_argnames=("num_bins", "bm", "bf", "interpret"))
 def _pallas_hist(bins_t, vals, num_bins: int, bm: int, bf: int, interpret: bool):
     F, n = bins_t.shape
     kernel = functools.partial(_hist_kernel, num_bins=num_bins)
-    return pl.pallas_call(
+    out = pl.pallas_call(
         kernel,
         grid=(F // bf, n // bm),
         in_specs=[
             pl.BlockSpec((bf, bm), lambda j, i: (j, i)),
             pl.BlockSpec((bm, 3), lambda j, i: (i, 0)),
         ],
-        out_specs=pl.BlockSpec((3, bf, num_bins), lambda j, i: (0, j, 0)),
-        out_shape=jax.ShapeDtypeStruct((3, F, num_bins), jnp.float32),
+        # Output layout (F/bf, 3, bf·B): feature-block leading so the block
+        # shape's last two dims (3, bf·B) satisfy TPU tiling by equalling
+        # the array dims; channels/bins unflatten outside the kernel.
+        out_specs=pl.BlockSpec((1, 3, bf * num_bins), lambda j, i: (j, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((F // bf, 3, bf * num_bins), jnp.float32),
         interpret=interpret,
     )(bins_t, vals)
+    return out.transpose(1, 0, 2).reshape(3, F, num_bins)
 
 
 def pallas_hist_chunk(
@@ -104,9 +108,17 @@ def pallas_hist_chunk(
         vals_c = jnp.pad(vals_c, ((0, pad_r), (0, 0)))
     if pad_f:
         bins_t = jnp.pad(bins_t, ((0, pad_f), (0, 0)))
-    interpret = jax.default_backend() == "cpu"
-    out = _pallas_hist(bins_t, vals_c, num_bins, bm, bf, interpret)  # (3, Fp, B)
-    return out[:, :F, :].transpose(1, 2, 0)
+    backend = jax.default_backend()
+    if backend not in ("cpu", "tpu"):
+        # The sequential-innermost-grid accumulation is a TPU contract; on
+        # GPU Pallas lowers via Triton with parallel grid cells and the
+        # out_ref accumulation would race.
+        raise NotImplementedError(
+            f"hist_backend='pallas' supports tpu (compiled) and cpu "
+            f"(interpret) backends, not {backend!r}; use 'scatter'"
+        )
+    out = _pallas_hist(bins_t, vals_c, num_bins, bm, bf, backend == "cpu")
+    return out[:, :F, :].transpose(1, 2, 0)  # (3, Fp, B) → (F, B, 3)
 
 
 def _round_up(x: int, m: int) -> int:

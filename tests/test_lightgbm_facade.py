@@ -1,0 +1,214 @@
+"""LightGBM estimator-facade tests (reference suite analog:
+UPSTREAM:.../lightgbm/split*/Verify{LightGBMClassifier,Regressor,Ranker}
+— SURVEY.md §4.3: AUC-threshold asserts, weight effects, early stopping,
+save/load native model)."""
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu import DataFrame
+from mmlspark_tpu.models.lightgbm import (
+    LightGBMClassificationModel,
+    LightGBMClassifier,
+    LightGBMRanker,
+    LightGBMRegressionModel,
+    LightGBMRegressor,
+)
+
+
+def _auc(y, p):
+    order = np.argsort(p)
+    ranks = np.empty(len(p))
+    ranks[order] = np.arange(1, len(p) + 1)
+    pos = y > 0
+    return (ranks[pos].sum() - pos.sum() * (pos.sum() + 1) / 2) / (pos.sum() * (~pos).sum())
+
+
+@pytest.fixture(scope="module")
+def small_params():
+    return dict(numIterations=10, numLeaves=7, minDataInLeaf=5)
+
+
+class TestClassifier:
+    def test_fit_transform_binary(self, binary_df, small_params):
+        model = LightGBMClassifier(**small_params).fit(binary_df)
+        out = model.transform(binary_df)
+        for col in ("rawPrediction", "probability", "prediction"):
+            assert col in out.columns
+        prob = np.stack(out["probability"])
+        assert prob.shape == (binary_df.count(), 2)
+        np.testing.assert_allclose(prob.sum(axis=1), 1.0, atol=1e-5)
+        assert _auc(binary_df["label"], prob[:, 1]) > 0.97
+        raw = np.stack(out["rawPrediction"])
+        np.testing.assert_allclose(raw[:, 0], -raw[:, 1], atol=1e-6)
+        acc = (out["prediction"] == binary_df["label"]).mean()
+        assert acc > 0.9
+
+    def test_thresholds_shift_prediction(self, binary_df, small_params):
+        model = LightGBMClassifier(**small_params).fit(binary_df)
+        default_pred = model.transform(binary_df)["prediction"]
+        skewed = model.copy({"thresholds": [0.01, 0.99]})
+        skewed_pred = skewed.transform(binary_df)["prediction"]
+        assert skewed_pred.sum() < default_pred.sum()
+
+    def test_leaf_prediction_col(self, binary_df, small_params):
+        model = LightGBMClassifier(leafPredictionCol="leaves", **small_params).fit(binary_df)
+        out = model.transform(binary_df)
+        leaves = np.stack(out["leaves"])
+        assert leaves.shape == (binary_df.count(), 10)
+        assert leaves.max() < 7
+
+    def test_early_stopping_with_validation_col(self, binary_df):
+        rng = np.random.default_rng(0)
+        df = binary_df.withColumn("isVal", rng.random(binary_df.count()) < 0.3)
+        model = LightGBMClassifier(
+            numIterations=50, numLeaves=7, minDataInLeaf=5,
+            validationIndicatorCol="isVal", earlyStoppingRound=3, metric="auc",
+        ).fit(df)
+        assert 0 <= model.getBooster().best_iteration < 50
+
+    def test_multiclass(self):
+        rng = np.random.default_rng(3)
+        X = rng.normal(size=(600, 5))
+        y = (X[:, 0] > 0.4).astype(float) + (X[:, 1] > 0).astype(float)
+        df = DataFrame({"features": list(X), "label": y}, num_partitions=2)
+        model = LightGBMClassifier(
+            objective="multiclass", numIterations=10, numLeaves=7, minDataInLeaf=5
+        ).fit(df)
+        out = model.transform(df)
+        prob = np.stack(out["probability"])
+        assert prob.shape == (600, 3)
+        assert (out["prediction"] == y).mean() > 0.8
+
+    def test_save_load_roundtrip(self, binary_df, small_params, tmp_path):
+        model = LightGBMClassifier(**small_params).fit(binary_df)
+        p = str(tmp_path / "clf_model")
+        model.save(p)
+        loaded = LightGBMClassificationModel.load(p)
+        np.testing.assert_allclose(
+            np.stack(model.transform(binary_df)["probability"]),
+            np.stack(loaded.transform(binary_df)["probability"]),
+            rtol=1e-4, atol=1e-5,
+        )
+
+    def test_native_model_file_roundtrip(self, binary_df, small_params, tmp_path):
+        model = LightGBMClassifier(**small_params).fit(binary_df)
+        p = str(tmp_path / "model.txt")
+        model.saveNativeModel(p)
+        loaded = LightGBMClassificationModel.loadNativeModelFromFile(p)
+        np.testing.assert_allclose(
+            np.stack(model.transform(binary_df)["probability"])[:, 1],
+            np.stack(loaded.transform(binary_df)["probability"])[:, 1],
+            rtol=1e-4, atol=1e-5,
+        )
+
+    def test_model_string_warm_start(self, binary_df, small_params):
+        base = LightGBMClassifier(**small_params).fit(binary_df)
+        s = base.getBooster().save_model_string()
+        cont = LightGBMClassifier(**small_params).setModelString(s).fit(binary_df)
+        assert cont.getBooster().num_iterations == 20
+
+    def test_feature_importances(self, binary_df, small_params):
+        model = LightGBMClassifier(**small_params).fit(binary_df)
+        imp = model.getFeatureImportances()
+        assert len(imp) == len(binary_df["features"][0])
+        assert sum(imp) > 0
+
+    def test_serial_matches_parallel_quality(self, binary_df, small_params):
+        par = LightGBMClassifier(**small_params).fit(binary_df)
+        ser = LightGBMClassifier(parallelism="serial", **small_params).fit(binary_df)
+        y = binary_df["label"]
+        auc_p = _auc(y, np.stack(par.transform(binary_df)["probability"])[:, 1])
+        auc_s = _auc(y, np.stack(ser.transform(binary_df)["probability"])[:, 1])
+        assert abs(auc_p - auc_s) < 0.01
+
+
+class TestRegressor:
+    def test_fit_transform(self, regression_df):
+        model = LightGBMRegressor(numIterations=20, numLeaves=15, minDataInLeaf=5).fit(
+            regression_df
+        )
+        out = model.transform(regression_df)
+        y = regression_df["label"]
+        pred = out["prediction"]
+        ss_res = float(((pred - y) ** 2).sum())
+        ss_tot = float(((y - y.mean()) ** 2).sum())
+        assert 1 - ss_res / ss_tot > 0.5  # R²
+
+    def test_quantile_objective(self, regression_df):
+        lo = LightGBMRegressor(
+            objective="quantile", alpha=0.1, numIterations=20, numLeaves=7, minDataInLeaf=5
+        ).fit(regression_df)
+        hi = LightGBMRegressor(
+            objective="quantile", alpha=0.9, numIterations=20, numLeaves=7, minDataInLeaf=5
+        ).fit(regression_df)
+        assert hi.transform(regression_df)["prediction"].mean() > lo.transform(
+            regression_df
+        )["prediction"].mean()
+
+    def test_weight_col(self, regression_df):
+        rng = np.random.default_rng(1)
+        w = np.where(regression_df["label"] > np.median(regression_df["label"]), 5.0, 0.5)
+        df = regression_df.withColumn("w", w)
+        m_w = LightGBMRegressor(
+            weightCol="w", numIterations=10, numLeaves=7, minDataInLeaf=5
+        ).fit(df)
+        m_0 = LightGBMRegressor(numIterations=10, numLeaves=7, minDataInLeaf=5).fit(df)
+        assert (
+            m_w.transform(df)["prediction"].mean() > m_0.transform(df)["prediction"].mean()
+        )
+
+    def test_save_load(self, regression_df, tmp_path):
+        model = LightGBMRegressor(numIterations=5, numLeaves=7, minDataInLeaf=5).fit(
+            regression_df
+        )
+        p = str(tmp_path / "reg")
+        model.save(p)
+        loaded = LightGBMRegressionModel.load(p)
+        np.testing.assert_allclose(
+            model.transform(regression_df)["prediction"],
+            loaded.transform(regression_df)["prediction"],
+            rtol=1e-4, atol=1e-4,
+        )
+
+
+class TestRanker:
+    @pytest.fixture(scope="class")
+    def ranking_df(self):
+        rng = np.random.default_rng(5)
+        rows, groups, labels = [], [], []
+        for q in range(40):
+            size = int(rng.integers(5, 12))
+            X = rng.normal(size=(size, 6))
+            rel = np.clip((X[:, 0] * 2 + rng.normal(scale=0.3, size=size)).round(), 0, 3)
+            rows.extend(list(X))
+            groups.extend([q] * size)
+            labels.extend(rel)
+        # shuffle rows so repartitionByGroupingColumn has work to do
+        perm = rng.permutation(len(rows))
+        return DataFrame(
+            {
+                "features": [rows[i] for i in perm],
+                "label": np.asarray(labels)[perm],
+                "query": np.asarray(groups)[perm].astype(float),
+            },
+            num_partitions=2,
+        )
+
+    def test_fit_and_rank(self, ranking_df):
+        model = LightGBMRanker(
+            groupCol="query", numIterations=20, numLeaves=7, minDataInLeaf=3
+        ).fit(ranking_df)
+        out = model.transform(ranking_df)
+        # Predicted scores must correlate with relevance labels.
+        scores = out["prediction"]
+        labels = ranking_df["label"]
+        corr = np.corrcoef(scores, labels)[0, 1]
+        assert corr > 0.5
+
+    def test_ranker_requires_group_integrity(self, ranking_df):
+        model = LightGBMRanker(
+            groupCol="query", numIterations=3, numLeaves=7, minDataInLeaf=3,
+            parallelism="serial",
+        ).fit(ranking_df)
+        assert model.getBooster().num_iterations == 3

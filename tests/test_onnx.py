@@ -1,0 +1,310 @@
+"""ONNX importer + ONNXModel/CNTKModel tests.
+
+Numerical oracle: torch functional ops (cpu) with identical weights — the
+same role stock LightGBM plays for the GBDT tests (SURVEY.md §4.4 style).
+Models are built programmatically with the in-repo protobuf classes (no
+onnx package exists in this environment, by design)."""
+
+import numpy as np
+import pytest
+import torch
+import torch.nn.functional as F
+
+from mmlspark_tpu.onnx.importer import (
+    OnnxFunction,
+    export_model_bytes,
+    make_node,
+)
+
+FLOAT = 1
+
+
+def _run_single(op_bytes, feeds):
+    fn = OnnxFunction(op_bytes)
+    return {k: np.asarray(v) for k, v in fn(feeds).items()}
+
+
+def _model(nodes, inputs, outputs, inits=None, opset=13):
+    return export_model_bytes(nodes, inputs, outputs, inits or {}, opset=opset)
+
+
+class TestOpParity:
+    def test_conv_stride_pad(self):
+        rng = np.random.default_rng(0)
+        x = rng.normal(size=(2, 3, 16, 16)).astype(np.float32)
+        w = rng.normal(size=(5, 3, 3, 3)).astype(np.float32)
+        b = rng.normal(size=5).astype(np.float32)
+        m = _model(
+            [make_node("Conv", ["x", "w", "b"], ["y"], strides=[2, 2], pads=[1, 1, 1, 1])],
+            [("x", (None, 3, 16, 16), FLOAT)], ["y"], {"w": w, "b": b},
+        )
+        got = _run_single(m, {"x": x})["y"]
+        want = F.conv2d(torch.tensor(x), torch.tensor(w), torch.tensor(b),
+                        stride=2, padding=1).numpy()
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+
+    def test_conv_groups_dilation(self):
+        rng = np.random.default_rng(1)
+        x = rng.normal(size=(1, 4, 12, 12)).astype(np.float32)
+        w = rng.normal(size=(8, 2, 3, 3)).astype(np.float32)
+        m = _model(
+            [make_node("Conv", ["x", "w"], ["y"], group=2, dilations=[2, 2])],
+            [("x", (None, 4, 12, 12), FLOAT)], ["y"], {"w": w},
+        )
+        got = _run_single(m, {"x": x})["y"]
+        want = F.conv2d(torch.tensor(x), torch.tensor(w), groups=2, dilation=2).numpy()
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+
+    def test_maxpool_and_avgpool(self):
+        rng = np.random.default_rng(2)
+        x = rng.normal(size=(1, 2, 10, 10)).astype(np.float32)
+        m = _model(
+            [make_node("MaxPool", ["x"], ["y"], kernel_shape=[3, 3], strides=[2, 2],
+                       pads=[1, 1, 1, 1])],
+            [("x", (None, 2, 10, 10), FLOAT)], ["y"],
+        )
+        got = _run_single(m, {"x": x})["y"]
+        want = F.max_pool2d(torch.tensor(x), 3, stride=2, padding=1).numpy()
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+        m = _model(
+            [make_node("AveragePool", ["x"], ["y"], kernel_shape=[2, 2], strides=[2, 2])],
+            [("x", (None, 2, 10, 10), FLOAT)], ["y"],
+        )
+        got = _run_single(m, {"x": x})["y"]
+        want = F.avg_pool2d(torch.tensor(x), 2, stride=2).numpy()
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+    def test_batchnorm(self):
+        rng = np.random.default_rng(3)
+        x = rng.normal(size=(2, 4, 5, 5)).astype(np.float32)
+        scale = rng.uniform(0.5, 2, 4).astype(np.float32)
+        bias = rng.normal(size=4).astype(np.float32)
+        mean = rng.normal(size=4).astype(np.float32)
+        var = rng.uniform(0.5, 2, 4).astype(np.float32)
+        m = _model(
+            [make_node("BatchNormalization", ["x", "s", "b", "m", "v"], ["y"], epsilon=1e-5)],
+            [("x", (None, 4, 5, 5), FLOAT)], ["y"],
+            {"s": scale, "b": bias, "m": mean, "v": var},
+        )
+        got = _run_single(m, {"x": x})["y"]
+        want = F.batch_norm(torch.tensor(x), torch.tensor(mean), torch.tensor(var),
+                            torch.tensor(scale), torch.tensor(bias), eps=1e-5).numpy()
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+
+    def test_gemm_transb(self):
+        rng = np.random.default_rng(4)
+        a = rng.normal(size=(3, 7)).astype(np.float32)
+        b = rng.normal(size=(5, 7)).astype(np.float32)
+        c = rng.normal(size=5).astype(np.float32)
+        m = _model(
+            [make_node("Gemm", ["a", "b", "c"], ["y"], transB=1, alpha=0.5, beta=2.0)],
+            [("a", (None, 7), FLOAT)], ["y"], {"b": b, "c": c},
+        )
+        got = _run_single(m, {"a": a})["y"]
+        want = 0.5 * (a @ b.T) + 2.0 * c
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+
+    def test_softmax_and_clip(self):
+        x = np.array([[1.0, 2.0, 3.0], [0.0, 0.0, 0.0]], np.float32)
+        m = _model([make_node("Softmax", ["x"], ["y"], axis=-1)],
+                   [("x", (None, 3), FLOAT)], ["y"])
+        got = _run_single(m, {"x": x})["y"]
+        np.testing.assert_allclose(got, F.softmax(torch.tensor(x), -1).numpy(), rtol=1e-5)
+
+        m = _model([make_node("Clip", ["x", "lo", "hi"], ["y"])],
+                   [("x", (None, 3), FLOAT)], ["y"],
+                   {"lo": np.float32(0.5), "hi": np.float32(2.5)})
+        got = _run_single(m, {"x": x})["y"]
+        np.testing.assert_allclose(got, np.clip(x, 0.5, 2.5), rtol=1e-6)
+
+    def test_shape_algebra_folds_under_jit(self):
+        # Shape → Gather → Unsqueeze → Concat → Reshape: the torch-exporter
+        # flatten idiom; must not produce dynamic shapes under jit.
+        import jax
+
+        m = _model(
+            [
+                make_node("Shape", ["x"], ["sh"]),
+                make_node("Gather", ["sh", "zero"], ["n"], axis=0),
+                make_node("Unsqueeze", ["n", "ax0"], ["n1"]),
+                make_node("Concat", ["n1", "minus1"], ["target"], axis=0),
+                make_node("Reshape", ["x", "target"], ["y"]),
+            ],
+            [("x", (None, 2, 3), FLOAT)], ["y"],
+            {"zero": np.int64(0), "ax0": np.array([0], np.int64),
+             "minus1": np.array([-1], np.int64)},
+        )
+        fn = OnnxFunction(m)
+        x = np.arange(24, dtype=np.float32).reshape(4, 2, 3)
+        out = jax.jit(lambda a: fn({"x": a})["y"])(x)
+        assert out.shape == (4, 6)
+
+    def test_reduce_and_transpose(self):
+        rng = np.random.default_rng(6)
+        x = rng.normal(size=(2, 3, 4)).astype(np.float32)
+        m = _model(
+            [make_node("Transpose", ["x"], ["t"], perm=[0, 2, 1]),
+             make_node("ReduceMean", ["t"], ["y"], axes=[2], keepdims=0)],
+            [("x", (None, 3, 4), FLOAT)], ["y"],
+        )
+        got = _run_single(m, {"x": x})["y"]
+        np.testing.assert_allclose(got, x.transpose(0, 2, 1).mean(axis=2), rtol=1e-5)
+
+    def test_unsupported_op_raises(self):
+        m = _model([make_node("FancyNewOp", ["x"], ["y"])],
+                   [("x", (None, 3), FLOAT)], ["y"])
+        with pytest.raises(NotImplementedError, match="FancyNewOp"):
+            OnnxFunction(m)
+
+
+class TestResNetBlock:
+    """A residual bottleneck chain vs the identical torch module."""
+
+    def _torch_block(self, seed=0):
+        torch.manual_seed(seed)
+        conv1 = torch.nn.Conv2d(8, 8, 3, padding=1, bias=False)
+        bn1 = torch.nn.BatchNorm2d(8).eval()
+        conv2 = torch.nn.Conv2d(8, 8, 3, padding=1, bias=False)
+        bn2 = torch.nn.BatchNorm2d(8).eval()
+        fc = torch.nn.Linear(8, 4)
+        with torch.no_grad():
+            for bn in (bn1, bn2):
+                bn.running_mean.normal_()
+                bn.running_var.uniform_(0.5, 2.0)
+                bn.weight.normal_()
+                bn.bias.normal_()
+        return conv1, bn1, conv2, bn2, fc
+
+    def test_block_matches_torch(self):
+        conv1, bn1, conv2, bn2, fc = self._torch_block()
+
+        def np_(t):
+            return t.detach().numpy()
+
+        inits = {
+            "w1": np_(conv1.weight), "s1": np_(bn1.weight), "b1": np_(bn1.bias),
+            "m1": np_(bn1.running_mean), "v1": np_(bn1.running_var),
+            "w2": np_(conv2.weight), "s2": np_(bn2.weight), "b2": np_(bn2.bias),
+            "m2": np_(bn2.running_mean), "v2": np_(bn2.running_var),
+            "wfc": np_(fc.weight), "bfc": np_(fc.bias),
+        }
+        nodes = [
+            make_node("Conv", ["x", "w1"], ["c1"], pads=[1, 1, 1, 1]),
+            make_node("BatchNormalization", ["c1", "s1", "b1", "m1", "v1"], ["n1"]),
+            make_node("Relu", ["n1"], ["r1"]),
+            make_node("Conv", ["r1", "w2"], ["c2"], pads=[1, 1, 1, 1]),
+            make_node("BatchNormalization", ["c2", "s2", "b2", "m2", "v2"], ["n2"]),
+            make_node("Add", ["n2", "x"], ["res"]),
+            make_node("Relu", ["res"], ["r2"]),
+            make_node("GlobalAveragePool", ["r2"], ["gap"]),
+            make_node("Flatten", ["gap"], ["flat"]),
+            make_node("Gemm", ["flat", "wfc", "bfc"], ["logits"], transB=1),
+            make_node("Softmax", ["logits"], ["prob"], axis=-1),
+        ]
+        m = _model(nodes, [("x", (None, 8, 6, 6), FLOAT)], ["prob"], inits)
+        fn = OnnxFunction(m)
+
+        rng = np.random.default_rng(7)
+        x = rng.normal(size=(3, 8, 6, 6)).astype(np.float32)
+        got = np.asarray(fn({"x": x})["prob"])
+
+        with torch.no_grad():
+            t = torch.tensor(x)
+            h = F.relu(bn1(conv1(t)))
+            h = bn2(conv2(h)) + t
+            h = F.relu(h)
+            h = h.mean(dim=(2, 3))
+            want = F.softmax(fc(h), dim=-1).numpy()
+        np.testing.assert_allclose(got, want, rtol=1e-3, atol=1e-4)
+
+
+class TestOnnxModelTransformer:
+    @pytest.fixture(scope="class")
+    def tiny_model_bytes(self):
+        rng = np.random.default_rng(8)
+        w = rng.normal(size=(4, 6)).astype(np.float32)
+        b = rng.normal(size=4).astype(np.float32)
+        nodes = [
+            make_node("Gemm", ["data", "w", "b"], ["logits"], transB=1),
+            make_node("Softmax", ["logits"], ["prob"], axis=-1),
+        ]
+        return _model(nodes, [("data", (None, 6), FLOAT)], ["logits", "prob"],
+                      {"w": w, "b": b}), w, b
+
+    def test_feed_fetch_minibatch(self, tiny_model_bytes):
+        from mmlspark_tpu import DataFrame
+        from mmlspark_tpu.models.onnx_model import ONNXModel
+
+        payload, w, b = tiny_model_bytes
+        rng = np.random.default_rng(9)
+        X = rng.normal(size=(37, 6)).astype(np.float32)  # 37 % 8 != 0 → tail pad
+        df = DataFrame({"feats": list(X)})
+        model = (
+            ONNXModel(miniBatchSize=8,
+                      feedDict={"data": "feats"},
+                      fetchDict={"out_logits": "logits", "out_prob": "prob"})
+            .setModelPayload(payload)
+        )
+        out = model.transform(df)
+        logits = np.stack(out["out_logits"])
+        np.testing.assert_allclose(logits, X @ w.T + b, rtol=1e-4, atol=1e-4)
+        prob = np.stack(out["out_prob"])
+        np.testing.assert_allclose(prob.sum(axis=1), 1.0, atol=1e-5)
+
+    def test_softmax_argmax_postops(self, tiny_model_bytes):
+        from mmlspark_tpu import DataFrame
+        from mmlspark_tpu.models.onnx_model import ONNXModel
+
+        payload, w, b = tiny_model_bytes
+        X = np.random.default_rng(10).normal(size=(10, 6)).astype(np.float32)
+        df = DataFrame({"features": list(X)})
+        model = (
+            ONNXModel(feedDict={"data": "features"},
+                      fetchDict={"logits": "logits"},
+                      softMaxDict={"logits": "probability"},
+                      argMaxDict={"logits": "prediction"})
+            .setModelPayload(payload)
+        )
+        out = model.transform(df)
+        np.testing.assert_allclose(np.stack(out["probability"]).sum(axis=1), 1.0, atol=1e-5)
+        assert (out["prediction"] == np.stack(out["logits"]).argmax(axis=1)).all()
+
+    def test_stage_save_load(self, tiny_model_bytes, tmp_path):
+        from mmlspark_tpu import DataFrame
+        from mmlspark_tpu.models.onnx_model import ONNXModel
+
+        payload, w, b = tiny_model_bytes
+        model = ONNXModel(feedDict={"data": "features"},
+                          fetchDict={"pred": "logits"}).setModelPayload(payload)
+        p = str(tmp_path / "onnx_stage")
+        model.save(p)
+        loaded = ONNXModel.load(p)
+        X = np.random.default_rng(11).normal(size=(5, 6)).astype(np.float32)
+        df = DataFrame({"features": list(X)})
+        np.testing.assert_allclose(
+            np.stack(model.transform(df)["pred"]),
+            np.stack(loaded.transform(df)["pred"]),
+            rtol=1e-5,
+        )
+
+
+class TestCNTKModel:
+    def test_node_selection_and_flat_output(self):
+        from mmlspark_tpu import DataFrame
+        from mmlspark_tpu.models.cntk_model import CNTKModel
+
+        rng = np.random.default_rng(12)
+        w = rng.normal(size=(3, 5)).astype(np.float32)
+        payload = _model(
+            [make_node("Gemm", ["in0", "w"], ["out0"], transB=1),
+             make_node("Relu", ["out0"], ["out1"])],
+            [("in0", (None, 5), FLOAT)], ["out0", "out1"], {"w": w},
+        )
+        X = rng.normal(size=(9, 5)).astype(np.float32)
+        df = DataFrame({"features": list(X)})
+        model = CNTKModel(inputNode=0, outputNode="out1", outputCol="feats_out")
+        model.setModel(payload)
+        out = model.transform(df)
+        vals = np.stack(out["feats_out"])
+        np.testing.assert_allclose(vals, np.maximum(X @ w.T, 0), rtol=1e-4, atol=1e-4)
