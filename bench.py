@@ -68,7 +68,7 @@ def bench_tpu(X, y):
     t0 = time.perf_counter()
     booster = train(params, ds)
     wall = time.perf_counter() - t0
-    a = auc(y, booster.predict(X[:100_000]))
+    a = auc(y[:100_000], booster.predict(X[:100_000]))
     _log(f"tpu train: {wall:.2f}s  train-AUC(first 100k)={a:.4f}")
     return wall, a
 
@@ -84,7 +84,7 @@ def bench_cpu_baseline(X, y):
     t0 = time.perf_counter()
     clf.fit(X, y)
     wall = time.perf_counter() - t0
-    a = auc(y, clf.predict_proba(X[:100_000])[:, 1])
+    a = auc(y[:100_000], clf.predict_proba(X[:100_000])[:, 1])
     _log(f"cpu baseline (sklearn HistGBDT): {wall:.2f}s  train-AUC={a:.4f}")
     return wall, a
 

@@ -1,0 +1,26 @@
+"""AutoML: model selection + hyperparameter tuning (reference:
+``cms.automl`` — SURVEY.md §2.7)."""
+
+from mmlspark_tpu.automl.hyperparams import (
+    DiscreteHyperParam,
+    DoubleRangeHyperParam,
+    FloatRangeHyperParam,
+    GridSpace,
+    HyperparamBuilder,
+    IntRangeHyperParam,
+    LongRangeHyperParam,
+    RandomSpace,
+)
+from mmlspark_tpu.automl.search import (
+    BestModel,
+    FindBestModel,
+    TuneHyperparameters,
+    TuneHyperparametersModel,
+)
+
+__all__ = [
+    "DiscreteHyperParam", "DoubleRangeHyperParam", "FloatRangeHyperParam",
+    "GridSpace", "HyperparamBuilder", "IntRangeHyperParam",
+    "LongRangeHyperParam", "RandomSpace", "BestModel", "FindBestModel",
+    "TuneHyperparameters", "TuneHyperparametersModel",
+]

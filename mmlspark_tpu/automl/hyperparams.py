@@ -1,0 +1,110 @@
+"""Hyperparameter space definitions (reference:
+UPSTREAM:.../automl/HyperparamBuilder.scala — SURVEY.md §2.7: "random/grid
+search with HyperparamBuilder, {Int,Long,Float,Double,Discrete}RangeHyperParam")."""
+
+from __future__ import annotations
+
+import itertools
+from typing import Any, Dict, Iterator, List, Sequence, Tuple
+
+import numpy as np
+
+
+class _HyperParam:
+    def sample(self, rng: np.random.Generator):
+        raise NotImplementedError
+
+    def grid_values(self) -> List[Any]:
+        raise NotImplementedError
+
+
+class DiscreteHyperParam(_HyperParam):
+    def __init__(self, values: Sequence[Any], seed: int = 0):
+        self.values = list(values)
+
+    def sample(self, rng):
+        return self.values[int(rng.integers(len(self.values)))]
+
+    def grid_values(self):
+        return list(self.values)
+
+
+class _RangeHyperParam(_HyperParam):
+    _cast = staticmethod(float)
+    _integral = False
+
+    def __init__(self, minimum, maximum, seed: int = 0):
+        if not minimum < maximum:
+            raise ValueError(f"range requires min < max, got [{minimum}, {maximum}]")
+        self.min, self.max = minimum, maximum
+
+    def sample(self, rng):
+        if self._integral:
+            return self._cast(rng.integers(self.min, self.max + 1))
+        return self._cast(self.min + (self.max - self.min) * rng.random())
+
+    def grid_values(self, n: int = 5):
+        if self._integral:
+            vals = np.unique(np.linspace(self.min, self.max, n).astype(np.int64))
+        else:
+            vals = np.linspace(self.min, self.max, n)
+        return [self._cast(v) for v in vals]
+
+
+class IntRangeHyperParam(_RangeHyperParam):
+    _cast = staticmethod(int)
+    _integral = True
+
+
+class LongRangeHyperParam(_RangeHyperParam):
+    _cast = staticmethod(int)
+    _integral = True
+
+
+class FloatRangeHyperParam(_RangeHyperParam):
+    _cast = staticmethod(float)
+
+
+class DoubleRangeHyperParam(_RangeHyperParam):
+    _cast = staticmethod(float)
+
+
+class HyperparamBuilder:
+    """Collects (param-name, space) pairs for one estimator."""
+
+    def __init__(self):
+        self._space: List[Tuple[str, _HyperParam]] = []
+
+    def addHyperparam(self, param, space: _HyperParam) -> "HyperparamBuilder":
+        name = param if isinstance(param, str) else param.name
+        self._space.append((name, space))
+        return self
+
+    def build(self) -> List[Tuple[str, _HyperParam]]:
+        return list(self._space)
+
+
+class RandomSpace:
+    """Random sampler over a built hyperparam space."""
+
+    def __init__(self, space: List[Tuple[str, _HyperParam]], seed: int = 0):
+        self.space = space
+        self.seed = seed
+
+    def param_maps(self, n: int) -> Iterator[Dict[str, Any]]:
+        rng = np.random.default_rng(self.seed)
+        for _ in range(n):
+            yield {name: hp.sample(rng) for name, hp in self.space}
+
+
+class GridSpace:
+    """Exhaustive grid over a built hyperparam space."""
+
+    def __init__(self, space: List[Tuple[str, _HyperParam]]):
+        self.space = space
+
+    def param_maps(self, n: int = 0) -> Iterator[Dict[str, Any]]:
+        names = [name for name, _ in self.space]
+        values = [hp.grid_values() for _, hp in self.space]
+        for combo in itertools.product(*values):
+            yield dict(zip(names, combo))

@@ -1,0 +1,169 @@
+"""FindBestModel + TuneHyperparameters (reference:
+UPSTREAM:.../automl/{FindBestModel,TuneHyperparameters}.scala — SURVEY.md
+§2.7, call stack §3.5: sample N param maps → parallel CV fits → evaluate →
+argmax)."""
+
+from __future__ import annotations
+
+from concurrent.futures import ThreadPoolExecutor
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from mmlspark_tpu.core.frame import DataFrame
+from mmlspark_tpu.core.params import ComplexParam, Param, Params
+from mmlspark_tpu.core.pipeline import Estimator, Model
+from mmlspark_tpu.core.registry import register_stage
+from mmlspark_tpu.train.compute_statistics import ComputeModelStatistics
+
+_METRIC_LARGER_BETTER = {
+    "AUC": True, "accuracy": True, "precision": True, "recall": True,
+    "R^2": True, "r2": True,
+    "mse": False, "mean_squared_error": False, "rmse": False,
+    "root_mean_squared_error": False, "mae": False, "mean_absolute_error": False,
+}
+
+_METRIC_KEY = {
+    "AUC": "AUC", "accuracy": "accuracy", "precision": "precision",
+    "recall": "recall", "r2": "R^2", "R^2": "R^2",
+    "mse": "mean_squared_error", "mean_squared_error": "mean_squared_error",
+    "rmse": "root_mean_squared_error",
+    "root_mean_squared_error": "root_mean_squared_error",
+    "mae": "mean_absolute_error", "mean_absolute_error": "mean_absolute_error",
+}
+
+
+def _evaluate(scored: DataFrame, metric: str, label_col: str) -> float:
+    kind = (
+        "classification"
+        if metric in ("AUC", "accuracy", "precision", "recall")
+        else "regression"
+    )
+    scores_col = "probability" if "probability" in scored.columns else None
+    stats = ComputeModelStatistics(
+        evaluationMetric=kind, labelCol=label_col, scoresCol=scores_col
+    ).transform(scored)
+    return float(stats.first()[_METRIC_KEY[metric]])
+
+
+@register_stage
+class BestModel(Model):
+    bestModel = ComplexParam("bestModel", "Winning fitted model", default=None)
+    bestScore = Param("bestScore", "Winning metric value", default=None, dtype=float)
+    allScores = ComplexParam("allScores", "Per-candidate scores", default=None)
+
+    def getBestModel(self):
+        return self.getOrDefault("bestModel")
+
+    def getBestModelMetrics(self):
+        return self.getOrDefault("allScores")
+
+    def _transform(self, df):
+        return self.getBestModel().transform(df)
+
+
+@register_stage
+class FindBestModel(Estimator):
+    """Evaluate pre-built candidate estimators on one validation frame."""
+
+    models = ComplexParam("models", "Candidate estimators", default=None)
+    evaluationMetric = Param("evaluationMetric", "Metric name", default="accuracy", dtype=str)
+    labelCol = Param("labelCol", "Label column", default="label", dtype=str)
+
+    def setModels(self, models):
+        self._paramMap["models"] = list(models)
+        return self
+
+    def _fit(self, df: DataFrame) -> BestModel:
+        metric = self.getEvaluationMetric()
+        larger = _METRIC_LARGER_BETTER[metric]
+        results = []
+        for est in self.getModels():
+            fitted = est.fit(df) if isinstance(est, Estimator) else est
+            score = _evaluate(fitted.transform(df), metric, self.getLabelCol())
+            results.append((score, fitted))
+        best_score, best = (max if larger else min)(results, key=lambda t: t[0])
+        out = BestModel(bestScore=float(best_score))
+        out._paramMap["bestModel"] = best
+        out._paramMap["allScores"] = [s for s, _ in results]
+        return out
+
+
+@register_stage
+class TuneHyperparameters(Estimator):
+    """Random/grid search with k-fold CV, candidates fit in a thread pool
+    (SURVEY.md §3.5 — the reference parallelizes over a driver thread pool;
+    XLA dispatch releases the GIL so threads overlap here too)."""
+
+    estimator = ComplexParam("estimator", "Base estimator", default=None)
+    searchSpace = ComplexParam("searchSpace", "Built hyperparam space", default=None)
+    evaluationMetric = Param("evaluationMetric", "Metric name", default="accuracy", dtype=str)
+    labelCol = Param("labelCol", "Label column", default="label", dtype=str)
+    numFolds = Param("numFolds", "CV folds", default=3, dtype=int)
+    numRuns = Param("numRuns", "Candidates to sample (random search)", default=10, dtype=int)
+    parallelism = Param("parallelism", "Concurrent candidate fits", default=4, dtype=int)
+    randomSearch = Param("randomSearch", "Random (true) vs grid (false)", default=True, dtype=bool)
+    seed = Param("seed", "Sampling seed", default=0, dtype=int)
+
+    def setEstimator(self, est):
+        self._paramMap["estimator"] = est
+        return self
+
+    def setSearchSpace(self, space):
+        self._paramMap["searchSpace"] = space
+        return self
+
+    def _fit(self, df: DataFrame) -> "TuneHyperparametersModel":
+        from mmlspark_tpu.automl.hyperparams import GridSpace, RandomSpace
+
+        est = self.getEstimator()
+        space = self.getSearchSpace()
+        metric = self.getEvaluationMetric()
+        larger = _METRIC_LARGER_BETTER[metric]
+        sampler = (
+            RandomSpace(space, seed=self.getSeed())
+            if self.getRandomSearch()
+            else GridSpace(space)
+        )
+        param_maps = list(sampler.param_maps(self.getNumRuns()))
+
+        k = self.getNumFolds()
+        rng = np.random.default_rng(self.getSeed())
+        folds = rng.integers(k, size=df.count())
+
+        def cv_score(pm: Dict[str, Any]) -> float:
+            scores = []
+            for fold in range(k):
+                train = df.filter(folds != fold)
+                valid = df.filter(folds == fold)
+                model = est.copy(pm).fit(train)
+                scores.append(_evaluate(model.transform(valid), metric, self.getLabelCol()))
+            return float(np.mean(scores))
+
+        with ThreadPoolExecutor(max_workers=self.getParallelism()) as pool:
+            scores = list(pool.map(cv_score, param_maps))
+
+        best_i = int(np.argmax(scores) if larger else np.argmin(scores))
+        best_model = est.copy(param_maps[best_i]).fit(df)
+        out = TuneHyperparametersModel(bestMetric=float(scores[best_i]))
+        out._paramMap["bestModel"] = best_model
+        out._paramMap["bestParams"] = param_maps[best_i]
+        out._paramMap["allScores"] = scores
+        return out
+
+
+@register_stage
+class TuneHyperparametersModel(Model):
+    bestModel = ComplexParam("bestModel", "Winning refit model", default=None)
+    bestParams = ComplexParam("bestParams", "Winning param map", default=None)
+    allScores = ComplexParam("allScores", "Per-candidate CV scores", default=None)
+    bestMetric = Param("bestMetric", "Winning CV metric", default=None, dtype=float)
+
+    def getBestModel(self):
+        return self.getOrDefault("bestModel")
+
+    def getBestModelInfo(self):
+        return self.getOrDefault("bestParams")
+
+    def _transform(self, df):
+        return self.getBestModel().transform(df)

@@ -1,0 +1,23 @@
+"""Featurization stages (reference: ``cms.featurize`` — SURVEY.md §2.7).
+
+Auto-featurization of mixed-type DataFrames into vector columns, missing-
+value imputation, value indexing with column-metadata level maps (the
+reference's ``CategoricalMap`` idea — SURVEY.md §2.1 "Categoricals"), type
+conversion, and the tokenize→ngram→hashingTF→IDF text pipeline.
+"""
+
+from mmlspark_tpu.featurize.clean import CleanMissingData, CleanMissingDataModel
+from mmlspark_tpu.featurize.convert import DataConversion
+from mmlspark_tpu.featurize.featurize import Featurize, FeaturizeModel
+from mmlspark_tpu.featurize.indexer import (
+    IndexToValue,
+    ValueIndexer,
+    ValueIndexerModel,
+)
+from mmlspark_tpu.featurize.text import TextFeaturizer, TextFeaturizerModel
+
+__all__ = [
+    "CleanMissingData", "CleanMissingDataModel", "DataConversion",
+    "Featurize", "FeaturizeModel", "IndexToValue", "ValueIndexer",
+    "ValueIndexerModel", "TextFeaturizer", "TextFeaturizerModel",
+]

@@ -1,0 +1,53 @@
+"""Missing-value imputation (reference:
+UPSTREAM:.../featurize/CleanMissingData.scala — SURVEY.md §2.7)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from mmlspark_tpu.core.params import ComplexParam, Param, ParamValidators, Params
+from mmlspark_tpu.core.pipeline import Estimator, Model
+from mmlspark_tpu.core.registry import register_stage
+
+
+class _CleanMissingParams(Params):
+    inputCols = Param("inputCols", "Columns to impute", default=None)
+    outputCols = Param("outputCols", "Output columns", default=None)
+    cleaningMode = Param(
+        "cleaningMode", "Mean|Median|Custom", default="Mean", dtype=str,
+        validator=ParamValidators.inList(["Mean", "Median", "Custom"]),
+    )
+    customValue = Param("customValue", "Fill value for Custom mode", default=None)
+
+
+@register_stage
+class CleanMissingData(Estimator, _CleanMissingParams):
+    def _fit(self, df):
+        mode = self.getCleaningMode()
+        fills = {}
+        for c in self.getInputCols():
+            col = np.asarray(df[c], dtype=np.float64)
+            valid = col[~np.isnan(col)]
+            if mode == "Mean":
+                fills[c] = float(valid.mean()) if valid.size else 0.0
+            elif mode == "Median":
+                fills[c] = float(np.median(valid)) if valid.size else 0.0
+            else:
+                fills[c] = float(self.getCustomValue())
+        model = CleanMissingDataModel(
+            inputCols=self.getInputCols(), outputCols=self.getOutputCols()
+        )
+        model._paramMap["fillValues"] = fills
+        return model
+
+
+@register_stage
+class CleanMissingDataModel(Model, _CleanMissingParams):
+    fillValues = ComplexParam("fillValues", "column -> fill value", default=None)
+
+    def _transform(self, df):
+        fills = self.getFillValues()
+        for in_c, out_c in zip(self.getInputCols(), self.getOutputCols()):
+            col = np.asarray(df[in_c], dtype=np.float64)
+            df = df.withColumn(out_c, np.where(np.isnan(col), fills[in_c], col))
+        return df

@@ -1,0 +1,68 @@
+"""Column type conversion (reference:
+UPSTREAM:.../featurize/DataConversion.scala — SURVEY.md §2.7)."""
+
+from __future__ import annotations
+
+import numpy as np
+import pandas as pd
+
+from mmlspark_tpu.core.frame import DataFrame
+from mmlspark_tpu.core.params import Param, ParamValidators
+from mmlspark_tpu.core.pipeline import Transformer
+from mmlspark_tpu.core.registry import register_stage
+from mmlspark_tpu.featurize.indexer import CATEGORICAL_META_KEY
+
+_CONVERSIONS = [
+    "boolean", "byte", "short", "integer", "long", "float", "double",
+    "string", "toCategorical", "clearCategorical", "date",
+]
+
+_NP = {
+    "boolean": np.bool_, "byte": np.int8, "short": np.int16,
+    "integer": np.int32, "long": np.int64, "float": np.float32,
+    "double": np.float64,
+}
+
+
+@register_stage
+class DataConversion(Transformer):
+    cols = Param("cols", "Columns to convert", default=None)
+    convertTo = Param(
+        "convertTo", "Target type", default="double", dtype=str,
+        validator=ParamValidators.inList(_CONVERSIONS),
+    )
+    dateTimeFormat = Param(
+        "dateTimeFormat", "Format for date conversion", default="yyyy-MM-dd HH:mm:ss", dtype=str
+    )
+
+    def _transform(self, df: DataFrame) -> DataFrame:
+        to = self.getConvertTo()
+        for c in self.getCols():
+            if to in _NP:
+                df = df.withColumn(c, np.asarray(df[c]).astype(_NP[to]))
+            elif to == "string":
+                df = df.withColumn(c, [str(v) for v in df[c]])
+            elif to == "toCategorical":
+                from mmlspark_tpu.featurize.indexer import ValueIndexer
+
+                model = ValueIndexer(inputCol=c, outputCol=c).fit(df)
+                df = model.transform(df)
+            elif to == "clearCategorical":
+                levels = df.metadata(c).get(CATEGORICAL_META_KEY)
+                if levels is not None:
+                    vals = [
+                        levels[int(v)] if 0 <= int(v) < len(levels) else None
+                        for v in df[c]
+                    ]
+                    df = df.withColumn(c, vals, metadata={})
+            elif to == "date":
+                # Translate the reference's Java pattern vocabulary minimally.
+                fmt = (
+                    self.getDateTimeFormat()
+                    .replace("yyyy", "%Y").replace("MM", "%m").replace("dd", "%d")
+                    .replace("HH", "%H").replace("mm", "%M").replace("ss", "%S")
+                )
+                df = df.withColumn(
+                    c, pd.to_datetime(df.column(c), format=fmt).tolist()
+                )
+        return df

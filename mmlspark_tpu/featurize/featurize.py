@@ -1,0 +1,106 @@
+"""Auto-featurization: mixed columns → one numeric vector column.
+
+Reference parity: ``Featurize`` (UPSTREAM:.../featurize/Featurize.scala —
+SURVEY.md §2.7): numerics pass through, categoricals (by metadata or low
+cardinality strings) one-hot/index, free strings hashed (hashingTF-style),
+vectors concatenated.  Fitted state is the per-column plan so transform is
+deterministic on new data.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List
+
+import numpy as np
+import pandas as pd
+
+from mmlspark_tpu.core.frame import DataFrame
+from mmlspark_tpu.core.params import ComplexParam, Param, Params
+from mmlspark_tpu.core.pipeline import Estimator, Model
+from mmlspark_tpu.core.registry import register_stage
+from mmlspark_tpu.featurize.text import hash_token
+
+
+class _FeaturizeParams(Params):
+    inputCols = Param("inputCols", "Columns to featurize (default: all but output)", default=None)
+    outputCol = Param("outputCol", "Assembled vector column", default="features", dtype=str)
+    oneHotEncodeCategoricals = Param(
+        "oneHotEncodeCategoricals", "One-hot instead of index-encode", default=True, dtype=bool
+    )
+    numFeatures = Param(
+        "numFeatures", "Hash buckets for free-text columns", default=262144, dtype=int
+    )
+    imputeMissing = Param("imputeMissing", "Mean-impute numeric NaNs", default=True, dtype=bool)
+
+
+@register_stage
+class Featurize(Estimator, _FeaturizeParams):
+    def _fit(self, df: DataFrame) -> "FeaturizeModel":
+        cols = self.getInputCols() or [
+            c for c in df.columns if c != self.getOutputCol()
+        ]
+        plan: List[Dict] = []
+        pdf = df.toPandas()
+        for c in cols:
+            col = pdf[c]
+            first = col.iloc[0] if len(col) else None
+            if isinstance(first, (list, np.ndarray)):
+                plan.append({"col": c, "kind": "vector"})
+            elif pd.api.types.is_bool_dtype(col):
+                plan.append({"col": c, "kind": "numeric", "fill": 0.0})
+            elif pd.api.types.is_numeric_dtype(col):
+                vals = col.to_numpy(dtype=np.float64)
+                if self.getImputeMissing():
+                    fill = float(np.nanmean(vals)) if np.isnan(vals).any() else 0.0
+                else:
+                    fill = float("nan")  # pass NaNs through untouched
+                plan.append({"col": c, "kind": "numeric", "fill": fill})
+            else:
+                levels = sorted(set(str(v) for v in col.dropna()))
+                if len(levels) <= 100:  # treat as categorical
+                    plan.append({
+                        "col": c,
+                        "kind": "onehot" if self.getOneHotEncodeCategoricals() else "index",
+                        "levels": levels,
+                    })
+                else:
+                    plan.append({"col": c, "kind": "hash", "n": min(self.getNumFeatures(), 1 << 18)})
+        model = FeaturizeModel(outputCol=self.getOutputCol())
+        model._paramMap["plan"] = plan
+        return model
+
+
+@register_stage
+class FeaturizeModel(Model, _FeaturizeParams):
+    plan = ComplexParam("plan", "Per-column featurization plan", default=None)
+
+    def _transform(self, df: DataFrame) -> DataFrame:
+        n = df.count()
+        parts: List[np.ndarray] = []
+        for step in self.getPlan():
+            c = step["col"]
+            if step["kind"] == "vector":
+                parts.append(np.stack([np.asarray(v, dtype=np.float64) for v in df[c]]))
+            elif step["kind"] == "numeric":
+                vals = np.asarray(df[c], dtype=np.float64)
+                parts.append(np.where(np.isnan(vals), step["fill"], vals)[:, None])
+            elif step["kind"] in ("onehot", "index"):
+                levels = step["levels"]
+                index = {v: i for i, v in enumerate(levels)}
+                idx = np.asarray([index.get(str(v), -1) for v in df[c]])
+                if step["kind"] == "index":
+                    parts.append(idx.astype(np.float64)[:, None])
+                else:
+                    oh = np.zeros((n, len(levels)))
+                    valid = idx >= 0
+                    oh[np.arange(n)[valid], idx[valid]] = 1.0
+                    parts.append(oh)
+            else:  # hash: bag-of-words token hashing
+                nb = step["n"]
+                out = np.zeros((n, nb))
+                for i, v in enumerate(df[c]):
+                    for tok in str(v).lower().split():
+                        out[i, hash_token(tok) % nb] += 1.0
+                parts.append(out)
+        vecs = np.concatenate(parts, axis=1) if parts else np.zeros((n, 0))
+        return df.withColumn(self.getOutputCol(), list(vecs))

@@ -1,0 +1,79 @@
+"""Value indexing with level maps carried in column metadata.
+
+Reference parity: ``ValueIndexer``/``IndexToValue`` +
+``CategoricalMap``-in-metadata (UPSTREAM:.../featurize/ValueIndexer.scala,
+.../core/schema/Categoricals.scala — SURVEY.md §2.1/§2.7).  The level↔index
+map travels with the column (DataFrame metadata), so ``IndexToValue`` can
+invert without refitting — the same contract the reference stores in Spark
+column metadata.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from mmlspark_tpu.core.frame import DataFrame
+from mmlspark_tpu.core.params import ComplexParam, HasInputCol, HasOutputCol, Param
+from mmlspark_tpu.core.pipeline import Estimator, Model, Transformer
+from mmlspark_tpu.core.registry import register_stage
+
+CATEGORICAL_META_KEY = "ml_attr_categorical_levels"
+
+
+@register_stage
+class ValueIndexer(Estimator, HasInputCol, HasOutputCol):
+    def _fit(self, df):
+        vals = df[self.getInputCol()]
+        levels = sorted(set(v for v in vals if not _is_nan(v)), key=_sort_key)
+        model = ValueIndexerModel(
+            inputCol=self.getInputCol(), outputCol=self.getOutputCol()
+        )
+        model._paramMap["levels"] = list(levels)
+        return model
+
+
+def _is_nan(v) -> bool:
+    return isinstance(v, float) and np.isnan(v)
+
+
+def _sort_key(v):
+    return (0, v) if isinstance(v, (int, float, np.number)) else (1, str(v))
+
+
+@register_stage
+class ValueIndexerModel(Model, HasInputCol, HasOutputCol):
+    levels = ComplexParam("levels", "Ordered distinct levels", default=None)
+
+    def getLevels(self):
+        return self.getOrDefault("levels")
+
+    def _transform(self, df):
+        levels = self.getLevels()
+        index = {v: i for i, v in enumerate(levels)}
+        missing_idx = len(levels)  # unseen/NaN → one-past-last (reference
+        # maps unknowns to the missing level)
+        vals = np.asarray(
+            [index.get(v, missing_idx) for v in df[self.getInputCol()]],
+            dtype=np.float64,
+        )
+        return df.withColumn(
+            self.getOutputCol(), vals, metadata={CATEGORICAL_META_KEY: list(levels)}
+        )
+
+
+@register_stage
+class IndexToValue(Transformer, HasInputCol, HasOutputCol):
+    """Invert a ValueIndexerModel output using the column's metadata levels."""
+
+    def _transform(self, df):
+        levels = df.metadata(self.getInputCol()).get(CATEGORICAL_META_KEY)
+        if levels is None:
+            raise ValueError(
+                f"column {self.getInputCol()!r} has no categorical level "
+                f"metadata; was it produced by ValueIndexerModel?"
+            )
+        out = []
+        for v in df[self.getInputCol()]:
+            i = int(v)
+            out.append(levels[i] if 0 <= i < len(levels) else None)
+        return df.withColumn(self.getOutputCol(), out)

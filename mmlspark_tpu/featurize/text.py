@@ -1,0 +1,147 @@
+"""Text featurization: tokenize → n-grams → hashingTF → IDF.
+
+Reference parity: ``TextFeaturizer`` (UPSTREAM:.../featurize/text/
+TextFeaturizer.scala — SURVEY.md §2.7), which composes Spark's Tokenizer/
+NGram/HashingTF/IDF into one estimator.  Hashing uses MurmurHash3-32 (the
+same family Spark's HashingTF uses) so bucket assignment is stable across
+runs and hosts.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import List
+
+import numpy as np
+
+from mmlspark_tpu.core.frame import DataFrame
+from mmlspark_tpu.core.params import ComplexParam, Param, Params
+from mmlspark_tpu.core.pipeline import Estimator, Model
+from mmlspark_tpu.core.registry import register_stage
+
+
+def murmurhash3_32(data: bytes, seed: int = 42) -> int:
+    """MurmurHash3 x86 32-bit (public algorithm; also what Spark/VW use)."""
+    c1, c2 = 0xCC9E2D51, 0x1B873593
+    h = seed & 0xFFFFFFFF
+    length = len(data)
+    rounded = length & ~0x3
+    for i in range(0, rounded, 4):
+        k = int.from_bytes(data[i : i + 4], "little")
+        k = (k * c1) & 0xFFFFFFFF
+        k = ((k << 15) | (k >> 17)) & 0xFFFFFFFF
+        k = (k * c2) & 0xFFFFFFFF
+        h ^= k
+        h = ((h << 13) | (h >> 19)) & 0xFFFFFFFF
+        h = (h * 5 + 0xE6546B64) & 0xFFFFFFFF
+    k = 0
+    tail = data[rounded:]
+    if len(tail) >= 3:
+        k ^= tail[2] << 16
+    if len(tail) >= 2:
+        k ^= tail[1] << 8
+    if len(tail) >= 1:
+        k ^= tail[0]
+        k = (k * c1) & 0xFFFFFFFF
+        k = ((k << 15) | (k >> 17)) & 0xFFFFFFFF
+        k = (k * c2) & 0xFFFFFFFF
+        h ^= k
+    h ^= length
+    h ^= h >> 16
+    h = (h * 0x85EBCA6B) & 0xFFFFFFFF
+    h ^= h >> 13
+    h = (h * 0xC2B2AE35) & 0xFFFFFFFF
+    h ^= h >> 16
+    return h
+
+
+def hash_token(tok: str, seed: int = 42) -> int:
+    return murmurhash3_32(tok.encode("utf-8"), seed)
+
+
+class _TextParams(Params):
+    inputCol = Param("inputCol", "Text column", dtype=str)
+    outputCol = Param("outputCol", "Output vector column", default="features", dtype=str)
+    useTokenizer = Param("useTokenizer", "Regex-tokenize the text", default=True, dtype=bool)
+    tokenizerPattern = Param("tokenizerPattern", "Token split regex", default=r"\s+", dtype=str)
+    toLowercase = Param("toLowercase", "Lowercase before tokenizing", default=True, dtype=bool)
+    useStopWordsRemover = Param("useStopWordsRemover", "Drop stop words", default=False, dtype=bool)
+    stopWords = Param("stopWords", "Stop word list", default=None)
+    useNGram = Param("useNGram", "Add n-grams", default=False, dtype=bool)
+    nGramLength = Param("nGramLength", "n-gram length", default=2, dtype=int)
+    numFeatures = Param("numFeatures", "Hash buckets", default=1 << 18, dtype=int)
+    binary = Param("binary", "Binary term counts", default=False, dtype=bool)
+    useIDF = Param("useIDF", "Rescale with inverse document frequency", default=True, dtype=bool)
+    minDocFreq = Param("minDocFreq", "Min docs for a term to count", default=1, dtype=int)
+
+
+_DEFAULT_STOPWORDS = {
+    "a", "an", "and", "are", "as", "at", "be", "by", "for", "from", "has",
+    "he", "in", "is", "it", "its", "of", "on", "that", "the", "to", "was",
+    "were", "will", "with",
+}
+
+
+def _tokenize(p: _TextParams, text: str) -> List[str]:
+    s = str(text)
+    if p.getToLowercase():
+        s = s.lower()
+    toks = re.split(p.getTokenizerPattern(), s) if p.getUseTokenizer() else [s]
+    toks = [t for t in toks if t]
+    if p.getUseStopWordsRemover():
+        stop = set(p.getStopWords() or _DEFAULT_STOPWORDS)
+        toks = [t for t in toks if t not in stop]
+    if p.getUseNGram():
+        n = p.getNGramLength()
+        toks = toks + [" ".join(toks[i : i + n]) for i in range(len(toks) - n + 1)]
+    return toks
+
+
+def _tf_vector(p: _TextParams, toks: List[str]) -> np.ndarray:
+    nb = p.getNumFeatures()
+    v = np.zeros(nb)
+    for t in toks:
+        v[hash_token(t) % nb] += 1.0
+    if p.getBinary():
+        v = (v > 0).astype(np.float64)
+    return v
+
+
+@register_stage
+class TextFeaturizer(Estimator, _TextParams):
+    def _fit(self, df: DataFrame) -> "TextFeaturizerModel":
+        model = TextFeaturizerModel()
+        self._copyValues(model)
+        if self.getUseIDF():
+            docs = [_tokenize(self, t) for t in df[self.getInputCol()]]
+            nb = self.getNumFeatures()
+            dfreq = np.zeros(nb)
+            for toks in docs:
+                idx = {hash_token(t) % nb for t in toks}
+                for i in idx:
+                    dfreq[i] += 1.0
+            n_docs = max(len(docs), 1)
+            # Spark's IDF: log((m+1)/(df+1)), and terms below minDocFreq are
+            # weighted 0 (dropped), not boosted.
+            idf = np.where(
+                dfreq >= self.getMinDocFreq(),
+                np.log((n_docs + 1.0) / (dfreq + 1.0)),
+                0.0,
+            )
+            model._paramMap["idfVector"] = idf
+        return model
+
+
+@register_stage
+class TextFeaturizerModel(Model, _TextParams):
+    idfVector = ComplexParam("idfVector", "Fitted IDF weights", default=None)
+
+    def _transform(self, df: DataFrame) -> DataFrame:
+        idf = self.getIdfVector() if self.getUseIDF() else None
+        out = []
+        for text in df[self.getInputCol()]:
+            v = _tf_vector(self, _tokenize(self, text))
+            if idf is not None:
+                v = v * idf
+            out.append(v)
+        return df.withColumn(self.getOutputCol(), out)

@@ -1,0 +1,80 @@
+"""ImageFeaturizer: pretrained-CNN transfer-learning featurization.
+
+Reference parity (SURVEY.md §2.4): ``ImageFeaturizer``
+(UPSTREAM:.../image/ImageFeaturizer.scala) composes ImageTransformer
+(resize/crop) → UnrollImage → CNTKModel with ``cutOutputLayers(n)`` heads
+removed, so a DataFrame of images becomes a DataFrame of CNN features.
+
+Here the backbone is an ONNX graph (the N3 interchange route) executed by
+the XLA-lowered :class:`~mmlspark_tpu.models.onnx_model._OnnxInferenceBase`
+machinery; ``cutOutputLayers`` selects which graph output feeds the feature
+column (ONNX graphs expose intermediate heads as extra outputs after
+conversion, so "cutting" = fetching an earlier output)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from mmlspark_tpu.core.frame import DataFrame
+from mmlspark_tpu.core.params import Param
+from mmlspark_tpu.core.registry import register_stage
+from mmlspark_tpu.models.onnx_model import _OnnxInferenceBase
+from mmlspark_tpu.ops.image_ops import ImageTransformer, UnrollImage, decode_image
+
+
+@register_stage
+class ImageFeaturizer(_OnnxInferenceBase):
+    inputCol = Param("inputCol", "Image column", default="image", dtype=str)
+    outputCol = Param("outputCol", "Feature vector column", default="features", dtype=str)
+    imageHeight = Param("imageHeight", "Model input height", default=224, dtype=int)
+    imageWidth = Param("imageWidth", "Model input width", default=224, dtype=int)
+    cutOutputLayers = Param(
+        "cutOutputLayers",
+        "How many output heads to cut: 0 = final output, k = k-th output "
+        "from the end (featurization taps an earlier head)",
+        default=1, dtype=int,
+    )
+    centerCropAfterResize = Param(
+        "centerCropAfterResize", "Center-crop to the target size", default=False, dtype=bool
+    )
+    channelNormalizationMeans = Param(
+        "channelNormalizationMeans", "Per-channel means", default=None
+    )
+    channelNormalizationStds = Param(
+        "channelNormalizationStds", "Per-channel stds", default=None
+    )
+    colorScaleFactor = Param("colorScaleFactor", "Pixel pre-scale", default=1.0, dtype=float)
+
+    def setImageHeight(self, v):
+        return self.set("imageHeight", v)
+
+    def setImageWidth(self, v):
+        return self.set("imageWidth", v)
+
+    def _transform(self, df: DataFrame) -> DataFrame:
+        graph = self._graph()
+        h, w = self.getImageHeight(), self.getImageWidth()
+        t = ImageTransformer(inputCol=self.getInputCol(), outputCol="__prep")
+        if self.getCenterCropAfterResize():
+            t = t.resize(int(h * 1.15), int(w * 1.15)).centerCrop(h, w)
+        else:
+            t = t.resize(h, w)
+        means = self.getChannelNormalizationMeans()
+        stds = self.getChannelNormalizationStds()
+        scale = self.getColorScaleFactor()
+        if means is not None or stds is not None or scale != 1.0:
+            n_ch = 3
+            t = t.normalize(means or [0.0] * n_ch, stds or [1.0] * n_ch, scale)
+        prepped = t.transform(df)
+        unrolled = UnrollImage(inputCol="__prep", outputCol="__unrolled").transform(prepped)
+
+        in_name = graph.input_names[0]
+        # cut k heads → use the k-th output from the end (k=0 ≡ k=1: last)
+        out_name = graph.output_names[-max(self.getCutOutputLayers(), 1)]
+        if df.count() == 0:
+            return df.withColumn(self.getOutputCol(), [])
+        feeds = {in_name: self._shape_input(unrolled["__unrolled"], in_name)}
+        outs = self._run_batched(feeds)
+        feats = outs[out_name]
+        feats = feats.reshape(feats.shape[0], -1).astype(np.float64)
+        return df.withColumn(self.getOutputCol(), list(feats))

@@ -74,6 +74,12 @@ class _OnnxInferenceBase(Model):
     def _run_batched(self, feeds: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:
         """Fixed-size minibatch loop with tail padding (one compiled shape)."""
         graph = self._graph()
+        unfed = sorted(set(graph.input_names) - set(feeds))
+        if unfed:
+            raise ValueError(
+                f"graph inputs {unfed} have no feed; graph inputs are "
+                f"{graph.input_names}"
+            )
         n = next(iter(feeds.values())).shape[0]
         bs = min(self.getMiniBatchSize(), n)
         outs: Dict[str, list] = {name: [] for name in graph.output_names}

@@ -1,0 +1,258 @@
+"""Image pipeline stages: decode/resize/crop/color/blur/threshold/flip +
+CHW unrolling + flip augmentation.
+
+Reference parity (SURVEY.md §2.4): ``ImageTransformer`` (OpenCV JNI ops —
+UPSTREAM:.../opencv/ImageTransformer.scala), ``UnrollImage`` /
+``UnrollBinaryImage`` / ``ImageSetAugmenter`` (UPSTREAM:.../image/).  The
+reference shells into native OpenCV per row (native component N6); here the
+ops are host-side numpy/PIL (decode/resize stay on host — SURVEY.md §2.9 N6
+"host-side image ops feeding device"), and the unrolled output feeds the
+jitted inference graphs.
+
+Image rows follow the Spark image-schema struct shape: a dict with
+``origin/height/width/nChannels/mode/data`` where ``data`` is an HWC uint8
+(or float) array — so pipelines translate 1:1.
+"""
+
+from __future__ import annotations
+
+import io
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from mmlspark_tpu.core.frame import DataFrame
+from mmlspark_tpu.core.params import ComplexParam, HasInputCol, HasOutputCol, Param
+from mmlspark_tpu.core.pipeline import Transformer
+from mmlspark_tpu.core.registry import register_stage
+
+
+def make_image_row(data: np.ndarray, origin: str = "") -> Dict[str, Any]:
+    """Build a Spark-image-schema-shaped struct from an HWC array."""
+    data = np.asarray(data)
+    if data.ndim == 2:
+        data = data[:, :, None]
+    return {
+        "origin": origin,
+        "height": int(data.shape[0]),
+        "width": int(data.shape[1]),
+        "nChannels": int(data.shape[2]),
+        "mode": 16 if data.shape[2] == 3 else 0,  # CV_8UC3 / CV_8UC1
+        "data": data,
+    }
+
+
+def decode_image(payload) -> Dict[str, Any]:
+    """bytes/array/struct → image struct (decode via PIL when bytes)."""
+    if isinstance(payload, dict):
+        return payload
+    if isinstance(payload, (bytes, bytearray)):
+        from PIL import Image
+
+        img = Image.open(io.BytesIO(payload))
+        return make_image_row(np.asarray(img.convert("RGB"))[:, :, ::-1])  # BGR like OpenCV
+    return make_image_row(np.asarray(payload))
+
+
+def _resize(img: np.ndarray, height: int, width: int) -> np.ndarray:
+    from PIL import Image
+
+    squeeze = img.shape[2] == 1
+    arr = img[:, :, 0] if squeeze else img
+    pil = Image.fromarray(arr.astype(np.uint8))
+    out = np.asarray(pil.resize((width, height), Image.BILINEAR))
+    return out[:, :, None] if squeeze else out
+
+
+def _center_crop(img: np.ndarray, height: int, width: int) -> np.ndarray:
+    h, w = img.shape[:2]
+    top = max((h - height) // 2, 0)
+    left = max((w - width) // 2, 0)
+    return img[top : top + height, left : left + width]
+
+
+def _gaussian_kernel(size: int, sigma: float) -> np.ndarray:
+    ax = np.arange(size) - (size - 1) / 2.0
+    k = np.exp(-(ax**2) / (2 * sigma**2))
+    k2 = np.outer(k, k)
+    return k2 / k2.sum()
+
+
+def _convolve2d(img: np.ndarray, kernel: np.ndarray) -> np.ndarray:
+    from scipy.signal import convolve2d
+
+    out = np.stack(
+        [
+            convolve2d(img[:, :, c].astype(np.float64), kernel, mode="same", boundary="symm")
+            for c in range(img.shape[2])
+        ],
+        axis=2,
+    )
+    return out
+
+
+_FLIP_CODES = {1: 1, 0: 0, -1: -1}
+
+
+@register_stage
+class ImageTransformer(Transformer, HasInputCol, HasOutputCol):
+    """Chained per-row image ops (reference op vocabulary:
+    resize/centerCrop/cropImage/colorFormat/blur/threshold/gaussianKernel/
+    flip — SURVEY.md §2.4)."""
+
+    inputCol = Param("inputCol", "Image struct column", default="image", dtype=str)
+    outputCol = Param("outputCol", "Output image column", default="out_image", dtype=str)
+    stages = ComplexParam("stages", "Ordered op list", default=None)
+
+    def _op_list(self) -> List[Dict[str, Any]]:
+        return list(self.getStages() or [])
+
+    def _add(self, op: Dict[str, Any]) -> "ImageTransformer":
+        self._paramMap["stages"] = self._op_list() + [op]
+        return self
+
+    # -- fluent op builders (mirror the Scala/PySpark surface) ------------
+    def resize(self, height: int, width: int) -> "ImageTransformer":
+        return self._add({"op": "resize", "height": height, "width": width})
+
+    def centerCrop(self, height: int, width: int) -> "ImageTransformer":
+        return self._add({"op": "centerCrop", "height": height, "width": width})
+
+    def crop(self, x: int, y: int, height: int, width: int) -> "ImageTransformer":
+        return self._add({"op": "crop", "x": x, "y": y, "height": height, "width": width})
+
+    def colorFormat(self, format: str) -> "ImageTransformer":
+        return self._add({"op": "colorFormat", "format": format})
+
+    def blur(self, height: float, width: float) -> "ImageTransformer":
+        return self._add({"op": "blur", "height": int(height), "width": int(width)})
+
+    def threshold(self, threshold: float, maxVal: float = 255.0) -> "ImageTransformer":
+        return self._add({"op": "threshold", "threshold": threshold, "maxVal": maxVal})
+
+    def gaussianKernel(self, apertureSize: int, sigma: float) -> "ImageTransformer":
+        return self._add({"op": "gaussianKernel", "apertureSize": apertureSize, "sigma": sigma})
+
+    def flip(self, flipCode: int = 1) -> "ImageTransformer":
+        return self._add({"op": "flip", "flipCode": flipCode})
+
+    def normalize(self, mean, std, color_scale_factor: float = 1.0) -> "ImageTransformer":
+        return self._add({
+            "op": "normalize", "mean": list(mean), "std": list(std),
+            "scale": color_scale_factor,
+        })
+
+    # -- execution --------------------------------------------------------
+    def _apply(self, img: np.ndarray, op: Dict[str, Any]) -> np.ndarray:
+        kind = op["op"]
+        if kind == "resize":
+            return _resize(img, op["height"], op["width"])
+        if kind == "centerCrop":
+            return _center_crop(img, op["height"], op["width"])
+        if kind == "crop":
+            return img[op["y"] : op["y"] + op["height"], op["x"] : op["x"] + op["width"]]
+        if kind == "colorFormat":
+            fmt = op["format"].lower()
+            if fmt in ("gray", "grayscale"):
+                # OpenCV BGR2GRAY weights
+                g = img[..., 0] * 0.114 + img[..., 1] * 0.587 + img[..., 2] * 0.299
+                return g[:, :, None]
+            if fmt in ("bgr2rgb", "rgb2bgr", "rgb", "bgr"):
+                return img[:, :, ::-1]
+            raise ValueError(f"unknown colorFormat {op['format']!r}")
+        if kind == "blur":
+            k = np.ones((op["height"], op["width"]))
+            return _convolve2d(img, k / k.sum())
+        if kind == "threshold":
+            return np.where(img > op["threshold"], op["maxVal"], 0.0)
+        if kind == "gaussianKernel":
+            return _convolve2d(img, _gaussian_kernel(op["apertureSize"], op["sigma"]))
+        if kind == "flip":
+            code = op.get("flipCode", 1)
+            if code == 1:  # horizontal (around y axis)
+                return img[:, ::-1]
+            if code == 0:  # vertical
+                return img[::-1]
+            return img[::-1, ::-1]
+        if kind == "normalize":
+            arr = img.astype(np.float64) * op["scale"]
+            mean = np.asarray(op["mean"]).reshape(1, 1, -1)
+            std = np.asarray(op["std"]).reshape(1, 1, -1)
+            return (arr - mean) / std
+        raise ValueError(f"unknown image op {kind!r}")
+
+    def _transform(self, df: DataFrame) -> DataFrame:
+        ops = self._op_list()
+        out = []
+        for payload in df[self.getInputCol()]:
+            struct = decode_image(payload)
+            img = np.asarray(struct["data"])
+            if img.ndim == 2:
+                img = img[:, :, None]
+            for op in ops:
+                img = self._apply(img, op)
+            out.append(make_image_row(img, origin=struct.get("origin", "")))
+        return df.withColumn(self.getOutputCol(), out)
+
+
+@register_stage
+class UnrollImage(Transformer, HasInputCol, HasOutputCol):
+    """Image struct → flat CHW float vector (reference:
+    UPSTREAM:.../image/UnrollImage.scala — SURVEY.md §2.4)."""
+
+    inputCol = Param("inputCol", "Image struct column", default="image", dtype=str)
+    outputCol = Param("outputCol", "Unrolled vector column", default="unrolled", dtype=str)
+
+    def _transform(self, df: DataFrame) -> DataFrame:
+        out = []
+        for struct in df[self.getInputCol()]:
+            img = np.asarray(decode_image(struct)["data"], dtype=np.float64)
+            if img.ndim == 2:
+                img = img[:, :, None]
+            out.append(img.transpose(2, 0, 1).reshape(-1))  # HWC → CHW, flat
+        return df.withColumn(self.getOutputCol(), out)
+
+
+@register_stage
+class UnrollBinaryImage(Transformer, HasInputCol, HasOutputCol):
+    """Encoded image bytes → decoded + unrolled vector in one step."""
+
+    inputCol = Param("inputCol", "Binary image column", default="image", dtype=str)
+    outputCol = Param("outputCol", "Unrolled vector column", default="unrolled", dtype=str)
+
+    def _transform(self, df: DataFrame) -> DataFrame:
+        inner = UnrollImage(inputCol=self.getInputCol(), outputCol=self.getOutputCol())
+        return inner.transform(df)
+
+
+@register_stage
+class ImageSetAugmenter(Transformer, HasInputCol, HasOutputCol):
+    """Dataset augmentation by flips (reference:
+    UPSTREAM:.../image/ImageSetAugmenter.scala): emits the original rows
+    plus flipped copies."""
+
+    inputCol = Param("inputCol", "Image column", default="image", dtype=str)
+    outputCol = Param("outputCol", "Output image column", default="image", dtype=str)
+    flipLeftRight = Param("flipLeftRight", "Add horizontal flips", default=True, dtype=bool)
+    flipUpDown = Param("flipUpDown", "Add vertical flips", default=False, dtype=bool)
+
+    def _transform(self, df: DataFrame) -> DataFrame:
+        base = df.withColumn(self.getOutputCol(), list(df[self.getInputCol()]))
+        frames = [base]
+        flips = []
+        if self.getFlipLeftRight():
+            flips.append(1)
+        if self.getFlipUpDown():
+            flips.append(0)
+        for code in flips:
+            flipped = []
+            for payload in df[self.getInputCol()]:
+                struct = decode_image(payload)
+                img = np.asarray(struct["data"])
+                img = img[:, ::-1] if code == 1 else img[::-1]
+                flipped.append(make_image_row(img, origin=struct.get("origin", "")))
+            frames.append(base.withColumn(self.getOutputCol(), flipped))
+        out = frames[0]
+        for f in frames[1:]:
+            out = out.union(f)
+        return out

@@ -1,0 +1,43 @@
+"""Pipeline utility stages (reference: ``cms.stages`` — SURVEY.md §2.7).
+
+Column ops, caching/repartition controls, timing, lambda/UDF transforms,
+class balancing, stratified repartition, data summarization, text
+preprocessing, and the minibatching family.  All host-side DataFrame
+manipulation — the reference's versions are likewise pure JVM.
+"""
+
+from mmlspark_tpu.stages.basic import (
+    Cacher,
+    ClassBalancer,
+    ClassBalancerModel,
+    DropColumns,
+    EnsembleByKey,
+    Explode,
+    Lambda,
+    MultiColumnAdapter,
+    PartitionConsolidator,
+    RenameColumn,
+    Repartition,
+    SelectColumns,
+    StratifiedRepartition,
+    SummarizeData,
+    TextPreprocessor,
+    Timer,
+    UDFTransformer,
+)
+from mmlspark_tpu.stages.minibatch import (
+    DynamicMiniBatchTransformer,
+    FixedMiniBatchTransformer,
+    FlattenBatch,
+    TimeIntervalMiniBatchTransformer,
+)
+
+__all__ = [
+    "Cacher", "ClassBalancer", "ClassBalancerModel", "DropColumns",
+    "EnsembleByKey", "Explode", "Lambda", "MultiColumnAdapter",
+    "PartitionConsolidator", "RenameColumn", "Repartition", "SelectColumns",
+    "StratifiedRepartition", "SummarizeData", "TextPreprocessor", "Timer",
+    "UDFTransformer", "DynamicMiniBatchTransformer",
+    "FixedMiniBatchTransformer", "FlattenBatch",
+    "TimeIntervalMiniBatchTransformer",
+]

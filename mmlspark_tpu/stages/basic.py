@@ -1,0 +1,377 @@
+"""Basic pipeline stages (reference: UPSTREAM:.../stages/*.scala, one class
+per stage — SURVEY.md §2.7 "Pipeline stages"; [REF-EMPTY] provenance)."""
+
+from __future__ import annotations
+
+import time as _time
+from typing import Any, Callable, List, Optional
+
+import numpy as np
+import pandas as pd
+
+from mmlspark_tpu.core.frame import DataFrame
+from mmlspark_tpu.core.params import ComplexParam, Param, Params
+from mmlspark_tpu.core.pipeline import Estimator, Model, Transformer
+from mmlspark_tpu.core.registry import register_stage
+
+
+@register_stage
+class DropColumns(Transformer):
+    cols = Param("cols", "Columns to drop", default=None)
+
+    def _transform(self, df):
+        return df.drop(*(self.getCols() or []))
+
+
+@register_stage
+class SelectColumns(Transformer):
+    cols = Param("cols", "Columns to keep", default=None)
+
+    def _transform(self, df):
+        return df.select(*(self.getCols() or df.columns))
+
+
+@register_stage
+class RenameColumn(Transformer):
+    inputCol = Param("inputCol", "Existing column name", dtype=str)
+    outputCol = Param("outputCol", "New column name", dtype=str)
+
+    def _transform(self, df):
+        return df.withColumnRenamed(self.getInputCol(), self.getOutputCol())
+
+
+@register_stage
+class Repartition(Transformer):
+    """Set the partition count (load-bearing: partitions drive numWorkers in
+    the training path — SURVEY.md §3.1)."""
+
+    n = Param("n", "Target number of partitions", dtype=int)
+    disable = Param("disable", "Pass-through when true", default=False, dtype=bool)
+
+    def _transform(self, df):
+        return df if self.getDisable() else df.repartition(self.getN())
+
+
+@register_stage
+class Cacher(Transformer):
+    disable = Param("disable", "Pass-through when true", default=False, dtype=bool)
+
+    def _transform(self, df):
+        return df if self.getDisable() else df.cache()
+
+
+@register_stage
+class Timer(Transformer):
+    """Wrap a stage and record wall-clock of its fit/transform.
+
+    The reference logs per-stage timings (UPSTREAM:.../stages/Timer.scala);
+    here timings are also kept on the instance and optionally traced via
+    ``jax.profiler`` ranges so device work shows up in Perfetto dumps
+    (SURVEY.md §5.1 — the "exceed the reference" hook).
+    """
+
+    stage = ComplexParam("stage", "The wrapped stage", default=None)
+    logToScala = Param("logToScala", "Print timing lines", default=True, dtype=bool)
+    disableMaterialization = Param(
+        "disableMaterialization", "Skip forcing evaluation", default=True, dtype=bool
+    )
+
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+        self.lastTimings: List[float] = []
+
+    def fitTimed(self, df):
+        import jax.profiler
+
+        stage = self.getStage()
+        with jax.profiler.TraceAnnotation(f"Timer.fit({type(stage).__name__})"):
+            t0 = _time.perf_counter()
+            model = stage.fit(df)
+            dt = _time.perf_counter() - t0
+        self.lastTimings.append(dt)
+        if self.getLogToScala():
+            print(f"Timer: fit({type(stage).__name__}) took {dt:.3f}s")
+        return Timer(logToScala=self.getLogToScala()).setStage(model)
+
+    def setStage(self, stage):
+        self._paramMap["stage"] = stage
+        return self
+
+    def _transform(self, df):
+        import jax.profiler
+
+        stage = self.getStage()
+        with jax.profiler.TraceAnnotation(f"Timer.transform({type(stage).__name__})"):
+            t0 = _time.perf_counter()
+            out = stage.transform(df)
+            dt = _time.perf_counter() - t0
+        self.lastTimings.append(dt)
+        if self.getLogToScala():
+            print(f"Timer: transform({type(stage).__name__}) took {dt:.3f}s")
+        return out
+
+
+@register_stage
+class Lambda(Transformer):
+    """Arbitrary df→df function stage (UPSTREAM:.../stages/Lambda.scala)."""
+
+    transformFunc = ComplexParam("transformFunc", "df -> df callable", default=None)
+
+    def setTransform(self, fn):
+        self._paramMap["transformFunc"] = fn
+        return self
+
+    def _transform(self, df):
+        fn = self.getTransformFunc()
+        out = fn(df)
+        return out if isinstance(out, DataFrame) else DataFrame(out)
+
+
+@register_stage
+class UDFTransformer(Transformer):
+    inputCol = Param("inputCol", "Input column", dtype=str)
+    inputCols = Param("inputCols", "Input columns (multi-arg UDF)", default=None)
+    outputCol = Param("outputCol", "Output column", dtype=str)
+    udf = ComplexParam("udf", "The per-value function", default=None)
+
+    def setUDF(self, fn):
+        self._paramMap["udf"] = fn
+        return self
+
+    def _transform(self, df):
+        fn = self.getUdf()
+        if self.getInputCols():
+            cols = [df[c] for c in self.getInputCols()]
+            vals = [fn(*args) for args in zip(*cols)]
+        else:
+            vals = [fn(v) for v in df[self.getInputCol()]]
+        return df.withColumn(self.getOutputCol(), vals)
+
+
+@register_stage
+class MultiColumnAdapter(Transformer):
+    """Apply a single-column stage to many columns
+    (UPSTREAM:.../stages/MultiColumnAdapter.scala)."""
+
+    baseStage = ComplexParam("baseStage", "Stage with inputCol/outputCol", default=None)
+    inputCols = Param("inputCols", "Input columns", default=None)
+    outputCols = Param("outputCols", "Output columns", default=None)
+
+    def setBaseStage(self, stage):
+        self._paramMap["baseStage"] = stage
+        return self
+
+    def _transform(self, df):
+        base = self.getBaseStage()
+        for in_c, out_c in zip(self.getInputCols(), self.getOutputCols()):
+            stage = base.copy()
+            stage.setParams(inputCol=in_c, outputCol=out_c)
+            df = stage.transform(df)
+        return df
+
+
+@register_stage
+class Explode(Transformer):
+    inputCol = Param("inputCol", "Column of sequences", dtype=str)
+    outputCol = Param("outputCol", "Exploded column", dtype=str)
+
+    def _transform(self, df):
+        pdf = df.toPandas()
+        out = pdf.explode(self.getInputCol(), ignore_index=True)
+        if self.getOutputCol() != self.getInputCol():
+            out = out.rename(columns={self.getInputCol(): self.getOutputCol()})
+        return DataFrame(out, num_partitions=df.num_partitions)
+
+
+@register_stage
+class EnsembleByKey(Transformer):
+    """Average/collect vector or scalar columns grouped by key columns
+    (UPSTREAM:.../stages/EnsembleByKey.scala)."""
+
+    keys = Param("keys", "Grouping key columns", default=None)
+    cols = Param("cols", "Columns to ensemble", default=None)
+    strategy = Param("strategy", "mean (only supported strategy)", default="mean", dtype=str)
+    collapseGroup = Param("collapseGroup", "One row per key", default=True, dtype=bool)
+    vectorDims = Param("vectorDims", "unused (API parity)", default=None)
+
+    def _transform(self, df):
+        keys, cols = list(self.getKeys()), list(self.getCols())
+        pdf = df.toPandas()
+
+        def agg_col(series):
+            vals = list(series)
+            if isinstance(vals[0], (list, np.ndarray)):
+                return np.mean(np.stack([np.asarray(v) for v in vals]), axis=0)
+            return float(np.mean(vals))
+
+        grouped = pdf.groupby(keys, sort=False)
+        out_rows = []
+        for key_vals, grp in grouped:
+            if not isinstance(key_vals, tuple):
+                key_vals = (key_vals,)
+            row = dict(zip(keys, key_vals))
+            for c in cols:
+                row[f"mean({c})"] = agg_col(grp[c])
+            out_rows.append(row)
+        out = pd.DataFrame(out_rows)
+        if not self.getCollapseGroup():
+            # Append the ensembled columns to the ORIGINAL rows (all columns
+            # survive), one value per row of its key group.
+            out = pdf.merge(out, on=keys, how="left")
+        return DataFrame(out, num_partitions=df.num_partitions)
+
+
+@register_stage
+class ClassBalancer(Estimator):
+    """Compute inverse-frequency weights per label value
+    (UPSTREAM:.../stages/ClassBalancer.scala): weight = max_count/count."""
+
+    inputCol = Param("inputCol", "Label column", default="label", dtype=str)
+    outputCol = Param("outputCol", "Weight column", default="weight", dtype=str)
+    broadcastJoin = Param("broadcastJoin", "unused (API parity)", default=False, dtype=bool)
+
+    def _fit(self, df):
+        vals, counts = np.unique(np.asarray(df[self.getInputCol()]), return_counts=True)
+        weights = counts.max() / counts
+        model = ClassBalancerModel(
+            inputCol=self.getInputCol(), outputCol=self.getOutputCol()
+        )
+        model._paramMap["weights"] = {v: float(w) for v, w in zip(vals, weights)}
+        return model
+
+
+@register_stage
+class ClassBalancerModel(Model):
+    inputCol = Param("inputCol", "Label column", default="label", dtype=str)
+    outputCol = Param("outputCol", "Weight column", default="weight", dtype=str)
+    weights = ComplexParam("weights", "level -> weight map", default=None)
+
+    def _transform(self, df):
+        w = self.getWeights()
+        vals = [w.get(v, 1.0) for v in df[self.getInputCol()]]
+        return df.withColumn(self.getOutputCol(), np.asarray(vals))
+
+
+@register_stage
+class StratifiedRepartition(Transformer):
+    """Redistribute rows so each partition sees every label value
+    (UPSTREAM:.../stages/StratifiedRepartition.scala).  Rows are sorted
+    round-robin per stratum across partition slots; with mode='equal' each
+    label gets equal representation via resampling."""
+
+    labelCol = Param("labelCol", "Label column", default="label", dtype=str)
+    mode = Param(
+        "mode", "native|equal|mixed", default="native", dtype=str,
+    )
+    seed = Param("seed", "Random seed", default=0, dtype=int)
+
+    def _transform(self, df):
+        rng = np.random.default_rng(self.getSeed())
+        pdf = df.toPandas()
+        labels = pdf[self.getLabelCol()].to_numpy()
+        n_part = df.num_partitions
+        if self.getMode() == "equal":
+            vals, counts = np.unique(labels, return_counts=True)
+            target = int(counts.max())
+            idx: List[int] = []
+            for v in vals:
+                rows = np.flatnonzero(labels == v)
+                idx.extend(rng.choice(rows, target, replace=len(rows) < target))
+            pdf = pdf.iloc[idx].reset_index(drop=True)
+            labels = pdf[self.getLabelCol()].to_numpy()
+        # Round-robin each stratum over partition slots, then order by slot:
+        # every partition slice ends up with every label present.
+        slot = np.zeros(len(pdf), np.int64)
+        for v in np.unique(labels):
+            rows = np.flatnonzero(labels == v)
+            slot[rows] = np.arange(len(rows)) % n_part
+        order = np.argsort(slot, kind="stable")
+        return DataFrame(
+            pdf.iloc[order].reset_index(drop=True), num_partitions=n_part
+        )
+
+
+@register_stage
+class SummarizeData(Transformer):
+    """Data profiling: counts/quantiles/basic stats per column
+    (UPSTREAM:.../stages/SummarizeData.scala)."""
+
+    basic = Param("basic", "Include basic stats", default=True, dtype=bool)
+    counts = Param("counts", "Include count stats", default=True, dtype=bool)
+    percentiles = Param("percentiles", "Include percentiles", default=True, dtype=bool)
+    errorThreshold = Param("errorThreshold", "Quantile error (unused: exact)", default=0.0, dtype=float)
+
+    def _transform(self, df):
+        rows = []
+        pdf = df.toPandas()
+        for c in pdf.columns:
+            col = pdf[c]
+            row: dict = {"Feature": c}
+            if self.getCounts():
+                row["Count"] = float(len(col))
+                row["Unique Value Count"] = float(col.nunique())
+                row["Missing Value Count"] = float(col.isna().sum())
+            if pd.api.types.is_numeric_dtype(col):
+                numeric = col.dropna().astype(float)
+                if self.getBasic():
+                    row.update({
+                        "Mean": float(numeric.mean()) if len(numeric) else np.nan,
+                        "Std": float(numeric.std(ddof=1)) if len(numeric) > 1 else np.nan,
+                        "Min": float(numeric.min()) if len(numeric) else np.nan,
+                        "Max": float(numeric.max()) if len(numeric) else np.nan,
+                    })
+                if self.getPercentiles():
+                    for q in (0.5, 0.25, 0.75):
+                        row[f"P{int(q*100)}"] = (
+                            float(numeric.quantile(q)) if len(numeric) else np.nan
+                        )
+            rows.append(row)
+        return DataFrame(pd.DataFrame(rows), num_partitions=1)
+
+
+@register_stage
+class TextPreprocessor(Transformer):
+    """Trie-based token normalization/removal
+    (UPSTREAM:.../stages/TextPreprocessor.scala): map is applied to the
+    text with longest-match-wins semantics."""
+
+    inputCol = Param("inputCol", "Input text column", dtype=str)
+    outputCol = Param("outputCol", "Output text column", dtype=str)
+    map = Param("map", "substring -> replacement map", default=None)
+    normFunc = Param(
+        "normFunc", "lowerCase|identity pre-normalization", default="lowerCase", dtype=str
+    )
+
+    def _transform(self, df):
+        mapping = self.getMap() or {}
+        # longest-first so longer matches win over their prefixes
+        keys = sorted(mapping, key=len, reverse=True)
+        norm = (lambda s: s.lower()) if self.getNormFunc() == "lowerCase" else (lambda s: s)
+
+        def clean(text: str) -> str:
+            out, i = [], 0
+            t = norm(str(text))
+            while i < len(t):
+                for k in keys:
+                    if t.startswith(k, i):
+                        out.append(mapping[k])
+                        i += len(k)
+                        break
+                else:
+                    out.append(t[i])
+                    i += 1
+            return "".join(out)
+
+        return df.withColumn(self.getOutputCol(), [clean(v) for v in df[self.getInputCol()]])
+
+
+@register_stage
+class PartitionConsolidator(Transformer):
+    """Funnel data from many partitions into few (for rate-limited resources
+    like HTTP clients — UPSTREAM:.../stages/PartitionConsolidator.scala)."""
+
+    concurrency = Param("concurrency", "Target partition count", default=1, dtype=int)
+    concurrentTimeout = Param("concurrentTimeout", "unused (API parity)", default=0.0, dtype=float)
+
+    def _transform(self, df):
+        return df.coalesce(self.getConcurrency())

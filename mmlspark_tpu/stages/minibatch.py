@@ -1,0 +1,113 @@
+"""Minibatching transformers (reference:
+UPSTREAM:.../stages/MiniBatchTransformer.scala — SURVEY.md §2.7
+"Mini-batching"): group rows into batch rows so downstream native/HTTP calls
+amortize per-call overhead, and FlattenBatch to undo it.
+
+In the TPU rebuild the same stages bound XLA dispatch overhead: a batch row
+becomes one jitted call (SURVEY.md §3.3 CNTKModel minibatch flow).
+"""
+
+from __future__ import annotations
+
+import time as _time
+from typing import List
+
+import numpy as np
+import pandas as pd
+
+from mmlspark_tpu.core.frame import DataFrame
+from mmlspark_tpu.core.params import Param
+from mmlspark_tpu.core.pipeline import Transformer
+from mmlspark_tpu.core.registry import register_stage
+
+
+def _batch_pdf(pdf: pd.DataFrame, bounds: List[int]) -> pd.DataFrame:
+    """Rows → one row per [bounds[i], bounds[i+1]) slice, each cell a list."""
+    out = {}
+    for c in pdf.columns:
+        col = pdf[c].tolist()
+        out[c] = [col[a:b] for a, b in zip(bounds[:-1], bounds[1:])]
+    return pd.DataFrame(out)
+
+
+class _MiniBatchBase(Transformer):
+    def _bounds(self, n: int) -> List[int]:
+        raise NotImplementedError
+
+    def _transform(self, df: DataFrame) -> DataFrame:
+        pdf = df.toPandas()
+        n = len(pdf)
+        if n == 0:
+            return df
+        bounds = self._bounds(n)
+        return DataFrame(_batch_pdf(pdf, bounds), num_partitions=df.num_partitions)
+
+
+@register_stage
+class FixedMiniBatchTransformer(_MiniBatchBase):
+    batchSize = Param("batchSize", "Rows per batch", default=10, dtype=int)
+    maxBufferSize = Param("maxBufferSize", "unused (API parity)", default=2147483647, dtype=int)
+    buffered = Param("buffered", "unused (API parity)", default=False, dtype=bool)
+
+    def _bounds(self, n):
+        bs = self.getBatchSize()
+        return list(range(0, n, bs)) + [n]
+
+
+@register_stage
+class DynamicMiniBatchTransformer(_MiniBatchBase):
+    """Batch whatever has arrived (streaming); in batch mode: one batch per
+    partition slice, mirroring the reference's all-available semantics."""
+
+    maxBatchSize = Param("maxBatchSize", "Upper bound on batch size", default=2147483647, dtype=int)
+
+    def _transform(self, df: DataFrame) -> DataFrame:
+        pdf = df.toPandas()
+        n = len(pdf)
+        if n == 0:
+            return df
+        cap = min(self.getMaxBatchSize(), n)
+        bounds = sorted({s.start for s in df.partition_slices()} | {n})
+        # enforce the cap within each partition batch
+        final = [0]
+        for b in bounds[1:] if bounds[0] == 0 else bounds:
+            while b - final[-1] > cap:
+                final.append(final[-1] + cap)
+            if b != final[-1]:
+                final.append(b)
+        return DataFrame(_batch_pdf(pdf, final), num_partitions=df.num_partitions)
+
+
+@register_stage
+class TimeIntervalMiniBatchTransformer(_MiniBatchBase):
+    """Batch rows arriving within a time window.  In batch (non-streaming)
+    mode all rows are already available, so this degrades to per-partition
+    batches like the reference does on a drained queue."""
+
+    millisToWait = Param("millisToWait", "Window length in ms", default=1000, dtype=int)
+    maxBatchSize = Param("maxBatchSize", "Upper bound on batch size", default=2147483647, dtype=int)
+
+    def _bounds(self, n):
+        cap = min(self.getMaxBatchSize(), n)
+        return list(range(0, n, cap)) + [n]
+
+
+@register_stage
+class FlattenBatch(Transformer):
+    """Inverse of the minibatchers: explode list-valued rows back to rows."""
+
+    def _transform(self, df: DataFrame) -> DataFrame:
+        pdf = df.toPandas()
+        if len(pdf) == 0:
+            return df
+        out = {c: [] for c in pdf.columns}
+        lengths = [
+            len(row) for row in pdf[pdf.columns[0]]
+        ]
+        for c in pdf.columns:
+            for cell, ln in zip(pdf[c].tolist(), lengths):
+                if isinstance(cell, (list, np.ndarray)) and len(cell) == ln:
+                    out[c].extend(list(cell))
+                else:  # scalar cell: replicate across the exploded rows
+                    out[c].extend([cell] * ln)
+        return DataFrame(pd.DataFrame(out), num_partitions=df.num_partitions)

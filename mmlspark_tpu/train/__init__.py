@@ -1,0 +1,20 @@
+"""Training convenience + model statistics (reference: ``cms.train`` —
+SURVEY.md §2.7): auto-featurize-and-fit wrappers and metric computation."""
+
+from mmlspark_tpu.train.compute_statistics import (
+    ComputeModelStatistics,
+    ComputePerInstanceStatistics,
+    MetricConstants,
+)
+from mmlspark_tpu.train.train_classifier import (
+    TrainClassifier,
+    TrainedClassifierModel,
+    TrainedRegressorModel,
+    TrainRegressor,
+)
+
+__all__ = [
+    "ComputeModelStatistics", "ComputePerInstanceStatistics",
+    "MetricConstants", "TrainClassifier", "TrainRegressor",
+    "TrainedClassifierModel", "TrainedRegressorModel",
+]

@@ -1,0 +1,147 @@
+"""Model quality metrics (reference: ``ComputeModelStatistics`` /
+``ComputePerInstanceStatistics`` — UPSTREAM:.../train/ComputeModelStatistics
+.scala, SURVEY.md §2.7: AUC, accuracy, precision/recall, confusion matrix,
+MSE/R² …)."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+import pandas as pd
+
+from mmlspark_tpu.core.frame import DataFrame
+from mmlspark_tpu.core.params import Param, Params
+from mmlspark_tpu.core.pipeline import Transformer
+from mmlspark_tpu.core.registry import register_stage
+
+
+class MetricConstants:
+    """Metric names (reference: cms.core.metrics.MetricConstants)."""
+
+    AucSparkMetric = "AUC"
+    AccuracySparkMetric = "accuracy"
+    PrecisionSparkMetric = "precision"
+    RecallSparkMetric = "recall"
+    AllSparkMetrics = "all"
+    MseSparkMetric = "mse"
+    RmseSparkMetric = "rmse"
+    MaeSparkMetric = "mae"
+    R2SparkMetric = "r2"
+    ClassificationMetricsName = "classification"
+    RegressionMetricsName = "regression"
+
+
+def _auc_score(y: np.ndarray, p: np.ndarray) -> float:
+    order = np.argsort(p)
+    ranks = np.empty(len(p))
+    ranks[order] = np.arange(1, len(p) + 1)
+    pos = y > 0
+    n1, n0 = int(pos.sum()), int((~pos).sum())
+    if n1 == 0 or n0 == 0:
+        return float("nan")
+    return float((ranks[pos].sum() - n1 * (n1 + 1) / 2) / (n1 * n0))
+
+
+@register_stage
+class ComputeModelStatistics(Transformer):
+    labelCol = Param("labelCol", "True label column", default="label", dtype=str)
+    scoresCol = Param("scoresCol", "Probability/score column (classification)", default=None)
+    scoredLabelsCol = Param("scoredLabelsCol", "Predicted label column", default="prediction", dtype=str)
+    evaluationMetric = Param(
+        "evaluationMetric", "classification|regression|all|<specific metric>",
+        default="all", dtype=str,
+    )
+
+    def _is_classification(self, y: np.ndarray) -> bool:
+        m = self.getEvaluationMetric()
+        if m in (MetricConstants.ClassificationMetricsName,
+                 MetricConstants.AucSparkMetric,
+                 MetricConstants.AccuracySparkMetric,
+                 MetricConstants.PrecisionSparkMetric,
+                 MetricConstants.RecallSparkMetric):
+            return True
+        if m in (MetricConstants.RegressionMetricsName,
+                 MetricConstants.MseSparkMetric, MetricConstants.RmseSparkMetric,
+                 MetricConstants.MaeSparkMetric, MetricConstants.R2SparkMetric):
+            return False
+        # 'all': infer like the reference does from label metadata/values
+        return np.allclose(y, np.round(y)) and len(np.unique(y)) <= max(20, int(np.sqrt(len(y))))
+
+    def _transform(self, df: DataFrame) -> DataFrame:
+        y = np.asarray(df[self.getLabelCol()], dtype=np.float64)
+        pred = np.asarray(df[self.getScoredLabelsCol()], dtype=np.float64)
+        row: dict = {}
+        if self._is_classification(y):
+            row["accuracy"] = float((pred == y).mean())
+            classes = np.unique(np.concatenate([y, pred]))
+            # macro-averaged precision/recall + confusion matrix
+            precisions, recalls = [], []
+            cm = np.zeros((len(classes), len(classes)))
+            for i, ci in enumerate(classes):
+                for j, cj in enumerate(classes):
+                    cm[i, j] = float(((y == ci) & (pred == cj)).sum())
+            for i, c in enumerate(classes):
+                tp = cm[i, i]
+                fp = cm[:, i].sum() - tp
+                fn = cm[i, :].sum() - tp
+                precisions.append(tp / (tp + fp) if tp + fp else 0.0)
+                recalls.append(tp / (tp + fn) if tp + fn else 0.0)
+            row["precision"] = float(np.mean(precisions))
+            row["recall"] = float(np.mean(recalls))
+            row["confusion_matrix"] = cm.tolist()
+            if len(classes) == 2:
+                scores_col = self.getScoresCol()
+                if scores_col and scores_col in df:
+                    sc = df[scores_col]
+                    p1 = np.asarray(
+                        [v[-1] if isinstance(v, (list, np.ndarray)) else v for v in sc],
+                        dtype=np.float64,
+                    )
+                else:
+                    p1 = pred
+                row["AUC"] = _auc_score(y, p1)
+        else:
+            err = pred - y
+            row["mean_squared_error"] = float(np.mean(err**2))
+            row["root_mean_squared_error"] = float(np.sqrt(np.mean(err**2)))
+            row["mean_absolute_error"] = float(np.mean(np.abs(err)))
+            ss_tot = float(((y - y.mean()) ** 2).sum())
+            row["R^2"] = float(1 - (err**2).sum() / ss_tot) if ss_tot else float("nan")
+        return DataFrame(pd.DataFrame([row]), num_partitions=1)
+
+
+@register_stage
+class ComputePerInstanceStatistics(Transformer):
+    """Per-row loss/log-loss columns (reference:
+    UPSTREAM:.../train/ComputePerInstanceStatistics.scala)."""
+
+    labelCol = Param("labelCol", "True label column", default="label", dtype=str)
+    scoresCol = Param("scoresCol", "Probability column", default=None)
+    scoredLabelsCol = Param("scoredLabelsCol", "Predicted label column", default="prediction", dtype=str)
+    evaluationMetric = Param("evaluationMetric", "classification|regression|all", default="all", dtype=str)
+
+    def _transform(self, df: DataFrame) -> DataFrame:
+        y = np.asarray(df[self.getLabelCol()], dtype=np.float64)
+        pred = np.asarray(df[self.getScoredLabelsCol()], dtype=np.float64)
+        is_clf = ComputeModelStatistics(
+            labelCol=self.getLabelCol(),
+            evaluationMetric=self.getEvaluationMetric(),
+        )._is_classification(y)
+        if is_clf:
+            scores_col = self.getScoresCol()
+            if scores_col and scores_col in df:
+                probs = np.stack(
+                    [np.atleast_1d(np.asarray(v, dtype=np.float64)) for v in df[scores_col]]
+                )
+                if probs.shape[1] == 1:
+                    probs = np.concatenate([1 - probs, probs], axis=1)
+                idx = np.clip(y.astype(int), 0, probs.shape[1] - 1)
+                p_true = probs[np.arange(len(y)), idx]
+                df = df.withColumn("log_loss", -np.log(np.clip(p_true, 1e-15, None)))
+            df = df.withColumn("correct", (pred == y).astype(np.float64))
+        else:
+            err = pred - y
+            df = df.withColumn("L1_loss", np.abs(err))
+            df = df.withColumn("L2_loss", err**2)
+        return df

@@ -1,0 +1,125 @@
+"""TrainClassifier / TrainRegressor: auto-featurize + fit any estimator.
+
+Reference parity (UPSTREAM:.../train/{TrainClassifier,TrainRegressor}.scala
+— SURVEY.md §2.7): wraps an inner estimator, auto-featurizes mixed columns
+into the features vector, indexes string labels (recording label metadata so
+predictions can be mapped back), and returns a model that scores new data
+with the same featurization.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from mmlspark_tpu.core.frame import DataFrame
+from mmlspark_tpu.core.params import ComplexParam, Param, Params
+from mmlspark_tpu.core.pipeline import Estimator, Model
+from mmlspark_tpu.core.registry import register_stage
+from mmlspark_tpu.featurize.featurize import Featurize
+
+
+class _TrainParams(Params):
+    model = ComplexParam("model", "Inner estimator", default=None)
+    labelCol = Param("labelCol", "Label column", default="label", dtype=str)
+    featuresCol = Param("featuresCol", "Assembled features column", default="features", dtype=str)
+    numFeatures = Param("numFeatures", "Hash buckets for text columns", default=262144, dtype=int)
+
+    def setModel(self, est):
+        self._paramMap["model"] = est
+        return self
+
+
+class _TrainBase(Estimator, _TrainParams):
+    _index_labels = False
+
+    def _fit(self, df: DataFrame) -> Model:
+        label_col = self.getLabelCol()
+        feat_cols = [c for c in df.columns if c not in (label_col, self.getFeaturesCol())]
+        featurizer = Featurize(
+            inputCols=feat_cols,
+            outputCol=self.getFeaturesCol(),
+            numFeatures=self.getNumFeatures(),
+        ).fit(df)
+        out = featurizer.transform(df)
+
+        levels = None
+        if self._index_labels:
+            raw = df[label_col]
+            if raw.dtype == object or not np.issubdtype(raw.dtype, np.number):
+                levels = sorted(set(str(v) for v in raw))
+                index = {v: i for i, v in enumerate(levels)}
+                out = out.withColumn(
+                    label_col, np.asarray([index[str(v)] for v in raw], dtype=np.float64)
+                )
+
+        inner = self.getModel()
+        if inner is None:
+            from mmlspark_tpu.models.lightgbm import (
+                LightGBMClassifier,
+                LightGBMRegressor,
+            )
+
+            inner = (
+                LightGBMClassifier() if self._index_labels else LightGBMRegressor()
+            )
+        if inner.hasParam("labelCol"):
+            inner = inner.copy({"labelCol": label_col})
+        if inner.hasParam("featuresCol"):
+            inner.set("featuresCol", self.getFeaturesCol())
+        if self._index_labels and inner.hasParam("objective"):
+            # Count classes on the (possibly indexed) labels — numeric
+            # multiclass labels need the upgrade too, not just string ones.
+            n_classes = len(np.unique(np.asarray(out[label_col], dtype=np.float64)))
+            if n_classes > 2 and inner.getOrDefault("objective") == "binary":
+                inner.set("objective", "multiclass")
+        fitted = inner.fit(out)
+
+        model_cls = TrainedClassifierModel if self._index_labels else TrainedRegressorModel
+        model = model_cls(labelCol=label_col, featuresCol=self.getFeaturesCol())
+        model._paramMap["featurizerModel"] = featurizer
+        model._paramMap["innerModel"] = fitted
+        model._paramMap["labelLevels"] = levels
+        return model
+
+
+@register_stage
+class TrainClassifier(_TrainBase):
+    _index_labels = True
+
+
+@register_stage
+class TrainRegressor(_TrainBase):
+    _index_labels = False
+
+
+class _TrainedBase(Model, _TrainParams):
+    featurizerModel = ComplexParam("featurizerModel", "Fitted featurizer", default=None)
+    innerModel = ComplexParam("innerModel", "Fitted inner model", default=None)
+    labelLevels = ComplexParam("labelLevels", "Original label levels", default=None)
+
+    def getModel(self):
+        return self.getOrDefault("innerModel")
+
+    def _transform(self, df: DataFrame) -> DataFrame:
+        out = self.getOrDefault("featurizerModel").transform(df)
+        out = self.getOrDefault("innerModel").transform(out)
+        levels = self.getOrDefault("labelLevels")
+        if levels is not None and "prediction" in out:
+            mapped = [
+                levels[int(p)] if 0 <= int(p) < len(levels) else None
+                for p in out["prediction"]
+            ]
+            out = out.withColumn("scored_labels", mapped)
+        return out
+
+
+@register_stage
+class TrainedClassifierModel(_TrainedBase):
+    pass
+
+
+@register_stage
+class TrainedRegressorModel(_TrainedBase):
+    pass

@@ -1,0 +1,188 @@
+"""AutoML + image stack tests (reference suites: .../automl/*, .../image/* —
+SURVEY.md §4)."""
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu import DataFrame
+
+
+def _clf_df(n=150, seed=0):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, 4))
+    y = (X[:, 0] + 0.5 * X[:, 1] > 0).astype(float)
+    return DataFrame({"features": list(X), "label": y})
+
+
+class TestHyperparams:
+    def test_ranges_and_discrete(self):
+        from mmlspark_tpu.automl import (
+            DiscreteHyperParam,
+            DoubleRangeHyperParam,
+            IntRangeHyperParam,
+        )
+
+        rng = np.random.default_rng(0)
+        ir = IntRangeHyperParam(2, 8)
+        assert all(2 <= ir.sample(rng) <= 8 for _ in range(20))
+        dr = DoubleRangeHyperParam(0.1, 0.5)
+        assert all(0.1 <= dr.sample(rng) <= 0.5 for _ in range(20))
+        d = DiscreteHyperParam(["a", "b"])
+        assert d.sample(rng) in ("a", "b")
+        with pytest.raises(ValueError):
+            IntRangeHyperParam(5, 5)
+
+    def test_builder_and_grid(self):
+        from mmlspark_tpu.automl import (
+            DiscreteHyperParam,
+            GridSpace,
+            HyperparamBuilder,
+            IntRangeHyperParam,
+        )
+
+        space = (
+            HyperparamBuilder()
+            .addHyperparam("numLeaves", DiscreteHyperParam([3, 7]))
+            .addHyperparam("numIterations", DiscreteHyperParam([2, 4]))
+            .build()
+        )
+        maps = list(GridSpace(space).param_maps())
+        assert len(maps) == 4
+        assert {m["numLeaves"] for m in maps} == {3, 7}
+
+
+class TestSearch:
+    def test_find_best_model(self):
+        from mmlspark_tpu.automl import FindBestModel
+        from mmlspark_tpu.models.lightgbm import LightGBMClassifier
+
+        df = _clf_df()
+        weak = LightGBMClassifier(numIterations=1, numLeaves=2, learningRate=0.01, minDataInLeaf=5)
+        strong = LightGBMClassifier(numIterations=10, numLeaves=7, minDataInLeaf=5)
+        best = FindBestModel(evaluationMetric="AUC").setModels([weak, strong]).fit(df)
+        scores = best.getBestModelMetrics()
+        assert best.getBestScore() == max(scores)
+        out = best.transform(df)
+        assert "prediction" in out.columns
+
+    def test_tune_hyperparameters(self):
+        from mmlspark_tpu.automl import (
+            DiscreteHyperParam,
+            HyperparamBuilder,
+            TuneHyperparameters,
+        )
+        from mmlspark_tpu.models.lightgbm import LightGBMClassifier
+
+        df = _clf_df(200)
+        space = (
+            HyperparamBuilder()
+            .addHyperparam("numLeaves", DiscreteHyperParam([3, 7]))
+            .addHyperparam("learningRate", DiscreteHyperParam([0.05, 0.3]))
+            .build()
+        )
+        tuned = (
+            TuneHyperparameters(
+                evaluationMetric="accuracy", numFolds=2, numRuns=3, parallelism=2,
+            )
+            .setEstimator(LightGBMClassifier(numIterations=5, minDataInLeaf=5))
+            .setSearchSpace(space)
+            .fit(df)
+        )
+        assert set(tuned.getBestModelInfo()) == {"numLeaves", "learningRate"}
+        assert len(tuned.getOrDefault("allScores")) == 3
+        assert (tuned.transform(df)["prediction"] == df["label"]).mean() > 0.8
+
+
+def _img(h=12, w=16, c=3, seed=0):
+    rng = np.random.default_rng(seed)
+    from mmlspark_tpu.ops.image_ops import make_image_row
+
+    return make_image_row(rng.integers(0, 255, size=(h, w, c)).astype(np.uint8))
+
+
+class TestImageOps:
+    def test_resize_crop_gray(self):
+        from mmlspark_tpu.ops.image_ops import ImageTransformer
+
+        df = DataFrame({"image": [_img()]})
+        t = (
+            ImageTransformer(inputCol="image", outputCol="out")
+            .resize(8, 8)
+            .centerCrop(4, 4)
+            .colorFormat("gray")
+        )
+        out = t.transform(df)["out"][0]
+        assert out["height"] == 4 and out["width"] == 4 and out["nChannels"] == 1
+
+    def test_flip_and_threshold(self):
+        from mmlspark_tpu.ops.image_ops import ImageTransformer
+
+        img = _img(4, 4, 1)
+        df = DataFrame({"image": [img]})
+        flipped = ImageTransformer(inputCol="image", outputCol="o").flip(1).transform(df)["o"][0]
+        np.testing.assert_array_equal(
+            np.asarray(flipped["data"]), np.asarray(img["data"])[:, ::-1]
+        )
+        th = ImageTransformer(inputCol="image", outputCol="o").threshold(128).transform(df)["o"][0]
+        assert set(np.unique(np.asarray(th["data"]))) <= {0.0, 255.0}
+
+    def test_unroll_chw(self):
+        from mmlspark_tpu.ops.image_ops import UnrollImage
+
+        img = _img(3, 2, 3)
+        df = DataFrame({"image": [img]})
+        v = UnrollImage(inputCol="image", outputCol="u").transform(df)["u"][0]
+        assert v.shape == (3 * 2 * 3,)
+        data = np.asarray(img["data"], dtype=np.float64)
+        np.testing.assert_allclose(v[:6], data[:, :, 0].reshape(-1))  # CHW order
+
+    def test_augmenter_doubles_rows(self):
+        from mmlspark_tpu.ops.image_ops import ImageSetAugmenter
+
+        df = DataFrame({"image": [_img(seed=1), _img(seed=2)], "label": [0.0, 1.0]})
+        out = ImageSetAugmenter(inputCol="image", flipLeftRight=True).transform(df)
+        assert out.count() == 4
+        assert list(out["label"]) == [0.0, 1.0, 0.0, 1.0]
+
+    def test_decode_bytes(self):
+        import io
+
+        from PIL import Image
+
+        from mmlspark_tpu.ops.image_ops import decode_image
+
+        arr = np.zeros((5, 7, 3), np.uint8)
+        arr[:, :, 0] = 200  # red in RGB
+        buf = io.BytesIO()
+        Image.fromarray(arr).save(buf, format="PNG")
+        struct = decode_image(buf.getvalue())
+        assert struct["height"] == 5 and struct["width"] == 7
+        # stored BGR (OpenCV convention): red lands in channel 2
+        assert np.asarray(struct["data"])[0, 0, 2] == 200
+
+    def test_image_featurizer_pipeline(self):
+        """ImageFeaturizer composition: image → preprocess → ONNX head."""
+        from mmlspark_tpu.models.image_featurizer import ImageFeaturizer
+        from mmlspark_tpu.onnx.importer import export_model_bytes, make_node
+
+        rng = np.random.default_rng(3)
+        w = rng.normal(size=(4, 3, 3, 3)).astype(np.float32)
+        payload = export_model_bytes(
+            [
+                make_node("Conv", ["data", "w"], ["conv"], pads=[1, 1, 1, 1]),
+                make_node("GlobalAveragePool", ["conv"], ["gap"]),
+                make_node("Flatten", ["gap"], ["feats"]),
+            ],
+            [("data", (None, 3, 8, 8), 1)], ["feats"], {"w": w},
+        )
+        df = DataFrame({"image": [_img(10, 12), _img(10, 12, seed=5)]})
+        feat = (
+            ImageFeaturizer(inputCol="image", outputCol="features")
+            .setModelPayload(payload)
+            .setImageHeight(8)
+            .setImageWidth(8)
+        )
+        out = feat.transform(df)
+        feats = np.stack(out["features"])
+        assert feats.shape == (2, 4)
+        assert np.isfinite(feats).all()

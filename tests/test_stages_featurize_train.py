@@ -1,0 +1,283 @@
+"""Stages/featurize/train utility-surface tests (reference suites:
+UPSTREAM:src/test/.../stages/*, .../featurize/*, .../train/* — SURVEY.md §4)."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from mmlspark_tpu import DataFrame
+
+
+class TestBasicStages:
+    def test_column_ops(self):
+        from mmlspark_tpu.stages import DropColumns, RenameColumn, SelectColumns
+
+        df = DataFrame({"a": [1.0], "b": [2.0], "c": [3.0]})
+        assert DropColumns(cols=["b"]).transform(df).columns == ["a", "c"]
+        assert SelectColumns(cols=["c", "a"]).transform(df).columns == ["c", "a"]
+        out = RenameColumn(inputCol="a", outputCol="z").transform(df)
+        assert "z" in out.columns and "a" not in out.columns
+
+    def test_repartition_and_consolidator(self):
+        from mmlspark_tpu.stages import PartitionConsolidator, Repartition
+
+        df = DataFrame({"x": list(range(10))}, num_partitions=1)
+        assert Repartition(n=5).transform(df).num_partitions == 5
+        assert PartitionConsolidator(concurrency=2).transform(
+            df.repartition(8)
+        ).num_partitions == 2
+
+    def test_lambda_and_udf(self):
+        from mmlspark_tpu.stages import Lambda, UDFTransformer
+
+        df = DataFrame({"x": [1.0, 2.0]})
+        out = Lambda().setTransform(lambda d: d.withColumn("y", d["x"] * 2)).transform(df)
+        np.testing.assert_allclose(out["y"], [2.0, 4.0])
+        out = UDFTransformer(inputCol="x", outputCol="sq").setUDF(lambda v: v * v).transform(df)
+        np.testing.assert_allclose(out["sq"], [1.0, 4.0])
+        out = UDFTransformer(inputCols=["x", "sq"], outputCol="s").setUDF(
+            lambda a, b: a + b
+        ).transform(out)
+        np.testing.assert_allclose(out["s"], [2.0, 6.0])
+
+    def test_multi_column_adapter(self):
+        from mmlspark_tpu.stages import MultiColumnAdapter, UDFTransformer
+
+        df = DataFrame({"a": [1.0], "b": [2.0]})
+        base = UDFTransformer().setUDF(lambda v: v + 10)
+        out = MultiColumnAdapter(
+            inputCols=["a", "b"], outputCols=["a10", "b10"]
+        ).setBaseStage(base).transform(df)
+        assert out["a10"][0] == 11.0 and out["b10"][0] == 12.0
+
+    def test_class_balancer(self):
+        from mmlspark_tpu.stages import ClassBalancer
+
+        df = DataFrame({"label": [0.0, 0.0, 0.0, 1.0]})
+        model = ClassBalancer(inputCol="label").fit(df)
+        out = model.transform(df)
+        np.testing.assert_allclose(out["weight"], [1.0, 1.0, 1.0, 3.0])
+
+    def test_stratified_repartition(self):
+        from mmlspark_tpu.stages import StratifiedRepartition
+
+        y = np.array([0] * 12 + [1] * 4, dtype=float)
+        df = DataFrame({"label": y}, num_partitions=4)
+        out = StratifiedRepartition(labelCol="label", seed=1).transform(df)
+        for sl in out.partition_slices():
+            part = out["label"][sl]
+            assert set(np.unique(part)) == {0.0, 1.0}
+        eq = StratifiedRepartition(labelCol="label", mode="equal", seed=1).transform(df)
+        vals, counts = np.unique(eq["label"], return_counts=True)
+        assert counts[0] == counts[1]
+
+    def test_summarize_data(self):
+        from mmlspark_tpu.stages import SummarizeData
+
+        df = DataFrame({"x": [1.0, 2.0, 3.0, np.nan], "s": ["a", "b", "a", "c"]})
+        out = SummarizeData().transform(df).toPandas().set_index("Feature")
+        assert out.loc["x", "Missing Value Count"] == 1
+        assert out.loc["x", "Mean"] == 2.0
+        assert out.loc["s", "Unique Value Count"] == 3
+
+    def test_text_preprocessor(self):
+        from mmlspark_tpu.stages import TextPreprocessor
+
+        df = DataFrame({"t": ["The DOG ran", "dogged pursuit"]})
+        out = TextPreprocessor(
+            inputCol="t", outputCol="o", map={"dog": "cat", "ran": "walked"}
+        ).transform(df)
+        assert out["o"][0] == "the cat walked"
+        assert out["o"][1] == "catged pursuit"
+
+    def test_timer(self, capsys):
+        from mmlspark_tpu.stages import DropColumns, Timer
+
+        df = DataFrame({"a": [1.0], "b": [2.0]})
+        t = Timer().setStage(DropColumns(cols=["b"]))
+        out = t.transform(df)
+        assert out.columns == ["a"]
+        assert len(t.lastTimings) == 1
+        assert "Timer: transform(DropColumns)" in capsys.readouterr().out
+
+    def test_ensemble_by_key(self):
+        from mmlspark_tpu.stages import EnsembleByKey
+
+        df = DataFrame({
+            "k": ["a", "a", "b"],
+            "score": [1.0, 3.0, 5.0],
+            "vec": [np.array([1.0, 0.0]), np.array([3.0, 2.0]), np.array([0.0, 1.0])],
+        })
+        out = EnsembleByKey(keys=["k"], cols=["score", "vec"]).transform(df)
+        pdf = out.toPandas().set_index("k")
+        assert pdf.loc["a", "mean(score)"] == 2.0
+        np.testing.assert_allclose(pdf.loc["a", "mean(vec)"], [2.0, 1.0])
+
+
+class TestMiniBatch:
+    def test_fixed_and_flatten_roundtrip(self):
+        from mmlspark_tpu.stages import FixedMiniBatchTransformer, FlattenBatch
+
+        df = DataFrame({"x": list(range(25)), "s": [str(i) for i in range(25)]})
+        batched = FixedMiniBatchTransformer(batchSize=10).transform(df)
+        assert batched.count() == 3
+        assert len(batched["x"][0]) == 10 and len(batched["x"][2]) == 5
+        flat = FlattenBatch().transform(batched)
+        assert flat.count() == 25
+        assert list(flat["x"]) == list(range(25))
+
+    def test_dynamic_respects_partitions(self):
+        from mmlspark_tpu.stages import DynamicMiniBatchTransformer
+
+        df = DataFrame({"x": list(range(20))}, num_partitions=4)
+        out = DynamicMiniBatchTransformer().transform(df)
+        assert out.count() == 4
+        out = DynamicMiniBatchTransformer(maxBatchSize=3).transform(df)
+        assert all(len(b) <= 3 for b in out["x"])
+
+    def test_time_interval(self):
+        from mmlspark_tpu.stages import TimeIntervalMiniBatchTransformer
+
+        df = DataFrame({"x": list(range(7))})
+        out = TimeIntervalMiniBatchTransformer(maxBatchSize=4).transform(df)
+        assert [len(b) for b in out["x"]] == [4, 3]
+
+
+class TestFeaturize:
+    def test_value_indexer_roundtrip(self):
+        from mmlspark_tpu.featurize import IndexToValue, ValueIndexer
+
+        df = DataFrame({"c": ["red", "blue", "red", "green"]})
+        model = ValueIndexer(inputCol="c", outputCol="idx").fit(df)
+        out = model.transform(df)
+        assert len(set(out["idx"])) == 3
+        back = IndexToValue(inputCol="idx", outputCol="orig").transform(out)
+        assert list(back["orig"]) == ["red", "blue", "red", "green"]
+        # unseen value → missing index → None on inversion
+        out2 = model.transform(DataFrame({"c": ["??"]}))
+        assert IndexToValue(inputCol="idx", outputCol="o").transform(out2)["o"][0] is None
+
+    def test_clean_missing_data(self):
+        from mmlspark_tpu.featurize import CleanMissingData
+
+        df = DataFrame({"x": [1.0, np.nan, 3.0], "y": [np.nan, 4.0, 8.0]})
+        model = CleanMissingData(
+            inputCols=["x", "y"], outputCols=["x", "y"], cleaningMode="Mean"
+        ).fit(df)
+        out = model.transform(df)
+        np.testing.assert_allclose(out["x"], [1.0, 2.0, 3.0])
+        np.testing.assert_allclose(out["y"], [6.0, 4.0, 8.0])
+        model = CleanMissingData(
+            inputCols=["x"], outputCols=["x2"], cleaningMode="Custom", customValue=-1
+        ).fit(df)
+        np.testing.assert_allclose(model.transform(df)["x2"], [1.0, -1.0, 3.0])
+
+    def test_data_conversion(self):
+        from mmlspark_tpu.featurize import DataConversion
+
+        df = DataFrame({"x": [1.5, 2.7], "s": ["a", "b"]})
+        out = DataConversion(cols=["x"], convertTo="integer").transform(df)
+        assert out["x"].dtype == np.int32
+        out = DataConversion(cols=["x"], convertTo="string").transform(df)
+        assert out["s"].dtype == object
+        out = DataConversion(cols=["s"], convertTo="toCategorical").transform(df)
+        assert set(out["s"]) == {0.0, 1.0}
+
+    def test_featurize_mixed_types(self):
+        from mmlspark_tpu.featurize import Featurize
+
+        df = DataFrame({
+            "num": [1.0, np.nan, 3.0],
+            "cat": ["a", "b", "a"],
+            "vec": [np.ones(2), np.zeros(2), np.ones(2)],
+            "label": [0.0, 1.0, 0.0],
+        })
+        model = Featurize(inputCols=["num", "cat", "vec"], outputCol="features").fit(df)
+        out = model.transform(df)
+        feats = np.stack(out["features"])
+        assert feats.shape == (3, 1 + 2 + 2)  # numeric + onehot(2) + vec(2)
+        assert not np.isnan(feats).any()
+
+    def test_text_featurizer_idf(self):
+        from mmlspark_tpu.featurize import TextFeaturizer
+
+        df = DataFrame({"t": ["the cat sat", "the dog sat", "a bird flew"]})
+        model = TextFeaturizer(inputCol="t", outputCol="f", numFeatures=1 << 10).fit(df)
+        out = model.transform(df)
+        f = np.stack(out["f"])
+        assert f.shape == (3, 1 << 10)
+        assert (f.sum(axis=1) > 0).all()
+        # common word ("sat" in 2 docs) weighs less than rare ("bird" in 1)
+        from mmlspark_tpu.featurize.text import hash_token
+
+        sat = f[0, hash_token("sat") % (1 << 10)]
+        bird = f[2, hash_token("bird") % (1 << 10)]
+        assert bird > sat > 0
+
+    def test_murmurhash_reference_vectors(self):
+        # Public MurmurHash3-32 test vectors (seed 0)
+        from mmlspark_tpu.featurize.text import murmurhash3_32
+
+        assert murmurhash3_32(b"", 0) == 0
+        assert murmurhash3_32(b"", 1) == 0x514E28B7
+        assert murmurhash3_32(b"abcd", 0x9747B28C) == 0xF0478627
+
+
+class TestTrain:
+    def test_train_classifier_string_labels(self):
+        from mmlspark_tpu.models.lightgbm import LightGBMClassifier
+        from mmlspark_tpu.train import TrainClassifier
+
+        rng = np.random.default_rng(0)
+        X = rng.normal(size=(200, 4))
+        y = np.where(X[:, 0] > 0, "pos", "neg")
+        df = DataFrame({
+            "f1": X[:, 0], "f2": X[:, 1], "f3": X[:, 2], "f4": X[:, 3],
+            "label": y,
+        })
+        model = TrainClassifier(labelCol="label").setModel(
+            LightGBMClassifier(numIterations=5, numLeaves=7, minDataInLeaf=5)
+        ).fit(df)
+        out = model.transform(df)
+        assert set(out["scored_labels"]) <= {"pos", "neg"}
+        assert (out["scored_labels"] == y).mean() > 0.9
+
+    def test_train_regressor_and_statistics(self):
+        from mmlspark_tpu.models.lightgbm import LightGBMRegressor
+        from mmlspark_tpu.train import ComputeModelStatistics, TrainRegressor
+
+        rng = np.random.default_rng(1)
+        X = rng.normal(size=(200, 3))
+        y = X[:, 0] * 2 + 1
+        df = DataFrame({"a": X[:, 0], "b": X[:, 1], "c": X[:, 2], "label": y})
+        model = TrainRegressor(labelCol="label").setModel(
+            LightGBMRegressor(numIterations=10, numLeaves=7, minDataInLeaf=5)
+        ).fit(df)
+        scored = model.transform(df)
+        stats = ComputeModelStatistics(evaluationMetric="regression").transform(scored)
+        row = stats.first()
+        assert row["R^2"] > 0.8
+        assert row["mean_squared_error"] < 1.0
+
+    def test_classification_statistics(self):
+        from mmlspark_tpu.train import ComputeModelStatistics, ComputePerInstanceStatistics
+
+        df = DataFrame({
+            "label": [0.0, 0.0, 1.0, 1.0],
+            "prediction": [0.0, 1.0, 1.0, 1.0],
+            "probability": [np.array([0.9, 0.1]), np.array([0.4, 0.6]),
+                            np.array([0.2, 0.8]), np.array([0.3, 0.7])],
+        })
+        stats = ComputeModelStatistics(
+            evaluationMetric="classification", scoresCol="probability"
+        ).transform(df).first()
+        assert stats["accuracy"] == 0.75
+        assert stats["AUC"] == 1.0  # probabilities perfectly rank the labels
+        cm = np.asarray(stats["confusion_matrix"])
+        assert cm.sum() == 4 and cm[0, 0] == 1 and cm[1, 1] == 2
+
+        per = ComputePerInstanceStatistics(
+            evaluationMetric="classification", scoresCol="probability"
+        ).transform(df)
+        assert "log_loss" in per.columns
+        np.testing.assert_allclose(per["log_loss"][0], -np.log(0.9), rtol=1e-6)
