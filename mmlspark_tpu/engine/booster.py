@@ -31,7 +31,7 @@ from mmlspark_tpu.engine import eval_metrics
 from mmlspark_tpu.engine.tree import (
     GrowConfig,
     Tree,
-    grow_tree,
+    grow_tree_auto,
     predict_tree_binned,
     predict_tree_leaf_binned,
 )
@@ -90,6 +90,7 @@ class TrainConfig:
     seed: int = 0
     tree_learner: str = "serial"
     top_k: int = 20
+    grow_policy: str = "lossguide"  # lossguide (LightGBM-exact) | depthwise
     hist_backend: str = "scatter"
     hist_chunk: int = DEFAULT_CHUNK
     verbosity: int = 1
@@ -597,6 +598,7 @@ def train(
         learning_rate=cfg.learning_rate if cfg.boosting != "rf" else 1.0,
         hist_backend=cfg.hist_backend,
         hist_chunk=chunk,
+        grow_policy=cfg.grow_policy,
     )
 
     def _grow_classes(gcfg_):
@@ -608,7 +610,7 @@ def train(
         def grow_all(bins_a, grad_a, hess_a, bag_a, fmask_a):
             def one(args):
                 g, h, fm = args
-                return grow_tree(gcfg_, bins_a, g, h, bag_a, fm)
+                return grow_tree_auto(gcfg_, bins_a, g, h, bag_a, fm)
 
             return jax.lax.map(one, (grad_a, hess_a, fmask_a))
 
@@ -632,9 +634,13 @@ def train(
             check_vma=False,
         )
 
+    # Device data enters the jitted step as ARGUMENTS, never closure
+    # captures: closed-over arrays become jaxpr constants and XLA spends
+    # minutes constant-folding through the 10s-of-MB binned matrix (75s →
+    # 8s compile observed at 262k×64).
     @jax.jit
-    def iteration(scores, key, bag_in):
-        grad, hess = obj.grad_hess(scores if K > 1 else scores[0], y_dev, w_dev)
+    def iteration(bins_a, y_a, w_a, vmask_a, scores, key, bag_in):
+        grad, hess = obj.grad_hess(scores if K > 1 else scores[0], y_a, w_a)
         if K == 1:
             grad, hess = grad[None, :], hess[None, :]
         gkey, fkey = jax.random.split(key)
@@ -644,20 +650,22 @@ def train(
         if cfg.boosting == "goss":
             # GOSS resamples every iteration from the current gradients.
             grad_abs = jnp.sum(jnp.abs(grad), axis=0)
-            bag = _bag_weights(gkey, cfg, valid_mask, grad_abs)
+            bag = _bag_weights(gkey, cfg, vmask_a, grad_abs)
         else:
             bag = bag_in
         fmask = jax.vmap(lambda k: _feature_mask(k, F, cfg.feature_fraction))(
             jax.random.split(fkey, K)
         )
-        tree, leaf_ids = grow(bins_dev, grad, hess, bag, fmask)
+        tree, leaf_ids = grow(bins_a, grad, hess, bag, fmask)
         delta = jax.vmap(lambda lv, li: lv[li])(tree.leaf_value, leaf_ids)
         return tree, delta
 
     # LightGBM bagging semantics: a bag is drawn at iterations where
     # ``it % bagging_freq == 0`` and *reused* until the next draw.
     resample_bag = jax.jit(
-        lambda key: _bag_weights(key, cfg, valid_mask, jnp.zeros(valid_mask.shape[0]))
+        lambda key, vmask_a: _bag_weights(
+            key, cfg, vmask_a, jnp.zeros(vmask_a.shape[0])
+        )
     )
     do_bagging = cfg.bagging_fraction < 1.0 and cfg.bagging_freq > 0
     full_bag = valid_mask.astype(jnp.float32)
@@ -703,13 +711,16 @@ def train(
     tree_weights: List[float] = []
     rng = np.random.default_rng(cfg.drop_seed)
     evals_result: Dict[str, Dict[str, List[float]]] = {nm: {metric_name: []} for nm in names}
-    key = jax.random.PRNGKey(cfg.bagging_seed + 7919 * cfg.seed)
+    # All per-iteration keys in one device call, pulled to host once: a
+    # jax.random.split per iteration is a dispatch round-trip each (adds up
+    # fast over remote-dispatch links).
+    root_key = jax.random.PRNGKey(cfg.bagging_seed + 7919 * cfg.seed)
+    all_keys = np.asarray(jax.random.split(root_key, 2 * cfg.num_iterations))
 
     for it in range(cfg.num_iterations):
-        key, sub = jax.random.split(key)
+        sub = all_keys[it]
         if do_bagging and it % cfg.bagging_freq == 0:
-            key, bag_key = jax.random.split(key)
-            current_bag = resample_bag(bag_key)
+            current_bag = resample_bag(all_keys[cfg.num_iterations + it], valid_mask)
         dropped_idx: List[int] = []
         if cfg.boosting == "dart" and trees_host and rng.random() >= cfg.skip_drop:
             mask = rng.random(len(trees_host)) < cfg.drop_rate
@@ -728,7 +739,9 @@ def train(
         else:
             train_scores = scores
 
-        tree, delta = iteration(train_scores, sub, current_bag)
+        tree, delta = iteration(
+            bins_dev, y_dev, w_dev, valid_mask, train_scores, sub, current_bag
+        )
 
         # boost_from_average bias folding into tree 0 (LightGBM AddBias).
         # Running scores already start at the init value, so the in-loop
@@ -794,12 +807,15 @@ def train(
             break
 
     # ---- stack trees (prepending the warm-start forest, if any) ---------
-    stacked = Tree(
-        *[
-            np.stack([np.asarray(getattr(t, f)) for t in trees_host], axis=0)
-            for f in Tree._fields
-        ]
-    )
+    # Stack on DEVICE in ONE jitted program, then one host transfer per
+    # field: pulling each tree's 8 small arrays separately costs a full
+    # dispatch round-trip per pull (~0.5s each through a remote-dispatch
+    # link — 400 pulls dominated wall-clock), and eager per-field stacks
+    # cost 8 separate remote compiles.
+    stacked_dev = jax.jit(
+        lambda ts: jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *ts)
+    )(trees_host)
+    stacked = Tree(*[np.asarray(a) for a in stacked_dev])
     weights = np.asarray(tree_weights)
     t_offset = 0
     if init_model is not None:

@@ -38,7 +38,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from mmlspark_tpu.ops.histogram import build_histogram
+from mmlspark_tpu.ops.histogram import build_histogram, build_histogram_by_leaf
 
 
 @dataclasses.dataclass(frozen=True)
@@ -61,6 +61,7 @@ class GrowConfig:
     hist_backend: str = "scatter"
     hist_chunk: int = 16_384
     axis_name: Optional[str] = None  # set under shard_map for psum
+    grow_policy: str = "lossguide"  # lossguide (LightGBM-exact) | depthwise
 
     @property
     def num_value_bins(self) -> int:
@@ -97,11 +98,12 @@ def _leaf_output(G, H, l1, l2, lr):
     return -_l1_threshold(G, l1) / (H + l2 + 1e-15) * lr
 
 
-def _best_split(cfg: GrowConfig, hists, leaf_stats, leaf_depth, num_leaves, feat_mask):
-    """Scan all (leaf, feature, threshold, missing-dir) candidates.
+def _leaf_candidates(cfg: GrowConfig, hists, leaf_stats, feat_mask):
+    """Best (feature, threshold, missing-dir) candidate PER LEAF.
 
     hists: (L, F, B, 3) with channels (Σgrad, Σhess, Σcount).
-    Returns (gain, leaf, feat, bin, default_left) of the best candidate.
+    Returns per-leaf (gain (L,), feat, bin, default_left); leaves with no
+    valid candidate get gain=-inf.
     """
     L, F, B, _ = hists.shape
     VB = B - 1
@@ -131,19 +133,29 @@ def _best_split(cfg: GrowConfig, hists, leaf_stats, leaf_depth, num_leaves, feat
         & (Hr >= cfg.min_sum_hessian_in_leaf)
     )
     valid &= feat_mask[None, :, None, None]
+
+    gain = jnp.where(valid, gain, -jnp.inf)
+    flat = gain.reshape(L, -1)
+    best = jnp.argmax(flat, axis=1)  # (L,)
+    best_gain = jnp.take_along_axis(flat, best[:, None], axis=1)[:, 0]
+    f, rem = jnp.divmod(best, VB * 2)
+    t, d = jnp.divmod(rem, 2)
+    return best_gain, f.astype(jnp.int32), t.astype(jnp.int32), d == 1
+
+
+def _best_split(cfg: GrowConfig, hists, leaf_stats, leaf_depth, num_leaves, feat_mask):
+    """Global best split over all leaves (lossguide step).
+
+    Returns (gain, leaf, feat, bin, default_left) of the best candidate.
+    """
+    L = hists.shape[0]
+    gain, f, t, d = _leaf_candidates(cfg, hists, leaf_stats, feat_mask)
     leaf_ok = jnp.arange(L) < num_leaves
     if cfg.max_depth > 0:
         leaf_ok &= leaf_depth < cfg.max_depth
-    valid &= leaf_ok[:, None, None, None]
-
-    gain = jnp.where(valid, gain, -jnp.inf)
-    flat = gain.reshape(-1)
-    best = jnp.argmax(flat)
-    best_gain = flat[best]
-    l, rem = jnp.divmod(best, F * VB * 2)
-    f, rem = jnp.divmod(rem, VB * 2)
-    t, d = jnp.divmod(rem, 2)
-    return best_gain, l.astype(jnp.int32), f.astype(jnp.int32), t.astype(jnp.int32), d == 1
+    gain = jnp.where(leaf_ok, gain, -jnp.inf)
+    l = jnp.argmax(gain).astype(jnp.int32)
+    return gain[l], l, f[l], t[l], d[l]
 
 
 def grow_tree(
@@ -239,6 +251,151 @@ def grow_tree(
         leaf_count=leaf_stats[:, 2],
     )
     return tree, leaf_ids
+
+
+def grow_tree_depthwise(
+    cfg: GrowConfig,
+    bins: jnp.ndarray,
+    grad: jnp.ndarray,
+    hess: jnp.ndarray,
+    bag_weight: jnp.ndarray,
+    feat_mask: jnp.ndarray,
+) -> Tuple[Tree, jnp.ndarray]:
+    """Level-synchronous growth: ONE per-leaf histogram pass per level.
+
+    The TPU-first answer to SURVEY.md §7.4.2: the lossguide grower rebuilds
+    a full-data histogram per split (O(n·F·num_leaves) per tree — the
+    measured 23x deficit vs CPU LightGBM), while this grower batches every
+    active leaf into one (L, F, B, 3) pass per level
+    (:func:`~mmlspark_tpu.ops.histogram.build_histogram_by_leaf`), so a
+    tree costs O(n·F·depth) — the same asymptotics LightGBM gets from its
+    dynamic row partitions, but with static shapes and a single psum per
+    level when data-parallel.
+
+    Split SEMANTICS per level are best-first: all active leaves propose
+    their best candidate, and the top-(remaining budget) by gain are
+    applied.  On balanced data this matches lossguide's tree; they diverge
+    only when the leaf budget runs out mid-level (lossguide can then favor
+    a deep chain).  The recorded Tree uses the identical step numbering, so
+    prediction replay and model-string export are unchanged.
+    """
+    n, F = bins.shape
+    B, L, S = cfg.num_bins, cfg.num_leaves, cfg.max_steps
+    bins = bins.astype(jnp.int32)
+    in_bag = (bag_weight > 0).astype(jnp.float32)
+    vals = jnp.stack(
+        [grad * bag_weight, hess * bag_weight, in_bag], axis=-1
+    ).astype(jnp.float32)
+
+    def hist_pass(leaf_ids):
+        return build_histogram_by_leaf(
+            bins, vals, leaf_ids, L, B,
+            backend=cfg.hist_backend, chunk=cfg.hist_chunk, axis_name=cfg.axis_name,
+        )
+
+    # Split-record arrays get one extra scratch slot (index S) that
+    # non-selected leaves harmlessly scatter into; trimmed at the end.
+    tree0 = Tree(
+        split_leaf=jnp.full(S + 1, -1, jnp.int32),
+        split_feat=jnp.zeros(S + 1, jnp.int32),
+        split_bin=jnp.zeros(S + 1, jnp.int32),
+        default_left=jnp.zeros(S + 1, bool),
+        split_gain=jnp.zeros(S + 1, jnp.float32),
+        leaf_value=jnp.zeros(L, jnp.float32),
+        leaf_count=jnp.zeros(L, jnp.float32),
+        num_leaves=jnp.asarray(1, jnp.int32),
+    )
+    leaf_arange = jnp.arange(L, dtype=jnp.int32)
+
+    def cond(carry):
+        return ~carry[-1]
+
+    def level(carry):
+        leaf_ids, tree, leaf_depth, step, _ = carry
+        cur_leaves = tree.num_leaves
+        hists = hist_pass(leaf_ids)  # (L, F, B, 3)
+        leaf_stats = hists[:, 0].sum(axis=1)  # feature 0's bins tile all rows
+        gain, f, t, dleft = _leaf_candidates(cfg, hists, leaf_stats, feat_mask)
+        leaf_ok = leaf_arange < cur_leaves
+        if cfg.max_depth > 0:
+            leaf_ok &= leaf_depth < cfg.max_depth
+        gain = jnp.where(leaf_ok, gain, -jnp.inf)
+        valid = gain > cfg.min_gain_to_split
+
+        # Best-first selection within the level, capped by the leaf budget.
+        budget = L - cur_leaves
+        order = jnp.argsort(-gain)
+        rank = jnp.argsort(order)  # gain-desc rank of each leaf
+        selected = valid & (rank < budget)
+        k = jnp.sum(selected).astype(jnp.int32)
+        # step id per selected leaf, in gain order (0-based among selected)
+        sel_rank = (jnp.cumsum(selected[order]) - 1)[rank]
+        step_of_leaf = jnp.where(selected, step + sel_rank.astype(jnp.int32), S)
+        new_id_of_leaf = (step_of_leaf + 1).astype(jnp.int32)  # right-child ids
+
+        # -- per-row moves (one gather per row on its leaf's split) -------
+        sel_row = selected[leaf_ids]
+        f_row = f[leaf_ids]
+        fcol = jnp.take_along_axis(bins, f_row[:, None], axis=1)[:, 0]
+        is_missing = fcol == (B - 1)
+        goes_left = jnp.where(is_missing, dleft[leaf_ids], fcol <= t[leaf_ids])
+        move = sel_row & ~goes_left
+        leaf_ids = jnp.where(move, new_id_of_leaf[leaf_ids], leaf_ids)
+
+        # -- record the level's splits (scratch slot S absorbs the rest) --
+        tree = tree._replace(
+            split_leaf=tree.split_leaf.at[step_of_leaf].set(
+                jnp.where(selected, leaf_arange, -1)
+            ),
+            split_feat=tree.split_feat.at[step_of_leaf].set(f),
+            split_bin=tree.split_bin.at[step_of_leaf].set(t),
+            default_left=tree.default_left.at[step_of_leaf].set(selected & dleft),
+            split_gain=tree.split_gain.at[step_of_leaf].set(
+                jnp.where(selected, gain, 0.0)
+            ),
+            num_leaves=cur_leaves + k,
+        )
+        child_depth = leaf_depth + 1
+        # right children (out-of-bounds ids for non-selected are dropped)
+        leaf_depth = leaf_depth.at[new_id_of_leaf].set(
+            jnp.where(selected, child_depth, 0), mode="drop"
+        )
+        leaf_depth = jnp.where(selected, child_depth, leaf_depth)
+
+        stop = (k == 0) | (tree.num_leaves >= L)
+        return (leaf_ids, tree, leaf_depth, step + k, stop)
+
+    carry = (
+        jnp.zeros(n, jnp.int32), tree0, jnp.zeros(L, jnp.int32),
+        jnp.asarray(0, jnp.int32), jnp.asarray(False),
+    )
+    leaf_ids, tree, leaf_depth, _, _ = lax.while_loop(cond, level, carry)
+
+    # Final per-leaf (G, H, count) in one cheap segment-sum.
+    leaf_stats = jnp.zeros((L, 3), jnp.float32).at[leaf_ids].add(vals, mode="drop")
+    if cfg.axis_name is not None:
+        leaf_stats = lax.psum(leaf_stats, cfg.axis_name)
+    leaf_value = _leaf_output(
+        leaf_stats[:, 0], leaf_stats[:, 1], cfg.lambda_l1, cfg.lambda_l2,
+        cfg.learning_rate,
+    )
+    active = leaf_arange < tree.num_leaves
+    tree = tree._replace(
+        split_leaf=tree.split_leaf[:S],
+        split_feat=tree.split_feat[:S],
+        split_bin=tree.split_bin[:S],
+        default_left=tree.default_left[:S],
+        split_gain=tree.split_gain[:S],
+        leaf_value=jnp.where(active, leaf_value, 0.0),
+        leaf_count=leaf_stats[:, 2],
+    )
+    return tree, leaf_ids
+
+
+def grow_tree_auto(cfg: GrowConfig, *args):
+    if cfg.grow_policy == "depthwise":
+        return grow_tree_depthwise(cfg, *args)
+    return grow_tree(cfg, *args)
 
 
 def predict_tree_binned(tree: Tree, bins: jnp.ndarray, num_bins: int) -> jnp.ndarray:

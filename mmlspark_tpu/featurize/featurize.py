@@ -64,7 +64,21 @@ class Featurize(Estimator, _FeaturizeParams):
                         "levels": levels,
                     })
                 else:
-                    plan.append({"col": c, "kind": "hash", "n": min(self.getNumFeatures(), 1 << 18)})
+                    # Dense assembly: a 262144-wide default would allocate
+                    # n_rows × 2 MiB; cap the hashed width and say so.
+                    nf = self.getNumFeatures()
+                    cap = 1 << 12
+                    if nf > cap:
+                        import warnings
+
+                        warnings.warn(
+                            f"Featurize hashes text column {c!r} into a DENSE "
+                            f"vector; clamping numFeatures {nf} -> {cap} to "
+                            f"bound memory (use TextFeaturizer directly for "
+                            f"wider spaces)"
+                        )
+                        nf = cap
+                    plan.append({"col": c, "kind": "hash", "n": nf})
         model = FeaturizeModel(outputCol=self.getOutputCol())
         model._paramMap["plan"] = plan
         return model

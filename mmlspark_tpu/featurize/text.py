@@ -69,7 +69,9 @@ class _TextParams(Params):
     stopWords = Param("stopWords", "Stop word list", default=None)
     useNGram = Param("useNGram", "Add n-grams", default=False, dtype=bool)
     nGramLength = Param("nGramLength", "n-gram length", default=2, dtype=int)
-    numFeatures = Param("numFeatures", "Hash buckets", default=1 << 18, dtype=int)
+    # Vectors here are DENSE numpy rows (8·numFeatures bytes per row), so
+    # the default is far below Spark HashingTF's sparse 2^20.
+    numFeatures = Param("numFeatures", "Hash buckets", default=1 << 12, dtype=int)
     binary = Param("binary", "Binary term counts", default=False, dtype=bool)
     useIDF = Param("useIDF", "Rescale with inverse document frequency", default=True, dtype=bool)
     minDocFreq = Param("minDocFreq", "Min docs for a term to count", default=1, dtype=int)
@@ -137,6 +139,13 @@ class TextFeaturizerModel(Model, _TextParams):
     idfVector = ComplexParam("idfVector", "Fitted IDF weights", default=None)
 
     def _transform(self, df: DataFrame) -> DataFrame:
+        est_bytes = df.count() * self.getNumFeatures() * 8
+        if est_bytes > 2 << 30:
+            raise MemoryError(
+                f"TextFeaturizer would materialize ~{est_bytes >> 30} GiB of "
+                f"dense vectors ({df.count()} rows x {self.getNumFeatures()} "
+                f"buckets); lower numFeatures or batch the DataFrame"
+            )
         idf = self.getIdfVector() if self.getUseIDF() else None
         out = []
         for text in df[self.getInputCol()]:

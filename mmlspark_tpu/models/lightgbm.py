@@ -138,6 +138,13 @@ class _LightGBMParams(
     leafPredictionCol = Param("leafPredictionCol", "Output column of leaf indices", default="", dtype=str)
     modelString = Param("modelString", "Warm-start model string", default="", dtype=str)
     seed = Param("seed", "Master random seed", default=0, dtype=int)
+    growPolicy = Param(
+        "growPolicy",
+        "lossguide (LightGBM-exact leaf-wise) | depthwise (level-batched "
+        "histograms — the fast TPU path, one pass per level)",
+        default="lossguide", dtype=str,
+        validator=ParamValidators.inList(["lossguide", "depthwise"]),
+    )
 
     def _train_params(self, num_class: int = 1) -> dict:
         """Flatten the param surface into the engine's LightGBM-vocabulary
@@ -178,6 +185,7 @@ class _LightGBMParams(
         }[self.getParallelism()]
         p["tree_learner"] = learner
         p["top_k"] = self.getTopK()
+        p["grow_policy"] = self.getGrowPolicy()
         return p
 
     def _num_workers(self, df: DataFrame) -> int:

@@ -107,3 +107,68 @@ def build_histogram(
     if axis_name is not None:
         hist = lax.psum(hist, axis_name)
     return hist
+
+
+def _scatter_hist_by_leaf_chunk(bins_c, vals_c, leaf_c, num_leaves: int, num_bins: int):
+    """(C, F) bins + (C, 3) vals + (C,) leaf ids → (L, F, B, 3) scatter-add."""
+    C, F = bins_c.shape
+    base = leaf_c.astype(jnp.int32)[:, None] * (F * num_bins)
+    idx = base + jnp.arange(F, dtype=jnp.int32)[None, :] * num_bins + bins_c.astype(jnp.int32)
+    contrib = jnp.broadcast_to(vals_c[:, None, :], (C, F, 3)).reshape(C * F, 3)
+    flat = jnp.zeros((num_leaves * F * num_bins, 3), jnp.float32).at[
+        idx.reshape(-1)
+    ].add(contrib)
+    return flat.reshape(num_leaves, F, num_bins, 3)
+
+
+def build_histogram_by_leaf(
+    bins: jnp.ndarray,
+    vals: jnp.ndarray,
+    leaf_ids: jnp.ndarray,
+    num_leaves: int,
+    num_bins: int,
+    backend: str = "scatter",
+    chunk: int = DEFAULT_CHUNK,
+    axis_name: Optional[str] = None,
+) -> jnp.ndarray:
+    """Per-leaf histograms in ONE pass over the data: (L, F, B, 3).
+
+    The depthwise grower's workhorse (SURVEY.md §7.4.2): instead of one
+    full-data masked pass per split (O(n·F) × num_leaves per tree), every
+    level rebuilds all leaves' histograms together, so a tree costs
+    O(n·F · depth).  Rows to exclude (out of bag / padding) must arrive
+    with ``leaf_ids`` set to a parking slot ≥ ``num_leaves`` or zeroed
+    ``vals``.  With ``axis_name``, the result is psum-med across the mesh —
+    the same single-collective structure as :func:`build_histogram`.
+    """
+    n, F = bins.shape
+    vals = vals.astype(jnp.float32)
+    if backend == "pallas":
+        from mmlspark_tpu.ops.pallas_hist import pallas_hist_by_leaf_chunk
+
+        fn = pallas_hist_by_leaf_chunk
+    elif backend in ("scatter", "onehot"):
+        fn = _scatter_hist_by_leaf_chunk
+    else:
+        raise ValueError(
+            f"unknown hist backend {backend!r}; expected scatter|onehot|pallas"
+        )
+    if n <= chunk:
+        hist = fn(bins, vals, leaf_ids, num_leaves, num_bins)
+    else:
+        if n % chunk != 0:
+            raise ValueError(f"row count {n} not a multiple of chunk {chunk}")
+        bc = bins.reshape(n // chunk, chunk, F)
+        vc = vals.reshape(n // chunk, chunk, 3)
+        lc = leaf_ids.reshape(n // chunk, chunk)
+
+        def body(acc, xs):
+            b, v, l = xs
+            return acc + fn(b, v, l, num_leaves, num_bins), None
+
+        hist, _ = lax.scan(
+            body, jnp.zeros((num_leaves, F, num_bins, 3), jnp.float32), (bc, vc, lc)
+        )
+    if axis_name is not None:
+        hist = lax.psum(hist, axis_name)
+    return hist

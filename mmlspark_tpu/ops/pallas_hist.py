@@ -123,3 +123,133 @@ def pallas_hist_chunk(
 
 def _round_up(x: int, m: int) -> int:
     return ((x + m - 1) // m) * m
+
+
+# ---------------------------------------------------------------------------
+# Per-leaf histograms (depthwise grower): hist[l, f, b, c] in one data pass.
+#
+# Contraction: out[fb, l·3+c] = Σ_r onehot_bins[fb, r] · (vals[r, c] ·
+# onehot_leaf[r, l]).  The leaf axis multiplies the matmul's tiny N=3
+# channel dimension up to 3·L — at L=64 that is N=192, which finally feeds
+# the 128-wide MXU properly (the single-leaf kernel idles ~97% of it).
+# ---------------------------------------------------------------------------
+def _hist_leaf_kernel(
+    bins_ref, vals_ref, leaf_ref, out_ref, *, num_bins: int, num_leaves: int, rm: int
+):
+    """One (feature-block j, row-block i) cell.
+
+    The row block (bm) is deliberately LARGE with an in-kernel
+    accumulation loop over ``rm``-row sub-blocks: the one-hot tile only
+    ever exists at (bf·B, rm) in VMEM, while the grid stays coarse — at
+    bm=rm the grid overhead of ~8k tiny cells dominated the pass (178ms
+    measured for a 262k×64 pass that is ~5ms of MXU work).
+    """
+    i = pl.program_id(1)  # row block, innermost → accumulation is safe
+    bf, bm = bins_ref.shape
+
+    def sub(s, acc):
+        sl = pl.ds(s * rm, rm)
+        bins = bins_ref[:, sl]  # (bf, rm) int32
+        vals = vals_ref[sl, :]  # (rm, 3) f32
+        leaf = leaf_ref[0, sl]  # (rm,) int32
+        iota_b = jax.lax.broadcasted_iota(jnp.int32, (bf, num_bins, rm), 1)
+        oh_bins = (iota_b == bins[:, None, :]).astype(jnp.float32)
+        oh_bins = oh_bins.reshape(bf * num_bins, rm)
+        # Leaf-masked values, channel-major columns: rhs[r, c·L + l] =
+        # vals[r, c] · (leaf[r] == l).  Three lane-dim concats because
+        # Mosaic cannot lane-merge a trailing (L, 3) pair.  Rows parked at
+        # leaf >= num_leaves (out-of-bag/padding) match no slot → 0.
+        iota_l = jax.lax.broadcasted_iota(jnp.int32, (rm, num_leaves), 1)
+        oh_leaf = (iota_l == leaf[:, None]).astype(jnp.float32)
+        rhs = jnp.concatenate(
+            [oh_leaf * vals[:, c][:, None] for c in range(3)], axis=1
+        )  # (rm, 3·L)
+        # Output (3·L, bf·B): the small 3·L axis on SUBLANES (pads to a
+        # multiple of 8) and the big bf·B axis on lanes — the transposed
+        # orientation padded 3·L up to 256 lanes and blew the 16M VMEM
+        # budget through the grid-resident accumulator tile.
+        return acc + jax.lax.dot_general(
+            rhs, oh_bins,
+            dimension_numbers=(((0,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+            precision=jax.lax.Precision.HIGHEST,
+        )  # (3·L, bf·B)
+
+    part = jax.lax.fori_loop(
+        0, bm // rm, sub,
+        jnp.zeros((3 * num_leaves, bf * num_bins), jnp.float32),
+    )
+
+    @pl.when(i == 0)
+    def _init():
+        out_ref[...] = part[None]
+
+    @pl.when(i > 0)
+    def _acc():
+        out_ref[...] += part[None]
+
+
+@functools.partial(
+    jax.jit, static_argnames=("num_leaves", "num_bins", "bm", "bf", "rm", "interpret")
+)
+def _pallas_hist_by_leaf(bins_t, vals, leaf_ids, num_leaves, num_bins, bm, bf, rm, interpret):
+    F, n = bins_t.shape
+    kernel = functools.partial(
+        _hist_leaf_kernel, num_bins=num_bins, num_leaves=num_leaves, rm=rm
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid=(F // bf, n // bm),
+        in_specs=[
+            pl.BlockSpec((bf, bm), lambda j, i: (j, i)),
+            pl.BlockSpec((bm, 3), lambda j, i: (i, 0)),
+            pl.BlockSpec((1, bm), lambda j, i: (0, i)),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, num_leaves * 3, bf * num_bins), lambda j, i: (j, 0, 0)
+        ),
+        out_shape=jax.ShapeDtypeStruct(
+            (F // bf, num_leaves * 3, bf * num_bins), jnp.float32
+        ),
+        interpret=interpret,
+    )(bins_t, vals, leaf_ids)
+    # (F/bf, 3·L, bf·B) channel-major → (L, F, B, 3)
+    out = out.reshape(F // bf, 3, num_leaves, bf, num_bins)
+    return out.transpose(2, 0, 3, 4, 1).reshape(num_leaves, F, num_bins, 3)
+
+
+def pallas_hist_by_leaf_chunk(
+    bins_c, vals_c, leaf_c, num_leaves: int, num_bins: int,
+    bm: int = 4096, bf: int = 8, rm: int = 256,
+) -> jnp.ndarray:
+    """(C, F) bins + (C, 3) vals + (C,) leaf ids → (L, F, B, 3).
+
+    ``rm`` bounds the VMEM one-hot tile (rm=256 keeps it under the 16M
+    scoped limit with B=256); ``bm`` is the DMA/grid granularity.
+    """
+    import jax as _jax
+
+    backend = _jax.default_backend()
+    if backend not in ("cpu", "tpu"):
+        raise NotImplementedError(
+            f"hist_backend='pallas' supports tpu/cpu backends, not {backend!r}"
+        )
+    C, F = bins_c.shape
+    bins_t = bins_c.astype(jnp.int32).T
+    vals_c = vals_c.astype(jnp.float32)
+    leaf_row = leaf_c.astype(jnp.int32)[None, :]  # (1, C): lane-friendly
+    bm = min(bm, _round_up(C, rm))
+    rm = min(rm, bm)
+    pad_r = (-C) % bm
+    pad_f = (-F) % bf
+    if pad_r:
+        bins_t = jnp.pad(bins_t, ((0, 0), (0, pad_r)))
+        vals_c = jnp.pad(vals_c, ((0, pad_r), (0, 0)))
+        # padded rows park at leaf == num_leaves → no one-hot slot
+        leaf_row = jnp.pad(leaf_row, ((0, 0), (0, pad_r)), constant_values=num_leaves)
+    if pad_f:
+        bins_t = jnp.pad(bins_t, ((0, pad_f), (0, 0)))
+    out = _pallas_hist_by_leaf(
+        bins_t, vals_c, leaf_row, num_leaves, num_bins, bm, bf, rm, backend == "cpu"
+    )
+    return out[:, :F]

@@ -10,7 +10,7 @@ import numpy as np
 import pandas as pd
 
 from mmlspark_tpu.core.frame import DataFrame
-from mmlspark_tpu.core.params import ComplexParam, Param, Params
+from mmlspark_tpu.core.params import ComplexParam, Param, ParamValidators, Params
 from mmlspark_tpu.core.pipeline import Estimator, Model, Transformer
 from mmlspark_tpu.core.registry import register_stage
 
@@ -262,6 +262,7 @@ class StratifiedRepartition(Transformer):
     labelCol = Param("labelCol", "Label column", default="label", dtype=str)
     mode = Param(
         "mode", "native|equal|mixed", default="native", dtype=str,
+        validator=ParamValidators.inList(["native", "equal", "mixed"]),
     )
     seed = Param("seed", "Random seed", default=0, dtype=int)
 
@@ -270,13 +271,21 @@ class StratifiedRepartition(Transformer):
         pdf = df.toPandas()
         labels = pdf[self.getLabelCol()].to_numpy()
         n_part = df.num_partitions
-        if self.getMode() == "equal":
+        mode = self.getMode()
+        if mode in ("equal", "mixed"):
             vals, counts = np.unique(labels, return_counts=True)
-            target = int(counts.max())
+            # equal: every label up to the max count; mixed: cap the
+            # imbalance ratio at 10:1 (rare labels resampled up to max/10).
+            target_of = {
+                v: int(counts.max()) if mode == "equal"
+                else max(int(c), int(np.ceil(counts.max() / 10)))
+                for v, c in zip(vals, counts)
+            }
             idx: List[int] = []
             for v in vals:
                 rows = np.flatnonzero(labels == v)
-                idx.extend(rng.choice(rows, target, replace=len(rows) < target))
+                t = target_of[v]
+                idx.extend(rng.choice(rows, t, replace=len(rows) < t))
             pdf = pdf.iloc[idx].reset_index(drop=True)
             labels = pdf[self.getLabelCol()].to_numpy()
         # Round-robin each stratum over partition slots, then order by slot:

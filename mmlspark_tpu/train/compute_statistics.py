@@ -33,14 +33,14 @@ class MetricConstants:
 
 
 def _auc_score(y: np.ndarray, p: np.ndarray) -> float:
-    order = np.argsort(p)
-    ranks = np.empty(len(p))
-    ranks[order] = np.arange(1, len(p) + 1)
     pos = y > 0
-    n1, n0 = int(pos.sum()), int((~pos).sum())
-    if n1 == 0 or n0 == 0:
+    if bool(pos.all()) or not bool(pos.any()):
         return float("nan")
-    return float((ranks[pos].sum() - n1 * (n1 + 1) / 2) / (n1 * n0))
+    # Tie-correct rank AUC (sequential ranks over tied scores give order-
+    # dependent garbage — e.g. constant predictions score 0.0 or 1.0).
+    from mmlspark_tpu.engine.eval_metrics import auc as _engine_auc
+
+    return _engine_auc(y, p)
 
 
 @register_stage
