@@ -63,8 +63,16 @@ _LAZY = {
     "VowpalWabbitInteractions": "mmlspark_tpu.models.vw",
     "SAR": "mmlspark_tpu.models.sar",
     "SARModel": "mmlspark_tpu.models.sar",
+    "RecommendationIndexer": "mmlspark_tpu.models.sar",
+    "RankingAdapter": "mmlspark_tpu.models.sar",
+    "RankingEvaluator": "mmlspark_tpu.models.sar",
+    "RankingTrainValidationSplit": "mmlspark_tpu.models.sar",
     "KNN": "mmlspark_tpu.models.knn",
     "ConditionalKNN": "mmlspark_tpu.models.knn",
+    "IsolationForest": "mmlspark_tpu.models.isolation_forest",
+    "TabularLIME": "mmlspark_tpu.explain.lime",
+    "ImageLIME": "mmlspark_tpu.explain.lime",
+    "SuperpixelTransformer": "mmlspark_tpu.explain.superpixel",
 }
 
 

@@ -1,0 +1,6 @@
+"""Model explainability (reference: ``cms.lime`` — SURVEY.md §2.7)."""
+
+from mmlspark_tpu.explain.lime import ImageLIME, TabularLIME, TabularLIMEModel
+from mmlspark_tpu.explain.superpixel import Superpixel, SuperpixelTransformer
+
+__all__ = ["ImageLIME", "TabularLIME", "TabularLIMEModel", "Superpixel", "SuperpixelTransformer"]

@@ -1,0 +1,2 @@
+"""IO: HTTP-on-Spark equivalents + serving (reference: ``cms.io`` —
+SURVEY.md §2.6)."""

@@ -1,0 +1,18 @@
+"""HTTP-on-Spark: request/response structs, batched async HTTP transformers,
+JSON convenience layer, and serving (reference: UPSTREAM:.../io/http/ —
+SURVEY.md §2.6)."""
+
+from mmlspark_tpu.io.http.http_schema import HTTPRequestData, HTTPResponseData
+from mmlspark_tpu.io.http.http_transformer import (
+    HTTPTransformer,
+    JSONInputParser,
+    JSONOutputParser,
+    SimpleHTTPTransformer,
+)
+from mmlspark_tpu.io.http.serving import HTTPServer as ServingServer
+
+__all__ = [
+    "HTTPRequestData", "HTTPResponseData", "HTTPTransformer",
+    "JSONInputParser", "JSONOutputParser", "SimpleHTTPTransformer",
+    "ServingServer",
+]

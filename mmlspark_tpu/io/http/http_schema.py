@@ -1,0 +1,63 @@
+"""HTTP request/response as structured data (reference: ``HTTPSchema`` —
+UPSTREAM:.../io/http/HTTPSchema.scala, SURVEY.md §2.6: "HTTPRequestData/
+HTTPResponseData as Spark SQL structs (full to/from Row codecs)")."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, Optional
+
+
+@dataclass
+class HTTPRequestData:
+    url: str
+    method: str = "GET"
+    headers: Dict[str, str] = field(default_factory=dict)
+    entity: Optional[bytes] = None
+
+    def to_row(self) -> dict:
+        return {
+            "requestLine": {"method": self.method, "uri": self.url},
+            "headers": [{"name": k, "value": v} for k, v in self.headers.items()],
+            "entity": {"content": self.entity} if self.entity is not None else None,
+        }
+
+    @staticmethod
+    def from_row(row: dict) -> "HTTPRequestData":
+        rl = row.get("requestLine", {})
+        headers = {h["name"]: h["value"] for h in row.get("headers", [])}
+        entity = (row.get("entity") or {}).get("content")
+        if isinstance(entity, str):
+            entity = entity.encode()
+        return HTTPRequestData(
+            url=rl.get("uri", ""), method=rl.get("method", "GET"),
+            headers=headers, entity=entity,
+        )
+
+
+@dataclass
+class HTTPResponseData:
+    statusCode: int
+    statusReason: str = ""
+    headers: Dict[str, str] = field(default_factory=dict)
+    entity: Optional[bytes] = None
+
+    def to_row(self) -> dict:
+        return {
+            "statusLine": {"statusCode": self.statusCode, "reasonPhrase": self.statusReason},
+            "headers": [{"name": k, "value": v} for k, v in self.headers.items()],
+            "entity": {"content": self.entity} if self.entity is not None else None,
+        }
+
+    @staticmethod
+    def from_row(row: dict) -> "HTTPResponseData":
+        sl = row.get("statusLine", {})
+        entity = (row.get("entity") or {}).get("content")
+        if isinstance(entity, str):
+            entity = entity.encode()
+        return HTTPResponseData(
+            statusCode=sl.get("statusCode", 0),
+            statusReason=sl.get("reasonPhrase", ""),
+            headers={h["name"]: h["value"] for h in row.get("headers", [])},
+            entity=entity,
+        )

@@ -1,0 +1,172 @@
+"""HTTPTransformer + the JSON convenience layer.
+
+Reference parity (SURVEY.md §2.6): ``HTTPTransformer`` maps a request
+column → response column through a shared async client with ``concurrency``
+in-flight requests and a 429-aware retry/backoff handler
+(UPSTREAM:.../io/http/{HTTPTransformer,HandlingUtils}.scala);
+``SimpleHTTPTransformer`` is JSON-in/JSON-out with an error column
+(UPSTREAM:.../io/http/SimpleHTTPTransformer.scala).
+
+stdlib ``urllib`` + a thread pool stand in for Apache HttpClient — request
+parallelism is I/O bound, so threads suffice (the GIL releases on socket
+waits), matching the reference's N-in-flight-per-partition semantics.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+import urllib.error
+import urllib.request
+from concurrent.futures import ThreadPoolExecutor
+from typing import Any, Dict, List, Optional
+
+from mmlspark_tpu.core.frame import DataFrame
+from mmlspark_tpu.core.params import ComplexParam, HasInputCol, HasOutputCol, Param
+from mmlspark_tpu.core.pipeline import Transformer
+from mmlspark_tpu.core.registry import register_stage
+from mmlspark_tpu.io.http.http_schema import HTTPRequestData, HTTPResponseData
+
+# Backoff schedule on 429/5xx (reference: HandlingUtils' advancedUDF
+# backoff list, milliseconds).
+DEFAULT_BACKOFFS_MS = (100, 500, 1000)
+
+
+def send_with_retries(
+    req: HTTPRequestData,
+    timeout: float = 60.0,
+    backoffs_ms=DEFAULT_BACKOFFS_MS,
+) -> HTTPResponseData:
+    attempt = 0
+    while True:
+        try:
+            r = urllib.request.Request(
+                req.url, data=req.entity, headers=req.headers, method=req.method
+            )
+            with urllib.request.urlopen(r, timeout=timeout) as resp:
+                return HTTPResponseData(
+                    statusCode=resp.status,
+                    statusReason=getattr(resp, "reason", ""),
+                    headers=dict(resp.headers.items()),
+                    entity=resp.read(),
+                )
+        except urllib.error.HTTPError as e:
+            code = e.code
+            if code == 429 or code >= 500:
+                if attempt < len(backoffs_ms):
+                    time.sleep(backoffs_ms[attempt] / 1000.0)
+                    attempt += 1
+                    continue
+            return HTTPResponseData(
+                statusCode=code, statusReason=str(e.reason),
+                headers=dict(e.headers.items()) if e.headers else {},
+                entity=e.read() if hasattr(e, "read") else None,
+            )
+        except Exception as e:  # connection errors → synthetic 0 status
+            if attempt < len(backoffs_ms):
+                time.sleep(backoffs_ms[attempt] / 1000.0)
+                attempt += 1
+                continue
+            return HTTPResponseData(statusCode=0, statusReason=repr(e))
+
+
+@register_stage
+class HTTPTransformer(Transformer, HasInputCol, HasOutputCol):
+    concurrency = Param("concurrency", "In-flight requests", default=1, dtype=int)
+    concurrentTimeout = Param("concurrentTimeout", "Per-request timeout (s)", default=60.0, dtype=float)
+    backoffs = Param("backoffs", "Retry backoffs in ms", default=list(DEFAULT_BACKOFFS_MS))
+
+    def _transform(self, df: DataFrame) -> DataFrame:
+        reqs = [
+            r if isinstance(r, HTTPRequestData) else HTTPRequestData.from_row(r)
+            for r in df[self.getInputCol()]
+        ]
+        timeout = self.getConcurrentTimeout()
+        backoffs = tuple(self.getBackoffs())
+        with ThreadPoolExecutor(max_workers=max(1, self.getConcurrency())) as pool:
+            responses = list(
+                pool.map(lambda r: send_with_retries(r, timeout, backoffs), reqs)
+            )
+        return df.withColumn(self.getOutputCol(), [r.to_row() for r in responses])
+
+
+@register_stage
+class JSONInputParser(Transformer, HasInputCol, HasOutputCol):
+    """Column value → HTTPRequestData with a JSON body (reference:
+    UPSTREAM:.../io/http/parsers: JSONInputParser)."""
+
+    url = Param("url", "Target URL", dtype=str)
+    method = Param("method", "HTTP method", default="POST", dtype=str)
+    headers = Param("headers", "Extra headers", default=None)
+
+    def _transform(self, df: DataFrame) -> DataFrame:
+        headers = {"Content-Type": "application/json", **(self.getHeaders() or {})}
+        out = []
+        for v in df[self.getInputCol()]:
+            body = json.dumps(v, default=_json_fallback).encode()
+            out.append(
+                HTTPRequestData(
+                    url=self.getUrl(), method=self.getMethod(),
+                    headers=dict(headers), entity=body,
+                ).to_row()
+            )
+        return df.withColumn(self.getOutputCol(), out)
+
+
+@register_stage
+class JSONOutputParser(Transformer, HasInputCol, HasOutputCol):
+    """HTTPResponseData → parsed JSON column (errors → None)."""
+
+    def _transform(self, df: DataFrame) -> DataFrame:
+        out = []
+        for row in df[self.getInputCol()]:
+            resp = row if isinstance(row, HTTPResponseData) else HTTPResponseData.from_row(row)
+            try:
+                out.append(json.loads(resp.entity.decode()) if resp.entity else None)
+            except (ValueError, AttributeError):
+                out.append(None)
+        return df.withColumn(self.getOutputCol(), out)
+
+
+@register_stage
+class SimpleHTTPTransformer(Transformer, HasInputCol, HasOutputCol):
+    """JSON-in → HTTP → JSON-out, with an error column for non-2xx rows."""
+
+    url = Param("url", "Target URL", dtype=str)
+    method = Param("method", "HTTP method", default="POST", dtype=str)
+    headers = Param("headers", "Extra headers", default=None)
+    concurrency = Param("concurrency", "In-flight requests", default=1, dtype=int)
+    concurrentTimeout = Param("concurrentTimeout", "Per-request timeout (s)", default=60.0, dtype=float)
+    errorCol = Param("errorCol", "Error output column", default="errors", dtype=str)
+    flattenOutputBatches = Param("flattenOutputBatches", "unused (API parity)", default=False, dtype=bool)
+
+    def _transform(self, df: DataFrame) -> DataFrame:
+        mk = JSONInputParser(
+            inputCol=self.getInputCol(), outputCol="__req", url=self.getUrl(),
+            method=self.getMethod(), headers=self.getHeaders(),
+        )
+        http = HTTPTransformer(
+            inputCol="__req", outputCol="__resp",
+            concurrency=self.getConcurrency(),
+            concurrentTimeout=self.getConcurrentTimeout(),
+        )
+        parse = JSONOutputParser(inputCol="__resp", outputCol=self.getOutputCol())
+        out = parse.transform(http.transform(mk.transform(df)))
+        errors = []
+        for row in out["__resp"]:
+            code = row["statusLine"]["statusCode"]
+            errors.append(
+                None if 200 <= code < 300 else
+                {"statusCode": code, "reason": row["statusLine"]["reasonPhrase"]}
+            )
+        return out.withColumn(self.getErrorCol(), errors).drop("__req", "__resp")
+
+
+def _json_fallback(o):
+    import numpy as np
+
+    if isinstance(o, np.ndarray):
+        return o.tolist()
+    if isinstance(o, (np.integer, np.floating)):
+        return o.item()
+    raise TypeError(f"not JSON serializable: {type(o).__name__}")

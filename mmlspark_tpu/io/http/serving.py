@@ -1,0 +1,137 @@
+"""Serving: turn a pipeline into a web service.
+
+Reference parity (SURVEY.md §2.6 "Spark Serving", §3.4 request lifecycle):
+``HTTPSource``/``DistributedHTTPSource`` embed an ``HttpServer`` whose
+requests become rows; the pipeline transforms a micro-batch; ``HTTPSink``
+correlates replies by request id (UPSTREAM:
+src/main/scala/org/apache/spark/sql/execution/streaming/*).
+
+Here the same lifecycle runs over stdlib ``http.server``: requests are
+queued as (id, HTTPRequestData) rows; :meth:`HTTPServer.get_batch` drains a
+micro-batch into a DataFrame; :meth:`HTTPServer.reply` sends responses by
+id.  ``serve_transformer`` wires a Transformer into that loop — model
+inference then batches whole micro-batches through one jitted call
+(SURVEY.md §3.3), which is the serving win on TPU.
+"""
+
+from __future__ import annotations
+
+import json
+import queue
+import threading
+import uuid
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable, Dict, Optional
+
+from mmlspark_tpu.core.frame import DataFrame
+from mmlspark_tpu.io.http.http_schema import HTTPRequestData, HTTPResponseData
+
+
+class HTTPServer:
+    """Micro-batch HTTP source/sink pair on one port."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0, api_path: str = "/"):
+        self._requests: "queue.Queue" = queue.Queue()
+        self._responders: Dict[str, threading.Event] = {}
+        self._responses: Dict[str, HTTPResponseData] = {}
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):  # quiet
+                pass
+
+            def _handle(self, method):
+                length = int(self.headers.get("Content-Length") or 0)
+                body = self.rfile.read(length) if length else None
+                rid = str(uuid.uuid4())
+                req = HTTPRequestData(
+                    url=self.path, method=method,
+                    headers=dict(self.headers.items()), entity=body,
+                )
+                ev = threading.Event()
+                outer._responders[rid] = ev
+                outer._requests.put((rid, req))
+                if not ev.wait(timeout=60.0):
+                    self.send_response(504)
+                    self.end_headers()
+                    return
+                resp = outer._responses.pop(rid)
+                self.send_response(resp.statusCode or 200)
+                for k, v in resp.headers.items():
+                    if k.lower() not in ("content-length", "date", "server"):
+                        self.send_header(k, v)
+                self.send_header("Content-Length", str(len(resp.entity or b"")))
+                self.end_headers()
+                if resp.entity:
+                    self.wfile.write(resp.entity)
+
+            def do_GET(self):
+                self._handle("GET")
+
+            def do_POST(self):
+                self._handle("POST")
+
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self.host, self.port = self._httpd.server_address
+        self._thread = threading.Thread(target=self._httpd.serve_forever, daemon=True)
+
+    # -- lifecycle -------------------------------------------------------
+    def start(self) -> "HTTPServer":
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+
+    # -- source ----------------------------------------------------------
+    def get_batch(self, max_rows: int = 64, timeout: float = 1.0) -> DataFrame:
+        """Drain up to ``max_rows`` pending requests into a micro-batch."""
+        rows = []
+        try:
+            rid, req = self._requests.get(timeout=timeout)
+            rows.append({"id": rid, "request": req.to_row()})
+            while len(rows) < max_rows:
+                rid, req = self._requests.get_nowait()
+                rows.append({"id": rid, "request": req.to_row()})
+        except queue.Empty:
+            pass
+        return DataFrame(rows or {"id": [], "request": []})
+
+    # -- sink ------------------------------------------------------------
+    def reply(self, request_id: str, response: HTTPResponseData) -> None:
+        ev = self._responders.pop(request_id, None)
+        if ev is None:
+            return
+        self._responses[request_id] = response
+        ev.set()
+
+    def reply_batch(self, df: DataFrame, response_col: str = "response") -> None:
+        for row in df.collect():
+            resp = row[response_col]
+            if isinstance(resp, dict) and "statusLine" in resp:
+                resp = HTTPResponseData.from_row(resp)
+            elif not isinstance(resp, HTTPResponseData):
+                resp = HTTPResponseData(
+                    statusCode=200,
+                    headers={"Content-Type": "application/json"},
+                    entity=json.dumps(resp, default=str).encode(),
+                )
+            self.reply(row["id"], resp)
+
+
+def serve_transformer(
+    server: HTTPServer,
+    transform: Callable[[DataFrame], DataFrame],
+    stop_event: threading.Event,
+    batch_size: int = 64,
+) -> None:
+    """Streaming loop: micro-batch requests → transform → correlated reply.
+    ``transform`` receives a frame with (id, request) and must return one
+    with (id, response)."""
+    while not stop_event.is_set():
+        batch = server.get_batch(max_rows=batch_size, timeout=0.2)
+        if batch.count() == 0:
+            continue
+        out = transform(batch)
+        server.reply_batch(out)

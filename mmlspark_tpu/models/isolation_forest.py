@@ -1,0 +1,141 @@
+"""Isolation-forest anomaly detection (reference: SURVEY.md §2.7
+"Isolation forest" — a wrapper over LinkedIn's isolation-forest Spark lib;
+[REF-EMPTY], SynapseML-era component).
+
+Implemented natively here: random isolation trees built host-side (cheap —
+each tree sees ≤256 samples), scored with the standard
+``s(x) = 2^(−E[h(x)]/c(ψ))`` anomaly score.  Scoring batches all trees into
+vectorized per-tree path evaluation.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+import numpy as np
+
+from mmlspark_tpu.core.frame import DataFrame
+from mmlspark_tpu.core.params import ComplexParam, Param, Params
+from mmlspark_tpu.core.pipeline import Estimator, Model
+from mmlspark_tpu.core.registry import register_stage
+
+
+def _c(n: float) -> float:
+    """Average unsuccessful-search path length in a BST of n nodes."""
+    if n <= 1:
+        return 0.0
+    return 2.0 * (np.log(n - 1.0) + 0.5772156649) - 2.0 * (n - 1.0) / n
+
+
+@dataclass
+class _ITree:
+    feature: np.ndarray  # (nodes,) int; -1 = leaf
+    threshold: np.ndarray
+    left: np.ndarray
+    right: np.ndarray
+    size: np.ndarray  # samples reaching node (for leaf path-length credit)
+
+
+def _build_tree(X: np.ndarray, rng: np.random.Generator, max_depth: int) -> _ITree:
+    feats, thrs, lefts, rights, sizes = [], [], [], [], []
+
+    def rec(rows: np.ndarray, depth: int) -> int:
+        node = len(feats)
+        feats.append(-1); thrs.append(0.0); lefts.append(-1); rights.append(-1)
+        sizes.append(len(rows))
+        if depth >= max_depth or len(rows) <= 1:
+            return node
+        f = int(rng.integers(X.shape[1]))
+        col = X[rows, f]
+        lo, hi = col.min(), col.max()
+        if lo == hi:
+            return node
+        t = float(rng.uniform(lo, hi))
+        feats[node], thrs[node] = f, t
+        lefts[node] = rec(rows[col < t], depth + 1)
+        rights[node] = rec(rows[col >= t], depth + 1)
+        return node
+
+    rec(np.arange(len(X)), 0)
+    return _ITree(
+        np.asarray(feats), np.asarray(thrs), np.asarray(lefts),
+        np.asarray(rights), np.asarray(sizes, np.float64),
+    )
+
+
+class _IFParams(Params):
+    featuresCol = Param("featuresCol", "Feature vector column", default="features", dtype=str)
+    predictionCol = Param("predictionCol", "0/1 outlier column", default="predictedLabel", dtype=str)
+    scoreCol = Param("scoreCol", "Anomaly score column", default="outlierScore", dtype=str)
+    numEstimators = Param("numEstimators", "Trees in the forest", default=100, dtype=int)
+    maxSamples = Param("maxSamples", "Subsample per tree", default=256, dtype=int)
+    maxFeatures = Param("maxFeatures", "unused (API parity)", default=1.0, dtype=float)
+    contamination = Param("contamination", "Expected outlier fraction", default=0.1, dtype=float)
+    randomSeed = Param("randomSeed", "RNG seed", default=1, dtype=int)
+
+
+@register_stage
+class IsolationForest(Estimator, _IFParams):
+    def _fit(self, df: DataFrame) -> "IsolationForestModel":
+        X = np.stack([np.asarray(v, dtype=np.float64) for v in df[self.getFeaturesCol()]])
+        rng = np.random.default_rng(self.getRandomSeed())
+        psi = min(self.getMaxSamples(), len(X))
+        max_depth = int(np.ceil(np.log2(max(psi, 2))))
+        trees = []
+        for _ in range(self.getNumEstimators()):
+            rows = rng.choice(len(X), psi, replace=False)
+            trees.append(_build_tree(X[rows], rng, max_depth))
+        model = IsolationForestModel()
+        self._copyValues(model)
+        model._paramMap["trees"] = trees
+        model._paramMap["subsampleSize"] = psi
+        # threshold from training scores at the contamination quantile
+        scores = model._score(X)
+        model._paramMap["threshold"] = float(
+            np.quantile(scores, 1.0 - self.getContamination())
+        )
+        return model
+
+
+@register_stage
+class IsolationForestModel(Model, _IFParams):
+    trees = ComplexParam("trees", "Isolation trees", default=None)
+    threshold = Param("threshold", "Outlier score threshold", default=0.5, dtype=float)
+    subsampleSize = Param("subsampleSize", "psi used at fit time", default=256, dtype=int)
+
+    def _score(self, X: np.ndarray) -> np.ndarray:
+        trees: List[_ITree] = self.getOrDefault("trees")
+        psi = self.getSubsampleSize()
+        depths = np.zeros((len(trees), len(X)))
+        for t_i, tree in enumerate(trees):
+            node = np.zeros(len(X), np.int64)
+            depth = np.zeros(len(X))
+            active = np.ones(len(X), bool)
+            while active.any():
+                f = tree.feature[node]
+                leaf = f < 0
+                newly_done = active & leaf
+                # leaf credit: c(size) for unexpanded subtrees
+                depths[t_i, newly_done] = (
+                    depth[newly_done]
+                    + np.asarray([_c(s) for s in tree.size[node[newly_done]]])
+                )
+                active &= ~leaf
+                if not active.any():
+                    break
+                x_f = X[np.arange(len(X)), np.where(leaf, 0, f)]
+                go_left = x_f < tree.threshold[node]
+                nxt = np.where(go_left, tree.left[node], tree.right[node])
+                node = np.where(active, nxt, node)
+                depth = depth + active.astype(np.float64)
+        avg_depth = depths.mean(axis=0)
+        return np.power(2.0, -avg_depth / max(_c(psi), 1e-9))
+
+    def _transform(self, df: DataFrame) -> DataFrame:
+        X = np.stack([np.asarray(v, dtype=np.float64) for v in df[self.getFeaturesCol()]])
+        scores = self._score(X)
+        df = df.withColumn(self.getScoreCol(), scores)
+        return df.withColumn(
+            self.getPredictionCol(), (scores >= self.getThreshold()).astype(np.float64)
+        )

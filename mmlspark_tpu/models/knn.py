@@ -1,0 +1,140 @@
+"""Exact KNN with conditional filtering (reference: ``cms.nn`` —
+SURVEY.md §2.7 "Cond. KNN": ball tree built in Scala with per-query
+conditional filtering).
+
+TPU-first redesign: the reference's ball tree exists to prune distance
+computations on a CPU.  On a TPU the idiomatic equivalent is a **jitted
+brute-force matmul**: ‖x−y‖² = ‖x‖² + ‖y‖² − 2x·y puts the whole
+(queries × index) distance matrix on the MXU, and top-k runs via
+``lax.top_k`` — exact results, no tree, batched.  Conditional KNN masks
+disallowed (query, candidate) pairs with +inf before top-k.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+import numpy as np
+
+from mmlspark_tpu.core.frame import DataFrame
+from mmlspark_tpu.core.params import ComplexParam, Param, Params
+from mmlspark_tpu.core.pipeline import Estimator, Model
+from mmlspark_tpu.core.registry import register_stage
+
+
+class _KNNParams(Params):
+    featuresCol = Param("featuresCol", "Feature vector column", default="features", dtype=str)
+    valuesCol = Param("valuesCol", "Payload column returned with matches", default="values", dtype=str)
+    outputCol = Param("outputCol", "Matches column", default="output", dtype=str)
+    k = Param("k", "Neighbors to return", default=5, dtype=int)
+    leafSize = Param("leafSize", "unused (ball-tree API parity)", default=50, dtype=int)
+
+
+def _knn_topk(index: np.ndarray, queries: np.ndarray, k: int, mask: Optional[np.ndarray] = None):
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def run(ix, q):
+        d2 = (
+            jnp.sum(q * q, axis=1)[:, None]
+            + jnp.sum(ix * ix, axis=1)[None, :]
+            - 2.0 * q @ ix.T
+        )
+        if mask is not None:
+            d2 = jnp.where(jnp.asarray(mask), d2, jnp.inf)
+        neg, idx = jax.lax.top_k(-d2, k)
+        return -neg, idx
+
+    d, i = run(jnp.asarray(index, jnp.float32), jnp.asarray(queries, jnp.float32))
+    return np.asarray(d), np.asarray(i)
+
+
+@register_stage
+class KNN(Estimator, _KNNParams):
+    def _fit(self, df: DataFrame) -> "KNNModel":
+        model = KNNModel()
+        self._copyValues(model)
+        model._paramMap["indexFeatures"] = np.stack(
+            [np.asarray(v, dtype=np.float64) for v in df[self.getFeaturesCol()]]
+        )
+        model._paramMap["indexValues"] = (
+            list(df[self.getValuesCol()]) if self.getValuesCol() in df else None
+        )
+        return model
+
+
+@register_stage
+class KNNModel(Model, _KNNParams):
+    indexFeatures = ComplexParam("indexFeatures", "Indexed feature matrix", default=None)
+    indexValues = ComplexParam("indexValues", "Indexed payloads", default=None)
+
+    def _transform(self, df: DataFrame) -> DataFrame:
+        Q = np.stack([np.asarray(v, dtype=np.float64) for v in df[self.getFeaturesCol()]])
+        ix = self.getOrDefault("indexFeatures")
+        values = self.getOrDefault("indexValues")
+        d, i = _knn_topk(ix, Q, min(self.getK(), len(ix)))
+        out = []
+        for qi in range(len(Q)):
+            out.append([
+                {
+                    "value": values[j] if values is not None else int(j),
+                    "distance": float(np.sqrt(max(d[qi, c], 0.0))),
+                }
+                for c, j in enumerate(i[qi])
+            ])
+        return df.withColumn(self.getOutputCol(), out)
+
+
+class _CondKNNParams(_KNNParams):
+    labelCol = Param("labelCol", "Index-side condition label column", default="labels", dtype=str)
+    conditionerCol = Param(
+        "conditionerCol", "Query-side set of allowed labels", default="conditioner", dtype=str
+    )
+
+
+@register_stage
+class ConditionalKNN(Estimator, _CondKNNParams):
+    def _fit(self, df: DataFrame) -> "ConditionalKNNModel":
+        model = ConditionalKNNModel()
+        self._copyValues(model)
+        model._paramMap["indexFeatures"] = np.stack(
+            [np.asarray(v, dtype=np.float64) for v in df[self.getFeaturesCol()]]
+        )
+        model._paramMap["indexValues"] = (
+            list(df[self.getValuesCol()]) if self.getValuesCol() in df else None
+        )
+        model._paramMap["indexLabels"] = list(df[self.getLabelCol()])
+        return model
+
+
+@register_stage
+class ConditionalKNNModel(Model, _CondKNNParams):
+    indexFeatures = ComplexParam("indexFeatures", "Indexed feature matrix", default=None)
+    indexValues = ComplexParam("indexValues", "Indexed payloads", default=None)
+    indexLabels = ComplexParam("indexLabels", "Index-side labels", default=None)
+
+    def _transform(self, df: DataFrame) -> DataFrame:
+        Q = np.stack([np.asarray(v, dtype=np.float64) for v in df[self.getFeaturesCol()]])
+        ix = self.getOrDefault("indexFeatures")
+        labels = self.getOrDefault("indexLabels")
+        values = self.getOrDefault("indexValues")
+        conds = df[self.getConditionerCol()]
+        mask = np.zeros((len(Q), len(ix)), bool)
+        for qi, allowed in enumerate(conds):
+            allowed_set = set(allowed) if isinstance(allowed, (list, set, np.ndarray)) else {allowed}
+            mask[qi] = [l in allowed_set for l in labels]
+        d, i = _knn_topk(ix, Q, min(self.getK(), len(ix)), mask=mask)
+        out = []
+        for qi in range(len(Q)):
+            matches = []
+            for c, j in enumerate(i[qi]):
+                if not np.isfinite(d[qi, c]):
+                    continue  # fewer than k allowed candidates
+                matches.append({
+                    "value": values[j] if values is not None else int(j),
+                    "distance": float(np.sqrt(max(d[qi, c], 0.0))),
+                    "label": labels[j],
+                })
+            out.append(matches)
+        return df.withColumn(self.getOutputCol(), out)

@@ -1,0 +1,316 @@
+"""SAR (Smart Adaptive Recommendations) + ranking evaluation utilities.
+
+Reference parity (SURVEY.md §2.7 "SAR recommender",
+UPSTREAM:.../recommendation/*.scala): item-item similarity from
+co-occurrence (count / jaccard / lift) × time-decayed user-item affinity,
+SparkML-compatible (``RecommendationIndexer``, ``RankingAdapter``,
+``RankingEvaluator``, ``RankingTrainValidationSplit``).
+
+TPU note: scoring is a dense (users × items) @ (items × items) matmul —
+jitted so batch recommendation rides the MXU.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import numpy as np
+import pandas as pd
+
+from mmlspark_tpu.core.frame import DataFrame
+from mmlspark_tpu.core.params import ComplexParam, Param, ParamValidators, Params
+from mmlspark_tpu.core.pipeline import Estimator, Evaluator, Model, Transformer
+from mmlspark_tpu.core.registry import register_stage
+
+
+class _SARParams(Params):
+    userCol = Param("userCol", "User id column", default="user", dtype=str)
+    itemCol = Param("itemCol", "Item id column", default="item", dtype=str)
+    ratingCol = Param("ratingCol", "Rating column ('' = implicit 1.0)", default="rating", dtype=str)
+    timeCol = Param("timeCol", "Event-time column (unix seconds)", default="", dtype=str)
+    similarityFunction = Param(
+        "similarityFunction", "cooccurrence|jaccard|lift", default="jaccard", dtype=str,
+        validator=ParamValidators.inList(["cooccurrence", "jaccard", "lift"]),
+    )
+    supportThreshold = Param("supportThreshold", "Min co-occurrence count", default=4, dtype=int)
+    timeDecayCoeff = Param("timeDecayCoeff", "Affinity half-life in days", default=30, dtype=int)
+    activityTimeFormat = Param("activityTimeFormat", "unused (API parity)", default="", dtype=str)
+
+
+@register_stage
+class SAR(Estimator, _SARParams):
+    def _fit(self, df: DataFrame) -> "SARModel":
+        users = df[self.getUserCol()]
+        items = df[self.getItemCol()]
+        u_levels = sorted(set(users))
+        i_levels = sorted(set(items))
+        u_index = {v: i for i, v in enumerate(u_levels)}
+        i_index = {v: i for i, v in enumerate(i_levels)}
+        U, I = len(u_levels), len(i_levels)
+        ui = np.zeros((U, I))
+        ratings = (
+            np.asarray(df[self.getRatingCol()], dtype=np.float64)
+            if self.getRatingCol() and self.getRatingCol() in df
+            else np.ones(df.count())
+        )
+        # time-decayed affinity: rating · 2^(-(T_ref − t)/half_life)
+        if self.getTimeCol() and self.getTimeCol() in df:
+            t = np.asarray(df[self.getTimeCol()], dtype=np.float64)
+            half_life_s = self.getTimeDecayCoeff() * 86400.0
+            decay = np.power(2.0, -(t.max() - t) / half_life_s)
+        else:
+            decay = np.ones(df.count())
+        for u, it, r, d in zip(users, items, ratings, decay):
+            ui[u_index[u], i_index[it]] += r * d
+
+        # item-item co-occurrence on the binarized matrix
+        seen = (ui > 0).astype(np.float64)
+        co = seen.T @ seen  # (I, I)
+        co = np.where(co >= self.getSupportThreshold(), co, 0.0)
+        diag = np.diag(co).copy()
+        sim_kind = self.getSimilarityFunction()
+        if sim_kind == "cooccurrence":
+            sim = co
+        elif sim_kind == "jaccard":
+            denom = diag[:, None] + diag[None, :] - co
+            sim = np.divide(co, denom, out=np.zeros_like(co), where=denom > 0)
+        else:  # lift
+            denom = diag[:, None] * diag[None, :]
+            sim = np.divide(co, denom, out=np.zeros_like(co), where=denom > 0)
+
+        model = SARModel()
+        self._copyValues(model)
+        model._paramMap["userAffinity"] = ui
+        model._paramMap["itemSimilarity"] = sim
+        model._paramMap["userLevels"] = u_levels
+        model._paramMap["itemLevels"] = i_levels
+        return model
+
+
+@register_stage
+class SARModel(Model, _SARParams):
+    userAffinity = ComplexParam("userAffinity", "(U, I) affinity matrix", default=None)
+    itemSimilarity = ComplexParam("itemSimilarity", "(I, I) similarity", default=None)
+    userLevels = ComplexParam("userLevels", "User id order", default=None)
+    itemLevels = ComplexParam("itemLevels", "Item id order", default=None)
+
+    def getItemSimilarity(self) -> np.ndarray:
+        return self.getOrDefault("itemSimilarity")
+
+    def _scores(self) -> np.ndarray:
+        import jax.numpy as jnp
+        import jax
+
+        ui = self.getOrDefault("userAffinity")
+        sim = self.getOrDefault("itemSimilarity")
+        return np.asarray(
+            jax.jit(lambda a, s: a @ s)(jnp.asarray(ui), jnp.asarray(sim))
+        )
+
+    def recommendForAllUsers(self, numItems: int) -> DataFrame:
+        scores = self._scores()
+        ui = self.getOrDefault("userAffinity")
+        scores = np.where(ui > 0, -np.inf, scores)  # don't re-recommend seen
+        order = np.argsort(-scores, axis=1)[:, :numItems]
+        u_levels = self.getOrDefault("userLevels")
+        i_levels = np.asarray(self.getOrDefault("itemLevels"), dtype=object)
+        recs = []
+        for u_i, u in enumerate(u_levels):
+            row = [
+                {"item": i_levels[j], "rating": float(scores[u_i, j])}
+                for j in order[u_i]
+                if np.isfinite(scores[u_i, j])
+            ]
+            recs.append({"user": u, "recommendations": row})
+        return DataFrame(pd.DataFrame(recs))
+
+    def _transform(self, df: DataFrame) -> DataFrame:
+        """Score (user, item) pairs."""
+        scores = self._scores()
+        u_index = {v: i for i, v in enumerate(self.getOrDefault("userLevels"))}
+        i_index = {v: i for i, v in enumerate(self.getOrDefault("itemLevels"))}
+        out = []
+        for u, it in zip(df[self.getUserCol()], df[self.getItemCol()]):
+            ui_, ii_ = u_index.get(u), i_index.get(it)
+            out.append(float(scores[ui_, ii_]) if ui_ is not None and ii_ is not None else 0.0)
+        return df.withColumn("prediction", np.asarray(out))
+
+
+@register_stage
+class RecommendationIndexer(Estimator):
+    """Index raw user/item ids to contiguous ints (reference:
+    UPSTREAM:.../recommendation/RecommendationIndexer.scala)."""
+
+    userInputCol = Param("userInputCol", "Raw user column", default="user", dtype=str)
+    userOutputCol = Param("userOutputCol", "Indexed user column", default="user_idx", dtype=str)
+    itemInputCol = Param("itemInputCol", "Raw item column", default="item", dtype=str)
+    itemOutputCol = Param("itemOutputCol", "Indexed item column", default="item_idx", dtype=str)
+    ratingCol = Param("ratingCol", "Rating column", default="rating", dtype=str)
+
+    def _fit(self, df):
+        model = RecommendationIndexerModel(
+            userInputCol=self.getUserInputCol(), userOutputCol=self.getUserOutputCol(),
+            itemInputCol=self.getItemInputCol(), itemOutputCol=self.getItemOutputCol(),
+        )
+        model._paramMap["userLevels"] = sorted(set(df[self.getUserInputCol()]))
+        model._paramMap["itemLevels"] = sorted(set(df[self.getItemInputCol()]))
+        return model
+
+
+@register_stage
+class RecommendationIndexerModel(Model):
+    userInputCol = Param("userInputCol", "Raw user column", default="user", dtype=str)
+    userOutputCol = Param("userOutputCol", "Indexed user column", default="user_idx", dtype=str)
+    itemInputCol = Param("itemInputCol", "Raw item column", default="item", dtype=str)
+    itemOutputCol = Param("itemOutputCol", "Indexed item column", default="item_idx", dtype=str)
+    userLevels = ComplexParam("userLevels", "User levels", default=None)
+    itemLevels = ComplexParam("itemLevels", "Item levels", default=None)
+
+    def _transform(self, df):
+        ul = {v: float(i) for i, v in enumerate(self.getOrDefault("userLevels"))}
+        il = {v: float(i) for i, v in enumerate(self.getOrDefault("itemLevels"))}
+        df = df.withColumn(self.getUserOutputCol(), [ul.get(v, -1.0) for v in df[self.getUserInputCol()]])
+        return df.withColumn(self.getItemOutputCol(), [il.get(v, -1.0) for v in df[self.getItemInputCol()]])
+
+
+def ndcg_at_k(actual: List, predicted: List, k: int) -> float:
+    dcg = sum(
+        1.0 / np.log2(i + 2.0) for i, p in enumerate(predicted[:k]) if p in set(actual)
+    )
+    idcg = sum(1.0 / np.log2(i + 2.0) for i in range(min(len(actual), k)))
+    return float(dcg / idcg) if idcg > 0 else 0.0
+
+
+def map_at_k(actual: List, predicted: List, k: int) -> float:
+    hits, score = 0, 0.0
+    aset = set(actual)
+    for i, p in enumerate(predicted[:k]):
+        if p in aset:
+            hits += 1
+            score += hits / (i + 1.0)
+    return float(score / min(len(actual), k)) if actual else 0.0
+
+
+@register_stage
+class RankingEvaluator(Evaluator):
+    """ndcgAt / map / precisionAtk / recallAtK over (prediction, label) list
+    columns (reference: UPSTREAM:.../recommendation/RankingEvaluator.scala)."""
+
+    k = Param("k", "Cutoff", default=10, dtype=int)
+    metricName = Param(
+        "metricName", "ndcgAt|map|precisionAtk|recallAtK", default="ndcgAt", dtype=str,
+        validator=ParamValidators.inList(["ndcgAt", "map", "precisionAtk", "recallAtK"]),
+    )
+    labelCol = Param("labelCol", "True item-list column", default="label", dtype=str)
+    predictionCol = Param("predictionCol", "Predicted item-list column", default="prediction", dtype=str)
+
+    def evaluate(self, df: DataFrame) -> float:
+        k = self.getK()
+        vals = []
+        for actual, pred in zip(df[self.getLabelCol()], df[self.getPredictionCol()]):
+            actual, pred = list(actual), list(pred)
+            if self.getMetricName() == "ndcgAt":
+                vals.append(ndcg_at_k(actual, pred, k))
+            elif self.getMetricName() == "map":
+                vals.append(map_at_k(actual, pred, k))
+            elif self.getMetricName() == "precisionAtk":
+                vals.append(len(set(actual) & set(pred[:k])) / float(k))
+            else:  # recallAtK
+                vals.append(
+                    len(set(actual) & set(pred[:k])) / float(max(len(actual), 1))
+                )
+        return float(np.mean(vals)) if vals else 0.0
+
+
+@register_stage
+class RankingAdapter(Estimator):
+    """Fit a recommender and emit per-user (prediction, label) item lists
+    for RankingEvaluator (reference: .../RankingAdapter.scala)."""
+
+    recommender = ComplexParam("recommender", "Inner recommender estimator", default=None)
+    k = Param("k", "Items to recommend", default=10, dtype=int)
+    labelCol = Param("labelCol", "Output true-items column", default="label", dtype=str)
+
+    def setRecommender(self, est):
+        self._paramMap["recommender"] = est
+        return self
+
+    def _fit(self, df):
+        fitted = self.getOrDefault("recommender").fit(df)
+        model = RankingAdapterModel(k=self.getK(), labelCol=self.getLabelCol())
+        model._paramMap["recommenderModel"] = fitted
+        return model
+
+
+@register_stage
+class RankingAdapterModel(Model):
+    recommenderModel = ComplexParam("recommenderModel", "Fitted recommender", default=None)
+    k = Param("k", "Items to recommend", default=10, dtype=int)
+    labelCol = Param("labelCol", "Output true-items column", default="label", dtype=str)
+
+    def _transform(self, df):
+        inner = self.getOrDefault("recommenderModel")
+        recs = inner.recommendForAllUsers(self.getK())
+        rec_map = {
+            r["user"]: [d["item"] for d in r["recommendations"]]
+            for r in recs.collect()
+        }
+        user_col = inner.getUserCol()
+        item_col = inner.getItemCol()
+        pdf = df.toPandas()
+        grouped = pdf.groupby(user_col)[item_col].apply(list)
+        rows = [
+            {
+                "user": u,
+                "prediction": rec_map.get(u, []),
+                self.getLabelCol(): items,
+            }
+            for u, items in grouped.items()
+        ]
+        return DataFrame(pd.DataFrame(rows))
+
+
+@register_stage
+class RankingTrainValidationSplit(Estimator):
+    """Per-user holdout split + ranking evaluation of candidate params
+    (reference: .../RankingTrainValidationSplit.scala)."""
+
+    estimator = ComplexParam("estimator", "Recommender estimator", default=None)
+    trainRatio = Param("trainRatio", "Train fraction per user", default=0.75, dtype=float)
+    userCol = Param("userCol", "User column", default="user", dtype=str)
+    itemCol = Param("itemCol", "Item column", default="item", dtype=str)
+    k = Param("k", "Eval cutoff", default=10, dtype=int)
+    seed = Param("seed", "Split seed", default=0, dtype=int)
+
+    def setEstimator(self, est):
+        self._paramMap["estimator"] = est
+        return self
+
+    def _fit(self, df):
+        rng = np.random.default_rng(self.getSeed())
+        pdf = df.toPandas()
+        mask = np.zeros(len(pdf), bool)
+        for _, idx in pdf.groupby(self.getUserCol()).indices.items():
+            idx = np.asarray(idx)
+            take = max(1, int(len(idx) * self.getTrainRatio()))
+            mask[rng.permutation(idx)[:take]] = True
+        train_df = DataFrame(pdf[mask].reset_index(drop=True))
+        test_df = DataFrame(pdf[~mask].reset_index(drop=True))
+        fitted = self.getOrDefault("estimator").fit(train_df)
+
+        adapter = RankingAdapterModel(k=self.getK())
+        adapter._paramMap["recommenderModel"] = fitted
+        ranked = adapter.transform(test_df)
+        metric = RankingEvaluator(k=self.getK()).evaluate(ranked)
+        model = RankingTrainValidationSplitModel(validationMetric=float(metric))
+        model._paramMap["bestModel"] = fitted
+        return model
+
+
+@register_stage
+class RankingTrainValidationSplitModel(Model):
+    bestModel = ComplexParam("bestModel", "Fitted recommender", default=None)
+    validationMetric = Param("validationMetric", "Holdout ranking metric", default=None, dtype=float)
+
+    def _transform(self, df):
+        return self.getOrDefault("bestModel").transform(df)

