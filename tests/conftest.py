@@ -10,6 +10,19 @@ the analog is ``xla_force_host_platform_device_count=8`` so distributed
 import os
 import sys
 
+# XLA's CPU collectives have a watchdog that ABORTS the process (not a
+# Python exception) when a psum straggles past the default 30s — on a
+# loaded host, 8 virtual devices sharing cores can trip it nondeterministically
+# (observed as "Fatal Python error: Aborted" inside the shard_map/psum
+# train path).  XLA_FLAGS is parsed lazily at first compile, so setting it
+# here (before any test compiles) still takes effect even though jax itself
+# was imported at interpreter startup.
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "")
+    + " --xla_cpu_collective_timeout_seconds=600"
+    + " --xla_cpu_collective_call_terminate_timeout_seconds=1200"
+).strip()
+
 # The session interpreter imports jax at startup (a sitecustomize registers
 # the tunneled real-TPU "axon" PJRT platform and env presets
 # JAX_PLATFORMS=axon), so env-var changes here are too late — jax captured
