@@ -93,6 +93,10 @@ class TrainConfig:
     grow_policy: str = "lossguide"  # lossguide (LightGBM-exact) | depthwise
     hist_backend: str = "scatter"
     hist_chunk: int = DEFAULT_CHUNK
+    hist_precision: str = "highest"  # highest (f32) | default (bf16 multiply)
+    cat_smooth: float = 10.0
+    cat_l2: float = 10.0
+    max_cat_threshold: int = 32
     verbosity: int = 1
 
     _ALIASES = {
@@ -194,17 +198,20 @@ def _concat_forests(old: Tree, new: Tree) -> Tree:
 
     def cat(field: str, a, b):
         a, b = np.asarray(a), np.asarray(b)
-        if a.ndim == 3 and a.shape[-1] != b.shape[-1]:
-            target = max(a.shape[-1], b.shape[-1])
+        # Budget axis: last for (T, K, S)/(T, K, L) fields, -2 for
+        # cat_threshold's (T, K, S, B).  B (bin count) always matches:
+        # warm start pins the BinMapper.
+        axis = -2 if field == "cat_threshold" else -1
+        if a.ndim >= 3 and a.shape[axis] != b.shape[axis]:
+            target = max(a.shape[axis], b.shape[axis])
             fill = _TREE_PAD_FILL.get(field, 0)
 
             def pad(x):
-                if x.shape[-1] == target:
+                if x.shape[axis] == target:
                     return x
-                extra = np.full(
-                    x.shape[:-1] + (target - x.shape[-1],), fill, dtype=x.dtype
-                )
-                return np.concatenate([x, extra], axis=-1)
+                widths = [(0, 0)] * x.ndim
+                widths[axis % x.ndim] = (0, target - x.shape[axis])
+                return np.pad(x, widths, constant_values=fill)
 
             a, b = pad(a), pad(b)
         return np.concatenate([a, b], axis=0)
@@ -412,6 +419,35 @@ def _feature_mask(key, F: int, fraction: float):
 # ---------------------------------------------------------------------------
 _PARALLEL_LEARNERS = ("data", "data_parallel", "voting", "voting_parallel")
 
+# Jitted whole-run scan programs cached ACROSS train() calls (bounded FIFO).
+# jax.jit caches per function object; without this, every fit (each AutoML
+# candidate, each CV fold, the bench's steady-state run) re-traces the scan
+# body — seconds of pure Python/tracing overhead per call.
+_SCAN_CACHE: Dict[Tuple, callable] = {}
+_SCAN_CACHE_MAX = 16
+
+
+def _hashable(v):
+    if isinstance(v, (list, tuple, np.ndarray)):
+        return tuple(np.asarray(v).ravel().tolist())
+    return v
+
+
+def _cfg_cache_key(cfg: TrainConfig) -> Tuple:
+    return tuple(
+        (f.name, _hashable(getattr(cfg, f.name))) for f in dataclasses.fields(cfg)
+    )
+
+
+def _mesh_cache_key(mesh):
+    if mesh is None:
+        return None
+    return (
+        tuple(d.id for d in mesh.devices.flat),
+        mesh.devices.shape,
+        tuple(mesh.axis_names),
+    )
+
 
 def train(
     params: dict,
@@ -443,14 +479,6 @@ def train(
         # the equivalent config check).
         raise ValueError(
             "boosting='rf' requires bagging_freq > 0 and bagging_fraction < 1"
-        )
-    if cfg.categorical_feature:
-        # Categorical membership splits (LightGBM's sorted-category
-        # algorithm) are not wired into the grower yet; fail loudly rather
-        # than silently degrading to ordinal splits over category ids.
-        raise NotImplementedError(
-            "categorical_feature support is not implemented yet; "
-            "one-hot or ordinal-encode categoricals explicitly for now"
         )
     if cfg.early_stopping_round > 0 and not valid_sets:
         # LightGBM: "For early stopping, at least one dataset ... is required".
@@ -598,7 +626,12 @@ def train(
         learning_rate=cfg.learning_rate if cfg.boosting != "rf" else 1.0,
         hist_backend=cfg.hist_backend,
         hist_chunk=chunk,
+        hist_precision=cfg.hist_precision,
         grow_policy=cfg.grow_policy,
+        categorical_features=tuple(int(f) for f in cfg.categorical_feature),
+        cat_smooth=cfg.cat_smooth,
+        cat_l2=cfg.cat_l2,
+        max_cat_threshold=cfg.max_cat_threshold,
     )
 
     def _grow_classes(gcfg_):
@@ -717,6 +750,156 @@ def train(
     root_key = jax.random.PRNGKey(cfg.bagging_seed + 7919 * cfg.seed)
     all_keys = np.asarray(jax.random.split(root_key, 2 * cfg.num_iterations))
 
+    if cfg.boosting != "dart":
+        # ---- FAST PATH: the whole boosting run as ONE lax.scan ----------
+        # Round 1 spent ~42s of a 44s / 50-iteration bench in per-iteration
+        # dispatch + host sync over the remote-dispatch link (the device
+        # compute per iteration is ~50ms) — exactly the reference's reason
+        # for keeping its hot loop inside native code (SURVEY.md §3.1 HOT
+        # LOOP).  Scanning over iterations makes the whole run one XLA
+        # program: 1 dispatch total without early stopping, 1 per
+        # `early_stopping_round` chunk with it (metrics are checked on host
+        # between chunks from per-iteration score snapshots; trees grown
+        # past the stopping point are discarded, so semantics match the
+        # per-iteration check exactly).  DART stays on the legacy loop: its
+        # drop bookkeeping mutates host-side RNG state per iteration.
+        n_iter = cfg.num_iterations
+        if do_bagging:
+            # LightGBM bagging reuse: iteration `it` uses the bag drawn at
+            # the last multiple of bagging_freq.  Recomputing the draw from
+            # the same key inside the scan body reproduces reuse without a
+            # carried bag array.
+            draw_at = (np.arange(n_iter) // cfg.bagging_freq) * cfg.bagging_freq
+            bag_keys = all_keys[n_iter + draw_at]
+        else:
+            bag_keys = np.zeros((n_iter, 2), dtype=all_keys.dtype)
+        iter_keys = all_keys[:n_iter]
+
+        vbins_t = tuple(vs["bins"] for vs in vsets)
+
+        # Like `iteration` above: device data enters as ARGUMENTS (valid
+        # bins included) so nothing large becomes a jaxpr constant.
+        def _build_scan_chunk():
+            def scan_chunk(
+                bins_a, y_a, w_a, vmask_a, init_scores_a, vbins_a, carry,
+                keys_c, bag_keys_c,
+            ):
+                def body(car, xs):
+                    scores_c, vscores_c = car
+                    key, bag_key = xs
+                    train_scores = init_scores_a if cfg.boosting == "rf" else scores_c
+                    grad, hess = obj.grad_hess(
+                        train_scores if K > 1 else train_scores[0], y_a, w_a
+                    )
+                    if K == 1:
+                        grad, hess = grad[None, :], hess[None, :]
+                    gkey, fkey = jax.random.split(key)
+                    fkey = jax.random.fold_in(fkey, cfg.feature_fraction_seed)
+                    if cfg.boosting == "goss":
+                        grad_abs = jnp.sum(jnp.abs(grad), axis=0)
+                        bag = _bag_weights(gkey, cfg, vmask_a, grad_abs)
+                    elif do_bagging:
+                        bag = _bag_weights(
+                            bag_key, cfg, vmask_a, jnp.zeros(vmask_a.shape[0])
+                        )
+                    else:
+                        bag = vmask_a.astype(jnp.float32)
+                    fmask = jax.vmap(
+                        lambda k: _feature_mask(k, F, cfg.feature_fraction)
+                    )(jax.random.split(fkey, K))
+                    tree, leaf_ids = grow(bins_a, grad, hess, bag, fmask)
+                    delta = jax.vmap(lambda lv, li: lv[li])(tree.leaf_value, leaf_ids)
+                    scores_c = scores_c + delta
+                    vscores_c = tuple(
+                        vsc + jax.vmap(lambda t: predict_tree_binned(t, vb, B))(tree)
+                        for vsc, vb in zip(vscores_c, vbins_a)
+                    )
+                    return (scores_c, vscores_c), (tree, vscores_c)
+
+                return jax.lax.scan(body, carry, (keys_c, bag_keys_c))
+
+            return jax.jit(scan_chunk)
+
+        # Reuse the jitted program across train() calls when nothing it
+        # closes over can differ (LambdaRank carries per-dataset group state
+        # inside `obj`, so it is excluded).
+        if isinstance(obj, LambdaRank):
+            scan_chunk = _build_scan_chunk()
+        else:
+            cache_key = (_cfg_cache_key(cfg), K, F, B, _mesh_cache_key(mesh))
+            scan_chunk = _SCAN_CACHE.get(cache_key)
+            if scan_chunk is None:
+                scan_chunk = _build_scan_chunk()
+                if len(_SCAN_CACHE) >= _SCAN_CACHE_MAX:
+                    _SCAN_CACHE.pop(next(iter(_SCAN_CACHE)))
+                _SCAN_CACHE[cache_key] = scan_chunk
+
+        if cfg.early_stopping_round > 0 and vsets:
+            chunk_iters = min(n_iter, max(cfg.early_stopping_round, 1))
+        else:
+            chunk_iters = n_iter
+
+        carry = (scores, tuple(vs["scores"] for vs in vsets))
+        tree_chunks: List[Tree] = []
+        n_done = 0
+        stop_at: Optional[int] = None
+        while n_done < n_iter and stop_at is None:
+            c = min(chunk_iters, n_iter - n_done)
+            carry, (trees_c, vsnap_c) = scan_chunk(
+                bins_dev, y_dev, w_dev, valid_mask, init_scores_dev, vbins_t,
+                carry, jnp.asarray(iter_keys[n_done : n_done + c]),
+                jnp.asarray(bag_keys[n_done : n_done + c]),
+            )
+            tree_chunks.append(trees_c)
+            if vsets:
+                # One batched transfer (issues every copy async, then waits)
+                # — per-array np.asarray pulls pay a full dispatch RTT each.
+                snaps = jax.device_get(list(vsnap_c))  # each (c, K, nv)
+                for j in range(c):
+                    it = n_done + j
+                    stop = False
+                    for nm, vs, sn in zip(names, vsets, snaps):
+                        div = (it + 1) if cfg.boosting == "rf" else 1
+                        m = eval_metric(sn[j] / div, vs["data"])
+                        evals_result[nm][metric_name].append(m)
+                        if cfg.early_stopping_round > 0 and nm == names[0]:
+                            improved = (
+                                m > best_score if higher_better else m < best_score
+                            )
+                            if improved:
+                                best_score, best_iter = m, it
+                            elif it - best_iter >= cfg.early_stopping_round:
+                                stop = True
+                    if stop:
+                        stop_at = it
+                        break
+            n_done += c
+
+        kept = (stop_at + 1) if stop_at is not None else n_iter
+        chunks_np = jax.device_get(tree_chunks)  # one batched transfer
+        stacked = Tree(
+            *[np.concatenate(arrs, axis=0)[:kept] for arrs in zip(*chunks_np)]
+        )
+        if vsets:
+            for nm in names:
+                evals_result[nm][metric_name] = evals_result[nm][metric_name][:kept]
+        if use_bfa:
+            # boost_from_average bias folding into the STORED tree 0
+            # (LightGBM AddBias) — the in-scan deltas stayed unbiased (the
+            # running scores already start at init), so fold here once.
+            bias = np.asarray(init, dtype=np.float32).reshape(-1)  # (K,) or (1,)
+            lv = stacked.leaf_value.copy()  # (T, K, L)
+            active = (
+                np.arange(lv.shape[-1])[None, :] < stacked.num_leaves[0][:, None]
+            )  # (K, L)
+            lv[0] = np.where(active, lv[0] + bias[:, None], 0.0)
+            stacked = stacked._replace(leaf_value=lv)
+        weights = np.ones(kept)
+        return _finalize_booster(
+            stacked, weights, bin_mapper, cfg, init_model, evals_result,
+            best_iter if cfg.early_stopping_round > 0 else -1,
+        )
+
     for it in range(cfg.num_iterations):
         sub = all_keys[it]
         if do_bagging and it % cfg.bagging_freq == 0:
@@ -806,7 +989,7 @@ def train(
         if stop:
             break
 
-    # ---- stack trees (prepending the warm-start forest, if any) ---------
+    # ---- stack trees (legacy/DART path) --------------------------------
     # Stack on DEVICE in ONE jitted program, then one host transfer per
     # field: pulling each tree's 8 small arrays separately costs a full
     # dispatch round-trip per pull (~0.5s each through a remote-dispatch
@@ -817,6 +1000,22 @@ def train(
     )(trees_host)
     stacked = Tree(*[np.asarray(a) for a in stacked_dev])
     weights = np.asarray(tree_weights)
+    return _finalize_booster(
+        stacked, weights, bin_mapper, cfg, init_model, evals_result,
+        best_iter if cfg.early_stopping_round > 0 else -1,
+    )
+
+
+def _finalize_booster(
+    stacked: Tree,
+    weights: np.ndarray,
+    bin_mapper: BinMapper,
+    cfg: TrainConfig,
+    init_model: Optional[Booster],
+    evals_result: Dict[str, Dict[str, List[float]]],
+    best_iter: int,
+) -> Booster:
+    """Warm-start concat + Booster construction (shared by both train paths)."""
     t_offset = 0
     if init_model is not None:
         # Keep only the iterations the base scores came from: an early-
@@ -830,11 +1029,7 @@ def train(
         tree_weights=weights,
         bin_mapper=bin_mapper,
         config=cfg,
-        best_iteration=(
-            t_offset + best_iter
-            if cfg.early_stopping_round > 0 and best_iter >= 0
-            else -1
-        ),
+        best_iteration=t_offset + best_iter if best_iter >= 0 else -1,
         average_output=cfg.boosting == "rf",
     )
     booster.evals_result = evals_result

@@ -1,0 +1,88 @@
+"""Native (C++) host-side components, loaded via ctypes.
+
+The reference keeps its performance-critical host path (Dataset build /
+feature binning) in native code shipped as prebuilt binaries (SURVEY.md
+§2.9, L2/L3 layers); here the equivalent is a small C++ library compiled
+on first use with the local toolchain and bound with ctypes (no pybind11
+in the image — task env rules).  Every native entry point has a pure
+numpy fallback in the calling module, selected automatically when the
+toolchain or the compiled library is unavailable (or when
+``MMLSPARK_TPU_NO_NATIVE=1``).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+_HERE = os.path.dirname(__file__)
+_SRC = os.path.join(_HERE, "binner.cpp")
+_SO = os.path.join(_HERE, "_binner.so")
+
+_lock = threading.Lock()
+_lib = None
+_tried = False
+
+
+def _compile() -> bool:
+    tmp = _SO + f".tmp{os.getpid()}"
+    try:
+        subprocess.run(
+            [
+                "g++", "-O3", "-shared", "-fPIC", "-std=c++17", "-pthread",
+                _SRC, "-o", tmp,
+            ],
+            check=True, capture_output=True, timeout=120,
+        )
+        os.replace(tmp, _SO)
+        return True
+    except Exception:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        return False
+
+
+def get_binner_lib():
+    """The compiled binner library, or None (numpy fallback)."""
+    global _lib, _tried
+    if _tried:
+        return _lib
+    with _lock:
+        if _tried:
+            return _lib
+        lib = None
+        if not os.environ.get("MMLSPARK_TPU_NO_NATIVE"):
+            try:
+                fresh = os.path.exists(_SO) and (
+                    os.path.getmtime(_SO) >= os.path.getmtime(_SRC)
+                )
+                if fresh or _compile():
+                    lib = ctypes.CDLL(_SO)
+                    c_double_p = ctypes.POINTER(ctypes.c_double)
+                    c_int_p = ctypes.POINTER(ctypes.c_int)
+                    c_u8_p = ctypes.POINTER(ctypes.c_uint8)
+                    lib.mml_binner_fit.argtypes = [
+                        c_double_p, ctypes.c_long, ctypes.c_long,
+                        ctypes.c_int, ctypes.c_int, c_u8_p,
+                        c_double_p, c_int_p, ctypes.c_int,
+                    ]
+                    lib.mml_binner_fit.restype = None
+                    lib.mml_binner_transform.argtypes = [
+                        c_double_p, ctypes.c_long, ctypes.c_long,
+                        c_double_p, c_int_p, ctypes.c_int, ctypes.c_int,
+                        c_u8_p, ctypes.c_int,
+                    ]
+                    lib.mml_binner_transform.restype = None
+            except Exception:
+                lib = None
+        _lib = lib
+        _tried = True
+        return _lib
+
+
+def default_threads() -> int:
+    return min(16, os.cpu_count() or 1)

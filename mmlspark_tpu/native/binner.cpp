@@ -1,0 +1,143 @@
+// Host-side feature binner: quantile-sketch fit + binned-matrix transform.
+//
+// TPU-native equivalent of the reference's native Dataset construction path
+// (SURVEY.md §2.9 N1: LightGBM's BinMapper in upstream C++ src/io/bin.cpp,
+// shipped prebuilt inside the lightgbmlib jar — [REF-EMPTY]; and §7.1 "C++
+// where the reference was native": the Arrow→binned-buffer feature binner).
+// The Python BinMapper (ops/binning.py) delegates here via ctypes when the
+// compiled library is available and falls back to the pure-numpy
+// implementation otherwise — both produce IDENTICAL boundaries and bins
+// (tested in tests/test_native_binner.py).
+//
+// Threading: std::thread over features (the natural partition — each
+// feature's sort/searchsorted is independent).  No external deps; built
+// with `g++ -O3 -shared -fPIC -std=c++17 -pthread`.
+
+#include <algorithm>
+#include <cmath>
+#include <cstdint>
+#include <cstring>
+#include <functional>
+#include <limits>
+#include <thread>
+#include <vector>
+
+namespace {
+
+// Greedy equal-count boundary placement over distinct values — the exact
+// LightGBM-compatible rule ops/binning.py::_fit_numeric implements:
+// accumulate counts until >= target, place the midpoint boundary, reset.
+int fit_numeric_col(const double* col, long n, long stride, int max_bin,
+                    int min_data_in_bin, double* out_uppers) {
+  std::vector<double> v;
+  v.reserve(static_cast<size_t>(n));
+  for (long i = 0; i < n; ++i) {
+    double x = col[i * stride];
+    if (!std::isnan(x)) v.push_back(x);
+  }
+  if (v.empty()) {
+    out_uppers[0] = std::numeric_limits<double>::infinity();
+    return 1;
+  }
+  std::sort(v.begin(), v.end());
+  std::vector<double> distinct;
+  std::vector<long> counts;
+  distinct.reserve(v.size());
+  for (size_t i = 0; i < v.size();) {
+    size_t j = i;
+    while (j < v.size() && v[j] == v[i]) ++j;
+    distinct.push_back(v[i]);
+    counts.push_back(static_cast<long>(j - i));
+    i = j;
+  }
+  const size_t nd = distinct.size();
+  if (nd <= static_cast<size_t>(max_bin)) {
+    for (size_t i = 0; i + 1 < nd; ++i)
+      out_uppers[i] = (distinct[i] + distinct[i + 1]) / 2.0;
+    out_uppers[nd - 1] = std::numeric_limits<double>::infinity();
+    return static_cast<int>(nd);
+  }
+  const double total = static_cast<double>(v.size());
+  const double target =
+      std::max(total / max_bin, static_cast<double>(min_data_in_bin));
+  int k = 0;
+  double acc = 0.0;
+  for (size_t i = 0; i + 1 < nd && k < max_bin - 1; ++i) {
+    acc += static_cast<double>(counts[i]);
+    if (acc >= target) {
+      out_uppers[k++] = (distinct[i] + distinct[i + 1]) / 2.0;
+      acc = 0.0;
+    }
+  }
+  out_uppers[k++] = std::numeric_limits<double>::infinity();
+  return k;
+}
+
+void parallel_over(long count, int n_threads,
+                   const std::function<void(long, long)>& body) {
+  if (n_threads <= 1 || count <= 1) {
+    body(0, count);
+    return;
+  }
+  int workers = static_cast<int>(std::min<long>(n_threads, count));
+  std::vector<std::thread> pool;
+  pool.reserve(workers);
+  long per = (count + workers - 1) / workers;
+  for (int w = 0; w < workers; ++w) {
+    long lo = w * per, hi = std::min(count, lo + per);
+    if (lo >= hi) break;
+    pool.emplace_back([&body, lo, hi] { body(lo, hi); });
+  }
+  for (auto& t : pool) t.join();
+}
+
+}  // namespace
+
+extern "C" {
+
+// Fit every feature's bin uppers from a row-major sample Xs (n, F).
+// skip[f] != 0 → feature handled elsewhere (categorical), 0 uppers written.
+// out_uppers is (F, max_bin) row-major; out_counts[f] = #uppers for f.
+void mml_binner_fit(const double* Xs, long n, long F, int max_bin,
+                    int min_data_in_bin, const uint8_t* skip,
+                    double* out_uppers, int* out_counts, int n_threads) {
+  parallel_over(F, n_threads, [&](long f0, long f1) {
+    for (long f = f0; f < f1; ++f) {
+      if (skip[f]) {
+        out_counts[f] = 0;
+        continue;
+      }
+      out_counts[f] =
+          fit_numeric_col(Xs + f, n, F, max_bin, min_data_in_bin,
+                          out_uppers + f * max_bin);
+    }
+  });
+}
+
+// Bin a row-major matrix X (n, F) into uint8 bins: for each value, the
+// first bin whose (inclusive) upper bound is >= value — numpy
+// searchsorted(side="left") semantics; NaN → missing_bin.  Features with
+// counts[f] == 0 are left untouched (caller fills them).
+void mml_binner_transform(const double* X, long n, long F,
+                          const double* uppers, const int* counts,
+                          int max_bin, int missing_bin, uint8_t* out,
+                          int n_threads) {
+  parallel_over(F, n_threads, [&](long f0, long f1) {
+    for (long f = f0; f < f1; ++f) {
+      const int m = counts[f];
+      if (m == 0) continue;
+      const double* ub = uppers + f * max_bin;
+      for (long i = 0; i < n; ++i) {
+        const double x = X[i * F + f];
+        if (std::isnan(x)) {
+          out[i * F + f] = static_cast<uint8_t>(missing_bin);
+          continue;
+        }
+        const long j = std::lower_bound(ub, ub + m, x) - ub;
+        out[i * F + f] = static_cast<uint8_t>(j < m ? j : m - 1);
+      }
+    }
+  });
+}
+
+}  // extern "C"

@@ -4,8 +4,19 @@ TPU-native replacement for LightGBM's histogram construction (reference
 native component N1, SURVEY.md §2.9: upstream C++ ``src/treelearner/*`` and
 its CUDA kernels, shipped prebuilt in the ``lightgbmlib`` jar — [REF-EMPTY]).
 
-Three interchangeable backends build the same (features, bins, 3) tensor of
-``(Σgrad, Σhess, Σcount)`` per (feature, bin):
+Three interchangeable backends build the same CHANNEL-MAJOR histogram of
+``(Σgrad, Σhess, Σcount)``:
+
+- ``build_histogram``          → ``(3, F, B)``
+- ``build_histogram_by_leaf``  → ``(3, L, F, B)``
+
+Channel-major layout is a TPU tiling decision: every downstream consumer
+(cumsums, split gains) then operates on arrays whose MINOR axis is the
+bin axis (lane-sized), instead of a trailing size-3 channel axis that
+wastes ~97% of each 8×128 vector tile.  ``vals`` arrives as ``(3, n)`` for
+the same reason.
+
+Backends:
 
 - ``scatter``  — ``jnp...at[].add`` scatter-add.  Reference semantics; the
   backend used on the CPU test mesh.
@@ -33,12 +44,15 @@ DEFAULT_CHUNK = 16_384
 
 
 def _scatter_hist_chunk(bins_c, vals_c, num_bins: int):
-    """(C, F) int bins, (C, 3) vals → (F, B, 3) via scatter-add."""
+    """(C, F) int bins, (3, C) vals → (3, F, B) via scatter-add."""
     C, F = bins_c.shape
     idx = bins_c.astype(jnp.int32) + jnp.arange(F, dtype=jnp.int32)[None, :] * num_bins
-    contrib = jnp.broadcast_to(vals_c[:, None, :], (C, F, 3)).reshape(C * F, 3)
-    flat = jnp.zeros((F * num_bins, 3), jnp.float32).at[idx.reshape(-1)].add(contrib)
-    return flat.reshape(F, num_bins, 3)
+    flat = jax.vmap(
+        lambda v: jnp.zeros(F * num_bins, jnp.float32).at[idx.reshape(-1)].add(
+            jnp.broadcast_to(v[:, None], (C, F)).reshape(-1)
+        )
+    )(vals_c)
+    return flat.reshape(3, F, num_bins)
 
 
 def _onehot_hist_chunk(bins_c, vals_c, num_bins: int, feat_block: int = 8):
@@ -54,10 +68,10 @@ def _onehot_hist_chunk(bins_c, vals_c, num_bins: int, feat_block: int = 8):
     def block_hist(bl):  # (C, feat_block)
         oh = (bl[:, :, None] == jnp.arange(num_bins, dtype=bl.dtype)[None, None, :])
         oh = oh.astype(jnp.float32).reshape(C, feat_block * num_bins)
-        return (oh.T @ vals_c).reshape(feat_block, num_bins, 3)
+        return (vals_c @ oh).reshape(3, feat_block, num_bins)
 
-    hist = lax.map(block_hist, blocks)  # (Fp/fb, fb, B, 3)
-    return hist.reshape(Fp, num_bins, 3)[:F]
+    hist = lax.map(block_hist, blocks)  # (Fp/fb, 3, fb, B)
+    return hist.transpose(1, 0, 2, 3).reshape(3, Fp, num_bins)[:, :F]
 
 
 def build_histogram(
@@ -68,8 +82,10 @@ def build_histogram(
     backend: str = "scatter",
     chunk: int = DEFAULT_CHUNK,
     axis_name: Optional[str] = None,
+    precision: str = "highest",
 ) -> jnp.ndarray:
-    """Histogram of ``vals`` (n, 3) over (feature, bin), rows gated by ``mask``.
+    """Histogram of ``vals`` (3, n) over (feature, bin), rows gated by
+    ``mask``; returns (3, F, B).
 
     When ``axis_name`` is set (running inside ``shard_map`` over row shards),
     the result is ``psum``-med across the mesh axis — this single line is the
@@ -78,11 +94,10 @@ def build_histogram(
     §5.8 native component N2).
     """
     n, F = bins.shape
-    vals = jnp.where(mask[:, None], vals, 0.0).astype(jnp.float32)
     if backend == "pallas":
         from mmlspark_tpu.ops.pallas_hist import pallas_hist_chunk
 
-        fn = pallas_hist_chunk
+        fn = functools.partial(pallas_hist_chunk, precision=precision)
     elif backend == "onehot":
         fn = _onehot_hist_chunk
     elif backend == "scatter":
@@ -91,34 +106,44 @@ def build_histogram(
         raise ValueError(
             f"unknown hist backend {backend!r}; expected scatter|onehot|pallas"
         )
+    vals = jnp.where(mask[None, :], vals, 0.0).astype(jnp.float32)
     if n <= chunk:
         hist = fn(bins, vals, num_bins)
     else:
         if n % chunk != 0:
             raise ValueError(f"row count {n} not a multiple of chunk {chunk}")
         bc = bins.reshape(n // chunk, chunk, F)
-        vc = vals.reshape(n // chunk, chunk, 3)
+        vc = vals.reshape(3, n // chunk, chunk).transpose(1, 0, 2)
 
         def body(acc, xs):
             b, v = xs
             return acc + fn(b, v, num_bins), None
 
-        hist, _ = lax.scan(body, jnp.zeros((F, num_bins, 3), jnp.float32), (bc, vc))
+        hist, _ = lax.scan(body, jnp.zeros((3, F, num_bins), jnp.float32), (bc, vc))
     if axis_name is not None:
         hist = lax.psum(hist, axis_name)
     return hist
 
 
 def _scatter_hist_by_leaf_chunk(bins_c, vals_c, leaf_c, num_leaves: int, num_bins: int):
-    """(C, F) bins + (C, 3) vals + (C,) leaf ids → (L, F, B, 3) scatter-add."""
+    """(C, F) bins + (3, C) vals + (C,) leaf ids → (3, L, F, B) scatter-add.
+
+    Rows parked outside ``[0, num_leaves)`` (including NEGATIVE ids from the
+    windowed depthwise pass) are routed to a scratch slot and sliced off —
+    negative flat indices would otherwise WRAP in ``.at[].add``.
+    """
     C, F = bins_c.shape
-    base = leaf_c.astype(jnp.int32)[:, None] * (F * num_bins)
+    leaf_c = leaf_c.astype(jnp.int32)
+    parked = (leaf_c < 0) | (leaf_c >= num_leaves)
+    leaf_c = jnp.where(parked, num_leaves, leaf_c)
+    base = leaf_c[:, None] * (F * num_bins)
     idx = base + jnp.arange(F, dtype=jnp.int32)[None, :] * num_bins + bins_c.astype(jnp.int32)
-    contrib = jnp.broadcast_to(vals_c[:, None, :], (C, F, 3)).reshape(C * F, 3)
-    flat = jnp.zeros((num_leaves * F * num_bins, 3), jnp.float32).at[
-        idx.reshape(-1)
-    ].add(contrib)
-    return flat.reshape(num_leaves, F, num_bins, 3)
+    flat = jax.vmap(
+        lambda v: jnp.zeros((num_leaves + 1) * F * num_bins, jnp.float32)
+        .at[idx.reshape(-1)]
+        .add(jnp.broadcast_to(v[:, None], (C, F)).reshape(-1))
+    )(vals_c)
+    return flat.reshape(3, num_leaves + 1, F, num_bins)[:, :num_leaves]
 
 
 def build_histogram_by_leaf(
@@ -130,23 +155,24 @@ def build_histogram_by_leaf(
     backend: str = "scatter",
     chunk: int = DEFAULT_CHUNK,
     axis_name: Optional[str] = None,
+    precision: str = "highest",
 ) -> jnp.ndarray:
-    """Per-leaf histograms in ONE pass over the data: (L, F, B, 3).
+    """Per-leaf histograms in ONE pass over the data: (3, L, F, B).
 
-    The depthwise grower's workhorse (SURVEY.md §7.4.2): instead of one
-    full-data masked pass per split (O(n·F) × num_leaves per tree), every
-    level rebuilds all leaves' histograms together, so a tree costs
-    O(n·F · depth).  Rows to exclude (out of bag / padding) must arrive
-    with ``leaf_ids`` set to a parking slot ≥ ``num_leaves`` or zeroed
-    ``vals``.  With ``axis_name``, the result is psum-med across the mesh —
-    the same single-collective structure as :func:`build_histogram`.
+    The depthwise grower's workhorse (SURVEY.md §7.4.2): one pass histograms
+    every leaf slot in ``[0, num_leaves)`` together.  Rows to exclude
+    (out of bag / padding / other leaves — e.g. the windowed new-children
+    pass, which passes ``leaf_ids - base``) must arrive with ``leaf_ids``
+    outside ``[0, num_leaves)`` (any parked value, including negatives) or
+    zeroed ``vals``.  With ``axis_name``, the result is psum-med across the
+    mesh — the same single-collective structure as :func:`build_histogram`.
     """
     n, F = bins.shape
     vals = vals.astype(jnp.float32)
     if backend == "pallas":
         from mmlspark_tpu.ops.pallas_hist import pallas_hist_by_leaf_chunk
 
-        fn = pallas_hist_by_leaf_chunk
+        fn = functools.partial(pallas_hist_by_leaf_chunk, precision=precision)
     elif backend in ("scatter", "onehot"):
         fn = _scatter_hist_by_leaf_chunk
     else:
@@ -159,7 +185,7 @@ def build_histogram_by_leaf(
         if n % chunk != 0:
             raise ValueError(f"row count {n} not a multiple of chunk {chunk}")
         bc = bins.reshape(n // chunk, chunk, F)
-        vc = vals.reshape(n // chunk, chunk, 3)
+        vc = vals.reshape(3, n // chunk, chunk).transpose(1, 0, 2)
         lc = leaf_ids.reshape(n // chunk, chunk)
 
         def body(acc, xs):
@@ -167,7 +193,9 @@ def build_histogram_by_leaf(
             return acc + fn(b, v, l, num_leaves, num_bins), None
 
         hist, _ = lax.scan(
-            body, jnp.zeros((num_leaves, F, num_bins, 3), jnp.float32), (bc, vc, lc)
+            body,
+            jnp.zeros((3, num_leaves, F, num_bins), jnp.float32),
+            (bc, vc, lc),
         )
     if axis_name is not None:
         hist = lax.psum(hist, axis_name)

@@ -56,6 +56,8 @@ def _tree_block(
     split_feat: np.ndarray,
     split_bin: np.ndarray,
     default_left: np.ndarray,
+    split_cat: np.ndarray,
+    cat_threshold_bins: np.ndarray,  # (S, B) bool membership over bins
     split_gain: np.ndarray,
     leaf_value: np.ndarray,
     leaf_count: np.ndarray,
@@ -66,7 +68,32 @@ def _tree_block(
 ) -> str:
     active = [s for s in range(len(split_leaf)) if split_leaf[s] >= 0]
     S = len(active)
-    lines = [f"Tree={idx}", f"num_leaves={max(num_leaves, 1)}", "num_cat=0"]
+
+    # Categorical splits: LightGBM stores per-split uint32 bitsets over RAW
+    # category values in a flat ``cat_threshold`` array, delimited by
+    # ``cat_boundaries``; the per-node ``threshold`` is the split's index
+    # into those boundaries (upstream ``src/io/tree.cpp`` — [REF-EMPTY]).
+    cat_boundaries = [0]
+    cat_words: List[int] = []
+    cat_idx_of_pos: Dict[int, int] = {}
+    for pos, s in enumerate(active):
+        if not split_cat[s]:
+            continue
+        f = int(split_feat[s])
+        member_bins = np.nonzero(cat_threshold_bins[s])[0]
+        cats = bin_mapper.cat_maps[f][
+            member_bins[member_bins < len(bin_mapper.cat_maps[f])]
+        ].astype(np.int64)
+        n_words = (int(cats.max()) // 32 + 1) if cats.size else 1
+        words = [0] * n_words
+        for c in cats:
+            words[int(c) // 32] |= 1 << (int(c) % 32)
+        cat_idx_of_pos[pos] = len(cat_boundaries) - 1
+        cat_words.extend(words)
+        cat_boundaries.append(len(cat_words))
+    num_cat = len(cat_idx_of_pos)
+
+    lines = [f"Tree={idx}", f"num_leaves={max(num_leaves, 1)}", f"num_cat={num_cat}"]
     if S == 0:
         lines += [
             "split_feature=", "split_gain=", "threshold=", "decision_type=",
@@ -101,10 +128,14 @@ def _tree_block(
         (left_child if side == 0 else right_child)[p] = -(leaf_id + 1)
 
     thresholds = [
-        bin_mapper.bin_to_threshold(int(split_feat[s]), int(split_bin[s]))
-        for s in active
+        float(cat_idx_of_pos[pos])
+        if split_cat[s]
+        else bin_mapper.bin_to_threshold(int(split_feat[s]), int(split_bin[s]))
+        for pos, s in enumerate(active)
     ]
-    dts = [_decision_type(bool(default_left[s])) for s in active]
+    dts = [
+        _decision_type(bool(default_left[s]), bool(split_cat[s])) for s in active
+    ]
     lv = leaf_value[:num_leaves] * weight
     lc = leaf_count[:num_leaves]
     fmt = lambda arr, f: " ".join(f(v) for v in arr)  # noqa: E731
@@ -115,6 +146,13 @@ def _tree_block(
         "decision_type=" + fmt(dts, str),
         "left_child=" + fmt(left_child, str),
         "right_child=" + fmt(right_child, str),
+    ]
+    if num_cat:
+        lines += [
+            "cat_boundaries=" + fmt(cat_boundaries, str),
+            "cat_threshold=" + fmt(cat_words, str),
+        ]
+    lines += [
         "leaf_value=" + fmt(lv, lambda v: f"{v:.17g}"),
         "leaf_weight=" + fmt(lc, lambda v: f"{v:g}"),
         "leaf_count=" + fmt(lc.astype(np.int64), str),
@@ -183,6 +221,8 @@ def booster_to_string(booster, num_iteration=None) -> str:
     sf = np.asarray(trees.split_feat)
     sb = np.asarray(trees.split_bin)
     dl = np.asarray(trees.default_left)
+    sc = np.asarray(trees.split_cat)
+    ct = np.asarray(trees.cat_threshold)
     sg = np.asarray(trees.split_gain)
     lv = np.asarray(trees.leaf_value)
     lc = np.asarray(trees.leaf_count)
@@ -192,8 +232,8 @@ def booster_to_string(booster, num_iteration=None) -> str:
             blocks.append(
                 _tree_block(
                     t * K + k,
-                    sl[t, k], sf[t, k], sb[t, k], dl[t, k], sg[t, k],
-                    lv[t, k], lc[t, k], int(nl[t, k]),
+                    sl[t, k], sf[t, k], sb[t, k], dl[t, k], sc[t, k], ct[t, k],
+                    sg[t, k], lv[t, k], lc[t, k], int(nl[t, k]),
                     bm, cfg.learning_rate, float(booster.tree_weights[t]),
                 )
             )
@@ -260,22 +300,46 @@ def booster_from_string(s: str):
     obj_kv = dict(p.split(":", 1) for p in obj_parts[1:] if ":" in p)
     average_output = "average_output" in header
 
-    # Per-feature threshold vocabulary → reconstructed bin uppers.
+    # Pass 1: per-feature threshold vocabulary → reconstructed bin uppers;
+    # per-feature category vocabulary (union of all bitset members) →
+    # reconstructed cat_maps.  Categories never named by any split behave
+    # identically whether binned or sent to the missing bin (they are in no
+    # membership set, so they go right at every categorical split).
     parsed = []
     thresholds_per_feature: List[set] = [set() for _ in range(num_features)]
+    cats_per_feature: List[set] = [set() for _ in range(num_features)]
     for b in blocks:
         feat = _ints(b.get("split_feature", ""))
         thr = _floats(b.get("threshold", ""))
-        for f, t in zip(feat, thr):
-            thresholds_per_feature[f].add(float(t))
+        dts = _ints(b.get("decision_type", ""))
+        cat_bnd = _ints(b.get("cat_boundaries", ""))
+        cat_words = _ints(b.get("cat_threshold", ""))
+        for sidx, (f, t) in enumerate(zip(feat, thr)):
+            _, is_cat = _parse_decision_type(int(dts[sidx]))
+            if is_cat:
+                ci = int(t)
+                words = cat_words[cat_bnd[ci] : cat_bnd[ci + 1]]
+                for w_i, w in enumerate(words):
+                    for bit in range(32):
+                        if w & (1 << bit):
+                            cats_per_feature[f].add(w_i * 32 + bit)
+            else:
+                thresholds_per_feature[f].add(float(t))
         parsed.append(b)
     uppers = [
         np.array(sorted(ts) + [np.inf]) for ts in thresholds_per_feature
     ]
-    max_bin = max(2, max(len(u) for u in uppers))
-    bm = BinMapper(max_bin=max_bin)
+    cat_features = sorted(f for f in range(num_features) if cats_per_feature[f])
+    cat_maps = {f: np.array(sorted(cats_per_feature[f]), np.int64) for f in cat_features}
+    max_bin = max(
+        2,
+        max(len(u) for u in uppers),
+        max((len(m) for m in cat_maps.values()), default=2),
+    )
+    bm = BinMapper(max_bin=max_bin, categorical_features=cat_features)
     bm.num_features = num_features
     bm.upper_bounds = uppers
+    bm.cat_maps = cat_maps
     B = bm.num_bins
 
     n_trees = len(parsed)
@@ -292,6 +356,8 @@ def booster_from_string(s: str):
             split_feat=np.zeros(S, np.int32),
             split_bin=np.zeros(S, np.int32),
             default_left=np.zeros(S, bool),
+            split_cat=np.zeros(S, bool),
+            cat_threshold=np.zeros((S, B), bool),
             split_gain=np.zeros(S, np.float32),
             leaf_value=np.zeros(L, np.float32),
             leaf_count=np.zeros(L, np.float32),
@@ -306,6 +372,8 @@ def booster_from_string(s: str):
         dts = _ints(b.get("decision_type", ""))
         lch = _ints(b.get("left_child", ""))
         gains = _floats(b.get("split_gain", ""))
+        cat_bnd = _ints(b.get("cat_boundaries", ""))
+        cat_words = _ints(b.get("cat_threshold", ""))
         for sidx in range(len(feat)):
             # split_leaf = leftmost descendant leaf id (left children keep
             # the parent's leaf id through every split).
@@ -317,14 +385,24 @@ def booster_from_string(s: str):
                     break
                 node = int(c)
             f = int(feat[sidx])
-            t = int(np.searchsorted(uppers[f], thr[sidx], side="left"))
             dl, cat = _parse_decision_type(int(dts[sidx]))
-            if cat:
-                raise NotImplementedError("categorical model import not supported yet")
             out["split_leaf"][sidx] = leaf_id
             out["split_feat"][sidx] = f
-            out["split_bin"][sidx] = t
-            out["default_left"][sidx] = dl
+            if cat:
+                ci = int(thr[sidx])
+                words = cat_words[cat_bnd[ci] : cat_bnd[ci + 1]]
+                members = np.zeros(B, bool)
+                for b_i, c_val in enumerate(cat_maps[f]):
+                    w_i, bit = int(c_val) // 32, int(c_val) % 32
+                    if w_i < len(words) and (words[w_i] >> bit) & 1:
+                        members[b_i] = True
+                out["split_cat"][sidx] = True
+                out["cat_threshold"][sidx] = members
+            else:
+                out["split_bin"][sidx] = int(
+                    np.searchsorted(uppers[f], thr[sidx], side="left")
+                )
+                out["default_left"][sidx] = dl
             if sidx < len(gains):
                 out["split_gain"][sidx] = gains[sidx]
         return out

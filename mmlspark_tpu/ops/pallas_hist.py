@@ -1,4 +1,4 @@
-"""Pallas TPU kernel for gradient-histogram construction.
+"""Pallas TPU kernels for gradient-histogram construction.
 
 The TPU-native analog of LightGBM's CUDA histogram kernels (reference native
 component N1, SURVEY.md §2.9: upstream ``src/treelearner/cuda/`` /
@@ -7,22 +7,26 @@ CUDA's approach — per-thread-block shared-memory scatter-adds — does not map
 to the TPU's vector/matrix units, so the kernel reformulates histogramming
 as a contraction (SURVEY.md §7.4.2):
 
-    hist[c, f, b] = Σ_rows vals[row, c] * onehot[(f, b), row]
+    hist[c, f, b] = Σ_rows vals[c, row] * onehot_f[b, row]
 
-i.e. a (3, bm) × (bm, bf·B) matmul per (feature-block, row-block) grid cell
-that lands on the MXU, with the one-hot tile materialized **only in VMEM**
-(never HBM).  The grid iterates row-blocks innermost so each feature block's
-output tile stays resident in VMEM and accumulates across row blocks — the
-standard Pallas reduction pattern.
+i.e. per feature a (channels, rows) × (rows, B) matmul that lands on the
+MXU, with the one-hot tile materialized **only in VMEM** (never HBM).  The
+grid iterates row-blocks innermost so each feature block's output tile stays
+resident in VMEM and accumulates across row blocks — the standard Pallas
+reduction pattern.
 
 Layout choices (TPU tiling wants the last dim lane-sized):
 - bins arrive transposed as (F, rows) so a block is (bf, bm) with rows on
   the 128-lane axis;
-- the output is (3, F, B) with B on the lane axis, transposed back to the
-  engine's (F, B, 3) outside the kernel.
+- vals arrive channel-major (3, rows) — rows on lanes;
+- bin one-hots are built PER FEATURE as clean 2-D (B, rows) iota-compares:
+  a fused (bf, B, rows)→(bf·B, rows) one-hot needs a Mosaic lane relayout
+  that traced at ~10x the matmul cost;
+- outputs keep channels/leaves on sublanes and (feature-block · B) on
+  lanes; the unflatten to engine layout happens outside the kernel.
 
-VMEM budget per grid cell (defaults bm=512, bf=8, B=256):
-one-hot 2048×512 f32 = 4 MiB + in/out tiles ≪ 16 MiB/core.
+VMEM budget per grid cell (by-leaf defaults bm=8192, bf=8, rm=1024):
+one-hot (256, 1024) f32 = 1 MiB + rhs/out tiles ≪ 16 MiB/core.
 """
 
 from __future__ import annotations
@@ -33,29 +37,41 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
+_PRECISIONS = {
+    "highest": jax.lax.Precision.HIGHEST,
+    "default": jax.lax.Precision.DEFAULT,
+}
 
-def _hist_kernel(bins_ref, vals_ref, out_ref, *, num_bins: int):
+
+def _round_up(x: int, m: int) -> int:
+    return ((x + m - 1) // m) * m
+
+
+def _hist_kernel(bins_ref, vals_ref, out_ref, *, num_bins: int, precision):
     """One (feature-block j, row-block i) cell: out[j] += vals·onehotᵀ."""
     i = pl.program_id(1)  # row block (innermost → accumulation is safe)
     bins = bins_ref[...]  # (bf, bm) int32
-    vals = vals_ref[...]  # (bm, 3) f32
+    vals = vals_ref[...]  # (3, bm) f32
     bf, bm = bins.shape
-    # One-hot over bins, rows on lanes — lives only in VMEM/registers.
-    iota = jax.lax.broadcasted_iota(jnp.int32, (bf, num_bins, bm), 1)
-    onehot = (iota == bins[:, None, :]).astype(jnp.float32)
-    onehot = onehot.reshape(bf * num_bins, bm)
-    # (3, bm) × (bm, bf*B) on the MXU.
-    # HIGHEST precision: the MXU's bf16-multiply default loses ~1e-3 per
-    # element, which corrupts split gains on near-tied candidates.  The
-    # one-hot operand is exactly representable, so f32 accumulate restores
-    # scatter-add-equivalent numerics.
-    part = jax.lax.dot_general(
-        vals, onehot,
-        dimension_numbers=(((0,), (1,)), ((), ())),
-        preferred_element_type=jnp.float32,
-        precision=jax.lax.Precision.HIGHEST,
-    )  # (3, bf*B) — kept flat: Mosaic can't lane-split (3, bf*B)→(3, bf, B)
-    # when B < 128, so the (F, B) unflatten happens outside the kernel.
+    # Per-feature 2-D one-hot over bins, rows on lanes — VMEM only.
+    # Precision: HIGHEST = f32 passes (scatter-add-exact numerics — the
+    # MXU's bf16-multiply default loses ~1e-3 per element, which can flip
+    # near-tied split gains); DEFAULT = bf16 multiplies with f32
+    # accumulation, ~4x throughput (the one-hot operand is exact either
+    # way).  Chosen by GrowConfig.hist_precision.
+    iota_b = jax.lax.broadcasted_iota(jnp.int32, (num_bins, bm), 0)
+    parts = []
+    for f in range(bf):
+        oh_f = (iota_b == bins[f, :][None, :]).astype(jnp.float32)
+        parts.append(
+            jax.lax.dot_general(
+                vals, oh_f,
+                dimension_numbers=(((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+                precision=precision,
+            )  # (3, B)
+        )
+    part = jnp.concatenate(parts, axis=1)  # (3, bf·B)
 
     @pl.when(i == 0)
     def _init():
@@ -66,20 +82,26 @@ def _hist_kernel(bins_ref, vals_ref, out_ref, *, num_bins: int):
         out_ref[...] += part[None, :, :]
 
 
-@functools.partial(jax.jit, static_argnames=("num_bins", "bm", "bf", "interpret"))
-def _pallas_hist(bins_t, vals, num_bins: int, bm: int, bf: int, interpret: bool):
+@functools.partial(
+    jax.jit, static_argnames=("num_bins", "bm", "bf", "interpret", "precision")
+)
+def _pallas_hist(
+    bins_t, vals, num_bins: int, bm: int, bf: int, interpret: bool, precision: str
+):
     F, n = bins_t.shape
-    kernel = functools.partial(_hist_kernel, num_bins=num_bins)
+    kernel = functools.partial(
+        _hist_kernel, num_bins=num_bins, precision=_PRECISIONS[precision]
+    )
     out = pl.pallas_call(
         kernel,
         grid=(F // bf, n // bm),
         in_specs=[
             pl.BlockSpec((bf, bm), lambda j, i: (j, i)),
-            pl.BlockSpec((bm, 3), lambda j, i: (i, 0)),
+            pl.BlockSpec((3, bm), lambda j, i: (0, i)),
         ],
         # Output layout (F/bf, 3, bf·B): feature-block leading so the block
         # shape's last two dims (3, bf·B) satisfy TPU tiling by equalling
-        # the array dims; channels/bins unflatten outside the kernel.
+        # the array dims; the bin unflatten happens outside the kernel.
         out_specs=pl.BlockSpec((1, 3, bf * num_bins), lambda j, i: (j, 0, 0)),
         out_shape=jax.ShapeDtypeStruct((F // bf, 3, bf * num_bins), jnp.float32),
         interpret=interpret,
@@ -88,14 +110,14 @@ def _pallas_hist(bins_t, vals, num_bins: int, bm: int, bf: int, interpret: bool)
 
 
 def pallas_hist_chunk(
-    bins_c, vals_c, num_bins: int, bm: int = 512, bf: int = 8
+    bins_c, vals_c, num_bins: int, bm: int = 512, bf: int = 8,
+    precision: str = "highest",
 ) -> jnp.ndarray:
-    """(C, F) int bins + (C, 3) vals → (F, B, 3), same contract as the
+    """(C, F) int bins + (3, C) vals → (3, F, B), same contract as the
     scatter/onehot chunk builders in :mod:`mmlspark_tpu.ops.histogram`.
 
     Pads rows/features up to block multiples (padded rows carry zero vals,
-    padded features are sliced off) and transposes the kernel's
-    lane-friendly layouts back to the engine's (F, B, 3).
+    padded features are sliced off).
     """
     C, F = bins_c.shape
     bins_t = bins_c.astype(jnp.int32).T  # (F, C): rows on the lane axis
@@ -105,7 +127,7 @@ def pallas_hist_chunk(
     pad_f = (-F) % bf
     if pad_r:
         bins_t = jnp.pad(bins_t, ((0, 0), (0, pad_r)))
-        vals_c = jnp.pad(vals_c, ((0, pad_r), (0, 0)))
+        vals_c = jnp.pad(vals_c, ((0, 0), (0, pad_r)))
     if pad_f:
         bins_t = jnp.pad(bins_t, ((0, pad_f), (0, 0)))
     backend = jax.default_backend()
@@ -117,32 +139,32 @@ def pallas_hist_chunk(
             f"hist_backend='pallas' supports tpu (compiled) and cpu "
             f"(interpret) backends, not {backend!r}; use 'scatter'"
         )
-    out = _pallas_hist(bins_t, vals_c, num_bins, bm, bf, backend == "cpu")
-    return out[:, :F, :].transpose(1, 2, 0)  # (3, Fp, B) → (F, B, 3)
-
-
-def _round_up(x: int, m: int) -> int:
-    return ((x + m - 1) // m) * m
+    out = _pallas_hist(
+        bins_t, vals_c, num_bins, bm, bf, backend == "cpu", precision
+    )
+    return out[:, :F, :]  # (3, F, B)
 
 
 # ---------------------------------------------------------------------------
-# Per-leaf histograms (depthwise grower): hist[l, f, b, c] in one data pass.
+# Per-leaf histograms (depthwise grower): hist[c, l, f, b] in one data pass.
 #
-# Contraction: out[fb, l·3+c] = Σ_r onehot_bins[fb, r] · (vals[r, c] ·
-# onehot_leaf[r, l]).  The leaf axis multiplies the matmul's tiny N=3
-# channel dimension up to 3·L — at L=64 that is N=192, which finally feeds
-# the 128-wide MXU properly (the single-leaf kernel idles ~97% of it).
+# Contraction per feature: out[(c·L+l), b] = Σ_r rhs[r, c·L+l] · onehot[b, r]
+# where rhs[r, c·L+l] = vals[c, r] · (leaf[r] == l).  The leaf axis
+# multiplies the matmul's tiny channel dimension up to 3·L — at the
+# depthwise window W=32 that is M=96, which feeds the MXU properly.
 # ---------------------------------------------------------------------------
 def _hist_leaf_kernel(
-    bins_ref, vals_ref, leaf_ref, out_ref, *, num_bins: int, num_leaves: int, rm: int
+    bins_ref, vals_ref, leaf_ref, out_ref, *,
+    num_bins: int, num_leaves: int, rm: int, precision,
 ):
     """One (feature-block j, row-block i) cell.
 
     The row block (bm) is deliberately LARGE with an in-kernel
-    accumulation loop over ``rm``-row sub-blocks: the one-hot tile only
-    ever exists at (bf·B, rm) in VMEM, while the grid stays coarse — at
-    bm=rm the grid overhead of ~8k tiny cells dominated the pass (178ms
-    measured for a 262k×64 pass that is ~5ms of MXU work).
+    accumulation loop over ``rm``-row sub-blocks: VMEM tiles are bounded by
+    ``rm`` while the grid stays coarse — at bm=rm the grid overhead of ~8k
+    tiny cells dominated the pass.  ``rm`` is also the matmul contraction
+    length: small rm left the MXU latency-bound (65k tiny matmuls at
+    rm=256 traced ~10x slower than rm=1024).
     """
     i = pl.program_id(1)  # row block, innermost → accumulation is safe
     bf, bm = bins_ref.shape
@@ -150,30 +172,35 @@ def _hist_leaf_kernel(
     def sub(s, acc):
         sl = pl.ds(s * rm, rm)
         bins = bins_ref[:, sl]  # (bf, rm) int32
-        vals = vals_ref[sl, :]  # (rm, 3) f32
+        vals = vals_ref[:, sl]  # (3, rm) f32
         leaf = leaf_ref[0, sl]  # (rm,) int32
-        iota_b = jax.lax.broadcasted_iota(jnp.int32, (bf, num_bins, rm), 1)
-        oh_bins = (iota_b == bins[:, None, :]).astype(jnp.float32)
-        oh_bins = oh_bins.reshape(bf * num_bins, rm)
         # Leaf-masked values, channel-major columns: rhs[r, c·L + l] =
-        # vals[r, c] · (leaf[r] == l).  Three lane-dim concats because
-        # Mosaic cannot lane-merge a trailing (L, 3) pair.  Rows parked at
-        # leaf >= num_leaves (out-of-bag/padding) match no slot → 0.
+        # vals[c, r] · (leaf[r] == l).  Three lane-dim concats because
+        # Mosaic cannot lane-merge a trailing (L, 3) pair.  Rows parked
+        # outside [0, num_leaves) (out-of-bag/padding/windowed-out) match
+        # no slot → 0.
         iota_l = jax.lax.broadcasted_iota(jnp.int32, (rm, num_leaves), 1)
         oh_leaf = (iota_l == leaf[:, None]).astype(jnp.float32)
         rhs = jnp.concatenate(
-            [oh_leaf * vals[:, c][:, None] for c in range(3)], axis=1
+            [oh_leaf * vals[c, :][:, None] for c in range(3)], axis=1
         )  # (rm, 3·L)
+        iota_b = jax.lax.broadcasted_iota(jnp.int32, (num_bins, rm), 0)
+        parts = []
+        for f in range(bf):
+            oh_f = (iota_b == bins[f, :][None, :]).astype(jnp.float32)
+            parts.append(
+                jax.lax.dot_general(
+                    rhs, oh_f,
+                    dimension_numbers=(((0,), (1,)), ((), ())),
+                    preferred_element_type=jnp.float32,
+                    precision=precision,
+                )  # (3·L, B)
+            )
         # Output (3·L, bf·B): the small 3·L axis on SUBLANES (pads to a
         # multiple of 8) and the big bf·B axis on lanes — the transposed
         # orientation padded 3·L up to 256 lanes and blew the 16M VMEM
         # budget through the grid-resident accumulator tile.
-        return acc + jax.lax.dot_general(
-            rhs, oh_bins,
-            dimension_numbers=(((0,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32,
-            precision=jax.lax.Precision.HIGHEST,
-        )  # (3·L, bf·B)
+        return acc + jnp.concatenate(parts, axis=1)  # (3·L, bf·B)
 
     part = jax.lax.fori_loop(
         0, bm // rm, sub,
@@ -190,19 +217,25 @@ def _hist_leaf_kernel(
 
 
 @functools.partial(
-    jax.jit, static_argnames=("num_leaves", "num_bins", "bm", "bf", "rm", "interpret")
+    jax.jit,
+    static_argnames=(
+        "num_leaves", "num_bins", "bm", "bf", "rm", "interpret", "precision"
+    ),
 )
-def _pallas_hist_by_leaf(bins_t, vals, leaf_ids, num_leaves, num_bins, bm, bf, rm, interpret):
+def _pallas_hist_by_leaf(
+    bins_t, vals, leaf_ids, num_leaves, num_bins, bm, bf, rm, interpret, precision
+):
     F, n = bins_t.shape
     kernel = functools.partial(
-        _hist_leaf_kernel, num_bins=num_bins, num_leaves=num_leaves, rm=rm
+        _hist_leaf_kernel, num_bins=num_bins, num_leaves=num_leaves, rm=rm,
+        precision=_PRECISIONS[precision],
     )
     out = pl.pallas_call(
         kernel,
         grid=(F // bf, n // bm),
         in_specs=[
             pl.BlockSpec((bf, bm), lambda j, i: (j, i)),
-            pl.BlockSpec((bm, 3), lambda j, i: (i, 0)),
+            pl.BlockSpec((3, bm), lambda j, i: (0, i)),
             pl.BlockSpec((1, bm), lambda j, i: (0, i)),
         ],
         out_specs=pl.BlockSpec(
@@ -213,19 +246,19 @@ def _pallas_hist_by_leaf(bins_t, vals, leaf_ids, num_leaves, num_bins, bm, bf, r
         ),
         interpret=interpret,
     )(bins_t, vals, leaf_ids)
-    # (F/bf, 3·L, bf·B) channel-major → (L, F, B, 3)
+    # (F/bf, 3·L, bf·B) channel-major → (3, L, F, B)
     out = out.reshape(F // bf, 3, num_leaves, bf, num_bins)
-    return out.transpose(2, 0, 3, 4, 1).reshape(num_leaves, F, num_bins, 3)
+    return out.transpose(1, 2, 0, 3, 4).reshape(3, num_leaves, F, num_bins)
 
 
 def pallas_hist_by_leaf_chunk(
     bins_c, vals_c, leaf_c, num_leaves: int, num_bins: int,
-    bm: int = 4096, bf: int = 8, rm: int = 256,
+    bm: int = 8192, bf: int = 8, rm: int = 1024, precision: str = "highest",
 ) -> jnp.ndarray:
-    """(C, F) bins + (C, 3) vals + (C,) leaf ids → (L, F, B, 3).
+    """(C, F) bins + (3, C) vals + (C,) leaf ids → (3, L, F, B).
 
-    ``rm`` bounds the VMEM one-hot tile (rm=256 keeps it under the 16M
-    scoped limit with B=256); ``bm`` is the DMA/grid granularity.
+    ``rm`` bounds the VMEM one-hot tile AND sets the matmul contraction
+    length; ``bm`` is the DMA/grid granularity.
     """
     import jax as _jax
 
@@ -244,12 +277,13 @@ def pallas_hist_by_leaf_chunk(
     pad_f = (-F) % bf
     if pad_r:
         bins_t = jnp.pad(bins_t, ((0, 0), (0, pad_r)))
-        vals_c = jnp.pad(vals_c, ((0, pad_r), (0, 0)))
+        vals_c = jnp.pad(vals_c, ((0, 0), (0, pad_r)))
         # padded rows park at leaf == num_leaves → no one-hot slot
         leaf_row = jnp.pad(leaf_row, ((0, 0), (0, pad_r)), constant_values=num_leaves)
     if pad_f:
         bins_t = jnp.pad(bins_t, ((0, pad_f), (0, 0)))
     out = _pallas_hist_by_leaf(
-        bins_t, vals_c, leaf_row, num_leaves, num_bins, bm, bf, rm, backend == "cpu"
+        bins_t, vals_c, leaf_row, num_leaves, num_bins, bm, bf, rm,
+        backend == "cpu", precision,
     )
-    return out[:, :F]
+    return out[:, :, :F]

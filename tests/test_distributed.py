@@ -49,7 +49,7 @@ class TestShardedHistogram:
         rng = np.random.default_rng(1)
         n, F, B = 1024, 6, 17
         bins = rng.integers(0, B, size=(n, F)).astype(np.int32)
-        vals = rng.normal(size=(n, 3)).astype(np.float32)
+        vals = rng.normal(size=(3, n)).astype(np.float32)
         mask = rng.random(n) < 0.8
 
         ref = np.asarray(build_histogram(jnp.asarray(bins), jnp.asarray(vals), jnp.asarray(mask), B))
@@ -58,12 +58,12 @@ class TestShardedHistogram:
         sharded = jax.shard_map(
             lambda b, v, m: build_histogram(b, v, m, B, axis_name="data"),
             mesh=mesh,
-            in_specs=(P("data", None), P("data", None), P("data")),
+            in_specs=(P("data", None), P(None, "data"), P("data")),
             out_specs=P(),
             check_vma=False,
         )
         bins_s = jax.device_put(bins, NamedSharding(mesh, P("data", None)))
-        vals_s = jax.device_put(vals, NamedSharding(mesh, P("data", None)))
+        vals_s = jax.device_put(vals, NamedSharding(mesh, P(None, "data")))
         mask_s = jax.device_put(mask, NamedSharding(mesh, P("data")))
         out = np.asarray(jax.jit(sharded)(bins_s, vals_s, mask_s))
         np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-5)

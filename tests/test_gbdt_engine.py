@@ -79,15 +79,15 @@ class TestHistogram:
         grad = rng.normal(size=n)
         hess = rng.uniform(0.1, 1, size=n)
         mask = rng.random(n) > 0.3
-        vals = np.stack([grad, hess, np.ones(n)], -1)
+        vals = np.stack([grad, hess, np.ones(n)], 0)  # (3, n) channel-major
         hist = np.asarray(
             build_histogram(jnp.asarray(bins), jnp.asarray(vals), jnp.asarray(mask), B)
-        )
+        )  # (3, F, B)
         for f in range(F):
             for b in range(B):
                 sel = (bins[:, f] == b) & mask
-                np.testing.assert_allclose(hist[f, b, 0], grad[sel].sum(), rtol=1e-5, atol=1e-5)
-                np.testing.assert_allclose(hist[f, b, 2], sel.sum(), rtol=1e-6)
+                np.testing.assert_allclose(hist[0, f, b], grad[sel].sum(), rtol=1e-5, atol=1e-5)
+                np.testing.assert_allclose(hist[2, f, b], sel.sum(), rtol=1e-6)
 
     def test_onehot_matches_scatter(self):
         import jax.numpy as jnp
@@ -97,7 +97,7 @@ class TestHistogram:
         rng = np.random.default_rng(2)
         n, F, B = 128, 7, 12
         bins = jnp.asarray(rng.integers(0, B, size=(n, F)))
-        vals = jnp.asarray(rng.normal(size=(n, 3)))
+        vals = jnp.asarray(rng.normal(size=(3, n)))
         mask = jnp.ones(n, bool)
         h1 = build_histogram(bins, vals, mask, B, backend="scatter")
         h2 = build_histogram(bins, vals, mask, B, backend="onehot")
@@ -111,7 +111,7 @@ class TestHistogram:
         rng = np.random.default_rng(3)
         n, F, B = 512, 3, 8
         bins = jnp.asarray(rng.integers(0, B, size=(n, F)))
-        vals = jnp.asarray(rng.normal(size=(n, 3)))
+        vals = jnp.asarray(rng.normal(size=(3, n)))
         mask = jnp.ones(n, bool)
         h1 = build_histogram(bins, vals, mask, B, chunk=128)
         h2 = build_histogram(bins, vals, mask, B, chunk=1024)
@@ -125,7 +125,7 @@ class TestHistogram:
         rng = np.random.default_rng(5)
         for (n, F, B) in [(257, 5, 16), (1024, 9, 64)]:
             bins = jnp.asarray(rng.integers(0, B, size=(n, F)))
-            vals = jnp.asarray(rng.normal(size=(n, 3)))
+            vals = jnp.asarray(rng.normal(size=(3, n)))
             mask = jnp.asarray(rng.random(n) > 0.3)
             h1 = build_histogram(bins, vals, mask, B, backend="scatter")
             h2 = build_histogram(bins, vals, mask, B, backend="pallas")
@@ -383,7 +383,7 @@ class TestWarmStartAndGuards:
         import pytest
 
         with pytest.raises(ValueError, match="hist backend"):
-            build_histogram(jnp.zeros((4, 2), jnp.int32), jnp.zeros((4, 3)),
+            build_histogram(jnp.zeros((4, 2), jnp.int32), jnp.zeros((3, 4)),
                             jnp.ones(4, bool), 4, backend="one_hot")
 
 
