@@ -468,7 +468,19 @@ def train(
     allreduce (SURVEY.md §3.1, §5.8 N2).  Every shard then computes an
     identical best split, exactly LightGBM's ``tree_learner=data`` semantics.
     """
+    import warnings
+
     cfg = params if isinstance(params, TrainConfig) else TrainConfig.from_params(params)
+    if cfg.tree_learner in ("feature", "feature_parallel"):
+        # LightGBM's feature-parallel partitions columns but still needs all
+        # data on every worker; on a TPU mesh it has no bandwidth advantage
+        # over data-parallel.  Be loud instead of silently degrading
+        # (round-1 advisor finding).
+        warnings.warn(
+            "tree_learner='feature' is not implemented; training with the "
+            "serial learner (identical model — feature-parallel changes "
+            "communication, not results)"
+        )
     if cfg.boosting == "dart" and cfg.early_stopping_round > 0:
         # Later DART iterations rescale earlier trees, so a truncated-at-
         # best-iteration model cannot reproduce the selected metric.
@@ -614,6 +626,21 @@ def train(
         init_scores_dev = init_scores_dev + init_model._raw_scores_binned(bins_dev)
     scores = init_scores_dev
 
+    voting = (
+        cfg.tree_learner in ("voting", "voting_parallel")
+        and mesh is not None
+        and D > 1
+    )
+    grow_policy = cfg.grow_policy
+    if voting and grow_policy != "depthwise":
+        # The two-round vote is level-synchronous by construction; the
+        # lossguide (one-split-per-step) grower would vote on a single leaf
+        # at a time, which is just data-parallel with extra rounds.
+        warnings.warn(
+            "voting_parallel uses the depthwise grower; overriding "
+            f"grow_policy={grow_policy!r}"
+        )
+        grow_policy = "depthwise"
     gcfg = GrowConfig(
         num_bins=B,
         num_leaves=cfg.num_leaves,
@@ -627,11 +654,13 @@ def train(
         hist_backend=cfg.hist_backend,
         hist_chunk=chunk,
         hist_precision=cfg.hist_precision,
-        grow_policy=cfg.grow_policy,
+        grow_policy=grow_policy,
         categorical_features=tuple(int(f) for f in cfg.categorical_feature),
         cat_smooth=cfg.cat_smooth,
         cat_l2=cfg.cat_l2,
         max_cat_threshold=cfg.max_cat_threshold,
+        voting=voting,
+        top_k=cfg.top_k,
     )
 
     def _grow_classes(gcfg_):
@@ -836,6 +865,12 @@ def train(
 
         if cfg.early_stopping_round > 0 and vsets:
             chunk_iters = min(n_iter, max(cfg.early_stopping_round, 1))
+        elif vsets:
+            # Metrics need per-iteration valid-score snapshots, which scan
+            # stacks into a (chunk, K, n_valid) buffer — cap the chunk so
+            # that buffer (and its host transfer) stays bounded regardless
+            # of num_iterations × valid size.
+            chunk_iters = min(n_iter, 64)
         else:
             chunk_iters = n_iter
 

@@ -82,6 +82,14 @@ class GrowConfig:
     cat_smooth: float = 10.0
     cat_l2: float = 10.0
     max_cat_threshold: int = 32
+    # Voting-parallel (SURVEY.md §2 parallelism table; LightGBM
+    # tree_learner=voting): workers keep LOCAL histograms, vote their
+    # top_k features per leaf by local gain, and only the globally
+    # top-(2·top_k)-voted features' histograms are psum-med for the exact
+    # split decision — the bandwidth-reduced data-parallel mode.  Only
+    # meaningful under shard_map (axis_name set); depthwise grower only.
+    voting: bool = False
+    top_k: int = 20
 
     @property
     def num_value_bins(self) -> int:
@@ -94,6 +102,10 @@ class GrowConfig:
     @property
     def has_categoricals(self) -> bool:
         return len(self.categorical_features) > 0
+
+    @property
+    def voting_active(self) -> bool:
+        return self.voting and self.axis_name is not None
 
     @property
     def level_window(self) -> int:
@@ -161,6 +173,8 @@ def _numeric_candidates(cfg: GrowConfig, hists, leaf_stats, feat_mask):
     totG = leaf_stats[0][:, None, None]  # (L, 1, 1)
     totH = leaf_stats[1][:, None, None]
     totC = leaf_stats[2][:, None, None]
+    # feat_mask may be (F,) shared or (L, F) per-leaf (voting-parallel).
+    fm2 = jnp.broadcast_to(feat_mask, (L, F))
     parent = _leaf_score(leaf_stats[0], leaf_stats[1], cfg.lambda_l1, cfg.lambda_l2)
 
     def direction(dleft):
@@ -183,7 +197,7 @@ def _numeric_candidates(cfg: GrowConfig, hists, leaf_stats, feat_mask):
             & (Hl >= cfg.min_sum_hessian_in_leaf)
             & (Hr >= cfg.min_sum_hessian_in_leaf)
         )
-        valid &= feat_mask[None, :, None]
+        valid &= fm2[..., None]
         gain = jnp.where(valid, gain, -jnp.inf)  # (L, F, VB)
         t = jnp.argmax(gain, axis=-1)  # (L, F)
         return jnp.take_along_axis(gain, t[..., None], axis=-1)[..., 0], t
@@ -225,6 +239,7 @@ def _cat_candidates(cfg: GrowConfig, hists, leaf_stats, feat_mask):
     _, L, F, B = hists.shape
     VB = B - 1
     hist_vb = hists[:, :, :, :VB]  # (3, L, F, VB)
+    # (feat_mask may be (F,) shared or (L, F) per-leaf — see numeric)
     l2 = cfg.lambda_l2 + cfg.cat_l2
     parent = _leaf_score(leaf_stats[0], leaf_stats[1], cfg.lambda_l1, l2)
 
@@ -251,7 +266,7 @@ def _cat_candidates(cfg: GrowConfig, hists, leaf_stats, feat_mask):
             & (Cr >= cfg.min_data_in_leaf)
             & (Hl >= cfg.min_sum_hessian_in_leaf)
             & (Hr >= cfg.min_sum_hessian_in_leaf)
-            & feat_mask[None, :, None]
+            & jnp.broadcast_to(feat_mask, (L, F))[..., None]
         )
         gain = jnp.where(valid, gain, -jnp.inf)
         best_k = jnp.argmax(gain, axis=-1)  # (L,F)
@@ -298,14 +313,13 @@ def _cat_feat_mask(cfg: GrowConfig, F: int) -> np.ndarray:
     return m
 
 
-def _leaf_candidates(cfg: GrowConfig, hists, leaf_stats, feat_mask):
-    """Best candidate PER LEAF over all features (numeric + categorical).
+def _candidate_matrix(cfg: GrowConfig, hists, leaf_stats, feat_mask):
+    """Best candidate per (leaf, feature): (gain, t, d) each (L, F).
 
-    Returns per-leaf (gain (L,), feat, t, d, is_cat) where for numeric
-    features ``t`` is the threshold bin and ``d`` the missing-left flag;
-    for categorical features ``t`` is the sorted-prefix length - 1 and
-    ``d`` the sort direction.  Leaves with no valid candidate get
-    gain=-inf.  hists is channel-major (3, L, F, B).
+    For numeric features ``t`` is the threshold bin and ``d`` the
+    missing-left flag; for categorical features ``t`` is the sorted-prefix
+    length - 1 and ``d`` the sort direction.  hists is channel-major
+    (3, L, F, B); feat_mask is (F,) or per-leaf (L, F).
     """
     _, L, F, B = hists.shape
     gain, t, d = _numeric_candidates(cfg, hists, leaf_stats, feat_mask)
@@ -315,12 +329,24 @@ def _leaf_candidates(cfg: GrowConfig, hists, leaf_stats, feat_mask):
         # all F and masking wasted ~F/n_cat of the sort work.
         cat_idx = jnp.asarray(cfg.categorical_features, dtype=jnp.int32)
         hists_cat = jnp.take(hists, cat_idx, axis=2)  # (3, L, nc, B)
+        fm = jnp.broadcast_to(feat_mask, (L, F))
         cgain, ck, cdesc = _cat_candidates(
-            cfg, hists_cat, leaf_stats, feat_mask[cat_idx]
+            cfg, hists_cat, leaf_stats, jnp.take(fm, cat_idx, axis=1)
         )
         gain = gain.at[:, cat_idx].set(cgain)
         t = t.at[:, cat_idx].set(ck)
         d = d.at[:, cat_idx].set(cdesc)
+    return gain, t, d
+
+
+def _leaf_candidates(cfg: GrowConfig, hists, leaf_stats, feat_mask):
+    """Best candidate PER LEAF over all features (numeric + categorical).
+
+    Returns per-leaf (gain (L,), feat, t, d, is_cat); leaves with no valid
+    candidate get gain=-inf.  hists is channel-major (3, L, F, B).
+    """
+    _, L, F, B = hists.shape
+    gain, t, d = _candidate_matrix(cfg, hists, leaf_stats, feat_mask)
     f = jnp.argmax(gain, axis=1).astype(jnp.int32)  # (L,)
     take = lambda a: jnp.take_along_axis(a, f[:, None], axis=1)[:, 0]  # noqa: E731
     best_gain = take(gain)
@@ -329,6 +355,66 @@ def _leaf_candidates(cfg: GrowConfig, hists, leaf_stats, feat_mask):
     else:
         is_cat = jnp.zeros(L, bool)
     return best_gain, f, take(t), take(d), is_cat
+
+
+def _voting_leaf_candidates(cfg: GrowConfig, hists_local, leaf_stats_local, feat_mask):
+    """Per-leaf best split under voting-parallel (LightGBM
+    ``tree_learner=voting`` — SURVEY.md §2 parallelism table, §5.8).
+
+    Two rounds per level instead of a full-histogram allreduce:
+
+    1. VOTE — every shard scores candidates on its LOCAL histograms and
+       votes its ``top_k`` features per leaf; votes are psum-med and the
+       top ``2·top_k``-voted features per leaf are elected (ties broken by
+       feature index — deterministic, so every shard elects identically).
+    2. EXACT — only the elected features' histogram slices are psum-med
+       (``(3, L, 2k, B)`` instead of ``(3, L, F, B)``), and the final
+       split decision is computed exactly on those global histograms with
+       globally-summed leaf stats.
+
+    Returns (gain (L,), f, t, d, is_cat, hists_sel (3,L,2k,B), sel (L,2k),
+    j (L,)) — the elected-histogram block and per-leaf winner column are
+    returned so categorical membership sets can be built from GLOBAL
+    statistics.
+    """
+    _, L, F, B = hists_local.shape
+    k = min(cfg.top_k, F)
+    k2 = min(2 * k, F)
+
+    # Round 1: local candidate gains → per-leaf top-k feature votes.
+    vgain, _, _ = _candidate_matrix(cfg, hists_local, leaf_stats_local, feat_mask)
+    _, topi = jax.lax.top_k(vgain, k)  # (L, k)
+    votes = jnp.zeros((L, F), jnp.float32).at[
+        jnp.arange(L)[:, None], topi
+    ].add(1.0)
+    votes = lax.psum(votes, cfg.axis_name)
+    _, sel = jax.lax.top_k(votes, k2)  # (L, k2); stable → replicated
+
+    # Round 2: psum only the elected features' histograms.
+    hists_sel = jnp.take_along_axis(
+        hists_local, sel[None, :, :, None], axis=2
+    )  # (3, L, k2, B)
+    hists_sel = lax.psum(hists_sel, cfg.axis_name)
+    leaf_stats = lax.psum(leaf_stats_local, cfg.axis_name)
+
+    fm = jnp.broadcast_to(feat_mask, (L, F))
+    fm_sel = jnp.take_along_axis(fm, sel, axis=1)  # (L, k2)
+    gain_s, t_s, d_s = _numeric_candidates(cfg, hists_sel, leaf_stats, fm_sel)
+    if cfg.has_categoricals:
+        cmask = jnp.asarray(_cat_feat_mask(cfg, F))
+        cmask_sel = cmask[sel]  # (L, k2) — dynamic election: no static subset
+        cgain, ck, cdesc = _cat_candidates(cfg, hists_sel, leaf_stats, fm_sel)
+        gain_s = jnp.where(cmask_sel, cgain, gain_s)
+        t_s = jnp.where(cmask_sel, ck, t_s)
+        d_s = jnp.where(cmask_sel, cdesc, d_s)
+    j = jnp.argmax(gain_s, axis=1).astype(jnp.int32)  # (L,) winner column
+    take = lambda a: jnp.take_along_axis(a, j[:, None], axis=1)[:, 0]  # noqa: E731
+    f = take(sel).astype(jnp.int32)
+    if cfg.has_categoricals:
+        is_cat = jnp.asarray(_cat_feat_mask(cfg, F))[f]
+    else:
+        is_cat = jnp.zeros(L, bool)
+    return take(gain_s), f, take(t_s), take(d_s), is_cat, hists_sel, sel, j
 
 
 def _best_split(cfg: GrowConfig, hists, leaf_stats, leaf_depth, num_leaves, feat_mask):
@@ -493,16 +579,21 @@ def grow_tree_depthwise(
         [grad * bag_weight, hess * bag_weight, in_bag], axis=0
     ).astype(jnp.float32)  # (3, n) channel-major
 
+    # Under voting-parallel the carried histogram buffer stays LOCAL per
+    # shard (votes + elected slices are the only collectives); otherwise
+    # the builders psum so the buffer is globally replicated.
+    hist_axis = None if cfg.voting_active else cfg.axis_name
+
     def window_hist(win_leaf):
         return build_histogram_by_leaf(
             bins, vals, win_leaf, W, B,
-            backend=cfg.hist_backend, chunk=cfg.hist_chunk, axis_name=cfg.axis_name,
+            backend=cfg.hist_backend, chunk=cfg.hist_chunk, axis_name=hist_axis,
             precision=cfg.hist_precision,
         )
 
     root_hist = build_histogram(
         bins, vals, jnp.ones(n, bool), B,
-        backend=cfg.hist_backend, chunk=cfg.hist_chunk, axis_name=cfg.axis_name,
+        backend=cfg.hist_backend, chunk=cfg.hist_chunk, axis_name=hist_axis,
         precision=cfg.hist_precision,
     )  # (3, F, B)
     hists0 = jnp.zeros((3, LB, F, B), jnp.float32).at[:, 0].set(root_hist)
@@ -520,9 +611,14 @@ def grow_tree_depthwise(
         cur_leaves = tree.num_leaves
         # feature 0's bins tile all rows → per-leaf totals
         leaf_stats = hists[:, :L, 0, :].sum(axis=-1)  # (3, L)
-        gain, f, t, dleft, is_cat = _leaf_candidates(
-            cfg, hists[:, :L], leaf_stats, feat_mask
-        )
+        if cfg.voting_active:
+            gain, f, t, dleft, is_cat, hists_sel, sel_feats, sel_j = (
+                _voting_leaf_candidates(cfg, hists[:, :L], leaf_stats, feat_mask)
+            )
+        else:
+            gain, f, t, dleft, is_cat = _leaf_candidates(
+                cfg, hists[:, :L], leaf_stats, feat_mask
+            )
         leaf_ok = leaf_arange < cur_leaves
         if cfg.max_depth > 0:
             leaf_ok &= leaf_depth < cfg.max_depth
@@ -544,9 +640,16 @@ def grow_tree_depthwise(
 
         # -- categorical membership sets for the level's winners ----------
         if cfg.has_categoricals:
-            hist_lf = jnp.take_along_axis(
-                hists[:, :L], f[None, :, None, None], axis=2
-            )[:, :, 0]  # (3, L, B)
+            if cfg.voting_active:
+                # GLOBAL statistics for the winning feature live in the
+                # psum-med elected block, not the local buffer.
+                hist_lf = jnp.take_along_axis(
+                    hists_sel, sel_j[None, :, None, None], axis=2
+                )[:, :, 0]  # (3, L, B)
+            else:
+                hist_lf = jnp.take_along_axis(
+                    hists[:, :L], f[None, :, None, None], axis=2
+                )[:, :, 0]  # (3, L, B)
             members = _cat_members(cfg, hist_lf, t, dleft)  # (L, B)
             members &= (selected & is_cat)[:, None]
         else:

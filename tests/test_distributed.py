@@ -69,6 +69,52 @@ class TestShardedHistogram:
         np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-5)
 
 
+class TestVotingParallel:
+    def test_voting_matches_data_parallel_trees(self):
+        # With top_k >= F every feature is elected, so the two-round voting
+        # protocol must reproduce the data-parallel model EXACTLY; with a
+        # tiny top_k it may differ but must stay a sane model.
+        X, y = _make_binary()
+        bm = BinMapper(max_bin=63).fit(X)
+        base = dict(objective="binary", num_iterations=10, num_leaves=15,
+                    min_data_in_leaf=5, grow_policy="depthwise")
+        dp = train(dict(base, tree_learner="data"), Dataset(X, y), bin_mapper=bm)
+        vp = train(dict(base, tree_learner="voting", top_k=X.shape[1]),
+                   Dataset(X, y), bin_mapper=bm)
+        np.testing.assert_allclose(vp.predict(X), dp.predict(X), rtol=1e-4, atol=1e-5)
+
+    def test_voting_small_topk_still_learns(self):
+        X, y = _make_binary()
+        vp = train(
+            dict(objective="binary", num_iterations=15, num_leaves=15,
+                 min_data_in_leaf=5, grow_policy="depthwise",
+                 tree_learner="voting_parallel", top_k=2),
+            Dataset(X, y),
+        )
+        assert _auc(y, vp.predict(X)) > 0.85
+
+    def test_voting_overrides_lossguide_with_warning(self):
+        X, y = _make_binary()
+        with pytest.warns(UserWarning, match="depthwise"):
+            vp = train(
+                dict(objective="binary", num_iterations=3, num_leaves=7,
+                     min_data_in_leaf=5, grow_policy="lossguide",
+                     tree_learner="voting", top_k=3),
+                Dataset(X, y),
+            )
+        assert np.isfinite(vp.predict(X)).all()
+
+    def test_feature_parallel_warns_and_trains_serial(self):
+        X, y = _make_binary()
+        with pytest.warns(UserWarning, match="feature"):
+            b = train(
+                dict(objective="binary", num_iterations=3, num_leaves=7,
+                     min_data_in_leaf=5, tree_learner="feature_parallel"),
+                Dataset(X, y),
+            )
+        assert np.isfinite(b.predict(X)).all()
+
+
 class TestDataParallelTraining:
     def test_distributed_matches_serial_predictions(self):
         X, y = _make_binary()
