@@ -73,6 +73,21 @@ _LAZY = {
     "TabularLIME": "mmlspark_tpu.explain.lime",
     "ImageLIME": "mmlspark_tpu.explain.lime",
     "SuperpixelTransformer": "mmlspark_tpu.explain.superpixel",
+    # cognitive services (SURVEY.md §2.6)
+    "TextSentiment": "mmlspark_tpu.cognitive",
+    "KeyPhraseExtractor": "mmlspark_tpu.cognitive",
+    "NER": "mmlspark_tpu.cognitive",
+    "EntityDetector": "mmlspark_tpu.cognitive",
+    "LanguageDetector": "mmlspark_tpu.cognitive",
+    "Translate": "mmlspark_tpu.cognitive",
+    "AnalyzeImage": "mmlspark_tpu.cognitive",
+    "OCR": "mmlspark_tpu.cognitive",
+    "DescribeImage": "mmlspark_tpu.cognitive",
+    "TagImage": "mmlspark_tpu.cognitive",
+    "DetectFace": "mmlspark_tpu.cognitive",
+    "DetectLastAnomaly": "mmlspark_tpu.cognitive",
+    "DetectEntireSeries": "mmlspark_tpu.cognitive",
+    "BingImageSearch": "mmlspark_tpu.cognitive",
 }
 
 

@@ -2,7 +2,32 @@
 
 Used by the fuzzing harness and codegen to enumerate every public stage
 (SURVEY.md §4.2 coverage-by-construction).  Modules are added here as they
-are built; keep this list complete.
+are built; keep this list complete — the registry meta-test
+(tests/test_fuzzing.py) walks ``all_stage_classes()`` after importing this
+module, so a stage module missing here escapes the persistence fuzz.
 """
 
+import mmlspark_tpu.automl.search  # noqa: F401
+import mmlspark_tpu.cognitive  # noqa: F401
 import mmlspark_tpu.core.pipeline  # noqa: F401
+import mmlspark_tpu.explain.lime  # noqa: F401
+import mmlspark_tpu.explain.superpixel  # noqa: F401
+import mmlspark_tpu.featurize.clean  # noqa: F401
+import mmlspark_tpu.featurize.convert  # noqa: F401
+import mmlspark_tpu.featurize.featurize  # noqa: F401
+import mmlspark_tpu.featurize.indexer  # noqa: F401
+import mmlspark_tpu.featurize.text  # noqa: F401
+import mmlspark_tpu.io.http.http_transformer  # noqa: F401
+import mmlspark_tpu.models.cntk_model  # noqa: F401
+import mmlspark_tpu.models.image_featurizer  # noqa: F401
+import mmlspark_tpu.models.isolation_forest  # noqa: F401
+import mmlspark_tpu.models.knn  # noqa: F401
+import mmlspark_tpu.models.lightgbm  # noqa: F401
+import mmlspark_tpu.models.onnx_model  # noqa: F401
+import mmlspark_tpu.models.sar  # noqa: F401
+import mmlspark_tpu.models.vw  # noqa: F401
+import mmlspark_tpu.ops.image_ops  # noqa: F401
+import mmlspark_tpu.stages.basic  # noqa: F401
+import mmlspark_tpu.stages.minibatch  # noqa: F401
+import mmlspark_tpu.train.compute_statistics  # noqa: F401
+import mmlspark_tpu.train.train_classifier  # noqa: F401

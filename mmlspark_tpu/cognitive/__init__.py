@@ -1,0 +1,35 @@
+"""Cognitive-services layer (reference: ``cms.cognitive`` — SURVEY.md §2.6).
+
+Service transformers = URL builder + ServiceParams (value-or-column duality)
++ subscription-key header over the HTTP core, exactly the reference's
+``CognitiveServicesBase`` composition."""
+
+from mmlspark_tpu.cognitive.anomaly import (
+    BingImageSearch,
+    DetectEntireSeries,
+    DetectLastAnomaly,
+)
+from mmlspark_tpu.cognitive.base import CognitiveServicesBase
+from mmlspark_tpu.cognitive.text import (
+    NER,
+    EntityDetector,
+    KeyPhraseExtractor,
+    LanguageDetector,
+    TextSentiment,
+    Translate,
+)
+from mmlspark_tpu.cognitive.vision import (
+    OCR,
+    AnalyzeImage,
+    DescribeImage,
+    DetectFace,
+    TagImage,
+)
+
+__all__ = [
+    "CognitiveServicesBase",
+    "TextSentiment", "KeyPhraseExtractor", "NER", "EntityDetector",
+    "LanguageDetector", "Translate",
+    "AnalyzeImage", "OCR", "DescribeImage", "TagImage", "DetectFace",
+    "DetectLastAnomaly", "DetectEntireSeries", "BingImageSearch",
+]

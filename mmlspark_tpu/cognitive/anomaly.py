@@ -1,0 +1,93 @@
+"""Anomaly Detector transformers (SURVEY.md §2.6;
+UPSTREAM:.../cognitive/AnomalyDetection.scala: DetectLastAnomaly /
+DetectEntireSeries over the Anomaly Detector timeseries API)."""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+from mmlspark_tpu.cognitive.base import CognitiveServicesBase, is_missing
+from mmlspark_tpu.core.frame import DataFrame
+from mmlspark_tpu.core.params import ServiceParam
+from mmlspark_tpu.core.registry import register_stage
+
+
+class _AnomalyBase(CognitiveServicesBase):
+    """Shared series input: a column of ``[{"timestamp", "value"}, ...]``
+    lists (one series per row) plus granularity/sensitivity knobs."""
+
+    series = ServiceParam(
+        "series", "Timeseries: list of {timestamp, value} points per row"
+    )
+    granularity = ServiceParam(
+        "granularity", "Series granularity", default={"value": "daily"}
+    )
+    sensitivity = ServiceParam("sensitivity", "Detection sensitivity 0-99")
+    maxAnomalyRatio = ServiceParam("maxAnomalyRatio", "Max fraction of anomalies")
+
+    def _prepare(self, df: DataFrame) -> Dict[str, Any]:
+        n = df.count()
+        return {
+            "series": self.getVectorParam(df, "series") or [None] * n,
+            "granularity": self.getVectorParam(df, "granularity") or ["daily"] * n,
+            "sensitivity": self.getVectorParam(df, "sensitivity") or [None] * n,
+            "maxAnomalyRatio": self.getVectorParam(df, "maxAnomalyRatio") or [None] * n,
+        }
+
+    def _row_body(self, ctx, i):
+        s = ctx["series"][i]
+        if is_missing(s):
+            return None
+        body = {"series": list(s), "granularity": ctx["granularity"][i]}
+        if not is_missing(ctx["sensitivity"][i]):
+            body["sensitivity"] = ctx["sensitivity"][i]
+        if not is_missing(ctx["maxAnomalyRatio"][i]):
+            body["maxAnomalyRatio"] = ctx["maxAnomalyRatio"][i]
+        return body
+
+
+@register_stage
+class DetectLastAnomaly(_AnomalyBase):
+    """Is the LATEST point anomalous (``DetectLastAnomaly``)."""
+
+    _URL_PATH = "/anomalydetector/v1.0/timeseries/last/detect"
+
+
+@register_stage
+class DetectEntireSeries(_AnomalyBase):
+    """Batch detection over the whole series (``DetectEntireSeries``)."""
+
+    _URL_PATH = "/anomalydetector/v1.0/timeseries/entire/detect"
+
+
+@register_stage
+class BingImageSearch(CognitiveServicesBase):
+    """Bing image search (UPSTREAM:.../cognitive/BingImageSearch.scala) —
+    GET with ``q`` query param on the global bing endpoint."""
+
+    _URL_PATH = "/v7.0/images/search"
+    _DEFAULT_DOMAIN = "api.bing.microsoft.com"
+    _METHOD = "GET"
+
+    q = ServiceParam("q", "Search query (value or column)")
+    count = ServiceParam("count", "Results per query", default={"value": 10})
+
+    def _base_url(self) -> str:
+        if self.getUrl():
+            return self.getUrl()
+        return f"https://{self._DEFAULT_DOMAIN}{self._URL_PATH}"
+
+    def _prepare(self, df: DataFrame) -> Dict[str, Any]:
+        n = df.count()
+        return {
+            "q": self.getVectorParam(df, "q") or [None] * n,
+            "count": self.getVectorParam(df, "count") or [10] * n,
+        }
+
+    def _row_query(self, ctx, i):
+        return {"q": str(ctx["q"][i]), "count": str(ctx["count"][i])}
+
+    def _row_body(self, ctx, i):
+        # GET: body presence gates the row; return an empty marker when the
+        # query exists.
+        return None if is_missing(ctx["q"][i]) else b""

@@ -1,0 +1,155 @@
+"""Cognitive-services base: URL builder + value-or-column params + key header.
+
+Reference parity (SURVEY.md §2.6, UPSTREAM:src/main/scala/com/microsoft/ml/
+spark/cognitive/): every cognitive transformer there is
+``CognitiveServicesBase`` = ``HasServiceParams`` (value-or-column duality)
++ a URL builder (location → regional endpoint), an
+``Ocp-Apim-Subscription-Key`` header, a shared async client with
+``concurrency``, and an internal JSON output parser with an error column.
+This module reproduces that contract over the HTTP core
+(:mod:`mmlspark_tpu.io.http.http_transformer`): subclasses declare their
+URL path, per-row query/body builders, and (optionally) a response
+postprocessor — everything else (key header, retries/backoff, concurrency
+pool, JSON parsing, error col) lives here.
+"""
+
+from __future__ import annotations
+
+import json
+import urllib.parse
+from concurrent.futures import ThreadPoolExecutor
+from typing import Any, Dict, List, Optional
+
+from mmlspark_tpu.core.frame import DataFrame
+from mmlspark_tpu.core.params import (
+    HasOutputCol,
+    HasServiceParams,
+    Param,
+    ServiceParam,
+)
+from mmlspark_tpu.core.pipeline import Transformer
+from mmlspark_tpu.io.http.http_schema import HTTPRequestData, HTTPResponseData
+from mmlspark_tpu.io.http.http_transformer import (
+    DEFAULT_BACKOFFS_MS,
+    send_with_retries,
+)
+
+
+def is_missing(v) -> bool:
+    """None or NaN (DataFrame-lite represents missing cells as float nan)."""
+    if v is None:
+        return True
+    return isinstance(v, float) and v != v
+
+
+class CognitiveServicesBase(Transformer, HasOutputCol, HasServiceParams):
+    """Shared machinery for every cognitive-service transformer.
+
+    Subclass contract:
+    - ``_URL_PATH``: service path appended to the regional endpoint.
+    - ``_row_query(ctx, i)``  → dict of query params for row ``i``.
+    - ``_row_body(ctx, i)``   → JSON-able body (or ``None`` row → skipped).
+    - ``_postprocess(parsed)`` → value stored in ``outputCol``.
+    - ``_prepare(df)`` → ctx dict of per-row resolved ServiceParam vectors.
+    """
+
+    subscriptionKey = ServiceParam(
+        "subscriptionKey", "API key sent as Ocp-Apim-Subscription-Key"
+    )
+    url = Param("url", "Full service URL (overrides location routing)", default="", dtype=str)
+    location = Param("location", "Service region, e.g. eastus", default="westus", dtype=str)
+    errorCol = Param("errorCol", "Column receiving per-row errors", default="", dtype=str)
+    concurrency = Param("concurrency", "In-flight requests", default=4, dtype=int)
+    concurrentTimeout = Param(
+        "concurrentTimeout", "Per-request timeout (s)", default=60.0, dtype=float
+    )
+    backoffs = Param("backoffs", "Retry backoffs in ms", default=list(DEFAULT_BACKOFFS_MS))
+
+    _URL_PATH = ""
+    _DEFAULT_DOMAIN = "api.cognitive.microsoft.com"
+    _METHOD = "POST"
+
+    def setLocation(self, value: str) -> "CognitiveServicesBase":
+        self._paramMap["location"] = value
+        return self
+
+    # -- subclass hooks --------------------------------------------------
+    def _base_url(self) -> str:
+        if self.getUrl():
+            return self.getUrl()
+        return f"https://{self.getLocation()}.{self._DEFAULT_DOMAIN}{self._URL_PATH}"
+
+    def _prepare(self, df: DataFrame) -> Dict[str, Any]:
+        return {}
+
+    def _row_query(self, ctx: Dict[str, Any], i: int) -> Dict[str, str]:
+        return {}
+
+    def _row_body(self, ctx: Dict[str, Any], i: int):
+        raise NotImplementedError
+
+    def _postprocess(self, parsed):
+        return parsed
+
+    # -- the shared transform --------------------------------------------
+    def _error_col(self) -> str:
+        return self.getErrorCol() or f"{self.getOutputCol()}_error"
+
+    def _transform(self, df: DataFrame) -> DataFrame:
+        n = df.count()
+        keys = self.getVectorParam(df, "subscriptionKey") or [None] * n
+        base = self._base_url()
+        ctx = self._prepare(df)
+
+        def build(i: int) -> Optional[HTTPRequestData]:
+            body = self._row_body(ctx, i)
+            if body is None:
+                return None
+            q = self._row_query(ctx, i)
+            url = base + ("?" + urllib.parse.urlencode(q) if q else "")
+            headers = {}
+            if not is_missing(keys[i]) and keys[i]:
+                headers["Ocp-Apim-Subscription-Key"] = str(keys[i])
+            if self._METHOD == "GET":
+                entity = None  # body only gates the row (None → skip)
+            elif isinstance(body, bytes):
+                entity = body
+                headers["Content-Type"] = "application/octet-stream"
+            else:
+                entity = json.dumps(body).encode()
+                headers["Content-Type"] = "application/json"
+            return HTTPRequestData(
+                url=url, method=self._METHOD, headers=headers, entity=entity
+            )
+
+        reqs = [build(i) for i in range(n)]
+        timeout = self.getConcurrentTimeout()
+        backoffs = tuple(self.getBackoffs())
+
+        def call(r: Optional[HTTPRequestData]) -> Optional[HTTPResponseData]:
+            return None if r is None else send_with_retries(r, timeout, backoffs)
+
+        with ThreadPoolExecutor(max_workers=max(1, self.getConcurrency())) as pool:
+            resps: List[Optional[HTTPResponseData]] = list(pool.map(call, reqs))
+
+        out, errors = [], []
+        for r in resps:
+            if r is None:
+                out.append(None)
+                errors.append(None)
+                continue
+            if 200 <= r.statusCode < 300:
+                try:
+                    parsed = json.loads(r.entity.decode()) if r.entity else None
+                except ValueError:
+                    parsed = None
+                out.append(self._postprocess(parsed))
+                errors.append(None)
+            else:
+                out.append(None)
+                errors.append(
+                    {"statusCode": r.statusCode, "reason": r.statusReason}
+                )
+        return df.withColumn(self.getOutputCol(), out).withColumn(
+            self._error_col(), errors
+        )

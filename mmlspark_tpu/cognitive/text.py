@@ -1,0 +1,126 @@
+"""Text Analytics transformers (SURVEY.md §2.6; UPSTREAM:.../cognitive/
+TextAnalytics.scala: TextSentiment, KeyPhraseExtractor, NER,
+LanguageDetector, EntityDetector over the v2/v3 documents API).
+
+All share the Text Analytics request shape
+``{"documents": [{"id", "text", "language"}]}``; like the reference's
+``TextAnalyticsBase``, rows are scored independently (the ``documents``
+batch here is one row — request parallelism comes from the shared
+concurrency pool, matching HTTP-on-Spark semantics)."""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+from mmlspark_tpu.cognitive.base import CognitiveServicesBase, is_missing
+from mmlspark_tpu.core.frame import DataFrame
+from mmlspark_tpu.core.params import ServiceParam
+from mmlspark_tpu.core.registry import register_stage
+
+
+class _TextAnalyticsBase(CognitiveServicesBase):
+    text = ServiceParam("text", "Input text (value or column)")
+    language = ServiceParam("language", "Document language", default={"value": "en"})
+
+    def _prepare(self, df: DataFrame) -> Dict[str, Any]:
+        n = df.count()
+        return {
+            "text": self.getVectorParam(df, "text") or [None] * n,
+            "language": self.getVectorParam(df, "language") or ["en"] * n,
+        }
+
+    def _row_body(self, ctx, i):
+        t = ctx["text"][i]
+        if is_missing(t):
+            return None
+        return {
+            "documents": [
+                {"id": "0", "text": str(t), "language": ctx["language"][i]}
+            ]
+        }
+
+    def _postprocess(self, parsed):
+        # unwrap the single-document batch → the document payload
+        if isinstance(parsed, dict) and parsed.get("documents"):
+            return parsed["documents"][0]
+        return parsed
+
+
+@register_stage
+class TextSentiment(_TextAnalyticsBase):
+    """Sentiment scoring (UPSTREAM:.../cognitive/TextAnalytics.scala
+    ``TextSentiment``)."""
+
+    _URL_PATH = "/text/analytics/v3.0/sentiment"
+
+
+@register_stage
+class KeyPhraseExtractor(_TextAnalyticsBase):
+    """Key-phrase extraction (``KeyPhraseExtractor``)."""
+
+    _URL_PATH = "/text/analytics/v3.0/keyPhrases"
+
+
+@register_stage
+class NER(_TextAnalyticsBase):
+    """Named-entity recognition (``NER``)."""
+
+    _URL_PATH = "/text/analytics/v3.0/entities/recognition/general"
+
+
+@register_stage
+class EntityDetector(_TextAnalyticsBase):
+    """Entity linking (``EntityDetector``)."""
+
+    _URL_PATH = "/text/analytics/v3.0/entities/linking"
+
+
+@register_stage
+class LanguageDetector(_TextAnalyticsBase):
+    """Language detection (``LanguageDetector``) — the ``language`` field is
+    an output here, so the request carries only the text."""
+
+    _URL_PATH = "/text/analytics/v3.0/languages"
+
+    def _row_body(self, ctx, i):
+        t = ctx["text"][i]
+        if is_missing(t):
+            return None
+        return {"documents": [{"id": "0", "text": str(t)}]}
+
+
+@register_stage
+class Translate(CognitiveServicesBase):
+    """Text translation (UPSTREAM:.../cognitive/Translator.scala) — the
+    Translator API uses a flat ``[{"Text": ...}]`` body and ``to``/``from``
+    query params on a global (non-regional) endpoint."""
+
+    _URL_PATH = "/translate"
+    _DEFAULT_DOMAIN = "api.cognitive.microsofttranslator.com"
+
+    text = ServiceParam("text", "Text to translate")
+    toLanguage = ServiceParam("toLanguage", "Target language(s), comma-joined")
+    fromLanguage = ServiceParam("fromLanguage", "Source language (optional)")
+
+    def _base_url(self) -> str:
+        if self.getUrl():
+            return self.getUrl()
+        return f"https://{self._DEFAULT_DOMAIN}{self._URL_PATH}"
+
+    def _prepare(self, df: DataFrame):
+        n = df.count()
+        return {
+            "text": self.getVectorParam(df, "text") or [None] * n,
+            "to": self.getVectorParam(df, "toLanguage") or ["en"] * n,
+            "from": self.getVectorParam(df, "fromLanguage") or [None] * n,
+        }
+
+    def _row_query(self, ctx, i):
+        q = {"api-version": "3.0", "to": ctx["to"][i]}
+        if ctx["from"][i]:
+            q["from"] = ctx["from"][i]
+        return q
+
+    def _row_body(self, ctx, i):
+        t = ctx["text"][i]
+        return None if is_missing(t) else [{"Text": str(t)}]

@@ -1,0 +1,119 @@
+"""Computer Vision + Face transformers (SURVEY.md §2.6;
+UPSTREAM:.../cognitive/{ComputerVision,Face}.scala: AnalyzeImage, OCR,
+DescribeImage, TagImage, GenerateThumbnails pattern; Face DetectFace)."""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+from mmlspark_tpu.cognitive.base import CognitiveServicesBase, is_missing
+from mmlspark_tpu.core.frame import DataFrame
+from mmlspark_tpu.core.params import ServiceParam
+from mmlspark_tpu.core.registry import register_stage
+
+
+class _ImageInputBase(CognitiveServicesBase):
+    """Image input duality (reference ``HasImageInput``): either an image
+    URL column/value (JSON ``{"url": ...}`` body) or raw image bytes
+    (octet-stream body)."""
+
+    imageUrl = ServiceParam("imageUrl", "Image URL (value or column)")
+    imageBytes = ServiceParam("imageBytes", "Raw image bytes (value or column)")
+
+    _EXTRA_VECTOR_PARAMS: tuple = ()
+
+    def _prepare(self, df: DataFrame) -> Dict[str, Any]:
+        n = df.count()
+        ctx = {
+            "url": self.getVectorParam(df, "imageUrl") or [None] * n,
+            "bytes": self.getVectorParam(df, "imageBytes") or [None] * n,
+        }
+        # every other ServiceParam resolves per-row too (value-or-column
+        # duality holds for query params, not just the image input)
+        for name in self._EXTRA_VECTOR_PARAMS:
+            ctx[name] = self.getVectorParam(df, name) or [None] * n
+        return ctx
+
+    def _row_body(self, ctx, i):
+        if not is_missing(ctx["bytes"][i]):
+            return bytes(ctx["bytes"][i])
+        if not is_missing(ctx["url"][i]):
+            return {"url": str(ctx["url"][i])}
+        return None
+
+
+@register_stage
+class AnalyzeImage(_ImageInputBase):
+    """Visual features analysis (``AnalyzeImage``)."""
+
+    _URL_PATH = "/vision/v3.2/analyze"
+
+    visualFeatures = ServiceParam(
+        "visualFeatures", "Comma-joined features (Categories,Tags,Description,...)"
+    )
+    _EXTRA_VECTOR_PARAMS = ("visualFeatures",)
+
+    def _row_query(self, ctx, i):
+        vf = ctx["visualFeatures"][i]
+        return {"visualFeatures": vf} if vf else {}
+
+
+@register_stage
+class OCR(_ImageInputBase):
+    """Printed-text OCR (``OCR``)."""
+
+    _URL_PATH = "/vision/v3.2/ocr"
+
+    detectOrientation = ServiceParam(
+        "detectOrientation", "Detect text orientation", default={"value": True}
+    )
+    _EXTRA_VECTOR_PARAMS = ("detectOrientation",)
+
+    def _row_query(self, ctx, i):
+        return {"detectOrientation": str(bool(ctx["detectOrientation"][i])).lower()}
+
+
+@register_stage
+class DescribeImage(_ImageInputBase):
+    """Natural-language image captions (``DescribeImage``)."""
+
+    _URL_PATH = "/vision/v3.2/describe"
+
+    maxCandidates = ServiceParam(
+        "maxCandidates", "Caption candidates", default={"value": 1}
+    )
+    _EXTRA_VECTOR_PARAMS = ("maxCandidates",)
+
+    def _row_query(self, ctx, i):
+        return {"maxCandidates": str(ctx["maxCandidates"][i])}
+
+
+@register_stage
+class TagImage(_ImageInputBase):
+    """Content tags (``TagImage``)."""
+
+    _URL_PATH = "/vision/v3.2/tag"
+
+
+@register_stage
+class DetectFace(_ImageInputBase):
+    """Face detection (UPSTREAM:.../cognitive/Face.scala ``DetectFace``)."""
+
+    _URL_PATH = "/face/v1.0/detect"
+
+    returnFaceAttributes = ServiceParam(
+        "returnFaceAttributes", "Comma-joined face attributes to return"
+    )
+    returnFaceLandmarks = ServiceParam(
+        "returnFaceLandmarks", "Return the 27-point landmarks", default={"value": False}
+    )
+    _EXTRA_VECTOR_PARAMS = ("returnFaceAttributes", "returnFaceLandmarks")
+
+    def _row_query(self, ctx, i):
+        q = {
+            "returnFaceLandmarks": str(bool(ctx["returnFaceLandmarks"][i])).lower()
+        }
+        attrs = ctx["returnFaceAttributes"][i]
+        if attrs:
+            q["returnFaceAttributes"] = attrs
+        return q

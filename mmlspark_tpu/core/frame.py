@@ -78,6 +78,49 @@ class DataFrame:
     def to_spark(self, spark):  # pragma: no cover - needs pyspark
         return spark.createDataFrame(self._pdf)
 
+    @staticmethod
+    def from_arrow(data, num_partitions: Optional[int] = None) -> "DataFrame":
+        """Build from a pyarrow ``Table`` or ``RecordBatch`` (list thereof).
+
+        The Spark-boundary ingestion path (SURVEY.md §7.3.4 "Spark↔TPU host
+        data path": executor JVM → Arrow IPC → host RAM): a Spark-side
+        integration ships partitions as Arrow record batches; each batch
+        becomes one partition here, so the reference's "numWorkers =
+        min(numTasks, partitions)" math (§3.1) keeps working.
+        """
+        import pyarrow as pa
+
+        if isinstance(data, pa.RecordBatch):
+            data = [data]
+        if isinstance(data, (list, tuple)):
+            if not data:
+                raise ValueError("from_arrow: empty batch list")
+            table = pa.Table.from_batches(list(data))
+            if num_partitions is None:
+                num_partitions = len(data)
+        elif isinstance(data, pa.Table):
+            table = data
+            if num_partitions is None:
+                num_partitions = max(1, len(table.to_batches()))
+        else:
+            raise TypeError(
+                f"from_arrow expects a pyarrow Table/RecordBatch, got "
+                f"{type(data).__name__}"
+            )
+        return DataFrame(
+            table.to_pandas(), num_partitions=num_partitions or 1
+        )
+
+    def to_arrow(self):
+        """This frame as a pyarrow ``Table`` (one batch per partition)."""
+        import pyarrow as pa
+
+        batches = [
+            pa.RecordBatch.from_pandas(self._pdf.iloc[sl].reset_index(drop=True))
+            for sl in self.partition_slices()
+        ]
+        return pa.Table.from_batches(batches)
+
     # ---- basic introspection -------------------------------------------
     @property
     def columns(self) -> List[str]:

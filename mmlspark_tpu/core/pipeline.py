@@ -137,8 +137,9 @@ class Model(Transformer):
     """A fitted Transformer (SparkML ``Model``)."""
 
 
-class Evaluator(Params):
-    """Metric evaluator contract (SparkML ``Evaluator``)."""
+class Evaluator(PipelineStage):
+    """Metric evaluator contract (SparkML ``Evaluator``; persistable like
+    any stage — the reference's evaluators are MLWritable)."""
 
     def evaluate(self, df: DataFrame) -> float:
         raise NotImplementedError

@@ -246,6 +246,19 @@ class Booster:
         self.evals_result: Dict[str, Dict[str, List[float]]] = {}
         self._predict_cache: Dict[Tuple, callable] = {}
 
+    # Boosters ride inside pickled ComplexParams (e.g. a fitted model nested
+    # in BestModel/TrainedClassifierModel); the jitted-closure cache and
+    # device arrays must not enter the pickle (found by the registry fuzz).
+    def __getstate__(self):
+        state = dict(self.__dict__)
+        state["_predict_cache"] = {}
+        state["trees"] = Tree(*[np.asarray(a) for a in self.trees])
+        return state
+
+    def __setstate__(self, state):
+        self.__dict__.update(state)
+        self.trees = Tree(*[jnp.asarray(a) for a in self.trees])
+
     # -- introspection ---------------------------------------------------
     @property
     def num_iterations(self) -> int:

@@ -104,7 +104,12 @@ class VowpalWabbitInteractions(Transformer):
             raise ValueError("VowpalWabbitInteractions needs >= 2 inputCols")
         n = df.count()
         out = np.zeros((n, n_slots))
-        mats = [np.stack([np.asarray(v, dtype=np.float64) for v in df[c]]) for c in cols]
+        # Scalar numeric columns participate as length-1 vectors (found by
+        # the registry fuzz: np.nonzero on a 0-d value raised).
+        mats = [
+            np.stack([np.atleast_1d(np.asarray(v, dtype=np.float64)) for v in df[c]])
+            for c in cols
+        ]
         for a_i in range(len(cols)):
             for b_i in range(a_i + 1, len(cols)):
                 A, B = mats[a_i], mats[b_i]

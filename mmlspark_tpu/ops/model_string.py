@@ -371,9 +371,35 @@ def booster_from_string(s: str):
         thr = _floats(b.get("threshold", ""))
         dts = _ints(b.get("decision_type", ""))
         lch = _ints(b.get("left_child", ""))
+        rch = _ints(b.get("right_child", ""))
         gains = _floats(b.get("split_gain", ""))
         cat_bnd = _ints(b.get("cat_boundaries", ""))
         cat_words = _ints(b.get("cat_threshold", ""))
+        # The replay Tree encodes "right child of split s is leaf s+1" —
+        # which genuine LightGBM files satisfy by construction (Tree::Split
+        # assigns the new right leaf id num_leaves == s+1).  Validate rather
+        # than silently mis-scoring a hand-edited/corrupt file.
+        if not (len(feat) == len(thr) == len(dts) == len(lch) == len(rch)):
+            raise ValueError(
+                "malformed model: split_feature/threshold/decision_type/"
+                f"left_child/right_child lengths differ "
+                f"({len(feat)}/{len(thr)}/{len(dts)}/{len(lch)}/{len(rch)})"
+            )
+        for sidx in range(len(feat)):
+            c = rch[sidx]
+            if c < 0 and (-int(c) - 1) != sidx + 1:
+                raise ValueError(
+                    f"malformed model: split {sidx} has right leaf "
+                    f"{-int(c) - 1}, expected {sidx + 1} (LightGBM numbering)"
+                )
+            c = lch[sidx]
+            if c >= 0 and not (sidx < int(c) < len(feat)):
+                # left child node of split s must be a LATER split index (it
+                # is the left subtree's next split in creation order).
+                raise ValueError(
+                    f"malformed model: split {sidx} points left at node "
+                    f"{int(c)} (must be in ({sidx}, {len(feat)}))"
+                )
         for sidx in range(len(feat)):
             # split_leaf = leftmost descendant leaf id (left children keep
             # the parent's leaf id through every split).

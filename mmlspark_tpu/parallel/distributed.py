@@ -90,3 +90,31 @@ def initialize_distributed(
     )
     _initialized = True
     return True
+
+
+def global_mesh():
+    """A 1-D mesh over ALL processes' devices (call after
+    :func:`initialize_distributed`) — delegates to
+    :func:`mmlspark_tpu.parallel.mesh.default_mesh`."""
+    from mmlspark_tpu.parallel.mesh import default_mesh
+
+    return default_mesh()
+
+
+def make_global_array(mesh, spec, local_rows):
+    """Assemble a globally-sharded array from PROCESS-LOCAL row data.
+
+    The multi-controller ingestion path (SURVEY.md §7.3.4): every process
+    holds only ITS partition (as the reference's per-task native Dataset
+    held only the partition rows) and contributes it to one global array —
+    ``jax.device_put`` of a host array would instead require every process
+    to hold the identical FULL dataset.  ``spec`` must shard the leading
+    (row) axis over the mesh's process dimension.
+    """
+    import jax
+    from jax.sharding import NamedSharding
+
+    sharding = NamedSharding(mesh, spec)
+    if jax.process_count() == 1:
+        return jax.device_put(local_rows, sharding)
+    return jax.make_array_from_process_local_data(sharding, local_rows)

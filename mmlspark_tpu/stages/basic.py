@@ -80,6 +80,13 @@ class Timer(Transformer):
         super().__init__(**kwargs)
         self.lastTimings: List[float] = []
 
+    def _timings(self) -> List[float]:
+        # load() bypasses __init__ (found by the registry fuzz) — create on
+        # first use.
+        if not hasattr(self, "lastTimings"):
+            self.lastTimings = []
+        return self.lastTimings
+
     def fitTimed(self, df):
         import jax.profiler
 
@@ -88,7 +95,7 @@ class Timer(Transformer):
             t0 = _time.perf_counter()
             model = stage.fit(df)
             dt = _time.perf_counter() - t0
-        self.lastTimings.append(dt)
+        self._timings().append(dt)
         if self.getLogToScala():
             print(f"Timer: fit({type(stage).__name__}) took {dt:.3f}s")
         return Timer(logToScala=self.getLogToScala()).setStage(model)
@@ -105,7 +112,7 @@ class Timer(Transformer):
             t0 = _time.perf_counter()
             out = stage.transform(df)
             dt = _time.perf_counter() - t0
-        self.lastTimings.append(dt)
+        self._timings().append(dt)
         if self.getLogToScala():
             print(f"Timer: transform({type(stage).__name__}) took {dt:.3f}s")
         return out

@@ -1,0 +1,547 @@
+"""Registry-wide persistence/experiment fuzzing (SURVEY.md §4.2).
+
+The reference's distinctive test layer: every public stage must appear in a
+fuzzing suite, enforced by a meta-test (``Fuzzing.scala``'s
+SerializationFuzzing + ExperimentFuzzing + the coverage meta-test —
+UPSTREAM:.../core/test/fuzzing/).  Here:
+
+- ``FIXTURES`` maps every registered stage to a constructor + dataframes.
+- Transformers: transform → save → load → re-transform → equality.
+- Estimators: fit → transform → save/load the MODEL → re-transform →
+  equality (which also covers the corresponding Model class), plus
+  save/load the estimator → params equal.
+- ``PERSIST_ONLY`` stages (need live endpoints / model payloads) get the
+  save→load→params-equal fuzz here and have their transform paths tested in
+  the suites named in the table.
+- ``test_every_registered_stage_is_covered`` FAILS when a new stage is
+  registered without coverage — coverage-by-construction.
+"""
+
+import math
+
+import numpy as np
+import pytest
+
+import mmlspark_tpu.all  # noqa: F401 — registration side effects
+from mmlspark_tpu.core.frame import DataFrame
+from mmlspark_tpu.core.registry import all_stage_classes
+from mmlspark_tpu.core.pipeline import Estimator, Model, Transformer
+
+
+# ---------------------------------------------------------------------------
+# Shared fixture data
+# ---------------------------------------------------------------------------
+def _tab_df(n=60, f=4, seed=0):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, f))
+    y = (X[:, 0] + 0.5 * X[:, 1] > 0).astype(np.float64)
+    return DataFrame({"features": list(X), "label": y})
+
+
+def _mixed_df():
+    return DataFrame({
+        "x": [1.0, 2.0, np.nan, 4.0, 2.5, 3.5],
+        "s": ["a", "b", "a", "c", "b", "a"],
+        "label": [0.0, 1.0, 0.0, 1.0, 1.0, 0.0],
+    })
+
+
+def _ratings_df():
+    rng = np.random.default_rng(0)
+    rows = {"user": [], "item": [], "rating": []}
+    for u in range(8):
+        for i in rng.choice(10, 5, replace=False):
+            rows["user"].append(int(u))
+            rows["item"].append(int(i))
+            rows["rating"].append(float(rng.integers(1, 6)))
+    return DataFrame(rows)
+
+
+def _img_df(n=1):
+    from mmlspark_tpu.ops.image_ops import make_image_row
+
+    rng = np.random.default_rng(0)
+    return DataFrame({
+        "image": [
+            make_image_row(rng.integers(0, 255, size=(10, 12, 3)).astype(np.uint8))
+            for _ in range(n)
+        ]
+    })
+
+
+def _scored_df():
+    df = _tab_df(40)
+    return (
+        df.withColumn("prediction", [float(v > 0) for v in df["label"]])
+        .withColumn("probability", [np.array([1 - p, p]) for p in
+                                    np.linspace(0.1, 0.9, 40)])
+    )
+
+
+def _lgbm(n_iter=3):
+    from mmlspark_tpu.models.lightgbm import LightGBMClassifier
+
+    return LightGBMClassifier(numIterations=n_iter, numLeaves=4, minDataInLeaf=2)
+
+
+# ---------------------------------------------------------------------------
+# The fixture table: stage class name → () -> (stage, fit_df, transform_df)
+# fit_df None → plain Transformer.  PERSIST_ONLY: name → suite covering the
+# live transform path.
+# ---------------------------------------------------------------------------
+def _fixtures():
+    from mmlspark_tpu import cognitive
+    from mmlspark_tpu.automl.hyperparams import (
+        DiscreteHyperParam,
+        HyperparamBuilder,
+    )
+    from mmlspark_tpu.automl.search import FindBestModel, TuneHyperparameters
+    from mmlspark_tpu.core.pipeline import Pipeline
+    from mmlspark_tpu.explain.lime import TabularLIME
+    from mmlspark_tpu.explain.superpixel import SuperpixelTransformer
+    from mmlspark_tpu.featurize.clean import CleanMissingData
+    from mmlspark_tpu.featurize.convert import DataConversion
+    from mmlspark_tpu.featurize.featurize import Featurize
+    from mmlspark_tpu.featurize.indexer import IndexToValue, ValueIndexer
+    from mmlspark_tpu.featurize.text import TextFeaturizer
+    from mmlspark_tpu.io.http.http_transformer import (
+        JSONInputParser,
+        JSONOutputParser,
+    )
+    from mmlspark_tpu.models.isolation_forest import IsolationForest
+    from mmlspark_tpu.models.knn import KNN, ConditionalKNN
+    from mmlspark_tpu.models.lightgbm import (
+        LightGBMClassifier,
+        LightGBMRanker,
+        LightGBMRegressor,
+    )
+    from mmlspark_tpu.models.sar import (
+        SAR,
+        RankingAdapter,
+        RankingEvaluator,
+        RankingTrainValidationSplit,
+        RecommendationIndexer,
+    )
+    from mmlspark_tpu.models.vw import (
+        VowpalWabbitClassifier,
+        VowpalWabbitFeaturizer,
+        VowpalWabbitInteractions,
+        VowpalWabbitRegressor,
+    )
+    from mmlspark_tpu.ops.image_ops import (
+        ImageSetAugmenter,
+        ImageTransformer,
+        UnrollBinaryImage,
+        UnrollImage,
+    )
+    from mmlspark_tpu.stages import basic as st
+    from mmlspark_tpu.stages import minibatch as mb
+    from mmlspark_tpu.train.compute_statistics import (
+        ComputeModelStatistics,
+        ComputePerInstanceStatistics,
+    )
+    from mmlspark_tpu.train.train_classifier import TrainClassifier, TrainRegressor
+
+    simple = DataFrame({"a": [1.0, 2.0, 3.0], "b": [4.0, 5.0, 6.0],
+                        "label": [0.0, 1.0, 0.0]})
+    text_df = DataFrame({"t": ["the cat sat", "a dog ran", "cats and dogs"]})
+    rank_df = DataFrame({
+        "features": list(np.random.default_rng(0).normal(size=(24, 3))),
+        "label": [float(i % 3) for i in range(24)],
+        "group": [i // 6 for i in range(24)],
+    })
+
+    return {
+        # -- stages.basic -------------------------------------------------
+        "DropColumns": lambda: (st.DropColumns(cols=["b"]), None, simple),
+        "SelectColumns": lambda: (st.SelectColumns(cols=["a"]), None, simple),
+        "RenameColumn": lambda: (st.RenameColumn(inputCol="a", outputCol="z"), None, simple),
+        "Repartition": lambda: (st.Repartition(n=2), None, simple),
+        "Cacher": lambda: (st.Cacher(), None, simple),
+        "Timer": lambda: (st.Timer(stage=st.DropColumns(cols=["b"])), None, simple),
+        "Lambda": lambda: (
+            st.Lambda(transformFunc=_double_a), None, simple,
+        ),
+        "UDFTransformer": lambda: (
+            st.UDFTransformer(inputCol="a", outputCol="a2", udf=_plus_one),
+            None, simple,
+        ),
+        "MultiColumnAdapter": lambda: (
+            st.MultiColumnAdapter(
+                baseStage=st.RenameColumn(), inputCols=["a", "b"],
+                outputCols=["a2", "b2"],
+            ),
+            None, simple,
+        ),
+        "Explode": lambda: (
+            st.Explode(inputCol="seq", outputCol="v"), None,
+            DataFrame({"seq": [[1, 2], [3]]}),
+        ),
+        "EnsembleByKey": lambda: (
+            st.EnsembleByKey(keys=["k"], cols=["v"]), None,
+            DataFrame({"k": ["a", "a", "b"], "v": [1.0, 3.0, 5.0]}),
+        ),
+        "ClassBalancer": lambda: (st.ClassBalancer(), _mixed_df(), _mixed_df()),
+        "StratifiedRepartition": lambda: (
+            st.StratifiedRepartition(labelCol="label"), None, _mixed_df(),
+        ),
+        "SummarizeData": lambda: (st.SummarizeData(), None, simple),
+        "TextPreprocessor": lambda: (
+            st.TextPreprocessor(inputCol="t", outputCol="t2", map={"cat": "dog"}),
+            None, text_df,
+        ),
+        "PartitionConsolidator": lambda: (
+            st.PartitionConsolidator(concurrency=1), None, simple,
+        ),
+        "Pipeline": lambda: (
+            Pipeline(stages=[st.RenameColumn(inputCol="a", outputCol="z"),
+                             st.DropColumns(cols=["b"])]),
+            simple, simple,
+        ),
+        # -- minibatch ----------------------------------------------------
+        "FixedMiniBatchTransformer": lambda: (
+            mb.FixedMiniBatchTransformer(batchSize=2), None, simple,
+        ),
+        "DynamicMiniBatchTransformer": lambda: (
+            mb.DynamicMiniBatchTransformer(), None, simple,
+        ),
+        "TimeIntervalMiniBatchTransformer": lambda: (
+            mb.TimeIntervalMiniBatchTransformer(millisToWait=1), None, simple,
+        ),
+        "FlattenBatch": lambda: (
+            mb.FlattenBatch(), None,
+            mb.FixedMiniBatchTransformer(batchSize=2).transform(simple),
+        ),
+        # -- featurize ----------------------------------------------------
+        "CleanMissingData": lambda: (
+            CleanMissingData(inputCols=["x"], outputCols=["x2"]),
+            _mixed_df(), _mixed_df(),
+        ),
+        "DataConversion": lambda: (
+            DataConversion(cols=["a"], convertTo="string"), None, simple,
+        ),
+        "Featurize": lambda: (
+            Featurize(inputCols=["x", "s"], outputCol="features"),
+            _mixed_df(), _mixed_df(),
+        ),
+        "ValueIndexer": lambda: (
+            ValueIndexer(inputCol="s", outputCol="si"), _mixed_df(), _mixed_df(),
+        ),
+        "IndexToValue": lambda: (
+            IndexToValue(inputCol="si", outputCol="s2"), None,
+            ValueIndexer(inputCol="s", outputCol="si").fit(_mixed_df())
+            .transform(_mixed_df()),
+        ),
+        "TextFeaturizer": lambda: (
+            TextFeaturizer(inputCol="t", outputCol="feats", numFeatures=32),
+            text_df, text_df,
+        ),
+        # -- io.http (request building / parsing are offline-safe) --------
+        "JSONInputParser": lambda: (
+            JSONInputParser(inputCol="a", outputCol="req", url="http://localhost:1/x"),
+            None, simple,
+        ),
+        "JSONOutputParser": lambda: (
+            JSONOutputParser(inputCol="resp", outputCol="out"), None,
+            DataFrame({"resp": [
+                {"statusLine": {"statusCode": 200, "reasonPhrase": "OK"},
+                 "headers": [], "entity": {"content": b'{"ok": 1}'}},
+            ]}),
+        ),
+        # -- models -------------------------------------------------------
+        "LightGBMClassifier": lambda: (_lgbm(), _tab_df(), _tab_df()),
+        "LightGBMRegressor": lambda: (
+            LightGBMRegressor(numIterations=3, numLeaves=4, minDataInLeaf=2),
+            _tab_df(), _tab_df(),
+        ),
+        "LightGBMRanker": lambda: (
+            LightGBMRanker(numIterations=3, numLeaves=4, minDataInLeaf=2,
+                           groupCol="group"),
+            rank_df, rank_df,
+        ),
+        "VowpalWabbitClassifier": lambda: (
+            VowpalWabbitClassifier(numPasses=2), _tab_df(), _tab_df(),
+        ),
+        "VowpalWabbitRegressor": lambda: (
+            VowpalWabbitRegressor(numPasses=2), _tab_df(), _tab_df(),
+        ),
+        "VowpalWabbitFeaturizer": lambda: (
+            VowpalWabbitFeaturizer(inputCols=["a", "b"], outputCol="f",
+                                   numBits=8),
+            None, simple,
+        ),
+        "VowpalWabbitInteractions": lambda: (
+            VowpalWabbitInteractions(inputCols=["a", "b"], outputCol="f",
+                                     numBits=8),
+            None, simple,
+        ),
+        "SAR": lambda: (
+            SAR(userCol="user", itemCol="item", ratingCol="rating"),
+            _ratings_df(), _ratings_df(),
+        ),
+        "RecommendationIndexer": lambda: (
+            RecommendationIndexer(userInputCol="user", itemInputCol="item",
+                                  userOutputCol="u", itemOutputCol="i"),
+            _ratings_df(), _ratings_df(),
+        ),
+        "RankingAdapter": lambda: (
+            RankingAdapter(recommender=SAR(userCol="user", itemCol="item",
+                                           ratingCol="rating"), k=3),
+            _ratings_df(), _ratings_df(),
+        ),
+        "RankingEvaluator": lambda: (RankingEvaluator(k=3), None, None),
+        "RankingTrainValidationSplit": lambda: (
+            RankingTrainValidationSplit(
+                estimator=SAR(userCol="user", itemCol="item", ratingCol="rating"),
+                userCol="user", itemCol="item", trainRatio=0.75, k=3,
+            ),
+            _ratings_df(), _ratings_df(),
+        ),
+        "KNN": lambda: (
+            KNN(valuesCol="values", k=2),
+            DataFrame({"features": list(np.eye(3)), "values": ["a", "b", "c"]}),
+            DataFrame({"features": [np.array([1.0, 0.1, 0.0])]}),
+        ),
+        "ConditionalKNN": lambda: (
+            ConditionalKNN(valuesCol="values", labelCol="cond", k=1),
+            DataFrame({"features": list(np.eye(3)), "values": ["a", "b", "c"],
+                       "cond": [0, 0, 1]}),
+            DataFrame({"features": [np.array([1.0, 0.1, 0.0])],
+                       "conditioner": [[0]]}),
+        ),
+        "IsolationForest": lambda: (
+            IsolationForest(numEstimators=5, maxSamples=16),
+            _tab_df(40), _tab_df(10),
+        ),
+        # -- image --------------------------------------------------------
+        "ImageTransformer": lambda: (
+            ImageTransformer().resize(8, 8), None, _img_df(),
+        ),
+        "UnrollImage": lambda: (UnrollImage(), None, _img_df()),
+        "UnrollBinaryImage": lambda: (UnrollBinaryImage(), None, _img_df()),
+        "ImageSetAugmenter": lambda: (ImageSetAugmenter(), None, _img_df()),
+        "SuperpixelTransformer": lambda: (
+            SuperpixelTransformer(inputCol="image", cellSize=6), None, _img_df(),
+        ),
+        # -- explain ------------------------------------------------------
+        "TabularLIME": lambda: (
+            TabularLIME(model=_lgbm().fit(_tab_df()), inputCol="features",
+                        predictionCol="prediction", nSamples=32),
+            _tab_df(), DataFrame({"features": [np.zeros(4)]}),
+        ),
+        # -- train / metrics ----------------------------------------------
+        "TrainClassifier": lambda: (
+            TrainClassifier(model=_lgbm(), labelCol="label"),
+            _mixed_df(), _mixed_df(),
+        ),
+        "TrainRegressor": lambda: (
+            TrainRegressor(
+                model=LightGBMRegressor(numIterations=2, numLeaves=4,
+                                        minDataInLeaf=2),
+                labelCol="label",
+            ),
+            _mixed_df(), _mixed_df(),
+        ),
+        "ComputeModelStatistics": lambda: (
+            ComputeModelStatistics(evaluationMetric="classification"),
+            None, _scored_df(),
+        ),
+        "ComputePerInstanceStatistics": lambda: (
+            ComputePerInstanceStatistics(evaluationMetric="classification"),
+            None, _scored_df(),
+        ),
+        # -- automl -------------------------------------------------------
+        "FindBestModel": lambda: (
+            FindBestModel(models=[_lgbm(2), _lgbm(3)],
+                          evaluationMetric="accuracy"),
+            _tab_df(), _tab_df(),
+        ),
+        "TuneHyperparameters": lambda: (
+            TuneHyperparameters(
+                estimator=_lgbm(),
+                searchSpace=(
+                    HyperparamBuilder()
+                    .addHyperparam("numLeaves", DiscreteHyperParam([3, 4]))
+                    .build()
+                ),
+                evaluationMetric="accuracy", numFolds=2, numRuns=2,
+            ),
+            _tab_df(), _tab_df(),
+        ),
+    }
+
+
+def _double_a(df):
+    return df.withColumn("a", [v * 2 for v in df["a"]])
+
+
+def _plus_one(v):
+    return v + 1
+
+
+# Stages whose transform needs a live endpoint or a model payload; the
+# persistence fuzz runs here, the live path is covered by the named suite.
+PERSIST_ONLY = {
+    "HTTPTransformer": "tests/test_stages_featurize_train.py (serving)",
+    "SimpleHTTPTransformer": "tests/test_stages_featurize_train.py",
+    "TextSentiment": "tests/test_cognitive.py",
+    "KeyPhraseExtractor": "tests/test_cognitive.py",
+    "NER": "tests/test_cognitive.py",
+    "EntityDetector": "tests/test_cognitive.py",
+    "LanguageDetector": "tests/test_cognitive.py",
+    "Translate": "tests/test_cognitive.py",
+    "AnalyzeImage": "tests/test_cognitive.py",
+    "OCR": "tests/test_cognitive.py",
+    "DescribeImage": "tests/test_cognitive.py",
+    "TagImage": "tests/test_cognitive.py",
+    "DetectFace": "tests/test_cognitive.py",
+    "DetectLastAnomaly": "tests/test_cognitive.py",
+    "DetectEntireSeries": "tests/test_cognitive.py",
+    "BingImageSearch": "tests/test_cognitive.py",
+    "ONNXModel": "tests/test_onnx.py",
+    "CNTKModel": "tests/test_onnx.py",
+    "ImageFeaturizer": "tests/test_automl_image.py",
+    "ImageLIME": "tests/test_automl_image.py",
+}
+
+# Model classes: covered by their estimator's fixture (the fitted model is
+# save/load round-tripped and its transform compared there).
+MODEL_CLASSES = {
+    "PipelineModel": "Pipeline",
+    "ClassBalancerModel": "ClassBalancer",
+    "CleanMissingDataModel": "CleanMissingData",
+    "FeaturizeModel": "Featurize",
+    "ValueIndexerModel": "ValueIndexer",
+    "TextFeaturizerModel": "TextFeaturizer",
+    "LightGBMClassificationModel": "LightGBMClassifier",
+    "LightGBMRegressionModel": "LightGBMRegressor",
+    "LightGBMRankerModel": "LightGBMRanker",
+    "VowpalWabbitClassificationModel": "VowpalWabbitClassifier",
+    "VowpalWabbitRegressionModel": "VowpalWabbitRegressor",
+    "SARModel": "SAR",
+    "RecommendationIndexerModel": "RecommendationIndexer",
+    "RankingAdapterModel": "RankingAdapter",
+    "RankingTrainValidationSplitModel": "RankingTrainValidationSplit",
+    "KNNModel": "KNN",
+    "ConditionalKNNModel": "ConditionalKNN",
+    "IsolationForestModel": "IsolationForest",
+    "TabularLIMEModel": "TabularLIME",
+    "TrainedClassifierModel": "TrainClassifier",
+    "TrainedRegressorModel": "TrainRegressor",
+    "BestModel": "FindBestModel",
+    "TuneHyperparametersModel": "TuneHyperparameters",
+}
+
+
+# ---------------------------------------------------------------------------
+# Comparison helpers
+# ---------------------------------------------------------------------------
+def _approx_eq(a, b, path=""):
+    if isinstance(a, dict) and isinstance(b, dict):
+        assert set(a) == set(b), f"{path}: keys {set(a)} != {set(b)}"
+        for k in a:
+            _approx_eq(a[k], b[k], f"{path}.{k}")
+        return
+    if isinstance(a, (list, tuple, np.ndarray)) and isinstance(b, (list, tuple, np.ndarray)):
+        a, b = list(a), list(b)
+        assert len(a) == len(b), f"{path}: len {len(a)} != {len(b)}"
+        for i, (x, y) in enumerate(zip(a, b)):
+            _approx_eq(x, y, f"{path}[{i}]")
+        return
+    if isinstance(a, (float, np.floating)) and isinstance(b, (float, np.floating)):
+        if math.isnan(a) and math.isnan(b):
+            return
+        assert a == pytest.approx(b, rel=1e-5, abs=1e-6), f"{path}: {a} != {b}"
+        return
+    assert np.asarray(a == b).all(), f"{path}: {a!r} != {b!r}"
+
+
+def _assert_df_eq(d1: DataFrame, d2: DataFrame):
+    assert set(d1.columns) == set(d2.columns)
+    for c in d1.columns:
+        _approx_eq(list(d1[c]), list(d2[c]), path=c)
+
+
+_ALL_FIXTURES = _fixtures()
+_ALL_NAMES = sorted(
+    c.__name__ for c in all_stage_classes()
+)
+
+
+class TestCoverageMeta:
+    def test_every_registered_stage_is_covered(self):
+        missing = [
+            n for n in _ALL_NAMES
+            if n not in _ALL_FIXTURES
+            and n not in PERSIST_ONLY
+            and n not in MODEL_CLASSES
+        ]
+        assert not missing, (
+            f"stages registered without fuzzing coverage: {missing} — add a "
+            f"FIXTURES entry (or PERSIST_ONLY/MODEL_CLASSES with a reason)"
+        )
+
+    def test_model_classes_point_at_real_fixtures(self):
+        for model, est in MODEL_CLASSES.items():
+            assert est in _ALL_FIXTURES, f"{model} → {est} has no fixture"
+
+    def test_no_stale_fixture_entries(self):
+        known = set(_ALL_NAMES)
+        for n in list(_ALL_FIXTURES) + list(PERSIST_ONLY) + list(MODEL_CLASSES):
+            assert n in known, f"fixture for unregistered stage {n}"
+
+
+@pytest.mark.parametrize("name", sorted(_ALL_FIXTURES))
+def test_stage_fuzz(name, tmp_path):
+    cls = {c.__name__: c for c in all_stage_classes()}[name]
+    stage, fit_df, tdf = _ALL_FIXTURES[name]()
+    assert isinstance(stage, cls)
+
+    # estimator/transformer param persistence
+    p1 = str(tmp_path / "stage")
+    stage.save(p1)
+    loaded = cls.load(p1)
+    if not _has_complex_params(cls):
+        assert _param_snapshot(stage) == _param_snapshot(loaded)
+
+    subject = stage
+    if isinstance(stage, Estimator) and fit_df is not None:
+        subject = stage.fit(fit_df)
+    if tdf is None:
+        return
+    out1 = subject.transform(tdf)
+
+    # save → load → re-transform → equality (the reference's
+    # SerializationFuzzing contract)
+    p2 = str(tmp_path / "fitted")
+    subject.save(p2)
+    subject2 = type(subject).load(p2)
+    out2 = subject2.transform(tdf)
+    _assert_df_eq(out1, out2)
+
+
+@pytest.mark.parametrize("name", sorted(PERSIST_ONLY))
+def test_stage_persist_only(name, tmp_path):
+    cls = {c.__name__: c for c in all_stage_classes()}[name]
+    stage = cls()
+    path = str(tmp_path / "stage")
+    stage.save(path)
+    loaded = cls.load(path)
+    if not _has_complex_params(cls):
+        assert _param_snapshot(stage) == _param_snapshot(loaded)
+    assert type(loaded) is cls
+
+
+def _has_complex_params(cls) -> bool:
+    from mmlspark_tpu.core.params import ComplexParam
+
+    return any(isinstance(p, ComplexParam) for p in cls._params.values())
+
+
+def _param_snapshot(stage):
+    out = {}
+    for name in stage._params:
+        if stage.isDefined(name):
+            v = stage.getOrDefault(name)
+            out[name] = repr(v)
+    return out
