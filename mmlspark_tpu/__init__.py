@@ -88,6 +88,11 @@ _LAZY = {
     "DetectLastAnomaly": "mmlspark_tpu.cognitive",
     "DetectEntireSeries": "mmlspark_tpu.cognitive",
     "BingImageSearch": "mmlspark_tpu.cognitive",
+    "SparseVector": "mmlspark_tpu.core.linalg",
+    "ModelDownloader": "mmlspark_tpu.models.downloader",
+    "ModelSchema": "mmlspark_tpu.models.downloader",
+    "readStream": "mmlspark_tpu.io.http.serving_streams",
+    "StreamingQuery": "mmlspark_tpu.io.http.serving_streams",
 }
 
 

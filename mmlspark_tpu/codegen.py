@@ -1,0 +1,161 @@
+"""Codegen: the generated binding surface + generated smoke tests.
+
+Reference parity (SURVEY.md §2.2 — load-bearing): upstream walks every
+``Wrappable`` stage via reflection and EMITS the Python/R API (one class
+per stage with a keyword argument per Param, getters/setters) plus
+generated pytest smoke tests, so the params metadata is the single source
+of truth for the whole binding surface.
+
+Here Python is already the source of truth (SURVEY.md §2.2 "Build
+implication": invert the direction), so the generator's jobs are:
+
+1. ``generate_api(path)`` — emit ``mmlspark_tpu/generated_api.py``: one
+   wrapper class per registered stage whose ``__init__`` has an EXPLICIT
+   keyword argument per Param (with its default), giving IDEs/users the
+   full introspectable surface the reference's generated PySpark wrappers
+   gave.  The emitted file is committed; a meta-test regenerates and
+   diffs, so a param added without regenerating fails CI (the reference's
+   codegen-tests job).
+2. ``generate_smoke_tests(path)`` — emit a pytest module with one test per
+   stage: construct → per-param kwarg acceptance → setter/getter round
+   trip (the reference's ``PySparkWrapperTest`` output).
+
+Run ``python -m mmlspark_tpu.codegen`` to regenerate both.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import List
+
+from mmlspark_tpu.core.params import ComplexParam, Param
+from mmlspark_tpu.core.registry import all_stage_classes
+
+_NO_DEFAULT = object()
+
+
+def _param_default_expr(p: Param) -> str:
+    d = getattr(p, "default", _NO_DEFAULT)
+    sentinel = type(d).__name__ == "object"  # core.params._NO_DEFAULT
+    if sentinel:
+        return "_UNSET"
+    try:
+        expr = repr(d)
+        if eval(expr, {}) == d or (d != d):  # noqa: S307 — literals only
+            return expr
+    except Exception:
+        pass
+    return "_UNSET"
+
+
+def _emit_class(cls) -> List[str]:
+    params = sorted(cls._params.values(), key=lambda p: p.name)
+    args = ["self"] + (["*"] if params else [])
+    for p in params:
+        args.append(f"{p.name}={_param_default_expr(p)}")
+    lines = [
+        f"class {cls.__name__}(_{cls.__name__}):",
+        f'    """Generated wrapper over '
+        f":class:`{cls.__module__}.{cls.__qualname__}`.",
+        "",
+        "    Params:",
+    ]
+    for p in params:
+        doc = (p.doc or "").replace('"', "'").split("\n")[0]
+        lines.append(f"      {p.name}: {doc}")
+    lines += [
+        '    """',
+        "",
+        f"    def __init__({', '.join(args)}):",
+        "        kw = {k: v for k, v in locals().items()",
+        "              if k not in ('self', '__class__') and v is not _UNSET}",
+        "        super().__init__(**kw)",
+        "",
+        "",
+    ]
+    return lines
+
+
+def render_api() -> str:
+    classes = all_stage_classes()
+    lines = [
+        '"""GENERATED FILE — do not edit by hand.',
+        "",
+        "Regenerate with `python -m mmlspark_tpu.codegen` (the codegen",
+        "meta-test diffs this file against the registry — SURVEY.md §2.2).",
+        '"""',
+        "",
+        "# flake8: noqa",
+        "_UNSET = object()",
+        "",
+    ]
+    for cls in classes:
+        lines.append(
+            f"from {cls.__module__} import {cls.__qualname__} as _{cls.__name__}"
+        )
+    lines.append("")
+    lines.append("")
+    for cls in classes:
+        lines += _emit_class(cls)
+    lines.append("__all__ = [")
+    for cls in classes:
+        lines.append(f"    {cls.__name__!r},")
+    lines.append("]")
+    lines.append("")
+    return "\n".join(lines)
+
+
+def render_smoke_tests() -> str:
+    classes = all_stage_classes()
+    lines = [
+        '"""GENERATED smoke tests — do not edit by hand.',
+        "",
+        "One test per stage: bare construction through the generated wrapper,",
+        "kwarg acceptance for every defaulted Param, setter/getter round trip",
+        '(the reference codegen\'s PySparkWrapperTest output — SURVEY.md §2.2)."""',
+        "",
+        "# flake8: noqa",
+        "import pytest",
+        "",
+        "import mmlspark_tpu.generated_api as gen",
+        "",
+        "_SAMPLES = {int: 3, float: 0.25, str: 'x', bool: True}",
+        "",
+    ]
+    for cls in classes:
+        simple = [
+            p for p in sorted(cls._params.values(), key=lambda p: p.name)
+            if not isinstance(p, ComplexParam)
+            and getattr(p, "dtype", None) in (int, float, str, bool)
+            and getattr(p, "validator", None) is None
+        ]
+        name = cls.__name__
+        lines += [
+            f"def test_generated_{name}():",
+            f"    stage = gen.{name}()",
+            f"    assert type(stage).__mro__[1].__name__ == {name!r}",
+        ]
+        for p in simple[:6]:
+            cap = p.name[0].upper() + p.name[1:]
+            lines += [
+                f"    v = _SAMPLES[{p.dtype.__name__}]",
+                f"    stage.set{cap}(v)",
+                f"    assert stage.get{cap}() == v",
+            ]
+        lines += ["", ""]
+    return "\n".join(lines)
+
+
+def generate(repo_root: str | None = None) -> None:
+    root = repo_root or os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    api_path = os.path.join(root, "mmlspark_tpu", "generated_api.py")
+    test_path = os.path.join(root, "tests", "test_codegen_generated.py")
+    with open(api_path, "w") as f:
+        f.write(render_api())
+    with open(test_path, "w") as f:
+        f.write(render_smoke_tests())
+    print(f"wrote {api_path}\nwrote {test_path}")
+
+
+if __name__ == "__main__":
+    generate()

@@ -38,7 +38,11 @@ class _AnomalyBase(CognitiveServicesBase):
         s = ctx["series"][i]
         if is_missing(s):
             return None
-        body = {"series": list(s), "granularity": ctx["granularity"][i]}
+        gran = ctx["granularity"][i]
+        body = {
+            "series": list(s),
+            "granularity": "daily" if is_missing(gran) else gran,
+        }
         if not is_missing(ctx["sensitivity"][i]):
             body["sensitivity"] = ctx["sensitivity"][i]
         if not is_missing(ctx["maxAnomalyRatio"][i]):
@@ -85,7 +89,8 @@ class BingImageSearch(CognitiveServicesBase):
         }
 
     def _row_query(self, ctx, i):
-        return {"q": str(ctx["q"][i]), "count": str(ctx["count"][i])}
+        c = ctx["count"][i]
+        return {"q": str(ctx["q"][i]), "count": "10" if is_missing(c) else str(int(c))}
 
     def _row_body(self, ctx, i):
         # GET: body presence gates the row; return an empty marker when the

@@ -33,9 +33,11 @@ class _TextAnalyticsBase(CognitiveServicesBase):
         t = ctx["text"][i]
         if is_missing(t):
             return None
+        lang = ctx["language"][i]
         return {
             "documents": [
-                {"id": "0", "text": str(t), "language": ctx["language"][i]}
+                {"id": "0", "text": str(t),
+                 "language": "en" if is_missing(lang) else lang}
             ]
         }
 
@@ -116,8 +118,9 @@ class Translate(CognitiveServicesBase):
         }
 
     def _row_query(self, ctx, i):
-        q = {"api-version": "3.0", "to": ctx["to"][i]}
-        if ctx["from"][i]:
+        to = ctx["to"][i]
+        q = {"api-version": "3.0", "to": "en" if is_missing(to) else to}
+        if not is_missing(ctx["from"][i]) and ctx["from"][i]:
             q["from"] = ctx["from"][i]
         return q
 

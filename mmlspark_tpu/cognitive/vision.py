@@ -55,7 +55,7 @@ class AnalyzeImage(_ImageInputBase):
 
     def _row_query(self, ctx, i):
         vf = ctx["visualFeatures"][i]
-        return {"visualFeatures": vf} if vf else {}
+        return {} if is_missing(vf) or not vf else {"visualFeatures": vf}
 
 
 @register_stage
@@ -70,7 +70,8 @@ class OCR(_ImageInputBase):
     _EXTRA_VECTOR_PARAMS = ("detectOrientation",)
 
     def _row_query(self, ctx, i):
-        return {"detectOrientation": str(bool(ctx["detectOrientation"][i])).lower()}
+        v = ctx["detectOrientation"][i]
+        return {"detectOrientation": str(not is_missing(v) and bool(v)).lower()}
 
 
 @register_stage
@@ -85,7 +86,8 @@ class DescribeImage(_ImageInputBase):
     _EXTRA_VECTOR_PARAMS = ("maxCandidates",)
 
     def _row_query(self, ctx, i):
-        return {"maxCandidates": str(ctx["maxCandidates"][i])}
+        v = ctx["maxCandidates"][i]
+        return {"maxCandidates": "1" if is_missing(v) else str(int(v))}
 
 
 @register_stage
@@ -110,10 +112,9 @@ class DetectFace(_ImageInputBase):
     _EXTRA_VECTOR_PARAMS = ("returnFaceAttributes", "returnFaceLandmarks")
 
     def _row_query(self, ctx, i):
-        q = {
-            "returnFaceLandmarks": str(bool(ctx["returnFaceLandmarks"][i])).lower()
-        }
+        lm = ctx["returnFaceLandmarks"][i]
+        q = {"returnFaceLandmarks": str(not is_missing(lm) and bool(lm)).lower()}
         attrs = ctx["returnFaceAttributes"][i]
-        if attrs:
+        if not is_missing(attrs) and attrs:
             q["returnFaceAttributes"] = attrs
         return q

@@ -1,0 +1,86 @@
+"""Minimal sparse vector, mirroring ``pyspark.ml.linalg.SparseVector``.
+
+The reference's VW featurizer emits SparkML sparse vectors (hashed feature
+spaces are 2^18+ slots with a handful of non-zeros per row — SURVEY.md
+§2.5); round 1 materialized a dense (rows × 2^18) matrix instead (~2 GB per
+1k rows).  This class carries (size, indices, values) per row; consumers
+densify per bounded minibatch or compute index-wise.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import numpy as np
+
+
+class SparseVector:
+    __slots__ = ("size", "indices", "values")
+
+    def __init__(self, size: int, indices: Sequence[int], values: Sequence[float]):
+        self.size = int(size)
+        self.indices = np.asarray(indices, dtype=np.int64)
+        self.values = np.asarray(values, dtype=np.float64)
+        if self.indices.shape != self.values.shape:
+            raise ValueError("indices/values length mismatch")
+
+    @property
+    def nnz(self) -> int:
+        return int(self.indices.size)
+
+    def toArray(self) -> np.ndarray:
+        out = np.zeros(self.size)
+        np.add.at(out, self.indices, self.values)
+        return out
+
+    def dot(self, dense: np.ndarray) -> float:
+        return float((np.asarray(dense)[self.indices] * self.values).sum())
+
+    def __len__(self) -> int:
+        return self.size
+
+    def __getitem__(self, i: int):
+        # IndexError on out-of-range is REQUIRED: Python's sequence
+        # iteration (and np.asarray) call __getitem__ with increasing
+        # indices until it raises — without it, iteration never ends.
+        if i < 0:
+            i += self.size
+        if not 0 <= i < self.size:
+            raise IndexError(f"index {i} out of range for size {self.size}")
+        hits = self.values[self.indices == i]
+        return float(hits.sum()) if hits.size else 0.0
+
+    def __array__(self, dtype=None, copy=None):
+        arr = self.toArray()
+        return arr.astype(dtype) if dtype is not None else arr
+
+    def __eq__(self, other) -> bool:
+        if not isinstance(other, SparseVector):
+            return NotImplemented
+        return (
+            self.size == other.size
+            and np.array_equal(self.indices, other.indices)
+            and np.array_equal(self.values, other.values)
+        )
+
+    def __repr__(self) -> str:
+        return (
+            f"SparseVector({self.size}, {self.indices.tolist()}, "
+            f"{self.values.tolist()})"
+        )
+
+
+def stack_sparse(rows: Sequence[SparseVector]):
+    """Pad a batch of sparse vectors to (n, K) index/value arrays.
+
+    K = max nnz in the batch; padding uses index 0 with value 0 (harmless
+    under gather-multiply-sum and scatter-add consumers).
+    """
+    n = len(rows)
+    K = max((r.nnz for r in rows), default=1) or 1
+    idx = np.zeros((n, K), np.int32)
+    val = np.zeros((n, K), np.float32)
+    for i, r in enumerate(rows):
+        idx[i, : r.nnz] = r.indices
+        val[i, : r.nnz] = r.values
+    return idx, val

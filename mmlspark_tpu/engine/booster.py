@@ -97,6 +97,7 @@ class TrainConfig:
     cat_smooth: float = 10.0
     cat_l2: float = 10.0
     max_cat_threshold: int = 32
+    num_threads: int = 0  # host-side binner threads (0 = auto)
     verbosity: int = 1
 
     _ALIASES = {
@@ -553,6 +554,7 @@ def train(
             max_bin=cfg.max_bin,
             categorical_features=tuple(cfg.categorical_feature),
             seed=cfg.seed,
+            threads=cfg.num_threads,
         ).fit(train_set.X)
     bins_np = bin_mapper.transform(train_set.X)
     n, F = bins_np.shape

@@ -1,0 +1,216 @@
+"""Spark Serving DSL: streaming HTTP source/sink + continuous queries.
+
+Reference parity (SURVEY.md §2.6 "Spark Serving", §3.4): the reference
+injects ``HTTPSourceV2``/``DistributedHTTPSource``/``HTTPSinkProvider``
+into Spark's streaming package so users write
+
+    spark.readStream.server().address(host, port, api).load()
+      ... pipeline stages ...
+      .writeStream.server().replyTo(id).queryName(q).start()
+
+This module reproduces that DSL over the micro-batch
+:class:`~mmlspark_tpu.io.http.serving.HTTPServer`: ``readStream()`` builds
+a source (one embedded server, or N of them for the distributed variant —
+the reference's per-executor ``DistributedHTTPSource``), stages chain with
+``.transform(...)``, and ``.writeStream.server().replyTo("id").start()``
+launches a :class:`StreamingQuery` whose loop drains micro-batches from
+every replica, runs the stages ONCE per batch (the TPU win: whole batches
+through one jitted apply — SURVEY.md §3.3), and replies by request id.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, List, Optional
+
+from mmlspark_tpu.core.frame import DataFrame
+from mmlspark_tpu.io.http.serving import HTTPServer
+
+
+class StreamingQuery:
+    """A running continuous query (reference: Spark's ``StreamingQuery``)."""
+
+    def __init__(self, name: str, servers: List[HTTPServer],
+                 stages: List[Callable[[DataFrame], DataFrame]],
+                 reply_col: str, id_col: str, batch_size: int):
+        self.name = name
+        self._servers = servers
+        self._stages = stages
+        self._reply_col = reply_col
+        self._id_col = id_col
+        self._batch_size = batch_size
+        self._stop = threading.Event()
+        self._exception: Optional[BaseException] = None
+        self._batches = 0
+        self._rows = 0
+        self._thread = threading.Thread(target=self._run, daemon=True)
+
+    # -- lifecycle --------------------------------------------------------
+    def _start(self) -> "StreamingQuery":
+        for s in self._servers:
+            s.start()
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._thread.join(timeout=10)
+        for s in self._servers:
+            s.stop()
+
+    def awaitTermination(self, timeout: Optional[float] = None) -> bool:
+        self._thread.join(timeout)
+        return not self._thread.is_alive()
+
+    @property
+    def isActive(self) -> bool:
+        return self._thread.is_alive()
+
+    def exception(self) -> Optional[BaseException]:
+        return self._exception
+
+    @property
+    def lastProgress(self) -> dict:
+        return {
+            "name": self.name,
+            "numBatches": self._batches,
+            "numRowsProcessed": self._rows,
+            "replicas": [
+                {"host": s.host, "port": s.port} for s in self._servers
+            ],
+        }
+
+    # -- the micro-batch loop ----------------------------------------------
+    def _run(self) -> None:
+        per = max(1, self._batch_size // len(self._servers))
+        while not self._stop.is_set():
+            drained = False
+            for server in self._servers:
+                batch = server.get_batch(max_rows=per, timeout=0.1)
+                if batch.count() == 0:
+                    continue
+                drained = True
+                try:
+                    out = batch
+                    for stage in self._stages:
+                        out = stage(out)
+                    server.reply_batch(out, response_col=self._reply_col)
+                except BaseException as e:  # surface via .exception()
+                    self._exception = e
+                    from mmlspark_tpu.io.http.http_schema import HTTPResponseData
+
+                    for rid in batch[self._id_col]:
+                        server.reply(
+                            rid, HTTPResponseData(statusCode=500,
+                                                  statusReason=repr(e))
+                        )
+                self._batches += 1
+                self._rows += batch.count()
+            if not drained:
+                time.sleep(0.02)
+
+
+class _SourceBuilder:
+    """``readStream.server()`` — address/options builder."""
+
+    def __init__(self):
+        self._host, self._port, self._api = "127.0.0.1", 0, "/"
+        self._replicas = 1
+        self._options = {}
+
+    def address(self, host: str, port: int, api_path: str = "/") -> "_SourceBuilder":
+        self._host, self._port, self._api = host, port, api_path
+        return self
+
+    def option(self, key: str, value) -> "_SourceBuilder":
+        if key == "numPartitions" or key == "replicas":
+            self._replicas = int(value)
+        else:
+            self._options[key] = value
+        return self
+
+    def distributed(self, replicas: int) -> "_SourceBuilder":
+        """The ``DistributedHTTPSource`` variant: one embedded server per
+        replica (per executor in the reference), all drained by the query."""
+        self._replicas = max(1, int(replicas))
+        return self
+
+    def load(self) -> "ServingFrame":
+        servers = [
+            HTTPServer(self._host, self._port if i == 0 and self._replicas == 1 else 0,
+                       api_path=self._api)
+            for i in range(self._replicas)
+        ]
+        return ServingFrame(servers)
+
+
+class ServingFrame:
+    """The streaming frame handle: chain stages, then ``writeStream``."""
+
+    def __init__(self, servers: List[HTTPServer],
+                 stages: Optional[List[Callable]] = None):
+        self._servers = servers
+        self._stages = list(stages or [])
+
+    def isStreaming(self) -> bool:
+        return True
+
+    @property
+    def addresses(self) -> List[tuple]:
+        return [(s.host, s.port) for s in self._servers]
+
+    def transform(self, stage) -> "ServingFrame":
+        """Attach a Transformer (or df→df callable) to the query plan."""
+        fn = stage.transform if hasattr(stage, "transform") else stage
+        return ServingFrame(self._servers, self._stages + [fn])
+
+    def withColumn(self, name: str, fn: Callable) -> "ServingFrame":
+        return self.transform(lambda df: df.withColumn(name, fn))
+
+    @property
+    def writeStream(self) -> "_SinkBuilder":
+        return _SinkBuilder(self)
+
+
+class _SinkBuilder:
+    """``writeStream.server()`` — reply routing + query options."""
+
+    def __init__(self, frame: ServingFrame):
+        self._frame = frame
+        self._reply_col = "response"
+        self._id_col = "id"
+        self._name = "serving-query"
+        self._batch_size = 64
+
+    def server(self) -> "_SinkBuilder":
+        return self
+
+    def replyTo(self, reply_col: str, id_col: str = "id") -> "_SinkBuilder":
+        self._reply_col, self._id_col = reply_col, id_col
+        return self
+
+    def queryName(self, name: str) -> "_SinkBuilder":
+        self._name = name
+        return self
+
+    def option(self, key: str, value) -> "_SinkBuilder":
+        if key == "maxBatchSize":
+            self._batch_size = int(value)
+        return self
+
+    def start(self) -> StreamingQuery:
+        return StreamingQuery(
+            self._name, self._frame._servers, self._frame._stages,
+            self._reply_col, self._id_col, self._batch_size,
+        )._start()
+
+
+class _ReadStream:
+    def server(self) -> _SourceBuilder:
+        return _SourceBuilder()
+
+
+def readStream() -> _ReadStream:
+    """Entry point mirroring ``spark.readStream`` (+ ``.server()`` DSL)."""
+    return _ReadStream()

@@ -1,0 +1,119 @@
+"""ModelDownloader: typed catalog of pretrained models + verified fetch.
+
+Reference parity (SURVEY.md §2.4, UPSTREAM:.../downloader/): a catalog of
+pretrained CNN models (name, uri, sha256 hash, input node, layer count)
+downloaded to a local directory with hash verification, feeding
+``ImageFeaturizer``.  The reference's catalog points at CNTK models on
+Azure blob storage; this one carries ONNX models (the interchange format
+of our deep-learning inference stack — SURVEY.md §2.9 N3/N4) and supports
+``https://``/``file://`` URIs through the same verified-fetch path, so
+air-gapped deployments register local catalogs.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import shutil
+import urllib.request
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, Optional
+
+
+@dataclass(frozen=True)
+class ModelSchema:
+    """One catalog entry (reference ``ModelSchema``)."""
+
+    name: str
+    uri: str
+    hash: str  # sha256 hex of the model file
+    inputNode: str = "data"
+    numLayers: int = 0
+    dataset: str = ""
+    modelType: str = "onnx"
+
+    def filename(self) -> str:
+        return os.path.basename(self.uri.rstrip("/")) or f"{self.name}.onnx"
+
+
+# The reference ships a fixed catalog of ImageNet CNNs; the names are kept
+# so ImageFeaturizer call sites port over.  URIs intentionally point at the
+# public ONNX model zoo layout — in an air-gapped image, register local
+# file:// entries instead (``ModelDownloader.register``).
+DEFAULT_CATALOG = {
+    "ResNet50": ModelSchema(
+        name="ResNet50",
+        uri="https://github.com/onnx/models/raw/main/validated/vision/classification/resnet/model/resnet50-v1-7.onnx",
+        hash="", inputNode="data", numLayers=50, dataset="ImageNet",
+    ),
+    "ResNet18": ModelSchema(
+        name="ResNet18",
+        uri="https://github.com/onnx/models/raw/main/validated/vision/classification/resnet/model/resnet18-v1-7.onnx",
+        hash="", inputNode="data", numLayers=18, dataset="ImageNet",
+    ),
+    "SqueezeNet": ModelSchema(
+        name="SqueezeNet",
+        uri="https://github.com/onnx/models/raw/main/validated/vision/classification/squeezenet/model/squeezenet1.0-7.onnx",
+        hash="", inputNode="data", numLayers=18, dataset="ImageNet",
+    ),
+}
+
+
+def sha256_file(path: str) -> str:
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            h.update(chunk)
+    return h.hexdigest()
+
+
+class ModelDownloader:
+    """Fetch-with-verify into a local model directory.
+
+    ``downloadByName(name)``/``downloadModel(schema)`` → local path; a file
+    whose sha256 already matches is not re-fetched (the reference's
+    behavior).  Hash mismatches DELETE the corrupt file and raise.
+    """
+
+    def __init__(self, local_path: str, catalog: Optional[Dict[str, ModelSchema]] = None):
+        self.local_path = local_path
+        self.catalog: Dict[str, ModelSchema] = dict(DEFAULT_CATALOG)
+        if catalog:
+            self.catalog.update(catalog)
+        os.makedirs(local_path, exist_ok=True)
+
+    def register(self, schema: ModelSchema) -> None:
+        self.catalog[schema.name] = schema
+
+    def remoteModels(self) -> Iterable[ModelSchema]:
+        return list(self.catalog.values())
+
+    def downloadByName(self, name: str) -> str:
+        if name not in self.catalog:
+            raise KeyError(
+                f"unknown model {name!r}; catalog has {sorted(self.catalog)}"
+            )
+        return self.downloadModel(self.catalog[name])
+
+    def downloadModel(self, schema: ModelSchema) -> str:
+        dest = os.path.join(self.local_path, schema.filename())
+        if os.path.exists(dest) and (
+            not schema.hash or sha256_file(dest) == schema.hash
+        ):
+            return dest
+        tmp = dest + ".part"
+        if schema.uri.startswith("file://"):
+            shutil.copyfile(schema.uri[len("file://"):], tmp)
+        else:
+            with urllib.request.urlopen(schema.uri) as r, open(tmp, "wb") as f:
+                shutil.copyfileobj(r, f)
+        if schema.hash:
+            got = sha256_file(tmp)
+            if got != schema.hash:
+                os.unlink(tmp)
+                raise ValueError(
+                    f"hash mismatch for {schema.name}: expected "
+                    f"{schema.hash}, got {got}"
+                )
+        os.replace(tmp, dest)
+        return dest

@@ -71,8 +71,25 @@ class _OnnxInferenceBase(Model):
             self._jit_cache = self._fn_cache.jit()
         return self._fn_cache
 
+    def _batch_sharding(self):
+        """Row sharding over all visible devices, or None single-device.
+
+        The reference scores partitions independently (embarrassing data
+        parallelism — SURVEY.md §2 parallelism table); here the same batch
+        is SPMD-sharded over the device mesh so one jitted apply runs
+        data-parallel across chips (SURVEY.md §2.9 N4 "jit + pjit batch
+        sharding")."""
+        import jax
+
+        if len(jax.devices()) <= 1:
+            return None
+        from mmlspark_tpu.parallel.mesh import default_mesh
+
+        return default_mesh()
+
     def _run_batched(self, feeds: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:
-        """Fixed-size minibatch loop with tail padding (one compiled shape)."""
+        """Fixed-size minibatch loop with tail padding (one compiled shape);
+        batches are row-sharded over the device mesh when one is visible."""
         graph = self._graph()
         unfed = sorted(set(graph.input_names) - set(feeds))
         if unfed:
@@ -82,6 +99,26 @@ class _OnnxInferenceBase(Model):
             )
         n = next(iter(feeds.values())).shape[0]
         bs = min(self.getMiniBatchSize(), n)
+        mesh = self._batch_sharding()
+        if mesh is not None:
+            import jax
+            from jax.sharding import NamedSharding
+            from jax.sharding import PartitionSpec as P
+
+            from mmlspark_tpu.parallel.mesh import DATA_AXIS
+
+            D = mesh.devices.size
+            bs = max(D, ((bs + D - 1) // D) * D)  # divisible batch rows
+
+            def place(arr):
+                spec = P(DATA_AXIS, *([None] * (arr.ndim - 1)))
+                return jax.device_put(arr, NamedSharding(mesh, spec))
+
+        else:
+
+            def place(arr):
+                return arr
+
         outs: Dict[str, list] = {name: [] for name in graph.output_names}
         for start in range(0, n, bs):
             stop = min(start + bs, n)
@@ -91,7 +128,7 @@ class _OnnxInferenceBase(Model):
                 if stop - start < bs:  # pad the tail to the compiled shape
                     pad = np.zeros((bs - (stop - start),) + arr.shape[1:], arr.dtype)
                     arr = np.concatenate([arr, pad], axis=0)
-                batch[name] = arr
+                batch[name] = place(arr)
             result = self._jit_cache(*[batch[n2] for n2 in graph.input_names])
             for name, val in zip(graph.output_names, result):
                 outs[name].append(np.asarray(val)[: stop - start])

@@ -37,6 +37,7 @@ from mmlspark_tpu.core.params import (
     Params,
 )
 from mmlspark_tpu.core.pipeline import Estimator, Model, Transformer
+from mmlspark_tpu.core.linalg import SparseVector, stack_sparse
 from mmlspark_tpu.core.registry import register_stage
 from mmlspark_tpu.featurize.text import murmurhash3_32
 
@@ -50,11 +51,14 @@ def _hash_feature(name: str, namespace: str = "", seed: int = 0) -> int:
 
 @register_stage
 class VowpalWabbitFeaturizer(Transformer):
-    """Hash (column, value) pairs into an indexed dense vector.
+    """Hash (column, value) pairs into a SPARSE indexed vector.
 
     Numeric column c → weight x at slot hash(c); string column → slot
     hash(c + '=' + value) with weight 1; vector column → per-slot hashes.
-    (Reference: UPSTREAM:.../vw/featurizer/*.scala — SURVEY.md §2.5.)
+    (Reference: UPSTREAM:.../vw/featurizer/*.scala — SURVEY.md §2.5; it
+    emits SparkML sparse vectors, and so does this — the hashed space is
+    2^numBits slots with a handful of non-zeros per row, so a dense
+    per-row vector would be ~1 MB/row at the default 18 bits.)
     """
 
     inputCols = Param("inputCols", "Columns to hash", default=None)
@@ -65,27 +69,42 @@ class VowpalWabbitFeaturizer(Transformer):
     seed = Param("seed", "Hash seed", default=0, dtype=int)
 
     def _transform(self, df: DataFrame) -> DataFrame:
-        n_slots = 1 << min(self.getNumBits(), 22)  # dense storage guard
+        n_slots = 1 << min(self.getNumBits(), 30)  # VW's own bit cap
         cols = self.getInputCols() or [c for c in df.columns if c != self.getOutputCol()]
         seed = self.getSeed()
-        out = np.zeros((df.count(), n_slots))
+        n = df.count()
+        acc = [dict() for _ in range(n)]
+
+        def add(i, slot, x):
+            acc[i][slot] = acc[i].get(slot, 0.0) + x
+
         for c in cols:
             vals = df[c]
             first = vals[0] if len(vals) else 0.0
-            if isinstance(first, (list, np.ndarray)):
+            if isinstance(first, (list, np.ndarray, SparseVector)):
                 for i, v in enumerate(vals):
-                    v = np.asarray(v, dtype=np.float64)
-                    for j, x in enumerate(v):
-                        out[i, _hash_feature(f"{c}_{j}", seed=seed) % n_slots] += x
+                    if isinstance(v, SparseVector):
+                        pairs = zip(v.indices, v.values)
+                    else:
+                        pairs = enumerate(np.asarray(v, dtype=np.float64))
+                    for j, x in pairs:
+                        if x != 0.0:
+                            add(i, _hash_feature(f"{c}_{j}", seed=seed) % n_slots, x)
             elif isinstance(first, str):
                 for i, v in enumerate(vals):
                     toks = str(v).split() if self.getStringSplit() else [str(v)]
                     for tok in toks:
-                        out[i, _hash_feature(f"{c}={tok}", seed=seed) % n_slots] += 1.0
+                        add(i, _hash_feature(f"{c}={tok}", seed=seed) % n_slots, 1.0)
             else:
                 slot = _hash_feature(c, seed=seed) % n_slots
-                out[:, slot] += np.asarray(vals, dtype=np.float64)
-        return df.withColumn(self.getOutputCol(), list(out))
+                for i, x in enumerate(np.asarray(vals, dtype=np.float64)):
+                    if x != 0.0:
+                        add(i, slot, x)
+        out = [
+            SparseVector(n_slots, *(zip(*sorted(d.items())) if d else ((), ())))
+            for d in acc
+        ]
+        return df.withColumn(self.getOutputCol(), out)
 
 
 @register_stage
@@ -103,26 +122,32 @@ class VowpalWabbitInteractions(Transformer):
         if not cols or len(cols) < 2:
             raise ValueError("VowpalWabbitInteractions needs >= 2 inputCols")
         n = df.count()
-        out = np.zeros((n, n_slots))
-        # Scalar numeric columns participate as length-1 vectors (found by
-        # the registry fuzz: np.nonzero on a 0-d value raised).
-        mats = [
-            np.stack([np.atleast_1d(np.asarray(v, dtype=np.float64)) for v in df[c]])
-            for c in cols
-        ]
+        # Per-row (index, value) non-zeros; scalar numeric columns are
+        # length-1 vectors, SparseVector columns use their nnz directly.
+        def row_nz(v):
+            if isinstance(v, SparseVector):
+                return list(zip(v.indices.tolist(), v.values.tolist()))
+            arr = np.atleast_1d(np.asarray(v, dtype=np.float64))
+            nz = np.nonzero(arr)[0]
+            return [(int(j), float(arr[j])) for j in nz]
+
+        col_nz = {c: [row_nz(v) for v in df[c]] for c in cols}
+        acc = [dict() for _ in range(n)]
         for a_i in range(len(cols)):
             for b_i in range(a_i + 1, len(cols)):
-                A, B = mats[a_i], mats[b_i]
-                nz_a = [np.nonzero(A[i])[0] for i in range(n)]
-                nz_b = [np.nonzero(B[i])[0] for i in range(n)]
+                ca, cb = cols[a_i], cols[b_i]
                 for i in range(n):
-                    for ja in nz_a[i]:
-                        for jb in nz_b[i]:
+                    for ja, xa in col_nz[ca][i]:
+                        for jb, xb in col_nz[cb][i]:
                             slot = murmurhash3_32(
-                                f"{cols[a_i]}_{ja}^{cols[b_i]}_{jb}".encode()
+                                f"{ca}_{ja}^{cb}_{jb}".encode()
                             ) % n_slots
-                            out[i, slot] += A[i, ja] * B[i, jb]
-        return df.withColumn(self.getOutputCol(), list(out))
+                            acc[i][slot] = acc[i].get(slot, 0.0) + xa * xb
+        out = [
+            SparseVector(n_slots, *(zip(*sorted(d.items())) if d else ((), ())))
+            for d in acc
+        ]
+        return df.withColumn(self.getOutputCol(), out)
 
 
 # ---------------------------------------------------------------------------
@@ -190,7 +215,13 @@ class _VWBase(Estimator, _VWParams):
         import jax.numpy as jnp
 
         cfg = self._resolved()
-        X = np.stack([np.asarray(v, dtype=np.float32) for v in df[self.getFeaturesCol()]])
+        feats = list(df[self.getFeaturesCol()])
+        sparse = bool(feats) and isinstance(feats[0], SparseVector)
+        if sparse:
+            D = feats[0].size
+            idx_all, val_all = stack_sparse(feats)
+        else:
+            X = np.stack([np.asarray(v, dtype=np.float32) for v in feats])
         y = np.asarray(df[self.getLabelCol()], dtype=np.float32)
         if self._is_classifier:
             y = (y > 0).astype(np.float32)
@@ -199,7 +230,10 @@ class _VWBase(Estimator, _VWParams):
             if self.isSet("weightCol")
             else np.ones_like(y)
         )
-        n, D = X.shape
+        if sparse:
+            n = len(feats)
+        else:
+            n, D = X.shape
         lr0 = float(cfg.get("learningRate", 0.5))
         power_t = float(cfg.get("powerT", 0.5))
         l1 = float(cfg.get("l1", 0.0))
@@ -209,22 +243,43 @@ class _VWBase(Estimator, _VWParams):
         passes = int(cfg.get("numPasses", 1))
 
         pad = (-n) % bs
-        Xp = np.concatenate([X, np.zeros((pad, D), np.float32)]) if pad else X
         yp = np.concatenate([y, np.zeros(pad, np.float32)]) if pad else y
         wp = np.concatenate([w_row, np.zeros(pad, np.float32)]) if pad else w_row
-        nb = len(Xp) // bs
-        Xb = jnp.asarray(Xp.reshape(nb, bs, D))
+        nb = (n + pad) // bs
         yb = jnp.asarray(yp.reshape(nb, bs))
         wb = jnp.asarray(wp.reshape(nb, bs))
+        if sparse:
+            # (n, K) padded non-zeros; padding rows/slots hit index 0 with
+            # value 0, which is a no-op for gather-multiply and scatter-add.
+            K = idx_all.shape[1]
+            ip = np.concatenate([idx_all, np.zeros((pad, K), np.int32)]) if pad else idx_all
+            vp = np.concatenate([val_all, np.zeros((pad, K), np.float32)]) if pad else val_all
+            Xb = (
+                jnp.asarray(ip.reshape(nb, bs, K)),
+                jnp.asarray(vp.reshape(nb, bs, K)),
+            )
+        else:
+            Xp = np.concatenate([X, np.zeros((pad, X.shape[1]), np.float32)]) if pad else X
+            Xb = jnp.asarray(Xp.reshape(nb, bs, -1))
 
         def grad_fn(wvec, xb, yb_, wgt, step):
-            margin = xb @ wvec
+            if sparse:
+                ib, vb = xb
+                margin = (wvec[ib] * vb).sum(axis=1)
+            else:
+                margin = xb @ wvec
             if loss == "logistic":
                 p = jax.nn.sigmoid(margin)
                 g_out = (p - yb_) * wgt
             else:  # squared
                 g_out = (margin - yb_) * wgt
-            g = xb.T @ g_out / jnp.maximum(wgt.sum(), 1e-9)
+            denom = jnp.maximum(wgt.sum(), 1e-9)
+            if sparse:
+                g = jnp.zeros_like(wvec).at[ib.reshape(-1)].add(
+                    (g_out[:, None] * vb).reshape(-1)
+                ) / denom
+            else:
+                g = xb.T @ g_out / denom
             lr = lr0 / jnp.power(step + 1.0, power_t)
             w_new = wvec - lr * (g + l2 * wvec)
             # L1 truncated-gradient (VW's --l1 behavior)
@@ -274,8 +329,12 @@ class _VWModelBase(Model, _VWParams):
         return self.getOrDefault("weights")
 
     def _margin(self, df):
-        X = np.stack([np.asarray(v, dtype=np.float32) for v in df[self.getFeaturesCol()]])
-        return X @ self.getWeights()
+        feats = list(df[self.getFeaturesCol()])
+        w = self.getWeights()
+        if feats and isinstance(feats[0], SparseVector):
+            return np.asarray([v.dot(w) for v in feats], dtype=np.float64)
+        X = np.stack([np.asarray(v, dtype=np.float32) for v in feats])
+        return X @ w
 
 
 @register_stage

@@ -48,6 +48,7 @@ class BinMapper:
     categorical_features: Sequence[int] = field(default_factory=list)
     min_data_in_bin: int = 3
     seed: int = 0
+    threads: int = 0  # native binner threads (0 = auto; reference numThreads)
 
     # fitted state
     upper_bounds: List[np.ndarray] = field(default_factory=list)
@@ -118,7 +119,7 @@ class BinMapper:
             skip.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
             uppers.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
             counts.ctypes.data_as(ctypes.POINTER(ctypes.c_int)),
-            ctypes.c_int(default_threads()),
+            ctypes.c_int(self.threads or default_threads()),
         )
         return [uppers[f, : counts[f]].copy() for f in range(F)]
 
@@ -218,7 +219,7 @@ class BinMapper:
             counts.ctypes.data_as(ctypes.POINTER(ctypes.c_int)),
             ctypes.c_int(self.max_bin), ctypes.c_int(self.missing_bin),
             out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
-            ctypes.c_int(default_threads()),
+            ctypes.c_int(self.threads or default_threads()),
         )
         return out
 

@@ -1,0 +1,1614 @@
+"""GENERATED smoke tests — do not edit by hand.
+
+One test per stage: bare construction through the generated wrapper,
+kwarg acceptance for every defaulted Param, setter/getter round trip
+(the reference codegen's PySparkWrapperTest output — SURVEY.md §2.2)."""
+
+# flake8: noqa
+import pytest
+
+import mmlspark_tpu.generated_api as gen
+
+_SAMPLES = {int: 3, float: 0.25, str: 'x', bool: True}
+
+def test_generated_BestModel():
+    stage = gen.BestModel()
+    assert type(stage).__mro__[1].__name__ == 'BestModel'
+    v = _SAMPLES[float]
+    stage.setBestScore(v)
+    assert stage.getBestScore() == v
+
+
+def test_generated_FindBestModel():
+    stage = gen.FindBestModel()
+    assert type(stage).__mro__[1].__name__ == 'FindBestModel'
+    v = _SAMPLES[str]
+    stage.setEvaluationMetric(v)
+    assert stage.getEvaluationMetric() == v
+    v = _SAMPLES[str]
+    stage.setLabelCol(v)
+    assert stage.getLabelCol() == v
+
+
+def test_generated_TuneHyperparameters():
+    stage = gen.TuneHyperparameters()
+    assert type(stage).__mro__[1].__name__ == 'TuneHyperparameters'
+    v = _SAMPLES[str]
+    stage.setEvaluationMetric(v)
+    assert stage.getEvaluationMetric() == v
+    v = _SAMPLES[str]
+    stage.setLabelCol(v)
+    assert stage.getLabelCol() == v
+    v = _SAMPLES[int]
+    stage.setNumFolds(v)
+    assert stage.getNumFolds() == v
+    v = _SAMPLES[int]
+    stage.setNumRuns(v)
+    assert stage.getNumRuns() == v
+    v = _SAMPLES[int]
+    stage.setParallelism(v)
+    assert stage.getParallelism() == v
+    v = _SAMPLES[bool]
+    stage.setRandomSearch(v)
+    assert stage.getRandomSearch() == v
+
+
+def test_generated_TuneHyperparametersModel():
+    stage = gen.TuneHyperparametersModel()
+    assert type(stage).__mro__[1].__name__ == 'TuneHyperparametersModel'
+    v = _SAMPLES[float]
+    stage.setBestMetric(v)
+    assert stage.getBestMetric() == v
+
+
+def test_generated_BingImageSearch():
+    stage = gen.BingImageSearch()
+    assert type(stage).__mro__[1].__name__ == 'BingImageSearch'
+    v = _SAMPLES[int]
+    stage.setConcurrency(v)
+    assert stage.getConcurrency() == v
+    v = _SAMPLES[float]
+    stage.setConcurrentTimeout(v)
+    assert stage.getConcurrentTimeout() == v
+    v = _SAMPLES[str]
+    stage.setErrorCol(v)
+    assert stage.getErrorCol() == v
+    v = _SAMPLES[str]
+    stage.setLocation(v)
+    assert stage.getLocation() == v
+    v = _SAMPLES[str]
+    stage.setOutputCol(v)
+    assert stage.getOutputCol() == v
+    v = _SAMPLES[str]
+    stage.setUrl(v)
+    assert stage.getUrl() == v
+
+
+def test_generated_DetectEntireSeries():
+    stage = gen.DetectEntireSeries()
+    assert type(stage).__mro__[1].__name__ == 'DetectEntireSeries'
+    v = _SAMPLES[int]
+    stage.setConcurrency(v)
+    assert stage.getConcurrency() == v
+    v = _SAMPLES[float]
+    stage.setConcurrentTimeout(v)
+    assert stage.getConcurrentTimeout() == v
+    v = _SAMPLES[str]
+    stage.setErrorCol(v)
+    assert stage.getErrorCol() == v
+    v = _SAMPLES[str]
+    stage.setLocation(v)
+    assert stage.getLocation() == v
+    v = _SAMPLES[str]
+    stage.setOutputCol(v)
+    assert stage.getOutputCol() == v
+    v = _SAMPLES[str]
+    stage.setUrl(v)
+    assert stage.getUrl() == v
+
+
+def test_generated_DetectLastAnomaly():
+    stage = gen.DetectLastAnomaly()
+    assert type(stage).__mro__[1].__name__ == 'DetectLastAnomaly'
+    v = _SAMPLES[int]
+    stage.setConcurrency(v)
+    assert stage.getConcurrency() == v
+    v = _SAMPLES[float]
+    stage.setConcurrentTimeout(v)
+    assert stage.getConcurrentTimeout() == v
+    v = _SAMPLES[str]
+    stage.setErrorCol(v)
+    assert stage.getErrorCol() == v
+    v = _SAMPLES[str]
+    stage.setLocation(v)
+    assert stage.getLocation() == v
+    v = _SAMPLES[str]
+    stage.setOutputCol(v)
+    assert stage.getOutputCol() == v
+    v = _SAMPLES[str]
+    stage.setUrl(v)
+    assert stage.getUrl() == v
+
+
+def test_generated_EntityDetector():
+    stage = gen.EntityDetector()
+    assert type(stage).__mro__[1].__name__ == 'EntityDetector'
+    v = _SAMPLES[int]
+    stage.setConcurrency(v)
+    assert stage.getConcurrency() == v
+    v = _SAMPLES[float]
+    stage.setConcurrentTimeout(v)
+    assert stage.getConcurrentTimeout() == v
+    v = _SAMPLES[str]
+    stage.setErrorCol(v)
+    assert stage.getErrorCol() == v
+    v = _SAMPLES[str]
+    stage.setLocation(v)
+    assert stage.getLocation() == v
+    v = _SAMPLES[str]
+    stage.setOutputCol(v)
+    assert stage.getOutputCol() == v
+    v = _SAMPLES[str]
+    stage.setUrl(v)
+    assert stage.getUrl() == v
+
+
+def test_generated_KeyPhraseExtractor():
+    stage = gen.KeyPhraseExtractor()
+    assert type(stage).__mro__[1].__name__ == 'KeyPhraseExtractor'
+    v = _SAMPLES[int]
+    stage.setConcurrency(v)
+    assert stage.getConcurrency() == v
+    v = _SAMPLES[float]
+    stage.setConcurrentTimeout(v)
+    assert stage.getConcurrentTimeout() == v
+    v = _SAMPLES[str]
+    stage.setErrorCol(v)
+    assert stage.getErrorCol() == v
+    v = _SAMPLES[str]
+    stage.setLocation(v)
+    assert stage.getLocation() == v
+    v = _SAMPLES[str]
+    stage.setOutputCol(v)
+    assert stage.getOutputCol() == v
+    v = _SAMPLES[str]
+    stage.setUrl(v)
+    assert stage.getUrl() == v
+
+
+def test_generated_LanguageDetector():
+    stage = gen.LanguageDetector()
+    assert type(stage).__mro__[1].__name__ == 'LanguageDetector'
+    v = _SAMPLES[int]
+    stage.setConcurrency(v)
+    assert stage.getConcurrency() == v
+    v = _SAMPLES[float]
+    stage.setConcurrentTimeout(v)
+    assert stage.getConcurrentTimeout() == v
+    v = _SAMPLES[str]
+    stage.setErrorCol(v)
+    assert stage.getErrorCol() == v
+    v = _SAMPLES[str]
+    stage.setLocation(v)
+    assert stage.getLocation() == v
+    v = _SAMPLES[str]
+    stage.setOutputCol(v)
+    assert stage.getOutputCol() == v
+    v = _SAMPLES[str]
+    stage.setUrl(v)
+    assert stage.getUrl() == v
+
+
+def test_generated_NER():
+    stage = gen.NER()
+    assert type(stage).__mro__[1].__name__ == 'NER'
+    v = _SAMPLES[int]
+    stage.setConcurrency(v)
+    assert stage.getConcurrency() == v
+    v = _SAMPLES[float]
+    stage.setConcurrentTimeout(v)
+    assert stage.getConcurrentTimeout() == v
+    v = _SAMPLES[str]
+    stage.setErrorCol(v)
+    assert stage.getErrorCol() == v
+    v = _SAMPLES[str]
+    stage.setLocation(v)
+    assert stage.getLocation() == v
+    v = _SAMPLES[str]
+    stage.setOutputCol(v)
+    assert stage.getOutputCol() == v
+    v = _SAMPLES[str]
+    stage.setUrl(v)
+    assert stage.getUrl() == v
+
+
+def test_generated_TextSentiment():
+    stage = gen.TextSentiment()
+    assert type(stage).__mro__[1].__name__ == 'TextSentiment'
+    v = _SAMPLES[int]
+    stage.setConcurrency(v)
+    assert stage.getConcurrency() == v
+    v = _SAMPLES[float]
+    stage.setConcurrentTimeout(v)
+    assert stage.getConcurrentTimeout() == v
+    v = _SAMPLES[str]
+    stage.setErrorCol(v)
+    assert stage.getErrorCol() == v
+    v = _SAMPLES[str]
+    stage.setLocation(v)
+    assert stage.getLocation() == v
+    v = _SAMPLES[str]
+    stage.setOutputCol(v)
+    assert stage.getOutputCol() == v
+    v = _SAMPLES[str]
+    stage.setUrl(v)
+    assert stage.getUrl() == v
+
+
+def test_generated_Translate():
+    stage = gen.Translate()
+    assert type(stage).__mro__[1].__name__ == 'Translate'
+    v = _SAMPLES[int]
+    stage.setConcurrency(v)
+    assert stage.getConcurrency() == v
+    v = _SAMPLES[float]
+    stage.setConcurrentTimeout(v)
+    assert stage.getConcurrentTimeout() == v
+    v = _SAMPLES[str]
+    stage.setErrorCol(v)
+    assert stage.getErrorCol() == v
+    v = _SAMPLES[str]
+    stage.setLocation(v)
+    assert stage.getLocation() == v
+    v = _SAMPLES[str]
+    stage.setOutputCol(v)
+    assert stage.getOutputCol() == v
+    v = _SAMPLES[str]
+    stage.setUrl(v)
+    assert stage.getUrl() == v
+
+
+def test_generated_AnalyzeImage():
+    stage = gen.AnalyzeImage()
+    assert type(stage).__mro__[1].__name__ == 'AnalyzeImage'
+    v = _SAMPLES[int]
+    stage.setConcurrency(v)
+    assert stage.getConcurrency() == v
+    v = _SAMPLES[float]
+    stage.setConcurrentTimeout(v)
+    assert stage.getConcurrentTimeout() == v
+    v = _SAMPLES[str]
+    stage.setErrorCol(v)
+    assert stage.getErrorCol() == v
+    v = _SAMPLES[str]
+    stage.setLocation(v)
+    assert stage.getLocation() == v
+    v = _SAMPLES[str]
+    stage.setOutputCol(v)
+    assert stage.getOutputCol() == v
+    v = _SAMPLES[str]
+    stage.setUrl(v)
+    assert stage.getUrl() == v
+
+
+def test_generated_DescribeImage():
+    stage = gen.DescribeImage()
+    assert type(stage).__mro__[1].__name__ == 'DescribeImage'
+    v = _SAMPLES[int]
+    stage.setConcurrency(v)
+    assert stage.getConcurrency() == v
+    v = _SAMPLES[float]
+    stage.setConcurrentTimeout(v)
+    assert stage.getConcurrentTimeout() == v
+    v = _SAMPLES[str]
+    stage.setErrorCol(v)
+    assert stage.getErrorCol() == v
+    v = _SAMPLES[str]
+    stage.setLocation(v)
+    assert stage.getLocation() == v
+    v = _SAMPLES[str]
+    stage.setOutputCol(v)
+    assert stage.getOutputCol() == v
+    v = _SAMPLES[str]
+    stage.setUrl(v)
+    assert stage.getUrl() == v
+
+
+def test_generated_DetectFace():
+    stage = gen.DetectFace()
+    assert type(stage).__mro__[1].__name__ == 'DetectFace'
+    v = _SAMPLES[int]
+    stage.setConcurrency(v)
+    assert stage.getConcurrency() == v
+    v = _SAMPLES[float]
+    stage.setConcurrentTimeout(v)
+    assert stage.getConcurrentTimeout() == v
+    v = _SAMPLES[str]
+    stage.setErrorCol(v)
+    assert stage.getErrorCol() == v
+    v = _SAMPLES[str]
+    stage.setLocation(v)
+    assert stage.getLocation() == v
+    v = _SAMPLES[str]
+    stage.setOutputCol(v)
+    assert stage.getOutputCol() == v
+    v = _SAMPLES[str]
+    stage.setUrl(v)
+    assert stage.getUrl() == v
+
+
+def test_generated_OCR():
+    stage = gen.OCR()
+    assert type(stage).__mro__[1].__name__ == 'OCR'
+    v = _SAMPLES[int]
+    stage.setConcurrency(v)
+    assert stage.getConcurrency() == v
+    v = _SAMPLES[float]
+    stage.setConcurrentTimeout(v)
+    assert stage.getConcurrentTimeout() == v
+    v = _SAMPLES[str]
+    stage.setErrorCol(v)
+    assert stage.getErrorCol() == v
+    v = _SAMPLES[str]
+    stage.setLocation(v)
+    assert stage.getLocation() == v
+    v = _SAMPLES[str]
+    stage.setOutputCol(v)
+    assert stage.getOutputCol() == v
+    v = _SAMPLES[str]
+    stage.setUrl(v)
+    assert stage.getUrl() == v
+
+
+def test_generated_TagImage():
+    stage = gen.TagImage()
+    assert type(stage).__mro__[1].__name__ == 'TagImage'
+    v = _SAMPLES[int]
+    stage.setConcurrency(v)
+    assert stage.getConcurrency() == v
+    v = _SAMPLES[float]
+    stage.setConcurrentTimeout(v)
+    assert stage.getConcurrentTimeout() == v
+    v = _SAMPLES[str]
+    stage.setErrorCol(v)
+    assert stage.getErrorCol() == v
+    v = _SAMPLES[str]
+    stage.setLocation(v)
+    assert stage.getLocation() == v
+    v = _SAMPLES[str]
+    stage.setOutputCol(v)
+    assert stage.getOutputCol() == v
+    v = _SAMPLES[str]
+    stage.setUrl(v)
+    assert stage.getUrl() == v
+
+
+def test_generated_Pipeline():
+    stage = gen.Pipeline()
+    assert type(stage).__mro__[1].__name__ == 'Pipeline'
+
+
+def test_generated_PipelineModel():
+    stage = gen.PipelineModel()
+    assert type(stage).__mro__[1].__name__ == 'PipelineModel'
+
+
+def test_generated_ImageLIME():
+    stage = gen.ImageLIME()
+    assert type(stage).__mro__[1].__name__ == 'ImageLIME'
+    v = _SAMPLES[int]
+    stage.setCellSize(v)
+    assert stage.getCellSize() == v
+    v = _SAMPLES[str]
+    stage.setInputCol(v)
+    assert stage.getInputCol() == v
+    v = _SAMPLES[float]
+    stage.setKernelWidth(v)
+    assert stage.getKernelWidth() == v
+    v = _SAMPLES[float]
+    stage.setModifier(v)
+    assert stage.getModifier() == v
+    v = _SAMPLES[int]
+    stage.setNSamples(v)
+    assert stage.getNSamples() == v
+    v = _SAMPLES[str]
+    stage.setOutputCol(v)
+    assert stage.getOutputCol() == v
+
+
+def test_generated_TabularLIME():
+    stage = gen.TabularLIME()
+    assert type(stage).__mro__[1].__name__ == 'TabularLIME'
+    v = _SAMPLES[str]
+    stage.setInputCol(v)
+    assert stage.getInputCol() == v
+    v = _SAMPLES[float]
+    stage.setKernelWidth(v)
+    assert stage.getKernelWidth() == v
+    v = _SAMPLES[int]
+    stage.setNSamples(v)
+    assert stage.getNSamples() == v
+    v = _SAMPLES[str]
+    stage.setOutputCol(v)
+    assert stage.getOutputCol() == v
+    v = _SAMPLES[str]
+    stage.setPredictionCol(v)
+    assert stage.getPredictionCol() == v
+    v = _SAMPLES[float]
+    stage.setRegularization(v)
+    assert stage.getRegularization() == v
+
+
+def test_generated_TabularLIMEModel():
+    stage = gen.TabularLIMEModel()
+    assert type(stage).__mro__[1].__name__ == 'TabularLIMEModel'
+    v = _SAMPLES[str]
+    stage.setInputCol(v)
+    assert stage.getInputCol() == v
+    v = _SAMPLES[float]
+    stage.setKernelWidth(v)
+    assert stage.getKernelWidth() == v
+    v = _SAMPLES[int]
+    stage.setNSamples(v)
+    assert stage.getNSamples() == v
+    v = _SAMPLES[str]
+    stage.setOutputCol(v)
+    assert stage.getOutputCol() == v
+    v = _SAMPLES[str]
+    stage.setPredictionCol(v)
+    assert stage.getPredictionCol() == v
+    v = _SAMPLES[float]
+    stage.setRegularization(v)
+    assert stage.getRegularization() == v
+
+
+def test_generated_SuperpixelTransformer():
+    stage = gen.SuperpixelTransformer()
+    assert type(stage).__mro__[1].__name__ == 'SuperpixelTransformer'
+    v = _SAMPLES[int]
+    stage.setCellSize(v)
+    assert stage.getCellSize() == v
+    v = _SAMPLES[str]
+    stage.setInputCol(v)
+    assert stage.getInputCol() == v
+    v = _SAMPLES[float]
+    stage.setModifier(v)
+    assert stage.getModifier() == v
+    v = _SAMPLES[str]
+    stage.setOutputCol(v)
+    assert stage.getOutputCol() == v
+
+
+def test_generated_CleanMissingData():
+    stage = gen.CleanMissingData()
+    assert type(stage).__mro__[1].__name__ == 'CleanMissingData'
+
+
+def test_generated_CleanMissingDataModel():
+    stage = gen.CleanMissingDataModel()
+    assert type(stage).__mro__[1].__name__ == 'CleanMissingDataModel'
+
+
+def test_generated_DataConversion():
+    stage = gen.DataConversion()
+    assert type(stage).__mro__[1].__name__ == 'DataConversion'
+    v = _SAMPLES[str]
+    stage.setDateTimeFormat(v)
+    assert stage.getDateTimeFormat() == v
+
+
+def test_generated_Featurize():
+    stage = gen.Featurize()
+    assert type(stage).__mro__[1].__name__ == 'Featurize'
+    v = _SAMPLES[bool]
+    stage.setImputeMissing(v)
+    assert stage.getImputeMissing() == v
+    v = _SAMPLES[int]
+    stage.setNumFeatures(v)
+    assert stage.getNumFeatures() == v
+    v = _SAMPLES[bool]
+    stage.setOneHotEncodeCategoricals(v)
+    assert stage.getOneHotEncodeCategoricals() == v
+    v = _SAMPLES[str]
+    stage.setOutputCol(v)
+    assert stage.getOutputCol() == v
+
+
+def test_generated_FeaturizeModel():
+    stage = gen.FeaturizeModel()
+    assert type(stage).__mro__[1].__name__ == 'FeaturizeModel'
+    v = _SAMPLES[bool]
+    stage.setImputeMissing(v)
+    assert stage.getImputeMissing() == v
+    v = _SAMPLES[int]
+    stage.setNumFeatures(v)
+    assert stage.getNumFeatures() == v
+    v = _SAMPLES[bool]
+    stage.setOneHotEncodeCategoricals(v)
+    assert stage.getOneHotEncodeCategoricals() == v
+    v = _SAMPLES[str]
+    stage.setOutputCol(v)
+    assert stage.getOutputCol() == v
+
+
+def test_generated_IndexToValue():
+    stage = gen.IndexToValue()
+    assert type(stage).__mro__[1].__name__ == 'IndexToValue'
+    v = _SAMPLES[str]
+    stage.setInputCol(v)
+    assert stage.getInputCol() == v
+    v = _SAMPLES[str]
+    stage.setOutputCol(v)
+    assert stage.getOutputCol() == v
+
+
+def test_generated_ValueIndexer():
+    stage = gen.ValueIndexer()
+    assert type(stage).__mro__[1].__name__ == 'ValueIndexer'
+    v = _SAMPLES[str]
+    stage.setInputCol(v)
+    assert stage.getInputCol() == v
+    v = _SAMPLES[str]
+    stage.setOutputCol(v)
+    assert stage.getOutputCol() == v
+
+
+def test_generated_ValueIndexerModel():
+    stage = gen.ValueIndexerModel()
+    assert type(stage).__mro__[1].__name__ == 'ValueIndexerModel'
+    v = _SAMPLES[str]
+    stage.setInputCol(v)
+    assert stage.getInputCol() == v
+    v = _SAMPLES[str]
+    stage.setOutputCol(v)
+    assert stage.getOutputCol() == v
+
+
+def test_generated_TextFeaturizer():
+    stage = gen.TextFeaturizer()
+    assert type(stage).__mro__[1].__name__ == 'TextFeaturizer'
+    v = _SAMPLES[bool]
+    stage.setBinary(v)
+    assert stage.getBinary() == v
+    v = _SAMPLES[str]
+    stage.setInputCol(v)
+    assert stage.getInputCol() == v
+    v = _SAMPLES[int]
+    stage.setMinDocFreq(v)
+    assert stage.getMinDocFreq() == v
+    v = _SAMPLES[int]
+    stage.setNGramLength(v)
+    assert stage.getNGramLength() == v
+    v = _SAMPLES[int]
+    stage.setNumFeatures(v)
+    assert stage.getNumFeatures() == v
+    v = _SAMPLES[str]
+    stage.setOutputCol(v)
+    assert stage.getOutputCol() == v
+
+
+def test_generated_TextFeaturizerModel():
+    stage = gen.TextFeaturizerModel()
+    assert type(stage).__mro__[1].__name__ == 'TextFeaturizerModel'
+    v = _SAMPLES[bool]
+    stage.setBinary(v)
+    assert stage.getBinary() == v
+    v = _SAMPLES[str]
+    stage.setInputCol(v)
+    assert stage.getInputCol() == v
+    v = _SAMPLES[int]
+    stage.setMinDocFreq(v)
+    assert stage.getMinDocFreq() == v
+    v = _SAMPLES[int]
+    stage.setNGramLength(v)
+    assert stage.getNGramLength() == v
+    v = _SAMPLES[int]
+    stage.setNumFeatures(v)
+    assert stage.getNumFeatures() == v
+    v = _SAMPLES[str]
+    stage.setOutputCol(v)
+    assert stage.getOutputCol() == v
+
+
+def test_generated_HTTPTransformer():
+    stage = gen.HTTPTransformer()
+    assert type(stage).__mro__[1].__name__ == 'HTTPTransformer'
+    v = _SAMPLES[int]
+    stage.setConcurrency(v)
+    assert stage.getConcurrency() == v
+    v = _SAMPLES[float]
+    stage.setConcurrentTimeout(v)
+    assert stage.getConcurrentTimeout() == v
+    v = _SAMPLES[str]
+    stage.setInputCol(v)
+    assert stage.getInputCol() == v
+    v = _SAMPLES[str]
+    stage.setOutputCol(v)
+    assert stage.getOutputCol() == v
+
+
+def test_generated_JSONInputParser():
+    stage = gen.JSONInputParser()
+    assert type(stage).__mro__[1].__name__ == 'JSONInputParser'
+    v = _SAMPLES[str]
+    stage.setInputCol(v)
+    assert stage.getInputCol() == v
+    v = _SAMPLES[str]
+    stage.setMethod(v)
+    assert stage.getMethod() == v
+    v = _SAMPLES[str]
+    stage.setOutputCol(v)
+    assert stage.getOutputCol() == v
+    v = _SAMPLES[str]
+    stage.setUrl(v)
+    assert stage.getUrl() == v
+
+
+def test_generated_JSONOutputParser():
+    stage = gen.JSONOutputParser()
+    assert type(stage).__mro__[1].__name__ == 'JSONOutputParser'
+    v = _SAMPLES[str]
+    stage.setInputCol(v)
+    assert stage.getInputCol() == v
+    v = _SAMPLES[str]
+    stage.setOutputCol(v)
+    assert stage.getOutputCol() == v
+
+
+def test_generated_SimpleHTTPTransformer():
+    stage = gen.SimpleHTTPTransformer()
+    assert type(stage).__mro__[1].__name__ == 'SimpleHTTPTransformer'
+    v = _SAMPLES[int]
+    stage.setConcurrency(v)
+    assert stage.getConcurrency() == v
+    v = _SAMPLES[float]
+    stage.setConcurrentTimeout(v)
+    assert stage.getConcurrentTimeout() == v
+    v = _SAMPLES[str]
+    stage.setErrorCol(v)
+    assert stage.getErrorCol() == v
+    v = _SAMPLES[bool]
+    stage.setFlattenOutputBatches(v)
+    assert stage.getFlattenOutputBatches() == v
+    v = _SAMPLES[str]
+    stage.setInputCol(v)
+    assert stage.getInputCol() == v
+    v = _SAMPLES[str]
+    stage.setMethod(v)
+    assert stage.getMethod() == v
+
+
+def test_generated_CNTKModel():
+    stage = gen.CNTKModel()
+    assert type(stage).__mro__[1].__name__ == 'CNTKModel'
+    v = _SAMPLES[bool]
+    stage.setBatchInput(v)
+    assert stage.getBatchInput() == v
+    v = _SAMPLES[str]
+    stage.setInputCol(v)
+    assert stage.getInputCol() == v
+    v = _SAMPLES[int]
+    stage.setMiniBatchSize(v)
+    assert stage.getMiniBatchSize() == v
+    v = _SAMPLES[str]
+    stage.setOutputCol(v)
+    assert stage.getOutputCol() == v
+
+
+def test_generated_ImageFeaturizer():
+    stage = gen.ImageFeaturizer()
+    assert type(stage).__mro__[1].__name__ == 'ImageFeaturizer'
+    v = _SAMPLES[bool]
+    stage.setCenterCropAfterResize(v)
+    assert stage.getCenterCropAfterResize() == v
+    v = _SAMPLES[float]
+    stage.setColorScaleFactor(v)
+    assert stage.getColorScaleFactor() == v
+    v = _SAMPLES[int]
+    stage.setCutOutputLayers(v)
+    assert stage.getCutOutputLayers() == v
+    v = _SAMPLES[int]
+    stage.setImageHeight(v)
+    assert stage.getImageHeight() == v
+    v = _SAMPLES[int]
+    stage.setImageWidth(v)
+    assert stage.getImageWidth() == v
+    v = _SAMPLES[str]
+    stage.setInputCol(v)
+    assert stage.getInputCol() == v
+
+
+def test_generated_IsolationForest():
+    stage = gen.IsolationForest()
+    assert type(stage).__mro__[1].__name__ == 'IsolationForest'
+    v = _SAMPLES[float]
+    stage.setContamination(v)
+    assert stage.getContamination() == v
+    v = _SAMPLES[str]
+    stage.setFeaturesCol(v)
+    assert stage.getFeaturesCol() == v
+    v = _SAMPLES[float]
+    stage.setMaxFeatures(v)
+    assert stage.getMaxFeatures() == v
+    v = _SAMPLES[int]
+    stage.setMaxSamples(v)
+    assert stage.getMaxSamples() == v
+    v = _SAMPLES[int]
+    stage.setNumEstimators(v)
+    assert stage.getNumEstimators() == v
+    v = _SAMPLES[str]
+    stage.setPredictionCol(v)
+    assert stage.getPredictionCol() == v
+
+
+def test_generated_IsolationForestModel():
+    stage = gen.IsolationForestModel()
+    assert type(stage).__mro__[1].__name__ == 'IsolationForestModel'
+    v = _SAMPLES[float]
+    stage.setContamination(v)
+    assert stage.getContamination() == v
+    v = _SAMPLES[str]
+    stage.setFeaturesCol(v)
+    assert stage.getFeaturesCol() == v
+    v = _SAMPLES[float]
+    stage.setMaxFeatures(v)
+    assert stage.getMaxFeatures() == v
+    v = _SAMPLES[int]
+    stage.setMaxSamples(v)
+    assert stage.getMaxSamples() == v
+    v = _SAMPLES[int]
+    stage.setNumEstimators(v)
+    assert stage.getNumEstimators() == v
+    v = _SAMPLES[str]
+    stage.setPredictionCol(v)
+    assert stage.getPredictionCol() == v
+
+
+def test_generated_ConditionalKNN():
+    stage = gen.ConditionalKNN()
+    assert type(stage).__mro__[1].__name__ == 'ConditionalKNN'
+    v = _SAMPLES[str]
+    stage.setConditionerCol(v)
+    assert stage.getConditionerCol() == v
+    v = _SAMPLES[str]
+    stage.setFeaturesCol(v)
+    assert stage.getFeaturesCol() == v
+    v = _SAMPLES[int]
+    stage.setK(v)
+    assert stage.getK() == v
+    v = _SAMPLES[str]
+    stage.setLabelCol(v)
+    assert stage.getLabelCol() == v
+    v = _SAMPLES[int]
+    stage.setLeafSize(v)
+    assert stage.getLeafSize() == v
+    v = _SAMPLES[str]
+    stage.setOutputCol(v)
+    assert stage.getOutputCol() == v
+
+
+def test_generated_ConditionalKNNModel():
+    stage = gen.ConditionalKNNModel()
+    assert type(stage).__mro__[1].__name__ == 'ConditionalKNNModel'
+    v = _SAMPLES[str]
+    stage.setConditionerCol(v)
+    assert stage.getConditionerCol() == v
+    v = _SAMPLES[str]
+    stage.setFeaturesCol(v)
+    assert stage.getFeaturesCol() == v
+    v = _SAMPLES[int]
+    stage.setK(v)
+    assert stage.getK() == v
+    v = _SAMPLES[str]
+    stage.setLabelCol(v)
+    assert stage.getLabelCol() == v
+    v = _SAMPLES[int]
+    stage.setLeafSize(v)
+    assert stage.getLeafSize() == v
+    v = _SAMPLES[str]
+    stage.setOutputCol(v)
+    assert stage.getOutputCol() == v
+
+
+def test_generated_KNN():
+    stage = gen.KNN()
+    assert type(stage).__mro__[1].__name__ == 'KNN'
+    v = _SAMPLES[str]
+    stage.setFeaturesCol(v)
+    assert stage.getFeaturesCol() == v
+    v = _SAMPLES[int]
+    stage.setK(v)
+    assert stage.getK() == v
+    v = _SAMPLES[int]
+    stage.setLeafSize(v)
+    assert stage.getLeafSize() == v
+    v = _SAMPLES[str]
+    stage.setOutputCol(v)
+    assert stage.getOutputCol() == v
+    v = _SAMPLES[str]
+    stage.setValuesCol(v)
+    assert stage.getValuesCol() == v
+
+
+def test_generated_KNNModel():
+    stage = gen.KNNModel()
+    assert type(stage).__mro__[1].__name__ == 'KNNModel'
+    v = _SAMPLES[str]
+    stage.setFeaturesCol(v)
+    assert stage.getFeaturesCol() == v
+    v = _SAMPLES[int]
+    stage.setK(v)
+    assert stage.getK() == v
+    v = _SAMPLES[int]
+    stage.setLeafSize(v)
+    assert stage.getLeafSize() == v
+    v = _SAMPLES[str]
+    stage.setOutputCol(v)
+    assert stage.getOutputCol() == v
+    v = _SAMPLES[str]
+    stage.setValuesCol(v)
+    assert stage.getValuesCol() == v
+
+
+def test_generated_LightGBMClassificationModel():
+    stage = gen.LightGBMClassificationModel()
+    assert type(stage).__mro__[1].__name__ == 'LightGBMClassificationModel'
+    v = _SAMPLES[float]
+    stage.setBaggingFraction(v)
+    assert stage.getBaggingFraction() == v
+    v = _SAMPLES[int]
+    stage.setBaggingFreq(v)
+    assert stage.getBaggingFreq() == v
+    v = _SAMPLES[int]
+    stage.setBaggingSeed(v)
+    assert stage.getBaggingSeed() == v
+    v = _SAMPLES[bool]
+    stage.setBoostFromAverage(v)
+    assert stage.getBoostFromAverage() == v
+    v = _SAMPLES[int]
+    stage.setDefaultListenPort(v)
+    assert stage.getDefaultListenPort() == v
+    v = _SAMPLES[str]
+    stage.setDeviceType(v)
+    assert stage.getDeviceType() == v
+
+
+def test_generated_LightGBMClassifier():
+    stage = gen.LightGBMClassifier()
+    assert type(stage).__mro__[1].__name__ == 'LightGBMClassifier'
+    v = _SAMPLES[float]
+    stage.setBaggingFraction(v)
+    assert stage.getBaggingFraction() == v
+    v = _SAMPLES[int]
+    stage.setBaggingFreq(v)
+    assert stage.getBaggingFreq() == v
+    v = _SAMPLES[int]
+    stage.setBaggingSeed(v)
+    assert stage.getBaggingSeed() == v
+    v = _SAMPLES[bool]
+    stage.setBoostFromAverage(v)
+    assert stage.getBoostFromAverage() == v
+    v = _SAMPLES[int]
+    stage.setDefaultListenPort(v)
+    assert stage.getDefaultListenPort() == v
+    v = _SAMPLES[str]
+    stage.setDeviceType(v)
+    assert stage.getDeviceType() == v
+
+
+def test_generated_LightGBMRanker():
+    stage = gen.LightGBMRanker()
+    assert type(stage).__mro__[1].__name__ == 'LightGBMRanker'
+    v = _SAMPLES[float]
+    stage.setBaggingFraction(v)
+    assert stage.getBaggingFraction() == v
+    v = _SAMPLES[int]
+    stage.setBaggingFreq(v)
+    assert stage.getBaggingFreq() == v
+    v = _SAMPLES[int]
+    stage.setBaggingSeed(v)
+    assert stage.getBaggingSeed() == v
+    v = _SAMPLES[bool]
+    stage.setBoostFromAverage(v)
+    assert stage.getBoostFromAverage() == v
+    v = _SAMPLES[int]
+    stage.setDefaultListenPort(v)
+    assert stage.getDefaultListenPort() == v
+    v = _SAMPLES[str]
+    stage.setDeviceType(v)
+    assert stage.getDeviceType() == v
+
+
+def test_generated_LightGBMRankerModel():
+    stage = gen.LightGBMRankerModel()
+    assert type(stage).__mro__[1].__name__ == 'LightGBMRankerModel'
+    v = _SAMPLES[float]
+    stage.setBaggingFraction(v)
+    assert stage.getBaggingFraction() == v
+    v = _SAMPLES[int]
+    stage.setBaggingFreq(v)
+    assert stage.getBaggingFreq() == v
+    v = _SAMPLES[int]
+    stage.setBaggingSeed(v)
+    assert stage.getBaggingSeed() == v
+    v = _SAMPLES[bool]
+    stage.setBoostFromAverage(v)
+    assert stage.getBoostFromAverage() == v
+    v = _SAMPLES[int]
+    stage.setDefaultListenPort(v)
+    assert stage.getDefaultListenPort() == v
+    v = _SAMPLES[str]
+    stage.setDeviceType(v)
+    assert stage.getDeviceType() == v
+
+
+def test_generated_LightGBMRegressionModel():
+    stage = gen.LightGBMRegressionModel()
+    assert type(stage).__mro__[1].__name__ == 'LightGBMRegressionModel'
+    v = _SAMPLES[float]
+    stage.setBaggingFraction(v)
+    assert stage.getBaggingFraction() == v
+    v = _SAMPLES[int]
+    stage.setBaggingFreq(v)
+    assert stage.getBaggingFreq() == v
+    v = _SAMPLES[int]
+    stage.setBaggingSeed(v)
+    assert stage.getBaggingSeed() == v
+    v = _SAMPLES[bool]
+    stage.setBoostFromAverage(v)
+    assert stage.getBoostFromAverage() == v
+    v = _SAMPLES[int]
+    stage.setDefaultListenPort(v)
+    assert stage.getDefaultListenPort() == v
+    v = _SAMPLES[str]
+    stage.setDeviceType(v)
+    assert stage.getDeviceType() == v
+
+
+def test_generated_LightGBMRegressor():
+    stage = gen.LightGBMRegressor()
+    assert type(stage).__mro__[1].__name__ == 'LightGBMRegressor'
+    v = _SAMPLES[float]
+    stage.setAlpha(v)
+    assert stage.getAlpha() == v
+    v = _SAMPLES[float]
+    stage.setBaggingFraction(v)
+    assert stage.getBaggingFraction() == v
+    v = _SAMPLES[int]
+    stage.setBaggingFreq(v)
+    assert stage.getBaggingFreq() == v
+    v = _SAMPLES[int]
+    stage.setBaggingSeed(v)
+    assert stage.getBaggingSeed() == v
+    v = _SAMPLES[bool]
+    stage.setBoostFromAverage(v)
+    assert stage.getBoostFromAverage() == v
+    v = _SAMPLES[int]
+    stage.setDefaultListenPort(v)
+    assert stage.getDefaultListenPort() == v
+
+
+def test_generated_ONNXModel():
+    stage = gen.ONNXModel()
+    assert type(stage).__mro__[1].__name__ == 'ONNXModel'
+    v = _SAMPLES[str]
+    stage.setDeviceType(v)
+    assert stage.getDeviceType() == v
+    v = _SAMPLES[int]
+    stage.setMiniBatchSize(v)
+    assert stage.getMiniBatchSize() == v
+
+
+def test_generated_RankingAdapter():
+    stage = gen.RankingAdapter()
+    assert type(stage).__mro__[1].__name__ == 'RankingAdapter'
+    v = _SAMPLES[int]
+    stage.setK(v)
+    assert stage.getK() == v
+    v = _SAMPLES[str]
+    stage.setLabelCol(v)
+    assert stage.getLabelCol() == v
+
+
+def test_generated_RankingAdapterModel():
+    stage = gen.RankingAdapterModel()
+    assert type(stage).__mro__[1].__name__ == 'RankingAdapterModel'
+    v = _SAMPLES[int]
+    stage.setK(v)
+    assert stage.getK() == v
+    v = _SAMPLES[str]
+    stage.setLabelCol(v)
+    assert stage.getLabelCol() == v
+
+
+def test_generated_RankingEvaluator():
+    stage = gen.RankingEvaluator()
+    assert type(stage).__mro__[1].__name__ == 'RankingEvaluator'
+    v = _SAMPLES[int]
+    stage.setK(v)
+    assert stage.getK() == v
+    v = _SAMPLES[str]
+    stage.setLabelCol(v)
+    assert stage.getLabelCol() == v
+    v = _SAMPLES[str]
+    stage.setPredictionCol(v)
+    assert stage.getPredictionCol() == v
+
+
+def test_generated_RankingTrainValidationSplit():
+    stage = gen.RankingTrainValidationSplit()
+    assert type(stage).__mro__[1].__name__ == 'RankingTrainValidationSplit'
+    v = _SAMPLES[str]
+    stage.setItemCol(v)
+    assert stage.getItemCol() == v
+    v = _SAMPLES[int]
+    stage.setK(v)
+    assert stage.getK() == v
+    v = _SAMPLES[int]
+    stage.setSeed(v)
+    assert stage.getSeed() == v
+    v = _SAMPLES[float]
+    stage.setTrainRatio(v)
+    assert stage.getTrainRatio() == v
+    v = _SAMPLES[str]
+    stage.setUserCol(v)
+    assert stage.getUserCol() == v
+
+
+def test_generated_RankingTrainValidationSplitModel():
+    stage = gen.RankingTrainValidationSplitModel()
+    assert type(stage).__mro__[1].__name__ == 'RankingTrainValidationSplitModel'
+    v = _SAMPLES[float]
+    stage.setValidationMetric(v)
+    assert stage.getValidationMetric() == v
+
+
+def test_generated_RecommendationIndexer():
+    stage = gen.RecommendationIndexer()
+    assert type(stage).__mro__[1].__name__ == 'RecommendationIndexer'
+    v = _SAMPLES[str]
+    stage.setItemInputCol(v)
+    assert stage.getItemInputCol() == v
+    v = _SAMPLES[str]
+    stage.setItemOutputCol(v)
+    assert stage.getItemOutputCol() == v
+    v = _SAMPLES[str]
+    stage.setRatingCol(v)
+    assert stage.getRatingCol() == v
+    v = _SAMPLES[str]
+    stage.setUserInputCol(v)
+    assert stage.getUserInputCol() == v
+    v = _SAMPLES[str]
+    stage.setUserOutputCol(v)
+    assert stage.getUserOutputCol() == v
+
+
+def test_generated_RecommendationIndexerModel():
+    stage = gen.RecommendationIndexerModel()
+    assert type(stage).__mro__[1].__name__ == 'RecommendationIndexerModel'
+    v = _SAMPLES[str]
+    stage.setItemInputCol(v)
+    assert stage.getItemInputCol() == v
+    v = _SAMPLES[str]
+    stage.setItemOutputCol(v)
+    assert stage.getItemOutputCol() == v
+    v = _SAMPLES[str]
+    stage.setUserInputCol(v)
+    assert stage.getUserInputCol() == v
+    v = _SAMPLES[str]
+    stage.setUserOutputCol(v)
+    assert stage.getUserOutputCol() == v
+
+
+def test_generated_SAR():
+    stage = gen.SAR()
+    assert type(stage).__mro__[1].__name__ == 'SAR'
+    v = _SAMPLES[str]
+    stage.setActivityTimeFormat(v)
+    assert stage.getActivityTimeFormat() == v
+    v = _SAMPLES[str]
+    stage.setItemCol(v)
+    assert stage.getItemCol() == v
+    v = _SAMPLES[str]
+    stage.setRatingCol(v)
+    assert stage.getRatingCol() == v
+    v = _SAMPLES[int]
+    stage.setSupportThreshold(v)
+    assert stage.getSupportThreshold() == v
+    v = _SAMPLES[str]
+    stage.setTimeCol(v)
+    assert stage.getTimeCol() == v
+    v = _SAMPLES[int]
+    stage.setTimeDecayCoeff(v)
+    assert stage.getTimeDecayCoeff() == v
+
+
+def test_generated_SARModel():
+    stage = gen.SARModel()
+    assert type(stage).__mro__[1].__name__ == 'SARModel'
+    v = _SAMPLES[str]
+    stage.setActivityTimeFormat(v)
+    assert stage.getActivityTimeFormat() == v
+    v = _SAMPLES[str]
+    stage.setItemCol(v)
+    assert stage.getItemCol() == v
+    v = _SAMPLES[str]
+    stage.setRatingCol(v)
+    assert stage.getRatingCol() == v
+    v = _SAMPLES[int]
+    stage.setSupportThreshold(v)
+    assert stage.getSupportThreshold() == v
+    v = _SAMPLES[str]
+    stage.setTimeCol(v)
+    assert stage.getTimeCol() == v
+    v = _SAMPLES[int]
+    stage.setTimeDecayCoeff(v)
+    assert stage.getTimeDecayCoeff() == v
+
+
+def test_generated_VowpalWabbitClassificationModel():
+    stage = gen.VowpalWabbitClassificationModel()
+    assert type(stage).__mro__[1].__name__ == 'VowpalWabbitClassificationModel'
+    v = _SAMPLES[int]
+    stage.setBatchSize(v)
+    assert stage.getBatchSize() == v
+    v = _SAMPLES[str]
+    stage.setFeaturesCol(v)
+    assert stage.getFeaturesCol() == v
+    v = _SAMPLES[int]
+    stage.setHashSeed(v)
+    assert stage.getHashSeed() == v
+    v = _SAMPLES[float]
+    stage.setL1(v)
+    assert stage.getL1() == v
+    v = _SAMPLES[float]
+    stage.setL2(v)
+    assert stage.getL2() == v
+    v = _SAMPLES[str]
+    stage.setLabelCol(v)
+    assert stage.getLabelCol() == v
+
+
+def test_generated_VowpalWabbitClassifier():
+    stage = gen.VowpalWabbitClassifier()
+    assert type(stage).__mro__[1].__name__ == 'VowpalWabbitClassifier'
+    v = _SAMPLES[int]
+    stage.setBatchSize(v)
+    assert stage.getBatchSize() == v
+    v = _SAMPLES[str]
+    stage.setFeaturesCol(v)
+    assert stage.getFeaturesCol() == v
+    v = _SAMPLES[int]
+    stage.setHashSeed(v)
+    assert stage.getHashSeed() == v
+    v = _SAMPLES[float]
+    stage.setL1(v)
+    assert stage.getL1() == v
+    v = _SAMPLES[float]
+    stage.setL2(v)
+    assert stage.getL2() == v
+    v = _SAMPLES[str]
+    stage.setLabelCol(v)
+    assert stage.getLabelCol() == v
+
+
+def test_generated_VowpalWabbitFeaturizer():
+    stage = gen.VowpalWabbitFeaturizer()
+    assert type(stage).__mro__[1].__name__ == 'VowpalWabbitFeaturizer'
+    v = _SAMPLES[int]
+    stage.setNumBits(v)
+    assert stage.getNumBits() == v
+    v = _SAMPLES[str]
+    stage.setOutputCol(v)
+    assert stage.getOutputCol() == v
+    v = _SAMPLES[int]
+    stage.setSeed(v)
+    assert stage.getSeed() == v
+    v = _SAMPLES[bool]
+    stage.setStringSplit(v)
+    assert stage.getStringSplit() == v
+    v = _SAMPLES[bool]
+    stage.setSumCollisions(v)
+    assert stage.getSumCollisions() == v
+
+
+def test_generated_VowpalWabbitInteractions():
+    stage = gen.VowpalWabbitInteractions()
+    assert type(stage).__mro__[1].__name__ == 'VowpalWabbitInteractions'
+    v = _SAMPLES[int]
+    stage.setNumBits(v)
+    assert stage.getNumBits() == v
+    v = _SAMPLES[str]
+    stage.setOutputCol(v)
+    assert stage.getOutputCol() == v
+
+
+def test_generated_VowpalWabbitRegressionModel():
+    stage = gen.VowpalWabbitRegressionModel()
+    assert type(stage).__mro__[1].__name__ == 'VowpalWabbitRegressionModel'
+    v = _SAMPLES[int]
+    stage.setBatchSize(v)
+    assert stage.getBatchSize() == v
+    v = _SAMPLES[str]
+    stage.setFeaturesCol(v)
+    assert stage.getFeaturesCol() == v
+    v = _SAMPLES[int]
+    stage.setHashSeed(v)
+    assert stage.getHashSeed() == v
+    v = _SAMPLES[float]
+    stage.setL1(v)
+    assert stage.getL1() == v
+    v = _SAMPLES[float]
+    stage.setL2(v)
+    assert stage.getL2() == v
+    v = _SAMPLES[str]
+    stage.setLabelCol(v)
+    assert stage.getLabelCol() == v
+
+
+def test_generated_VowpalWabbitRegressor():
+    stage = gen.VowpalWabbitRegressor()
+    assert type(stage).__mro__[1].__name__ == 'VowpalWabbitRegressor'
+    v = _SAMPLES[int]
+    stage.setBatchSize(v)
+    assert stage.getBatchSize() == v
+    v = _SAMPLES[str]
+    stage.setFeaturesCol(v)
+    assert stage.getFeaturesCol() == v
+    v = _SAMPLES[int]
+    stage.setHashSeed(v)
+    assert stage.getHashSeed() == v
+    v = _SAMPLES[float]
+    stage.setL1(v)
+    assert stage.getL1() == v
+    v = _SAMPLES[float]
+    stage.setL2(v)
+    assert stage.getL2() == v
+    v = _SAMPLES[str]
+    stage.setLabelCol(v)
+    assert stage.getLabelCol() == v
+
+
+def test_generated_ImageSetAugmenter():
+    stage = gen.ImageSetAugmenter()
+    assert type(stage).__mro__[1].__name__ == 'ImageSetAugmenter'
+    v = _SAMPLES[bool]
+    stage.setFlipLeftRight(v)
+    assert stage.getFlipLeftRight() == v
+    v = _SAMPLES[bool]
+    stage.setFlipUpDown(v)
+    assert stage.getFlipUpDown() == v
+    v = _SAMPLES[str]
+    stage.setInputCol(v)
+    assert stage.getInputCol() == v
+    v = _SAMPLES[str]
+    stage.setOutputCol(v)
+    assert stage.getOutputCol() == v
+
+
+def test_generated_ImageTransformer():
+    stage = gen.ImageTransformer()
+    assert type(stage).__mro__[1].__name__ == 'ImageTransformer'
+    v = _SAMPLES[str]
+    stage.setInputCol(v)
+    assert stage.getInputCol() == v
+    v = _SAMPLES[str]
+    stage.setOutputCol(v)
+    assert stage.getOutputCol() == v
+
+
+def test_generated_UnrollBinaryImage():
+    stage = gen.UnrollBinaryImage()
+    assert type(stage).__mro__[1].__name__ == 'UnrollBinaryImage'
+    v = _SAMPLES[str]
+    stage.setInputCol(v)
+    assert stage.getInputCol() == v
+    v = _SAMPLES[str]
+    stage.setOutputCol(v)
+    assert stage.getOutputCol() == v
+
+
+def test_generated_UnrollImage():
+    stage = gen.UnrollImage()
+    assert type(stage).__mro__[1].__name__ == 'UnrollImage'
+    v = _SAMPLES[str]
+    stage.setInputCol(v)
+    assert stage.getInputCol() == v
+    v = _SAMPLES[str]
+    stage.setOutputCol(v)
+    assert stage.getOutputCol() == v
+
+
+def test_generated_Cacher():
+    stage = gen.Cacher()
+    assert type(stage).__mro__[1].__name__ == 'Cacher'
+    v = _SAMPLES[bool]
+    stage.setDisable(v)
+    assert stage.getDisable() == v
+
+
+def test_generated_ClassBalancer():
+    stage = gen.ClassBalancer()
+    assert type(stage).__mro__[1].__name__ == 'ClassBalancer'
+    v = _SAMPLES[bool]
+    stage.setBroadcastJoin(v)
+    assert stage.getBroadcastJoin() == v
+    v = _SAMPLES[str]
+    stage.setInputCol(v)
+    assert stage.getInputCol() == v
+    v = _SAMPLES[str]
+    stage.setOutputCol(v)
+    assert stage.getOutputCol() == v
+
+
+def test_generated_ClassBalancerModel():
+    stage = gen.ClassBalancerModel()
+    assert type(stage).__mro__[1].__name__ == 'ClassBalancerModel'
+    v = _SAMPLES[str]
+    stage.setInputCol(v)
+    assert stage.getInputCol() == v
+    v = _SAMPLES[str]
+    stage.setOutputCol(v)
+    assert stage.getOutputCol() == v
+
+
+def test_generated_DropColumns():
+    stage = gen.DropColumns()
+    assert type(stage).__mro__[1].__name__ == 'DropColumns'
+
+
+def test_generated_EnsembleByKey():
+    stage = gen.EnsembleByKey()
+    assert type(stage).__mro__[1].__name__ == 'EnsembleByKey'
+    v = _SAMPLES[bool]
+    stage.setCollapseGroup(v)
+    assert stage.getCollapseGroup() == v
+    v = _SAMPLES[str]
+    stage.setStrategy(v)
+    assert stage.getStrategy() == v
+
+
+def test_generated_Explode():
+    stage = gen.Explode()
+    assert type(stage).__mro__[1].__name__ == 'Explode'
+    v = _SAMPLES[str]
+    stage.setInputCol(v)
+    assert stage.getInputCol() == v
+    v = _SAMPLES[str]
+    stage.setOutputCol(v)
+    assert stage.getOutputCol() == v
+
+
+def test_generated_Lambda():
+    stage = gen.Lambda()
+    assert type(stage).__mro__[1].__name__ == 'Lambda'
+
+
+def test_generated_MultiColumnAdapter():
+    stage = gen.MultiColumnAdapter()
+    assert type(stage).__mro__[1].__name__ == 'MultiColumnAdapter'
+
+
+def test_generated_PartitionConsolidator():
+    stage = gen.PartitionConsolidator()
+    assert type(stage).__mro__[1].__name__ == 'PartitionConsolidator'
+    v = _SAMPLES[int]
+    stage.setConcurrency(v)
+    assert stage.getConcurrency() == v
+    v = _SAMPLES[float]
+    stage.setConcurrentTimeout(v)
+    assert stage.getConcurrentTimeout() == v
+
+
+def test_generated_RenameColumn():
+    stage = gen.RenameColumn()
+    assert type(stage).__mro__[1].__name__ == 'RenameColumn'
+    v = _SAMPLES[str]
+    stage.setInputCol(v)
+    assert stage.getInputCol() == v
+    v = _SAMPLES[str]
+    stage.setOutputCol(v)
+    assert stage.getOutputCol() == v
+
+
+def test_generated_Repartition():
+    stage = gen.Repartition()
+    assert type(stage).__mro__[1].__name__ == 'Repartition'
+    v = _SAMPLES[bool]
+    stage.setDisable(v)
+    assert stage.getDisable() == v
+    v = _SAMPLES[int]
+    stage.setN(v)
+    assert stage.getN() == v
+
+
+def test_generated_SelectColumns():
+    stage = gen.SelectColumns()
+    assert type(stage).__mro__[1].__name__ == 'SelectColumns'
+
+
+def test_generated_StratifiedRepartition():
+    stage = gen.StratifiedRepartition()
+    assert type(stage).__mro__[1].__name__ == 'StratifiedRepartition'
+    v = _SAMPLES[str]
+    stage.setLabelCol(v)
+    assert stage.getLabelCol() == v
+    v = _SAMPLES[int]
+    stage.setSeed(v)
+    assert stage.getSeed() == v
+
+
+def test_generated_SummarizeData():
+    stage = gen.SummarizeData()
+    assert type(stage).__mro__[1].__name__ == 'SummarizeData'
+    v = _SAMPLES[bool]
+    stage.setBasic(v)
+    assert stage.getBasic() == v
+    v = _SAMPLES[bool]
+    stage.setCounts(v)
+    assert stage.getCounts() == v
+    v = _SAMPLES[float]
+    stage.setErrorThreshold(v)
+    assert stage.getErrorThreshold() == v
+    v = _SAMPLES[bool]
+    stage.setPercentiles(v)
+    assert stage.getPercentiles() == v
+
+
+def test_generated_TextPreprocessor():
+    stage = gen.TextPreprocessor()
+    assert type(stage).__mro__[1].__name__ == 'TextPreprocessor'
+    v = _SAMPLES[str]
+    stage.setInputCol(v)
+    assert stage.getInputCol() == v
+    v = _SAMPLES[str]
+    stage.setNormFunc(v)
+    assert stage.getNormFunc() == v
+    v = _SAMPLES[str]
+    stage.setOutputCol(v)
+    assert stage.getOutputCol() == v
+
+
+def test_generated_Timer():
+    stage = gen.Timer()
+    assert type(stage).__mro__[1].__name__ == 'Timer'
+    v = _SAMPLES[bool]
+    stage.setDisableMaterialization(v)
+    assert stage.getDisableMaterialization() == v
+    v = _SAMPLES[bool]
+    stage.setLogToScala(v)
+    assert stage.getLogToScala() == v
+
+
+def test_generated_UDFTransformer():
+    stage = gen.UDFTransformer()
+    assert type(stage).__mro__[1].__name__ == 'UDFTransformer'
+    v = _SAMPLES[str]
+    stage.setInputCol(v)
+    assert stage.getInputCol() == v
+    v = _SAMPLES[str]
+    stage.setOutputCol(v)
+    assert stage.getOutputCol() == v
+
+
+def test_generated_DynamicMiniBatchTransformer():
+    stage = gen.DynamicMiniBatchTransformer()
+    assert type(stage).__mro__[1].__name__ == 'DynamicMiniBatchTransformer'
+    v = _SAMPLES[int]
+    stage.setMaxBatchSize(v)
+    assert stage.getMaxBatchSize() == v
+
+
+def test_generated_FixedMiniBatchTransformer():
+    stage = gen.FixedMiniBatchTransformer()
+    assert type(stage).__mro__[1].__name__ == 'FixedMiniBatchTransformer'
+    v = _SAMPLES[int]
+    stage.setBatchSize(v)
+    assert stage.getBatchSize() == v
+    v = _SAMPLES[bool]
+    stage.setBuffered(v)
+    assert stage.getBuffered() == v
+    v = _SAMPLES[int]
+    stage.setMaxBufferSize(v)
+    assert stage.getMaxBufferSize() == v
+
+
+def test_generated_FlattenBatch():
+    stage = gen.FlattenBatch()
+    assert type(stage).__mro__[1].__name__ == 'FlattenBatch'
+
+
+def test_generated_TimeIntervalMiniBatchTransformer():
+    stage = gen.TimeIntervalMiniBatchTransformer()
+    assert type(stage).__mro__[1].__name__ == 'TimeIntervalMiniBatchTransformer'
+    v = _SAMPLES[int]
+    stage.setMaxBatchSize(v)
+    assert stage.getMaxBatchSize() == v
+    v = _SAMPLES[int]
+    stage.setMillisToWait(v)
+    assert stage.getMillisToWait() == v
+
+
+def test_generated_ComputeModelStatistics():
+    stage = gen.ComputeModelStatistics()
+    assert type(stage).__mro__[1].__name__ == 'ComputeModelStatistics'
+    v = _SAMPLES[str]
+    stage.setEvaluationMetric(v)
+    assert stage.getEvaluationMetric() == v
+    v = _SAMPLES[str]
+    stage.setLabelCol(v)
+    assert stage.getLabelCol() == v
+    v = _SAMPLES[str]
+    stage.setScoredLabelsCol(v)
+    assert stage.getScoredLabelsCol() == v
+
+
+def test_generated_ComputePerInstanceStatistics():
+    stage = gen.ComputePerInstanceStatistics()
+    assert type(stage).__mro__[1].__name__ == 'ComputePerInstanceStatistics'
+    v = _SAMPLES[str]
+    stage.setEvaluationMetric(v)
+    assert stage.getEvaluationMetric() == v
+    v = _SAMPLES[str]
+    stage.setLabelCol(v)
+    assert stage.getLabelCol() == v
+    v = _SAMPLES[str]
+    stage.setScoredLabelsCol(v)
+    assert stage.getScoredLabelsCol() == v
+
+
+def test_generated_TrainClassifier():
+    stage = gen.TrainClassifier()
+    assert type(stage).__mro__[1].__name__ == 'TrainClassifier'
+    v = _SAMPLES[str]
+    stage.setFeaturesCol(v)
+    assert stage.getFeaturesCol() == v
+    v = _SAMPLES[str]
+    stage.setLabelCol(v)
+    assert stage.getLabelCol() == v
+    v = _SAMPLES[int]
+    stage.setNumFeatures(v)
+    assert stage.getNumFeatures() == v
+
+
+def test_generated_TrainRegressor():
+    stage = gen.TrainRegressor()
+    assert type(stage).__mro__[1].__name__ == 'TrainRegressor'
+    v = _SAMPLES[str]
+    stage.setFeaturesCol(v)
+    assert stage.getFeaturesCol() == v
+    v = _SAMPLES[str]
+    stage.setLabelCol(v)
+    assert stage.getLabelCol() == v
+    v = _SAMPLES[int]
+    stage.setNumFeatures(v)
+    assert stage.getNumFeatures() == v
+
+
+def test_generated_TrainedClassifierModel():
+    stage = gen.TrainedClassifierModel()
+    assert type(stage).__mro__[1].__name__ == 'TrainedClassifierModel'
+    v = _SAMPLES[str]
+    stage.setFeaturesCol(v)
+    assert stage.getFeaturesCol() == v
+    v = _SAMPLES[str]
+    stage.setLabelCol(v)
+    assert stage.getLabelCol() == v
+    v = _SAMPLES[int]
+    stage.setNumFeatures(v)
+    assert stage.getNumFeatures() == v
+
+
+def test_generated_TrainedRegressorModel():
+    stage = gen.TrainedRegressorModel()
+    assert type(stage).__mro__[1].__name__ == 'TrainedRegressorModel'
+    v = _SAMPLES[str]
+    stage.setFeaturesCol(v)
+    assert stage.getFeaturesCol() == v
+    v = _SAMPLES[str]
+    stage.setLabelCol(v)
+    assert stage.getLabelCol() == v
+    v = _SAMPLES[int]
+    stage.setNumFeatures(v)
+    assert stage.getNumFeatures() == v
+

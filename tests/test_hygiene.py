@@ -1,0 +1,140 @@
+"""Round-2 hygiene coverage: ModelDownloader, numBatches continuation,
+sparse vectors, matrixType honesty, label validation, numThreads plumbing."""
+
+import hashlib
+import os
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu.core.frame import DataFrame
+from mmlspark_tpu.core.linalg import SparseVector, stack_sparse
+from mmlspark_tpu.models.downloader import ModelDownloader, ModelSchema, sha256_file
+from mmlspark_tpu.models.lightgbm import LightGBMClassifier
+
+
+def _df(n=120, seed=0):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, 4))
+    y = (X[:, 0] + 0.5 * X[:, 1] > 0).astype(np.float64)
+    return DataFrame({"features": list(X), "label": y}), X, y
+
+
+class TestModelDownloader:
+    def test_catalog_and_file_uri_download_with_hash(self, tmp_path):
+        payload = b"onnx-bytes-stand-in"
+        src = tmp_path / "model.onnx"
+        src.write_bytes(payload)
+        schema = ModelSchema(
+            name="TinyNet", uri=f"file://{src}",
+            hash=hashlib.sha256(payload).hexdigest(), inputNode="in0",
+        )
+        d = ModelDownloader(str(tmp_path / "cache"))
+        d.register(schema)
+        assert any(m.name == "ResNet50" for m in d.remoteModels())
+        p1 = d.downloadByName("TinyNet")
+        assert open(p1, "rb").read() == payload
+        # cached: second call returns without re-fetching
+        os.utime(p1)
+        assert d.downloadByName("TinyNet") == p1
+
+    def test_hash_mismatch_raises_and_cleans_up(self, tmp_path):
+        src = tmp_path / "model.onnx"
+        src.write_bytes(b"payload")
+        schema = ModelSchema(name="Bad", uri=f"file://{src}", hash="0" * 64)
+        d = ModelDownloader(str(tmp_path / "cache"))
+        with pytest.raises(ValueError, match="hash mismatch"):
+            d.downloadModel(schema)
+        assert not os.path.exists(os.path.join(d.local_path, "model.onnx"))
+
+    def test_unknown_name(self, tmp_path):
+        with pytest.raises(KeyError, match="unknown model"):
+            ModelDownloader(str(tmp_path)).downloadByName("NotAModel")
+
+    def test_sha256_file(self, tmp_path):
+        p = tmp_path / "f"
+        p.write_bytes(b"abc")
+        assert sha256_file(str(p)) == hashlib.sha256(b"abc").hexdigest()
+
+
+class TestNumBatches:
+    def test_batched_continuation_trains_all_iterations(self):
+        df, X, y = _df(200)
+        m = LightGBMClassifier(
+            numIterations=6, numLeaves=4, minDataInLeaf=2, numBatches=3
+        ).fit(df)
+        booster = m.getBooster()
+        assert booster.num_iterations == 6  # 2 per batch, concatenated
+        acc = (np.asarray(m.transform(df)["prediction"]) == y).mean()
+        assert acc > 0.8
+
+    def test_single_batch_equals_plain(self):
+        df, X, y = _df(150)
+        m1 = LightGBMClassifier(numIterations=4, numLeaves=4, minDataInLeaf=2).fit(df)
+        m0 = LightGBMClassifier(
+            numIterations=4, numLeaves=4, minDataInLeaf=2, numBatches=1
+        ).fit(df)
+        np.testing.assert_allclose(
+            np.stack(list(m1.transform(df)["probability"])),
+            np.stack(list(m0.transform(df)["probability"])),
+            rtol=1e-6,
+        )
+
+
+class TestHonestParams:
+    def test_matrix_type_sparse_warns(self):
+        df, X, y = _df(60)
+        with pytest.warns(UserWarning, match="dense binned"):
+            LightGBMClassifier(
+                numIterations=2, numLeaves=4, minDataInLeaf=2, matrixType="sparse"
+            ).fit(df)
+
+    def test_multiclass_label_validation(self):
+        df, X, y = _df(60)
+        bad = df.withColumn("label", [-1.0] * 60)
+        with pytest.raises(ValueError, match="non-negative"):
+            LightGBMClassifier(
+                objective="multiclass", numIterations=2, numLeaves=4
+            ).fit(bad)
+        frac = df.withColumn("label", [0.5] * 60)
+        with pytest.raises(ValueError, match="integers"):
+            LightGBMClassifier(
+                objective="multiclass", numIterations=2, numLeaves=4
+            ).fit(frac)
+
+    def test_num_threads_plumbed(self):
+        from mmlspark_tpu.ops.binning import BinMapper
+
+        clf = LightGBMClassifier(numThreads=2)
+        assert clf._train_params()["num_threads"] == 2
+        bm = BinMapper(threads=3)
+        assert bm.threads == 3
+
+
+class TestSparseVector:
+    def test_basics(self):
+        v = SparseVector(8, [1, 5], [2.0, -1.0])
+        assert v.nnz == 2 and len(v) == 8
+        np.testing.assert_array_equal(
+            v.toArray(), [0, 2.0, 0, 0, 0, -1.0, 0, 0]
+        )
+        assert v[5] == -1.0 and v[0] == 0.0
+        assert v.dot(np.arange(8)) == 2.0 * 1 + (-1.0) * 5
+        assert v == SparseVector(8, [1, 5], [2.0, -1.0])
+
+    def test_stack_sparse_padding(self):
+        rows = [SparseVector(16, [3], [1.0]), SparseVector(16, [2, 9], [0.5, 2.0])]
+        idx, val = stack_sparse(rows)
+        assert idx.shape == (2, 2)
+        assert idx[0, 1] == 0 and val[0, 1] == 0.0  # padding is a no-op pair
+
+    def test_featurizer_emits_sparse(self):
+        from mmlspark_tpu.models.vw import VowpalWabbitFeaturizer
+
+        df = DataFrame({"age": [25.0, 40.0], "city": ["ny", "sf"]})
+        out = VowpalWabbitFeaturizer(
+            inputCols=["age", "city"], outputCol="f", numBits=18
+        ).transform(df)
+        v = out["f"][0]
+        assert isinstance(v, SparseVector)
+        assert v.size == 1 << 18 and 1 <= v.nnz <= 4

@@ -9,6 +9,7 @@ psum-reduced histogram — the full multi-controller path end to end.
 """
 
 import json
+import os
 import socket
 import subprocess
 import sys
@@ -17,7 +18,7 @@ import textwrap
 import numpy as np
 import pytest
 
-REPO = "/root/repo"
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 _WORKER = textwrap.dedent(
     """

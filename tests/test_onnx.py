@@ -308,3 +308,36 @@ class TestCNTKModel:
         out = model.transform(df)
         vals = np.stack(out["feats_out"])
         np.testing.assert_allclose(vals, np.maximum(X @ w.T, 0), rtol=1e-4, atol=1e-4)
+
+
+class TestShardedInference:
+    def test_sharded_batch_matches_expected(self):
+        """8-device CPU mesh: ONNXModel row-shards minibatches over the mesh
+        (SURVEY.md §2.9 N4 'jit + pjit batch sharding') and scores
+        identically to the raw graph."""
+        import jax
+        import numpy as np
+
+        from mmlspark_tpu.core.frame import DataFrame
+        from mmlspark_tpu.models.onnx_model import ONNXModel
+
+        assert jax.device_count() >= 8  # conftest forces the virtual mesh
+        rng = np.random.default_rng(0)
+        W = rng.normal(size=(5, 3)).astype(np.float32)
+        b = rng.normal(size=3).astype(np.float32)
+        model_bytes = _model(
+            [make_node("Gemm", ["x", "W", "b"], ["y"], alpha=1.0, beta=1.0)],
+            [("x", (None, 5), FLOAT)], ["y"], {"W": W, "b": b},
+        )
+        X = rng.normal(size=(37, 5)).astype(np.float32)  # odd count → padding
+        df = DataFrame({"features": list(X)})
+        stage = (
+            ONNXModel()
+            .setModelPayload(model_bytes)
+            .setFeedDict({"x": "features"})
+            .setFetchDict({"out": "y"})
+            .setMiniBatchSize(16)
+        )
+        out = stage.transform(df)
+        got = np.stack(list(out["out"]))
+        np.testing.assert_allclose(got, X @ W + b, rtol=1e-4, atol=1e-5)
