@@ -34,6 +34,10 @@ from mmlspark_tpu.core.registry import all_stage_classes
 _NO_DEFAULT = object()
 
 
+def _package_stages():
+    return all_stage_classes(package_only=True)
+
+
 def _param_default_expr(p: Param) -> str:
     d = getattr(p, "default", _NO_DEFAULT)
     sentinel = type(d).__name__ == "object"  # core.params._NO_DEFAULT
@@ -77,7 +81,7 @@ def _emit_class(cls) -> List[str]:
 
 
 def render_api() -> str:
-    classes = all_stage_classes()
+    classes = _package_stages()
     lines = [
         '"""GENERATED FILE — do not edit by hand.',
         "",
@@ -106,7 +110,7 @@ def render_api() -> str:
 
 
 def render_smoke_tests() -> str:
-    classes = all_stage_classes()
+    classes = _package_stages()
     lines = [
         '"""GENERATED smoke tests — do not edit by hand.',
         "",

@@ -28,11 +28,18 @@ def register_stage(cls: type) -> type:
     return cls
 
 
-def all_stage_classes() -> List[type]:
+def all_stage_classes(package_only: bool = False) -> List[type]:
+    """Every registered stage; ``package_only`` filters to stages defined
+    inside the package (test modules register toy stages for their own
+    persistence checks — codegen and the coverage meta-test must not see
+    them)."""
     # Import the full surface so registration side effects have happened.
     import mmlspark_tpu.all  # noqa: F401
 
-    return [c for _, c in sorted(_STAGES.items())]
+    out = [c for _, c in sorted(_STAGES.items())]
+    if package_only:
+        out = [c for c in out if c.__module__.startswith("mmlspark_tpu.")]
+    return out
 
 
 def resolve_class(qualified: str) -> type:

@@ -98,6 +98,14 @@ class TrainConfig:
     cat_l2: float = 10.0
     max_cat_threshold: int = 32
     num_threads: int = 0  # host-side binner threads (0 = auto)
+    # Checkpointed boosting (SURVEY.md §5.4 "tree list is a natural
+    # incremental checkpoint"): every `checkpoint_every` iterations the
+    # model string so far is written atomically to
+    # `<checkpoint_dir>/model.txt`; a later train() with the same dir
+    # resumes from it (continuation re-bins — thresholds come from the
+    # checkpoint's own vocabulary, §5.4 "resume = load tree array + rebin").
+    checkpoint_dir: Optional[str] = None
+    checkpoint_every: int = 0
     verbosity: int = 1
 
     _ALIASES = {
@@ -514,6 +522,41 @@ def train(
     obj = get_objective(cfg.objective, **cfg.objective_params())
     K = obj.num_model_per_iteration
 
+    # ---- checkpoint recovery (SURVEY.md §5.3/§5.4) ---------------------
+    # The resume source is a PICKLE (exact Booster state, including the
+    # fitted BinMapper — a model-string round trip would collapse
+    # never-yet-split features to a single bin); model.txt is mirrored
+    # alongside for interop/inspection.  dart cannot warm-start (drop
+    # bookkeeping) and rf cannot continue (averaged output), so neither
+    # checkpoints.
+    ckpt_path = ckpt_txt = None
+    if (
+        cfg.checkpoint_dir
+        and cfg.checkpoint_every > 0
+        and cfg.boosting not in ("dart", "rf")
+    ):
+        import os
+        import pickle
+
+        os.makedirs(cfg.checkpoint_dir, exist_ok=True)
+        ckpt_path = os.path.join(cfg.checkpoint_dir, "checkpoint.pkl")
+        ckpt_txt = os.path.join(cfg.checkpoint_dir, "model.txt")
+        if init_model is None and os.path.exists(ckpt_path):
+            with open(ckpt_path, "rb") as f:
+                init_model = pickle.load(f)
+            done = init_model.num_iterations
+            if done >= cfg.num_iterations:
+                # Honor the REQUESTED size: truncate rather than silently
+                # returning a bigger forest than asked for.
+                T = cfg.num_iterations
+                return Booster(
+                    trees=init_model._slice_trees(T),
+                    tree_weights=init_model.tree_weights[:T],
+                    bin_mapper=init_model.bin_mapper,
+                    config=cfg,
+                )
+            cfg = dataclasses.replace(cfg, num_iterations=cfg.num_iterations - done)
+
     # ---- warm start (continued training; the reference's `modelString`
     # param — SURVEY.md §2.3.1, §5.4) -----------------------------------
     if init_model is not None:
@@ -888,6 +931,52 @@ def train(
             chunk_iters = min(n_iter, 64)
         else:
             chunk_iters = n_iter
+        if ckpt_path is not None:
+            chunk_iters = min(chunk_iters, max(cfg.checkpoint_every, 1))
+        ckpt_host_chunks: List[Tree] = []  # fetched once per chunk, reused
+
+        def _write_snapshot(booster_snap):
+            import os
+            import pickle
+
+            tmp = ckpt_path + ".tmp"
+            with open(tmp, "wb") as f:
+                pickle.dump(booster_snap, f)
+            os.replace(tmp, ckpt_path)
+            tmp = ckpt_txt + ".tmp"
+            with open(tmp, "w") as f:
+                f.write(
+                    booster_snap.save_model_string(
+                        num_iteration=booster_snap.num_iterations
+                    )
+                )
+            os.replace(tmp, ckpt_txt)
+
+        def _write_checkpoint(new_chunk):
+            # Each chunk is fetched from device ONCE and kept host-side;
+            # the snapshot concatenates the host copies (atomic replace so
+            # a crash never leaves a torn checkpoint).
+            ckpt_host_chunks.append(
+                Tree(*[np.asarray(a) for a in jax.device_get(new_chunk)])
+            )
+            so_far = Tree(
+                *[np.concatenate(a, axis=0) for a in zip(*ckpt_host_chunks)]
+            )
+            if use_bfa:
+                bias_ = np.asarray(init, dtype=np.float32).reshape(-1)
+                lv_ = so_far.leaf_value.copy()
+                act_ = (
+                    np.arange(lv_.shape[-1])[None, :]
+                    < so_far.num_leaves[0][:, None]
+                )
+                lv_[0] = np.where(act_, lv_[0] + bias_[:, None], 0.0)
+                so_far = so_far._replace(leaf_value=lv_)
+            _write_snapshot(
+                _finalize_booster(
+                    so_far, np.ones(so_far.split_leaf.shape[0]), bin_mapper,
+                    cfg, init_model, {}, -1,
+                )
+            )
 
         carry = (scores, tuple(vs["scores"] for vs in vsets))
         tree_chunks: List[Tree] = []
@@ -901,6 +990,8 @@ def train(
                 jnp.asarray(bag_keys[n_done : n_done + c]),
             )
             tree_chunks.append(trees_c)
+            if ckpt_path is not None:
+                _write_checkpoint(trees_c)
             if vsets:
                 # One batched transfer (issues every copy async, then waits)
                 # — per-array np.asarray pulls pay a full dispatch RTT each.
@@ -945,10 +1036,15 @@ def train(
             lv[0] = np.where(active, lv[0] + bias[:, None], 0.0)
             stacked = stacked._replace(leaf_value=lv)
         weights = np.ones(kept)
-        return _finalize_booster(
+        final = _finalize_booster(
             stacked, weights, bin_mapper, cfg, init_model, evals_result,
             best_iter if cfg.early_stopping_round > 0 else -1,
         )
+        if ckpt_path is not None and stop_at is not None:
+            # Early stopping truncated the forest: rewrite the checkpoint
+            # so a rerun resumes from the RETURNED model, not the overshoot.
+            _write_snapshot(final)
+        return final
 
     for it in range(cfg.num_iterations):
         sub = all_keys[it]

@@ -33,12 +33,11 @@ class StreamingQuery:
 
     def __init__(self, name: str, servers: List[HTTPServer],
                  stages: List[Callable[[DataFrame], DataFrame]],
-                 reply_col: str, id_col: str, batch_size: int):
+                 reply_col: str, batch_size: int):
         self.name = name
         self._servers = servers
         self._stages = stages
         self._reply_col = reply_col
-        self._id_col = id_col
         self._batch_size = batch_size
         self._stop = threading.Event()
         self._exception: Optional[BaseException] = None
@@ -100,7 +99,9 @@ class StreamingQuery:
                     self._exception = e
                     from mmlspark_tpu.io.http.http_schema import HTTPResponseData
 
-                    for rid in batch[self._id_col]:
+                    # the source frame always carries the request id in
+                    # the "id" column
+                    for rid in batch["id"]:
                         server.reply(
                             rid, HTTPResponseData(statusCode=500,
                                                   statusReason=repr(e))
@@ -179,15 +180,16 @@ class _SinkBuilder:
     def __init__(self, frame: ServingFrame):
         self._frame = frame
         self._reply_col = "response"
-        self._id_col = "id"
         self._name = "serving-query"
         self._batch_size = 64
 
     def server(self) -> "_SinkBuilder":
         return self
 
-    def replyTo(self, reply_col: str, id_col: str = "id") -> "_SinkBuilder":
-        self._reply_col, self._id_col = reply_col, id_col
+    def replyTo(self, reply_col: str) -> "_SinkBuilder":
+        """Column carrying the reply payload (request ids always live in
+        the source's ``id`` column)."""
+        self._reply_col = reply_col
         return self
 
     def queryName(self, name: str) -> "_SinkBuilder":
@@ -202,7 +204,7 @@ class _SinkBuilder:
     def start(self) -> StreamingQuery:
         return StreamingQuery(
             self._name, self._frame._servers, self._frame._stages,
-            self._reply_col, self._id_col, self._batch_size,
+            self._reply_col, self._batch_size,
         )._start()
 
 

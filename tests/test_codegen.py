@@ -33,9 +33,9 @@ class TestCodegenMeta:
 
     def test_every_stage_has_a_wrapper(self):
         import mmlspark_tpu.generated_api as gen
-        from mmlspark_tpu.core.registry import all_stage_classes
+        from mmlspark_tpu.codegen import _package_stages
 
-        for cls in all_stage_classes():
+        for cls in _package_stages():
             assert hasattr(gen, cls.__name__), cls.__name__
 
     def test_generated_wrapper_is_functional(self):

@@ -1,0 +1,145 @@
+"""Env utilities (SURVEY.md §2.1 StreamUtilities/FaultToleranceUtils/
+EnvironmentUtils) + checkpointed-boosting restart (§5.3/§5.4)."""
+
+import os
+import time
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu.core.env import EnvironmentUtils, FaultToleranceUtils, using
+from mmlspark_tpu.engine.booster import Booster, Dataset, train
+
+
+class TestUsing:
+    def test_closes_on_success_and_error(self):
+        class Res:
+            closed = 0
+
+            def close(self):
+                Res.closed += 1
+
+        with using(Res(), Res()) as (a, b):
+            pass
+        assert Res.closed == 2
+        with pytest.raises(RuntimeError):
+            with using(Res()):
+                raise RuntimeError("boom")
+        assert Res.closed == 3
+
+    def test_stop_fallback(self):
+        class Stoppable:
+            stopped = False
+
+            def stop(self):
+                Stoppable.stopped = True
+
+        with using(Stoppable()):
+            pass
+        assert Stoppable.stopped
+
+
+class TestRetryWithTimeout:
+    def test_succeeds_after_flaky_failures(self):
+        calls = {"n": 0}
+
+        def flaky():
+            calls["n"] += 1
+            if calls["n"] < 3:
+                raise ConnectionError("transient")
+            return "ok"
+
+        assert FaultToleranceUtils.retry_with_timeout(
+            flaky, timeout_s=5, retries=3, backoff_s=0.01
+        ) == "ok"
+        assert calls["n"] == 3
+
+    def test_timeout_attempts_then_raises(self):
+        def slow():
+            time.sleep(2.0)
+
+        t0 = time.time()
+        with pytest.raises(TimeoutError):
+            FaultToleranceUtils.retry_with_timeout(
+                slow, timeout_s=0.1, retries=2, backoff_s=0.01
+            )
+        assert time.time() - t0 < 1.5
+
+    def test_non_retryable_raises_immediately(self):
+        calls = {"n": 0}
+
+        def bad():
+            calls["n"] += 1
+            raise ValueError("permanent")
+
+        with pytest.raises(ValueError):
+            FaultToleranceUtils.retry_with_timeout(
+                bad, retries=3, retry_on=(ConnectionError,)
+            )
+        assert calls["n"] == 1
+
+    def test_environment_summary(self):
+        s = EnvironmentUtils.summary()
+        assert s["platform"] == "cpu" and s["devices"] >= 8
+
+
+class TestCheckpointedBoosting:
+    def _data(self, n=400):
+        rng = np.random.default_rng(0)
+        X = rng.normal(size=(n, 4))
+        y = (X[:, 0] + 0.3 * X[:, 1] > 0).astype(np.float64)
+        return X, y
+
+    def test_checkpoints_written_and_resume_completes(self, tmp_path):
+        X, y = self._data()
+        params = dict(
+            objective="binary", num_iterations=6, num_leaves=7,
+            min_data_in_leaf=5, checkpoint_dir=str(tmp_path),
+            checkpoint_every=2,
+        )
+        full = train(dict(params), Dataset(X, y))
+        assert full.num_iterations == 6
+        ckpt = os.path.join(str(tmp_path), "model.txt")
+        assert os.path.exists(ckpt)
+        # the final checkpoint IS the full model
+        with open(ckpt) as f:
+            snap = Booster.from_model_string(f.read())
+        assert snap.num_iterations == 6
+        np.testing.assert_allclose(
+            snap.predict(X), full.predict(X), rtol=1e-4, atol=1e-5
+        )
+
+    def test_crash_resume_trains_only_remaining(self, tmp_path):
+        X, y = self._data()
+        base = dict(
+            objective="binary", num_iterations=4, num_leaves=7,
+            min_data_in_leaf=5, checkpoint_dir=str(tmp_path),
+            checkpoint_every=2,
+        )
+        # "crashed" run: only 4 of 10 iterations completed
+        partial = train(dict(base), Dataset(X, y))
+        p4 = partial.predict(X)  # BEFORE resume overwrites the checkpoint
+        resumed = train(
+            dict(base, num_iterations=10), Dataset(X, y)
+        )
+        assert resumed.num_iterations == 10
+        # quality: the resumed forest must fit noticeably better than the
+        # 4-tree checkpoint
+        from sklearn.metrics import log_loss
+
+        p10 = resumed.predict(X)
+        assert log_loss(y, p10) < log_loss(y, p4)
+
+    def test_completed_checkpoint_short_circuits(self, tmp_path):
+        X, y = self._data(200)
+        params = dict(
+            objective="binary", num_iterations=3, num_leaves=7,
+            min_data_in_leaf=5, checkpoint_dir=str(tmp_path),
+            checkpoint_every=1,
+        )
+        b1 = train(dict(params), Dataset(X, y))
+        b2 = train(dict(params), Dataset(X, y))  # resumes → already done
+        assert b2.num_iterations == 3
+        np.testing.assert_allclose(
+            b1.predict(X), b2.predict(X), rtol=1e-4, atol=1e-5
+        )

@@ -466,9 +466,7 @@ _ALL_FIXTURES = _fixtures()
 # Only PACKAGE stages: test modules register toy stages for their own
 # persistence checks (tests/test_core.py), which must not trip the
 # coverage meta-test when the whole suite runs in one process.
-_PKG_CLASSES = [
-    c for c in all_stage_classes() if c.__module__.startswith("mmlspark_tpu.")
-]
+_PKG_CLASSES = all_stage_classes(package_only=True)
 _ALL_NAMES = sorted(c.__name__ for c in _PKG_CLASSES)
 
 
