@@ -21,9 +21,15 @@ T = TypeVar("T")
 @contextlib.contextmanager
 def using(*resources):
     """Scala ``StreamUtilities.using``: yield resources, close them all on
-    exit (even on error), first-close-error wins after all close attempts."""
+    exit (even on error).  A close failure is raised only when the body
+    itself succeeded — a body exception always propagates unmasked (the
+    reference's semantics)."""
+    body_failed = False
     try:
         yield resources if len(resources) != 1 else resources[0]
+    except BaseException:
+        body_failed = True
+        raise
     finally:
         err = None
         for r in resources:
@@ -35,7 +41,7 @@ def using(*resources):
                     except Exception as e:  # keep closing the rest
                         err = err or e
                     break
-        if err is not None:
+        if err is not None and not body_failed:
             raise err
 
 

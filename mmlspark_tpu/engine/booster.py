@@ -455,9 +455,20 @@ def _hashable(v):
     return v
 
 
+# Config fields the jitted scan body does NOT close over: excluding them
+# lets e.g. per-run checkpoint directories or different iteration counts
+# reuse the compiled program (scan length retraces by shape anyway).
+_CACHE_KEY_EXCLUDE = frozenset(
+    {"num_iterations", "checkpoint_dir", "checkpoint_every", "verbosity",
+     "metric", "early_stopping_round"}
+)
+
+
 def _cfg_cache_key(cfg: TrainConfig) -> Tuple:
     return tuple(
-        (f.name, _hashable(getattr(cfg, f.name))) for f in dataclasses.fields(cfg)
+        (f.name, _hashable(getattr(cfg, f.name)))
+        for f in dataclasses.fields(cfg)
+        if f.name not in _CACHE_KEY_EXCLUDE
     )
 
 
@@ -544,16 +555,22 @@ def train(
         if init_model is None and os.path.exists(ckpt_path):
             with open(ckpt_path, "rb") as f:
                 init_model = pickle.load(f)
-            done = init_model.num_iterations
+            # Count the trees continuation will actually replay/keep
+            # (_used_iters: an early-stopped snapshot contributes only
+            # best_iteration+1 trees).
+            done = init_model._used_iters(None)
             if done >= cfg.num_iterations:
                 # Honor the REQUESTED size: truncate rather than silently
-                # returning a bigger forest than asked for.
+                # returning a bigger forest than asked for, preserving the
+                # early-stopping cutoff when it survives the truncation.
                 T = cfg.num_iterations
+                bi = init_model.best_iteration
                 return Booster(
                     trees=init_model._slice_trees(T),
                     tree_weights=init_model.tree_weights[:T],
                     bin_mapper=init_model.bin_mapper,
                     config=cfg,
+                    best_iteration=bi if 0 <= bi < T else -1,
                 )
             cfg = dataclasses.replace(cfg, num_iterations=cfg.num_iterations - done)
 
@@ -834,8 +851,14 @@ def train(
     # All per-iteration keys in one device call, pulled to host once: a
     # jax.random.split per iteration is a dispatch round-trip each (adds up
     # fast over remote-dispatch links).
+    # Continuation (modelString warm start or checkpoint resume) CONTINUES
+    # the per-iteration key stream where the base forest left off — reusing
+    # keys 0..k would re-draw the identical bags/feature subsets for the
+    # new trees (correlated forest).
+    key_start = init_model._used_iters(None) if init_model is not None else 0
+    total_keyed = key_start + cfg.num_iterations
     root_key = jax.random.PRNGKey(cfg.bagging_seed + 7919 * cfg.seed)
-    all_keys = np.asarray(jax.random.split(root_key, 2 * cfg.num_iterations))
+    all_keys = np.asarray(jax.random.split(root_key, 2 * total_keyed))
 
     if cfg.boosting != "dart":
         # ---- FAST PATH: the whole boosting run as ONE lax.scan ----------
@@ -855,12 +878,15 @@ def train(
             # LightGBM bagging reuse: iteration `it` uses the bag drawn at
             # the last multiple of bagging_freq.  Recomputing the draw from
             # the same key inside the scan body reproduces reuse without a
-            # carried bag array.
-            draw_at = (np.arange(n_iter) // cfg.bagging_freq) * cfg.bagging_freq
-            bag_keys = all_keys[n_iter + draw_at]
+            # carried bag array.  Iteration indices are GLOBAL (offset by
+            # the warm-start forest) so resumed draws differ from the base
+            # forest's.
+            global_it = np.arange(key_start, total_keyed)
+            draw_at = (global_it // cfg.bagging_freq) * cfg.bagging_freq
+            bag_keys = all_keys[total_keyed + draw_at]
         else:
             bag_keys = np.zeros((n_iter, 2), dtype=all_keys.dtype)
-        iter_keys = all_keys[:n_iter]
+        iter_keys = all_keys[key_start:total_keyed]
 
         vbins_t = tuple(vs["bins"] for vs in vsets)
 
@@ -963,14 +989,7 @@ def train(
                 *[np.concatenate(a, axis=0) for a in zip(*ckpt_host_chunks)]
             )
             if use_bfa:
-                bias_ = np.asarray(init, dtype=np.float32).reshape(-1)
-                lv_ = so_far.leaf_value.copy()
-                act_ = (
-                    np.arange(lv_.shape[-1])[None, :]
-                    < so_far.num_leaves[0][:, None]
-                )
-                lv_[0] = np.where(act_, lv_[0] + bias_[:, None], 0.0)
-                so_far = so_far._replace(leaf_value=lv_)
+                so_far = _fold_bias(so_far, init)
             _write_snapshot(
                 _finalize_booster(
                     so_far, np.ones(so_far.split_leaf.shape[0]), bin_mapper,
@@ -1017,7 +1036,11 @@ def train(
             n_done += c
 
         kept = (stop_at + 1) if stop_at is not None else n_iter
-        chunks_np = jax.device_get(tree_chunks)  # one batched transfer
+        # checkpointing already host-copied every chunk — reuse those
+        chunks_np = (
+            ckpt_host_chunks if ckpt_path is not None
+            else jax.device_get(tree_chunks)
+        )  # one batched transfer otherwise
         stacked = Tree(
             *[np.concatenate(arrs, axis=0)[:kept] for arrs in zip(*chunks_np)]
         )
@@ -1025,16 +1048,7 @@ def train(
             for nm in names:
                 evals_result[nm][metric_name] = evals_result[nm][metric_name][:kept]
         if use_bfa:
-            # boost_from_average bias folding into the STORED tree 0
-            # (LightGBM AddBias) — the in-scan deltas stayed unbiased (the
-            # running scores already start at init), so fold here once.
-            bias = np.asarray(init, dtype=np.float32).reshape(-1)  # (K,) or (1,)
-            lv = stacked.leaf_value.copy()  # (T, K, L)
-            active = (
-                np.arange(lv.shape[-1])[None, :] < stacked.num_leaves[0][:, None]
-            )  # (K, L)
-            lv[0] = np.where(active, lv[0] + bias[:, None], 0.0)
-            stacked = stacked._replace(leaf_value=lv)
+            stacked = _fold_bias(stacked, init)
         weights = np.ones(kept)
         final = _finalize_booster(
             stacked, weights, bin_mapper, cfg, init_model, evals_result,
@@ -1046,6 +1060,7 @@ def train(
             _write_snapshot(final)
         return final
 
+    assert key_start == 0  # dart forbids warm start, so no offset here
     for it in range(cfg.num_iterations):
         sub = all_keys[it]
         if do_bagging and it % cfg.bagging_freq == 0:
@@ -1150,6 +1165,19 @@ def train(
         stacked, weights, bin_mapper, cfg, init_model, evals_result,
         best_iter if cfg.early_stopping_round > 0 else -1,
     )
+
+
+def _fold_bias(stacked: Tree, init) -> Tree:
+    """boost_from_average bias folding into the STORED tree 0 (LightGBM
+    AddBias): in-scan deltas stay unbiased (running scores already start at
+    init), so the bias lands on the persisted leaf values exactly once."""
+    bias = np.asarray(init, dtype=np.float32).reshape(-1)  # (K,) or (1,)
+    lv = stacked.leaf_value.copy()  # (T, K, L)
+    active = (
+        np.arange(lv.shape[-1])[None, :] < stacked.num_leaves[0][:, None]
+    )  # (K, L)
+    lv[0] = np.where(active, lv[0] + bias[:, None], 0.0)
+    return stacked._replace(leaf_value=lv)
 
 
 def _finalize_booster(

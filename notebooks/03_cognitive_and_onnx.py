@@ -1,0 +1,47 @@
+# %% [markdown]
+# # Cognitive services + ONNX inference
+#
+# The HTTP-on-Spark side of the reference (SURVEY.md §2.6) and the
+# XLA-lowered ONNX inference path (§2.4). The cognitive cells point at a
+# configurable endpoint — swap in a real Azure region + key, or a stub.
+
+# %%
+import numpy as np
+
+from mmlspark_tpu import DataFrame, ONNXModel, TextSentiment
+
+# %% Cognitive transformer (value-or-column ServiceParams)
+sentiment = (
+    TextSentiment()
+    .setLocation("eastus")             # regional URL builder...
+    # .setUrl("http://127.0.0.1:8900/text/analytics/v3.0/sentiment")  # ...or explicit
+    .setSubscriptionKey("<your-key>")
+    .setText({"col": "review"})
+    .setOutputCol("sentiment")
+    .setConcurrency(8)
+)
+df = DataFrame({"review": ["great product", "terrible service"]})
+# out = sentiment.transform(df)  # needs a reachable endpoint
+print("request URL:", sentiment._base_url())
+
+# %% ONNX graph -> jitted XLA program, mesh-sharded minibatches
+from mmlspark_tpu.onnx.importer import export_model_bytes, make_node
+
+rng = np.random.default_rng(0)
+W = rng.normal(size=(8, 3)).astype(np.float32)
+model_bytes = export_model_bytes(
+    [make_node("MatMul", ["x", "W"], ["y"])],
+    [("x", (None, 8), 1)], ["y"], {"W": W},
+)
+onnx = (
+    ONNXModel()
+    .setModelPayload(model_bytes)
+    .setFeedDict({"x": "features"})
+    .setFetchDict({"embedding": "y"})
+    .setArgMaxDict({"embedding": "label"})
+    .setMiniBatchSize(64)
+)
+feats = rng.normal(size=(100, 8)).astype(np.float32)
+out = onnx.transform(DataFrame({"features": list(feats)}))
+print("embedding shape:", np.stack(list(out["embedding"])).shape)
+print("argmax labels:", np.asarray(out["label"])[:10])

@@ -1,0 +1,43 @@
+#!/usr/bin/env python
+"""Release helper (SURVEY.md §2.8 "tools/"): regenerate the codegen
+surface, run the gate suites, build the wheel, and smoke-import it."""
+
+from __future__ import annotations
+
+import subprocess
+import sys
+import tempfile
+
+
+def run(cmd: list, **kw) -> None:
+    print("+", " ".join(cmd))
+    subprocess.run(cmd, check=True, **kw)
+
+
+def main() -> None:
+    py = sys.executable
+    # 1. regenerate bindings; fail if anything was stale
+    run([py, "-m", "mmlspark_tpu.codegen"])
+    out = subprocess.run(
+        ["git", "diff", "--name-only", "--", "mmlspark_tpu/generated_api.py",
+         "tests/test_codegen_generated.py"],
+        capture_output=True, text=True, check=True,
+    ).stdout.strip()
+    if out:
+        sys.exit(f"codegen output was stale; commit regenerated files:\n{out}")
+    # 2. gate suites (fast subsets; CI runs the full matrix)
+    run([py, "-m", "pytest", "tests/test_codegen.py", "tests/test_core.py",
+         "-q", "-p", "no:cacheprovider"])
+    # 3. wheel + smoke import
+    dist = tempfile.mkdtemp()
+    run([py, "-m", "pip", "wheel", ".", "--no-deps",
+         "--no-build-isolation", "-w", dist])
+    run([py, "-c",
+         "import glob, subprocess, sys; "
+         f"w = glob.glob('{dist}/*.whl')[0]; "
+         "print('built', w)"])
+    print("release checks passed")
+
+
+if __name__ == "__main__":
+    main()
