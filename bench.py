@@ -14,11 +14,12 @@ since no reference numbers are recoverable (SURVEY.md §6, BASELINE.md).
 AUC parity between the two is asserted to ±0.01 so the speed comparison is
 at equal model quality; details go to stderr, never stdout.
 
-Timing protocol: two identical ``train`` calls.  The first includes jit
-compilation (reported separately as ``compile_s`` — amortized in any real
-deployment by the persistent compile cache and by long-lived executors);
-the second is the steady-state train wall-clock, which is the headline
-``value`` compared against the baseline's fit().
+Timing protocol: a cold ``train`` call pays jit compilation (reported
+separately as ``compile_s`` — amortized in any real deployment by the
+persistent compile cache and by long-lived executors); the headline
+``value`` is the BEST of two post-compile runs, since dispatch latency
+through the remote TPU link varies ±25% run to run; the CPU baseline is
+likewise best-of-2, keeping the comparison symmetric.
 """
 
 import json
@@ -81,17 +82,24 @@ def bench_tpu(X, y):
         hist_precision="default",
     )
     ds = Dataset(X, y)
-    # Run 1 pays jit compilation; run 2 is the steady state (see module
-    # docstring for the protocol).
+    # Run 1 pays jit compilation; the steady state is the BEST of two
+    # post-compile runs — dispatch latency through the remote TPU link
+    # varies ±25% run to run, and min-of-k is the standard way to report
+    # the machine's actual capability (the baseline's fit() is likewise
+    # unaffected by the tunnel).
     t0 = time.perf_counter()
     booster = train(params, ds)
     cold = time.perf_counter() - t0
-    t0 = time.perf_counter()
-    booster = train(params, ds)
-    wall = time.perf_counter() - t0
+    steadies = []
+    for _ in range(2):
+        t0 = time.perf_counter()
+        booster = train(params, ds)
+        steadies.append(time.perf_counter() - t0)
+    wall = min(steadies)
     a = auc(y[:100_000], booster.predict(X[:100_000]))
     _log(
-        f"tpu train: cold(incl. compile)={cold:.2f}s steady={wall:.2f}s  "
+        f"tpu train: cold(incl. compile)={cold:.2f}s "
+        f"steady_runs={[round(s, 2) for s in steadies]} best={wall:.2f}s  "
         f"train-AUC(first 100k)={a:.4f}"
     )
     return wall, max(cold - wall, 0.0), a
@@ -100,16 +108,22 @@ def bench_tpu(X, y):
 def bench_cpu_baseline(X, y):
     from sklearn.ensemble import HistGradientBoostingClassifier
 
-    clf = HistGradientBoostingClassifier(
-        max_iter=N_ITER, max_leaf_nodes=NUM_LEAVES, max_bins=MAX_BIN,
-        learning_rate=0.1, min_samples_leaf=20, early_stopping=False,
-        validation_fraction=None,
-    )
-    t0 = time.perf_counter()
-    clf.fit(X, y)
-    wall = time.perf_counter() - t0
+    walls = []
+    for _ in range(2):  # best-of-2, symmetric with the TPU protocol
+        clf = HistGradientBoostingClassifier(
+            max_iter=N_ITER, max_leaf_nodes=NUM_LEAVES, max_bins=MAX_BIN,
+            learning_rate=0.1, min_samples_leaf=20, early_stopping=False,
+            validation_fraction=None,
+        )
+        t0 = time.perf_counter()
+        clf.fit(X, y)
+        walls.append(time.perf_counter() - t0)
+    wall = min(walls)
     a = auc(y[:100_000], clf.predict_proba(X[:100_000])[:, 1])
-    _log(f"cpu baseline (sklearn HistGBDT): {wall:.2f}s  train-AUC={a:.4f}")
+    _log(
+        f"cpu baseline (sklearn HistGBDT): runs={[round(w, 2) for w in walls]} "
+        f"best={wall:.2f}s  train-AUC={a:.4f}"
+    )
     return wall, a
 
 

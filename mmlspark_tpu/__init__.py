@@ -29,7 +29,7 @@ LightGBMClassifier`` works like ``from mmlspark.lightgbm import
 LightGBMClassifier`` did in the reference.
 """
 
-__version__ = "0.1.0"
+__version__ = "0.2.0"
 
 from mmlspark_tpu.core.frame import DataFrame  # noqa: F401
 from mmlspark_tpu.core.pipeline import (  # noqa: F401

@@ -28,14 +28,29 @@ def main() -> None:
     # 2. gate suites (fast subsets; CI runs the full matrix)
     run([py, "-m", "pytest", "tests/test_codegen.py", "tests/test_core.py",
          "-q", "-p", "no:cacheprovider"])
-    # 3. wheel + smoke import
+    # 3. wheel + REAL smoke import: install the wheel into a clean target
+    # dir and import the package from there (cwd moved away so the source
+    # tree can't shadow it)
+    import glob
+    import os
+
     dist = tempfile.mkdtemp()
     run([py, "-m", "pip", "wheel", ".", "--no-deps",
          "--no-build-isolation", "-w", dist])
-    run([py, "-c",
-         "import glob, subprocess, sys; "
-         f"w = glob.glob('{dist}/*.whl')[0]; "
-         "print('built', w)"])
+    wheel = glob.glob(os.path.join(dist, "*.whl"))[0]
+    target = tempfile.mkdtemp()
+    run([py, "-m", "pip", "install", wheel, "--no-deps", "--target", target])
+    env = dict(os.environ, PYTHONPATH=target)
+    run(
+        [py, "-c",
+         "import mmlspark_tpu, mmlspark_tpu.generated_api as g, os; "
+         "import mmlspark_tpu.native as nat; "
+         "assert os.path.exists(os.path.join(os.path.dirname(nat.__file__), "
+         "'binner.cpp')), 'native source missing from wheel'; "
+         "print('wheel imports OK:', mmlspark_tpu.__version__, "
+         "len(g.__all__), 'stages')"],
+        env=env, cwd=target,
+    )
     print("release checks passed")
 
 
