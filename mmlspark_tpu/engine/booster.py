@@ -81,6 +81,11 @@ class TrainConfig:
     tweedie_variance_power: float = 1.5
     early_stopping_round: int = 0
     metric: Optional[str] = None
+    # Record the metric on TRAINING data each iteration under
+    # evals_result["training"] (the reference's isProvideTrainingMetric --
+    # SURVEY.md 2.3.1/5.5; unlike the reference, the values surface on
+    # the booster instead of being trapped in executor logs).
+    is_provide_training_metric: bool = False
     is_unbalance: bool = False
     scale_pos_weight: float = 1.0
     boost_from_average: bool = True
@@ -824,6 +829,13 @@ def train(
             )
         vsets.append({"bins": vb, "scores": jnp.asarray(vscore), "data": vs})
 
+    if cfg.is_provide_training_metric:
+        # The training set joins the eval loop as a LAST pseudo-valid (so
+        # early stopping, which watches names[0], never keys on it).  Its
+        # scores snapshot reuses the sharded padded bins already on device.
+        names.append("training")
+        vsets.append({"bins": bins_dev, "scores": scores, "data": train_set})
+
     predict_v = jax.jit(
         lambda tree, vbins: jax.vmap(lambda t: predict_tree_binned(t, vbins, B))(tree)
     )
@@ -923,10 +935,20 @@ def train(
                     tree, leaf_ids = grow(bins_a, grad, hess, bag, fmask)
                     delta = jax.vmap(lambda lv, li: lv[li])(tree.leaf_value, leaf_ids)
                     scores_c = scores_c + delta
-                    vscores_c = tuple(
-                        vsc + jax.vmap(lambda t: predict_tree_binned(t, vb, B))(tree)
-                        for vsc, vb in zip(vscores_c, vbins_a)
-                    )
+                    nv = len(vbins_a)
+                    new_vs = []
+                    for vi, (vsc, vb) in enumerate(zip(vscores_c, vbins_a)):
+                        if cfg.is_provide_training_metric and vi == nv - 1:
+                            # the training pseudo-valid (always last) IS the
+                            # carry — no second full-data tree replay
+                            new_vs.append(scores_c)
+                        else:
+                            new_vs.append(
+                                vsc + jax.vmap(
+                                    lambda t: predict_tree_binned(t, vb, B)
+                                )(tree)
+                            )
+                    vscores_c = tuple(new_vs)
                     return (scores_c, vscores_c), (tree, vscores_c)
 
                 return jax.lax.scan(body, carry, (keys_c, bag_keys_c))

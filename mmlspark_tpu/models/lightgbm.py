@@ -168,6 +168,7 @@ class _LightGBMParams(
             "is_unbalance": self.getIsUnbalance(),
             "boost_from_average": self.getBoostFromAverage(),
             "early_stopping_round": self.getEarlyStoppingRound(),
+            "is_provide_training_metric": self.getIsProvideTrainingMetric(),
             "verbosity": self.getVerbosity(),
             "seed": self.getSeed(),
             "num_class": num_class,

@@ -47,6 +47,10 @@ def _round_up(x: int, m: int) -> int:
     return ((x + m - 1) // m) * m
 
 
+def _pow2_floor(x: int) -> int:
+    return 1 << (max(x, 1).bit_length() - 1)
+
+
 def _hist_kernel(bins_ref, vals_ref, out_ref, *, num_bins: int, precision):
     """One (feature-block j, row-block i) cell: out[j] += vals·onehotᵀ."""
     i = pl.program_id(1)  # row block (innermost → accumulation is safe)
@@ -124,8 +128,10 @@ def pallas_hist_chunk(
     vals_c = vals_c.astype(jnp.float32)
     # VMEM guard: the kernel's iota/one-hot tiles are (num_bins, bm); the
     # defaults were swept at B=256, so scale bm down for bigger bin counts.
-    bm = min(bm, max(512, _round_up(bm * 256 // num_bins, 8)))
-    bm = min(bm, _round_up(C, 8))
+    # Powers of two / 128-multiples only: Pallas requires 128-aligned
+    # trailing block dims (an 8-aligned guard broke num_bins like 712).
+    bm = min(bm, _pow2_floor(max(512, bm * 256 // num_bins)))
+    bm = min(bm, _round_up(C, 128))
     bf = min(bf, max(8, _round_up(F, 8)))  # don't pad tiny feature counts 4x
     pad_r = (-C) % bm
     pad_f = (-F) % bf
@@ -279,8 +285,11 @@ def pallas_hist_by_leaf_chunk(
     vals_c = vals_c.astype(jnp.float32)
     leaf_row = leaf_c.astype(jnp.int32)[None, :]  # (1, C): lane-friendly
     bf = min(bf, max(8, _round_up(F, 8)))  # don't pad tiny feature counts 4x
-    # VMEM guard: (num_bins, rm) one-hot tiles were swept at B=256.
-    rm = min(rm, max(256, _round_up(rm * 256 // num_bins, 8)))
+    # VMEM guard: (num_bins, rm) one-hot tiles were swept at B=256.  rm
+    # must stay a power of two ≥ 256: pl.ds offsets need 128 alignment and
+    # the in-kernel loop needs rm | bm (an 8-aligned guard silently dropped
+    # rows on the interpret path for num_bins like 304).
+    rm = min(rm, _pow2_floor(max(256, rm * 256 // num_bins)))
     bm = min(bm, _round_up(C, rm))
     rm = min(rm, bm)
     pad_r = (-C) % bm

@@ -1,0 +1,150 @@
+"""Spark boundary: barrier-stage rendezvous derivation + Arrow handoff.
+
+The reference's entire raison d'être is estimators driven from Spark
+partitions (SURVEY.md §3.1, §7.3.4): a barrier-scheduled stage where every
+task reports ``ip:port`` to a driver socket, receives the machine list, and
+calls ``LGBM_NetworkInit``.  The TPU-native translation implemented here:
+
+- task addresses come from ``BarrierTaskContext.getTaskInfos()`` (no driver
+  socket needed — Spark already distributes them);
+- task 0's host is elected coordinator and every task derives a
+  :class:`~mmlspark_tpu.parallel.distributed.BarrierContext` from the SAME
+  list (:func:`barrier_context_from_task_infos` — pure, tested);
+- each task feeds its partition through Arrow, merges rows with a ragged
+  collective allgather, and joins the SPMD ``train``;
+- task 0 returns the model string, exactly where the reference's task 0
+  runs ``LGBM_BoosterSaveModelToString``.
+
+Everything pyspark-specific is import-gated; the derivation/assembly logic
+is pure and unit-tested without Spark.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from mmlspark_tpu.parallel.distributed import (
+    BarrierContext,
+    global_mesh,
+    initialize_distributed,
+)
+
+DEFAULT_COORDINATOR_PORT = 12400  # the reference's defaultListenPort
+
+
+def barrier_context_from_task_infos(
+    addresses: Sequence[str],
+    partition_id: int,
+    coordinator_port: int = DEFAULT_COORDINATOR_PORT,
+) -> BarrierContext:
+    """Task-address list + own partition id → rendezvous context.
+
+    ``addresses`` is ``[info.address for info in
+    BarrierTaskContext.get().getTaskInfos()]`` (``host:port`` or bare
+    host).  Task 0's HOST + ``coordinator_port`` is the coordinator — the
+    moral equivalent of the reference's driver machine-list broadcast
+    (SURVEY.md §3.1), with jax.distributed's own service in place of the
+    driver ServerSocket.
+    """
+    if not addresses:
+        raise ValueError("empty barrier task-address list")
+    if not 0 <= partition_id < len(addresses):
+        raise ValueError(
+            f"partition_id {partition_id} out of range for "
+            f"{len(addresses)} tasks"
+        )
+    host = str(addresses[0]).rsplit(":", 1)[0] or "127.0.0.1"
+    return BarrierContext(
+        coordinator_address=f"{host}:{coordinator_port}",
+        num_processes=len(addresses),
+        process_id=partition_id,
+    )
+
+
+def rows_from_arrow_batches(batches) -> np.ndarray:
+    """Arrow record batches (one partition's worth) → (rows, features+1)
+    float matrix with the label LAST (feeder contract of
+    :func:`barrier_train_task`)."""
+    import pyarrow as pa
+
+    table = pa.Table.from_batches(list(batches))
+    cols = [np.asarray(table.column(i).to_numpy(zero_copy_only=False),
+                       dtype=np.float64) for i in range(table.num_columns)]
+    return np.column_stack(cols)
+
+
+def barrier_train_task(
+    local_rows: np.ndarray,
+    context: BarrierContext,
+    params: dict,
+    timeout_s: int = 1200,
+) -> Optional[str]:
+    """The per-task body for ``rdd.barrier().mapPartitions`` (SURVEY.md
+    §3.1 ``TrainUtils.trainLightGBM`` translated): rendezvous, contribute
+    the local partition to the global row-sharded arrays, run the SPMD
+    training step, and return the model string from process 0 (None
+    elsewhere).
+
+    ``local_rows``: this task's partition as (rows, F+1) with the label in
+    the LAST column (see :func:`rows_from_arrow_batches`).
+    """
+    initialize_distributed(context, timeout_s=timeout_s)
+    mesh = global_mesh()
+
+    from mmlspark_tpu.engine.booster import Dataset, train
+    from mmlspark_tpu.ops.binning import BinMapper
+
+    # Every process materializes the merged rows via ONE collective ragged
+    # allgather of the combined (X|label) matrix (partition sizes may
+    # differ, so counts travel first and padding is sliced back off).
+    # This replaces the reference's "every worker holds its partition in a
+    # native Dataset" with "every process holds the host copy, rows
+    # device-sharded by train()"; once train() ingests pre-sharded global
+    # arrays directly, this allgather can drop away.
+    rows_global = _allgather_ragged_rows(np.ascontiguousarray(local_rows))
+    X_global = rows_global[:, :-1]
+    y_global = np.ascontiguousarray(rows_global[:, -1])
+
+    # Shared binning (SURVEY.md §7.4.3): one mapper fit on the merged rows
+    # — deterministic, so every process computes identical thresholds.
+    bm = BinMapper(
+        max_bin=int(params.get("max_bin", 255)),
+        categorical_features=tuple(params.get("categorical_feature", ())),
+        seed=int(params.get("seed", 0)),
+    ).fit(X_global)
+    booster = train(params, Dataset(X_global, y_global), bin_mapper=bm, mesh=mesh)
+    if context.process_id == 0:
+        return booster.save_model_string()
+    return None
+
+
+def _allgather_ragged_rows(arr: np.ndarray) -> np.ndarray:
+    """Concatenate every process's rows (differing counts allowed)."""
+    from jax.experimental import multihost_utils as mhu
+
+    counts = np.asarray(mhu.process_allgather(np.asarray([len(arr)])))
+    counts = counts.reshape(-1)
+    m = int(counts.max())
+    padded = np.zeros((m,) + arr.shape[1:], arr.dtype)
+    padded[: len(arr)] = arr
+    gathered = np.asarray(mhu.process_allgather(padded))  # (nproc, m, ...)
+    return np.concatenate(
+        [gathered[i, : counts[i]] for i in range(len(counts))], axis=0
+    )
+
+
+def fit_on_spark(estimator, sdf, num_tasks: Optional[int] = None):
+    """Driver-side convenience: fit one of our estimators on a pyspark
+    DataFrame via the Arrow boundary (single-controller path)."""
+    from mmlspark_tpu.core.frame import DataFrame
+
+    collect_arrow = getattr(sdf, "_collect_as_arrow", None)
+    if collect_arrow is not None:
+        df = DataFrame.from_arrow(collect_arrow())
+    else:  # very old pyspark: fall back through pandas
+        df = DataFrame(sdf.toPandas(), num_partitions=sdf.rdd.getNumPartitions())
+    if num_tasks is not None and hasattr(estimator, "setNumTasks"):
+        estimator.setNumTasks(num_tasks)
+    return estimator.fit(df)

@@ -336,6 +336,42 @@ class TestBoosterQuality:
         assert imp.sum() > 0 and imp.shape == (X.shape[1],)
 
 
+class TestTrainingMetric:
+    def test_is_provide_training_metric_records_per_iteration(self):
+        rng = np.random.default_rng(0)
+        X = rng.normal(size=(300, 4))
+        y = (X[:, 0] > 0).astype(np.float64)
+        from mmlspark_tpu.engine.booster import Dataset, train
+
+        b = train(
+            dict(objective="binary", num_iterations=5, num_leaves=7,
+                 min_data_in_leaf=5, metric="binary_logloss",
+                 is_provide_training_metric=True),
+            Dataset(X[:200], y[:200]), valid_sets=[Dataset(X[200:], y[200:])],
+        )
+        assert "training" in b.evals_result and "valid_0" in b.evals_result
+        tr = b.evals_result["training"]["binary_logloss"]
+        assert len(tr) == 5
+        assert tr[-1] < tr[0]  # training loss decreases
+
+    def test_training_metric_never_drives_early_stopping(self):
+        rng = np.random.default_rng(1)
+        X = rng.normal(size=(300, 4))
+        y = (X[:, 0] > 0).astype(np.float64)
+        from mmlspark_tpu.engine.booster import Dataset, train
+
+        b = train(
+            dict(objective="binary", num_iterations=30, num_leaves=7,
+                 min_data_in_leaf=5, early_stopping_round=3,
+                 is_provide_training_metric=True),
+            Dataset(X[:200], y[:200]), valid_sets=[Dataset(X[200:], y[200:])],
+        )
+        # early stopping keyed to valid_0 (training loss keeps improving,
+        # so stopping at all proves it watched the validation metric)
+        assert b.best_iteration >= 0
+        assert len(b.evals_result["training"]["binary_logloss"]) == b.num_iterations
+
+
 class TestWarmStartAndGuards:
     def test_init_model_continued_training(self):
         from mmlspark_tpu.engine.booster import Dataset, train

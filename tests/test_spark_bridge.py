@@ -1,0 +1,112 @@
+"""Spark-boundary bridge tests (SURVEY.md §3.1/§7.3.4) — the pure
+derivation/assembly logic without Spark, plus the barrier task body run as
+TWO REAL PROCESSES rendezvousing exactly as barrier tasks would."""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+import textwrap
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu.parallel.distributed import BarrierContext
+from mmlspark_tpu.spark_bridge import (
+    barrier_context_from_task_infos,
+    rows_from_arrow_batches,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+class TestBarrierDerivation:
+    def test_task0_host_is_coordinator(self):
+        ctx = barrier_context_from_task_infos(
+            ["10.0.0.5:33221", "10.0.0.6:41200", "10.0.0.7:40001"], 1
+        )
+        assert ctx == BarrierContext("10.0.0.5:12400", 3, 1)
+
+    def test_bare_hosts_and_custom_port(self):
+        ctx = barrier_context_from_task_infos(["hostA", "hostB"], 0,
+                                              coordinator_port=9999)
+        assert ctx.coordinator_address == "hostA:9999"
+        assert ctx.num_processes == 2 and ctx.process_id == 0
+
+    def test_invalid_inputs(self):
+        with pytest.raises(ValueError, match="empty"):
+            barrier_context_from_task_infos([], 0)
+        with pytest.raises(ValueError, match="out of range"):
+            barrier_context_from_task_infos(["h"], 3)
+
+
+class TestArrowFeeder:
+    def test_rows_from_arrow_batches(self):
+        import pyarrow as pa
+
+        b = pa.RecordBatch.from_pydict({
+            "f0": [1.0, 2.0], "f1": [3.0, 4.0], "label": [0.0, 1.0],
+        })
+        rows = rows_from_arrow_batches([b])
+        np.testing.assert_array_equal(rows, [[1, 3, 0], [2, 4, 1]])
+
+
+_WORKER = textwrap.dedent(
+    """
+    import json, sys
+    sys.path.insert(0, {repo!r})
+    import numpy as np
+    from mmlspark_tpu.spark_bridge import (
+        barrier_context_from_task_infos, barrier_train_task,
+    )
+
+    pid = int(sys.argv[1]); port = sys.argv[2]
+    # the "task info" list every barrier task sees
+    addresses = [f"127.0.0.1:{{port}}", "127.0.0.1:0"]
+    ctx = barrier_context_from_task_infos(addresses, pid,
+                                          coordinator_port=int(port))
+    rng = np.random.default_rng(pid)
+    X = rng.normal(size=(60, 3))
+    y = (X[:, 0] > 0).astype(np.float64)
+    rows = np.column_stack([X, y])
+    model_str = barrier_train_task(
+        rows, ctx,
+        dict(objective="binary", num_iterations=3, num_leaves=7,
+             min_data_in_leaf=2, tree_learner="data"),
+        timeout_s=60,
+    )
+    print(json.dumps({{"pid": pid, "has_model": model_str is not None,
+                       "model_head": (model_str or "")[:9]}}))
+    """
+)
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def test_barrier_train_task_two_processes(tmp_path):
+    port = _free_port()
+    script = tmp_path / "task.py"
+    script.write_text(_WORKER.format(repo=REPO))
+    env = {"PATH": "/usr/bin:/bin:/usr/local/bin", "HOME": "/root",
+           "JAX_PLATFORMS": "cpu", "PYTHONDONTWRITEBYTECODE": "1"}
+    procs = [
+        subprocess.Popen([sys.executable, str(script), str(pid), str(port)],
+                         stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+                         text=True, env=env)
+        for pid in range(2)
+    ]
+    results = []
+    for p in procs:
+        out, err = p.communicate(timeout=240)
+        assert p.returncode == 0, f"task failed:\n{err[-2000:]}"
+        results.append(json.loads(out.strip().splitlines()[-1]))
+    by_pid = {r["pid"]: r for r in results}
+    # task 0 returns the model string (the reference's task-0 gather), the
+    # other task returns None
+    assert by_pid[0]["has_model"] and by_pid[0]["model_head"] == "tree\nvers"
+    assert not by_pid[1]["has_model"]
