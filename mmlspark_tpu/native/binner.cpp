@@ -118,22 +118,41 @@ void mml_binner_fit(const double* Xs, long n, long F, int max_bin,
 // first bin whose (inclusive) upper bound is >= value — numpy
 // searchsorted(side="left") semantics; NaN → missing_bin.  Features with
 // counts[f] == 0 are left untouched (caller fills them).
+//
+// The search is a BRANCHLESS fixed-depth binary search over boundaries
+// padded to a power of two with +inf: every value takes the identical
+// log2(P) iterations with a conditional-move step instead of
+// std::lower_bound's unpredictable branch — ~2x on the 16M-value
+// transform that dominates train() fixed overhead on the single-core
+// host.
 void mml_binner_transform(const double* X, long n, long F,
                           const double* uppers, const int* counts,
                           int max_bin, int missing_bin, uint8_t* out,
                           int n_threads) {
   parallel_over(F, n_threads, [&](long f0, long f1) {
+    std::vector<double> padded;
     for (long f = f0; f < f1; ++f) {
       const int m = counts[f];
       if (m == 0) continue;
       const double* ub = uppers + f * max_bin;
+      // pad boundaries to the next power of two with +inf
+      long P = 1;
+      while (P < m) P <<= 1;
+      padded.assign(static_cast<size_t>(P),
+                    std::numeric_limits<double>::infinity());
+      std::copy(ub, ub + m, padded.begin());
+      const double* pb = padded.data();
       for (long i = 0; i < n; ++i) {
         const double x = X[i * F + f];
         if (std::isnan(x)) {
           out[i * F + f] = static_cast<uint8_t>(missing_bin);
           continue;
         }
-        const long j = std::lower_bound(ub, ub + m, x) - ub;
+        long j = 0;
+        for (long step = P >> 1; step > 0; step >>= 1) {
+          // first index with pb[idx] >= x (searchsorted "left")
+          j += (pb[j + step - 1] < x) ? step : 0;
+        }
         out[i * F + f] = static_cast<uint8_t>(j < m ? j : m - 1);
       }
     }
