@@ -382,8 +382,8 @@ def _plus_one(v):
 # Stages whose transform needs a live endpoint or a model payload; the
 # persistence fuzz runs here, the live path is covered by the named suite.
 PERSIST_ONLY = {
-    "HTTPTransformer": "tests/test_stages_featurize_train.py (serving)",
-    "SimpleHTTPTransformer": "tests/test_stages_featurize_train.py",
+    "HTTPTransformer": "tests/test_http_transformers.py",
+    "SimpleHTTPTransformer": "tests/test_http_transformers.py",
     "TextSentiment": "tests/test_cognitive.py",
     "KeyPhraseExtractor": "tests/test_cognitive.py",
     "NER": "tests/test_cognitive.py",
@@ -401,7 +401,7 @@ PERSIST_ONLY = {
     "ONNXModel": "tests/test_onnx.py",
     "CNTKModel": "tests/test_onnx.py",
     "ImageFeaturizer": "tests/test_automl_image.py",
-    "ImageLIME": "tests/test_automl_image.py",
+    "ImageLIME": "tests/test_http_transformers.py (functional LIME)",
 }
 
 # Model classes: covered by their estimator's fixture (the fitted model is

@@ -1,0 +1,136 @@
+"""HTTPTransformer / SimpleHTTPTransformer against a live local server
+(SURVEY.md §2.6 / §4.5: the reference spins real local HttpServers and hits
+them through the transformers), plus an ImageLIME functional test — filling
+the last PERSIST_ONLY rows of the fuzzing table with real transform
+coverage."""
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, HTTPServer
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu.core.frame import DataFrame
+from mmlspark_tpu.io.http.http_schema import HTTPRequestData
+from mmlspark_tpu.io.http.http_transformer import (
+    HTTPTransformer,
+    SimpleHTTPTransformer,
+)
+
+
+class _EchoHandler(BaseHTTPRequestHandler):
+    def log_message(self, *a):
+        pass
+
+    def do_POST(self):
+        body = json.loads(self.rfile.read(int(self.headers["Content-Length"])))
+        if body.get("fail"):
+            self.send_response(503)
+            self.end_headers()
+            return
+        out = json.dumps({"doubled": body["x"] * 2}).encode()
+        self.send_response(200)
+        self.send_header("Content-Length", str(len(out)))
+        self.end_headers()
+        self.wfile.write(out)
+
+
+@pytest.fixture(scope="module")
+def echo():
+    srv = HTTPServer(("127.0.0.1", 0), _EchoHandler)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    yield f"http://127.0.0.1:{srv.server_address[1]}/"
+    srv.shutdown()
+    srv.server_close()
+
+
+class TestHTTPTransformer:
+    def test_request_column_to_response_column(self, echo):
+        reqs = [
+            HTTPRequestData(
+                url=echo, method="POST",
+                headers={"Content-Type": "application/json"},
+                entity=json.dumps({"x": v}).encode(),
+            ).to_row()
+            for v in (1, 2, 3)
+        ]
+        out = (
+            HTTPTransformer(inputCol="req", outputCol="resp", concurrency=3)
+            .transform(DataFrame({"req": reqs}))
+        )
+        vals = [
+            json.loads(r["entity"]["content"].decode())["doubled"]
+            for r in out["resp"]
+        ]
+        assert vals == [2, 4, 6]
+        codes = [r["statusLine"]["statusCode"] for r in out["resp"]]
+        assert codes == [200, 200, 200]
+
+    def test_5xx_surfaces_after_retries(self, echo):
+        req = HTTPRequestData(
+            url=echo, method="POST",
+            headers={"Content-Type": "application/json"},
+            entity=json.dumps({"x": 1, "fail": True}).encode(),
+        ).to_row()
+        out = (
+            HTTPTransformer(inputCol="req", outputCol="resp",
+                            backoffs=[1, 1])  # fast retries
+            .transform(DataFrame({"req": [req]}))
+        )
+        assert out["resp"][0]["statusLine"]["statusCode"] == 503
+
+
+class TestSimpleHTTPTransformer:
+    def test_json_in_json_out_with_error_col(self, echo, monkeypatch):
+        import mmlspark_tpu.io.http.http_transformer as ht
+
+        # fast retries: SimpleHTTPTransformer has no backoffs knob, so the
+        # 503 row would otherwise sleep through the real backoff schedule
+        monkeypatch.setattr(ht, "DEFAULT_BACKOFFS_MS", (1, 1))
+        df = DataFrame({"payload": [{"x": 5}, {"x": 7, "fail": True}]})
+        out = (
+            SimpleHTTPTransformer(
+                inputCol="payload", outputCol="parsed", url=echo,
+                errorCol="errs", concurrency=2,
+            ).transform(df)
+        )
+        assert out["parsed"][0] == {"doubled": 10}
+        assert out["errs"][0] is None
+        assert out["parsed"][1] is None
+        assert out["errs"][1]["statusCode"] == 503
+
+
+class TestImageLIMEFunctional:
+    def test_superpixel_weights_highlight_signal_region(self):
+        from mmlspark_tpu.explain.lime import ImageLIME
+        from mmlspark_tpu.ops.image_ops import make_image_row
+
+        rng = np.random.default_rng(0)
+
+        class BrightTopLeft:
+            """Inner 'model': scores the mean intensity of the top-left
+            quadrant — LIME should weight top-left superpixels highest."""
+
+            def transform(self, df):
+                scores = []
+                for row in df["image"]:
+                    arr = np.asarray(row["data"], dtype=np.float64).reshape(
+                        row["height"], row["width"], row["nChannels"]
+                    )
+                    scores.append(float(arr[:8, :8].mean()))
+                return df.withColumn("prediction", scores)
+
+        img = np.zeros((16, 16, 3), np.uint8)
+        img[:8, :8] = 255  # bright top-left quadrant
+        df = DataFrame({"image": [make_image_row(img)]})
+        lime = ImageLIME(
+            model=BrightTopLeft(), inputCol="image",
+            predictionCol="prediction", nSamples=64, cellSize=8, seed=0,
+        )
+        out = lime.transform(df)
+        weights = np.asarray(out[lime.getOutputCol()][0], dtype=np.float64)
+        # the superpixel covering the bright quadrant must carry the top
+        # weight
+        assert weights.argmax() == 0, weights
+        assert weights[0] > 0
