@@ -10,6 +10,13 @@ from mmlspark_tpu.cognitive.anomaly import (
     DetectLastAnomaly,
 )
 from mmlspark_tpu.cognitive.base import CognitiveServicesBase
+from mmlspark_tpu.cognitive.face import (
+    FindSimilarFace,
+    GroupFaces,
+    IdentifyFaces,
+    VerifyFaces,
+)
+from mmlspark_tpu.cognitive.speech import SpeechToText
 from mmlspark_tpu.cognitive.text import (
     NER,
     EntityDetector,
@@ -31,5 +38,7 @@ __all__ = [
     "TextSentiment", "KeyPhraseExtractor", "NER", "EntityDetector",
     "LanguageDetector", "Translate",
     "AnalyzeImage", "OCR", "DescribeImage", "TagImage", "DetectFace",
+    "IdentifyFaces", "VerifyFaces", "GroupFaces", "FindSimilarFace",
+    "SpeechToText",
     "DetectLastAnomaly", "DetectEntireSeries", "BingImageSearch",
 ]

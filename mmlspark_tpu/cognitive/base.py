@@ -68,6 +68,9 @@ class CognitiveServicesBase(Transformer, HasOutputCol, HasServiceParams):
     _URL_PATH = ""
     _DEFAULT_DOMAIN = "api.cognitive.microsoft.com"
     _METHOD = "POST"
+    # Content-Type stamped on raw-bytes bodies; services with typed binary
+    # payloads (e.g. SpeechToText's audio/wav) override this.
+    _BYTES_CONTENT_TYPE = "application/octet-stream"
 
     def setLocation(self, value: str) -> "CognitiveServicesBase":
         self._paramMap["location"] = value
@@ -114,7 +117,7 @@ class CognitiveServicesBase(Transformer, HasOutputCol, HasServiceParams):
                 entity = None  # body only gates the row (None → skip)
             elif isinstance(body, bytes):
                 entity = body
-                headers["Content-Type"] = "application/octet-stream"
+                headers["Content-Type"] = self._BYTES_CONTENT_TYPE
             else:
                 entity = json.dumps(body).encode()
                 headers["Content-Type"] = "application/json"

@@ -1,0 +1,172 @@
+"""Face-identity transformers (SURVEY.md §2.6;
+UPSTREAM:.../cognitive/Face.scala: IdentifyFaces, VerifyFaces, GroupFaces,
+FindSimilarFace over the ``/face/v1.0`` JSON API — DetectFace lives in
+:mod:`mmlspark_tpu.cognitive.vision` with the image-input transformers).
+
+All four take face IDs produced by DetectFace and post small JSON bodies;
+the value-or-column duality and key/concurrency/error handling come from
+:class:`CognitiveServicesBase`.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+from mmlspark_tpu.cognitive.base import CognitiveServicesBase, is_missing
+from mmlspark_tpu.core.frame import DataFrame
+from mmlspark_tpu.core.params import ServiceParam
+from mmlspark_tpu.core.registry import register_stage
+
+
+def _as_id_list(v):
+    """A faceIds cell may be a list/ndarray of IDs or a comma-joined string."""
+    if isinstance(v, str):
+        return [s for s in (p.strip() for p in v.split(",")) if s]
+    return [str(x) for x in v]
+
+
+class _FaceBase(CognitiveServicesBase):
+    _VECTOR_PARAMS: tuple = ()
+
+    def _prepare(self, df: DataFrame) -> Dict[str, Any]:
+        n = df.count()
+        return {
+            name: self.getVectorParam(df, name) or [None] * n
+            for name in self._VECTOR_PARAMS
+        }
+
+
+@register_stage
+class IdentifyFaces(_FaceBase):
+    """1-to-many identification against a (large) person group
+    (``IdentifyFaces``)."""
+
+    _URL_PATH = "/face/v1.0/identify"
+
+    faceIds = ServiceParam("faceIds", "Face IDs to identify (list or csv)")
+    personGroupId = ServiceParam("personGroupId", "Target person group")
+    largePersonGroupId = ServiceParam(
+        "largePersonGroupId", "Target large person group (excludes personGroupId)"
+    )
+    maxNumOfCandidatesReturned = ServiceParam(
+        "maxNumOfCandidatesReturned", "Candidates per face", default={"value": 1}
+    )
+    confidenceThreshold = ServiceParam(
+        "confidenceThreshold", "Identification confidence threshold"
+    )
+    _VECTOR_PARAMS = (
+        "faceIds", "personGroupId", "largePersonGroupId",
+        "maxNumOfCandidatesReturned", "confidenceThreshold",
+    )
+
+    def _row_body(self, ctx, i):
+        ids = ctx["faceIds"][i]
+        if is_missing(ids):
+            return None
+        body = {"faceIds": _as_id_list(ids)}
+        pg, lpg = ctx["personGroupId"][i], ctx["largePersonGroupId"][i]
+        if not is_missing(pg) and pg:
+            body["personGroupId"] = str(pg)
+        if not is_missing(lpg) and lpg:
+            body["largePersonGroupId"] = str(lpg)
+        mc = ctx["maxNumOfCandidatesReturned"][i]
+        if not is_missing(mc):
+            body["maxNumOfCandidatesReturned"] = int(mc)
+        ct = ctx["confidenceThreshold"][i]
+        if not is_missing(ct):
+            body["confidenceThreshold"] = float(ct)
+        return body
+
+
+@register_stage
+class VerifyFaces(_FaceBase):
+    """Face-to-face or face-to-person verification (``VerifyFaces``)."""
+
+    _URL_PATH = "/face/v1.0/verify"
+
+    faceId1 = ServiceParam("faceId1", "First face ID (face-to-face mode)")
+    faceId2 = ServiceParam("faceId2", "Second face ID (face-to-face mode)")
+    faceId = ServiceParam("faceId", "Face ID (face-to-person mode)")
+    personGroupId = ServiceParam("personGroupId", "Person group (face-to-person)")
+    largePersonGroupId = ServiceParam(
+        "largePersonGroupId", "Large person group (face-to-person)"
+    )
+    personId = ServiceParam("personId", "Person ID (face-to-person)")
+    _VECTOR_PARAMS = (
+        "faceId1", "faceId2", "faceId", "personGroupId", "largePersonGroupId",
+        "personId",
+    )
+
+    def _row_body(self, ctx, i):
+        f1, f2 = ctx["faceId1"][i], ctx["faceId2"][i]
+        if not is_missing(f1) and not is_missing(f2):
+            return {"faceId1": str(f1), "faceId2": str(f2)}
+        f, p = ctx["faceId"][i], ctx["personId"][i]
+        if is_missing(f) or is_missing(p):
+            return None
+        body = {"faceId": str(f), "personId": str(p)}
+        pg, lpg = ctx["personGroupId"][i], ctx["largePersonGroupId"][i]
+        if not is_missing(pg) and pg:
+            body["personGroupId"] = str(pg)
+        if not is_missing(lpg) and lpg:
+            body["largePersonGroupId"] = str(lpg)
+        return body
+
+
+@register_stage
+class GroupFaces(_FaceBase):
+    """Cluster face IDs into similarity groups (``GroupFaces``)."""
+
+    _URL_PATH = "/face/v1.0/group"
+
+    faceIds = ServiceParam("faceIds", "Face IDs to group (list or csv)")
+    _VECTOR_PARAMS = ("faceIds",)
+
+    def _row_body(self, ctx, i):
+        ids = ctx["faceIds"][i]
+        return None if is_missing(ids) else {"faceIds": _as_id_list(ids)}
+
+
+@register_stage
+class FindSimilarFace(_FaceBase):
+    """Similar-face search against a face list or explicit IDs
+    (``FindSimilarFace``)."""
+
+    _URL_PATH = "/face/v1.0/findsimilars"
+
+    faceId = ServiceParam("faceId", "Query face ID")
+    faceListId = ServiceParam("faceListId", "Face list to search")
+    largeFaceListId = ServiceParam("largeFaceListId", "Large face list to search")
+    faceIds = ServiceParam("faceIds", "Candidate face IDs (list or csv)")
+    maxNumOfCandidatesReturned = ServiceParam(
+        "maxNumOfCandidatesReturned", "Max matches returned", default={"value": 20}
+    )
+    mode = ServiceParam(
+        "mode", "matchPerson | matchFace", default={"value": "matchPerson"}
+    )
+    _VECTOR_PARAMS = (
+        "faceId", "faceListId", "largeFaceListId", "faceIds",
+        "maxNumOfCandidatesReturned", "mode",
+    )
+
+    def _row_body(self, ctx, i):
+        f = ctx["faceId"][i]
+        if is_missing(f):
+            return None
+        body = {"faceId": str(f)}
+        fl, lfl, ids = (
+            ctx["faceListId"][i], ctx["largeFaceListId"][i], ctx["faceIds"][i]
+        )
+        if not is_missing(fl) and fl:
+            body["faceListId"] = str(fl)
+        elif not is_missing(lfl) and lfl:
+            body["largeFaceListId"] = str(lfl)
+        elif not is_missing(ids):
+            body["faceIds"] = _as_id_list(ids)
+        mc = ctx["maxNumOfCandidatesReturned"][i]
+        if not is_missing(mc):
+            body["maxNumOfCandidatesReturned"] = int(mc)
+        m = ctx["mode"][i]
+        if not is_missing(m) and m:
+            body["mode"] = str(m)
+        return body

@@ -1,0 +1,68 @@
+"""Speech-service transformers (SURVEY.md §2.6;
+UPSTREAM:.../cognitive/SpeechToText.scala).
+
+The reference's ``SpeechToText`` posts raw audio bytes to the regional
+speech endpoint (``<location>.stt.speech.microsoft.com``) with language /
+format / profanity query params and parses the recognition JSON.  Same
+contract here over :class:`CognitiveServicesBase` — the audio codec handling
+stays client-side (the service accepts WAV/OGG bytes as-is), so no native
+audio stack is needed for parity.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+from mmlspark_tpu.cognitive.base import CognitiveServicesBase, is_missing
+from mmlspark_tpu.core.frame import DataFrame
+from mmlspark_tpu.core.params import ServiceParam
+from mmlspark_tpu.core.registry import register_stage
+
+
+@register_stage
+class SpeechToText(CognitiveServicesBase):
+    """Short-audio speech recognition (``SpeechToText``).
+
+    ``audioData`` carries the raw WAV/OGG bytes (value or column);
+    ``language``/``format``/``profanity`` map to the service query params.
+    """
+
+    _URL_PATH = "/speech/recognition/conversation/cognitiveservices/v1"
+    _DEFAULT_DOMAIN = "stt.speech.microsoft.com"
+    # The STT endpoint rejects generic octet-stream bodies; the reference
+    # sends the WAV/PCM audio content type.
+    _BYTES_CONTENT_TYPE = "audio/wav; codecs=audio/pcm; samplerate=16000"
+
+    audioData = ServiceParam("audioData", "Raw audio bytes (value or column)")
+    language = ServiceParam(
+        "language", "Recognition language", default={"value": "en-US"}
+    )
+    format = ServiceParam(
+        "format", "simple | detailed output", default={"value": "simple"}
+    )
+    profanity = ServiceParam(
+        "profanity", "masked | removed | raw", default={"value": "masked"}
+    )
+
+    def _prepare(self, df: DataFrame) -> Dict[str, Any]:
+        n = df.count()
+        return {
+            "audio": self.getVectorParam(df, "audioData") or [None] * n,
+            "language": self.getVectorParam(df, "language") or ["en-US"] * n,
+            "format": self.getVectorParam(df, "format") or ["simple"] * n,
+            "profanity": self.getVectorParam(df, "profanity") or ["masked"] * n,
+        }
+
+    def _row_query(self, ctx, i):
+        lang = ctx["language"][i]
+        fmt = ctx["format"][i]
+        prof = ctx["profanity"][i]
+        return {
+            "language": "en-US" if is_missing(lang) else str(lang),
+            "format": "simple" if is_missing(fmt) else str(fmt),
+            "profanity": "masked" if is_missing(prof) else str(prof),
+        }
+
+    def _row_body(self, ctx, i):
+        a = ctx["audio"][i]
+        return None if is_missing(a) else bytes(a)

@@ -96,6 +96,10 @@ class TrainConfig:
     tree_learner: str = "serial"
     top_k: int = 20
     grow_policy: str = "lossguide"  # lossguide (LightGBM-exact) | depthwise
+    # >0: apply at most this many best-first splits per histogram pass
+    # (k-batched growth; 1 = LightGBM-exact lossguide via the windowed
+    # grower, ~num_leaves/2 ≈ depthwise).  0 keeps the policy's default.
+    split_batch: int = 0
     hist_backend: str = "scatter"
     hist_chunk: int = DEFAULT_CHUNK
     hist_precision: str = "highest"  # highest (f32) | default (bf16 multiply)
@@ -174,7 +178,14 @@ class TrainConfig:
 class Dataset:
     """Training data container (the moral analog of LightGBM's ``Dataset``
     built per executor task from partition rows — SURVEY.md §3.1
-    ``generateDataset``)."""
+    ``generateDataset``).
+
+    Like LightGBM's Dataset — which quantizes features ONCE at construction
+    and reuses the binned matrix across every subsequent training call —
+    this container caches the fitted :class:`BinMapper` (per bin-config) and
+    the binned matrix (per mapper), so repeated ``train()`` calls on the
+    same Dataset skip the host binning pass entirely.
+    """
 
     def __init__(
         self,
@@ -192,6 +203,56 @@ class Dataset:
             None if init_score is None else np.asarray(init_score, dtype=np.float64)
         )
         self.num_rows, self.num_features = self.X.shape
+        self._mapper_cache: Dict[Tuple, BinMapper] = {}
+        self._bins_cache: Dict[int, np.ndarray] = {}
+        self._dev_bins_cache: Dict[Tuple, object] = {}  # padded device copies
+        self._cache_refs: List[BinMapper] = []  # pin ids used as cache keys
+
+    def __getstate__(self):
+        # No cache enters a pickle (Datasets ride inside pickled estimator
+        # params in AutoML flows): device arrays don't serialize, binned
+        # matrices would bloat the payload, and _bins_cache keys are id()s
+        # that a new process would recycle onto unrelated mappers.
+        state = dict(self.__dict__)
+        state["_mapper_cache"] = {}
+        state["_bins_cache"] = {}
+        state["_dev_bins_cache"] = {}
+        state["_cache_refs"] = []
+        return state
+
+    def fitted_mapper(self, cfg: "TrainConfig") -> BinMapper:
+        """The BinMapper for this dataset under ``cfg``'s binning params,
+        fit on first use (LightGBM bins at Dataset construction; lazy here
+        so ``bin_mapper``-supplying callers never pay it)."""
+        # num_threads is host parallelism only — the fitted thresholds are
+        # deterministic in the input, so it must not key (or evict) the cache.
+        key = (cfg.max_bin, tuple(cfg.categorical_feature), cfg.seed)
+        bm = self._mapper_cache.get(key)
+        if bm is None:
+            bm = BinMapper(
+                max_bin=cfg.max_bin,
+                categorical_features=tuple(cfg.categorical_feature),
+                seed=cfg.seed,
+                threads=cfg.num_threads,
+            ).fit(self.X)
+            self._mapper_cache = {key: bm}  # size-1: sweeps must not pin all
+        return bm
+
+    def binned(self, bin_mapper: BinMapper) -> np.ndarray:
+        """This dataset's rows under ``bin_mapper``, cached for the MOST
+        RECENT mapper instance (mappers are fit-once/immutable by
+        contract).  Size-1 on purpose: each entry is a full n×F matrix, and
+        a hyperparameter sweep over binning configs must not pin one copy
+        per config (the common case — many train() calls, one mapper —
+        still always hits)."""
+        key = id(bin_mapper)
+        bins = self._bins_cache.get(key)
+        if bins is None:
+            bins = bin_mapper.transform(self.X)
+            self._bins_cache = {key: bins}
+            self._dev_bins_cache = {}
+            self._cache_refs = [bin_mapper]  # keep id() stable while cached
+        return bins
 
 
 def _pad_rows(arr: np.ndarray, n_pad: int, value=0):
@@ -495,6 +556,7 @@ def train(
     bin_mapper: Optional[BinMapper] = None,
     init_model: Optional[Booster] = None,
     mesh=None,
+    process_local: bool = False,
 ) -> Booster:
     """Training entry — single-device or data-parallel over a device mesh.
 
@@ -505,6 +567,18 @@ def train(
     replacement for the reference's ``LGBM_NetworkInit`` + socket histogram
     allreduce (SURVEY.md §3.1, §5.8 N2).  Every shard then computes an
     identical best split, exactly LightGBM's ``tree_learner=data`` semantics.
+
+    ``process_local=True`` is the MULTI-CONTROLLER ingestion contract
+    (SURVEY.md §3.1 ``generateDataset``, §7.3.4): ``train_set`` holds ONLY
+    this process's partition rows — exactly as the reference's per-task
+    native Dataset holds only the partition — and the global row-sharded
+    arrays are assembled with ``jax.make_array_from_process_local_data``,
+    so no process ever materializes another's rows.  Label statistics that
+    the serial path reads from the full label vector (boost_from_average
+    seed, is_unbalance pos/neg) come from tiny summed-stat allgathers; pass
+    a ``bin_mapper`` fit by :func:`mmlspark_tpu.ops.binning.distributed_fit`
+    so thresholds agree across processes.  Every process must call train()
+    collectively (SPMD) and receives the identical replicated Booster.
     """
     import warnings
 
@@ -545,7 +619,15 @@ def train(
     # alongside for interop/inspection.  dart cannot warm-start (drop
     # bookkeeping) and rf cannot continue (averaged output), so neither
     # checkpoints.
+    #
+    # TRUST MODEL: checkpoint_dir must be as trusted as the code itself —
+    # ``pickle.load`` executes whatever the file says (same stance as
+    # torch.load or the reference's JVM deserialization).  Point it at a
+    # per-job private directory, never a shared/world-writable one; for an
+    # interchange-safe artifact use the mirrored model.txt +
+    # BinMapper.to_dict(), which are data-only.
     ckpt_path = ckpt_txt = None
+    requested_total = cfg.num_iterations
     if (
         cfg.checkpoint_dir
         and cfg.checkpoint_every > 0
@@ -577,6 +659,12 @@ def train(
                     config=cfg,
                     best_iteration=bi if 0 <= bi < T else -1,
                 )
+            if getattr(init_model, "_ckpt_completed_for", -1) >= cfg.num_iterations:
+                # The prior run FINISHED this request (early stopping just
+                # truncated the forest below num_iterations).  Rerunning
+                # must be stable: return the completed snapshot as-is
+                # instead of training past the recorded stopping point.
+                return init_model
             cfg = dataclasses.replace(cfg, num_iterations=cfg.num_iterations - done)
 
     # ---- warm start (continued training; the reference's `modelString`
@@ -605,7 +693,7 @@ def train(
         bin_mapper = init_model.bin_mapper
 
     # ---- mesh (data-parallel tree learner) -----------------------------
-    if mesh is None and cfg.tree_learner in _PARALLEL_LEARNERS:
+    if mesh is None and (process_local or cfg.tree_learner in _PARALLEL_LEARNERS):
         from mmlspark_tpu.parallel.mesh import default_mesh
 
         mesh = default_mesh()
@@ -613,15 +701,28 @@ def train(
 
     D = mesh_num_devices(mesh)
 
-    # ---- binning -------------------------------------------------------
+    if process_local:
+        # v1 contract: metric evaluation pulls per-iteration score
+        # snapshots to every host, which a process-local run cannot do
+        # (the snapshots are row-sharded across processes); ranking groups
+        # would span process boundaries.
+        if valid_sets or cfg.is_provide_training_metric:
+            raise NotImplementedError(
+                "process_local training does not support valid_sets / "
+                "is_provide_training_metric; evaluate on a held-out set "
+                "after training"
+            )
+        if isinstance(obj, LambdaRank):
+            raise NotImplementedError(
+                "process_local training does not support lambdarank "
+                "(query groups span process boundaries)"
+            )
+
+    # ---- binning (cached on the Dataset — LightGBM bins at Dataset
+    # construction and reuses across training calls) --------------------
     if bin_mapper is None:
-        bin_mapper = BinMapper(
-            max_bin=cfg.max_bin,
-            categorical_features=tuple(cfg.categorical_feature),
-            seed=cfg.seed,
-            threads=cfg.num_threads,
-        ).fit(train_set.X)
-    bins_np = bin_mapper.transform(train_set.X)
+        bin_mapper = train_set.fitted_mapper(cfg)
+    bins_np = train_set.binned(bin_mapper)
     n, F = bins_np.shape
     B = bin_mapper.num_bins
 
@@ -629,10 +730,23 @@ def train(
     # Each of the D shards holds n_local rows; n_local must be one chunk or
     # a multiple of chunks so the scan in build_histogram stays shape-static.
     chunk = cfg.hist_chunk
-    n_local = (n + D - 1) // D
-    if n_local > chunk:
-        n_local = ((n_local + chunk - 1) // chunk) * chunk
-    n_pad = n_local * D - n
+    if process_local:
+        # Global padding agreement without global data: every process pads
+        # its partition to the same per-device row count, derived from the
+        # allgathered per-process counts (a few ints on the wire).
+        from mmlspark_tpu.parallel.distributed import host_allgather
+
+        proc_counts = host_allgather(np.asarray([n])).reshape(-1)
+        d_local = max(len(mesh.local_devices), 1)
+        n_local = (int(proc_counts.max()) + d_local - 1) // d_local
+        if n_local > chunk:
+            n_local = ((n_local + chunk - 1) // chunk) * chunk
+        n_pad = n_local * d_local - n  # THIS process's padding
+    else:
+        n_local = (n + D - 1) // D
+        if n_local > chunk:
+            n_local = ((n_local + chunk - 1) // chunk) * chunk
+        n_pad = n_local * D - n
     bins_np = _pad_rows(bins_np, n_pad)
     y = _pad_rows(train_set.label, n_pad)
     valid_mask_np = np.concatenate([np.ones(n, bool), np.zeros(n_pad, bool)])
@@ -640,8 +754,19 @@ def train(
     # ---- weights (is_unbalance / scale_pos_weight) ---------------------
     w = train_set.weight
     if cfg.objective == "binary":
-        pos = max(float((train_set.label > 0).sum()), 1.0)
-        neg = max(float((train_set.label <= 0).sum()), 1.0)
+        if process_local:
+            from mmlspark_tpu.parallel.distributed import host_allgather
+
+            pn = host_allgather(
+                np.asarray([
+                    float((train_set.label > 0).sum()),
+                    float((train_set.label <= 0).sum()),
+                ])
+            ).sum(axis=0)
+            pos, neg = max(pn[0], 1.0), max(pn[1], 1.0)
+        else:
+            pos = max(float((train_set.label > 0).sum()), 1.0)
+            neg = max(float((train_set.label <= 0).sum()), 1.0)
         if cfg.is_unbalance:
             spw = neg / pos
         else:
@@ -665,7 +790,16 @@ def train(
         and train_set.init_score is None
         and init_model is None  # the old forest already embeds its bias
     )
-    if use_bfa:
+    if use_bfa and process_local:
+        # Seed from SUMMED sufficient statistics (one tiny allgather) —
+        # the global label vector never exists on any host.
+        from mmlspark_tpu.parallel.distributed import host_allgather
+
+        stats = host_allgather(
+            obj.init_score_stats(train_set.label, train_set.weight)
+        ).sum(axis=0)
+        init = obj.init_score_from_stats(stats)
+    elif use_bfa:
         init = obj.init_score(train_set.label, train_set.weight)
     else:
         init = np.zeros(K) if K > 1 else 0.0
@@ -679,24 +813,47 @@ def train(
     # Under a mesh, rows are sharded over the data axis up front so the
     # binned matrix lives partitioned in HBM (SURVEY.md §7.2) and per-
     # iteration programs never reshuffle it.
-    if mesh is not None:
+    dev_key = (id(bin_mapper), n_pad, _mesh_cache_key(mesh), process_local)
+    bins_dev = train_set._dev_bins_cache.get(dev_key)
+    if process_local:
+        # Multi-controller assembly: each process contributes ONLY its
+        # (padded) partition; jax stitches the global sharded arrays from
+        # the per-process pieces.  No host ever sees another's rows.
+        from jax.sharding import PartitionSpec as P
+
+        from mmlspark_tpu.parallel.distributed import make_global_array
+
+        if bins_dev is None:
+            bins_dev = make_global_array(mesh, P(DATA_AXIS, None), bins_np)
+        y_dev = make_global_array(mesh, P(DATA_AXIS), y.astype(np.float32))
+        w_dev = None if w_np is None else make_global_array(
+            mesh, P(DATA_AXIS), w_np.astype(np.float32)
+        )
+        valid_mask = make_global_array(mesh, P(DATA_AXIS), valid_mask_np)
+        init_scores_dev = make_global_array(mesh, P(None, DATA_AXIS), init_arr)
+    elif mesh is not None:
         from jax.sharding import NamedSharding
         from jax.sharding import PartitionSpec as P
 
         row_sh = NamedSharding(mesh, P(DATA_AXIS))
         rowF_sh = NamedSharding(mesh, P(DATA_AXIS, None))
         krow_sh = NamedSharding(mesh, P(None, DATA_AXIS))
-        bins_dev = jax.device_put(bins_np, rowF_sh)
+        if bins_dev is None:
+            bins_dev = jax.device_put(bins_np, rowF_sh)
         y_dev = jax.device_put(y.astype(np.float32), row_sh)
         w_dev = None if w_np is None else jax.device_put(w_np.astype(np.float32), row_sh)
         valid_mask = jax.device_put(valid_mask_np, row_sh)
         init_scores_dev = jax.device_put(init_arr, krow_sh)
     else:
-        bins_dev = jnp.asarray(bins_np)
+        if bins_dev is None:
+            bins_dev = jnp.asarray(bins_np)
         y_dev = jnp.asarray(y, dtype=jnp.float32)
         w_dev = None if w_np is None else jnp.asarray(w_np, dtype=jnp.float32)
         valid_mask = jnp.asarray(valid_mask_np)
         init_scores_dev = jnp.asarray(init_arr)
+    # Size-1 like the host caches: each entry pins a full-matrix device
+    # copy, and sweeps over mesh/chunk configs must not accumulate HBM.
+    train_set._dev_bins_cache = {dev_key: bins_dev}
     if init_model is not None:
         # Replay the base forest over the already-placed binned matrix:
         # under a mesh this runs sharded (bins_dev carries the row sharding
@@ -735,6 +892,7 @@ def train(
         hist_chunk=chunk,
         hist_precision=cfg.hist_precision,
         grow_policy=grow_policy,
+        split_batch=cfg.split_batch,
         categorical_features=tuple(int(f) for f in cfg.categorical_feature),
         cat_smooth=cfg.cat_smooth,
         cat_l2=cfg.cat_l2,
@@ -817,7 +975,7 @@ def train(
     vsets = []
     names = list(valid_names) if valid_names else [f"valid_{i}" for i in range(len(valid_sets))]
     for vs in valid_sets:
-        vb = jnp.asarray(bin_mapper.transform(vs.X))
+        vb = jnp.asarray(vs.binned(bin_mapper))
         vscore = np.broadcast_to(
             np.asarray(init, dtype=np.float32).reshape(-1, 1), (K, vs.num_rows)
         ).copy()
@@ -956,9 +1114,11 @@ def train(
             return jax.jit(scan_chunk)
 
         # Reuse the jitted program across train() calls when nothing it
-        # closes over can differ (LambdaRank carries per-dataset group state
-        # inside `obj`, so it is excluded).
-        if isinstance(obj, LambdaRank):
+        # closes over can differ.  The cached program closes over the FIRST
+        # call's objective instance, which is sound only because objectives
+        # are stateless-by-construction (Objective.stateful); instances that
+        # carry per-dataset state (LambdaRank's group matrix) are excluded.
+        if obj.stateful:
             scan_chunk = _build_scan_chunk()
         else:
             cache_key = (_cfg_cache_key(cfg), K, F, B, _mesh_cache_key(mesh))
@@ -986,6 +1146,9 @@ def train(
         def _write_snapshot(booster_snap):
             import os
             import pickle
+
+            if process_local and jax.process_index() != 0:
+                return  # every process holds the same replicated model
 
             tmp = ckpt_path + ".tmp"
             with open(tmp, "wb") as f:
@@ -1076,9 +1239,13 @@ def train(
             stacked, weights, bin_mapper, cfg, init_model, evals_result,
             best_iter if cfg.early_stopping_round > 0 else -1,
         )
-        if ckpt_path is not None and stop_at is not None:
-            # Early stopping truncated the forest: rewrite the checkpoint
-            # so a rerun resumes from the RETURNED model, not the overshoot.
+        if ckpt_path is not None:
+            # Terminal snapshot: rewrite the checkpoint as the RETURNED
+            # model (early stopping may have truncated past-chunk trees) and
+            # record that the run COMPLETED this request, so a rerun with
+            # the same dir returns this snapshot unchanged instead of
+            # training past the recorded stopping point.
+            final._ckpt_completed_for = requested_total
             _write_snapshot(final)
         return final
 

@@ -90,6 +90,16 @@ class GrowConfig:
     # meaningful under shard_map (axis_name set); depthwise grower only.
     voting: bool = False
     top_k: int = 20
+    # k-batched best-first growth (TPU-first generalization): at most
+    # ``split_batch`` splits are applied per histogram pass, selected
+    # best-first by gain over ALL current leaves.  0 = a full level's worth
+    # (the depthwise default); 1 = one split per pass, which reproduces the
+    # lossguide grower's split sequence exactly (same argmax ordering)
+    # while paying ONE windowed data pass per split instead of the
+    # all-rows masked pass of :func:`grow_tree`.  Intermediate k trades a
+    # small policy delay (the k-th split is chosen before the first k-1
+    # splits' children are scored) for k-fold fewer passes.
+    split_batch: int = 0
 
     @property
     def num_value_bins(self) -> int:
@@ -109,14 +119,18 @@ class GrowConfig:
 
     @property
     def level_window(self) -> int:
-        """Static width of the per-level new-children window (depthwise).
+        """Static width of the per-pass new-children window (depthwise).
 
         A level's split count is bounded by min(current leaves, remaining
         budget) ≤ ⌈num_leaves/2⌉ — if half the budget is already leaves,
         the remaining budget is under half — so the next power of two of
-        ⌈num_leaves/2⌉ always fits every level's new right children.
+        ⌈num_leaves/2⌉ always fits every level's new right children.  With
+        ``split_batch`` set, the per-pass split count (hence the window) is
+        capped at the batch size instead.
         """
         need = max(1, (self.num_leaves + 1) // 2)
+        if self.split_batch > 0:
+            need = min(need, self.split_batch)
         return 1 << (need - 1).bit_length()
 
 
@@ -460,7 +474,8 @@ def grow_tree(
     """
     n, F = bins.shape
     B, L, S = cfg.num_bins, cfg.num_leaves, cfg.max_steps
-    bins = bins.astype(jnp.int32)
+    # One convert+transpose per tree (histogram passes want (F, n) int32).
+    bins_t = bins.astype(jnp.int32).T
     in_bag = (bag_weight > 0).astype(jnp.float32)
     vals = jnp.stack(
         [grad * bag_weight, hess * bag_weight, in_bag], axis=0
@@ -468,9 +483,9 @@ def grow_tree(
 
     def hist(mask):
         return build_histogram(
-            bins, vals, mask, B,
+            bins_t, vals, mask, B,
             backend=cfg.hist_backend, chunk=cfg.hist_chunk, axis_name=cfg.axis_name,
-            precision=cfg.hist_precision,
+            precision=cfg.hist_precision, transposed=True,
         )
 
     root_hist = hist(jnp.ones(n, bool))  # (3, F, B)
@@ -491,7 +506,7 @@ def grow_tree(
         )
         do = (gain > cfg.min_gain_to_split) & ~stopped
 
-        fcol = lax.dynamic_index_in_dim(bins, f, axis=1, keepdims=False)
+        fcol = lax.dynamic_index_in_dim(bins_t, f, axis=0, keepdims=False)
         is_missing = fcol == (B - 1)
         goes_left = jnp.where(is_missing, dleft, fcol <= t)
         if cfg.has_categoricals:
@@ -573,7 +588,10 @@ def grow_tree_depthwise(
     B, L, S = cfg.num_bins, cfg.num_leaves, cfg.max_steps
     W = cfg.level_window
     LB = L + W  # hist buffer slots: window writes start at base ≤ S
-    bins = bins.astype(jnp.int32)
+    # ONE convert+transpose per tree: every histogram pass wants rows on
+    # the lane axis ((F, n) int32), and re-deriving it per pass cost a
+    # ~10s-of-MB relayout each level.
+    bins_t = bins.astype(jnp.int32).T  # (F, n)
     in_bag = (bag_weight > 0).astype(jnp.float32)
     vals = jnp.stack(
         [grad * bag_weight, hess * bag_weight, in_bag], axis=0
@@ -586,15 +604,15 @@ def grow_tree_depthwise(
 
     def window_hist(win_leaf):
         return build_histogram_by_leaf(
-            bins, vals, win_leaf, W, B,
+            bins_t, vals, win_leaf, W, B,
             backend=cfg.hist_backend, chunk=cfg.hist_chunk, axis_name=hist_axis,
-            precision=cfg.hist_precision,
+            precision=cfg.hist_precision, transposed=True,
         )
 
     root_hist = build_histogram(
-        bins, vals, jnp.ones(n, bool), B,
+        bins_t, vals, jnp.ones(n, bool), B,
         backend=cfg.hist_backend, chunk=cfg.hist_chunk, axis_name=hist_axis,
-        precision=cfg.hist_precision,
+        precision=cfg.hist_precision, transposed=True,
     )  # (3, F, B)
     hists0 = jnp.zeros((3, LB, F, B), jnp.float32).at[:, 0].set(root_hist)
 
@@ -625,9 +643,12 @@ def grow_tree_depthwise(
         gain = jnp.where(leaf_ok, gain, -jnp.inf)
         valid = gain > cfg.min_gain_to_split
 
-        # Best-first selection within the level, capped by the leaf budget
-        # (level_window never binds below the budget — see its docstring).
+        # Best-first selection within the pass, capped by the leaf budget
+        # and (with split_batch) the per-pass batch size (level_window
+        # never binds below either — see its docstring).
         budget = jnp.minimum(L - cur_leaves, W)
+        if cfg.split_batch > 0:
+            budget = jnp.minimum(budget, cfg.split_batch)
         order = jnp.argsort(-gain)
         rank = jnp.argsort(order)  # gain-desc rank of each leaf
         selected = valid & (rank < budget)
@@ -658,7 +679,7 @@ def grow_tree_depthwise(
         # -- per-row moves (one gather per row on its leaf's split) -------
         sel_row = selected[leaf_ids]
         f_row = f[leaf_ids]
-        fcol = jnp.take_along_axis(bins, f_row[:, None], axis=1)[:, 0]
+        fcol = jnp.take_along_axis(bins_t, f_row[None, :], axis=0)[0]
         is_missing = fcol == (B - 1)
         goes_left = jnp.where(is_missing, dleft[leaf_ids], fcol <= t[leaf_ids])
         if cfg.has_categoricals:
@@ -735,7 +756,10 @@ def grow_tree_depthwise(
 
 
 def grow_tree_auto(cfg: GrowConfig, *args):
-    if cfg.grow_policy == "depthwise":
+    # split_batch routes lossguide through the windowed grower too (k
+    # best-first splits per windowed pass; k=1 reproduces grow_tree's split
+    # sequence exactly — see GrowConfig.split_batch).
+    if cfg.grow_policy == "depthwise" or cfg.split_batch > 0:
         return grow_tree_depthwise(cfg, *args)
     return grow_tree(cfg, *args)
 

@@ -14,6 +14,11 @@ from mmlspark_tpu.automl.search import TuneHyperparametersModel as _TuneHyperpar
 from mmlspark_tpu.cognitive.anomaly import BingImageSearch as _BingImageSearch
 from mmlspark_tpu.cognitive.anomaly import DetectEntireSeries as _DetectEntireSeries
 from mmlspark_tpu.cognitive.anomaly import DetectLastAnomaly as _DetectLastAnomaly
+from mmlspark_tpu.cognitive.face import FindSimilarFace as _FindSimilarFace
+from mmlspark_tpu.cognitive.face import GroupFaces as _GroupFaces
+from mmlspark_tpu.cognitive.face import IdentifyFaces as _IdentifyFaces
+from mmlspark_tpu.cognitive.face import VerifyFaces as _VerifyFaces
+from mmlspark_tpu.cognitive.speech import SpeechToText as _SpeechToText
 from mmlspark_tpu.cognitive.text import EntityDetector as _EntityDetector
 from mmlspark_tpu.cognitive.text import KeyPhraseExtractor as _KeyPhraseExtractor
 from mmlspark_tpu.cognitive.text import LanguageDetector as _LanguageDetector
@@ -240,6 +245,128 @@ class DetectLastAnomaly(_DetectLastAnomaly):
     """
 
     def __init__(self, *, backoffs=[100, 500, 1000], concurrency=4, concurrentTimeout=60.0, errorCol='', granularity={'value': 'daily'}, location='westus', maxAnomalyRatio=_UNSET, outputCol=_UNSET, sensitivity=_UNSET, series=_UNSET, subscriptionKey=_UNSET, url=''):
+        kw = {k: v for k, v in locals().items()
+              if k not in ('self', '__class__') and v is not _UNSET}
+        super().__init__(**kw)
+
+
+class FindSimilarFace(_FindSimilarFace):
+    """Generated wrapper over :class:`mmlspark_tpu.cognitive.face.FindSimilarFace`.
+
+    Params:
+      backoffs: Retry backoffs in ms
+      concurrency: In-flight requests
+      concurrentTimeout: Per-request timeout (s)
+      errorCol: Column receiving per-row errors
+      faceId: Query face ID
+      faceIds: Candidate face IDs (list or csv)
+      faceListId: Face list to search
+      largeFaceListId: Large face list to search
+      location: Service region, e.g. eastus
+      maxNumOfCandidatesReturned: Max matches returned
+      mode: matchPerson | matchFace
+      outputCol: The name of the output column
+      subscriptionKey: API key sent as Ocp-Apim-Subscription-Key
+      url: Full service URL (overrides location routing)
+    """
+
+    def __init__(self, *, backoffs=[100, 500, 1000], concurrency=4, concurrentTimeout=60.0, errorCol='', faceId=_UNSET, faceIds=_UNSET, faceListId=_UNSET, largeFaceListId=_UNSET, location='westus', maxNumOfCandidatesReturned={'value': 20}, mode={'value': 'matchPerson'}, outputCol=_UNSET, subscriptionKey=_UNSET, url=''):
+        kw = {k: v for k, v in locals().items()
+              if k not in ('self', '__class__') and v is not _UNSET}
+        super().__init__(**kw)
+
+
+class GroupFaces(_GroupFaces):
+    """Generated wrapper over :class:`mmlspark_tpu.cognitive.face.GroupFaces`.
+
+    Params:
+      backoffs: Retry backoffs in ms
+      concurrency: In-flight requests
+      concurrentTimeout: Per-request timeout (s)
+      errorCol: Column receiving per-row errors
+      faceIds: Face IDs to group (list or csv)
+      location: Service region, e.g. eastus
+      outputCol: The name of the output column
+      subscriptionKey: API key sent as Ocp-Apim-Subscription-Key
+      url: Full service URL (overrides location routing)
+    """
+
+    def __init__(self, *, backoffs=[100, 500, 1000], concurrency=4, concurrentTimeout=60.0, errorCol='', faceIds=_UNSET, location='westus', outputCol=_UNSET, subscriptionKey=_UNSET, url=''):
+        kw = {k: v for k, v in locals().items()
+              if k not in ('self', '__class__') and v is not _UNSET}
+        super().__init__(**kw)
+
+
+class IdentifyFaces(_IdentifyFaces):
+    """Generated wrapper over :class:`mmlspark_tpu.cognitive.face.IdentifyFaces`.
+
+    Params:
+      backoffs: Retry backoffs in ms
+      concurrency: In-flight requests
+      concurrentTimeout: Per-request timeout (s)
+      confidenceThreshold: Identification confidence threshold
+      errorCol: Column receiving per-row errors
+      faceIds: Face IDs to identify (list or csv)
+      largePersonGroupId: Target large person group (excludes personGroupId)
+      location: Service region, e.g. eastus
+      maxNumOfCandidatesReturned: Candidates per face
+      outputCol: The name of the output column
+      personGroupId: Target person group
+      subscriptionKey: API key sent as Ocp-Apim-Subscription-Key
+      url: Full service URL (overrides location routing)
+    """
+
+    def __init__(self, *, backoffs=[100, 500, 1000], concurrency=4, concurrentTimeout=60.0, confidenceThreshold=_UNSET, errorCol='', faceIds=_UNSET, largePersonGroupId=_UNSET, location='westus', maxNumOfCandidatesReturned={'value': 1}, outputCol=_UNSET, personGroupId=_UNSET, subscriptionKey=_UNSET, url=''):
+        kw = {k: v for k, v in locals().items()
+              if k not in ('self', '__class__') and v is not _UNSET}
+        super().__init__(**kw)
+
+
+class VerifyFaces(_VerifyFaces):
+    """Generated wrapper over :class:`mmlspark_tpu.cognitive.face.VerifyFaces`.
+
+    Params:
+      backoffs: Retry backoffs in ms
+      concurrency: In-flight requests
+      concurrentTimeout: Per-request timeout (s)
+      errorCol: Column receiving per-row errors
+      faceId: Face ID (face-to-person mode)
+      faceId1: First face ID (face-to-face mode)
+      faceId2: Second face ID (face-to-face mode)
+      largePersonGroupId: Large person group (face-to-person)
+      location: Service region, e.g. eastus
+      outputCol: The name of the output column
+      personGroupId: Person group (face-to-person)
+      personId: Person ID (face-to-person)
+      subscriptionKey: API key sent as Ocp-Apim-Subscription-Key
+      url: Full service URL (overrides location routing)
+    """
+
+    def __init__(self, *, backoffs=[100, 500, 1000], concurrency=4, concurrentTimeout=60.0, errorCol='', faceId=_UNSET, faceId1=_UNSET, faceId2=_UNSET, largePersonGroupId=_UNSET, location='westus', outputCol=_UNSET, personGroupId=_UNSET, personId=_UNSET, subscriptionKey=_UNSET, url=''):
+        kw = {k: v for k, v in locals().items()
+              if k not in ('self', '__class__') and v is not _UNSET}
+        super().__init__(**kw)
+
+
+class SpeechToText(_SpeechToText):
+    """Generated wrapper over :class:`mmlspark_tpu.cognitive.speech.SpeechToText`.
+
+    Params:
+      audioData: Raw audio bytes (value or column)
+      backoffs: Retry backoffs in ms
+      concurrency: In-flight requests
+      concurrentTimeout: Per-request timeout (s)
+      errorCol: Column receiving per-row errors
+      format: simple | detailed output
+      language: Recognition language
+      location: Service region, e.g. eastus
+      outputCol: The name of the output column
+      profanity: masked | removed | raw
+      subscriptionKey: API key sent as Ocp-Apim-Subscription-Key
+      url: Full service URL (overrides location routing)
+    """
+
+    def __init__(self, *, audioData=_UNSET, backoffs=[100, 500, 1000], concurrency=4, concurrentTimeout=60.0, errorCol='', format={'value': 'simple'}, language={'value': 'en-US'}, location='westus', outputCol=_UNSET, profanity={'value': 'masked'}, subscriptionKey=_UNSET, url=''):
         kw = {k: v for k, v in locals().items()
               if k not in ('self', '__class__') and v is not _UNSET}
         super().__init__(**kw)
@@ -2155,6 +2282,11 @@ __all__ = [
     'BingImageSearch',
     'DetectEntireSeries',
     'DetectLastAnomaly',
+    'FindSimilarFace',
+    'GroupFaces',
+    'IdentifyFaces',
+    'VerifyFaces',
+    'SpeechToText',
     'EntityDetector',
     'KeyPhraseExtractor',
     'LanguageDetector',

@@ -16,6 +16,7 @@ import hashlib
 import os
 import shutil
 import urllib.request
+import warnings
 from dataclasses import dataclass, field
 from typing import Dict, Iterable, Optional
 
@@ -96,6 +97,17 @@ class ModelDownloader:
         return self.downloadModel(self.catalog[name])
 
     def downloadModel(self, schema: ModelSchema) -> str:
+        if not schema.hash:
+            # An empty hash means NO integrity check: a tampered or
+            # truncated download (or a stale cached file) would be accepted
+            # silently.  The reference catalog pins hashes for every entry;
+            # unpinned entries here are loudly the caller's responsibility.
+            warnings.warn(
+                f"catalog entry {schema.name!r} has no sha256 hash — the "
+                f"download and any cached copy will NOT be verified; pin "
+                f"ModelSchema.hash to enable verification",
+                stacklevel=2,
+            )
         dest = os.path.join(self.local_path, schema.filename())
         if os.path.exists(dest) and (
             not schema.hash or sha256_file(dest) == schema.hash

@@ -260,6 +260,73 @@ class BinMapper:
         return bm
 
 
+def sample_rows_for_binning(
+    local_X: np.ndarray,
+    n_total: int,
+    seed: int = 0,
+    process_id: int = 0,
+    max_sample: int = MAX_SAMPLE,
+) -> np.ndarray:
+    """This process's share of the global binning sample.
+
+    The distributed quantile sketch (SURVEY.md §7.4.3 "1TB binning"):
+    LightGBM fits its BinMapper on ``bin_construct_sample_cnt`` (200k)
+    sampled rows regardless of dataset size — so the distributed fit never
+    needs the raw rows gathered, only a proportional per-process sample
+    whose TOTAL is bounded by ``max_sample``.  Each process draws
+    ``⌈max_sample · n_local/n_total⌉`` rows (everything when the dataset is
+    small) with a seed derived from ``(seed, process_id)``; the samples are
+    ragged-allgathered in process order and one mapper is fit on the merge,
+    deterministically identical on every process.
+    """
+    n_local = len(local_X)
+    if n_total <= max_sample:
+        return np.ascontiguousarray(local_X, dtype=np.float64)
+    k = min(n_local, int(np.ceil(max_sample * n_local / max(n_total, 1))))
+    rng = np.random.default_rng([seed, process_id])
+    idx = np.sort(rng.choice(n_local, k, replace=False))
+    return np.ascontiguousarray(local_X[idx], dtype=np.float64)
+
+
+def distributed_fit(
+    local_X: np.ndarray,
+    max_bin: int = 255,
+    categorical_features: Sequence[int] = (),
+    seed: int = 0,
+    threads: int = 0,
+) -> BinMapper:
+    """Fit ONE BinMapper across all processes without gathering raw rows.
+
+    Per-process proportional sample (:func:`sample_rows_for_binning`) →
+    bounded ragged allgather (≤ ``MAX_SAMPLE`` rows total on the wire) →
+    deterministic merged fit.  Every process returns a mapper with
+    IDENTICAL thresholds (the merge order is the process order, and
+    :meth:`BinMapper.fit` is deterministic in its input multiset).
+    Replaces the full-rows allgather the round-2 bridge used — the
+    Criteo-1TB blocker (VERDICT r2 #1/#2).
+    """
+    import jax
+
+    from mmlspark_tpu.parallel.distributed import (
+        host_allgather,
+        host_allgather_ragged_rows,
+    )
+
+    n_total = int(
+        host_allgather(np.asarray([len(local_X)])).sum()
+    ) if jax.process_count() > 1 else len(local_X)
+    sample = sample_rows_for_binning(
+        local_X, n_total, seed=seed, process_id=jax.process_index()
+    )
+    merged = host_allgather_ragged_rows(sample)
+    return BinMapper(
+        max_bin=max_bin,
+        categorical_features=tuple(categorical_features),
+        seed=seed,
+        threads=threads,
+    ).fit(merged)
+
+
 def merge_samples_and_fit(
     samples: Sequence[np.ndarray],
     max_bin: int = 255,

@@ -83,9 +83,15 @@ def build_histogram(
     chunk: int = DEFAULT_CHUNK,
     axis_name: Optional[str] = None,
     precision: str = "highest",
+    transposed: bool = False,
 ) -> jnp.ndarray:
     """Histogram of ``vals`` (3, n) over (feature, bin), rows gated by
     ``mask``; returns (3, F, B).
+
+    ``transposed=True`` means ``bins`` arrives as (F, n) int32 — growers
+    hoist the convert+transpose out of their per-pass loop (pallas wants
+    rows on the lane axis; the scatter/onehot fallbacks transpose back,
+    they are the small-scale/test paths).
 
     When ``axis_name`` is set (running inside ``shard_map`` over row shards),
     the result is ``psum``-med across the mesh axis — this single line is the
@@ -93,15 +99,24 @@ def build_histogram(
     (``LGBM_NetworkInit`` + recursive-halving allreduce; SURVEY.md §3.1,
     §5.8 native component N2).
     """
-    n, F = bins.shape
+    if transposed:
+        F, n = bins.shape
+    else:
+        n, F = bins.shape
     if backend == "pallas":
         from mmlspark_tpu.ops.pallas_hist import pallas_hist_chunk
 
-        fn = functools.partial(pallas_hist_chunk, precision=precision)
+        fn = functools.partial(
+            pallas_hist_chunk, precision=precision, transposed=transposed
+        )
     elif backend == "onehot":
-        fn = _onehot_hist_chunk
+        fn = _onehot_hist_chunk if not transposed else (
+            lambda b, v, nb: _onehot_hist_chunk(b.T, v, nb)
+        )
     elif backend == "scatter":
-        fn = _scatter_hist_chunk
+        fn = _scatter_hist_chunk if not transposed else (
+            lambda b, v, nb: _scatter_hist_chunk(b.T, v, nb)
+        )
     else:
         raise ValueError(
             f"unknown hist backend {backend!r}; expected scatter|onehot|pallas"
@@ -112,7 +127,10 @@ def build_histogram(
     else:
         if n % chunk != 0:
             raise ValueError(f"row count {n} not a multiple of chunk {chunk}")
-        bc = bins.reshape(n // chunk, chunk, F)
+        if transposed:
+            bc = bins.reshape(F, n // chunk, chunk).transpose(1, 0, 2)
+        else:
+            bc = bins.reshape(n // chunk, chunk, F)
         vc = vals.reshape(3, n // chunk, chunk).transpose(1, 0, 2)
 
         def body(acc, xs):
@@ -156,6 +174,7 @@ def build_histogram_by_leaf(
     chunk: int = DEFAULT_CHUNK,
     axis_name: Optional[str] = None,
     precision: str = "highest",
+    transposed: bool = False,
 ) -> jnp.ndarray:
     """Per-leaf histograms in ONE pass over the data: (3, L, F, B).
 
@@ -164,17 +183,26 @@ def build_histogram_by_leaf(
     (out of bag / padding / other leaves — e.g. the windowed new-children
     pass, which passes ``leaf_ids - base``) must arrive with ``leaf_ids``
     outside ``[0, num_leaves)`` (any parked value, including negatives) or
-    zeroed ``vals``.  With ``axis_name``, the result is psum-med across the
-    mesh — the same single-collective structure as :func:`build_histogram`.
+    zeroed ``vals``.  ``transposed=True``: bins arrive as (F, n) int32 (see
+    :func:`build_histogram`).  With ``axis_name``, the result is psum-med
+    across the mesh — the same single-collective structure as
+    :func:`build_histogram`.
     """
-    n, F = bins.shape
+    if transposed:
+        F, n = bins.shape
+    else:
+        n, F = bins.shape
     vals = vals.astype(jnp.float32)
     if backend == "pallas":
         from mmlspark_tpu.ops.pallas_hist import pallas_hist_by_leaf_chunk
 
-        fn = functools.partial(pallas_hist_by_leaf_chunk, precision=precision)
+        fn = functools.partial(
+            pallas_hist_by_leaf_chunk, precision=precision, transposed=transposed
+        )
     elif backend in ("scatter", "onehot"):
-        fn = _scatter_hist_by_leaf_chunk
+        fn = _scatter_hist_by_leaf_chunk if not transposed else (
+            lambda b, v, l, nl, nb: _scatter_hist_by_leaf_chunk(b.T, v, l, nl, nb)
+        )
     else:
         raise ValueError(
             f"unknown hist backend {backend!r}; expected scatter|onehot|pallas"
@@ -184,7 +212,10 @@ def build_histogram_by_leaf(
     else:
         if n % chunk != 0:
             raise ValueError(f"row count {n} not a multiple of chunk {chunk}")
-        bc = bins.reshape(n // chunk, chunk, F)
+        if transposed:
+            bc = bins.reshape(F, n // chunk, chunk).transpose(1, 0, 2)
+        else:
+            bc = bins.reshape(n // chunk, chunk, F)
         vc = vals.reshape(3, n // chunk, chunk).transpose(1, 0, 2)
         lc = leaf_ids.reshape(n // chunk, chunk)
 

@@ -29,6 +29,12 @@ class Objective:
     name = "base"
     num_model_per_iteration = 1  # K>1 for multiclass
     default_metric = "l2"
+    # True when instances carry PER-DATASET state (set after construction,
+    # e.g. LambdaRank's group matrix).  The booster's cross-call scan-program
+    # cache closes over the objective from the FIRST call with a given
+    # config, which is only sound for stateless instances — stateful
+    # objectives MUST set this so the cache excludes them.
+    stateful = False
 
     def __init__(self, **params):
         self.params = params
@@ -38,6 +44,24 @@ class Objective:
     def init_score(self, y: np.ndarray, w: Optional[np.ndarray]) -> float:
         """boost_from_average seed (scalar raw score)."""
         return 0.0
+
+    # -- distributed boost_from_average ----------------------------------
+    # Process-local training never materializes the global label vector, so
+    # the init score is computed from SUMMED sufficient statistics instead:
+    # every process contributes ``init_score_stats`` (local), the vectors
+    # are element-wise summed across processes (one tiny allgather), and
+    # ``init_score_from_stats`` maps the global sums to the seed score.
+    # The avg-based family ([weighted-sum, weight-total] → f(avg)) covers
+    # every objective except the quantile/median ones, which raise.
+    def init_score_stats(self, y: np.ndarray, w: Optional[np.ndarray]) -> np.ndarray:
+        wv = np.ones_like(y, dtype=np.float64) if w is None else np.asarray(w, dtype=np.float64)
+        return np.asarray([float(np.sum(wv * y)), float(np.sum(wv))])
+
+    def init_score_from_stats(self, stats: np.ndarray):
+        return self._init_from_avg(float(stats[0]) / max(float(stats[1]), 1e-300))
+
+    def _init_from_avg(self, avg: float):
+        return 0.0  # objectives without bias folding keep a zero seed
 
     # -- device-side -----------------------------------------------------
     def grad_hess(
@@ -69,6 +93,10 @@ class BinaryObjective(Objective):
         p = min(max(_avg(y, w), 1e-15), 1 - 1e-15)
         return float(np.log(p / (1 - p)) / self.sigmoid)
 
+    def _init_from_avg(self, avg):
+        p = min(max(avg, 1e-15), 1 - 1e-15)
+        return float(np.log(p / (1 - p)) / self.sigmoid)
+
     def grad_hess(self, score, y, w):
         p = jax.nn.sigmoid(self.sigmoid * score)
         grad = self.sigmoid * (p - y)
@@ -86,6 +114,9 @@ class RegressionL2(Objective):
     def init_score(self, y, w):
         return _avg(y, w)
 
+    def _init_from_avg(self, avg):
+        return float(avg)
+
     def grad_hess(self, score, y, w):
         return self._apply_weight(score - y, jnp.ones_like(score), w)
 
@@ -97,6 +128,13 @@ class RegressionL1(Objective):
     def init_score(self, y, w):
         return float(np.median(y))
 
+    def init_score_stats(self, y, w):
+        raise NotImplementedError(
+            f"objective {self.name!r} seeds from a quantile/median, which has "
+            f"no summable sufficient statistics; process-local training "
+            f"requires boost_from_average=False for it"
+        )
+
     def grad_hess(self, score, y, w):
         return self._apply_weight(jnp.sign(score - y), jnp.ones_like(score), w)
 
@@ -107,6 +145,9 @@ class Huber(Objective):
 
     def init_score(self, y, w):
         return _avg(y, w)
+
+    def _init_from_avg(self, avg):
+        return float(avg)
 
     def grad_hess(self, score, y, w):
         alpha = float(self.params.get("alpha", 0.9))
@@ -122,6 +163,9 @@ class Fair(Objective):
     def init_score(self, y, w):
         return _avg(y, w)
 
+    def _init_from_avg(self, avg):
+        return float(avg)
+
     def grad_hess(self, score, y, w):
         c = float(self.params.get("fair_c", 1.0))
         d = score - y
@@ -135,6 +179,9 @@ class Poisson(Objective):
 
     def init_score(self, y, w):
         return float(np.log(max(_avg(y, w), 1e-15)))
+
+    def _init_from_avg(self, avg):
+        return float(np.log(max(avg, 1e-15)))
 
     def grad_hess(self, score, y, w):
         max_delta = float(self.params.get("poisson_max_delta_step", 0.7))
@@ -152,6 +199,9 @@ class Gamma(Objective):
     def init_score(self, y, w):
         return float(np.log(max(_avg(y, w), 1e-15)))
 
+    def _init_from_avg(self, avg):
+        return float(np.log(max(avg, 1e-15)))
+
     def grad_hess(self, score, y, w):
         ye = y * jnp.exp(-score)
         return self._apply_weight(1.0 - ye, ye, w)
@@ -166,6 +216,9 @@ class Tweedie(Objective):
 
     def init_score(self, y, w):
         return float(np.log(max(_avg(y, w), 1e-15)))
+
+    def _init_from_avg(self, avg):
+        return float(np.log(max(avg, 1e-15)))
 
     def grad_hess(self, score, y, w):
         rho = float(self.params.get("tweedie_variance_power", 1.5))
@@ -187,6 +240,13 @@ class Quantile(Objective):
         alpha = float(self.params.get("alpha", 0.9))
         return float(np.quantile(y, alpha))
 
+    def init_score_stats(self, y, w):
+        raise NotImplementedError(
+            f"objective {self.name!r} seeds from a quantile/median, which has "
+            f"no summable sufficient statistics; process-local training "
+            f"requires boost_from_average=False for it"
+        )
+
     def grad_hess(self, score, y, w):
         alpha = float(self.params.get("alpha", 0.9))
         grad = jnp.where(score >= y, 1.0 - alpha, -alpha)
@@ -199,6 +259,13 @@ class MAPE(Objective):
 
     def init_score(self, y, w):
         return float(np.median(y))
+
+    def init_score_stats(self, y, w):
+        raise NotImplementedError(
+            f"objective {self.name!r} seeds from a quantile/median, which has "
+            f"no summable sufficient statistics; process-local training "
+            f"requires boost_from_average=False for it"
+        )
 
     def grad_hess(self, score, y, w):
         inv = 1.0 / jnp.maximum(jnp.abs(y), 1.0)
@@ -222,6 +289,12 @@ class Multiclass(Objective):
         self.num_model_per_iteration = self.num_class
 
     def init_score(self, y, w):
+        return np.zeros(self.num_class, dtype=np.float64)
+
+    def init_score_stats(self, y, w):
+        return np.zeros(1)
+
+    def init_score_from_stats(self, stats):
         return np.zeros(self.num_class, dtype=np.float64)
 
     def grad_hess(self, score, y, w):
@@ -269,6 +342,7 @@ class LambdaRank(Objective):
 
     name = "lambdarank"
     default_metric = "ndcg"
+    stateful = True  # set_groups() stores per-dataset group indices
 
     def __init__(self, **params):
         super().__init__(**params)

@@ -115,16 +115,24 @@ def _pallas_hist(
 
 def pallas_hist_chunk(
     bins_c, vals_c, num_bins: int, bm: int = 4096, bf: int = 32,
-    precision: str = "highest",
+    precision: str = "highest", transposed: bool = False,
 ) -> jnp.ndarray:
     """(C, F) int bins + (3, C) vals → (3, F, B), same contract as the
     scatter/onehot chunk builders in :mod:`mmlspark_tpu.ops.histogram`.
 
+    ``transposed=True`` means ``bins_c`` arrives PRE-transposed as (F, C)
+    int32 — the grower hoists the 10s-of-MB convert+transpose out of the
+    per-pass path (it is invariant across a tree's passes).
+
     Pads rows/features up to block multiples (padded rows carry zero vals,
     padded features are sliced off).
     """
-    C, F = bins_c.shape
-    bins_t = bins_c.astype(jnp.int32).T  # (F, C): rows on the lane axis
+    if transposed:
+        bins_t = bins_c  # (F, C) int32 already
+        F, C = bins_t.shape
+    else:
+        C, F = bins_c.shape
+        bins_t = bins_c.astype(jnp.int32).T  # (F, C): rows on the lane axis
     vals_c = vals_c.astype(jnp.float32)
     # VMEM guard: the kernel's iota/one-hot tiles are (num_bins, bm); the
     # defaults were swept at B=256, so scale bm down for bigger bin counts.
@@ -264,8 +272,12 @@ def _pallas_hist_by_leaf(
 def pallas_hist_by_leaf_chunk(
     bins_c, vals_c, leaf_c, num_leaves: int, num_bins: int,
     bm: int = 16384, bf: int = 32, rm: int = 1024, precision: str = "highest",
+    transposed: bool = False,
 ) -> jnp.ndarray:
     """(C, F) bins + (3, C) vals + (C,) leaf ids → (3, L, F, B).
+
+    ``transposed=True``: bins arrive pre-transposed (F, C) int32 (see
+    :func:`pallas_hist_chunk`).
 
     ``rm`` bounds the VMEM one-hot tile AND sets the matmul contraction
     length; ``bm`` is the DMA/grid granularity.  Defaults from a traced
@@ -280,8 +292,12 @@ def pallas_hist_by_leaf_chunk(
         raise NotImplementedError(
             f"hist_backend='pallas' supports tpu/cpu backends, not {backend!r}"
         )
-    C, F = bins_c.shape
-    bins_t = bins_c.astype(jnp.int32).T
+    if transposed:
+        bins_t = bins_c
+        F, C = bins_t.shape
+    else:
+        C, F = bins_c.shape
+        bins_t = bins_c.astype(jnp.int32).T
     vals_c = vals_c.astype(jnp.float32)
     leaf_row = leaf_c.astype(jnp.int32)[None, :]  # (1, C): lane-friendly
     bf = min(bf, max(8, _round_up(F, 8)))  # don't pad tiny feature counts 4x

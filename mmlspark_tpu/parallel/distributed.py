@@ -118,3 +118,46 @@ def make_global_array(mesh, spec, local_rows):
     if jax.process_count() == 1:
         return jax.device_put(local_rows, sharding)
     return jax.make_array_from_process_local_data(sharding, local_rows)
+
+
+def host_allgather(arr) -> "np.ndarray":
+    """Allgather a SMALL host array across processes → (nproc, *shape).
+
+    The host-side control-plane collective for per-process metadata (row
+    counts, label sufficient statistics, binning samples) — never the data
+    plane.  Single-process: returns the array with a leading axis of 1.
+    """
+    import jax
+    import numpy as np
+
+    a = np.ascontiguousarray(arr)
+    if jax.process_count() == 1:
+        return a[None]
+    from jax.experimental import multihost_utils as mhu
+
+    # Gather RAW BYTES: routing float64/int64 through jax would silently
+    # truncate to 32-bit (jax_enable_x64 is off), which perturbs e.g.
+    # binning-sample values — bin boundaries must be bit-identical to a
+    # single-host fit.
+    raw = a.reshape(-1).view(np.uint8)
+    gathered = np.asarray(mhu.process_allgather(raw))  # (nproc, nbytes)
+    return gathered.view(a.dtype).reshape((gathered.shape[0],) + a.shape)
+
+
+def host_allgather_ragged_rows(arr) -> "np.ndarray":
+    """Concatenate every process's rows (differing counts allowed), in
+    process order — for BOUNDED payloads (e.g. binning samples ≤
+    ``bin_construct_sample_cnt`` rows total), never the raw dataset."""
+    import numpy as np
+
+    arr = np.ascontiguousarray(arr)
+    counts = host_allgather(np.asarray([len(arr)])).reshape(-1)
+    if len(counts) == 1:
+        return arr
+    m = int(counts.max())
+    padded = np.zeros((m,) + arr.shape[1:], arr.dtype)
+    padded[: len(arr)] = arr
+    gathered = host_allgather(padded)  # (nproc, m, ...)
+    return np.concatenate(
+        [gathered[i, : counts[i]] for i in range(len(counts))], axis=0
+    )

@@ -82,10 +82,19 @@ def barrier_train_task(
     timeout_s: int = 1200,
 ) -> Optional[str]:
     """The per-task body for ``rdd.barrier().mapPartitions`` (SURVEY.md
-    §3.1 ``TrainUtils.trainLightGBM`` translated): rendezvous, contribute
-    the local partition to the global row-sharded arrays, run the SPMD
-    training step, and return the model string from process 0 (None
-    elsewhere).
+    §3.1 ``TrainUtils.trainLightGBM`` translated): rendezvous, bin with a
+    distributed quantile sketch, contribute the local partition DIRECTLY to
+    the global row-sharded arrays, run the SPMD training step, and return
+    the model string from process 0 (None elsewhere).
+
+    Scale contract (the reference's: each worker holds ONLY its partition
+    in a native Dataset — ``UPSTREAM:.../lightgbm/TrainUtils.scala``
+    ``generateDataset``): host memory per process is O(partition) +
+    O(binning sample).  The only cross-process host traffic is the bounded
+    binning sample (≤ ``bin_construct_sample_cnt`` rows total) and a few
+    scalar stat vectors; rows reach the device mesh via
+    ``jax.make_array_from_process_local_data`` (``train(...,
+    process_local=True)``), never via a raw-row allgather.
 
     ``local_rows``: this task's partition as (rows, F+1) with the label in
     the LAST column (see :func:`rows_from_arrow_batches`).
@@ -94,45 +103,29 @@ def barrier_train_task(
     mesh = global_mesh()
 
     from mmlspark_tpu.engine.booster import Dataset, train
-    from mmlspark_tpu.ops.binning import BinMapper
+    from mmlspark_tpu.ops.binning import distributed_fit
 
-    # Every process materializes the merged rows via ONE collective ragged
-    # allgather of the combined (X|label) matrix (partition sizes may
-    # differ, so counts travel first and padding is sliced back off).
-    # This replaces the reference's "every worker holds its partition in a
-    # native Dataset" with "every process holds the host copy, rows
-    # device-sharded by train()"; once train() ingests pre-sharded global
-    # arrays directly, this allgather can drop away.
-    rows_global = _allgather_ragged_rows(np.ascontiguousarray(local_rows))
-    X_global = rows_global[:, :-1]
-    y_global = np.ascontiguousarray(rows_global[:, -1])
+    local_rows = np.ascontiguousarray(local_rows)
+    X_local = local_rows[:, :-1]
+    y_local = np.ascontiguousarray(local_rows[:, -1])
 
-    # Shared binning (SURVEY.md §7.4.3): one mapper fit on the merged rows
-    # — deterministic, so every process computes identical thresholds.
-    bm = BinMapper(
+    # Distributed sketch binning (SURVEY.md §7.4.3): proportional
+    # per-process sample → bounded allgather → deterministic merged fit;
+    # every process derives IDENTICAL thresholds.
+    bm = distributed_fit(
+        X_local,
         max_bin=int(params.get("max_bin", 255)),
         categorical_features=tuple(params.get("categorical_feature", ())),
         seed=int(params.get("seed", 0)),
-    ).fit(X_global)
-    booster = train(params, Dataset(X_global, y_global), bin_mapper=bm, mesh=mesh)
+        threads=int(params.get("num_threads", 0)),
+    )
+    booster = train(
+        params, Dataset(X_local, y_local), bin_mapper=bm, mesh=mesh,
+        process_local=True,
+    )
     if context.process_id == 0:
         return booster.save_model_string()
     return None
-
-
-def _allgather_ragged_rows(arr: np.ndarray) -> np.ndarray:
-    """Concatenate every process's rows (differing counts allowed)."""
-    from jax.experimental import multihost_utils as mhu
-
-    counts = np.asarray(mhu.process_allgather(np.asarray([len(arr)])))
-    counts = counts.reshape(-1)
-    m = int(counts.max())
-    padded = np.zeros((m,) + arr.shape[1:], arr.dtype)
-    padded[: len(arr)] = arr
-    gathered = np.asarray(mhu.process_allgather(padded))  # (nproc, m, ...)
-    return np.concatenate(
-        [gathered[i, : counts[i]] for i in range(len(counts))], axis=0
-    )
 
 
 def fit_on_spark(estimator, sdf, num_tasks: Optional[int] = None):

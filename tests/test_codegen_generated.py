@@ -130,6 +130,121 @@ def test_generated_DetectLastAnomaly():
     assert stage.getUrl() == v
 
 
+def test_generated_FindSimilarFace():
+    stage = gen.FindSimilarFace()
+    assert type(stage).__mro__[1].__name__ == 'FindSimilarFace'
+    v = _SAMPLES[int]
+    stage.setConcurrency(v)
+    assert stage.getConcurrency() == v
+    v = _SAMPLES[float]
+    stage.setConcurrentTimeout(v)
+    assert stage.getConcurrentTimeout() == v
+    v = _SAMPLES[str]
+    stage.setErrorCol(v)
+    assert stage.getErrorCol() == v
+    v = _SAMPLES[str]
+    stage.setLocation(v)
+    assert stage.getLocation() == v
+    v = _SAMPLES[str]
+    stage.setOutputCol(v)
+    assert stage.getOutputCol() == v
+    v = _SAMPLES[str]
+    stage.setUrl(v)
+    assert stage.getUrl() == v
+
+
+def test_generated_GroupFaces():
+    stage = gen.GroupFaces()
+    assert type(stage).__mro__[1].__name__ == 'GroupFaces'
+    v = _SAMPLES[int]
+    stage.setConcurrency(v)
+    assert stage.getConcurrency() == v
+    v = _SAMPLES[float]
+    stage.setConcurrentTimeout(v)
+    assert stage.getConcurrentTimeout() == v
+    v = _SAMPLES[str]
+    stage.setErrorCol(v)
+    assert stage.getErrorCol() == v
+    v = _SAMPLES[str]
+    stage.setLocation(v)
+    assert stage.getLocation() == v
+    v = _SAMPLES[str]
+    stage.setOutputCol(v)
+    assert stage.getOutputCol() == v
+    v = _SAMPLES[str]
+    stage.setUrl(v)
+    assert stage.getUrl() == v
+
+
+def test_generated_IdentifyFaces():
+    stage = gen.IdentifyFaces()
+    assert type(stage).__mro__[1].__name__ == 'IdentifyFaces'
+    v = _SAMPLES[int]
+    stage.setConcurrency(v)
+    assert stage.getConcurrency() == v
+    v = _SAMPLES[float]
+    stage.setConcurrentTimeout(v)
+    assert stage.getConcurrentTimeout() == v
+    v = _SAMPLES[str]
+    stage.setErrorCol(v)
+    assert stage.getErrorCol() == v
+    v = _SAMPLES[str]
+    stage.setLocation(v)
+    assert stage.getLocation() == v
+    v = _SAMPLES[str]
+    stage.setOutputCol(v)
+    assert stage.getOutputCol() == v
+    v = _SAMPLES[str]
+    stage.setUrl(v)
+    assert stage.getUrl() == v
+
+
+def test_generated_VerifyFaces():
+    stage = gen.VerifyFaces()
+    assert type(stage).__mro__[1].__name__ == 'VerifyFaces'
+    v = _SAMPLES[int]
+    stage.setConcurrency(v)
+    assert stage.getConcurrency() == v
+    v = _SAMPLES[float]
+    stage.setConcurrentTimeout(v)
+    assert stage.getConcurrentTimeout() == v
+    v = _SAMPLES[str]
+    stage.setErrorCol(v)
+    assert stage.getErrorCol() == v
+    v = _SAMPLES[str]
+    stage.setLocation(v)
+    assert stage.getLocation() == v
+    v = _SAMPLES[str]
+    stage.setOutputCol(v)
+    assert stage.getOutputCol() == v
+    v = _SAMPLES[str]
+    stage.setUrl(v)
+    assert stage.getUrl() == v
+
+
+def test_generated_SpeechToText():
+    stage = gen.SpeechToText()
+    assert type(stage).__mro__[1].__name__ == 'SpeechToText'
+    v = _SAMPLES[int]
+    stage.setConcurrency(v)
+    assert stage.getConcurrency() == v
+    v = _SAMPLES[float]
+    stage.setConcurrentTimeout(v)
+    assert stage.getConcurrentTimeout() == v
+    v = _SAMPLES[str]
+    stage.setErrorCol(v)
+    assert stage.getErrorCol() == v
+    v = _SAMPLES[str]
+    stage.setLocation(v)
+    assert stage.getLocation() == v
+    v = _SAMPLES[str]
+    stage.setOutputCol(v)
+    assert stage.getOutputCol() == v
+    v = _SAMPLES[str]
+    stage.setUrl(v)
+    assert stage.getUrl() == v
+
+
 def test_generated_EntityDetector():
     stage = gen.EntityDetector()
     assert type(stage).__mro__[1].__name__ == 'EntityDetector'

@@ -19,10 +19,15 @@ from mmlspark_tpu.cognitive import (
     AnalyzeImage,
     BingImageSearch,
     DetectLastAnomaly,
+    FindSimilarFace,
+    GroupFaces,
+    IdentifyFaces,
     KeyPhraseExtractor,
     LanguageDetector,
+    SpeechToText,
     TextSentiment,
     Translate,
+    VerifyFaces,
 )
 from mmlspark_tpu.core.frame import DataFrame
 
@@ -220,7 +225,102 @@ class TestAnomalyAndSearch:
             .setQ({"col": "q"}).setCount(3).setOutputCol("imgs")
         ).transform(df)
         assert out["imgs"][0]["value"][0]["name"] == "img"
-        assert "q=dogs" in stub.requests[-1]["path"]
+        # the concurrency pool may deliver the two GETs in either order
+        assert any("q=dogs" in r["path"] for r in stub.requests[-2:])
+
+
+class TestFaceIdentity:
+    def test_identify_faces_body(self, stub):
+        df = DataFrame({"ids": [["f1", "f2"], "f3, f4"]})
+        out = (
+            IdentifyFaces()
+            .setUrl(_url(stub, "/face/v1.0/identify"))
+            .setFaceIds({"col": "ids"})
+            .setPersonGroupId("pg1")
+            .setMaxNumOfCandidatesReturned(2)
+            .setOutputCol("who")
+        ).transform(df)
+        assert out["who"][0] is not None and out["who"][1] is not None
+        # list cell and csv cell both normalize to an ID list
+        assert stub.requests[-2]["body"]["faceIds"] == ["f1", "f2"]
+        assert stub.requests[-1]["body"]["faceIds"] == ["f3", "f4"]
+        assert stub.requests[-1]["body"]["personGroupId"] == "pg1"
+        assert stub.requests[-1]["body"]["maxNumOfCandidatesReturned"] == 2
+
+    def test_verify_faces_both_modes(self, stub):
+        df = DataFrame({"a": ["fa"], "b": ["fb"]})
+        (
+            VerifyFaces()
+            .setUrl(_url(stub, "/face/v1.0/verify"))
+            .setFaceId1({"col": "a"}).setFaceId2({"col": "b"})
+            .setOutputCol("same")
+        ).transform(df)
+        assert stub.requests[-1]["body"] == {"faceId1": "fa", "faceId2": "fb"}
+        (
+            VerifyFaces()
+            .setUrl(_url(stub, "/face/v1.0/verify"))
+            .setFaceId("fx").setPersonId("p9").setLargePersonGroupId("lpg")
+            .setOutputCol("same")
+        ).transform(df)
+        body = stub.requests[-1]["body"]
+        assert body["faceId"] == "fx" and body["personId"] == "p9"
+        assert body["largePersonGroupId"] == "lpg"
+
+    def test_group_and_find_similar(self, stub):
+        df = DataFrame({"ids": [["g1", "g2", "g3"]]})
+        (
+            GroupFaces()
+            .setUrl(_url(stub, "/face/v1.0/group"))
+            .setFaceIds({"col": "ids"}).setOutputCol("groups")
+        ).transform(df)
+        assert stub.requests[-1]["body"] == {"faceIds": ["g1", "g2", "g3"]}
+        (
+            FindSimilarFace()
+            .setUrl(_url(stub, "/face/v1.0/findsimilars"))
+            .setFaceId("q1").setFaceListId("fl").setMode("matchFace")
+            .setOutputCol("similar")
+        ).transform(df)
+        body = stub.requests[-1]["body"]
+        assert body["faceId"] == "q1" and body["faceListId"] == "fl"
+        assert body["mode"] == "matchFace"
+        assert body["maxNumOfCandidatesReturned"] == 20
+
+    def test_missing_ids_skipped(self, stub):
+        df = DataFrame({"ids": [None]})
+        out = (
+            GroupFaces()
+            .setUrl(_url(stub, "/face/v1.0/group"))
+            .setFaceIds({"col": "ids"}).setOutputCol("groups")
+        ).transform(df)
+        assert out["groups"][0] is None and out["groups_error"][0] is None
+
+
+class TestSpeech:
+    def test_speech_to_text_bytes_and_query(self, stub):
+        wav = b"RIFF fake wav"
+        df = DataFrame({"audio": [wav]})
+        out = (
+            SpeechToText()
+            .setSubscriptionKey("sk")
+            .setUrl(_url(stub, "/speech/recognition/conversation/cognitiveservices/v1"))
+            .setAudioData({"col": "audio"})
+            .setLanguage("de-DE")
+            .setOutputCol("stt")
+        ).transform(df)
+        assert out["stt"][0]["echo"]["_bytes"] == len(wav)
+        sent = stub.requests[-1]
+        assert sent["headers"]["Ocp-Apim-Subscription-Key"] == "sk"
+        assert sent["headers"]["Content-Type"].startswith("audio/wav")
+        assert "language=de-DE" in sent["path"]
+        assert "format=simple" in sent["path"]
+        assert "profanity=masked" in sent["path"]
+
+    def test_speech_regional_url(self):
+        t = SpeechToText().setLocation("eastus")
+        assert t._base_url() == (
+            "https://eastus.stt.speech.microsoft.com"
+            "/speech/recognition/conversation/cognitiveservices/v1"
+        )
 
 
 class TestRegistration:
@@ -234,6 +334,8 @@ class TestRegistration:
             "LanguageDetector", "Translate", "AnalyzeImage", "OCR",
             "DescribeImage", "TagImage", "DetectFace", "DetectLastAnomaly",
             "DetectEntireSeries", "BingImageSearch",
+            "IdentifyFaces", "VerifyFaces", "GroupFaces", "FindSimilarFace",
+            "SpeechToText",
         ]:
             assert cls in names, f"{cls} not registered"
 

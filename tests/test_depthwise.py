@@ -65,6 +65,47 @@ class TestDepthwiseGrower:
             rtol=1e-5, atol=1e-6,
         )
 
+    def test_split_batch_1_reproduces_lossguide_exactly(self):
+        # split_batch=1 routes lossguide through the windowed grower with
+        # one best-first split per pass — the SPLIT SEQUENCE (leaf, feat,
+        # bin, gain order) must equal grow_tree's exactly, not just the
+        # final loss.
+        rng = np.random.default_rng(7)
+        n, F, B = 1500, 6, 33
+        bins = rng.integers(0, B - 1, size=(n, F))
+        grad = rng.normal(size=n).astype(np.float32)
+        hess = np.ones(n, np.float32)
+        common = dict(num_bins=B, num_leaves=9, min_data_in_leaf=10,
+                      learning_rate=1.0)
+        args = (jnp.asarray(bins), jnp.asarray(grad), jnp.asarray(hess),
+                jnp.ones(n, jnp.float32), jnp.ones(F, bool))
+        tl, ids_l = grow_tree(GrowConfig(**common), *args)
+        tb, ids_b = grow_tree_depthwise(
+            GrowConfig(**common, split_batch=1), *args
+        )
+        np.testing.assert_array_equal(np.asarray(tl.split_leaf), np.asarray(tb.split_leaf))
+        np.testing.assert_array_equal(np.asarray(tl.split_feat), np.asarray(tb.split_feat))
+        np.testing.assert_array_equal(np.asarray(tl.split_bin), np.asarray(tb.split_bin))
+        np.testing.assert_array_equal(np.asarray(ids_l), np.asarray(ids_b))
+        np.testing.assert_allclose(
+            np.asarray(tl.leaf_value), np.asarray(tb.leaf_value),
+            rtol=1e-5, atol=1e-6,
+        )
+
+    def test_split_batch_intermediate_quality(self):
+        # k between 1 and a full level: valid trees, same budget, quality
+        # within the lossguide/depthwise envelope.
+        X, y = _toy(3000)
+        base = dict(objective="binary", num_iterations=10, num_leaves=15,
+                    min_data_in_leaf=10)
+        ds = Dataset(X, y)
+        auc_k = {}
+        for k in (1, 3, 0):
+            b = train(dict(base, grow_policy="lossguide", split_batch=k)
+                      if k else dict(base), ds)
+            auc_k[k] = _auc(y, b.predict(X))
+        assert auc_k[3] > 0.8 and auc_k[1] > 0.8
+
     def test_depth_constraint(self):
         rng = np.random.default_rng(2)
         n = 800

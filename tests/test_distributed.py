@@ -130,6 +130,32 @@ class TestDataParallelTraining:
         assert abs(_auc(y, ps) - _auc(y, pd)) < 5e-3
         assert _auc(y, pd) > 0.9
 
+    def test_process_local_matches_mesh_training(self):
+        # process_local=True routes through make_array_from_process_local_
+        # data + the summed-stats init path; with one process it must equal
+        # regular mesh training exactly (same shapes → same program).
+        X, y = _make_binary(n=2048, F=8, seed=5)
+        params = dict(objective="binary", num_iterations=8, num_leaves=15,
+                      min_data_in_leaf=5, tree_learner="data")
+        bm = BinMapper(max_bin=63).fit(X)
+        a = train(dict(params), Dataset(X, y), bin_mapper=bm)
+        b = train(dict(params), Dataset(X, y), bin_mapper=bm,
+                  process_local=True)
+        np.testing.assert_allclose(a.predict(X), b.predict(X), rtol=1e-5, atol=1e-6)
+
+    def test_process_local_rejects_unsupported(self):
+        X, y = _make_binary(n=512, F=4, seed=6)
+        bm = BinMapper(max_bin=31).fit(X)
+        with pytest.raises(NotImplementedError, match="valid_sets"):
+            train(dict(objective="binary", num_iterations=2, num_leaves=7,
+                       tree_learner="data"),
+                  Dataset(X, y), valid_sets=[Dataset(X, y)], bin_mapper=bm,
+                  process_local=True)
+        with pytest.raises(NotImplementedError, match="quantile/median"):
+            train(dict(objective="regression_l1", num_iterations=2,
+                       num_leaves=7, tree_learner="data"),
+                  Dataset(X, y), bin_mapper=bm, process_local=True)
+
     def test_distributed_tree_structure_replicated(self):
         # All shards must agree on every split (psum-identical argmax): the
         # booster's trees are finite and produce a LightGBM model string.

@@ -143,3 +143,26 @@ class TestCheckpointedBoosting:
         np.testing.assert_allclose(
             b1.predict(X), b2.predict(X), rtol=1e-4, atol=1e-5
         )
+
+    def test_early_stopped_rerun_is_stable(self, tmp_path):
+        # A completed early-stopped run must return the SAME forest on
+        # rerun with the same checkpoint_dir, not resume past the recorded
+        # stopping point (round-2 advisor finding).
+        rng = np.random.default_rng(1)
+        X = rng.normal(size=(300, 4))
+        y = rng.normal(size=300)  # pure noise → valid metric stops improving
+        Xv, yv = rng.normal(size=(100, 4)), rng.normal(size=100)
+        params = dict(
+            objective="regression", num_iterations=40, num_leaves=7,
+            min_data_in_leaf=5, learning_rate=0.5,
+            early_stopping_round=2, checkpoint_dir=str(tmp_path),
+            checkpoint_every=5,
+        )
+        b1 = train(dict(params), Dataset(X, y), valid_sets=[Dataset(Xv, yv)])
+        assert b1.num_iterations < 40  # early stopping actually fired
+        b2 = train(dict(params), Dataset(X, y), valid_sets=[Dataset(Xv, yv)])
+        assert b2.num_iterations == b1.num_iterations
+        assert b2.best_iteration == b1.best_iteration
+        np.testing.assert_allclose(
+            b1.predict(X), b2.predict(X), rtol=1e-4, atol=1e-5
+        )

@@ -395,6 +395,11 @@ PERSIST_ONLY = {
     "DescribeImage": "tests/test_cognitive.py",
     "TagImage": "tests/test_cognitive.py",
     "DetectFace": "tests/test_cognitive.py",
+    "IdentifyFaces": "tests/test_cognitive.py",
+    "VerifyFaces": "tests/test_cognitive.py",
+    "GroupFaces": "tests/test_cognitive.py",
+    "FindSimilarFace": "tests/test_cognitive.py",
+    "SpeechToText": "tests/test_cognitive.py",
     "DetectLastAnomaly": "tests/test_cognitive.py",
     "DetectEntireSeries": "tests/test_cognitive.py",
     "BingImageSearch": "tests/test_cognitive.py",

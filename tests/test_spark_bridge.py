@@ -62,22 +62,52 @@ _WORKER = textwrap.dedent(
     )
 
     pid = int(sys.argv[1]); port = sys.argv[2]
+    PARAMS = dict(objective="binary", num_iterations=3, num_leaves=7,
+                  min_data_in_leaf=2, tree_learner="data")
+
+    def partition(p):
+        rng = np.random.default_rng(p)
+        # DIFFERING partition sizes: the process-local padding must agree
+        # across processes without any process seeing the other's rows.
+        X = rng.normal(size=(60 + 13 * p, 3))
+        y = (X[:, 0] > 0).astype(np.float64)
+        return X, y
+
     # the "task info" list every barrier task sees
     addresses = [f"127.0.0.1:{{port}}", "127.0.0.1:0"]
     ctx = barrier_context_from_task_infos(addresses, pid,
                                           coordinator_port=int(port))
-    rng = np.random.default_rng(pid)
-    X = rng.normal(size=(60, 3))
-    y = (X[:, 0] > 0).astype(np.float64)
+    X, y = partition(pid)
     rows = np.column_stack([X, y])
-    model_str = barrier_train_task(
-        rows, ctx,
-        dict(objective="binary", num_iterations=3, num_leaves=7,
-             min_data_in_leaf=2, tree_learner="data"),
-        timeout_s=60,
-    )
-    print(json.dumps({{"pid": pid, "has_model": model_str is not None,
-                       "model_head": (model_str or "")[:9]}}))
+    model_str = barrier_train_task(rows, ctx, dict(PARAMS), timeout_s=60)
+
+    out = {{"pid": pid, "has_model": model_str is not None,
+            "model_head": (model_str or "")[:9]}}
+    # (a) sketch thresholds == mapper fit on the merged rows.  The sketch
+    # is a collective, so BOTH workers run it; pid 0 compares against a
+    # TEST-side oracle that regenerates both partitions (the data path
+    # itself never moves raw rows between processes).
+    from mmlspark_tpu.ops.binning import BinMapper, distributed_fit
+    bm_dist = distributed_fit(X, max_bin=255)
+    if pid == 0:
+        from mmlspark_tpu.engine.booster import Booster, Dataset, train
+        X1, y1 = partition(1)
+        X_all = np.concatenate([X, X1]); y_all = np.concatenate([y, y1])
+        bm_ref = BinMapper(max_bin=255).fit(X_all)
+        out["thresholds_equal"] = bool(
+            len(bm_dist.upper_bounds) == len(bm_ref.upper_bounds)
+            and all(np.array_equal(a, b) for a, b in
+                    zip(bm_dist.upper_bounds, bm_ref.upper_bounds))
+        )
+        # (b) the distributed booster == serial training on the merge
+        # (same thresholds; split raw thresholds ride the model string).
+        dist = Booster.from_model_string(model_str)
+        serial = train(dict(PARAMS, tree_learner="serial"),
+                       Dataset(X_all, y_all), bin_mapper=bm_ref)
+        out["preds_match"] = bool(np.allclose(
+            dist.predict(X_all), serial.predict(X_all), rtol=1e-4, atol=1e-5
+        ))
+    print(json.dumps(out))
     """
 )
 
@@ -110,3 +140,6 @@ def test_barrier_train_task_two_processes(tmp_path):
     # other task returns None
     assert by_pid[0]["has_model"] and by_pid[0]["model_head"] == "tree\nvers"
     assert not by_pid[1]["has_model"]
+    # distributed sketch == merged-fit thresholds; dist model == serial
+    assert by_pid[0]["thresholds_equal"]
+    assert by_pid[0]["preds_match"]

@@ -1,0 +1,59 @@
+"""Sweep split_batch k on the bench config: wall-clock + AUC per k.
+
+Run on the real TPU.  Steady runs exercise the new Dataset binning cache,
+so the deltas here are device-side.
+"""
+
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, "/root/repo")
+
+from bench import N_ITER, N_ROWS, NUM_LEAVES, MAX_BIN, auc, make_data
+
+
+def main():
+    import jax
+
+    jax.config.update("jax_compilation_cache_dir", "/tmp/mmlspark_tpu_jit_cache")
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
+    from mmlspark_tpu.engine.booster import Dataset, train
+
+    X, y = make_data()
+    ds = Dataset(X, y)
+    print(f"backend={jax.default_backend()}", flush=True)
+
+    ks = [int(a) for a in sys.argv[1:]] or [0, 16, 8, 4, 1]
+    for k in ks:
+        params = dict(
+            objective="binary", num_iterations=N_ITER, num_leaves=NUM_LEAVES,
+            max_bin=MAX_BIN, min_data_in_leaf=20, learning_rate=0.1,
+            hist_backend="pallas" if jax.default_backend() == "tpu" else "scatter",
+            hist_chunk=N_ROWS, hist_precision="default",
+        )
+        if k == 0:
+            params["grow_policy"] = "depthwise"
+            name = "depthwise(k=0)"
+        else:
+            params.update(grow_policy="lossguide", split_batch=k)
+            name = f"lossguide k={k}"
+        t0 = time.perf_counter()
+        booster = train(params, ds)
+        cold = time.perf_counter() - t0
+        runs = []
+        for _ in range(2):
+            t0 = time.perf_counter()
+            booster = train(params, ds)
+            runs.append(time.perf_counter() - t0)
+        a = auc(y[:100_000], booster.predict(X[:100_000]))
+        print(
+            f"{name}: cold={cold:.2f}s steady={[round(r, 2) for r in runs]} "
+            f"auc={a:.4f}", flush=True,
+        )
+
+
+if __name__ == "__main__":
+    main()
