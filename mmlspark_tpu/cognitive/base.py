@@ -71,6 +71,10 @@ class CognitiveServicesBase(Transformer, HasOutputCol, HasServiceParams):
     # Content-Type stamped on raw-bytes bodies; services with typed binary
     # payloads (e.g. SpeechToText's audio/wav) override this.
     _BYTES_CONTENT_TYPE = "application/octet-stream"
+    # ServiceParams the default ``_prepare`` resolves to per-row vectors
+    # (value-or-column duality); subclasses list their params here instead
+    # of re-implementing the resolution loop.
+    _VECTOR_PARAMS: tuple = ()
 
     def setLocation(self, value: str) -> "CognitiveServicesBase":
         self._paramMap["location"] = value
@@ -83,7 +87,11 @@ class CognitiveServicesBase(Transformer, HasOutputCol, HasServiceParams):
         return f"https://{self.getLocation()}.{self._DEFAULT_DOMAIN}{self._URL_PATH}"
 
     def _prepare(self, df: DataFrame) -> Dict[str, Any]:
-        return {}
+        n = df.count()
+        return {
+            name: self.getVectorParam(df, name) or [None] * n
+            for name in self._VECTOR_PARAMS
+        }
 
     def _row_query(self, ctx: Dict[str, Any], i: int) -> Dict[str, str]:
         return {}

@@ -10,10 +10,7 @@ the value-or-column duality and key/concurrency/error handling come from
 
 from __future__ import annotations
 
-from typing import Any, Dict
-
 from mmlspark_tpu.cognitive.base import CognitiveServicesBase, is_missing
-from mmlspark_tpu.core.frame import DataFrame
 from mmlspark_tpu.core.params import ServiceParam
 from mmlspark_tpu.core.registry import register_stage
 
@@ -25,19 +22,12 @@ def _as_id_list(v):
     return [str(x) for x in v]
 
 
-class _FaceBase(CognitiveServicesBase):
-    _VECTOR_PARAMS: tuple = ()
-
-    def _prepare(self, df: DataFrame) -> Dict[str, Any]:
-        n = df.count()
-        return {
-            name: self.getVectorParam(df, name) or [None] * n
-            for name in self._VECTOR_PARAMS
-        }
+# All four face transformers use the base class's _VECTOR_PARAMS-driven
+# _prepare (value-or-column resolution lives once, in the base).
 
 
 @register_stage
-class IdentifyFaces(_FaceBase):
+class IdentifyFaces(CognitiveServicesBase):
     """1-to-many identification against a (large) person group
     (``IdentifyFaces``)."""
 
@@ -79,7 +69,7 @@ class IdentifyFaces(_FaceBase):
 
 
 @register_stage
-class VerifyFaces(_FaceBase):
+class VerifyFaces(CognitiveServicesBase):
     """Face-to-face or face-to-person verification (``VerifyFaces``)."""
 
     _URL_PATH = "/face/v1.0/verify"
@@ -114,7 +104,7 @@ class VerifyFaces(_FaceBase):
 
 
 @register_stage
-class GroupFaces(_FaceBase):
+class GroupFaces(CognitiveServicesBase):
     """Cluster face IDs into similarity groups (``GroupFaces``)."""
 
     _URL_PATH = "/face/v1.0/group"
@@ -128,7 +118,7 @@ class GroupFaces(_FaceBase):
 
 
 @register_stage
-class FindSimilarFace(_FaceBase):
+class FindSimilarFace(CognitiveServicesBase):
     """Similar-face search against a face list or explicit IDs
     (``FindSimilarFace``)."""
 

@@ -11,10 +11,7 @@ audio stack is needed for parity.
 
 from __future__ import annotations
 
-from typing import Any, Dict
-
 from mmlspark_tpu.cognitive.base import CognitiveServicesBase, is_missing
-from mmlspark_tpu.core.frame import DataFrame
 from mmlspark_tpu.core.params import ServiceParam
 from mmlspark_tpu.core.registry import register_stage
 
@@ -44,14 +41,7 @@ class SpeechToText(CognitiveServicesBase):
         "profanity", "masked | removed | raw", default={"value": "masked"}
     )
 
-    def _prepare(self, df: DataFrame) -> Dict[str, Any]:
-        n = df.count()
-        return {
-            "audio": self.getVectorParam(df, "audioData") or [None] * n,
-            "language": self.getVectorParam(df, "language") or ["en-US"] * n,
-            "format": self.getVectorParam(df, "format") or ["simple"] * n,
-            "profanity": self.getVectorParam(df, "profanity") or ["masked"] * n,
-        }
+    _VECTOR_PARAMS = ("audioData", "language", "format", "profanity")
 
     def _row_query(self, ctx, i):
         lang = ctx["language"][i]
@@ -64,5 +54,5 @@ class SpeechToText(CognitiveServicesBase):
         }
 
     def _row_body(self, ctx, i):
-        a = ctx["audio"][i]
+        a = ctx["audioData"][i]
         return None if is_missing(a) else bytes(a)

@@ -505,7 +505,10 @@ def _feature_mask(key, F: int, fraction: float):
 # ---------------------------------------------------------------------------
 # The training loop
 # ---------------------------------------------------------------------------
-_PARALLEL_LEARNERS = ("data", "data_parallel", "voting", "voting_parallel")
+_PARALLEL_LEARNERS = (
+    "data", "data_parallel", "voting", "voting_parallel",
+    "feature", "feature_parallel",
+)
 
 # Jitted whole-run scan programs cached ACROSS train() calls (bounded FIFO).
 # jax.jit caches per function object; without this, every fit (each AutoML
@@ -584,15 +587,22 @@ def train(
 
     cfg = params if isinstance(params, TrainConfig) else TrainConfig.from_params(params)
     if cfg.tree_learner in ("feature", "feature_parallel"):
-        # LightGBM's feature-parallel partitions columns but still needs all
-        # data on every worker; on a TPU mesh it has no bandwidth advantage
-        # over data-parallel.  Be loud instead of silently degrading
-        # (round-1 advisor finding).
-        warnings.warn(
-            "tree_learner='feature' is not implemented; training with the "
-            "serial learner (identical model — feature-parallel changes "
-            "communication, not results)"
-        )
+        if cfg.categorical_feature:
+            # The categorical split scan needs the static categorical
+            # column set, which cannot differ per shard inside one SPMD
+            # program; LightGBM's own guidance prefers data-parallel for
+            # such workloads anyway.
+            raise NotImplementedError(
+                "tree_learner='feature' does not support categorical_feature; "
+                "use tree_learner='data' (identical model, different "
+                "communication pattern)"
+            )
+        if process_local:
+            raise NotImplementedError(
+                "tree_learner='feature' replicates rows across shards and is "
+                "incompatible with process_local row ingestion; use "
+                "tree_learner='data'"
+            )
     if cfg.boosting == "dart" and cfg.early_stopping_round > 0:
         # Later DART iterations rescale earlier trees, so a truncated-at-
         # best-iteration model cannot reproduce the selected metric.
@@ -721,10 +731,45 @@ def train(
     # ---- binning (cached on the Dataset — LightGBM bins at Dataset
     # construction and reuses across training calls) --------------------
     if bin_mapper is None:
-        bin_mapper = train_set.fitted_mapper(cfg)
+        if process_local:
+            # A per-process local fit would give every process DIFFERENT
+            # thresholds (silently wrong model); route through the
+            # distributed sample-sketch so all processes agree.
+            from mmlspark_tpu.ops.binning import distributed_fit
+
+            # distinct from fitted_mapper's key: the sketch samples
+            # differently, so the two fits must never share a cache slot
+            key = ("dist", cfg.max_bin, tuple(cfg.categorical_feature), cfg.seed)
+            bin_mapper = train_set._mapper_cache.get(key)
+            if bin_mapper is None:
+                bin_mapper = distributed_fit(
+                    train_set.X,
+                    max_bin=cfg.max_bin,
+                    categorical_features=tuple(cfg.categorical_feature),
+                    seed=cfg.seed,
+                    threads=cfg.num_threads,
+                )
+                train_set._mapper_cache = {key: bin_mapper}
+        else:
+            bin_mapper = train_set.fitted_mapper(cfg)
     bins_np = train_set.binned(bin_mapper)
     n, F = bins_np.shape
     B = bin_mapper.num_bins
+
+    # ---- feature-parallel: columns sharded, rows replicated ------------
+    feature_par = (
+        cfg.tree_learner in ("feature", "feature_parallel")
+        and mesh is not None
+        and D > 1
+    )
+    F_real = F
+    if feature_par:
+        # Pad columns to a multiple of the shard count; padded columns are
+        # masked out of every candidate search (feat_valid below).
+        f_pad = (-F) % D
+        if f_pad:
+            bins_np = np.pad(bins_np, ((0, 0), (0, f_pad)))
+            F += f_pad
 
     # ---- padding: shard count × histogram chunk ------------------------
     # Each of the D shards holds n_local rows; n_local must be one chunk or
@@ -743,10 +788,13 @@ def train(
             n_local = ((n_local + chunk - 1) // chunk) * chunk
         n_pad = n_local * d_local - n  # THIS process's padding
     else:
-        n_local = (n + D - 1) // D
+        # feature-parallel replicates rows: every shard holds all n rows,
+        # so only the histogram-chunk alignment applies.
+        D_rows = 1 if feature_par else D
+        n_local = (n + D_rows - 1) // D_rows
         if n_local > chunk:
             n_local = ((n_local + chunk - 1) // chunk) * chunk
-        n_pad = n_local * D - n
+        n_pad = n_local * D_rows - n
     bins_np = _pad_rows(bins_np, n_pad)
     y = _pad_rows(train_set.label, n_pad)
     valid_mask_np = np.concatenate([np.ones(n, bool), np.zeros(n_pad, bool)])
@@ -813,9 +861,23 @@ def train(
     # Under a mesh, rows are sharded over the data axis up front so the
     # binned matrix lives partitioned in HBM (SURVEY.md §7.2) and per-
     # iteration programs never reshuffle it.
-    dev_key = (id(bin_mapper), n_pad, _mesh_cache_key(mesh), process_local)
+    dev_key = (
+        id(bin_mapper), n_pad, _mesh_cache_key(mesh), process_local, feature_par,
+    )
     bins_dev = train_set._dev_bins_cache.get(dev_key)
-    if process_local:
+    if feature_par:
+        from jax.sharding import NamedSharding
+        from jax.sharding import PartitionSpec as P
+
+        col_sh = NamedSharding(mesh, P(None, DATA_AXIS))  # columns sharded
+        rep = NamedSharding(mesh, P())  # rows replicated on every shard
+        if bins_dev is None:
+            bins_dev = jax.device_put(bins_np, col_sh)
+        y_dev = jax.device_put(y.astype(np.float32), rep)
+        w_dev = None if w_np is None else jax.device_put(w_np.astype(np.float32), rep)
+        valid_mask = jax.device_put(valid_mask_np, rep)
+        init_scores_dev = jax.device_put(init_arr, rep)
+    elif process_local:
         # Multi-controller assembly: each process contributes ONLY its
         # (padded) partition; jax stitches the global sharded arrays from
         # the per-process pieces.  No host ever sees another's rows.
@@ -878,6 +940,11 @@ def train(
             f"grow_policy={grow_policy!r}"
         )
         grow_policy = "depthwise"
+    split_batch = cfg.split_batch
+    if feature_par and grow_policy == "lossguide" and split_batch == 0:
+        # The winner exchange lives in the windowed grower; one split per
+        # pass reproduces LightGBM's exact leaf-wise sequence there.
+        split_batch = 1
     gcfg = GrowConfig(
         num_bins=B,
         num_leaves=cfg.num_leaves,
@@ -892,7 +959,7 @@ def train(
         hist_chunk=chunk,
         hist_precision=cfg.hist_precision,
         grow_policy=grow_policy,
-        split_batch=cfg.split_batch,
+        split_batch=split_batch,
         categorical_features=tuple(int(f) for f in cfg.categorical_feature),
         cat_smooth=cfg.cat_smooth,
         cat_l2=cfg.cat_l2,
@@ -918,6 +985,30 @@ def train(
 
     if mesh is None:
         grow = _grow_classes(gcfg)
+    elif feature_par:
+        # Feature-parallel shard_map: COLUMNS sharded (bins + feature
+        # masks), rows/gradients replicated; each shard histograms only its
+        # feature block and the winner exchange (all_gather of per-leaf
+        # candidates + owner psum of the row partition) replaces the
+        # histogram allreduce entirely — LightGBM tree_learner=feature
+        # (SURVEY.md §2 parallelism table).
+        from jax.sharding import PartitionSpec as P
+
+        tree_spec = Tree(*([P()] * len(Tree._fields)))
+        grow = jax.shard_map(
+            _grow_classes(
+                dataclasses.replace(
+                    gcfg, axis_name=DATA_AXIS, feature_parallel=True
+                )
+            ),
+            mesh=mesh,
+            in_specs=(
+                P(None, DATA_AXIS), P(None, None), P(None, None), P(None),
+                P(None, DATA_AXIS),
+            ),
+            out_specs=(tree_spec, P(None, None)),
+            check_vma=False,
+        )
     else:
         # Per-shard grower: local rows in, psum-med histograms inside
         # (GrowConfig.axis_name), replicated tree out.  check_vma=False: the
@@ -933,6 +1024,15 @@ def train(
             out_specs=(tree_spec, P(None, DATA_AXIS)),
             check_vma=False,
         )
+
+    def _fmask_one(key):
+        # feature_fraction samples over the REAL features; feature-parallel
+        # padding columns stay masked out (False) so no shard ever proposes
+        # a split on one.
+        m = _feature_mask(key, F_real, cfg.feature_fraction)
+        if F != F_real:
+            m = jnp.pad(m, (0, F - F_real))
+        return m
 
     # Device data enters the jitted step as ARGUMENTS, never closure
     # captures: closed-over arrays become jaxpr constants and XLA spends
@@ -953,9 +1053,7 @@ def train(
             bag = _bag_weights(gkey, cfg, vmask_a, grad_abs)
         else:
             bag = bag_in
-        fmask = jax.vmap(lambda k: _feature_mask(k, F, cfg.feature_fraction))(
-            jax.random.split(fkey, K)
-        )
+        fmask = jax.vmap(_fmask_one)(jax.random.split(fkey, K))
         tree, leaf_ids = grow(bins_a, grad, hess, bag, fmask)
         delta = jax.vmap(lambda lv, li: lv[li])(tree.leaf_value, leaf_ids)
         return tree, delta
@@ -1087,9 +1185,9 @@ def train(
                         )
                     else:
                         bag = vmask_a.astype(jnp.float32)
-                    fmask = jax.vmap(
-                        lambda k: _feature_mask(k, F, cfg.feature_fraction)
-                    )(jax.random.split(fkey, K))
+                    fmask = jax.vmap(_fmask_one)(
+                        jax.random.split(fkey, K)
+                    )
                     tree, leaf_ids = grow(bins_a, grad, hess, bag, fmask)
                     delta = jax.vmap(lambda lv, li: lv[li])(tree.leaf_value, leaf_ids)
                     scores_c = scores_c + delta

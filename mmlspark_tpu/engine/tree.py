@@ -90,6 +90,22 @@ class GrowConfig:
     # meaningful under shard_map (axis_name set); depthwise grower only.
     voting: bool = False
     top_k: int = 20
+    # Feature-parallel (SURVEY.md §2 parallelism table; LightGBM
+    # tree_learner=feature): COLUMNS are sharded across the mesh axis and
+    # rows are replicated.  Each shard builds histograms and candidates for
+    # only its feature block (no histogram allreduce at all); per-leaf
+    # local winners are all-gathered (a few scalars per leaf), every shard
+    # elects the identical global winner, and the OWNING shard broadcasts
+    # the per-row left/right partition via one psum — exactly LightGBM's
+    # "communicate best split, winner broadcasts the row partition"
+    # structure.  Same split decisions as serial up to float-summation
+    # order: histogramming a narrow column block accumulates in a different
+    # order than the full-width build, so gains match only to ulps and a
+    # near-tied split can resolve differently (LightGBM's distributed
+    # learners have the same property vs its serial learner).  Windowed
+    # grower only; numeric features only (a static per-shard categorical
+    # set cannot exist in one SPMD program).
+    feature_parallel: bool = False
     # k-batched best-first growth (TPU-first generalization): at most
     # ``split_batch`` splits are applied per histogram pass, selected
     # best-first by gain over ALL current leaves.  0 = a full level's worth
@@ -116,6 +132,10 @@ class GrowConfig:
     @property
     def voting_active(self) -> bool:
         return self.voting and self.axis_name is not None
+
+    @property
+    def feature_parallel_active(self) -> bool:
+        return self.feature_parallel and self.axis_name is not None
 
     @property
     def level_window(self) -> int:
@@ -598,9 +618,14 @@ def grow_tree_depthwise(
     ).astype(jnp.float32)  # (3, n) channel-major
 
     # Under voting-parallel the carried histogram buffer stays LOCAL per
-    # shard (votes + elected slices are the only collectives); otherwise
-    # the builders psum so the buffer is globally replicated.
-    hist_axis = None if cfg.voting_active else cfg.axis_name
+    # shard (votes + elected slices are the only collectives); under
+    # feature-parallel it is local by CONSTRUCTION (each shard owns its
+    # columns outright — no histogram collective exists in the mode);
+    # otherwise the builders psum so the buffer is globally replicated.
+    hist_axis = (
+        None if (cfg.voting_active or cfg.feature_parallel_active)
+        else cfg.axis_name
+    )
 
     def window_hist(win_leaf):
         return build_histogram_by_leaf(
@@ -627,12 +652,56 @@ def grow_tree_depthwise(
     def level(carry):
         leaf_ids, hists, tree, leaf_depth, step, _ = carry
         cur_leaves = tree.num_leaves
-        # feature 0's bins tile all rows → per-leaf totals
-        leaf_stats = hists[:, :L, 0, :].sum(axis=-1)  # (3, L)
+        if cfg.feature_parallel_active:
+            # Per-leaf totals from a segment-sum over the REPLICATED rows:
+            # every shard computes bit-identical stats (local feature 0
+            # differs per shard, and its different float summation order
+            # would skew near-tied gains differently across shards,
+            # breaking the lowest-feature tie agreement with serial).
+            leaf_stats = jax.vmap(
+                lambda v: jnp.zeros(L, jnp.float32).at[leaf_ids].add(
+                    v, mode="drop"
+                )
+            )(vals)  # (3, L)
+        else:
+            # feature 0's bins tile all rows → per-leaf totals
+            leaf_stats = hists[:, :L, 0, :].sum(axis=-1)  # (3, L)
         if cfg.voting_active:
             gain, f, t, dleft, is_cat, hists_sel, sel_feats, sel_j = (
                 _voting_leaf_candidates(cfg, hists[:, :L], leaf_stats, feat_mask)
             )
+        elif cfg.feature_parallel_active:
+            # Candidates over the LOCAL feature block, then the winner
+            # exchange: all-gather each shard's per-leaf best (4 scalars
+            # per leaf) and argmax across shards.  Ties pick the lowest
+            # shard (argmax-first), whose within-shard winner is its lowest
+            # local index — together the lowest GLOBAL feature index,
+            # identical to the serial argmax tie-break (features are
+            # sharded in contiguous ascending blocks).
+            gain_l, f_l, t_l, d_l, _ = _leaf_candidates(
+                cfg, hists[:, :L], leaf_stats, feat_mask
+            )
+            ax = cfg.axis_name
+            shard = lax.axis_index(ax)
+            cand = jnp.stack([
+                gain_l,
+                (f_l + shard * F).astype(jnp.float32),  # global feature id
+                t_l.astype(jnp.float32),
+                d_l.astype(jnp.float32),
+            ])  # (4, L)
+            allc = lax.all_gather(cand, ax)  # (D, 4, L)
+            win_shard = jnp.argmax(allc[:, 0, :], axis=0)  # (L,)
+
+            def take_s(c):
+                return jnp.take_along_axis(allc[:, c, :], win_shard[None], axis=0)[0]
+
+            gain = take_s(0)
+            f = take_s(1).astype(jnp.int32)  # GLOBAL index (for the record)
+            t = take_s(2).astype(jnp.int32)
+            dleft = take_s(3) > 0.5
+            is_cat = jnp.zeros(L, bool)
+            fp_own = win_shard == shard  # (L,) leaf's winner lives here
+            fp_f_local = jnp.clip(f - shard * F, 0, F - 1)
         else:
             gain, f, t, dleft, is_cat = _leaf_candidates(
                 cfg, hists[:, :L], leaf_stats, feat_mask
@@ -678,15 +747,31 @@ def grow_tree_depthwise(
 
         # -- per-row moves (one gather per row on its leaf's split) -------
         sel_row = selected[leaf_ids]
-        f_row = f[leaf_ids]
-        fcol = jnp.take_along_axis(bins_t, f_row[None, :], axis=0)[0]
-        is_missing = fcol == (B - 1)
-        goes_left = jnp.where(is_missing, dleft[leaf_ids], fcol <= t[leaf_ids])
-        if cfg.has_categoricals:
-            # One flat gather per row — members[leaf_ids] would materialize
-            # an (n, B) intermediate just to read one bool per row.
-            cat_left = members.reshape(-1)[leaf_ids * B + fcol]
-            goes_left = jnp.where(is_cat[leaf_ids], cat_left, goes_left)
+        if cfg.feature_parallel_active:
+            # Only the winner-owning shard can read the split column; it
+            # computes the row partition and broadcasts it with one psum —
+            # LightGBM feature-parallel's "winner broadcasts the split
+            # result" step (its n-bit bitset → an n-vector reduction here).
+            f_row = fp_f_local[leaf_ids]
+            fcol = jnp.take_along_axis(bins_t, f_row[None, :], axis=0)[0]
+            is_missing = fcol == (B - 1)
+            gl_local = jnp.where(is_missing, dleft[leaf_ids], fcol <= t[leaf_ids])
+            own_row = fp_own[leaf_ids]
+            goes_left = lax.psum(
+                jnp.where(own_row, gl_local.astype(jnp.float32), 0.0),
+                cfg.axis_name,
+            ) > 0.5
+        else:
+            f_row = f[leaf_ids]
+            fcol = jnp.take_along_axis(bins_t, f_row[None, :], axis=0)[0]
+            is_missing = fcol == (B - 1)
+            goes_left = jnp.where(is_missing, dleft[leaf_ids], fcol <= t[leaf_ids])
+            if cfg.has_categoricals:
+                # One flat gather per row — members[leaf_ids] would
+                # materialize an (n, B) intermediate just to read one bool
+                # per row.
+                cat_left = members.reshape(-1)[leaf_ids * B + fcol]
+                goes_left = jnp.where(is_cat[leaf_ids], cat_left, goes_left)
         move = sel_row & ~goes_left
         leaf_ids = jnp.where(move, new_id_of_leaf[leaf_ids], leaf_ids)
 
@@ -734,7 +819,9 @@ def grow_tree_depthwise(
     leaf_stats = jax.vmap(
         lambda v: jnp.zeros(L, jnp.float32).at[leaf_ids].add(v, mode="drop")
     )(vals)  # (3, L)
-    if cfg.axis_name is not None:
+    if cfg.axis_name is not None and not cfg.feature_parallel_active:
+        # Row-sharded modes sum partial stats; feature-parallel replicates
+        # rows, so the local sum is already the global sum.
         leaf_stats = lax.psum(leaf_stats, cfg.axis_name)
     leaf_value = _leaf_output(
         leaf_stats[0], leaf_stats[1], cfg.lambda_l1, cfg.lambda_l2,
@@ -758,8 +845,13 @@ def grow_tree_depthwise(
 def grow_tree_auto(cfg: GrowConfig, *args):
     # split_batch routes lossguide through the windowed grower too (k
     # best-first splits per windowed pass; k=1 reproduces grow_tree's split
-    # sequence exactly — see GrowConfig.split_batch).
-    if cfg.grow_policy == "depthwise" or cfg.split_batch > 0:
+    # sequence exactly — see GrowConfig.split_batch).  Feature-parallel's
+    # winner exchange only exists in the windowed grower.
+    if (
+        cfg.grow_policy == "depthwise"
+        or cfg.split_batch > 0
+        or cfg.feature_parallel_active
+    ):
         return grow_tree_depthwise(cfg, *args)
     return grow_tree(cfg, *args)
 

@@ -1,0 +1,116 @@
+// Sanitizer harness for binner.cpp (SURVEY.md §5.2: the reference's C++
+// gets ASAN/TSAN jobs; here the native binner gets an ASAN+UBSAN pass).
+//
+// Built and run by tests/test_native_binner.py::test_sanitizer_pass and the
+// CI sanitize job:
+//   g++ -std=c++17 -O1 -g -pthread -fsanitize=address,undefined \
+//       -fno-sanitize-recover=all binner.cpp sanitize_main.cpp -o harness
+// Exit 0 = no sanitizer findings; any finding aborts with non-zero.
+//
+// Exercises the edge cases the Python fallback parity tests cover, plus
+// shapes that stress indexing: all-NaN columns, constant columns, heavy
+// duplicates, more distinct values than max_bin, tiny/large thread counts,
+// max_bin at the uint8 boundary.
+
+#include <cmath>
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <limits>
+#include <vector>
+
+extern "C" {
+void mml_binner_fit(const double*, long, long, int, int, const uint8_t*,
+                    double*, int*, int);
+void mml_binner_transform(const double*, long, long, const double*,
+                          const int*, int, int, uint8_t*, int);
+}
+
+namespace {
+
+unsigned long long rng_state = 0x9E3779B97F4A7C15ULL;
+double urand() {  // xorshift — deterministic, no libc rand concerns
+  rng_state ^= rng_state << 13;
+  rng_state ^= rng_state >> 7;
+  rng_state ^= rng_state << 17;
+  return static_cast<double>(rng_state % 1000003) / 1000003.0;
+}
+
+int run_case(long n, long F, int max_bin, int threads) {
+  std::vector<double> X(static_cast<size_t>(n) * F);
+  for (long i = 0; i < n; ++i) {
+    for (long f = 0; f < F; ++f) {
+      double v;
+      if (f == 0) {
+        v = std::numeric_limits<double>::quiet_NaN();  // all-NaN column
+      } else if (f == 1) {
+        v = 42.0;  // constant column
+      } else if (f == 2) {
+        v = static_cast<double>(i % 5);  // few distinct values
+      } else {
+        v = urand() * 100.0 - 50.0;
+        if ((i + f) % 17 == 0) v = std::numeric_limits<double>::quiet_NaN();
+        if ((i + f) % 23 == 0) v = 0.0;  // duplicates incl. ±0 interplay
+        if ((i + f) % 29 == 0) v = -0.0;
+      }
+      X[static_cast<size_t>(i) * F + f] = v;
+    }
+  }
+  std::vector<uint8_t> skip(static_cast<size_t>(F), 0);
+  if (F > 3) skip[3] = 1;  // one "categorical" column left to the caller
+  std::vector<double> uppers(static_cast<size_t>(F) * max_bin, 0.0);
+  std::vector<int> counts(static_cast<size_t>(F), 0);
+  mml_binner_fit(X.data(), n, F, max_bin, 3, skip.data(), uppers.data(),
+                 counts.data(), threads);
+  for (long f = 0; f < F; ++f) {
+    if (skip[f]) {
+      if (counts[f] != 0) return 1;
+      continue;
+    }
+    if (counts[f] < 1 || counts[f] > max_bin) return 2;
+    // last boundary must be +inf so every finite value lands in range
+    if (!std::isinf(uppers[static_cast<size_t>(f) * max_bin + counts[f] - 1]))
+      return 3;
+  }
+  std::vector<uint8_t> out(static_cast<size_t>(n) * F, 255);
+  mml_binner_transform(X.data(), n, F, uppers.data(), counts.data(), max_bin,
+                       max_bin, out.data(), threads);
+  for (long i = 0; i < n; ++i) {
+    for (long f = 0; f < F; ++f) {
+      if (skip[f]) continue;  // untouched by contract
+      uint8_t b = out[static_cast<size_t>(i) * F + f];
+      double x = X[static_cast<size_t>(i) * F + f];
+      if (std::isnan(x)) {
+        if (b != max_bin) return 4;
+      } else if (b >= counts[f]) {
+        return 5;
+      }
+    }
+  }
+  return 0;
+}
+
+}  // namespace
+
+int main() {
+  struct {
+    long n, F;
+    int max_bin, threads;
+  } cases[] = {
+      {1, 1, 255, 1},        // minimal shapes
+      {997, 7, 15, 1},       // odd sizes, serial
+      {5000, 8, 255, 4},     // threaded, uint8-boundary max_bin
+      {20000, 5, 63, 16},    // more threads than a balanced split needs
+      {4096, 3, 2, 2},       // tiny bin budget forces the greedy walk
+  };
+  for (auto& c : cases) {
+    int rc = run_case(c.n, c.F, c.max_bin, c.threads);
+    if (rc != 0) {
+      std::fprintf(stderr, "case n=%ld F=%ld max_bin=%d threads=%d -> %d\n",
+                   c.n, c.F, c.max_bin, c.threads, rc);
+      return rc;
+    }
+  }
+  std::puts("sanitize harness: all cases OK");
+  return 0;
+}
