@@ -4,10 +4,7 @@ DescribeImage, TagImage, GenerateThumbnails pattern; Face DetectFace)."""
 
 from __future__ import annotations
 
-from typing import Any, Dict
-
 from mmlspark_tpu.cognitive.base import CognitiveServicesBase, is_missing
-from mmlspark_tpu.core.frame import DataFrame
 from mmlspark_tpu.core.params import ServiceParam
 from mmlspark_tpu.core.registry import register_stage
 
@@ -15,30 +12,20 @@ from mmlspark_tpu.core.registry import register_stage
 class _ImageInputBase(CognitiveServicesBase):
     """Image input duality (reference ``HasImageInput``): either an image
     URL column/value (JSON ``{"url": ...}`` body) or raw image bytes
-    (octet-stream body)."""
+    (octet-stream body).  Resolution of all ServiceParams rides the base
+    class's ``_VECTOR_PARAMS`` ``_prepare`` — subclasses extend the tuple
+    with their query params."""
 
     imageUrl = ServiceParam("imageUrl", "Image URL (value or column)")
     imageBytes = ServiceParam("imageBytes", "Raw image bytes (value or column)")
 
-    _EXTRA_VECTOR_PARAMS: tuple = ()
-
-    def _prepare(self, df: DataFrame) -> Dict[str, Any]:
-        n = df.count()
-        ctx = {
-            "url": self.getVectorParam(df, "imageUrl") or [None] * n,
-            "bytes": self.getVectorParam(df, "imageBytes") or [None] * n,
-        }
-        # every other ServiceParam resolves per-row too (value-or-column
-        # duality holds for query params, not just the image input)
-        for name in self._EXTRA_VECTOR_PARAMS:
-            ctx[name] = self.getVectorParam(df, name) or [None] * n
-        return ctx
+    _VECTOR_PARAMS = ("imageUrl", "imageBytes")
 
     def _row_body(self, ctx, i):
-        if not is_missing(ctx["bytes"][i]):
-            return bytes(ctx["bytes"][i])
-        if not is_missing(ctx["url"][i]):
-            return {"url": str(ctx["url"][i])}
+        if not is_missing(ctx["imageBytes"][i]):
+            return bytes(ctx["imageBytes"][i])
+        if not is_missing(ctx["imageUrl"][i]):
+            return {"url": str(ctx["imageUrl"][i])}
         return None
 
 
@@ -51,7 +38,7 @@ class AnalyzeImage(_ImageInputBase):
     visualFeatures = ServiceParam(
         "visualFeatures", "Comma-joined features (Categories,Tags,Description,...)"
     )
-    _EXTRA_VECTOR_PARAMS = ("visualFeatures",)
+    _VECTOR_PARAMS = _ImageInputBase._VECTOR_PARAMS + ("visualFeatures",)
 
     def _row_query(self, ctx, i):
         vf = ctx["visualFeatures"][i]
@@ -67,7 +54,7 @@ class OCR(_ImageInputBase):
     detectOrientation = ServiceParam(
         "detectOrientation", "Detect text orientation", default={"value": True}
     )
-    _EXTRA_VECTOR_PARAMS = ("detectOrientation",)
+    _VECTOR_PARAMS = _ImageInputBase._VECTOR_PARAMS + ("detectOrientation",)
 
     def _row_query(self, ctx, i):
         v = ctx["detectOrientation"][i]
@@ -83,7 +70,7 @@ class DescribeImage(_ImageInputBase):
     maxCandidates = ServiceParam(
         "maxCandidates", "Caption candidates", default={"value": 1}
     )
-    _EXTRA_VECTOR_PARAMS = ("maxCandidates",)
+    _VECTOR_PARAMS = _ImageInputBase._VECTOR_PARAMS + ("maxCandidates",)
 
     def _row_query(self, ctx, i):
         v = ctx["maxCandidates"][i]
@@ -109,7 +96,9 @@ class DetectFace(_ImageInputBase):
     returnFaceLandmarks = ServiceParam(
         "returnFaceLandmarks", "Return the 27-point landmarks", default={"value": False}
     )
-    _EXTRA_VECTOR_PARAMS = ("returnFaceAttributes", "returnFaceLandmarks")
+    _VECTOR_PARAMS = _ImageInputBase._VECTOR_PARAMS + (
+        "returnFaceAttributes", "returnFaceLandmarks",
+    )
 
     def _row_query(self, ctx, i):
         lm = ctx["returnFaceLandmarks"][i]

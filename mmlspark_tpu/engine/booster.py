@@ -586,23 +586,12 @@ def train(
     import warnings
 
     cfg = params if isinstance(params, TrainConfig) else TrainConfig.from_params(params)
-    if cfg.tree_learner in ("feature", "feature_parallel"):
-        if cfg.categorical_feature:
-            # The categorical split scan needs the static categorical
-            # column set, which cannot differ per shard inside one SPMD
-            # program; LightGBM's own guidance prefers data-parallel for
-            # such workloads anyway.
-            raise NotImplementedError(
-                "tree_learner='feature' does not support categorical_feature; "
-                "use tree_learner='data' (identical model, different "
-                "communication pattern)"
-            )
-        if process_local:
-            raise NotImplementedError(
-                "tree_learner='feature' replicates rows across shards and is "
-                "incompatible with process_local row ingestion; use "
-                "tree_learner='data'"
-            )
+    if cfg.tree_learner in ("feature", "feature_parallel") and process_local:
+        raise NotImplementedError(
+            "tree_learner='feature' replicates rows across shards and is "
+            "incompatible with process_local row ingestion; use "
+            "tree_learner='data'"
+        )
     if cfg.boosting == "dart" and cfg.early_stopping_round > 0:
         # Later DART iterations rescale earlier trees, so a truncated-at-
         # best-iteration model cannot reproduce the selected metric.
@@ -764,6 +753,17 @@ def train(
     )
     F_real = F
     if feature_par:
+        if cfg.categorical_feature:
+            # The categorical split scan needs the static categorical
+            # column set, which cannot differ per shard inside one SPMD
+            # program.  Checked only when the mode actually ENGAGES (>1
+            # shard): on a single device the learner trains serially, where
+            # categoricals work — matching LightGBM's 1-machine behavior.
+            raise NotImplementedError(
+                "tree_learner='feature' does not support categorical_feature "
+                "on a multi-device mesh; use tree_learner='data' (identical "
+                "model, different communication pattern)"
+            )
         # Pad columns to a multiple of the shard count; padded columns are
         # masked out of every candidate search (feat_valid below).
         f_pad = (-F) % D
@@ -1213,13 +1213,18 @@ def train(
 
         # Reuse the jitted program across train() calls when nothing it
         # closes over can differ.  The cached program closes over the FIRST
-        # call's objective instance, which is sound only because objectives
-        # are stateless-by-construction (Objective.stateful); instances that
-        # carry per-dataset state (LambdaRank's group matrix) are excluded.
-        if obj.stateful:
+        # call's objective instance, which is sound because objectives are
+        # stateless-by-construction (Objective.stateful) — stateful ones
+        # (LambdaRank's group matrix) participate only when their state
+        # fingerprint is part of the key, and are rebuilt otherwise.
+        state_key = obj.state_key() if obj.stateful else None
+        if obj.stateful and state_key is None:
             scan_chunk = _build_scan_chunk()
         else:
-            cache_key = (_cfg_cache_key(cfg), K, F, B, _mesh_cache_key(mesh))
+            cache_key = (
+                _cfg_cache_key(cfg), K, F, B, _mesh_cache_key(mesh),
+                type(obj).__name__, state_key,
+            )
             scan_chunk = _SCAN_CACHE.get(cache_key)
             if scan_chunk is None:
                 scan_chunk = _build_scan_chunk()

@@ -151,7 +151,12 @@ class GrowConfig:
         need = max(1, (self.num_leaves + 1) // 2)
         if self.split_batch > 0:
             need = min(need, self.split_batch)
-        return 1 << (need - 1).bit_length()
+        # Round to a sublane-friendly multiple of 4, not a power of two:
+        # the by-leaf kernel's matmul M is 3·W, so a k=12 batch at W=12
+        # (M=36) does 25% less work than the old W=16 (M=48).  Tiny
+        # windows stay exact — rounding 1→4 would 4x the k=1 (exact
+        # lossguide) pass.
+        return need if need <= 4 else ((need + 3) // 4) * 4
 
 
 class Tree(NamedTuple):
@@ -373,22 +378,26 @@ def _candidate_matrix(cfg: GrowConfig, hists, leaf_stats, feat_mask):
     return gain, t, d
 
 
+def _reduce_candidates(cfg: GrowConfig, gain_m, t_m, d_m):
+    """(L, F) candidate matrices → per-leaf best (gain, f, t, d, is_cat)."""
+    L, F = gain_m.shape
+    f = jnp.argmax(gain_m, axis=1).astype(jnp.int32)  # (L,)
+    take = lambda a: jnp.take_along_axis(a, f[:, None], axis=1)[:, 0]  # noqa: E731
+    if cfg.has_categoricals:
+        is_cat = jnp.asarray(_cat_feat_mask(cfg, F))[f]
+    else:
+        is_cat = jnp.zeros(L, bool)
+    return take(gain_m), f, take(t_m), take(d_m), is_cat
+
+
 def _leaf_candidates(cfg: GrowConfig, hists, leaf_stats, feat_mask):
     """Best candidate PER LEAF over all features (numeric + categorical).
 
     Returns per-leaf (gain (L,), feat, t, d, is_cat); leaves with no valid
     candidate get gain=-inf.  hists is channel-major (3, L, F, B).
     """
-    _, L, F, B = hists.shape
     gain, t, d = _candidate_matrix(cfg, hists, leaf_stats, feat_mask)
-    f = jnp.argmax(gain, axis=1).astype(jnp.int32)  # (L,)
-    take = lambda a: jnp.take_along_axis(a, f[:, None], axis=1)[:, 0]  # noqa: E731
-    best_gain = take(gain)
-    if cfg.has_categoricals:
-        is_cat = jnp.asarray(_cat_feat_mask(cfg, F))[f]
-    else:
-        is_cat = jnp.zeros(L, bool)
-    return best_gain, f, take(t), take(d), is_cat
+    return _reduce_candidates(cfg, gain, t, d)
 
 
 def _voting_leaf_candidates(cfg: GrowConfig, hists_local, leaf_stats_local, feat_mask):
@@ -641,6 +650,25 @@ def grow_tree_depthwise(
     )  # (3, F, B)
     hists0 = jnp.zeros((3, LB, F, B), jnp.float32).at[:, 0].set(root_hist)
 
+    # Incremental candidate cache (serial + data-parallel paths): only the
+    # ≤ 2W leaves whose histograms a pass touches (split parents + new
+    # children) get their (L, F) candidate rows re-scored — candidates per
+    # leaf depend only on that leaf's own histogram, so unchanged rows are
+    # bitwise stable.  Kills the full (3·L·F·B) cumsum+argmax chain every
+    # pass (L/2W of it is redundant).  Voting re-scores LOCAL candidates
+    # against re-psum-ed stats and feature-parallel re-scores local blocks
+    # per shard, so both keep the full per-pass compute.
+    use_cand_cache = not (cfg.voting_active or cfg.feature_parallel_active)
+    if use_cand_cache:
+        stats0 = hists0[:, :L, 0, :].sum(axis=-1)
+        cand0 = _candidate_matrix(cfg, hists0[:, :L], stats0, feat_mask)
+    else:  # dummy carry slot (shapes must match across the while_loop)
+        cand0 = (
+            jnp.full((L, F), -jnp.inf, jnp.float32),
+            jnp.zeros((L, F), jnp.int32),
+            jnp.zeros((L, F), bool),
+        )
+
     # Split-record arrays get one extra scratch slot (index S) that
     # non-selected leaves harmlessly scatter into; trimmed at the end.
     tree0 = _empty_tree(S + 1, L, B)
@@ -650,7 +678,8 @@ def grow_tree_depthwise(
         return ~carry[-1]
 
     def level(carry):
-        leaf_ids, hists, tree, leaf_depth, step, _ = carry
+        leaf_ids, hists, tree, leaf_depth, step, cand, _ = carry
+        gain_m, t_m, d_m = cand
         cur_leaves = tree.num_leaves
         if cfg.feature_parallel_active:
             # Per-leaf totals from a segment-sum over the REPLICATED rows:
@@ -663,10 +692,12 @@ def grow_tree_depthwise(
                     v, mode="drop"
                 )
             )(vals)  # (3, L)
-        else:
+        elif not use_cand_cache:
             # feature 0's bins tile all rows → per-leaf totals
             leaf_stats = hists[:, :L, 0, :].sum(axis=-1)  # (3, L)
-        if cfg.voting_active:
+        if use_cand_cache:
+            gain, f, t, dleft, is_cat = _reduce_candidates(cfg, gain_m, t_m, d_m)
+        elif cfg.voting_active:
             gain, f, t, dleft, is_cat, hists_sel, sel_feats, sel_j = (
                 _voting_leaf_candidates(cfg, hists[:, :L], leaf_stats, feat_mask)
             )
@@ -702,10 +733,6 @@ def grow_tree_depthwise(
             is_cat = jnp.zeros(L, bool)
             fp_own = win_shard == shard  # (L,) leaf's winner lives here
             fp_f_local = jnp.clip(f - shard * F, 0, F - 1)
-        else:
-            gain, f, t, dleft, is_cat = _leaf_candidates(
-                cfg, hists[:, :L], leaf_stats, feat_mask
-            )
         leaf_ok = leaf_arange < cur_leaves
         if cfg.max_depth > 0:
             leaf_ok &= leaf_depth < cfg.max_depth
@@ -782,6 +809,23 @@ def grow_tree_depthwise(
         sub = jnp.where(selected[None, :, None, None], win[:, widx], 0.0)
         hists = hists.at[:, :L].add(-sub)
 
+        if use_cand_cache:
+            # Re-score ONLY the ≤2W leaves whose histograms changed: the
+            # split parents (now left children, post-subtraction) and the
+            # new right children.  Unselected slots park at LB (gather
+            # clipped harmlessly, scatter dropped), so shapes stay static.
+            warange = jnp.arange(W, dtype=jnp.int32)
+            parent_slots = order[:W].astype(jnp.int32)
+            parent_ids = jnp.where(selected[parent_slots], parent_slots, LB)
+            child_ids = jnp.where(warange < k, base + warange, LB)
+            changed = jnp.concatenate([parent_ids, child_ids])  # (2W,)
+            h_ch = jnp.take(hists, jnp.minimum(changed, LB - 1), axis=1)
+            stats_ch = h_ch[:, :, 0, :].sum(axis=-1)  # (3, 2W)
+            cg, ct, cd = _candidate_matrix(cfg, h_ch, stats_ch, feat_mask)
+            gain_m = gain_m.at[changed].set(cg, mode="drop")
+            t_m = t_m.at[changed].set(ct, mode="drop")
+            d_m = d_m.at[changed].set(cd, mode="drop")
+
         # -- record the level's splits (scratch slot S absorbs the rest) --
         tree = tree._replace(
             split_leaf=tree.split_leaf.at[step_of_leaf].set(
@@ -807,13 +851,16 @@ def grow_tree_depthwise(
         leaf_depth = jnp.where(selected, child_depth, leaf_depth)
 
         stop = (k == 0) | (tree.num_leaves >= L)
-        return (leaf_ids, hists, tree, leaf_depth, step + k, stop)
+        return (
+            leaf_ids, hists, tree, leaf_depth, step + k,
+            (gain_m, t_m, d_m), stop,
+        )
 
     carry = (
         jnp.zeros(n, jnp.int32), hists0, tree0, jnp.zeros(L, jnp.int32),
-        jnp.asarray(0, jnp.int32), jnp.asarray(False),
+        jnp.asarray(0, jnp.int32), cand0, jnp.asarray(False),
     )
-    leaf_ids, _, tree, leaf_depth, _, _ = lax.while_loop(cond, level, carry)
+    leaf_ids, _, tree, leaf_depth, _, _, _ = lax.while_loop(cond, level, carry)
 
     # Final per-leaf (G, H, count) in one cheap per-channel segment-sum.
     leaf_stats = jax.vmap(

@@ -63,6 +63,15 @@ class Objective:
     def _init_from_avg(self, avg: float):
         return 0.0  # objectives without bias folding keep a zero seed
 
+    def state_key(self):
+        """Fingerprint of per-dataset state for STATEFUL objectives, or
+        None when state is unset/unfingerprintable.  Lets the booster's
+        cross-call scan-program cache include stateful instances safely:
+        same config + same state key ⇒ the closed-over instance computes
+        identical gradients, so the compiled program is reusable (without
+        this, every lambdarank train() call re-traced the whole scan)."""
+        return None
+
     # -- device-side -----------------------------------------------------
     def grad_hess(
         self, score: jnp.ndarray, y: jnp.ndarray, w: Optional[jnp.ndarray]
@@ -363,7 +372,11 @@ class LambdaRank(Objective):
             start += s
         self._group_idx = jnp.asarray(idx)
         self._group_valid = jnp.asarray(valid)
+        self._state_key = hash(sizes.tobytes())
         return self
+
+    def state_key(self):
+        return getattr(self, "_state_key", None)
 
     def _gains(self, labels):
         if self.label_gain is not None:

@@ -341,3 +341,12 @@ class TestShardedInference:
         out = stage.transform(df)
         got = np.stack(list(out["out"]))
         np.testing.assert_allclose(got, X @ W + b, rtol=1e-4, atol=1e-5)
+
+
+class TestCNTKIngestionContract:
+    def test_raw_cntk_bytes_raise_with_conversion_guidance(self):
+        from mmlspark_tpu.models.cntk_model import CNTKModel
+
+        m = CNTKModel().setModel(b"\x42CNTKv2 not-an-onnx-graph\x00\x01")
+        with pytest.raises(ValueError, match="convert it to ONNX"):
+            m._graph()
