@@ -141,10 +141,11 @@ class GrowConfig:
     def level_window(self) -> int:
         """Static width of the per-pass new-children window (depthwise).
 
-        A level's split count is bounded by min(current leaves, remaining
+        A pass's split count is bounded by min(current leaves, remaining
         budget) ≤ ⌈num_leaves/2⌉ — if half the budget is already leaves,
-        the remaining budget is under half — so the next power of two of
-        ⌈num_leaves/2⌉ always fits every level's new right children.  With
+        the remaining budget is under half — and the selection logic
+        additionally caps the per-pass budget at W itself, so any W ≥ the
+        rounded need below fits every pass's new right children.  With
         ``split_batch`` set, the per-pass split count (hence the window) is
         capped at the batch size instead.
         """

@@ -269,22 +269,13 @@ def _pallas_hist_by_leaf(
     return out.transpose(1, 2, 0, 3, 4).reshape(3, num_leaves, F, num_bins)
 
 
-def pallas_hist_by_leaf_chunk(
+def _prep_by_leaf_chunk(
     bins_c, vals_c, leaf_c, num_leaves: int, num_bins: int,
-    bm: int = 16384, bf: int = 32, rm: int = 1024, precision: str = "highest",
-    transposed: bool = False,
-) -> jnp.ndarray:
-    """(C, F) bins + (3, C) vals + (C,) leaf ids → (3, L, F, B).
-
-    ``transposed=True``: bins arrive pre-transposed (F, C) int32 (see
-    :func:`pallas_hist_chunk`).
-
-    ``rm`` bounds the VMEM one-hot tile AND sets the matmul contraction
-    length; ``bm`` is the DMA/grid granularity.  Defaults from a traced
-    sweep at 262k×64×256/W=32 on v5e: bf=32 amortizes the per-sub-block
-    leaf-side rhs build over 4x more matmul work (10.3 → 6.0 ms/pass);
-    bf=64 and bm=32k×rm=2k blow the remote-compile VMEM budget.
-    """
+    bm: int, bf: int, rm: int, transposed: bool,
+):
+    """Shared wrapper prep for both by-leaf kernels: backend check,
+    transpose, block clamps, padding.  Returns
+    (bins_t, vals, leaf_row, bm, bf, rm, F, interpret)."""
     import jax as _jax
 
     backend = _jax.default_backend()
@@ -317,8 +308,156 @@ def pallas_hist_by_leaf_chunk(
         leaf_row = jnp.pad(leaf_row, ((0, 0), (0, pad_r)), constant_values=num_leaves)
     if pad_f:
         bins_t = jnp.pad(bins_t, ((0, pad_f), (0, 0)))
+    return bins_t, vals_c, leaf_row, bm, bf, rm, F, backend == "cpu"
+
+
+def pallas_hist_by_leaf_chunk(
+    bins_c, vals_c, leaf_c, num_leaves: int, num_bins: int,
+    bm: int = 16384, bf: int = 32, rm: int = 1024, precision: str = "highest",
+    transposed: bool = False,
+) -> jnp.ndarray:
+    """(C, F) bins + (3, C) vals + (C,) leaf ids → (3, L, F, B).
+
+    ``transposed=True``: bins arrive pre-transposed (F, C) int32 (see
+    :func:`pallas_hist_chunk`).
+
+    ``rm`` bounds the VMEM one-hot tile AND sets the matmul contraction
+    length; ``bm`` is the DMA/grid granularity.  Defaults from a traced
+    sweep at 262k×64×256/W=32 on v5e: bf=32 amortizes the per-sub-block
+    leaf-side rhs build over 4x more matmul work (10.3 → 6.0 ms/pass);
+    bf=64 and bm=32k×rm=2k blow the remote-compile VMEM budget.
+    """
+    bins_t, vals_c, leaf_row, bm, bf, rm, F, interp = _prep_by_leaf_chunk(
+        bins_c, vals_c, leaf_c, num_leaves, num_bins, bm, bf, rm, transposed
+    )
     out = _pallas_hist_by_leaf(
         bins_t, vals_c, leaf_row, num_leaves, num_bins, bm, bf, rm,
-        backend == "cpu", precision,
+        interp, precision,
+    )
+    return out[:, :, :F]
+
+
+# ---------------------------------------------------------------------------
+# Factorized (hi/lo) by-leaf kernel for SMALL leaf windows.
+#
+# At small W the plain kernel's matmul M = 3·W starves the MXU (W=12 →
+# M=36/128 ≈ 28% utilization, with the N axis already full at B=256).
+# Factoring the bin axis as bin = hi·LO + lo moves the hi part into M:
+#
+#     out[(c,l,hi), (f,lo)] = Σ_r vals[c,r]·1[leaf_r=l]·1[hi_rf=hi]·1[lo_rf=lo]
+#
+# i.e. per feature a (rm, 3·W·H) × (rm, LO) contraction with M = 3·W·H and
+# N = LO = 128 — identical FLOPs to the plain kernel (M·N invariant), twice
+# the MXU utilization at W≤16, and the (B, rm) one-hot build shrinks to
+# (W·H, rm) + (LO, rm).  Only pays when W is small: at W=32 the plain
+# kernel is already M-saturated and the per-feature lhs build dominates.
+# ---------------------------------------------------------------------------
+_NIBBLE_LO = 128
+
+
+def _hist_leaf_nibble_kernel(
+    bins_ref, vals_ref, leaf_ref, out_ref, *,
+    num_bins: int, num_leaves: int, rm: int, precision,
+):
+    i = pl.program_id(1)  # row block, innermost → accumulation is safe
+    bf, bm = bins_ref.shape
+    H = (num_bins + _NIBBLE_LO - 1) // _NIBBLE_LO
+    M = 3 * num_leaves * H
+
+    def sub(s, acc):
+        sl = pl.ds(s * rm, rm)
+        bins = bins_ref[:, sl]  # (bf, rm) int32
+        vals = vals_ref[:, sl]  # (3, rm) f32
+        leaf = leaf_ref[0, sl]  # (rm,) int32
+        # All operands keep ROWS ON LANES (rm trailing) — mixed-orientation
+        # tiles with a 24-wide trailing dim crashed the Mosaic compile.
+        iota_key = jax.lax.broadcasted_iota(
+            jnp.int32, (num_leaves * H, rm), 0
+        )
+        iota_lo = jax.lax.broadcasted_iota(jnp.int32, (_NIBBLE_LO, rm), 0)
+        parts = []
+        for f in range(bf):
+            hi = bins[f, :] >> 7  # LO = 128
+            lo = bins[f, :] & (_NIBBLE_LO - 1)
+            # parked rows (leaf outside [0, W)) produce keys outside the
+            # iota range → all-zero one-hot rows
+            key = leaf * H + hi
+            oh_key = (iota_key == key[None, :]).astype(jnp.float32)  # (WH, rm)
+            lhs = jnp.concatenate(
+                [oh_key * vals[c, :][None, :] for c in range(3)], axis=0
+            )  # (3·W·H, rm)
+            oh_lo = (iota_lo == lo[None, :]).astype(jnp.float32)  # (LO, rm)
+            parts.append(
+                jax.lax.dot_general(
+                    lhs, oh_lo,
+                    dimension_numbers=(((1,), (1,)), ((), ())),
+                    preferred_element_type=jnp.float32,
+                    precision=precision,
+                )  # (3·W·H, LO)
+            )
+        return acc + jnp.concatenate(parts, axis=1)  # (M, bf·LO)
+
+    part = jax.lax.fori_loop(
+        0, bm // rm, sub, jnp.zeros((M, bf * _NIBBLE_LO), jnp.float32)
+    )
+
+    @pl.when(i == 0)
+    def _init():
+        out_ref[...] = part[None]
+
+    @pl.when(i > 0)
+    def _acc():
+        out_ref[...] += part[None]
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=(
+        "num_leaves", "num_bins", "bm", "bf", "rm", "interpret", "precision"
+    ),
+)
+def _pallas_hist_by_leaf_nibble(
+    bins_t, vals, leaf_ids, num_leaves, num_bins, bm, bf, rm, interpret, precision
+):
+    F, n = bins_t.shape
+    H = (num_bins + _NIBBLE_LO - 1) // _NIBBLE_LO
+    M = 3 * num_leaves * H
+    kernel = functools.partial(
+        _hist_leaf_nibble_kernel, num_bins=num_bins, num_leaves=num_leaves,
+        rm=rm, precision=_PRECISIONS[precision],
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid=(F // bf, n // bm),
+        in_specs=[
+            pl.BlockSpec((bf, bm), lambda j, i: (j, i)),
+            pl.BlockSpec((3, bm), lambda j, i: (0, i)),
+            pl.BlockSpec((1, bm), lambda j, i: (0, i)),
+        ],
+        out_specs=pl.BlockSpec((1, M, bf * _NIBBLE_LO), lambda j, i: (j, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((F // bf, M, bf * _NIBBLE_LO), jnp.float32),
+        interpret=interpret,
+    )(bins_t, vals, leaf_ids)
+    # (F/bf, 3·W·H, bf·LO) → (3, W, F, H·LO) → slice the real bin range
+    out = out.reshape(F // bf, 3, num_leaves, H, bf, _NIBBLE_LO)
+    out = out.transpose(1, 2, 0, 4, 3, 5).reshape(
+        3, num_leaves, F, H * _NIBBLE_LO
+    )
+    return out[:, :, :, :num_bins]
+
+
+def pallas_hist_by_leaf_nibble_chunk(
+    bins_c, vals_c, leaf_c, num_leaves: int, num_bins: int,
+    bm: int = 16384, bf: int = 32, rm: int = 1024, precision: str = "highest",
+    transposed: bool = False,
+) -> jnp.ndarray:
+    """Factorized-bin variant of :func:`pallas_hist_by_leaf_chunk` — same
+    contract, intended for small windows (see module comment above)."""
+    bins_t, vals_c, leaf_row, bm, bf, rm, F, interp = _prep_by_leaf_chunk(
+        bins_c, vals_c, leaf_c, num_leaves, num_bins, bm, bf, rm, transposed
+    )
+    out = _pallas_hist_by_leaf_nibble(
+        bins_t, vals_c, leaf_row, num_leaves, num_bins, bm, bf, rm,
+        interp, precision,
     )
     return out[:, :, :F]
