@@ -503,6 +503,73 @@ def _feature_mask(key, F: int, fraction: float):
 
 
 # ---------------------------------------------------------------------------
+# Packed single-fetch transfers.  The remote-dispatch tunnel pays ~120ms
+# latency PER ARRAY fetched (measured: 9 tree-field fetches ≈ 1.1-1.3s where
+# one packed ~100KB fetch is ~0.15s), so a pytree headed for the host is
+# first packed device-side into ONE uint32 vector — numeric fields bitcast,
+# bool fields bit-packed 32× (cat_threshold is 97% of a chunk's bits) —
+# fetched once, and unpacked with numpy views.
+# ---------------------------------------------------------------------------
+@jax.jit
+def _pack_u32(pt):
+    parts = []
+    for a in jax.tree_util.tree_leaves(pt):
+        if a.dtype == jnp.bool_:
+            flat = a.ravel()
+            flat = jnp.pad(flat, (0, (-flat.size) % 32))
+            w = flat.reshape(-1, 32).astype(jnp.uint32)
+            parts.append(
+                (w << jnp.arange(32, dtype=jnp.uint32)[None, :]).sum(
+                    axis=1, dtype=jnp.uint32
+                )
+            )
+        else:
+            parts.append(jax.lax.bitcast_convert_type(a, jnp.uint32).ravel())
+    return jnp.concatenate(parts) if parts else jnp.zeros((0,), jnp.uint32)
+
+
+def fetch_packed(pt):
+    """``jax.device_get(pt)`` via one packed uint32 transfer (bit-exact)."""
+    leaves, treedef = jax.tree_util.tree_flatten(pt)
+    if any(a.dtype != jnp.bool_ and a.dtype.itemsize != 4 for a in leaves):
+        return jax.device_get(pt)  # e.g. x64 arrays: not 32-bit packable
+    packed = np.asarray(_pack_u32(pt))
+    out, off = [], 0
+    for a in leaves:
+        n = a.size
+        if a.dtype == jnp.bool_:
+            nw = (n + 31) // 32
+            bits = np.unpackbits(
+                packed[off : off + nw].view(np.uint8), bitorder="little"
+            )[:n]
+            out.append(bits.astype(bool).reshape(a.shape))
+            off += nw
+        else:
+            out.append(
+                packed[off : off + n]
+                .view(np.dtype(a.dtype.name))
+                .reshape(a.shape)
+            )
+            off += n
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+
+def _fetch_tree_chunks(chunks: List[Tree], has_cats: bool) -> List[Tree]:
+    """One packed fetch for a whole list of stacked-Tree chunks; without
+    categoricals the all-False ``cat_threshold`` planes (the bulk of the
+    bits) are dropped device-side and rebuilt host-side."""
+    if not has_cats:
+        shapes = [c.cat_threshold.shape for c in chunks]
+        slim = [c._replace(cat_threshold=jnp.zeros((0,), bool)) for c in chunks]
+        fetched = fetch_packed(slim)
+        return [
+            c._replace(cat_threshold=np.zeros(s, bool))
+            for c, s in zip(fetched, shapes)
+        ]
+    return fetch_packed(chunks)
+
+
+# ---------------------------------------------------------------------------
 # The training loop
 # ---------------------------------------------------------------------------
 _PARALLEL_LEARNERS = (
@@ -1034,6 +1101,33 @@ def train(
             m = jnp.pad(m, (0, F - F_real))
         return m
 
+    _delta_precision = (
+        jax.lax.Precision.DEFAULT
+        if cfg.hist_precision == "default"
+        else jax.lax.Precision.HIGHEST
+    )
+
+    def _leaf_delta(tree, leaf_ids):
+        # delta[k] = leaf_value[k][leaf_ids[k]] as a one-hot contraction:
+        # the (n,)-gather-from-(L,) lowering cost ~2.1ms/tree at the bench
+        # shape vs ~0.2ms for the compare+dot.  Precision follows
+        # cfg.hist_precision (same contract as the histogram kernels): the
+        # one-hot operand is exact either way; "default" rounds the f32
+        # leaf value to bf16 (~2^-9 relative) in the TRAINING-score
+        # accumulation only — the stored model keeps f32 leaf values, and
+        # "highest" makes training scores replay-exact against them.
+        return jax.vmap(
+            lambda lv, li: jax.lax.dot_general(
+                lv[None, :],
+                (
+                    li[None, :]
+                    == jnp.arange(lv.shape[0], dtype=li.dtype)[:, None]
+                ).astype(jnp.float32),
+                dimension_numbers=(((1,), (0,)), ((), ())),
+                precision=_delta_precision,
+            )[0]
+        )(tree.leaf_value, leaf_ids)
+
     # Device data enters the jitted step as ARGUMENTS, never closure
     # captures: closed-over arrays become jaxpr constants and XLA spends
     # minutes constant-folding through the 10s-of-MB binned matrix (75s →
@@ -1055,8 +1149,7 @@ def train(
             bag = bag_in
         fmask = jax.vmap(_fmask_one)(jax.random.split(fkey, K))
         tree, leaf_ids = grow(bins_a, grad, hess, bag, fmask)
-        delta = jax.vmap(lambda lv, li: lv[li])(tree.leaf_value, leaf_ids)
-        return tree, delta
+        return tree, _leaf_delta(tree, leaf_ids)
 
     # LightGBM bagging semantics: a bag is drawn at iterations where
     # ``it % bagging_freq == 0`` and *reused* until the next draw.
@@ -1189,7 +1282,7 @@ def train(
                         jax.random.split(fkey, K)
                     )
                     tree, leaf_ids = grow(bins_a, grad, hess, bag, fmask)
-                    delta = jax.vmap(lambda lv, li: lv[li])(tree.leaf_value, leaf_ids)
+                    delta = _leaf_delta(tree, leaf_ids)
                     scores_c = scores_c + delta
                     nv = len(vbins_a)
                     new_vs = []
@@ -1271,7 +1364,7 @@ def train(
             # the snapshot concatenates the host copies (atomic replace so
             # a crash never leaves a torn checkpoint).
             ckpt_host_chunks.append(
-                Tree(*[np.asarray(a) for a in jax.device_get(new_chunk)])
+                _fetch_tree_chunks([new_chunk], bool(cfg.categorical_feature))[0]
             )
             so_far = Tree(
                 *[np.concatenate(a, axis=0) for a in zip(*ckpt_host_chunks)]
@@ -1327,8 +1420,8 @@ def train(
         # checkpointing already host-copied every chunk — reuse those
         chunks_np = (
             ckpt_host_chunks if ckpt_path is not None
-            else jax.device_get(tree_chunks)
-        )  # one batched transfer otherwise
+            else _fetch_tree_chunks(tree_chunks, bool(cfg.categorical_feature))
+        )  # one packed transfer otherwise
         stacked = Tree(
             *[np.concatenate(arrs, axis=0)[:kept] for arrs in zip(*chunks_np)]
         )

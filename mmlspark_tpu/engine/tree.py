@@ -644,11 +644,11 @@ def grow_tree_depthwise(
             precision=cfg.hist_precision, transposed=True,
         )
 
-    root_hist = build_histogram(
-        bins_t, vals, jnp.ones(n, bool), B,
-        backend=cfg.hist_backend, chunk=cfg.hist_chunk, axis_name=hist_axis,
-        precision=cfg.hist_precision, transposed=True,
-    )  # (3, F, B)
+    # Root histogram through the SAME windowed kernel (all rows in slot 0):
+    # the plain per-feature kernel's M=3 matmuls cost 2.8ms/pass at the
+    # bench shape vs 1.9ms for the factorized windowed kernel, and reusing
+    # it drops one compiled kernel from the program.
+    root_hist = window_hist(jnp.zeros(n, jnp.int32))[:, 0]  # (3, F, B)
     hists0 = jnp.zeros((3, LB, F, B), jnp.float32).at[:, 0].set(root_hist)
 
     # Incremental candidate cache (serial + data-parallel paths): only the
@@ -773,9 +773,9 @@ def grow_tree_depthwise(
         else:
             members = jnp.zeros((L, B), bool)
 
-        # -- per-row moves (one gather per row on its leaf's split) -------
-        sel_row = selected[leaf_ids]
+        # -- per-row moves ------------------------------------------------
         if cfg.feature_parallel_active:
+            sel_row = selected[leaf_ids]
             # Only the winner-owning shard can read the split column; it
             # computes the row partition and broadcasts it with one psum —
             # LightGBM feature-parallel's "winner broadcasts the split
@@ -789,19 +789,30 @@ def grow_tree_depthwise(
                 jnp.where(own_row, gl_local.astype(jnp.float32), 0.0),
                 cfg.axis_name,
             ) > 0.5
+            move = sel_row & ~goes_left
+            leaf_ids = jnp.where(move, new_id_of_leaf[leaf_ids], leaf_ids)
         else:
-            f_row = f[leaf_ids]
-            fcol = jnp.take_along_axis(bins_t, f_row[None, :], axis=0)[0]
-            is_missing = fcol == (B - 1)
-            goes_left = jnp.where(is_missing, dleft[leaf_ids], fcol <= t[leaf_ids])
-            if cfg.has_categoricals:
-                # One flat gather per row — members[leaf_ids] would
-                # materialize an (n, B) intermediate just to read one bool
-                # per row.
-                cat_left = members.reshape(-1)[leaf_ids * B + fcol]
-                goes_left = jnp.where(is_cat[leaf_ids], cat_left, goes_left)
-        move = sel_row & ~goes_left
-        leaf_ids = jnp.where(move, new_id_of_leaf[leaf_ids], leaf_ids)
+            # Only the ≤W window leaves split this pass, so instead of a
+            # per-row gather of each row's split-feature bin out of the
+            # (F, n) matrix — a dynamic cross-sublane lookup that cost
+            # ~2.7ms/pass at the bench shape, more than the histogram
+            # kernel itself — read the ≤W split columns with W dynamic
+            # slices and resolve rows against their leaf's slot with
+            # n-sized selects (~0.2ms/pass).  A moved row's new id is
+            # ≥ base > every splittable leaf id, so later slots can never
+            # re-match it.
+            slot_leaves = order[:W].astype(jnp.int32)  # gain-ranked slots
+            for w in range(W):
+                l_w = slot_leaves[w]
+                col = lax.dynamic_slice(
+                    bins_t, (f[l_w], jnp.int32(0)), (1, n)
+                )[0]
+                gl_w = jnp.where(col == (B - 1), dleft[l_w], col <= t[l_w])
+                if cfg.has_categoricals:
+                    memb_w = lax.dynamic_slice(members, (l_w, 0), (1, B))[0]
+                    gl_w = jnp.where(is_cat[l_w], jnp.take(memb_w, col), gl_w)
+                moves_w = (leaf_ids == l_w) & selected[l_w] & ~gl_w
+                leaf_ids = jnp.where(moves_w, new_id_of_leaf[l_w], leaf_ids)
 
         # -- windowed new-children histograms + parent subtraction --------
         win = window_hist(leaf_ids - base)  # (3, W, F, B); old ids park <0
@@ -863,10 +874,16 @@ def grow_tree_depthwise(
     )
     leaf_ids, _, tree, leaf_depth, _, _, _ = lax.while_loop(cond, level, carry)
 
-    # Final per-leaf (G, H, count) in one cheap per-channel segment-sum.
-    leaf_stats = jax.vmap(
-        lambda v: jnp.zeros(L, jnp.float32).at[leaf_ids].add(v, mode="drop")
-    )(vals)  # (3, L)
+    # Final per-leaf (G, H, count) as a one-hot contraction — the
+    # scatter-add lowering cost ~1.8ms/tree at the bench shape vs ~0.2ms
+    # for the compare+dot (MXU, K=n contraction).
+    leaf_oh = (
+        leaf_ids[None, :] == jnp.arange(L, dtype=jnp.int32)[:, None]
+    ).astype(jnp.float32)  # (L, n)
+    leaf_stats = jax.lax.dot_general(
+        vals, leaf_oh, dimension_numbers=(((1,), (1,)), ((), ())),
+        precision=jax.lax.Precision.HIGHEST,
+    )  # (3, L)
     if cfg.axis_name is not None and not cfg.feature_parallel_active:
         # Row-sharded modes sum partial stats; feature-parallel replicates
         # rows, so the local sum is already the global sum.

@@ -150,7 +150,9 @@ class TestByLeafKernels:
 
         rng = np.random.default_rng(B + W)
         n, F = 2048, 9
-        bins = jnp.asarray(rng.integers(0, B - 1, size=(n, F)))
+        # inclusive of bin B-1: the top bin exercises the nibble kernel's
+        # hi plane and the H*128 -> num_bins slice at non-power-of-two B
+        bins = jnp.asarray(rng.integers(0, B, size=(n, F)))
         vals = jnp.asarray(rng.normal(size=(3, n)), dtype=jnp.float32)
         # parked ids on both sides of the window range
         leaf = jnp.asarray(rng.integers(-3, W + 2, size=(n,)), dtype=jnp.int32)
@@ -167,7 +169,7 @@ class TestByLeafKernels:
 
         rng = np.random.default_rng(7)
         n, F, B, W = 1024, 6, 256, 8
-        bins = jnp.asarray(rng.integers(0, B - 1, size=(n, F)))
+        bins = jnp.asarray(rng.integers(0, B, size=(n, F)))
         vals = jnp.asarray(rng.normal(size=(3, n)), dtype=jnp.float32)
         leaf = jnp.asarray(rng.integers(-1, W + 1, size=(n,)), dtype=jnp.int32)
         ref = np.asarray(build_histogram_by_leaf(bins, vals, leaf, W, B,

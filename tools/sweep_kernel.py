@@ -83,5 +83,4 @@ def nibble():
 
 if __name__ == "__main__":
     main()
-    if "--nibble" in sys.argv or True:  # both kernels by default
-        nibble()
+    nibble()
