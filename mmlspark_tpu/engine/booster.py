@@ -1033,6 +1033,9 @@ def train(
         max_cat_threshold=cfg.max_cat_threshold,
         voting=voting,
         top_k=cfg.top_k,
+        # classes grow sequentially (lax.map below), so the grower's
+        # one-hot stats operand is (L, n) f32 for ONE class at a time
+        onehot_stats=cfg.num_leaves * n <= 128_000_000,
     )
 
     def _grow_classes(gcfg_):
@@ -1106,6 +1109,10 @@ def train(
         if cfg.hist_precision == "default"
         else jax.lax.Precision.HIGHEST
     )
+    # The one-hot delta is vmapped over classes, so its operand is
+    # (K, L, n) f32 — fall back to the gather when that blows the budget
+    # (the gather needs only the (K, n) output).
+    _delta_onehot = K * cfg.num_leaves * n <= 128_000_000
 
     def _leaf_delta(tree, leaf_ids):
         # delta[k] = leaf_value[k][leaf_ids[k]] as a one-hot contraction:
@@ -1116,6 +1123,8 @@ def train(
         # leaf value to bf16 (~2^-9 relative) in the TRAINING-score
         # accumulation only — the stored model keeps f32 leaf values, and
         # "highest" makes training scores replay-exact against them.
+        if not _delta_onehot:
+            return jax.vmap(lambda lv, li: lv[li])(tree.leaf_value, leaf_ids)
         return jax.vmap(
             lambda lv, li: jax.lax.dot_general(
                 lv[None, :],

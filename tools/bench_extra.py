@@ -183,7 +183,11 @@ def bench_ranker():
     )
     import jax
     if jax.default_backend() == "tpu":
-        params.update(hist_backend="pallas", hist_chunk=n)
+        # Same precision protocol as bench.py: bf16 multiplies / f32
+        # accumulation.  Measured NDCG@5 0.8323 bf16 vs 0.8303 f32 at this
+        # config — the quality check below is the gate either way.
+        params.update(hist_backend="pallas", hist_chunk=n,
+                      hist_precision="default")
     ds = Dataset(X, y, group=group)
     t0 = time.perf_counter()
     booster = train(params, ds)
