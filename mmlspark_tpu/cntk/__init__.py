@@ -1,0 +1,11 @@
+"""CNTK v2 ``.model`` ingestion: Dictionary-format parser + ONNX converter.
+
+Reference parity (SURVEY.md §2.4/§2.9 N3): the reference evaluates CNTK
+graphs through the discontinued CNTK JNI runtime
+(UPSTREAM:.../cntk/CNTKModel.scala — [REF-EMPTY]).  Here the ``.model``
+protobuf (CNTK's Dictionary serialization of a CompositeFunction) is parsed
+directly and converted to the in-repo ONNX graph, which the XLA importer
+then lowers — no CNTK runtime involved.
+"""
+
+from mmlspark_tpu.cntk.converter import cntk_model_to_onnx  # noqa: F401

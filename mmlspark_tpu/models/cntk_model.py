@@ -37,25 +37,39 @@ class CNTKModel(_OnnxInferenceBase):
         return self.setModelLocation(payload_or_path)
 
     def _graph(self):
-        # LOUD ingestion contract (VERDICT r2 missing #6): this class
-        # evaluates the ONNX-converted graph, NOT raw CNTK ``.model``
-        # binaries (the CNTK runtime is discontinued; CNTK itself shipped
-        # ONNX export — run ``cntk_py.Function.load(m).save(path,
-        # format=ModelFormat.ONNX)`` out-of-band, once, per SURVEY §2.9 N3).
+        # Ingestion contract (VERDICT r2 missing #6): the payload may be
+        # either (a) an ONNX graph (the SURVEY §2.9 N3 interchange route —
+        # CNTK itself shipped ONNX export) or (b) a raw CNTK v2 ``.model``
+        # Dictionary, which the in-repo converter
+        # (:mod:`mmlspark_tpu.cntk.converter`) lowers to the same ONNX
+        # graph — no CNTK runtime involved.  ONNX is tried first; on parse
+        # failure the CNTK route runs, and if BOTH fail the error reports
+        # both causes.
         from google.protobuf.message import DecodeError
 
         payload = self.getModelPayload()  # missing-param errors stay as-is
         try:
             return super()._graph()
         except (DecodeError, ValueError, KeyError, IndexError, EOFError) as e:
-            # graph-parse failures only — import errors etc. propagate
+            onnx_err = e  # graph-parse failures only — import errors raise
+        try:
+            from mmlspark_tpu.cntk import cntk_model_to_onnx
+            from mmlspark_tpu.onnx import OnnxFunction
+
+            fn = OnnxFunction(cntk_model_to_onnx(bytes(payload)))
+            jitted = fn.jit()
+            # cache only once BOTH built — a partial cache would make later
+            # _graph() calls return a half-initialized function
+            self._fn_cache, self._jit_cache = fn, jitted
+            return self._fn_cache
+        except (DecodeError, ValueError, KeyError, IndexError, EOFError) as e2:
             raise ValueError(
                 f"CNTKModel could not parse the {len(payload)}-byte payload "
-                "as ONNX. If this is a raw CNTK .model file, convert it to "
-                "ONNX first (CNTK's own exporter: "
-                "Function.load(...).save(path, format=ONNX)) and pass the "
-                "converted bytes/path."
-            ) from e
+                f"as ONNX ({onnx_err}) nor as a CNTK v2 .model Dictionary "
+                f"({e2}). Supported: ONNX bytes, or CNTK v2 models using "
+                "the converter's op subset (see mmlspark_tpu/cntk/"
+                "converter.py)."
+            ) from e2
 
     def _resolve(self, sel, names):
         if isinstance(sel, int):

@@ -1,0 +1,226 @@
+"""CNTK v2 ``.model`` ingestion: converter + CNTKModel end-to-end.
+
+The payloads are built with the same schema the parser reads
+(``mmlspark_tpu/cntk/cntk.proto``, a subset of the public CNTK v2
+serialization schema), the way ``tests/test_onnx.py`` builds ONNX payloads
+with the in-repo helpers — so these tests pin the converter's op
+semantics and the CNTKModel fallback path, with numpy as the oracle.
+"""
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu.cntk.converter import (
+    cntk_model_to_onnx,
+    save_model_bytes,
+)
+from mmlspark_tpu.onnx import OnnxFunction
+
+
+def _softmax(z):
+    e = np.exp(z - z.max(axis=-1, keepdims=True))
+    return e / e.sum(axis=-1, keepdims=True)
+
+
+def _mlp_model(rng):
+    W1 = rng.normal(size=(4, 8)).astype(np.float32)
+    b1 = rng.normal(size=(8,)).astype(np.float32)
+    W2 = rng.normal(size=(8, 3)).astype(np.float32)
+    b2 = rng.normal(size=(3,)).astype(np.float32)
+    model = {
+        "type": "CompositeFunction",
+        "root": "sm_Output_0",
+        "inputs": [
+            {"uid": "x", "kind": 0, "shape": (4,), "name": "features"},
+            {"uid": "W1", "kind": 2, "shape": (4, 8), "value": W1},
+            {"uid": "b1", "kind": 2, "shape": (8,), "value": b1},
+            {"uid": "W2", "kind": 2, "shape": (8, 3), "value": W2},
+            {"uid": "b2", "kind": 3, "shape": (3,), "value": b2},
+        ],
+        "primitive_functions": [
+            # deliberately out of dependency order: the converter must sort
+            {"uid": "sm", "op": 10, "inputs": ["p2_Output_0"],
+             "attributes": {}},
+            {"uid": "t1", "op": 31, "inputs": ["x", "W1"], "attributes": {}},
+            {"uid": "p1", "op": 19, "inputs": ["t1_Output_0", "b1"],
+             "attributes": {}},
+            {"uid": "r1", "op": 3, "inputs": ["p1_Output_0"],
+             "attributes": {}},
+            {"uid": "t2", "op": 31, "inputs": ["r1_Output_0", "W2"],
+             "attributes": {}},
+            {"uid": "p2", "op": 19, "inputs": ["t2_Output_0", "b2"],
+             "attributes": {}},
+        ],
+    }
+    ref = lambda X: _softmax(np.maximum(X @ W1 + b1, 0) @ W2 + b2)  # noqa: E731
+    return model, ref
+
+
+class TestConverter:
+    def test_mlp_matches_numpy(self):
+        rng = np.random.default_rng(0)
+        model, ref = _mlp_model(rng)
+        fn = OnnxFunction(cntk_model_to_onnx(save_model_bytes(model)))
+        X = rng.normal(size=(16, 4)).astype(np.float32)
+        (out,) = fn({"x": X}).values()
+        np.testing.assert_allclose(np.asarray(out), ref(X), rtol=1e-4, atol=1e-5)
+
+    def test_conv_bn_pool_matches_numpy(self):
+        rng = np.random.default_rng(1)
+        C, H, Wd = 1, 8, 8
+        W = rng.normal(size=(2, C, 3, 3)).astype(np.float32)
+        scale = rng.uniform(0.5, 1.5, size=(2,)).astype(np.float32)
+        bias = rng.normal(size=(2,)).astype(np.float32)
+        mean = rng.normal(size=(2,)).astype(np.float32)
+        var = rng.uniform(0.5, 1.5, size=(2,)).astype(np.float32)
+        model = {
+            "type": "CompositeFunction",
+            "root": "pool",
+            "inputs": [
+                {"uid": "img", "kind": 0, "shape": (C, H, Wd)},
+                {"uid": "W", "kind": 2, "shape": (2, C, 3, 3), "value": W},
+                {"uid": "sc", "kind": 2, "shape": (2,), "value": scale},
+                {"uid": "bi", "kind": 2, "shape": (2,), "value": bias},
+                {"uid": "mu", "kind": 3, "shape": (2,), "value": mean},
+                {"uid": "va", "kind": 3, "shape": (2,), "value": var},
+            ],
+            "primitive_functions": [
+                # realistic serialization: 3-axis strides (logical
+                # (c, h, w) = (1, 1, 1)) and autoPadding in attribute
+                # order [w, h, c] with the channel axis NOT padded
+                {"uid": "conv", "op": 33, "inputs": ["W", "img"],
+                 "attributes": {"strides": (1, 1, 1),
+                                "autoPadding": [True, True, False]}},
+                {"uid": "bn", "op": 40,
+                 "inputs": ["conv_Output_0", "sc", "bi", "mu", "va"],
+                 "attributes": {"epsilon": 1e-5}},
+                {"uid": "relu", "op": 3, "inputs": ["bn_Output_0"],
+                 "attributes": {}},
+                {"uid": "pool", "op": 17, "inputs": ["relu_Output_0"],
+                 "attributes": {"poolingType": 0,
+                                "poolingWindowShape": (2, 2),
+                                "strides": (2, 2),
+                                "autoPadding": [False]}},
+            ],
+        }
+        fn = OnnxFunction(cntk_model_to_onnx(save_model_bytes(model)))
+        X = rng.normal(size=(3, C, H, Wd)).astype(np.float32)
+        out = np.asarray(list(fn({"img": X}).values())[0])
+
+        # numpy oracle
+        pad = np.pad(X, ((0, 0), (0, 0), (1, 1), (1, 1)))
+        conv = np.zeros((3, 2, H, Wd), np.float32)
+        for co in range(2):
+            for i in range(H):
+                for j in range(Wd):
+                    patch = pad[:, :, i : i + 3, j : j + 3]
+                    conv[:, co, i, j] = (patch * W[co]).sum(axis=(1, 2, 3))
+        bn = (conv - mean[None, :, None, None]) / np.sqrt(
+            var[None, :, None, None] + 1e-5
+        ) * scale[None, :, None, None] + bias[None, :, None, None]
+        relu = np.maximum(bn, 0)
+        ref = relu.reshape(3, 2, 4, 2, 4, 2).max(axis=(3, 5))
+        np.testing.assert_allclose(out, ref, rtol=1e-3, atol=1e-4)
+
+    def test_strided_conv_3axis_strides(self):
+        """Stride 2 serialized as a 3-axis NDShape (logical (1, 2, 2)):
+        the spatial dims must come out as the TRAILING entries."""
+        rng = np.random.default_rng(4)
+        W = rng.normal(size=(1, 1, 3, 3)).astype(np.float32)
+        model = {
+            "root": "conv",
+            "inputs": [
+                {"uid": "img", "kind": 0, "shape": (1, 8, 8)},
+                {"uid": "W", "kind": 2, "shape": (1, 1, 3, 3), "value": W},
+            ],
+            "primitive_functions": [
+                {"uid": "conv", "op": 33, "inputs": ["W", "img"],
+                 "attributes": {"strides": (1, 2, 2),
+                                "autoPadding": [True, True, False]}},
+            ],
+        }
+        fn = OnnxFunction(cntk_model_to_onnx(save_model_bytes(model)))
+        X = rng.normal(size=(2, 1, 8, 8)).astype(np.float32)
+        out = np.asarray(list(fn({"img": X}).values())[0])
+        assert out.shape == (2, 1, 4, 4), out.shape
+        pad = np.pad(X, ((0, 0), (0, 0), (1, 1), (1, 1)))
+        ref = np.zeros((2, 1, 4, 4), np.float32)
+        for i in range(4):
+            for j in range(4):
+                patch = pad[:, :, 2 * i : 2 * i + 3, 2 * j : 2 * j + 3]
+                ref[:, 0, i, j] = (patch * W[0]).sum(axis=(1, 2, 3))
+        np.testing.assert_allclose(out, ref, rtol=1e-3, atol=1e-4)
+
+    def test_splice_elementwise(self):
+        import mmlspark_tpu.cntk.cntk_pb2 as cpb
+
+        rng = np.random.default_rng(2)
+        a = rng.normal(size=(5,)).astype(np.float32)
+        model = {
+            "type": "CompositeFunction",
+            "root": "cat",
+            "inputs": [
+                {"uid": "x", "kind": 0, "shape": (5,)},
+                {"uid": "a", "kind": 3, "shape": (5,), "value": a},
+            ],
+            "primitive_functions": [
+                {"uid": "mul", "op": 21, "inputs": ["x", "a"],
+                 "attributes": {}},
+                {"uid": "sub", "op": 20, "inputs": ["x", "a"],
+                 "attributes": {}},
+                {"uid": "cat", "op": 43,
+                 "inputs": ["mul_Output_0", "sub_Output_0"],
+                 "attributes": {"axis": cpb.Axis(static_axis_idx=0)}},
+            ],
+        }
+        fn = OnnxFunction(cntk_model_to_onnx(save_model_bytes(model)))
+        X = rng.normal(size=(4, 5)).astype(np.float32)
+        out = np.asarray(list(fn({"x": X}).values())[0])
+        ref = np.concatenate([X * a, X - a], axis=-1)
+        np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-6)
+
+    def test_unsupported_op_is_loud(self):
+        model = {
+            "root": "f",
+            "inputs": [{"uid": "x", "kind": 0, "shape": (2,)}],
+            "primitive_functions": [
+                {"uid": "f", "op": 49, "inputs": ["x"], "attributes": {}},
+            ],
+        }
+        with pytest.raises(ValueError, match="unsupported primitive op 49"):
+            cntk_model_to_onnx(save_model_bytes(model))
+
+    def test_garbage_payload_is_loud(self):
+        with pytest.raises(Exception):
+            cntk_model_to_onnx(b"not a protobuf at all \x00\x01")
+
+
+class TestCNTKModelIngestion:
+    def test_transform_accepts_raw_cntk_model(self):
+        from mmlspark_tpu.core.frame import DataFrame
+        from mmlspark_tpu.models.cntk_model import CNTKModel
+
+        rng = np.random.default_rng(3)
+        model, ref = _mlp_model(rng)
+        payload = save_model_bytes(model)
+        X = rng.normal(size=(6, 4))
+        df = DataFrame({"features": [r for r in X]})
+        m = (
+            CNTKModel()
+            .setModel(payload)
+            .setInputNode(0)
+            .setOutputNode(0)
+            .setOutputCol("out")
+        )
+        out = m.transform(df)
+        got = np.stack(out["out"])
+        np.testing.assert_allclose(
+            got, ref(X.astype(np.float32)), rtol=1e-4, atol=1e-5
+        )
+
+    def test_error_reports_both_parse_failures(self):
+        from mmlspark_tpu.models.cntk_model import CNTKModel
+
+        m = CNTKModel().setModel(b"\xff\xfe garbage bytes")
+        with pytest.raises(ValueError, match="as ONNX .* CNTK v2"):
+            m._graph()

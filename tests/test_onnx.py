@@ -344,9 +344,10 @@ class TestShardedInference:
 
 
 class TestCNTKIngestionContract:
-    def test_raw_cntk_bytes_raise_with_conversion_guidance(self):
+    def test_unparseable_bytes_raise_with_both_causes(self):
+        # neither ONNX nor CNTK v2 Dictionary: the error names both routes
         from mmlspark_tpu.models.cntk_model import CNTKModel
 
         m = CNTKModel().setModel(b"\x42CNTKv2 not-an-onnx-graph\x00\x01")
-        with pytest.raises(ValueError, match="convert it to ONNX"):
+        with pytest.raises(ValueError, match="as ONNX .* CNTK v2"):
             m._graph()
