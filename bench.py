@@ -68,7 +68,9 @@ def auc(y, p):
     return float((ranks[pos].sum() - n1 * (n1 + 1) / 2) / (n1 * n0))
 
 
-def bench_tpu(X, y):
+def bench_config():
+    """The bench's compile-cache setup + train params — shared with
+    tools/profile_trace.py so profiles always measure THIS config."""
     import jax
 
     # Persistent compile cache: repeated bench runs skip the jit cost the
@@ -78,12 +80,7 @@ def bench_tpu(X, y):
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
     except Exception:
         pass
-
-    from mmlspark_tpu.engine.booster import Dataset, train
-    from mmlspark_tpu.ops.binning import BinMapper
-
-    _log(f"backend={jax.default_backend()} devices={jax.device_count()}")
-    params = dict(
+    return dict(
         objective="binary", num_iterations=N_ITER, num_leaves=NUM_LEAVES,
         max_bin=MAX_BIN, min_data_in_leaf=20, learning_rate=0.1,
         # k-batched best-first growth: lossguide-quality splits at
@@ -95,6 +92,16 @@ def bench_tpu(X, y):
         # passes; the AUC-parity assertion below is the quality gate.
         hist_precision="default",
     )
+
+
+def bench_tpu(X, y):
+    import jax
+
+    from mmlspark_tpu.engine.booster import Dataset, train
+    from mmlspark_tpu.ops.binning import BinMapper
+
+    params = bench_config()
+    _log(f"backend={jax.default_backend()} devices={jax.device_count()}")
     # Host binning measured separately so the breakdown is explicit; the
     # mapper+bins land in the Dataset cache (LightGBM Dataset semantics).
     t0 = time.perf_counter()
