@@ -68,18 +68,24 @@ def auc(y, p):
     return float((ranks[pos].sum() - n1 * (n1 + 1) / 2) / (n1 * n0))
 
 
-def bench_config():
-    """The bench's compile-cache setup + train params — shared with
-    tools/profile_trace.py so profiles always measure THIS config."""
+def enable_compile_cache():
+    """Persistent compile cache: repeated bench runs skip the jit cost the
+    way long-lived Spark executors amortize JIT/native warmup."""
     import jax
 
-    # Persistent compile cache: repeated bench runs skip the jit cost the
-    # way long-lived Spark executors amortize JIT/native warmup.
     try:
         jax.config.update("jax_compilation_cache_dir", "/tmp/mmlspark_tpu_jit_cache")
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
     except Exception:
         pass
+
+
+def bench_config():
+    """The bench's compile-cache setup + train params — shared with the
+    tools/ profilers so they always measure THIS config."""
+    import jax
+
+    enable_compile_cache()
     return dict(
         objective="binary", num_iterations=N_ITER, num_leaves=NUM_LEAVES,
         max_bin=MAX_BIN, min_data_in_leaf=20, learning_rate=0.1,

@@ -62,6 +62,7 @@ _WORKER = textwrap.dedent(
     )
 
     pid = int(sys.argv[1]); port = sys.argv[2]
+    nproc = int(sys.argv[3]) if len(sys.argv) > 3 else 2
     PARAMS = dict(objective="binary", num_iterations=3, num_leaves=7,
                   min_data_in_leaf=2, tree_learner="data")
 
@@ -74,7 +75,7 @@ _WORKER = textwrap.dedent(
         return X, y
 
     # the "task info" list every barrier task sees
-    addresses = [f"127.0.0.1:{{port}}", "127.0.0.1:0"]
+    addresses = [f"127.0.0.1:{{port}}"] + ["127.0.0.1:0"] * (nproc - 1)
     ctx = barrier_context_from_task_infos(addresses, pid,
                                           coordinator_port=int(port))
     X, y = partition(pid)
@@ -84,15 +85,16 @@ _WORKER = textwrap.dedent(
     out = {{"pid": pid, "has_model": model_str is not None,
             "model_head": (model_str or "")[:9]}}
     # (a) sketch thresholds == mapper fit on the merged rows.  The sketch
-    # is a collective, so BOTH workers run it; pid 0 compares against a
-    # TEST-side oracle that regenerates both partitions (the data path
-    # itself never moves raw rows between processes).
+    # is a collective, so EVERY worker runs it; pid 0 compares against a
+    # TEST-side oracle that regenerates all nproc partitions (the data
+    # path itself never moves raw rows between processes).
     from mmlspark_tpu.ops.binning import BinMapper, distributed_fit
     bm_dist = distributed_fit(X, max_bin=255)
     if pid == 0:
         from mmlspark_tpu.engine.booster import Booster, Dataset, train
-        X1, y1 = partition(1)
-        X_all = np.concatenate([X, X1]); y_all = np.concatenate([y, y1])
+        parts = [(X, y)] + [partition(p) for p in range(1, nproc)]
+        X_all = np.concatenate([p[0] for p in parts])
+        y_all = np.concatenate([p[1] for p in parts])
         bm_ref = BinMapper(max_bin=255).fit(X_all)
         out["thresholds_equal"] = bool(
             len(bm_dist.upper_bounds) == len(bm_ref.upper_bounds)
@@ -118,28 +120,32 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
-def test_barrier_train_task_two_processes(tmp_path):
+@pytest.mark.parametrize("nproc", [2, 4])
+def test_barrier_train_task_multi_process(tmp_path, nproc):
     port = _free_port()
     script = tmp_path / "task.py"
     script.write_text(_WORKER.format(repo=REPO))
     env = {"PATH": "/usr/bin:/bin:/usr/local/bin", "HOME": "/root",
            "JAX_PLATFORMS": "cpu", "PYTHONDONTWRITEBYTECODE": "1"}
     procs = [
-        subprocess.Popen([sys.executable, str(script), str(pid), str(port)],
-                         stdout=subprocess.PIPE, stderr=subprocess.PIPE,
-                         text=True, env=env)
-        for pid in range(2)
+        subprocess.Popen(
+            [sys.executable, str(script), str(pid), str(port), str(nproc)],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+            text=True, env=env,
+        )
+        for pid in range(nproc)
     ]
     results = []
     for p in procs:
-        out, err = p.communicate(timeout=240)
+        out, err = p.communicate(timeout=300)
         assert p.returncode == 0, f"task failed:\n{err[-2000:]}"
         results.append(json.loads(out.strip().splitlines()[-1]))
     by_pid = {r["pid"]: r for r in results}
     # task 0 returns the model string (the reference's task-0 gather), the
-    # other task returns None
+    # other tasks return None
     assert by_pid[0]["has_model"] and by_pid[0]["model_head"] == "tree\nvers"
-    assert not by_pid[1]["has_model"]
-    # distributed sketch == merged-fit thresholds; dist model == serial
+    assert not any(by_pid[p]["has_model"] for p in range(1, nproc))
+    # distributed sketch == merged-fit thresholds; dist model == serial,
+    # with NO process ever holding another's raw rows
     assert by_pid[0]["thresholds_equal"]
     assert by_pid[0]["preds_match"]

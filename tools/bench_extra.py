@@ -240,11 +240,9 @@ def bench_transfer_pipeline(payload, n_images=256):
 def main():
     import jax
 
-    try:
-        jax.config.update("jax_compilation_cache_dir", "/tmp/mmlspark_tpu_jit_cache")
-        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
-    except Exception:
-        pass
+    from bench import enable_compile_cache
+
+    enable_compile_cache()
     _log(f"backend={jax.default_backend()}")
     which = set(sys.argv[1:]) or {"ranker", "resnet", "pipeline"}
     payload = None

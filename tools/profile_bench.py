@@ -23,9 +23,7 @@ def main():
     quick = "--quick" in sys.argv
     import jax
 
-    jax.config.update("jax_compilation_cache_dir", "/tmp/mmlspark_tpu_jit_cache")
-    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
-
+    from bench import bench_config
     from mmlspark_tpu.engine.booster import Dataset, train
     from mmlspark_tpu.ops.binning import BinMapper
 
@@ -59,12 +57,9 @@ def main():
 
     ds = Dataset(X, y)
     for name, extra in configs:
-        params = dict(
-            objective="binary", num_iterations=N_ITER, num_leaves=NUM_LEAVES,
-            max_bin=MAX_BIN, min_data_in_leaf=20, learning_rate=0.1,
-            hist_backend="pallas" if jax.default_backend() == "tpu" else "scatter",
-            hist_chunk=N_ROWS, **extra,
-        )
+        # the EXACT bench config, varying only the ablation axes (the
+        # bench pins split_batch, which depthwise configs override)
+        params = dict(bench_config(), split_batch=0, **extra)
         t0 = time.perf_counter()
         booster = train(params, ds, bin_mapper=bm)
         cold = time.perf_counter() - t0

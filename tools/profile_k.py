@@ -17,9 +17,7 @@ from bench import N_ITER, N_ROWS, NUM_LEAVES, MAX_BIN, auc, make_data
 def main():
     import jax
 
-    jax.config.update("jax_compilation_cache_dir", "/tmp/mmlspark_tpu_jit_cache")
-    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
-
+    from bench import bench_config
     from mmlspark_tpu.engine.booster import Dataset, train
 
     X, y = make_data()
@@ -28,12 +26,7 @@ def main():
 
     ks = [int(a) for a in sys.argv[1:]] or [0, 16, 8, 4, 1]
     for k in ks:
-        params = dict(
-            objective="binary", num_iterations=N_ITER, num_leaves=NUM_LEAVES,
-            max_bin=MAX_BIN, min_data_in_leaf=20, learning_rate=0.1,
-            hist_backend="pallas" if jax.default_backend() == "tpu" else "scatter",
-            hist_chunk=N_ROWS, hist_precision="default",
-        )
+        params = dict(bench_config(), split_batch=0)  # k set below
         if k == 0:
             params["grow_policy"] = "depthwise"
             name = "depthwise(k=0)"
