@@ -327,6 +327,7 @@ class Booster:
     def __getstate__(self):
         state = dict(self.__dict__)
         state["_predict_cache"] = {}
+        state.pop("_native_predictor", None)  # ctypes handle: rebuild lazily
         state["trees"] = Tree(*[np.asarray(a) for a in self.trees])
         return state
 
@@ -459,6 +460,20 @@ class Booster:
         from mmlspark_tpu.ops.model_string import booster_from_string
 
         return booster_from_string(s)
+
+    def native_predictor(self):
+        """Host-side C++ single-row scorer over this model (serving path).
+
+        The XLA ``predict`` is right for batched DataFrame scoring but
+        pays a dispatch round-trip per call; HTTP serving of one request
+        wants the native walker (~µs/row) — the reference's
+        ``LGBM_BoosterPredictForMatSingleRow`` parity (SURVEY.md §3.2,
+        §7.1(c)).  Falls back to a Python walker without a toolchain."""
+        from mmlspark_tpu.native.predictor import NativePredictor
+
+        if getattr(self, "_native_predictor", None) is None:
+            self._native_predictor = NativePredictor(self.save_model_string())
+        return self._native_predictor
 
 
 # ---------------------------------------------------------------------------

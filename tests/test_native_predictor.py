@@ -1,0 +1,242 @@
+"""Native single-row predictor: parity with the XLA booster + golden
+oracle, malformed-input rejection, and an ASAN/UBSAN pass.
+
+The predictor is the serving-latency path (SURVEY.md §7.1(c)): it scores
+raw feature rows against the LightGBM v3 text model with a host-side C++
+walker, so its outputs must match both the engine's binned-replay predict
+(our exporter) and the independent format oracle (the golden file).
+"""
+
+import os
+import subprocess
+import tempfile
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu.native.predictor import NativePredictor, native_available
+
+GOLDEN = os.path.join(os.path.dirname(__file__), "data", "golden_lgbm_v3.txt")
+
+
+def _trained(params, n=400, F=5, seed=0, categorical=False):
+    from mmlspark_tpu.engine.booster import Dataset, train
+
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, F))
+    if categorical:
+        X[:, 2] = rng.integers(0, 6, size=n)
+    X[rng.random((n, F)) < 0.04] = np.nan
+    if params.get("objective") == "multiclass":
+        y = rng.integers(0, params["num_class"], size=n).astype(np.float64)
+        y = np.where(np.nan_to_num(X[:, 0]) > 0.5, 0.0, y)
+    else:
+        y = (
+            (np.nan_to_num(X[:, 0]) + 0.5 * np.nan_to_num(X[:, 1]) > 0)
+        ).astype(np.float64)
+    p = dict(params)
+    if categorical:
+        p["categorical_feature"] = [2]
+    b = train(p, Dataset(X, y))
+    return b, X
+
+
+class TestNativePredictorParity:
+    def test_binary_matches_booster(self):
+        b, X = _trained(dict(objective="binary", num_iterations=10,
+                             num_leaves=15, min_data_in_leaf=5))
+        np_pred = NativePredictor(b.save_model_string())
+        got = np_pred.predict(X)
+        want = np.asarray(b.predict(X))
+        np.testing.assert_allclose(got, want, rtol=1e-6, atol=1e-7)
+        raw = np_pred.predict(X, raw_score=True)
+        want_raw = np.asarray(b.predict(X, raw_score=True))
+        np.testing.assert_allclose(raw, want_raw, rtol=1e-6, atol=1e-7)
+
+    def test_categorical_and_nan_match(self):
+        b, X = _trained(dict(objective="binary", num_iterations=12,
+                             num_leaves=15, min_data_in_leaf=5),
+                        categorical=True, seed=1)
+        # probe unseen categories + NaN everywhere
+        probes = np.vstack([X[:50], np.full((2, X.shape[1]), np.nan)])
+        probes[0, 2] = 99.0  # unseen category
+        np_pred = NativePredictor(b.save_model_string())
+        np.testing.assert_allclose(
+            np_pred.predict(probes), np.asarray(b.predict(probes)),
+            rtol=1e-6, atol=1e-7,
+        )
+
+    def test_multiclass_matches_booster(self):
+        b, X = _trained(dict(objective="multiclass", num_class=3,
+                             num_iterations=6, num_leaves=7,
+                             min_data_in_leaf=5), seed=2)
+        np_pred = NativePredictor(b.save_model_string())
+        assert np_pred.num_class == 3
+        np.testing.assert_allclose(
+            np_pred.predict(X), np.asarray(b.predict(X)),
+            rtol=1e-6, atol=1e-7,
+        )
+
+    def test_single_row_shape(self):
+        b, X = _trained(dict(objective="binary", num_iterations=4,
+                             num_leaves=7, min_data_in_leaf=5))
+        np_pred = NativePredictor(b.save_model_string())
+        one = np_pred.predict(X[0])
+        assert np.isscalar(one) or one.ndim == 0
+
+    def test_booster_accessor_and_pickle(self):
+        import pickle
+
+        b, X = _trained(dict(objective="binary", num_iterations=4,
+                             num_leaves=7, min_data_in_leaf=5))
+        p = b.native_predictor()
+        assert p is b.native_predictor()  # cached
+        np.testing.assert_allclose(
+            p.predict(X[:8]), np.asarray(b.predict(X[:8])),
+            rtol=1e-6, atol=1e-7,
+        )
+        # the ctypes handle must not enter the pickle; it rebuilds lazily
+        b2 = pickle.loads(pickle.dumps(b))
+        np.testing.assert_allclose(
+            b2.native_predictor().predict(X[:8]),
+            np.asarray(b.predict(X[:8])), rtol=1e-6, atol=1e-7,
+        )
+
+    def test_golden_model_matches_independent_oracle(self):
+        from tests.test_golden_model import _PROBES, oracle_predict
+
+        with open(GOLDEN) as f:
+            text = f.read()
+        np_pred = NativePredictor(text)
+        got = np_pred.predict(_PROBES)
+        want = oracle_predict(text, _PROBES)
+        np.testing.assert_allclose(got, want, rtol=1e-9, atol=1e-12)
+
+    @pytest.mark.skipif(not native_available(), reason="no toolchain")
+    @pytest.mark.parametrize("bad_line", [
+        "left_child=5 -1",   # child points past the tree
+        "left_child=0 -1",   # child <= parent: would cycle the walker
+        "left_child=1 1",    # second node self/backward ref
+    ])
+    def test_malformed_model_rejected(self, bad_line):
+        bad = (
+            "tree\nversion=v3\nnum_class=1\nmax_feature_idx=1\n"
+            "objective=binary sigmoid:1\n\nTree=0\nnum_leaves=3\n"
+            "split_feature=0 1\nthreshold=1 2\ndecision_type=0 0\n"
+            f"{bad_line}\nright_child=-2 -3\nleaf_value=0.1 0.2 0.3\n"
+            "\nend of trees\n"
+        )
+        with pytest.raises(ValueError, match="malformed"):
+            NativePredictor(bad)
+
+    @pytest.mark.skipif(not native_available(), reason="no toolchain")
+    def test_malformed_cat_boundaries_rejected(self):
+        bad = (
+            "tree\nversion=v3\nnum_class=1\nmax_feature_idx=0\n"
+            "objective=binary sigmoid:1\n\nTree=0\nnum_leaves=2\n"
+            "split_feature=0\nthreshold=0\ndecision_type=1\n"
+            "left_child=-1\nright_child=-2\n"
+            "cat_boundaries=-5 1\ncat_threshold=10\n"
+            "leaf_value=0.1 0.2\n\nend of trees\n"
+        )  # negative boundary would read the bitset out of bounds
+        with pytest.raises(ValueError, match="malformed"):
+            NativePredictor(bad)
+
+    def test_wrong_feature_count_raises(self):
+        b, X = _trained(dict(objective="binary", num_iterations=4,
+                             num_leaves=7, min_data_in_leaf=5))
+        np_pred = NativePredictor(b.save_model_string())
+        with pytest.raises(ValueError, match="number of features"):
+            np_pred.predict(X[:, :2])
+
+    def test_huge_and_inf_categorical_values(self):
+        # out-of-long-range / inf categorical values must be treated as
+        # non-members, not undefined behavior
+        b, X = _trained(dict(objective="binary", num_iterations=6,
+                             num_leaves=7, min_data_in_leaf=5),
+                        categorical=True, seed=3)
+        np_pred = NativePredictor(b.save_model_string())
+        probes = X[:4].copy()
+        probes[0, 2] = 1e300
+        probes[1, 2] = np.inf
+        probes[2, 2] = -np.inf
+        got = np_pred.predict(probes)
+        want = np.asarray(b.predict(probes))
+        np.testing.assert_allclose(got, want, rtol=1e-6, atol=1e-7)
+
+
+class TestNativePredictorSanitized:
+    def test_asan_ubsan_pass(self):
+        """Same §5.2 harness as the binner: compile the predictor with
+        ASAN/UBSAN and run load+predict over the golden model plus edge
+        rows; exit 0 = memory- and UB-clean."""
+        import shutil
+
+        if shutil.which("g++") is None:
+            pytest.skip("no g++ toolchain")
+        import mmlspark_tpu.native as native
+
+        src = os.path.join(os.path.dirname(native.__file__), "predictor.cpp")
+        harness = r"""
+#include <cmath>
+#include <cstdio>
+#include <cstdlib>
+#include <string>
+#include <vector>
+extern "C" {
+void* mml_model_load(const char*);
+void mml_model_info(void*, int*, int*, int*);
+void mml_model_predict(void*, const double*, long, long, int, double*);
+void mml_model_free(void*);
+}
+int main(int argc, char** argv) {
+    FILE* f = fopen(argv[1], "rb");
+    if (!f) return 2;
+    std::string text;
+    char buf[4096];
+    size_t r;
+    while ((r = fread(buf, 1, sizeof buf, f)) > 0) text.append(buf, r);
+    fclose(f);
+    void* h = mml_model_load(text.c_str());
+    if (!h) return 3;
+    int nc, nt, mf;
+    mml_model_info(h, &nc, &nt, &mf);
+    const long F = mf + 1;
+    std::vector<double> X(7 * F, 0.0);
+    for (long i = 0; i < 7 * F; ++i) X[i] = (i % 5) - 2.0;
+    X[0] = NAN; X[F + 2] = 99.0; X[2 * F] = -1.0;
+    std::vector<double> out(7 * (nc > 0 ? nc : 1));
+    mml_model_predict(h, X.data(), 7, F, 0, out.data());
+    mml_model_predict(h, X.data(), 7, F, 1, out.data());
+    mml_model_free(h);
+    // malformed inputs must be REJECTED, not walked
+    if (mml_model_load("Tree=0\nsplit_feature=0\nthreshold=1\n"
+                       "decision_type=0\nleft_child=9\nright_child=-1\n"
+                       "leaf_value=1 2\nend of trees\n") != nullptr)
+        return 4;
+    void* empty = mml_model_load("");  // empty model is valid
+    if (empty == nullptr) return 5;
+    mml_model_free(empty);
+    puts("ok");
+    return 0;
+}
+"""
+        with tempfile.TemporaryDirectory() as td:
+            hp = os.path.join(td, "main.cpp")
+            with open(hp, "w") as fh:
+                fh.write(harness)
+            exe = os.path.join(td, "predictor_sanitize")
+            build = subprocess.run(
+                ["g++", "-std=c++17", "-O1", "-g",
+                 "-fsanitize=address,undefined", "-fno-sanitize-recover=all",
+                 src, hp, "-o", exe],
+                capture_output=True, text=True, timeout=180,
+            )
+            if build.returncode != 0 and "asan" in build.stderr.lower():
+                pytest.skip(f"toolchain lacks sanitizers: {build.stderr[-300:]}")
+            assert build.returncode == 0, build.stderr[-2000:]
+            run = subprocess.run(
+                [exe, GOLDEN], capture_output=True, text=True, timeout=120,
+            )
+            assert run.returncode == 0, (run.stdout, run.stderr[-2000:])
+            assert "ok" in run.stdout
