@@ -100,8 +100,15 @@ class TrainConfig:
     # (k-batched growth; 1 = LightGBM-exact lossguide via the windowed
     # grower, ~num_leaves/2 ≈ depthwise).  0 keeps the policy's default.
     split_batch: int = 0
-    hist_backend: str = "scatter"
-    hist_chunk: int = DEFAULT_CHUNK
+    # "auto" resolves at train() time: the Pallas MXU kernels on a TPU
+    # backend, the XLA scatter builder elsewhere (pallas on CPU means
+    # interpret mode — orders of magnitude slower).  Without this, the
+    # user-facing estimators silently trained on the slow path on TPU.
+    hist_backend: str = "auto"
+    # 0 = auto: one chunk (the whole padded row count, capped) under the
+    # pallas backend — fewer scan steps; DEFAULT_CHUNK for the
+    # memory-bound scatter/onehot builders.
+    hist_chunk: int = 0
     hist_precision: str = "highest"  # highest (f32) | default (bf16 multiply)
     cat_smooth: float = 10.0
     cat_l2: float = 10.0
@@ -599,6 +606,31 @@ _PARALLEL_LEARNERS = (
 _SCAN_CACHE: Dict[Tuple, callable] = {}
 _SCAN_CACHE_MAX = 16
 
+# DART's scan path carries a (num_iterations, K, n) per-tree prediction
+# buffer; beyond this element budget it falls back to the legacy
+# per-iteration loop (tests monkeypatch this to force the legacy path).
+_DART_SCAN_MAX_ELS = 128_000_000
+
+
+def _dart_drop_schedule(rng, cfg: "TrainConfig") -> np.ndarray:
+    """(T, T) mask: row ``it`` marks the trees dropped at iteration ``it``.
+
+    The drop decisions consume only host RNG — one uniform for the skip
+    check (only once trees exist), one vector draw for the mask, one
+    integer draw only when the mask came up empty — so the whole schedule
+    precomputes, shared by the scan and legacy paths.
+    """
+    T = cfg.num_iterations
+    rows = np.zeros((T, T), np.float32)
+    for it in range(T):
+        if it > 0 and rng.random() >= cfg.skip_drop:
+            m = rng.random(it) < cfg.drop_rate
+            idx = np.nonzero(m)[0][: cfg.max_drop]
+            if idx.size == 0:
+                idx = np.array([int(rng.integers(it))])
+            rows[it, idx] = 1.0
+    return rows
+
 
 def _hashable(v):
     if isinstance(v, (list, tuple, np.ndarray)):
@@ -826,6 +858,26 @@ def train(
     bins_np = train_set.binned(bin_mapper)
     n, F = bins_np.shape
     B = bin_mapper.num_bins
+
+    # ---- "auto" histogram backend/chunk resolution ---------------------
+    # The resolved values live on cfg from here on (GrowConfig, the scan
+    # cache key, and the padding math all read them).
+    if cfg.hist_backend == "auto":
+        cfg = dataclasses.replace(
+            cfg,
+            hist_backend=(
+                "pallas" if jax.default_backend() == "tpu" else "scatter"
+            ),
+        )
+    if cfg.hist_chunk == 0:
+        if cfg.hist_backend == "pallas":
+            # one chunk when it fits (fewer scan steps; the kernel's grid
+            # streams row blocks anyway); beyond 4M rows fall back to 1M
+            # chunks so the multiple-of-chunk padding stays ≤ 25%
+            auto_chunk = (1 << 22) if n <= (1 << 22) else (1 << 20)
+        else:
+            auto_chunk = DEFAULT_CHUNK
+        cfg = dataclasses.replace(cfg, hist_chunk=auto_chunk)
 
     # ---- feature-parallel: columns sharded, rows replicated ------------
     feature_par = (
@@ -1245,7 +1297,34 @@ def train(
     root_key = jax.random.PRNGKey(cfg.bagging_seed + 7919 * cfg.seed)
     all_keys = np.asarray(jax.random.split(root_key, 2 * total_keyed))
 
-    if cfg.boosting != "dart":
+    # DART in the scan: the drop decisions consume only HOST RNG (never
+    # data), so the whole schedule is precomputed as a (T, T) mask with the
+    # exact RNG call order of the legacy loop, and the scan carries the
+    # per-tree weight vector plus per-tree prediction buffers (P: (T, K, n))
+    # so dropped contributions are one einsum instead of per-tree predict
+    # dispatches.  Gated to the single-controller path, no checkpointing
+    # (the checkpoint writer assumes unit weights), and a P-buffer HBM
+    # budget — outside those, the legacy per-iteration loop below remains.
+    dart = cfg.boosting == "dart"
+    # Carry memory counts the training P buffer AND the per-valid-set PV
+    # buffers (the training pseudo-valid carries a zero-size dummy); the
+    # T^2 drop-schedule matrix is bounded separately.
+    _dart_carry_rows = int(scores.shape[-1]) + sum(
+        int(np.shape(vs["scores"])[-1]) for vi, vs in enumerate(vsets)
+        if not (cfg.is_provide_training_metric and vi == len(vsets) - 1)
+    )
+    dart_scan = (
+        dart and mesh is None and ckpt_path is None
+        and cfg.num_iterations <= 4096
+        and cfg.num_iterations * K * _dart_carry_rows <= _DART_SCAN_MAX_ELS
+    )
+    if dart:
+        # ONE schedule for both paths (scan xs / legacy loop) so the RNG
+        # call order can never diverge between them.
+        drop_rows = _dart_drop_schedule(rng, cfg)
+        it_indices = np.arange(cfg.num_iterations, dtype=np.int32)
+
+    if cfg.boosting != "dart" or dart_scan:
         # ---- FAST PATH: the whole boosting run as ONE lax.scan ----------
         # Round 1 spent ~42s of a 44s / 50-iteration bench in per-iteration
         # dispatch + host sync over the remote-dispatch link (the device
@@ -1256,8 +1335,7 @@ def train(
         # `early_stopping_round` chunk with it (metrics are checked on host
         # between chunks from per-iteration score snapshots; trees grown
         # past the stopping point are discarded, so semantics match the
-        # per-iteration check exactly).  DART stays on the legacy loop: its
-        # drop bookkeeping mutates host-side RNG state per iteration.
+        # per-iteration check exactly).
         n_iter = cfg.num_iterations
         if do_bagging:
             # LightGBM bagging reuse: iteration `it` uses the bag drawn at
@@ -1280,12 +1358,27 @@ def train(
         def _build_scan_chunk():
             def scan_chunk(
                 bins_a, y_a, w_a, vmask_a, init_scores_a, vbins_a, carry,
-                keys_c, bag_keys_c,
+                keys_c, bag_keys_c, *dart_xs,
             ):
                 def body(car, xs):
-                    scores_c, vscores_c = car
-                    key, bag_key = xs
-                    train_scores = init_scores_a if cfg.boosting == "rf" else scores_c
+                    if dart_scan:
+                        scores_c, vscores_c, P, PVs, wts = car
+                        key, bag_key, drop_row, it_idx = xs
+                        # dropped contribution removed in ONE einsum over
+                        # the carried per-tree prediction buffer (exact
+                        # precision: scores must match legacy replay)
+                        sub_w = drop_row * wts  # pre-rescale weights
+                        sub = jnp.einsum(
+                            "t,tkn->kn", sub_w, P,
+                            precision=jax.lax.Precision.HIGHEST,
+                        )
+                        train_scores = scores_c - sub
+                    else:
+                        scores_c, vscores_c = car
+                        key, bag_key = xs
+                        train_scores = (
+                            init_scores_a if cfg.boosting == "rf" else scores_c
+                        )
                     grad, hess = obj.grad_hess(
                         train_scores if K > 1 else train_scores[0], y_a, w_a
                     )
@@ -1307,24 +1400,62 @@ def train(
                     )
                     tree, leaf_ids = grow(bins_a, grad, hess, bag, fmask)
                     delta = _leaf_delta(tree, leaf_ids)
-                    scores_c = scores_c + delta
+                    if dart_scan:
+                        # DART normalization (legacy-loop semantics): new
+                        # tree at 1/(k+1), dropped trees rescaled by
+                        # k/(k+1) and re-added — the re-add is exactly
+                        # factor * the subtract einsum, so no second
+                        # (T, K, n) contraction.  (use_bfa never reaches
+                        # dart: boost_from_average excludes it.)
+                        kdrop = jnp.sum(drop_row)
+                        has = kdrop > 0
+                        w_new = jnp.where(has, 1.0 / (kdrop + 1.0), 1.0)
+                        factor = jnp.where(has, kdrop / (kdrop + 1.0), 1.0)
+                        wts = jnp.where(drop_row > 0, wts * factor, wts)
+                        scores_c = train_scores + factor * sub + w_new * delta
+                        P = jax.lax.dynamic_update_slice(
+                            P, delta[None], (it_idx, 0, 0)
+                        )
+                        wts = wts.at[it_idx].set(w_new)
+                    else:
+                        scores_c = scores_c + delta
                     nv = len(vbins_a)
                     new_vs = []
+                    new_pvs = []
                     for vi, (vsc, vb) in enumerate(zip(vscores_c, vbins_a)):
                         if cfg.is_provide_training_metric and vi == nv - 1:
                             # the training pseudo-valid (always last) IS the
                             # carry — no second full-data tree replay
                             new_vs.append(scores_c)
-                        else:
-                            new_vs.append(
-                                vsc + jax.vmap(
-                                    lambda t: predict_tree_binned(t, vb, B)
-                                )(tree)
+                            if dart_scan:
+                                new_pvs.append(PVs[vi])
+                            continue
+                        vdelta = jax.vmap(
+                            lambda t: predict_tree_binned(t, vb, B)
+                        )(tree)
+                        if dart_scan:
+                            PV = PVs[vi]
+                            # valid-score drop adjustment: Σ drop·(w_new_t
+                            # − w_old_t)·PV = (factor−1)·Σ drop·w_old·PV
+                            adj = (factor - 1.0) * jnp.einsum(
+                                "t,tkn->kn", sub_w, PV,
+                                precision=jax.lax.Precision.HIGHEST,
                             )
+                            new_pvs.append(jax.lax.dynamic_update_slice(
+                                PV, vdelta[None], (it_idx, 0, 0)
+                            ))
+                            new_vs.append(vsc + adj + w_new * vdelta)
+                        else:
+                            new_vs.append(vsc + vdelta)
                     vscores_c = tuple(new_vs)
+                    if dart_scan:
+                        car = (scores_c, vscores_c, P, tuple(new_pvs), wts)
+                        return car, (tree, vscores_c)
                     return (scores_c, vscores_c), (tree, vscores_c)
 
-                return jax.lax.scan(body, carry, (keys_c, bag_keys_c))
+                return jax.lax.scan(
+                    body, carry, (keys_c, bag_keys_c) + tuple(dart_xs)
+                )
 
             return jax.jit(scan_chunk)
 
@@ -1402,16 +1533,37 @@ def train(
                 )
             )
 
-        carry = (scores, tuple(vs["scores"] for vs in vsets))
+        if dart_scan:
+            # the training pseudo-valid (always last) never reads its PV
+            # (its scores ARE the carry) — a zero-size dummy keeps the
+            # carry structure without the (T, K, n) allocation
+            zero_pv = tuple(
+                jnp.zeros((0,), jnp.float32)
+                if cfg.is_provide_training_metric and vi == len(vsets) - 1
+                else jnp.zeros((n_iter,) + np.shape(vs["scores"]), jnp.float32)
+                for vi, vs in enumerate(vsets)
+            )
+            carry = (
+                scores, tuple(vs["scores"] for vs in vsets),
+                jnp.zeros((n_iter,) + np.shape(scores), jnp.float32),
+                zero_pv, jnp.zeros((n_iter,), jnp.float32),
+            )
+        else:
+            carry = (scores, tuple(vs["scores"] for vs in vsets))
         tree_chunks: List[Tree] = []
         n_done = 0
         stop_at: Optional[int] = None
         while n_done < n_iter and stop_at is None:
             c = min(chunk_iters, n_iter - n_done)
+            dart_xs = (
+                (jnp.asarray(drop_rows[n_done : n_done + c]),
+                 jnp.asarray(it_indices[n_done : n_done + c]))
+                if dart_scan else ()
+            )
             carry, (trees_c, vsnap_c) = scan_chunk(
                 bins_dev, y_dev, w_dev, valid_mask, init_scores_dev, vbins_t,
                 carry, jnp.asarray(iter_keys[n_done : n_done + c]),
-                jnp.asarray(bag_keys[n_done : n_done + c]),
+                jnp.asarray(bag_keys[n_done : n_done + c]), *dart_xs,
             )
             tree_chunks.append(trees_c)
             if ckpt_path is not None:
@@ -1454,7 +1606,14 @@ def train(
                 evals_result[nm][metric_name] = evals_result[nm][metric_name][:kept]
         if use_bfa:
             stacked = _fold_bias(stacked, init)
-        weights = np.ones(kept)
+        if dart_scan:
+            # dart forbids early stopping (ValueError above), so
+            # kept == n_iter and the final carry's weight vector IS the
+            # trained forest's weights
+            assert kept == n_iter
+            weights = np.asarray(carry[-1]).astype(np.float64)
+        else:
+            weights = np.ones(kept)
         final = _finalize_booster(
             stacked, weights, bin_mapper, cfg, init_model, evals_result,
             best_iter if cfg.early_stopping_round > 0 else -1,
@@ -1474,12 +1633,11 @@ def train(
         sub = all_keys[it]
         if do_bagging and it % cfg.bagging_freq == 0:
             current_bag = resample_bag(all_keys[cfg.num_iterations + it], valid_mask)
-        dropped_idx: List[int] = []
-        if cfg.boosting == "dart" and trees_host and rng.random() >= cfg.skip_drop:
-            mask = rng.random(len(trees_host)) < cfg.drop_rate
-            dropped_idx = list(np.nonzero(mask)[0][: cfg.max_drop])
-            if not dropped_idx:
-                dropped_idx = [int(rng.integers(len(trees_host)))]
+        # drop set from the shared precomputed schedule (same RNG stream
+        # as the scan path — see _dart_drop_schedule)
+        dropped_idx: List[int] = (
+            list(np.nonzero(drop_rows[it])[0]) if dart else []
+        )
         if dropped_idx:
             drop_pred = []
             for t_i in dropped_idx:

@@ -1182,6 +1182,7 @@ class LightGBMClassificationModel(_LightGBMClassificationModel):
       rawPredictionCol: Raw margin output column
       seed: Master random seed
       slotNames: Feature vector slot names
+      splitBatch: k-batched best-first growth: apply up to k best splits per histogram pass (0 = policy default; 1 = exact lossguide; ~12 gives leaf-wise quality at level-wise pass counts — the bench setting; see BASELINE.md)
       thresholds: Per-class prediction thresholds
       timeout: Distributed initialization timeout in seconds
       topK: Top-k features voted per worker in voting_parallel
@@ -1191,7 +1192,7 @@ class LightGBMClassificationModel(_LightGBMClassificationModel):
       weightCol: The name of the sample-weight column
     """
 
-    def __init__(self, *, baggingFraction=1.0, baggingFreq=0, baggingSeed=3, boostFromAverage=True, booster=_UNSET, boostingType='gbdt', categoricalSlotIndexes=None, categoricalSlotNames=None, defaultListenPort=12400, deviceType='tpu', driverListenPort=0, earlyStoppingRound=0, featureFraction=1.0, featuresCol='features', growPolicy='lossguide', initScoreCol=_UNSET, isProvideTrainingMetric=False, isUnbalance=False, labelCol='label', lambdaL1=0.0, lambdaL2=0.0, leafPredictionCol='', learningRate=0.1, matrixType='auto', maxBin=255, maxDepth=-1, metric='', minDataInLeaf=20, minSumHessianInLeaf=0.001, modelString='', numBatches=0, numIterations=100, numLeaves=31, numTasks=0, numThreads=0, objective='regression', parallelism='data_parallel', predictionCol='prediction', probabilityCol='probability', rawPredictionCol='rawPrediction', seed=0, slotNames=None, thresholds=None, timeout=1200.0, topK=20, useBarrierExecutionMode=False, validationIndicatorCol=_UNSET, verbosity=1, weightCol=_UNSET):
+    def __init__(self, *, baggingFraction=1.0, baggingFreq=0, baggingSeed=3, boostFromAverage=True, booster=_UNSET, boostingType='gbdt', categoricalSlotIndexes=None, categoricalSlotNames=None, defaultListenPort=12400, deviceType='tpu', driverListenPort=0, earlyStoppingRound=0, featureFraction=1.0, featuresCol='features', growPolicy='lossguide', initScoreCol=_UNSET, isProvideTrainingMetric=False, isUnbalance=False, labelCol='label', lambdaL1=0.0, lambdaL2=0.0, leafPredictionCol='', learningRate=0.1, matrixType='auto', maxBin=255, maxDepth=-1, metric='', minDataInLeaf=20, minSumHessianInLeaf=0.001, modelString='', numBatches=0, numIterations=100, numLeaves=31, numTasks=0, numThreads=0, objective='regression', parallelism='data_parallel', predictionCol='prediction', probabilityCol='probability', rawPredictionCol='rawPrediction', seed=0, slotNames=None, splitBatch=0, thresholds=None, timeout=1200.0, topK=20, useBarrierExecutionMode=False, validationIndicatorCol=_UNSET, verbosity=1, weightCol=_UNSET):
         kw = {k: v for k, v in locals().items()
               if k not in ('self', '__class__') and v is not _UNSET}
         super().__init__(**kw)
@@ -1242,6 +1243,7 @@ class LightGBMClassifier(_LightGBMClassifier):
       rawPredictionCol: Raw margin output column
       seed: Master random seed
       slotNames: Feature vector slot names
+      splitBatch: k-batched best-first growth: apply up to k best splits per histogram pass (0 = policy default; 1 = exact lossguide; ~12 gives leaf-wise quality at level-wise pass counts — the bench setting; see BASELINE.md)
       thresholds: Per-class prediction thresholds
       timeout: Distributed initialization timeout in seconds
       topK: Top-k features voted per worker in voting_parallel
@@ -1251,7 +1253,7 @@ class LightGBMClassifier(_LightGBMClassifier):
       weightCol: The name of the sample-weight column
     """
 
-    def __init__(self, *, baggingFraction=1.0, baggingFreq=0, baggingSeed=3, boostFromAverage=True, boostingType='gbdt', categoricalSlotIndexes=None, categoricalSlotNames=None, defaultListenPort=12400, deviceType='tpu', driverListenPort=0, earlyStoppingRound=0, featureFraction=1.0, featuresCol='features', growPolicy='lossguide', initScoreCol=_UNSET, isProvideTrainingMetric=False, isUnbalance=False, labelCol='label', lambdaL1=0.0, lambdaL2=0.0, leafPredictionCol='', learningRate=0.1, matrixType='auto', maxBin=255, maxDepth=-1, metric='', minDataInLeaf=20, minSumHessianInLeaf=0.001, modelString='', numBatches=0, numIterations=100, numLeaves=31, numTasks=0, numThreads=0, objective='binary', parallelism='data_parallel', predictionCol='prediction', probabilityCol='probability', rawPredictionCol='rawPrediction', seed=0, slotNames=None, thresholds=None, timeout=1200.0, topK=20, useBarrierExecutionMode=False, validationIndicatorCol=_UNSET, verbosity=1, weightCol=_UNSET):
+    def __init__(self, *, baggingFraction=1.0, baggingFreq=0, baggingSeed=3, boostFromAverage=True, boostingType='gbdt', categoricalSlotIndexes=None, categoricalSlotNames=None, defaultListenPort=12400, deviceType='tpu', driverListenPort=0, earlyStoppingRound=0, featureFraction=1.0, featuresCol='features', growPolicy='lossguide', initScoreCol=_UNSET, isProvideTrainingMetric=False, isUnbalance=False, labelCol='label', lambdaL1=0.0, lambdaL2=0.0, leafPredictionCol='', learningRate=0.1, matrixType='auto', maxBin=255, maxDepth=-1, metric='', minDataInLeaf=20, minSumHessianInLeaf=0.001, modelString='', numBatches=0, numIterations=100, numLeaves=31, numTasks=0, numThreads=0, objective='binary', parallelism='data_parallel', predictionCol='prediction', probabilityCol='probability', rawPredictionCol='rawPrediction', seed=0, slotNames=None, splitBatch=0, thresholds=None, timeout=1200.0, topK=20, useBarrierExecutionMode=False, validationIndicatorCol=_UNSET, verbosity=1, weightCol=_UNSET):
         kw = {k: v for k, v in locals().items()
               if k not in ('self', '__class__') and v is not _UNSET}
         super().__init__(**kw)
@@ -1305,6 +1307,7 @@ class LightGBMRanker(_LightGBMRanker):
       repartitionByGroupingColumn: Keep each query group within one worker shard
       seed: Master random seed
       slotNames: Feature vector slot names
+      splitBatch: k-batched best-first growth: apply up to k best splits per histogram pass (0 = policy default; 1 = exact lossguide; ~12 gives leaf-wise quality at level-wise pass counts — the bench setting; see BASELINE.md)
       timeout: Distributed initialization timeout in seconds
       topK: Top-k features voted per worker in voting_parallel
       useBarrierExecutionMode: Gang-schedule training (the SPMD program launch is inherently gang-scheduled on TPU; kept for API parity)
@@ -1313,7 +1316,7 @@ class LightGBMRanker(_LightGBMRanker):
       weightCol: The name of the sample-weight column
     """
 
-    def __init__(self, *, baggingFraction=1.0, baggingFreq=0, baggingSeed=3, boostFromAverage=True, boostingType='gbdt', categoricalSlotIndexes=None, categoricalSlotNames=None, defaultListenPort=12400, deviceType='tpu', driverListenPort=0, earlyStoppingRound=0, evalAt=[1, 2, 3, 4, 5], featureFraction=1.0, featuresCol='features', groupCol='group', growPolicy='lossguide', initScoreCol=_UNSET, isProvideTrainingMetric=False, isUnbalance=False, labelCol='label', labelGain=None, lambdaL1=0.0, lambdaL2=0.0, leafPredictionCol='', learningRate=0.1, matrixType='auto', maxBin=255, maxDepth=-1, maxPosition=20, metric='', minDataInLeaf=20, minSumHessianInLeaf=0.001, modelString='', numBatches=0, numIterations=100, numLeaves=31, numTasks=0, numThreads=0, objective='lambdarank', parallelism='data_parallel', predictionCol='prediction', repartitionByGroupingColumn=True, seed=0, slotNames=None, timeout=1200.0, topK=20, useBarrierExecutionMode=False, validationIndicatorCol=_UNSET, verbosity=1, weightCol=_UNSET):
+    def __init__(self, *, baggingFraction=1.0, baggingFreq=0, baggingSeed=3, boostFromAverage=True, boostingType='gbdt', categoricalSlotIndexes=None, categoricalSlotNames=None, defaultListenPort=12400, deviceType='tpu', driverListenPort=0, earlyStoppingRound=0, evalAt=[1, 2, 3, 4, 5], featureFraction=1.0, featuresCol='features', groupCol='group', growPolicy='lossguide', initScoreCol=_UNSET, isProvideTrainingMetric=False, isUnbalance=False, labelCol='label', labelGain=None, lambdaL1=0.0, lambdaL2=0.0, leafPredictionCol='', learningRate=0.1, matrixType='auto', maxBin=255, maxDepth=-1, maxPosition=20, metric='', minDataInLeaf=20, minSumHessianInLeaf=0.001, modelString='', numBatches=0, numIterations=100, numLeaves=31, numTasks=0, numThreads=0, objective='lambdarank', parallelism='data_parallel', predictionCol='prediction', repartitionByGroupingColumn=True, seed=0, slotNames=None, splitBatch=0, timeout=1200.0, topK=20, useBarrierExecutionMode=False, validationIndicatorCol=_UNSET, verbosity=1, weightCol=_UNSET):
         kw = {k: v for k, v in locals().items()
               if k not in ('self', '__class__') and v is not _UNSET}
         super().__init__(**kw)
@@ -1363,6 +1366,7 @@ class LightGBMRankerModel(_LightGBMRankerModel):
       predictionCol: The name of the prediction column
       seed: Master random seed
       slotNames: Feature vector slot names
+      splitBatch: k-batched best-first growth: apply up to k best splits per histogram pass (0 = policy default; 1 = exact lossguide; ~12 gives leaf-wise quality at level-wise pass counts — the bench setting; see BASELINE.md)
       timeout: Distributed initialization timeout in seconds
       topK: Top-k features voted per worker in voting_parallel
       useBarrierExecutionMode: Gang-schedule training (the SPMD program launch is inherently gang-scheduled on TPU; kept for API parity)
@@ -1371,7 +1375,7 @@ class LightGBMRankerModel(_LightGBMRankerModel):
       weightCol: The name of the sample-weight column
     """
 
-    def __init__(self, *, baggingFraction=1.0, baggingFreq=0, baggingSeed=3, boostFromAverage=True, booster=_UNSET, boostingType='gbdt', categoricalSlotIndexes=None, categoricalSlotNames=None, defaultListenPort=12400, deviceType='tpu', driverListenPort=0, earlyStoppingRound=0, featureFraction=1.0, featuresCol='features', growPolicy='lossguide', initScoreCol=_UNSET, isProvideTrainingMetric=False, isUnbalance=False, labelCol='label', lambdaL1=0.0, lambdaL2=0.0, leafPredictionCol='', learningRate=0.1, matrixType='auto', maxBin=255, maxDepth=-1, metric='', minDataInLeaf=20, minSumHessianInLeaf=0.001, modelString='', numBatches=0, numIterations=100, numLeaves=31, numTasks=0, numThreads=0, objective='regression', parallelism='data_parallel', predictionCol='prediction', seed=0, slotNames=None, timeout=1200.0, topK=20, useBarrierExecutionMode=False, validationIndicatorCol=_UNSET, verbosity=1, weightCol=_UNSET):
+    def __init__(self, *, baggingFraction=1.0, baggingFreq=0, baggingSeed=3, boostFromAverage=True, booster=_UNSET, boostingType='gbdt', categoricalSlotIndexes=None, categoricalSlotNames=None, defaultListenPort=12400, deviceType='tpu', driverListenPort=0, earlyStoppingRound=0, featureFraction=1.0, featuresCol='features', growPolicy='lossguide', initScoreCol=_UNSET, isProvideTrainingMetric=False, isUnbalance=False, labelCol='label', lambdaL1=0.0, lambdaL2=0.0, leafPredictionCol='', learningRate=0.1, matrixType='auto', maxBin=255, maxDepth=-1, metric='', minDataInLeaf=20, minSumHessianInLeaf=0.001, modelString='', numBatches=0, numIterations=100, numLeaves=31, numTasks=0, numThreads=0, objective='regression', parallelism='data_parallel', predictionCol='prediction', seed=0, slotNames=None, splitBatch=0, timeout=1200.0, topK=20, useBarrierExecutionMode=False, validationIndicatorCol=_UNSET, verbosity=1, weightCol=_UNSET):
         kw = {k: v for k, v in locals().items()
               if k not in ('self', '__class__') and v is not _UNSET}
         super().__init__(**kw)
@@ -1421,6 +1425,7 @@ class LightGBMRegressionModel(_LightGBMRegressionModel):
       predictionCol: The name of the prediction column
       seed: Master random seed
       slotNames: Feature vector slot names
+      splitBatch: k-batched best-first growth: apply up to k best splits per histogram pass (0 = policy default; 1 = exact lossguide; ~12 gives leaf-wise quality at level-wise pass counts — the bench setting; see BASELINE.md)
       timeout: Distributed initialization timeout in seconds
       topK: Top-k features voted per worker in voting_parallel
       useBarrierExecutionMode: Gang-schedule training (the SPMD program launch is inherently gang-scheduled on TPU; kept for API parity)
@@ -1429,7 +1434,7 @@ class LightGBMRegressionModel(_LightGBMRegressionModel):
       weightCol: The name of the sample-weight column
     """
 
-    def __init__(self, *, baggingFraction=1.0, baggingFreq=0, baggingSeed=3, boostFromAverage=True, booster=_UNSET, boostingType='gbdt', categoricalSlotIndexes=None, categoricalSlotNames=None, defaultListenPort=12400, deviceType='tpu', driverListenPort=0, earlyStoppingRound=0, featureFraction=1.0, featuresCol='features', growPolicy='lossguide', initScoreCol=_UNSET, isProvideTrainingMetric=False, isUnbalance=False, labelCol='label', lambdaL1=0.0, lambdaL2=0.0, leafPredictionCol='', learningRate=0.1, matrixType='auto', maxBin=255, maxDepth=-1, metric='', minDataInLeaf=20, minSumHessianInLeaf=0.001, modelString='', numBatches=0, numIterations=100, numLeaves=31, numTasks=0, numThreads=0, objective='regression', parallelism='data_parallel', predictionCol='prediction', seed=0, slotNames=None, timeout=1200.0, topK=20, useBarrierExecutionMode=False, validationIndicatorCol=_UNSET, verbosity=1, weightCol=_UNSET):
+    def __init__(self, *, baggingFraction=1.0, baggingFreq=0, baggingSeed=3, boostFromAverage=True, booster=_UNSET, boostingType='gbdt', categoricalSlotIndexes=None, categoricalSlotNames=None, defaultListenPort=12400, deviceType='tpu', driverListenPort=0, earlyStoppingRound=0, featureFraction=1.0, featuresCol='features', growPolicy='lossguide', initScoreCol=_UNSET, isProvideTrainingMetric=False, isUnbalance=False, labelCol='label', lambdaL1=0.0, lambdaL2=0.0, leafPredictionCol='', learningRate=0.1, matrixType='auto', maxBin=255, maxDepth=-1, metric='', minDataInLeaf=20, minSumHessianInLeaf=0.001, modelString='', numBatches=0, numIterations=100, numLeaves=31, numTasks=0, numThreads=0, objective='regression', parallelism='data_parallel', predictionCol='prediction', seed=0, slotNames=None, splitBatch=0, timeout=1200.0, topK=20, useBarrierExecutionMode=False, validationIndicatorCol=_UNSET, verbosity=1, weightCol=_UNSET):
         kw = {k: v for k, v in locals().items()
               if k not in ('self', '__class__') and v is not _UNSET}
         super().__init__(**kw)
@@ -1479,6 +1484,7 @@ class LightGBMRegressor(_LightGBMRegressor):
       predictionCol: The name of the prediction column
       seed: Master random seed
       slotNames: Feature vector slot names
+      splitBatch: k-batched best-first growth: apply up to k best splits per histogram pass (0 = policy default; 1 = exact lossguide; ~12 gives leaf-wise quality at level-wise pass counts — the bench setting; see BASELINE.md)
       timeout: Distributed initialization timeout in seconds
       topK: Top-k features voted per worker in voting_parallel
       tweedieVariancePower: Tweedie variance power (1..2)
@@ -1488,7 +1494,7 @@ class LightGBMRegressor(_LightGBMRegressor):
       weightCol: The name of the sample-weight column
     """
 
-    def __init__(self, *, alpha=0.9, baggingFraction=1.0, baggingFreq=0, baggingSeed=3, boostFromAverage=True, boostingType='gbdt', categoricalSlotIndexes=None, categoricalSlotNames=None, defaultListenPort=12400, deviceType='tpu', driverListenPort=0, earlyStoppingRound=0, featureFraction=1.0, featuresCol='features', growPolicy='lossguide', initScoreCol=_UNSET, isProvideTrainingMetric=False, isUnbalance=False, labelCol='label', lambdaL1=0.0, lambdaL2=0.0, leafPredictionCol='', learningRate=0.1, matrixType='auto', maxBin=255, maxDepth=-1, metric='', minDataInLeaf=20, minSumHessianInLeaf=0.001, modelString='', numBatches=0, numIterations=100, numLeaves=31, numTasks=0, numThreads=0, objective='regression', parallelism='data_parallel', predictionCol='prediction', seed=0, slotNames=None, timeout=1200.0, topK=20, tweedieVariancePower=1.5, useBarrierExecutionMode=False, validationIndicatorCol=_UNSET, verbosity=1, weightCol=_UNSET):
+    def __init__(self, *, alpha=0.9, baggingFraction=1.0, baggingFreq=0, baggingSeed=3, boostFromAverage=True, boostingType='gbdt', categoricalSlotIndexes=None, categoricalSlotNames=None, defaultListenPort=12400, deviceType='tpu', driverListenPort=0, earlyStoppingRound=0, featureFraction=1.0, featuresCol='features', growPolicy='lossguide', initScoreCol=_UNSET, isProvideTrainingMetric=False, isUnbalance=False, labelCol='label', lambdaL1=0.0, lambdaL2=0.0, leafPredictionCol='', learningRate=0.1, matrixType='auto', maxBin=255, maxDepth=-1, metric='', minDataInLeaf=20, minSumHessianInLeaf=0.001, modelString='', numBatches=0, numIterations=100, numLeaves=31, numTasks=0, numThreads=0, objective='regression', parallelism='data_parallel', predictionCol='prediction', seed=0, slotNames=None, splitBatch=0, timeout=1200.0, topK=20, tweedieVariancePower=1.5, useBarrierExecutionMode=False, validationIndicatorCol=_UNSET, verbosity=1, weightCol=_UNSET):
         kw = {k: v for k, v in locals().items()
               if k not in ('self', '__class__') and v is not _UNSET}
         super().__init__(**kw)

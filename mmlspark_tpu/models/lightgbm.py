@@ -145,6 +145,14 @@ class _LightGBMParams(
         default="lossguide", dtype=str,
         validator=ParamValidators.inList(["lossguide", "depthwise"]),
     )
+    splitBatch = Param(
+        "splitBatch",
+        "k-batched best-first growth: apply up to k best splits per "
+        "histogram pass (0 = policy default; 1 = exact lossguide; ~12 "
+        "gives leaf-wise quality at level-wise pass counts — the bench "
+        "setting; see BASELINE.md)",
+        default=0, dtype=int,
+    )
 
     def _train_params(self, num_class: int = 1) -> dict:
         """Flatten the param surface into the engine's LightGBM-vocabulary
@@ -187,6 +195,7 @@ class _LightGBMParams(
         p["tree_learner"] = learner
         p["top_k"] = self.getTopK()
         p["grow_policy"] = self.getGrowPolicy()
+        p["split_batch"] = self.getSplitBatch()
         p["num_threads"] = self.getNumThreads()
         if self.getMatrixType() == "sparse":
             import warnings

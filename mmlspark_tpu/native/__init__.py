@@ -22,66 +22,74 @@ _SRC = os.path.join(_HERE, "binner.cpp")
 _SO = os.path.join(_HERE, "_binner.so")
 
 _lock = threading.Lock()
-_lib = None
-_tried = False
+_libs: dict = {}  # so-path -> CDLL | None (None = tried, unavailable)
 
 
-def _compile() -> bool:
-    tmp = _SO + f".tmp{os.getpid()}"
-    try:
-        subprocess.run(
-            [
-                "g++", "-O3", "-shared", "-fPIC", "-std=c++17", "-pthread",
-                _SRC, "-o", tmp,
-            ],
-            check=True, capture_output=True, timeout=120,
-        )
-        os.replace(tmp, _SO)
-        return True
-    except Exception:
-        try:
-            os.unlink(tmp)
-        except OSError:
-            pass
-        return False
+def load_native_lib(src: str, so: str, bind) -> "ctypes.CDLL | None":
+    """Shared compile-if-stale + CDLL + bind loader for the C++ components.
+
+    Compiles ``src`` to ``so`` with the local toolchain when the binary is
+    missing or older than the source (atomic tmp+replace, per-process tmp
+    name), loads it, and calls ``bind(lib)`` to set the ctypes signatures.
+    Returns None — the caller's numpy fallback — when the toolchain or the
+    library is unavailable, or when ``MMLSPARK_TPU_NO_NATIVE=1``.
+    """
+    if so in _libs:
+        return _libs[so]
+    with _lock:
+        if so in _libs:
+            return _libs[so]
+        lib = None
+        if not os.environ.get("MMLSPARK_TPU_NO_NATIVE"):
+            try:
+                fresh = os.path.exists(so) and (
+                    os.path.getmtime(so) >= os.path.getmtime(src)
+                )
+                if not fresh:
+                    tmp = so + f".tmp{os.getpid()}"
+                    try:
+                        subprocess.run(
+                            ["g++", "-O3", "-shared", "-fPIC", "-std=c++17",
+                             "-pthread", src, "-o", tmp],
+                            check=True, capture_output=True, timeout=120,
+                        )
+                        os.replace(tmp, so)
+                        fresh = True
+                    except Exception:
+                        try:
+                            os.unlink(tmp)
+                        except OSError:
+                            pass
+                if fresh:
+                    lib = ctypes.CDLL(so)
+                    bind(lib)
+            except Exception:
+                lib = None
+        _libs[so] = lib
+        return lib
+
+
+def _bind_binner(lib):
+    c_double_p = ctypes.POINTER(ctypes.c_double)
+    c_int_p = ctypes.POINTER(ctypes.c_int)
+    c_u8_p = ctypes.POINTER(ctypes.c_uint8)
+    lib.mml_binner_fit.argtypes = [
+        c_double_p, ctypes.c_long, ctypes.c_long,
+        ctypes.c_int, ctypes.c_int, c_u8_p,
+        c_double_p, c_int_p, ctypes.c_int,
+    ]
+    lib.mml_binner_fit.restype = None
+    lib.mml_binner_transform.argtypes = [
+        c_double_p, ctypes.c_long, ctypes.c_long,
+        c_double_p, c_int_p, ctypes.c_int, ctypes.c_int,
+        c_u8_p, ctypes.c_int,
+    ]
+    lib.mml_binner_transform.restype = None
 
 
 def get_binner_lib():
     """The compiled binner library, or None (numpy fallback)."""
-    global _lib, _tried
-    if _tried:
-        return _lib
-    with _lock:
-        if _tried:
-            return _lib
-        lib = None
-        if not os.environ.get("MMLSPARK_TPU_NO_NATIVE"):
-            try:
-                fresh = os.path.exists(_SO) and (
-                    os.path.getmtime(_SO) >= os.path.getmtime(_SRC)
-                )
-                if fresh or _compile():
-                    lib = ctypes.CDLL(_SO)
-                    c_double_p = ctypes.POINTER(ctypes.c_double)
-                    c_int_p = ctypes.POINTER(ctypes.c_int)
-                    c_u8_p = ctypes.POINTER(ctypes.c_uint8)
-                    lib.mml_binner_fit.argtypes = [
-                        c_double_p, ctypes.c_long, ctypes.c_long,
-                        ctypes.c_int, ctypes.c_int, c_u8_p,
-                        c_double_p, c_int_p, ctypes.c_int,
-                    ]
-                    lib.mml_binner_fit.restype = None
-                    lib.mml_binner_transform.argtypes = [
-                        c_double_p, ctypes.c_long, ctypes.c_long,
-                        c_double_p, c_int_p, ctypes.c_int, ctypes.c_int,
-                        c_u8_p, ctypes.c_int,
-                    ]
-                    lib.mml_binner_transform.restype = None
-            except Exception:
-                lib = None
-        _lib = lib
-        _tried = True
-        return _lib
+    return load_native_lib(_SRC, _SO, _bind_binner)
 
 
 def default_threads() -> int:

@@ -41,7 +41,9 @@ struct Model {
     int num_class = 1;
     int num_tree_per_iteration = 1;
     int max_feature_idx = 0;
-    int objective = 0;  // 0=identity/regression, 1=sigmoid, 2=softmax
+    // 0=identity/regression, 1=sigmoid, 2=softmax, 3=exp
+    // (poisson/gamma/tweedie), 4=one-vs-all (sigmoid + normalize)
+    int objective = 0;
     double sigmoid = 1.0;
     std::vector<Tree> trees;
 };
@@ -153,9 +155,13 @@ void* mml_model_load(const char* text) {
                     if (s != std::string::npos)
                         m->sigmoid = atof(v.c_str() + s + 8);
                 } else if (starts_with(v, "multiclassova")) {
-                    m->objective = 1;
+                    m->objective = 4;  // sigmoid per class, then normalize
                 } else if (starts_with(v, "multiclass")) {
                     m->objective = 2;
+                } else if (starts_with(v, "poisson") ||
+                           starts_with(v, "gamma") ||
+                           starts_with(v, "tweedie")) {
+                    m->objective = 3;  // log-link: predict = exp(margin)
                 }
             }
         } else {
@@ -256,6 +262,15 @@ void mml_model_predict(void* h, const double* X, long n, long n_feat,
                 double sum = 0.0;
                 for (int k = 0; k < K; ++k) {
                     o[k] = std::exp(o[k] - mx);
+                    sum += o[k];
+                }
+                for (int k = 0; k < K; ++k) o[k] /= sum;
+            } else if (m->objective == 3) {
+                for (int k = 0; k < K; ++k) o[k] = std::exp(o[k]);
+            } else if (m->objective == 4) {
+                double sum = 0.0;
+                for (int k = 0; k < K; ++k) {
+                    o[k] = 1.0 / (1.0 + std::exp(-m->sigmoid * o[k]));
                     sum += o[k];
                 }
                 for (int k = 0; k < K; ++k) o[k] /= sum;

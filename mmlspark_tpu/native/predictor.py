@@ -15,8 +15,6 @@ from __future__ import annotations
 
 import ctypes
 import os
-import subprocess
-import threading
 
 import numpy as np
 
@@ -24,51 +22,27 @@ _HERE = os.path.dirname(__file__)
 _SRC = os.path.join(_HERE, "predictor.cpp")
 _SO = os.path.join(_HERE, "_predictor.so")
 
-_lock = threading.Lock()
-_lib = None
-_tried = False
+
+def _bind(lib):
+    dp = ctypes.POINTER(ctypes.c_double)
+    ip = ctypes.POINTER(ctypes.c_int)
+    lib.mml_model_load.argtypes = [ctypes.c_char_p]
+    lib.mml_model_load.restype = ctypes.c_void_p
+    lib.mml_model_info.argtypes = [ctypes.c_void_p, ip, ip, ip]
+    lib.mml_model_info.restype = None
+    lib.mml_model_predict.argtypes = [
+        ctypes.c_void_p, dp, ctypes.c_long, ctypes.c_long,
+        ctypes.c_int, dp,
+    ]
+    lib.mml_model_predict.restype = None
+    lib.mml_model_free.argtypes = [ctypes.c_void_p]
+    lib.mml_model_free.restype = None
 
 
 def _get_lib():
-    global _lib, _tried
-    if _tried:
-        return _lib
-    with _lock:
-        if _tried:
-            return _lib
-        lib = None
-        if not os.environ.get("MMLSPARK_TPU_NO_NATIVE"):
-            try:
-                fresh = os.path.exists(_SO) and (
-                    os.path.getmtime(_SO) >= os.path.getmtime(_SRC)
-                )
-                if not fresh:
-                    tmp = _SO + f".tmp{os.getpid()}"
-                    subprocess.run(
-                        ["g++", "-O3", "-shared", "-fPIC", "-std=c++17",
-                         _SRC, "-o", tmp],
-                        check=True, capture_output=True, timeout=120,
-                    )
-                    os.replace(tmp, _SO)
-                lib = ctypes.CDLL(_SO)
-                dp = ctypes.POINTER(ctypes.c_double)
-                ip = ctypes.POINTER(ctypes.c_int)
-                lib.mml_model_load.argtypes = [ctypes.c_char_p]
-                lib.mml_model_load.restype = ctypes.c_void_p
-                lib.mml_model_info.argtypes = [ctypes.c_void_p, ip, ip, ip]
-                lib.mml_model_info.restype = None
-                lib.mml_model_predict.argtypes = [
-                    ctypes.c_void_p, dp, ctypes.c_long, ctypes.c_long,
-                    ctypes.c_int, dp,
-                ]
-                lib.mml_model_predict.restype = None
-                lib.mml_model_free.argtypes = [ctypes.c_void_p]
-                lib.mml_model_free.restype = None
-            except Exception:
-                lib = None
-        _lib = lib
-        _tried = True
-        return _lib
+    from mmlspark_tpu.native import load_native_lib
+
+    return load_native_lib(_SRC, _SO, _bind)
 
 
 class NativePredictor:

@@ -77,6 +77,35 @@ class TestNativePredictorParity:
             rtol=1e-6, atol=1e-7,
         )
 
+    def test_poisson_exp_transform_matches(self):
+        from mmlspark_tpu.engine.booster import Dataset, train
+
+        rng = np.random.default_rng(5)
+        X = rng.normal(size=(300, 4))
+        y = rng.poisson(np.exp(0.5 * X[:, 0])).astype(np.float64)
+        b = train(dict(objective="poisson", num_iterations=8, num_leaves=7,
+                       min_data_in_leaf=5), Dataset(X, y))
+        np_pred = NativePredictor(b.save_model_string())
+        got = np_pred.predict(X)
+        want = np.asarray(b.predict(X))
+        assert (got > 0).all()  # log-link: predictions are exp(margin)
+        np.testing.assert_allclose(got, want, rtol=1e-6, atol=1e-7)
+
+    def test_multiclassova_normalized_sigmoid_matches(self):
+        from mmlspark_tpu.engine.booster import Dataset, train
+
+        rng = np.random.default_rng(6)
+        X = rng.normal(size=(300, 4))
+        y = rng.integers(0, 3, size=300).astype(np.float64)
+        b = train(dict(objective="multiclassova", num_class=3,
+                       num_iterations=6, num_leaves=7, min_data_in_leaf=5),
+                  Dataset(X, y))
+        np_pred = NativePredictor(b.save_model_string())
+        got = np_pred.predict(X)
+        want = np.asarray(b.predict(X))
+        np.testing.assert_allclose(got.sum(axis=1), 1.0, atol=1e-6)
+        np.testing.assert_allclose(got, want, rtol=1e-6, atol=1e-7)
+
     def test_single_row_shape(self):
         b, X = _trained(dict(objective="binary", num_iterations=4,
                              num_leaves=7, min_data_in_leaf=5))
