@@ -699,6 +699,14 @@ def train(
     """
     import warnings
 
+    from mmlspark_tpu.core.jit_cache import enable_compile_cache
+
+    # Library-level persistent compile cache (SURVEY.md §3.1: the reference
+    # has no compile step to beat — a user's FIRST fit must not pay full
+    # XLA freight every process).  No-op if the user opted out/configured
+    # their own.
+    enable_compile_cache()
+
     cfg = params if isinstance(params, TrainConfig) else TrainConfig.from_params(params)
     if cfg.tree_learner in ("feature", "feature_parallel") and process_local:
         raise NotImplementedError(
@@ -814,21 +822,34 @@ def train(
 
     D = mesh_num_devices(mesh)
 
+    # process_local metric evaluation never pulls score snapshots to hosts
+    # (they are row-sharded across processes): metrics are computed from
+    # psum-able sufficient statistics INSIDE the jitted scan — the direct
+    # analog of the reference's Network-reduced `LGBM_BoosterGetEval` each
+    # iteration (SURVEY.md §3.1, §5.8).  Valid sets hold ONLY this
+    # process's partition rows (sharded like the train set); every process
+    # must pass the same number of valid sets in the same order (SPMD).
+    # Ranking groups are process-aligned (the reference's
+    # repartitionByGroupingColumn contract) and only group METADATA is
+    # allgathered.
+    device_eval = process_local
     if process_local:
-        # v1 contract: metric evaluation pulls per-iteration score
-        # snapshots to every host, which a process-local run cannot do
-        # (the snapshots are row-sharded across processes); ranking groups
-        # would span process boundaries.
-        if valid_sets or cfg.is_provide_training_metric:
-            raise NotImplementedError(
-                "process_local training does not support valid_sets / "
-                "is_provide_training_metric; evaluate on a held-out set "
-                "after training"
-            )
-        if isinstance(obj, LambdaRank):
-            raise NotImplementedError(
-                "process_local training does not support lambdarank "
-                "(query groups span process boundaries)"
+        # Fail fast on a violated SPMD contract (e.g. one barrier task with
+        # an empty validation split passing None): a mismatched valid-set
+        # count would otherwise pair collectives across DIFFERENT call
+        # sites and deadlock or crash with garbage shapes.
+        from mmlspark_tpu.parallel.distributed import host_allgather
+
+        sig = host_allgather(np.asarray([
+            len(valid_sets), int(bool(cfg.is_provide_training_metric)),
+            int(isinstance(obj, LambdaRank)),
+        ]))
+        if not (sig == sig[0]).all():
+            raise ValueError(
+                "process_local SPMD contract violated: every process must "
+                "pass the same number of valid_sets (use an EMPTY array "
+                "for an empty partition, never None) and identical "
+                f"eval/objective flags; got {sig.tolist()} across processes"
             )
 
     # ---- binning (cached on the Dataset — LightGBM bins at Dataset
@@ -958,10 +979,37 @@ def train(
             w = np.where(train_set.label > 0, base * spw, base)
     w_np = None if w is None else _pad_rows(np.asarray(w, dtype=np.float64), n_pad)
 
+    # Process-aligned ranking groups (distributed lambdarank): every
+    # process's queries live wholly inside its own row block, the padded
+    # (G, M) index matrices are assembled GLOBALLY from allgathered group
+    # metadata (engine/dist_metrics.assemble_global_groups), and the
+    # pairwise lambda computation runs unchanged over the globally sharded
+    # scores — the score[idx] gather is the one collective.
+    train_groups_host = None
     if isinstance(obj, LambdaRank):
         if train_set.group is None:
             raise ValueError("lambdarank requires group sizes")
-        obj.set_groups(train_set.group)
+        if int(np.sum(train_set.group)) != n:
+            raise ValueError(
+                "group sizes must sum to this dataset's row count "
+                f"({int(np.sum(train_set.group))} != {n})"
+            )
+        if process_local:
+            from jax.sharding import PartitionSpec as P
+
+            from mmlspark_tpu.engine.dist_metrics import assemble_global_groups
+            from mmlspark_tpu.parallel.distributed import make_global_array
+
+            row_off = jax.process_index() * n_local * d_local
+            idx_g, valid_g = assemble_global_groups(train_set.group, row_off)
+            train_groups_host = (idx_g, valid_g)
+            obj.set_group_matrix(
+                make_global_array(mesh, P(), idx_g),
+                make_global_array(mesh, P(), valid_g),
+                state_key=hash(idx_g.tobytes() + valid_g.tobytes()),
+            )
+        else:
+            obj.set_groups(train_set.group)
 
     # ---- init score ----------------------------------------------------
     # dart (tree rescaling would corrupt the folded bias) and rf (averaged
@@ -1242,7 +1290,55 @@ def train(
     vsets = []
     names = list(valid_names) if valid_names else [f"valid_{i}" for i in range(len(valid_sets))]
     for vs in valid_sets:
-        vb = jnp.asarray(vs.binned(bin_mapper))
+        vbins_np = vs.binned(bin_mapper)
+        if process_local:
+            # Each process contributes ONLY its valid partition, padded to
+            # an allgathered common per-device count (same contract as the
+            # train rows above); labels/weights/mask ride as global sharded
+            # arrays for the in-scan stats reductions.
+            from jax.sharding import PartitionSpec as P
+
+            from mmlspark_tpu.parallel.distributed import (
+                host_allgather,
+                make_global_array,
+            )
+
+            vcounts = host_allgather(np.asarray([vs.num_rows])).reshape(-1)
+            nv_local = (int(vcounts.max()) + d_local - 1) // d_local
+            v_pad = nv_local * d_local - vs.num_rows
+            vb = make_global_array(
+                mesh, P(DATA_AXIS, None), _pad_rows(vbins_np, v_pad)
+            )
+            vy = make_global_array(
+                mesh, P(DATA_AXIS),
+                _pad_rows(vs.label, v_pad).astype(np.float32),
+            )
+            vw = None if vs.weight is None else make_global_array(
+                mesh, P(DATA_AXIS),
+                _pad_rows(vs.weight, v_pad).astype(np.float32),
+            )
+            vvm = make_global_array(
+                mesh, P(DATA_AXIS),
+                np.concatenate([np.ones(vs.num_rows, bool), np.zeros(v_pad, bool)]),
+            )
+            vscore_np = np.broadcast_to(
+                np.asarray(init, dtype=np.float32).reshape(-1, 1),
+                (K, vs.num_rows + v_pad),
+            ).copy()
+            if vs.init_score is not None:
+                vscore_np = vscore_np + _pad_rows(
+                    vs.init_score.astype(np.float32), v_pad
+                ).reshape(1, -1)
+            vscore = make_global_array(mesh, P(None, DATA_AXIS), vscore_np)
+            if init_model is not None:
+                vscore = vscore + init_model._raw_scores_binned(vb)
+            vsets.append({
+                "bins": vb, "scores": vscore, "data": vs,
+                "eval_arrays": (vy, vw, vvm),
+                "row_offset": jax.process_index() * nv_local * d_local,
+            })
+            continue
+        vb = jnp.asarray(vbins_np)
         vscore = np.broadcast_to(
             np.asarray(init, dtype=np.float32).reshape(-1, 1), (K, vs.num_rows)
         ).copy()
@@ -1259,7 +1355,13 @@ def train(
         # early stopping, which watches names[0], never keys on it).  Its
         # scores snapshot reuses the sharded padded bins already on device.
         names.append("training")
-        vsets.append({"bins": bins_dev, "scores": scores, "data": train_set})
+        vsets.append({
+            "bins": bins_dev, "scores": scores, "data": train_set,
+            "eval_arrays": (y_dev, w_dev, valid_mask),
+            "row_offset": (
+                jax.process_index() * n_local * d_local if process_local else 0
+            ),
+        })
 
     predict_v = jax.jit(
         lambda tree, vbins: jax.vmap(lambda t: predict_tree_binned(t, vbins, B))(tree)
@@ -1271,6 +1373,44 @@ def train(
         metric_name, alpha=cfg.alpha
     )
     best_score, best_iter = (-np.inf if higher_better else np.inf), -1
+
+    if device_eval and vsets:
+        # Attach the device evaluator + its aux arrays to every eval set.
+        from jax.sharding import PartitionSpec as P
+
+        from mmlspark_tpu.engine.dist_metrics import (
+            assemble_global_groups,
+            get_device_metric,
+        )
+        from mmlspark_tpu.parallel.distributed import make_global_array
+
+        for vi, vs in enumerate(vsets):
+            gi = gv = None
+            if needs_groups:
+                is_train_pseudo = (
+                    cfg.is_provide_training_metric and vi == len(vsets) - 1
+                )
+                if is_train_pseudo and train_groups_host is not None:
+                    gi, gv = train_groups_host
+                else:
+                    dset = vs["data"]
+                    if dset.group is None:
+                        raise ValueError(
+                            f"metric {metric_name!r} needs group sizes on "
+                            f"eval set {names[vi]!r}"
+                        )
+                    gi, gv = assemble_global_groups(
+                        dset.group, vs["row_offset"]
+                    )
+            ev = get_device_metric(
+                metric_name, alpha=cfg.alpha, group_idx=gi, group_valid=gv
+            )
+            vs["evaluator"] = ev
+            vs["aux"] = vs["eval_arrays"] + (
+                tuple(
+                    make_global_array(mesh, P(), a) for a in ev.aux_host()
+                ),
+            )
 
     def eval_metric(scores_arr, dset: Dataset):
         s = np.asarray(scores_arr)
@@ -1352,18 +1492,29 @@ def train(
         iter_keys = all_keys[key_start:total_keyed]
 
         vbins_t = tuple(vs["bins"] for vs in vsets)
+        vaux_t = (
+            tuple(vs["aux"] for vs in vsets) if device_eval and vsets else ()
+        )
+        evaluators = [vs.get("evaluator") for vs in vsets]
+        it_global = np.arange(key_start, total_keyed, dtype=np.int32)
 
         # Like `iteration` above: device data enters as ARGUMENTS (valid
-        # bins included) so nothing large becomes a jaxpr constant.
+        # bins included, eval label/weight/mask/group aux included) so
+        # nothing large becomes a jaxpr constant.
         def _build_scan_chunk():
+            from jax.sharding import NamedSharding
+            from jax.sharding import PartitionSpec as _PS
+
+            _rep = NamedSharding(mesh, _PS()) if mesh is not None else None
+
             def scan_chunk(
-                bins_a, y_a, w_a, vmask_a, init_scores_a, vbins_a, carry,
-                keys_c, bag_keys_c, *dart_xs,
+                bins_a, y_a, w_a, vmask_a, init_scores_a, vbins_a, vaux_a,
+                carry, keys_c, bag_keys_c, it_c, *dart_xs,
             ):
                 def body(car, xs):
                     if dart_scan:
                         scores_c, vscores_c, P, PVs, wts = car
-                        key, bag_key, drop_row, it_idx = xs
+                        key, bag_key, it_g, drop_row, it_idx = xs
                         # dropped contribution removed in ONE einsum over
                         # the carried per-tree prediction buffer (exact
                         # precision: scores must match legacy replay)
@@ -1375,7 +1526,7 @@ def train(
                         train_scores = scores_c - sub
                     else:
                         scores_c, vscores_c = car
-                        key, bag_key = xs
+                        key, bag_key, it_g = xs
                         train_scores = (
                             init_scores_a if cfg.boosting == "rf" else scores_c
                         )
@@ -1448,13 +1599,32 @@ def train(
                         else:
                             new_vs.append(vsc + vdelta)
                     vscores_c = tuple(new_vs)
+                    if device_eval and vsets:
+                        # In-scan sufficient-statistics evaluation: the ys
+                        # output per eval set is a tiny replicated (S,)
+                        # vector (the psum-ed stats), never a row-sharded
+                        # score snapshot — the §5.8 Network-reduced eval.
+                        stats_out = []
+                        for vi2, vsc in enumerate(vscores_c):
+                            ay, aw, am, aextra = vaux_a[vi2]
+                            sc = (
+                                vsc / (it_g.astype(jnp.float32) + 1.0)
+                                if cfg.boosting == "rf" else vsc
+                            )
+                            st = evaluators[vi2].stats(sc, ay, aw, am, *aextra)
+                            if _rep is not None:
+                                st = jax.lax.with_sharding_constraint(st, _rep)
+                            stats_out.append(st)
+                        ys_v = tuple(stats_out)
+                    else:
+                        ys_v = vscores_c
                     if dart_scan:
                         car = (scores_c, vscores_c, P, tuple(new_pvs), wts)
-                        return car, (tree, vscores_c)
-                    return (scores_c, vscores_c), (tree, vscores_c)
+                        return car, (tree, ys_v)
+                    return (scores_c, vscores_c), (tree, ys_v)
 
                 return jax.lax.scan(
-                    body, carry, (keys_c, bag_keys_c) + tuple(dart_xs)
+                    body, carry, (keys_c, bag_keys_c, it_c) + tuple(dart_xs)
                 )
 
             return jax.jit(scan_chunk)
@@ -1466,7 +1636,12 @@ def train(
         # (LambdaRank's group matrix) participate only when their state
         # fingerprint is part of the key, and are rebuilt otherwise.
         state_key = obj.state_key() if obj.stateful else None
-        if obj.stateful and state_key is None:
+        if device_eval and vsets:
+            # Evaluator aux shapes and group-count constants are per-call
+            # state; the distributed-eval program skips the cross-call
+            # cache (jit still reuses compiles across this run's chunks).
+            scan_chunk = _build_scan_chunk()
+        elif obj.stateful and state_key is None:
             scan_chunk = _build_scan_chunk()
         else:
             cache_key = (
@@ -1486,8 +1661,9 @@ def train(
             # Metrics need per-iteration valid-score snapshots, which scan
             # stacks into a (chunk, K, n_valid) buffer — cap the chunk so
             # that buffer (and its host transfer) stays bounded regardless
-            # of num_iterations × valid size.
-            chunk_iters = min(n_iter, 64)
+            # of num_iterations × valid size.  Device-eval stacks only
+            # (chunk, S) stat vectors, so the whole run is one dispatch.
+            chunk_iters = n_iter if device_eval else min(n_iter, 64)
         else:
             chunk_iters = n_iter
         if ckpt_path is not None:
@@ -1562,8 +1738,9 @@ def train(
             )
             carry, (trees_c, vsnap_c) = scan_chunk(
                 bins_dev, y_dev, w_dev, valid_mask, init_scores_dev, vbins_t,
-                carry, jnp.asarray(iter_keys[n_done : n_done + c]),
-                jnp.asarray(bag_keys[n_done : n_done + c]), *dart_xs,
+                vaux_t, carry, jnp.asarray(iter_keys[n_done : n_done + c]),
+                jnp.asarray(bag_keys[n_done : n_done + c]),
+                jnp.asarray(it_global[n_done : n_done + c]), *dart_xs,
             )
             tree_chunks.append(trees_c)
             if ckpt_path is not None:
@@ -1571,13 +1748,18 @@ def train(
             if vsets:
                 # One batched transfer (issues every copy async, then waits)
                 # — per-array np.asarray pulls pay a full dispatch RTT each.
-                snaps = jax.device_get(list(vsnap_c))  # each (c, K, nv)
+                # Device-eval: each snap is (c, S) replicated stats, so the
+                # transfer is O(iters × stats), independent of valid size.
+                snaps = jax.device_get(list(vsnap_c))  # each (c, K, nv)|(c, S)
                 for j in range(c):
                     it = n_done + j
                     stop = False
                     for nm, vs, sn in zip(names, vsets, snaps):
-                        div = (it + 1) if cfg.boosting == "rf" else 1
-                        m = eval_metric(sn[j] / div, vs["data"])
+                        if device_eval:
+                            m = vs["evaluator"].finalize(sn[j])
+                        else:
+                            div = (it + 1) if cfg.boosting == "rf" else 1
+                            m = eval_metric(sn[j] / div, vs["data"])
                         evals_result[nm][metric_name].append(m)
                         if cfg.early_stopping_round > 0 and nm == names[0]:
                             improved = (
@@ -1629,6 +1811,25 @@ def train(
         return final
 
     assert key_start == 0  # dart forbids warm start, so no offset here
+    if device_eval and vsets:
+        # Legacy-loop (dart) counterpart of the in-scan stats: one jitted
+        # stats reduction per eval set over the sharded score/label arrays.
+        from jax.sharding import NamedSharding
+        from jax.sharding import PartitionSpec as _PS
+
+        _rep_leg = NamedSharding(mesh, _PS())
+
+        def _make_stats_fn(ev):
+            @jax.jit
+            def f(s, aux):
+                ay, aw, am, aextra = aux
+                return jax.lax.with_sharding_constraint(
+                    ev.stats(s, ay, aw, am, *aextra), _rep_leg
+                )
+
+            return f
+
+        _legacy_stats = [_make_stats_fn(vs["evaluator"]) for vs in vsets]
     for it in range(cfg.num_iterations):
         sub = all_keys[it]
         if do_bagging and it % cfg.bagging_freq == 0:
@@ -1685,7 +1886,7 @@ def train(
 
         # ---- validation & early stopping -------------------------------
         stop = False
-        for nm, vs in zip(names, vsets):
+        for vi_l, (nm, vs) in enumerate(zip(names, vsets)):
             # Valid scores start at init; the stored tree-0 bias must not be
             # double counted, so replay the *unbiased* growth delta.  The
             # stored tree already includes the bias, so subtract it back out.
@@ -1706,7 +1907,12 @@ def train(
                     ) * vp
             vs["scores"] = vs["scores"] + w_new * vdelta
             div = (it + 1) if cfg.boosting == "rf" else 1
-            m = eval_metric(vs["scores"] / div, vs["data"])
+            if device_eval:
+                m = vs["evaluator"].finalize(
+                    np.asarray(_legacy_stats[vi_l](vs["scores"] / div, vs["aux"]))
+                )
+            else:
+                m = eval_metric(vs["scores"] / div, vs["data"])
             evals_result[nm][metric_name].append(m)
             if cfg.early_stopping_round > 0 and nm == names[0]:
                 improved = m > best_score if higher_better else m < best_score

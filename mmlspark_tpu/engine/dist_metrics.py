@@ -1,0 +1,295 @@
+"""Device-side metric evaluation from psum-able sufficient statistics.
+
+The single-controller training loop evaluates metrics on HOST: per-iteration
+score snapshots are fetched and fed to :mod:`engine.eval_metrics`.  A
+multi-controller (``process_local=True``) run cannot do that — the score
+snapshots are row-sharded across processes and no host may materialize
+another's rows.  This module is the distributed replacement, mirroring how
+the reference's Network layer reduces eval metrics inside the hot loop
+(SURVEY.md §3.1 ``LGBM_BoosterGetEval`` every iteration, §5.8): each metric
+is split into
+
+- ``stats(score, y, w, mask, *aux) -> (S,)`` — a SMALL jit-safe reduction
+  over the (globally sharded) score/label arrays.  Run inside the training
+  scan, XLA lowers the reductions to cross-shard psums over ICI/DCN, and the
+  (S,)-vector output is replicated on every process.  S is O(1) or
+  O(num_bins) — never O(rows).
+- ``finalize(stats) -> float`` — host-side scalar from the fetched stats.
+
+Exactness contract per family:
+
+- Pointwise metrics (logloss/l2/l1/error/...): ``[Σ w·loss, Σ w]`` — exact
+  up to f32 summation order vs the host metric.
+- AUC: a weighted pos/neg histogram over ``sigmoid(score)`` in ``_AUC_BINS``
+  uniform bins, allreduced, then the rank statistic on bin counts.  Scores
+  falling in one bin are treated as tied (trapezoid credit) — a bounded
+  quantization of the exact tie-averaged AUC (|err| ≲ collisions/bin;
+  ≤ ~1e-4 observed at 4096 bins), exactly the bandwidth-conscious
+  histogram-allreduce trade the reference makes for distributed training.
+- NDCG@k: per-group DCG/IDCG via a padded (G, M) group-index matrix (groups
+  must be process-aligned — the reference's ``repartitionByGroupingColumn``
+  contract, SURVEY.md §2.3.1); ``[Σ ndcg_g, G]``.  Exact vs host up to f32.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+_AUC_BINS = 4096
+
+
+class DeviceMetric:
+    """One metric as (device sufficient-statistics, host finalize)."""
+
+    higher_better = False
+
+    def aux_host(self) -> Tuple[np.ndarray, ...]:
+        """Extra HOST arrays the stats fn needs (e.g. group matrices).
+        The booster places them on device (replicated) and threads them
+        through the jitted scan as arguments — never closures, so the
+        multi-process SPMD program sees proper global arrays."""
+        return ()
+
+    def stats(self, score_kn, y, w, mask, *aux) -> jnp.ndarray:
+        raise NotImplementedError
+
+    def finalize(self, s: np.ndarray) -> float:
+        raise NotImplementedError
+
+
+def _eff_w(y, w, mask):
+    m = mask.astype(jnp.float32)
+    return m if w is None else m * w
+
+
+class _Pointwise(DeviceMetric):
+    """stats = [Σ w·loss, Σ w]; finalize = ratio (optionally post-mapped)."""
+
+    def __init__(self, loss_fn: Callable, higher_better=False, post=None):
+        self._loss = loss_fn
+        self.higher_better = higher_better
+        self._post = post
+
+    def stats(self, score_kn, y, w, mask):
+        wm = _eff_w(y, w, mask)
+        loss = self._loss(score_kn, y)
+        return jnp.stack([jnp.sum(loss * wm), jnp.sum(wm)])
+
+    def finalize(self, s):
+        v = float(s[0]) / max(float(s[1]), 1e-300)
+        return self._post(v) if self._post is not None else v
+
+
+def _sig(s):
+    return jax.nn.sigmoid(s)
+
+
+def _binary_logloss(score_kn, y):
+    # softplus(s) - y*s == -[y log σ(s) + (1-y) log(1-σ(s))], evaluated
+    # stably (the host metric's clip+log+exp runs in f64; this form keeps
+    # the f32 device evaluation within ~1e-7 of it).
+    s = score_kn[0]
+    return jax.nn.softplus(s) - y * s
+
+
+def _binary_error(score_kn, y):
+    return ((_sig(score_kn[0]) > 0.5).astype(jnp.float32) != y).astype(jnp.float32)
+
+
+def _l2(score_kn, y):
+    return (y - score_kn[0]) ** 2
+
+
+def _l1(score_kn, y):
+    return jnp.abs(y - score_kn[0])
+
+
+def _mape(score_kn, y):
+    return jnp.abs(y - score_kn[0]) / jnp.maximum(jnp.abs(y), 1.0)
+
+
+def _poisson(score_kn, y):
+    return jnp.exp(score_kn[0]) - y * score_kn[0]
+
+
+def _quantile(alpha):
+    def f(score_kn, y):
+        d = y - score_kn[0]
+        return jnp.maximum(alpha * d, (alpha - 1.0) * d)
+
+    return f
+
+
+def _multi_logloss(score_kn, y):
+    p = jnp.clip(jax.nn.softmax(score_kn, axis=0), 1e-15, None)
+    yi = y.astype(jnp.int32)
+    return -jnp.log(jnp.take_along_axis(p, yi[None, :], axis=0)[0])
+
+
+def _multi_error(score_kn, y):
+    return (jnp.argmax(score_kn, axis=0) != y.astype(jnp.int32)).astype(
+        jnp.float32
+    )
+
+
+class _BinnedAUC(DeviceMetric):
+    """Weighted ROC-AUC from a pos/neg score histogram (one allreduce)."""
+
+    higher_better = True
+
+    def stats(self, score_kn, y, w, mask):
+        wm = _eff_w(y, w, mask)
+        p = _sig(score_kn[0])
+        b = jnp.clip((p * _AUC_BINS).astype(jnp.int32), 0, _AUC_BINS - 1)
+        pos_w = jnp.where(y > 0, wm, 0.0)
+        neg_w = jnp.where(y > 0, 0.0, wm)
+        pos_h = jnp.zeros(_AUC_BINS, jnp.float32).at[b].add(pos_w)
+        neg_h = jnp.zeros(_AUC_BINS, jnp.float32).at[b].add(neg_w)
+        return jnp.concatenate([pos_h, neg_h])
+
+    def finalize(self, s):
+        pos, neg = np.asarray(s[:_AUC_BINS], np.float64), np.asarray(
+            s[_AUC_BINS:], np.float64
+        )
+        tp, tn = pos.sum(), neg.sum()
+        if tp == 0 or tn == 0:
+            return 0.5
+        below = np.cumsum(neg) - neg  # negatives strictly below each bin
+        return float(np.sum(pos * (below + 0.5 * neg)) / (tp * tn))
+
+
+class _GroupedNDCG(DeviceMetric):
+    """NDCG@k over a padded (G, M) group-index matrix (process-aligned)."""
+
+    higher_better = True
+
+    def __init__(self, k: int, group_idx: np.ndarray, group_valid: np.ndarray):
+        self.k = k
+        self._idx = np.asarray(group_idx, np.int32)
+        self._valid = np.asarray(group_valid, bool)
+
+    def aux_host(self):
+        return (self._idx, self._valid)
+
+    def stats(self, score_kn, y, w, mask, idx, valid):
+        s = jnp.where(valid, score_kn[0][idx], -jnp.inf)
+        lbl = jnp.where(valid, y[idx], 0.0)
+        gains = jnp.where(valid, 2.0 ** lbl - 1.0, 0.0)
+        pos = jnp.arange(s.shape[1])
+        disc = jnp.where(pos < self.k, 1.0 / jnp.log2(pos + 2.0), 0.0)
+        # argsort is stable (mergesort semantics), matching the host metric's
+        # tie ordering over the same group layout.
+        order = jnp.argsort(-s, axis=1)
+        dcg = jnp.sum(jnp.take_along_axis(gains, order, axis=1) * disc, axis=1)
+        ideal = jnp.sort(gains, axis=1)[:, ::-1]
+        idcg = jnp.sum(ideal * disc, axis=1)
+        ndcg = jnp.where(idcg > 0, dcg / jnp.maximum(idcg, 1e-300), 1.0)
+        return jnp.stack(
+            [jnp.sum(ndcg), jnp.asarray(float(self._idx.shape[0]), jnp.float32)]
+        )
+
+    def finalize(self, s):
+        return float(s[0]) / max(float(s[1]), 1e-300)
+
+
+def get_device_metric(
+    name: str,
+    alpha: float = 0.9,
+    group_idx: Optional[np.ndarray] = None,
+    group_valid: Optional[np.ndarray] = None,
+) -> DeviceMetric:
+    """The device evaluator for an ``eval_metrics`` name.
+
+    ``group_idx``/``group_valid``: padded global group matrices, required
+    for ndcg (built process-aligned by the booster's ingestion path)."""
+    name = name.lower()
+    if name.startswith("ndcg"):
+        if group_idx is None:
+            raise ValueError("ndcg needs process-aligned group matrices")
+        k = int(name.split("@", 1)[1]) if "@" in name else 5
+        return _GroupedNDCG(k, group_idx, group_valid)
+    table = {
+        "auc": lambda: _BinnedAUC(),
+        "binary_logloss": lambda: _Pointwise(_binary_logloss),
+        "binary_error": lambda: _Pointwise(_binary_error),
+        "l2": lambda: _Pointwise(_l2),
+        "mse": lambda: _Pointwise(_l2),
+        "mean_squared_error": lambda: _Pointwise(_l2),
+        "rmse": lambda: _Pointwise(_l2, post=lambda v: float(np.sqrt(v))),
+        "l1": lambda: _Pointwise(_l1),
+        "mae": lambda: _Pointwise(_l1),
+        "mean_absolute_error": lambda: _Pointwise(_l1),
+        "mape": lambda: _Pointwise(_mape),
+        "poisson": lambda: _Pointwise(_poisson),
+        "gamma": lambda: _Pointwise(_poisson),
+        "tweedie": lambda: _Pointwise(_poisson),
+        "huber": lambda: _Pointwise(_l2),
+        "fair": lambda: _Pointwise(_l1),
+        "quantile": lambda: _Pointwise(_quantile(float(alpha))),
+        "multi_logloss": lambda: _Pointwise(_multi_logloss),
+        "multi_error": lambda: _Pointwise(_multi_error),
+    }
+    if name not in table:
+        raise ValueError(
+            f"metric {name!r} has no distributed evaluator; known: "
+            f"{sorted(table) + ['ndcg', 'ndcg@k']}"
+        )
+    return table[name]()
+
+
+# ---------------------------------------------------------------------------
+# Process-aligned group assembly (distributed ranking)
+# ---------------------------------------------------------------------------
+def global_group_matrix(
+    local_sizes: np.ndarray, row_offset: int, max_size: int
+) -> Tuple[np.ndarray, np.ndarray]:
+    """This process's groups as (G_local, max_size) GLOBAL-row-index +
+    validity matrices.  ``row_offset`` is where this process's (padded)
+    row block starts in the global sharded array; ``max_size`` the global
+    max group size (host-allgathered so every process pads identically)."""
+    sizes = np.asarray(local_sizes, np.int64)
+    G = len(sizes)
+    idx = np.zeros((G, max_size), np.int32)
+    valid = np.zeros((G, max_size), bool)
+    start = row_offset
+    for g, s in enumerate(sizes):
+        idx[g, :s] = np.arange(start, start + s)
+        valid[g, :s] = True
+        start += s
+    return idx, valid
+
+
+def assemble_global_groups(
+    local_sizes: Optional[np.ndarray], row_offset: int
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Allgather every process's group structure into ONE (ΣG, M) padded
+    index/valid matrix pair, identical on every process.
+
+    Only group METADATA moves (sizes → index matrices): the bounded
+    control-plane traffic the process-local contract allows, exactly like
+    the reference keeps ranking groups worker-local
+    (``repartitionByGroupingColumn``) and reduces only eval scalars.
+    ``row_offset``: global row index where this process's padded block
+    starts (p · rows_per_process for the 1-D process-ordered mesh).
+    """
+    from mmlspark_tpu.parallel.distributed import (
+        host_allgather,
+        host_allgather_ragged_rows,
+    )
+
+    sizes = (
+        np.zeros((0,), np.int64)
+        if local_sizes is None
+        else np.asarray(local_sizes, np.int64)
+    )
+    local_max = int(sizes.max()) if sizes.size else 0
+    M = int(host_allgather(np.asarray([local_max])).max())
+    M = max(M, 1)
+    idx, valid = global_group_matrix(sizes, row_offset, M)
+    idx_g = host_allgather_ragged_rows(idx)
+    valid_g = host_allgather_ragged_rows(valid)
+    return idx_g, valid_g

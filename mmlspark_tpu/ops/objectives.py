@@ -361,18 +361,40 @@ class LambdaRank(Objective):
 
     def set_groups(self, group_sizes: np.ndarray):
         """Precompute padded group index matrix from per-query sizes."""
+        # lazy import: ops must not import engine at module load
+        from mmlspark_tpu.engine.dist_metrics import global_group_matrix
+
         sizes = np.asarray(group_sizes, dtype=np.int64)
-        G, M = len(sizes), int(sizes.max()) if len(sizes) else 1
-        idx = np.zeros((G, M), dtype=np.int32)
-        valid = np.zeros((G, M), dtype=bool)
-        start = 0
-        for g, s in enumerate(sizes):
-            idx[g, :s] = np.arange(start, start + s)
-            valid[g, :s] = True
-            start += s
-        self._group_idx = jnp.asarray(idx)
-        self._group_valid = jnp.asarray(valid)
-        self._state_key = hash(sizes.tobytes())
+        M = max(int(sizes.max()) if len(sizes) else 1, 1)
+        idx, valid = global_group_matrix(sizes, 0, M)
+        return self.set_group_matrix(idx, valid)
+
+    def set_group_matrix(self, idx, valid, state_key=None):
+        """Install a PREBUILT padded (G, M) group matrix.
+
+        The distributed path assembles this globally (process-aligned
+        groups with global row offsets — engine/dist_metrics
+        ``assemble_global_groups``) so the pairwise lambda computation runs
+        unchanged over the globally sharded score vector: the ``score[idx]``
+        gather is the one collective (an allgather of the (n,) scores, the
+        same wire class as a histogram psum), everything after is local.
+        ``idx``/``valid`` may be host numpy or device arrays; device
+        placement (replicated global arrays under a multi-process mesh) is
+        the caller's choice.  Pass ``state_key`` (hash of the HOST
+        matrices) alongside device arrays — otherwise fingerprinting pulls
+        them back to host.
+        """
+        self._group_idx = idx if hasattr(idx, "sharding") else jnp.asarray(
+            np.asarray(idx)
+        )
+        self._group_valid = (
+            valid if hasattr(valid, "sharding") else jnp.asarray(np.asarray(valid))
+        )
+        if state_key is None:
+            state_key = hash(
+                np.asarray(idx).tobytes() + np.asarray(valid).tobytes()
+            )
+        self._state_key = state_key
         return self
 
     def state_key(self):

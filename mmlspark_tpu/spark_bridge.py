@@ -80,6 +80,7 @@ def barrier_train_task(
     context: BarrierContext,
     params: dict,
     timeout_s: int = 1200,
+    valid_rows: Optional[np.ndarray] = None,
 ) -> Optional[str]:
     """The per-task body for ``rdd.barrier().mapPartitions`` (SURVEY.md
     §3.1 ``TrainUtils.trainLightGBM`` translated): rendezvous, bin with a
@@ -98,6 +99,13 @@ def barrier_train_task(
 
     ``local_rows``: this task's partition as (rows, F+1) with the label in
     the LAST column (see :func:`rows_from_arrow_batches`).
+
+    ``valid_rows``: this task's VALIDATION partition in the same layout
+    (the reference's ``validationIndicatorCol`` split — SURVEY.md §2.3.1).
+    Validation rows stay process-local too; per-iteration metrics and
+    early stopping ride psum-able sufficient statistics inside the jitted
+    scan (engine/dist_metrics).  SPMD contract: every task passes either a
+    (possibly empty) array or None uniformly — mixing is undefined.
     """
     initialize_distributed(context, timeout_s=timeout_s)
     mesh = global_mesh()
@@ -119,9 +127,15 @@ def barrier_train_task(
         seed=int(params.get("seed", 0)),
         threads=int(params.get("num_threads", 0)),
     )
+    valid_sets = []
+    if valid_rows is not None:
+        valid_rows = np.ascontiguousarray(valid_rows)
+        valid_sets = [
+            Dataset(valid_rows[:, :-1], np.ascontiguousarray(valid_rows[:, -1]))
+        ]
     booster = train(
-        params, Dataset(X_local, y_local), bin_mapper=bm, mesh=mesh,
-        process_local=True,
+        params, Dataset(X_local, y_local), valid_sets=valid_sets,
+        bin_mapper=bm, mesh=mesh, process_local=True,
     )
     if context.process_id == 0:
         return booster.save_model_string()

@@ -188,15 +188,99 @@ class TestDataParallelTraining:
     def test_process_local_rejects_unsupported(self):
         X, y = _make_binary(n=512, F=4, seed=6)
         bm = BinMapper(max_bin=31).fit(X)
-        with pytest.raises(NotImplementedError, match="valid_sets"):
-            train(dict(objective="binary", num_iterations=2, num_leaves=7,
-                       tree_learner="data"),
-                  Dataset(X, y), valid_sets=[Dataset(X, y)], bin_mapper=bm,
-                  process_local=True)
         with pytest.raises(NotImplementedError, match="quantile/median"):
             train(dict(objective="regression_l1", num_iterations=2,
                        num_leaves=7, tree_learner="data"),
                   Dataset(X, y), bin_mapper=bm, process_local=True)
+
+    def test_process_local_early_stopping_matches_serial(self):
+        # Distributed eval (VERDICT r3 #1): process_local runs valid_sets +
+        # early stopping via in-scan psum-able sufficient statistics.  With
+        # one process the stats reductions run over the same sharded arrays
+        # as mesh training — the stopped iteration and metric curve must
+        # match the serial host-metric path.
+        X, y = _make_binary(n=3000, F=8, seed=7)
+        Xv, yv = _make_binary(n=1000, F=8, seed=8)
+        params = dict(objective="binary", num_iterations=60, num_leaves=31,
+                      min_data_in_leaf=5, metric="binary_logloss",
+                      early_stopping_round=5, learning_rate=0.3,
+                      tree_learner="data")
+        bm = BinMapper(max_bin=63).fit(X)
+        # Same mesh/trees on both sides (meshless-serial can flip a
+        # near-tie split vs the 8-shard psum ordering and cascade — the
+        # serial-merged comparison lives in the multiprocess barrier test);
+        # this isolates the EVAL path: host snapshot metrics vs in-scan
+        # psum-able stats.
+        host_eval = train(dict(params), Dataset(X, y),
+                          valid_sets=[Dataset(Xv, yv)], bin_mapper=bm)
+        dist = train(dict(params), Dataset(X, y),
+                     valid_sets=[Dataset(Xv, yv)], bin_mapper=bm,
+                     process_local=True)
+        # Identical trees (process_local assembly is bit-exact vs
+        # device_put); the metric curve differs only by the evaluator's
+        # numeric path (f32 psum-able stats vs f64 host sums, ~2e-5 abs),
+        # which must not move the stopping decision at a decisive config.
+        assert dist.num_iterations < 60  # early stopping engaged
+        assert host_eval.best_iteration == dist.best_iteration
+        assert dist.num_iterations == host_eval.num_iterations
+        np.testing.assert_allclose(
+            dist.evals_result["valid_0"]["binary_logloss"],
+            host_eval.evals_result["valid_0"]["binary_logloss"],
+            rtol=1e-4, atol=2e-5,
+        )
+        np.testing.assert_allclose(dist.predict(Xv), host_eval.predict(Xv))
+
+    def test_process_local_auc_and_training_metric(self):
+        # Binned-AUC device stats vs the exact host rank-AUC: ≤ ~1e-3
+        # quantization at 4096 bins; the training pseudo-valid rides the
+        # sharded train arrays.
+        X, y = _make_binary(n=2048, F=8, seed=9)
+        Xv, yv = _make_binary(n=800, F=8, seed=10)
+        params = dict(objective="binary", num_iterations=10, num_leaves=15,
+                      min_data_in_leaf=5, metric="auc",
+                      is_provide_training_metric=True, tree_learner="data")
+        bm = BinMapper(max_bin=63).fit(X)
+        serial = train(dict(params, tree_learner="serial"),
+                       Dataset(X, y), valid_sets=[Dataset(Xv, yv)],
+                       bin_mapper=bm)
+        dist = train(dict(params), Dataset(X, y),
+                     valid_sets=[Dataset(Xv, yv)], bin_mapper=bm,
+                     process_local=True)
+        for nm in ("valid_0", "training"):
+            a = np.asarray(serial.evals_result[nm]["auc"])
+            d = np.asarray(dist.evals_result[nm]["auc"])
+            assert a.shape == d.shape
+            assert np.max(np.abs(a - d)) < 2e-3, (nm, a, d)
+
+    def test_process_local_lambdarank_matches_serial(self):
+        # Distributed lambdarank: process-aligned groups assembled into one
+        # global padded index matrix; single-process parity vs serial.
+        rng = np.random.default_rng(11)
+        n_groups, gsize = 64, 16
+        n = n_groups * gsize
+        X = rng.normal(size=(n, 6))
+        rel = np.clip((X[:, 0] + 0.5 * X[:, 1] + rng.normal(scale=0.3, size=n)) * 1.2 + 1.5, 0, 4)
+        y = np.floor(rel)
+        group = np.full(n_groups, gsize, dtype=np.int64)
+        params = dict(objective="lambdarank", num_iterations=12,
+                      num_leaves=15, min_data_in_leaf=3, metric="ndcg@5",
+                      tree_learner="data")
+        bm = BinMapper(max_bin=63).fit(X)
+        serial = train(dict(params, tree_learner="serial"),
+                       Dataset(X, y, group=group), bin_mapper=bm,
+                       valid_sets=[Dataset(X, y, group=group)])
+        dist = train(dict(params), Dataset(X, y, group=group),
+                     bin_mapper=bm,
+                     valid_sets=[Dataset(X, y, group=group)],
+                     process_local=True)
+        np.testing.assert_allclose(
+            dist.predict(X), serial.predict(X), rtol=1e-4, atol=1e-5
+        )
+        np.testing.assert_allclose(
+            dist.evals_result["valid_0"]["ndcg@5"],
+            serial.evals_result["valid_0"]["ndcg@5"],
+            rtol=1e-4,
+        )
 
     def test_distributed_tree_structure_replicated(self):
         # All shards must agree on every split (psum-identical argmax): the
