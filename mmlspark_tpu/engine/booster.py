@@ -110,9 +110,18 @@ class TrainConfig:
     # memory-bound scatter/onehot builders.
     hist_chunk: int = 0
     hist_precision: str = "highest"  # highest (f32) | default (bf16 multiply)
+    # Wire dtype of the cross-shard histogram allreduce: float32 | bfloat16
+    # (halves the dominant data-parallel collective; see GrowConfig)
+    hist_psum_dtype: str = "float32"
     cat_smooth: float = 10.0
     cat_l2: float = 10.0
-    max_cat_threshold: int = 32
+    # 0 = auto (UNCAPPED, resolved to max_bin): LightGBM's default cap of
+    # 32 bounds the cost of its sequential sorted-category scan — a CPU
+    # artifact.  The TPU candidate scan is fully vectorized over every
+    # sorted prefix regardless, so the cap buys nothing and costs measured
+    # AUC (~0.009 on the criteo-schema bench at 200-ish cardinalities).
+    # Set an explicit value (e.g. 32) for LightGBM-matching behavior.
+    max_cat_threshold: int = 0
     num_threads: int = 0  # host-side binner threads (0 = auto)
     # Checkpointed boosting (SURVEY.md §5.4 "tree list is a natural
     # incremental checkpoint"): every `checkpoint_every` iterations the
@@ -1140,12 +1149,15 @@ def train(
         hist_backend=cfg.hist_backend,
         hist_chunk=chunk,
         hist_precision=cfg.hist_precision,
+        hist_psum_dtype=cfg.hist_psum_dtype,
         grow_policy=grow_policy,
         split_batch=split_batch,
         categorical_features=tuple(int(f) for f in cfg.categorical_feature),
         cat_smooth=cfg.cat_smooth,
         cat_l2=cfg.cat_l2,
-        max_cat_threshold=cfg.max_cat_threshold,
+        max_cat_threshold=(
+            cfg.max_cat_threshold if cfg.max_cat_threshold > 0 else cfg.max_bin
+        ),
         voting=voting,
         top_k=cfg.top_k,
         # classes grow sequentially (lax.map below), so the grower's
@@ -1453,8 +1465,14 @@ def train(
         int(np.shape(vs["scores"])[-1]) for vi, vs in enumerate(vsets)
         if not (cfg.is_provide_training_metric and vi == len(vsets) - 1)
     )
+    # Mesh runs ride the scan too (VERDICT r3 #5): the P/PV buffers are
+    # created row-sharded over the data axis (below), the drop einsum and
+    # dynamic_update_slice are elementwise over the sharded rows, and the
+    # drop schedule is host-RNG-only (identical on every process).
+    # ckpt_path is always None for dart (no resume — LightGBM semantics);
+    # kept in the gate as a guard against future checkpoint loosening.
     dart_scan = (
-        dart and mesh is None and ckpt_path is None
+        dart and ckpt_path is None
         and cfg.num_iterations <= 4096
         and cfg.num_iterations * K * _dart_carry_rows <= _DART_SCAN_MAX_ELS
     )
@@ -1710,18 +1728,43 @@ def train(
             )
 
         if dart_scan:
+            if mesh is not None:
+                # (T, K, n) buffers sharded over the data axis from birth —
+                # a mesh DART run never materializes an unsharded P buffer
+                from jax.sharding import NamedSharding
+                from jax.sharding import PartitionSpec as _PS
+
+                _pbuf_sh = NamedSharding(mesh, _PS(None, None, DATA_AXIS))
+
+                def _pbuf(shape):
+                    return jax.jit(
+                        lambda: jnp.zeros(shape, jnp.float32),
+                        out_shardings=_pbuf_sh,
+                    )()
+            else:
+                def _pbuf(shape):
+                    return jnp.zeros(shape, jnp.float32)
+
             # the training pseudo-valid (always last) never reads its PV
             # (its scores ARE the carry) — a zero-size dummy keeps the
-            # carry structure without the (T, K, n) allocation
+            # carry structure without the (T, K, n) allocation.  PV
+            # sharding mirrors each valid set's scores: row-sharded only
+            # in process_local mode (where valid sets are sharded).
             zero_pv = tuple(
                 jnp.zeros((0,), jnp.float32)
                 if cfg.is_provide_training_metric and vi == len(vsets) - 1
-                else jnp.zeros((n_iter,) + np.shape(vs["scores"]), jnp.float32)
+                else (
+                    _pbuf((n_iter,) + np.shape(vs["scores"]))
+                    if process_local
+                    else jnp.zeros(
+                        (n_iter,) + np.shape(vs["scores"]), jnp.float32
+                    )
+                )
                 for vi, vs in enumerate(vsets)
             )
             carry = (
                 scores, tuple(vs["scores"] for vs in vsets),
-                jnp.zeros((n_iter,) + np.shape(scores), jnp.float32),
+                _pbuf((n_iter,) + np.shape(scores)),
                 zero_pv, jnp.zeros((n_iter,), jnp.float32),
             )
         else:

@@ -73,6 +73,12 @@ class GrowConfig:
     # f32 — validate AUC before enabling on a new workload).
     hist_precision: str = "highest"
     axis_name: Optional[str] = None  # set under shard_map for psum
+    # Wire dtype for the histogram allreduce: "float32" (exact) or
+    # "bfloat16" — halves the dominant data-parallel collective (3·L·F·B
+    # floats/pass) at ~2^-8 relative rounding on the cross-shard SUM only
+    # (per-shard accumulation stays f32).  Quality-gate with AUC before
+    # enabling (tools/bench_scaling.py measures both).
+    hist_psum_dtype: str = "float32"
     grow_policy: str = "lossguide"  # lossguide (LightGBM-exact) | depthwise
     # Categorical membership splits (LightGBM's sorted-category algorithm —
     # SURVEY.md §7.4.5; defaults are LightGBM's cat_smooth/cat_l2/
@@ -295,8 +301,34 @@ def _cat_candidates(cfg: GrowConfig, hists, leaf_stats, feat_mask):
 
     key, used = _cat_sort_key(cfg, hist_vb)
     order = jnp.argsort(key, axis=-1)  # (L, F, VB): used block first
-    sorted_h = jnp.take_along_axis(hist_vb, order[None], axis=-1)
-    cum = jnp.cumsum(sorted_h, axis=-1)  # prefix k+1 sums at index k
+    rank = jnp.argsort(order, axis=-1)  # rank of each value bin
+    # Sorted-prefix sums WITHOUT the take_along_axis gather + cumsum (both
+    # slow TPU lowerings — the gather+cumsum chain was ~0.7s of the 2.5s
+    # catmix bench): cum[..., k] = Σ_v hist[..., v]·[rank[v] ≤ k] is ONE
+    # MXU contraction against the rank mask.  Precision follows
+    # cfg.hist_precision like the histogram kernels: "highest" runs the
+    # f32 dot exactly; "default" uses the hi/lo bf16 split (the factorized
+    # pallas-kernel idiom) — le is exact 0/1 in bf16, the hist splits into
+    # bf16 high + residual for ~2^-16 relative accuracy on the sums.
+    le = rank[..., :, None] <= jnp.arange(VB, dtype=rank.dtype)[None, :]
+
+    if cfg.hist_precision == "default":
+        le_b = le.astype(jnp.bfloat16)
+
+        def _mm(x):
+            return jnp.einsum(
+                "clfv,lfvk->clfk", x, le_b,
+                preferred_element_type=jnp.float32,
+            )
+
+        hi = hist_vb.astype(jnp.bfloat16)
+        lo = (hist_vb - hi.astype(jnp.float32)).astype(jnp.bfloat16)
+        cum = _mm(hi) + _mm(lo)  # prefix k+1 sums at index k
+    else:
+        cum = jnp.einsum(
+            "clfv,lfvk->clfk", hist_vb, le.astype(jnp.float32),
+            precision=jax.lax.Precision.HIGHEST,
+        )
     nuse = used.sum(axis=-1)[..., None]  # (L, F, 1)
     k = jnp.arange(VB)[None, None, :]
     fm = jnp.broadcast_to(feat_mask, (L, F))[..., None]
@@ -569,6 +601,7 @@ def grow_tree(
         return build_histogram(
             bins_t, vals, mask, B,
             backend=cfg.hist_backend, chunk=cfg.hist_chunk, axis_name=cfg.axis_name,
+            psum_dtype=cfg.hist_psum_dtype,
             precision=cfg.hist_precision, transposed=True,
         )
 
@@ -697,6 +730,7 @@ def grow_tree_depthwise(
         return build_histogram_by_leaf(
             bins_t, vals, win_leaf, W, B,
             backend=cfg.hist_backend, chunk=cfg.hist_chunk, axis_name=hist_axis,
+            psum_dtype=cfg.hist_psum_dtype,
             precision=cfg.hist_precision, transposed=True,
         )
 

@@ -84,6 +84,7 @@ def build_histogram(
     axis_name: Optional[str] = None,
     precision: str = "highest",
     transposed: bool = False,
+    psum_dtype: str = "float32",
 ) -> jnp.ndarray:
     """Histogram of ``vals`` (3, n) over (feature, bin), rows gated by
     ``mask``; returns (3, F, B).
@@ -139,7 +140,14 @@ def build_histogram(
 
         hist, _ = lax.scan(body, jnp.zeros((3, F, num_bins), jnp.float32), (bc, vc))
     if axis_name is not None:
-        hist = lax.psum(hist, axis_name)
+        if psum_dtype == "bfloat16":
+            # halve the wire: per-shard sums stay f32; only the cross-
+            # shard reduction rides bf16 (tools/bench_scaling.py gates it)
+            hist = lax.psum(hist.astype(jnp.bfloat16), axis_name).astype(
+                jnp.float32
+            )
+        else:
+            hist = lax.psum(hist, axis_name)
     return hist
 
 
@@ -175,6 +183,7 @@ def build_histogram_by_leaf(
     axis_name: Optional[str] = None,
     precision: str = "highest",
     transposed: bool = False,
+    psum_dtype: str = "float32",
 ) -> jnp.ndarray:
     """Per-leaf histograms in ONE pass over the data: (3, L, F, B).
 
@@ -244,5 +253,12 @@ def build_histogram_by_leaf(
             (bc, vc, lc),
         )
     if axis_name is not None:
-        hist = lax.psum(hist, axis_name)
+        if psum_dtype == "bfloat16":
+            # halve the wire: per-shard sums stay f32; only the cross-
+            # shard reduction rides bf16 (tools/bench_scaling.py gates it)
+            hist = lax.psum(hist.astype(jnp.bfloat16), axis_name).astype(
+                jnp.float32
+            )
+        else:
+            hist = lax.psum(hist, axis_name)
     return hist

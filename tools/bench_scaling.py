@@ -1,0 +1,222 @@
+"""Multi-chip scaling evidence: weak scaling + collective-bytes accounting.
+
+VERDICT r3 #4: nothing measured how the data-parallel/voting collectives
+scale.  This tool produces the table BASELINE.md commits:
+
+1. **Weak scaling** over 1→8 virtual CPU devices (fixed rows/device):
+   steady train wall for ``tree_learner=data`` vs ``voting`` vs data with
+   the bf16 histogram wire (``hist_psum_dtype="bfloat16"``), plus AUC so
+   wire-precision tradeoffs are quality-gated.  Virtual CPU devices share
+   one core, so WALL numbers measure collective/overhead growth (the
+   shape of the curve), not real ICI speedup — the BYTES are the part
+   that predicts v5e-32 behavior.
+2. **Measured collective bytes**: every ``lax.psum``/``all_gather`` the
+   training program actually traces is recorded (shape × dtype at the
+   call site — a tracing shim, so the numbers come from the real program,
+   not a hand formula), scaled by the statically known pass count.  For
+   the bench-shape depthwise config the dominant term is the histogram
+   allreduce: 3·W·F·B floats/pass for data-parallel vs the elected
+   top-2k slices (3·W·2k·B) + votes for voting-parallel.
+3. **psum vs psum_scatter microbench** on a histogram-shaped array — the
+   upper bound for a future reduce_scatter split search (each shard
+   electing candidates for its own bin slice).
+
+Usage:  python tools/bench_scaling.py            # full table (spawns children)
+        python tools/bench_scaling.py --child D  # one device count (internal)
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+ROWS_PER_DEV = 32_768
+F = 64
+B = 256
+ITERS = 10
+LEAVES = 63
+TOP_K = 8
+
+
+def _log(*a):
+    print(*a, file=sys.stderr, flush=True)
+
+
+class CollectiveRecorder:
+    """Tracing shim over lax.psum / lax.all_gather: records operand bytes
+    per traced call site.  Numbers reflect the REAL program's collectives
+    (anything the grower adds or removes shows up here unprompted)."""
+
+    def __init__(self):
+        self.calls = []
+
+    def __enter__(self):
+        from jax import lax
+
+        self._lax = lax
+        self._psum, self._ag = lax.psum, lax.all_gather
+        rec = self.calls
+
+        def psum(x, axis_name, **kw):
+            import jax
+
+            for leaf in jax.tree_util.tree_leaves(x):
+                rec.append(("psum", tuple(leaf.shape), str(leaf.dtype),
+                            int(np.prod(leaf.shape)) * leaf.dtype.itemsize))
+            return self._psum(x, axis_name, **kw)
+
+        def all_gather(x, axis_name, **kw):
+            import jax
+
+            for leaf in jax.tree_util.tree_leaves(x):
+                rec.append(("all_gather", tuple(leaf.shape), str(leaf.dtype),
+                            int(np.prod(leaf.shape)) * leaf.dtype.itemsize))
+            return self._ag(x, axis_name, **kw)
+
+        self._lax.psum, self._lax.all_gather = psum, all_gather
+        return self
+
+    def __exit__(self, *exc):
+        self._lax.psum, self._lax.all_gather = self._psum, self._ag
+
+    def summary(self):
+        out = {}
+        for kind, shape, dtype, nbytes in self.calls:
+            key = f"{kind}{list(shape)}:{dtype}"
+            ent = out.setdefault(key, {"bytes": nbytes, "traced_calls": 0})
+            ent["traced_calls"] += 1
+        return out
+
+
+def make_data(n, seed=0):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, F)).astype(np.float32)
+    w = rng.normal(size=F) * (rng.random(F) < 0.4)
+    logits = X @ w + 0.5 * X[:, 0] * X[:, 1]
+    y = (logits + rng.logistic(size=n) > 0).astype(np.float64)
+    return X.astype(np.float64), y
+
+
+def _auc(y, p):
+    from mmlspark_tpu.engine.eval_metrics import auc
+
+    return float(auc(y, p))
+
+
+def run_child(n_dev: int):
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_num_cpu_devices", n_dev)
+
+    from mmlspark_tpu.engine.booster import Dataset, train
+    from mmlspark_tpu.ops.binning import BinMapper
+    from mmlspark_tpu.parallel.mesh import default_mesh
+
+    n = ROWS_PER_DEV * n_dev  # weak scaling: fixed rows per device
+    X, y = make_data(n)
+    bm = BinMapper(max_bin=B - 1).fit(X)
+    ds = Dataset(X, y)
+    ds.binned(bm)
+    mesh = default_mesh() if n_dev > 1 else None
+    base = dict(
+        objective="binary", num_iterations=ITERS, num_leaves=LEAVES,
+        max_bin=B - 1, min_data_in_leaf=20, grow_policy="depthwise",
+        top_k=TOP_K,
+    )
+    results = {"n_devices": n_dev, "rows": n, "modes": {}}
+    modes = [("data", dict(tree_learner="data")),
+             ("data_bf16wire", dict(tree_learner="data",
+                                    hist_psum_dtype="bfloat16")),
+             ("voting", dict(tree_learner="voting"))]
+    if n_dev == 1:
+        modes = [("data", dict(tree_learner="serial"))]
+    for name, extra in modes:
+        params = dict(base, **extra)
+        with CollectiveRecorder() as rec:
+            train(params, ds, bin_mapper=bm, mesh=mesh)  # compile + trace
+        t0 = time.perf_counter()
+        booster = train(params, ds, bin_mapper=bm, mesh=mesh)
+        wall = time.perf_counter() - t0
+        results["modes"][name] = {
+            "steady_wall_s": round(wall, 3),
+            "auc": round(_auc(y, booster.predict(X)), 5),
+            "collectives": rec.summary(),
+        }
+
+    # psum vs psum_scatter microbench on a histogram-shaped array
+    if n_dev > 1:
+        import jax.numpy as jnp
+        from jax.sharding import PartitionSpec as P
+
+        W = (LEAVES + 1) // 2 + 2  # the level window the grower uses
+        shape = (3, W, F, B)
+        h = jnp.ones((n_dev,) + shape, jnp.float32)
+
+        def timed(fn, *args):
+            fn(*args)[0].block_until_ready() if isinstance(fn(*args), tuple) \
+                else fn(*args).block_until_ready()
+            t0 = time.perf_counter()
+            for _ in range(5):
+                r = fn(*args)
+                jax.tree_util.tree_leaves(r)[0].block_until_ready()
+            return (time.perf_counter() - t0) / 5
+
+        psum_f = jax.jit(jax.shard_map(
+            lambda x: jax.lax.psum(x[0], "data"), mesh=mesh,
+            in_specs=P("data"), out_specs=P()))
+        scat_f = jax.jit(jax.shard_map(
+            lambda x: jax.lax.psum_scatter(
+                x[0], "data", scatter_dimension=3, tiled=True),
+            mesh=mesh, in_specs=P("data"), out_specs=P(None, None, None, "data")))
+        results["microbench"] = {
+            "shape": list(shape),
+            "psum_s": round(timed(psum_f, h), 5),
+            "psum_scatter_s": round(timed(scat_f, h), 5),
+        }
+    print(json.dumps(results))
+
+
+def main():
+    rows = []
+    for d in (1, 2, 4, 8):
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        env.pop("JAX_NUM_CPU_DEVICES", None)
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--child", str(d)],
+            env=env, capture_output=True, text=True, timeout=1200,
+        )
+        if proc.returncode != 0:
+            _log(f"child D={d} failed:\n{proc.stderr[-3000:]}")
+            continue
+        rows.append(json.loads(proc.stdout.strip().splitlines()[-1]))
+        _log(f"D={d} done")
+    print(json.dumps(rows, indent=1))
+    # Human summary table
+    _log("\nD  rows    mode            wall(s)  AUC      hist-allreduce/pass")
+    for r in rows:
+        for mode, m in r["modes"].items():
+            hist_key = next(
+                (k for k in m["collectives"] if "psum[3," in k), "-"
+            )
+            hb = m["collectives"].get(hist_key, {}).get("bytes", 0)
+            _log(f"{r['n_devices']}  {r['rows']:>7} {mode:<15} "
+                 f"{m['steady_wall_s']:>7} {m['auc']:.4f}  "
+                 f"{hb/1e6:.2f} MB ({hist_key})")
+        if "microbench" in r:
+            mb = r["microbench"]
+            _log(f"   microbench {mb['shape']}: psum={mb['psum_s']}s "
+                 f"psum_scatter={mb['psum_scatter_s']}s")
+
+
+if __name__ == "__main__":
+    if len(sys.argv) >= 3 and sys.argv[1] == "--child":
+        run_child(int(sys.argv[2]))
+    else:
+        main()

@@ -24,13 +24,20 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 def main():
     import jax
 
-    from bench import MAX_BIN, bench_config, make_data
+    from bench import MAX_BIN, bench_config, make_catmix_data, make_data
     from mmlspark_tpu.engine.booster import Dataset, train
     from mmlspark_tpu.ops.binning import BinMapper
 
-    params = bench_config()  # the EXACT bench params + compile cache
-    X, y = make_data()
-    bm = BinMapper(max_bin=MAX_BIN).fit(X)
+    if "catmix" in sys.argv[1:]:
+        X, y, cat_idx = make_catmix_data()
+        params = bench_config(cat_idx)  # headline config + compile cache
+        bm = BinMapper(
+            max_bin=MAX_BIN, categorical_features=tuple(cat_idx)
+        ).fit(X)
+    else:
+        params = bench_config()  # numeric config + compile cache
+        X, y = make_data()
+        bm = BinMapper(max_bin=MAX_BIN).fit(X)
     ds = Dataset(X, y)
     ds.binned(bm)
     train(params, ds, bin_mapper=bm)  # warm
