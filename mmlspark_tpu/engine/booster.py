@@ -917,19 +917,11 @@ def train(
     )
     F_real = F
     if feature_par:
-        if cfg.categorical_feature:
-            # The categorical split scan needs the static categorical
-            # column set, which cannot differ per shard inside one SPMD
-            # program.  Checked only when the mode actually ENGAGES (>1
-            # shard): on a single device the learner trains serially, where
-            # categoricals work — matching LightGBM's 1-machine behavior.
-            raise NotImplementedError(
-                "tree_learner='feature' does not support categorical_feature "
-                "on a multi-device mesh; use tree_learner='data' (identical "
-                "model, different communication pattern)"
-            )
         # Pad columns to a multiple of the shard count; padded columns are
         # masked out of every candidate search (feat_valid below).
+        # Categoricals: each shard derives its local columns' kinds at RUN
+        # time from axis_index (tree.py _fp_local_cat_mask) — right-padding
+        # never renumbers real columns, so the global indices stay valid.
         f_pad = (-F) % D
         if f_pad:
             bins_np = np.pad(bins_np, ((0, 0), (0, f_pad)))
@@ -1515,6 +1507,18 @@ def train(
         )
         evaluators = [vs.get("evaluator") for vs in vsets]
         it_global = np.arange(key_start, total_keyed, dtype=np.int32)
+        # ONE packed xs upload per chunk: each host→device transfer pays a
+        # full RPC latency on remote-dispatch links (~120ms measured), so
+        # iteration keys (c,2) + bag keys (c,2) + global iteration index
+        # ride one (c,5) uint32 array, unpacked inside the scan body.
+        xs_packed = np.concatenate(
+            [
+                np.asarray(iter_keys, dtype=np.uint32),
+                np.asarray(bag_keys, dtype=np.uint32),
+                it_global[:, None].astype(np.uint32),
+            ],
+            axis=1,
+        )
 
         # Like `iteration` above: device data enters as ARGUMENTS (valid
         # bins included, eval label/weight/mask/group aux included) so
@@ -1527,12 +1531,14 @@ def train(
 
             def scan_chunk(
                 bins_a, y_a, w_a, vmask_a, init_scores_a, vbins_a, vaux_a,
-                carry, keys_c, bag_keys_c, it_c, *dart_xs,
+                carry, xs_c, *dart_xs,
             ):
                 def body(car, xs):
                     if dart_scan:
                         scores_c, vscores_c, P, PVs, wts = car
-                        key, bag_key, it_g, drop_row, it_idx = xs
+                        xs_row, drop_row, it_idx = xs
+                        key, bag_key = xs_row[:2], xs_row[2:4]
+                        it_g = xs_row[4].astype(jnp.int32)
                         # dropped contribution removed in ONE einsum over
                         # the carried per-tree prediction buffer (exact
                         # precision: scores must match legacy replay)
@@ -1544,7 +1550,9 @@ def train(
                         train_scores = scores_c - sub
                     else:
                         scores_c, vscores_c = car
-                        key, bag_key, it_g = xs
+                        (xs_row,) = xs
+                        key, bag_key = xs_row[:2], xs_row[2:4]
+                        it_g = xs_row[4].astype(jnp.int32)
                         train_scores = (
                             init_scores_a if cfg.boosting == "rf" else scores_c
                         )
@@ -1642,7 +1650,7 @@ def train(
                     return (scores_c, vscores_c), (tree, ys_v)
 
                 return jax.lax.scan(
-                    body, carry, (keys_c, bag_keys_c, it_c) + tuple(dart_xs)
+                    body, carry, (xs_c,) + tuple(dart_xs)
                 )
 
             return jax.jit(scan_chunk)
@@ -1781,9 +1789,8 @@ def train(
             )
             carry, (trees_c, vsnap_c) = scan_chunk(
                 bins_dev, y_dev, w_dev, valid_mask, init_scores_dev, vbins_t,
-                vaux_t, carry, jnp.asarray(iter_keys[n_done : n_done + c]),
-                jnp.asarray(bag_keys[n_done : n_done + c]),
-                jnp.asarray(it_global[n_done : n_done + c]), *dart_xs,
+                vaux_t, carry, jnp.asarray(xs_packed[n_done : n_done + c]),
+                *dart_xs,
             )
             tree_chunks.append(trees_c)
             if ckpt_path is not None:

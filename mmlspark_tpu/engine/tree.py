@@ -547,6 +547,40 @@ def _voting_leaf_candidates(cfg: GrowConfig, hists_local, leaf_stats_local, feat
     return take(gain_s), f, take(t_s), take(d_s), is_cat, hists_sel, sel, j
 
 
+def _fp_local_cat_mask(cfg: GrowConfig, F_local: int):
+    """Runtime (F_local,) categorical mask of THIS shard's column block.
+
+    ``cfg.categorical_features`` holds GLOBAL column indices, but one SPMD
+    program cannot specialize statically per shard — so the mask is
+    computed from ``lax.axis_index`` at run time: local column j is global
+    ``shard·F_local + j``, compared against the static set (a handful of
+    traced equality ops, no extra operand threading).
+    """
+    shard = lax.axis_index(cfg.axis_name)
+    gids = shard * F_local + jnp.arange(F_local, dtype=jnp.int32)
+    m = jnp.zeros(F_local, bool)
+    for c in cfg.categorical_features:
+        m = m | (gids == c)
+    return m
+
+
+def _fp_leaf_candidates(cfg: GrowConfig, hists, leaf_stats, feat_mask, cmask):
+    """Per-leaf best over a feature-parallel LOCAL block with a RUNTIME
+    categorical mask: numeric and sorted-category candidates are both
+    computed for every local column and selected per column by ``cmask``
+    (the voting path's dynamic-election technique) — a static per-shard
+    column subset cannot exist inside one SPMD program."""
+    _, L, F, B = hists.shape
+    gain, t, d = _numeric_candidates(cfg, hists, leaf_stats, feat_mask)
+    cgain, ck, cdesc = _cat_candidates(cfg, hists, leaf_stats, feat_mask)
+    gain = jnp.where(cmask[None, :], cgain, gain)
+    t = jnp.where(cmask[None, :], ck, t)
+    d = jnp.where(cmask[None, :], cdesc, d)
+    f = jnp.argmax(gain, axis=1).astype(jnp.int32)  # (L,)
+    take = lambda a: jnp.take_along_axis(a, f[:, None], axis=1)[:, 0]  # noqa: E731
+    return take(gain), f, take(t), take(d), cmask[f]
+
+
 def _best_split(cfg: GrowConfig, hists, leaf_stats, leaf_depth, num_leaves, feat_mask):
     """Global best split over all leaves (lossguide step)."""
     L = hists.shape[1]
@@ -800,9 +834,17 @@ def grow_tree_depthwise(
             # local index — together the lowest GLOBAL feature index,
             # identical to the serial argmax tie-break (features are
             # sharded in contiguous ascending blocks).
-            gain_l, f_l, t_l, d_l, _ = _leaf_candidates(
-                cfg, hists[:, :L], leaf_stats, feat_mask
-            )
+            if cfg.has_categoricals:
+                # runtime per-shard column kinds (a static per-shard set
+                # cannot exist in one SPMD program — VERDICT r3 #7)
+                fp_cmask = _fp_local_cat_mask(cfg, F)
+                gain_l, f_l, t_l, d_l, ic_l = _fp_leaf_candidates(
+                    cfg, hists[:, :L], leaf_stats, feat_mask, fp_cmask
+                )
+            else:
+                gain_l, f_l, t_l, d_l, ic_l = _leaf_candidates(
+                    cfg, hists[:, :L], leaf_stats, feat_mask
+                )
             ax = cfg.axis_name
             shard = lax.axis_index(ax)
             cand = jnp.stack([
@@ -810,8 +852,9 @@ def grow_tree_depthwise(
                 (f_l + shard * F).astype(jnp.float32),  # global feature id
                 t_l.astype(jnp.float32),
                 d_l.astype(jnp.float32),
-            ])  # (4, L)
-            allc = lax.all_gather(cand, ax)  # (D, 4, L)
+                ic_l.astype(jnp.float32),
+            ])  # (5, L)
+            allc = lax.all_gather(cand, ax)  # (D, 5, L)
             win_shard = jnp.argmax(allc[:, 0, :], axis=0)  # (L,)
 
             def take_s(c):
@@ -821,7 +864,7 @@ def grow_tree_depthwise(
             f = take_s(1).astype(jnp.int32)  # GLOBAL index (for the record)
             t = take_s(2).astype(jnp.int32)
             dleft = take_s(3) > 0.5
-            is_cat = jnp.zeros(L, bool)
+            is_cat = take_s(4) > 0.5
             fp_own = win_shard == shard  # (L,) leaf's winner lives here
             fp_f_local = jnp.clip(f - shard * F, 0, F - 1)
         leaf_ok = leaf_arange < cur_leaves
@@ -854,6 +897,20 @@ def grow_tree_depthwise(
                 hist_lf = jnp.take_along_axis(
                     hists_sel, sel_j[None, :, None, None], axis=2
                 )[:, :, 0]  # (3, L, B)
+            elif cfg.feature_parallel_active:
+                # The winner's histogram lives whole on its OWNING shard
+                # (rows replicated ⇒ local histograms are complete); one
+                # small psum of the owner's (3, L, B) slice replicates it,
+                # so every shard derives the identical membership set —
+                # the exchange rides the same owner-broadcast structure as
+                # the row partition below.
+                hist_own = jnp.take_along_axis(
+                    hists[:, :L], fp_f_local[None, :, None, None], axis=2
+                )[:, :, 0]  # (3, L, B)
+                hist_lf = lax.psum(
+                    jnp.where(fp_own[None, :, None], hist_own, 0.0),
+                    cfg.axis_name,
+                )
             else:
                 hist_lf = jnp.take_along_axis(
                     hists[:, :L], f[None, :, None, None], axis=2
@@ -874,6 +931,23 @@ def grow_tree_depthwise(
             fcol = jnp.take_along_axis(bins_t, f_row[None, :], axis=0)[0]
             is_missing = fcol == (B - 1)
             gl_local = jnp.where(is_missing, dleft[leaf_ids], fcol <= t[leaf_ids])
+            if cfg.has_categoricals:
+                # categorical winners route rows by MEMBERSHIP: per-leaf
+                # sets bit-packed to (L, ⌈B/32⌉) u32 words, one small-table
+                # take per row (the `members` above is already global —
+                # psum-ed from the owner — so every shard agrees)
+                nw = (B + 31) // 32
+                mbits = jnp.pad(members, ((0, 0), (0, nw * 32 - B)))
+                words = (
+                    mbits.reshape(L, nw, 32).astype(jnp.uint32)
+                    << jnp.arange(32, dtype=jnp.uint32)[None, None, :]
+                ).sum(axis=2)  # (L, nw)
+                wsel = jnp.take(
+                    words.reshape(-1),
+                    leaf_ids * nw + (fcol >> 5).astype(jnp.int32),
+                )
+                gl_cat = ((wsel >> (fcol & 31).astype(jnp.uint32)) & 1) > 0
+                gl_local = jnp.where(is_cat[leaf_ids], gl_cat, gl_local)
             own_row = fp_own[leaf_ids]
             goes_left = lax.psum(
                 jnp.where(own_row, gl_local.astype(jnp.float32), 0.0),

@@ -165,12 +165,33 @@ class TestDataParallelTraining:
         feats = np.asarray(fp.trees.split_feat)[np.asarray(fp.trees.split_leaf) >= 0]
         assert (feats < 16).all()
 
-    def test_feature_parallel_rejects_categoricals(self):
-        X, y = _make_binary(n=512, F=4, seed=11)
-        with pytest.raises(NotImplementedError, match="categorical"):
-            train(dict(objective="binary", num_iterations=2, num_leaves=7,
-                       tree_learner="feature", categorical_feature=[1]),
-                  Dataset(X, y))
+    def test_feature_parallel_categoricals_match_serial(self):
+        # VERDICT r3 #7: categorical membership splits in tree_learner=
+        # 'feature' — runtime per-shard column kinds, owner-psum membership
+        # exchange.  Gate: near-identical structure + model-quality parity
+        # (the numeric feature-parallel contract).
+        rng = np.random.default_rng(12)
+        n = 2048
+        Xn = rng.normal(size=(n, 6))
+        c0 = rng.integers(0, 9, size=n)
+        c1 = rng.integers(0, 5, size=n)
+        logits = Xn[:, 0] - 0.8 * Xn[:, 1] + 1.2 * np.isin(c0, [2, 5]) - 0.7 * (c1 == 3)
+        y = (logits + rng.normal(scale=0.4, size=n) > 0).astype(np.float64)
+        X = np.column_stack([Xn, c0.astype(np.float64), c1.astype(np.float64)])
+        params = dict(objective="binary", num_iterations=10, num_leaves=15,
+                      min_data_in_leaf=5, categorical_feature=[6, 7])
+        bm = BinMapper(max_bin=63, categorical_features=(6, 7)).fit(X)
+        serial = train(dict(params), Dataset(X, y), bin_mapper=bm)
+        fp = train(dict(params, tree_learner="feature"), Dataset(X, y),
+                   bin_mapper=bm)
+        ps, pf = serial.predict(X), fp.predict(X)
+        assert abs(_auc(y, ps) - _auc(y, pf)) < 1e-3
+        assert _auc(y, pf) > 0.9
+        # categorical splits actually used
+        assert bool(np.asarray(fp.trees.split_cat).any())
+        sf = np.asarray(serial.trees.split_feat).ravel()
+        ff = np.asarray(fp.trees.split_feat).ravel()
+        assert np.mean(sf != ff) <= 0.15, (sf, ff)
 
     def test_process_local_matches_mesh_training(self):
         # process_local=True routes through make_array_from_process_local_

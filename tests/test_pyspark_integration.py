@@ -1,0 +1,107 @@
+"""Live-pyspark integration (VERDICT r3 #6) — skip-gated: no JVM/pyspark
+exists in this image (verified at collection time), but when one is
+present this suite runs the REAL Spark entry points end to end:
+
+1. ``barrier_train_task`` inside an actual ``rdd.barrier().mapPartitions``
+   stage on ``local[*]`` — the reference's execution shape (SURVEY.md §3.1
+   ``TrainUtils.trainLightGBM`` inside a barrier stage), with task
+   addresses from ``BarrierTaskContext.getTaskInfos()`` driving the
+   jax.distributed rendezvous.
+2. ``fit_on_spark`` on a pyspark DataFrame through the Arrow boundary.
+
+The JVM/R surface decision record lives in README.md ("Spark/JVM
+integration"): the supported surface is Python-first (pyspark barrier
+stage + Arrow), because the reference's Scala facade exists to host
+codegen'd wrappers around a JVM-side native loader — our native side IS
+the Python process (jax/XLA), so a Scala shim would be a remoting layer
+with no counterpart runtime.  The thin generated PySpark-facing surface
+(generated_api.py) plays the role of the reference's generated wrappers.
+"""
+
+import numpy as np
+import pytest
+
+pyspark = pytest.importorskip("pyspark", reason="pyspark not installed in this image")
+
+
+@pytest.fixture(scope="module")
+def spark():
+    from pyspark.sql import SparkSession
+
+    s = (
+        SparkSession.builder.master("local[2]")
+        .appName("mmlspark_tpu-it")
+        .config("spark.sql.execution.arrow.pyspark.enabled", "true")
+        .getOrCreate()
+    )
+    yield s
+    s.stop()
+
+
+def _toy(n=400, seed=0):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, 4))
+    y = (X[:, 0] - 0.5 * X[:, 1] + rng.normal(scale=0.3, size=n) > 0).astype(float)
+    return X, y
+
+
+def test_barrier_train_task_in_real_barrier_stage(spark):
+    """2 barrier tasks on local[2], each holding only its partition, train
+    one distributed model; task 0 returns the model string."""
+    from pyspark import BarrierTaskContext
+
+    X, y = _toy()
+    rows = np.column_stack([X, y])
+    rdd = spark.sparkContext.parallelize(
+        [rows[: len(rows) // 2], rows[len(rows) // 2:]], numSlices=2
+    )
+
+    def task(it):
+        import os
+
+        from mmlspark_tpu.spark_bridge import (
+            barrier_context_from_task_infos,
+            barrier_train_task,
+        )
+
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        ctx = BarrierTaskContext.get()
+        addresses = [i.address for i in ctx.getTaskInfos()]
+        bctx = barrier_context_from_task_infos(addresses, ctx.partitionId())
+        part = np.concatenate(list(it), axis=0)
+        model = barrier_train_task(
+            part,
+            bctx,
+            dict(objective="binary", num_iterations=5, num_leaves=7,
+                 min_data_in_leaf=2, tree_learner="data"),
+            timeout_s=120,
+        )
+        return [model] if model is not None else []
+
+    out = rdd.barrier().mapPartitions(task).collect()
+    assert len(out) == 1 and out[0].startswith("tree\n")
+
+    from mmlspark_tpu.engine.booster import Booster
+
+    booster = Booster.from_model_string(out[0])
+    pred = booster.predict(X)
+    assert ((pred > 0.5).astype(float) == y).mean() > 0.85
+
+
+def test_fit_on_spark_end_to_end(spark):
+    from pyspark.sql import Row
+
+    from mmlspark_tpu.models.lightgbm import LightGBMClassifier
+    from mmlspark_tpu.spark_bridge import fit_on_spark
+
+    X, y = _toy()
+    sdf = spark.createDataFrame(
+        [Row(features=[float(v) for v in X[i]], label=float(y[i]))
+         for i in range(len(y))]
+    )
+    model = fit_on_spark(
+        LightGBMClassifier(numIterations=5, numLeaves=7, minDataInLeaf=2),
+        sdf,
+    )
+    proba = model.getBooster().predict(X)
+    assert ((proba > 0.5).astype(float) == y).mean() > 0.85
