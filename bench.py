@@ -143,15 +143,24 @@ def bench_tpu(X, y, categorical_feature=(), tag="tpu"):
     ds.binned(bm)
     bin_transform_s = time.perf_counter() - t0
     _log(f"[{tag}] host binning: fit={bin_fit_s:.2f}s transform={bin_transform_s:.2f}s")
+    def _sync(b):
+        # train() leaves the forest DEVICE-RESIDENT and returns without a
+        # host sync (r4); the timed region must wait for completion — a
+        # tiny fetch is the reliable sync through the tunnel
+        # (block_until_ready is not).
+        np.asarray(b.trees.num_leaves)
+
     # Run 1 pays jit compilation + the bins upload; the steady state is the
     # BEST of two post-compile runs (protocol in the module docstring).
     t0 = time.perf_counter()
     booster = train(params, ds, bin_mapper=bm)
+    _sync(booster)
     cold = time.perf_counter() - t0
     steadies = []
     for _ in range(2):
         t0 = time.perf_counter()
         booster = train(params, ds, bin_mapper=bm)
+        _sync(booster)
         steadies.append(time.perf_counter() - t0)
     wall = min(steadies)
     a = auc(y[:100_000], booster.predict(X[:100_000]))

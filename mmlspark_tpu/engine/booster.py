@@ -337,6 +337,30 @@ class Booster:
         self.evals_result: Dict[str, Dict[str, List[float]]] = {}
         self._predict_cache: Dict[Tuple, callable] = {}
 
+    def _host_trees(self) -> Tree:
+        """Host (numpy) copy of the forest, materialized LAZILY via ONE
+        bit-packed fetch and cached.
+
+        train() keeps the forest device-resident (predict consumes it
+        there; fetching + re-uploading cost ~3 RPC latencies per fit on
+        remote-dispatch links), so export/pickle/importance paths pull it
+        through here instead of per-field ``np.asarray`` (10 fetch RPCs).
+        """
+        if getattr(self, "_trees_np", None) is None:
+            if isinstance(self.trees.split_leaf, np.ndarray):
+                self._trees_np = self.trees
+            else:
+                # cat_threshold planes are ~97% of the packed bits but all
+                # False for non-categorical models: trust the config when
+                # it declares categoricals; otherwise confirm with one
+                # small split_cat fetch (a booster loaded from a model
+                # string may carry cat splits its config never mentions).
+                has_cats = bool(
+                    getattr(self.config, "categorical_feature", ())
+                ) or bool(np.asarray(self.trees.split_cat).any())
+                self._trees_np = _fetch_tree_chunks([self.trees], has_cats)[0]
+        return self._trees_np
+
     # Boosters ride inside pickled ComplexParams (e.g. a fitted model nested
     # in BestModel/TrainedClassifierModel); the jitted-closure cache and
     # device arrays must not enter the pickle (found by the registry fuzz).
@@ -344,7 +368,8 @@ class Booster:
         state = dict(self.__dict__)
         state["_predict_cache"] = {}
         state.pop("_native_predictor", None)  # ctypes handle: rebuild lazily
-        state["trees"] = Tree(*[np.asarray(a) for a in self.trees])
+        state.pop("_trees_np", None)
+        state["trees"] = self._host_trees()
         return state
 
     def __setstate__(self, state):
@@ -454,14 +479,15 @@ class Booster:
     def feature_importance(self, importance_type: str = "split") -> np.ndarray:
         """Split-count or total-gain importances (parity:
         ``LightGBMBooster.getFeatureImportances`` — SURVEY.md §2.3)."""
-        feats = np.asarray(self.trees.split_feat).reshape(-1)
-        active = np.asarray(self.trees.split_leaf).reshape(-1) >= 0
+        host = self._host_trees()
+        feats = np.asarray(host.split_feat).reshape(-1)
+        active = np.asarray(host.split_leaf).reshape(-1) >= 0
         F = self.num_features
         out = np.zeros(F)
         if importance_type == "split":
             np.add.at(out, feats[active], 1.0)
         else:
-            gains = np.asarray(self.trees.split_gain).reshape(-1)
+            gains = np.asarray(host.split_gain).reshape(-1)
             np.add.at(out, feats[active], gains[active])
         return out
 
@@ -615,10 +641,56 @@ _PARALLEL_LEARNERS = (
 _SCAN_CACHE: Dict[Tuple, callable] = {}
 _SCAN_CACHE_MAX = 16
 
+# Device copies of the packed per-iteration xs (keys/bag-keys/iteration
+# index) cached across train() calls: the array derives deterministically
+# from (seed, bagging config, iteration range), and every host→device
+# upload pays a full RPC latency on remote-dispatch links — repeated fits
+# (CV folds, AutoML candidates, benches) reuse the same xs bytes.
+_XS_CACHE: Dict[Tuple, object] = {}
+_XS_CACHE_MAX = 8
+
 # DART's scan path carries a (num_iterations, K, n) per-tree prediction
 # buffer; beyond this element budget it falls back to the legacy
 # per-iteration loop (tests monkeypatch this to force the legacy path).
 _DART_SCAN_MAX_ELS = 128_000_000
+
+
+# Jitted device-side chunk stackers, cached by (chunk count, kept,
+# has-bias) — a fresh jax.jit per train() call would retrace every fit,
+# and the bias VALUES enter as a traced argument (each CV fold's label
+# mean differs; baking it into the closure would recompile per fit).
+_STACK_CACHE: Dict[Tuple, callable] = {}
+_STACK_CACHE_MAX = 16
+
+
+def _stack_chunks_device(chunks: List[Tree], kept: int, bias) -> Tree:
+    """Concatenate per-chunk tree stacks, truncate to ``kept`` iterations,
+    and fold the boost_from_average bias into stored tree 0 — all in ONE
+    device program, output left device-resident (see Booster._host_trees).
+    ``bias``: (K,) float32 or None."""
+    key = (len(chunks), kept, bias is None)
+    fn = _STACK_CACHE.get(key)
+    if fn is None:
+
+        def stack(bias_a, *chs):
+            t = jax.tree_util.tree_map(
+                lambda *xs: jnp.concatenate(xs, axis=0)[:kept], *chs
+            )
+            if bias_a is not None:
+                lv = t.leaf_value  # (T, K, L)
+                active = (
+                    jnp.arange(lv.shape[-1])[None, :]
+                    < t.num_leaves[0][:, None]
+                )
+                lv0 = jnp.where(active, lv[0] + bias_a.reshape(-1, 1), 0.0)
+                t = t._replace(leaf_value=lv.at[0].set(lv0))
+            return t
+
+        fn = jax.jit(stack)
+        if len(_STACK_CACHE) >= _STACK_CACHE_MAX:
+            _STACK_CACHE.pop(next(iter(_STACK_CACHE)))
+        _STACK_CACHE[key] = fn
+    return fn(None if bias is None else jnp.asarray(bias), *chunks)
 
 
 def _dart_drop_schedule(rng, cfg: "TrainConfig") -> np.ndarray:
@@ -1511,14 +1583,24 @@ def train(
         # full RPC latency on remote-dispatch links (~120ms measured), so
         # iteration keys (c,2) + bag keys (c,2) + global iteration index
         # ride one (c,5) uint32 array, unpacked inside the scan body.
-        xs_packed = np.concatenate(
-            [
-                np.asarray(iter_keys, dtype=np.uint32),
-                np.asarray(bag_keys, dtype=np.uint32),
-                it_global[:, None].astype(np.uint32),
-            ],
-            axis=1,
+        xs_key = (
+            cfg.bagging_seed, cfg.seed, cfg.bagging_freq, do_bagging,
+            key_start, total_keyed, n_iter,
         )
+        xs_dev = _XS_CACHE.get(xs_key)
+        if xs_dev is None:
+            xs_packed = np.concatenate(
+                [
+                    np.asarray(iter_keys, dtype=np.uint32),
+                    np.asarray(bag_keys, dtype=np.uint32),
+                    it_global[:, None].astype(np.uint32),
+                ],
+                axis=1,
+            )
+            xs_dev = jnp.asarray(xs_packed)
+            if len(_XS_CACHE) >= _XS_CACHE_MAX:
+                _XS_CACHE.pop(next(iter(_XS_CACHE)))
+            _XS_CACHE[xs_key] = xs_dev
 
         # Like `iteration` above: device data enters as ARGUMENTS (valid
         # bins included, eval label/weight/mask/group aux included) so
@@ -1789,7 +1871,8 @@ def train(
             )
             carry, (trees_c, vsnap_c) = scan_chunk(
                 bins_dev, y_dev, w_dev, valid_mask, init_scores_dev, vbins_t,
-                vaux_t, carry, jnp.asarray(xs_packed[n_done : n_done + c]),
+                vaux_t, carry, jax.lax.slice(xs_dev, (n_done, 0), (n_done + c, 5))
+                if c < n_iter else xs_dev,
                 *dart_xs,
             )
             tree_chunks.append(trees_c)
@@ -1825,19 +1908,31 @@ def train(
             n_done += c
 
         kept = (stop_at + 1) if stop_at is not None else n_iter
-        # checkpointing already host-copied every chunk — reuse those
-        chunks_np = (
-            ckpt_host_chunks if ckpt_path is not None
-            else _fetch_tree_chunks(tree_chunks, bool(cfg.categorical_feature))
-        )  # one packed transfer otherwise
-        stacked = Tree(
-            *[np.concatenate(arrs, axis=0)[:kept] for arrs in zip(*chunks_np)]
-        )
+        if ckpt_path is None and init_model is None:
+            # The forest STAYS device-resident: one jitted concat/slice/
+            # bias-fold program instead of a packed fetch + 10 re-uploads
+            # (~3 RPC latencies per fit through remote-dispatch links).
+            # Host copies materialize lazily (Booster._host_trees) only
+            # for export/pickle paths.  Checkpoint and warm-start runs
+            # keep the host path (their concat logic is numpy).
+            stacked = _stack_chunks_device(
+                tree_chunks, kept,
+                np.asarray(init, np.float32).reshape(-1) if use_bfa else None,
+            )
+        else:
+            # checkpointing already host-copied every chunk — reuse those
+            chunks_np = (
+                ckpt_host_chunks if ckpt_path is not None
+                else _fetch_tree_chunks(tree_chunks, bool(cfg.categorical_feature))
+            )  # one packed transfer otherwise
+            stacked = Tree(
+                *[np.concatenate(arrs, axis=0)[:kept] for arrs in zip(*chunks_np)]
+            )
+            if use_bfa:
+                stacked = _fold_bias(stacked, init)
         if vsets:
             for nm in names:
                 evals_result[nm][metric_name] = evals_result[nm][metric_name][:kept]
-        if use_bfa:
-            stacked = _fold_bias(stacked, init)
         if dart_scan:
             # dart forbids early stopping (ValueError above), so
             # kept == n_iter and the final carry's weight vector IS the

@@ -190,7 +190,9 @@ def booster_to_string(booster, num_iteration=None) -> str:
     save→load round trip scores identically (the text format itself has no
     best_iteration field to carry the truncation point).
     """
-    trees = booster.trees
+    # ONE packed lazy fetch instead of 10 per-field device pulls (each
+    # np.asarray of a device array pays a full RPC latency on remote links)
+    trees = booster._host_trees()
     _, K = trees.split_leaf.shape[:2]
     T = booster._used_iters(num_iteration)
     bm = booster.bin_mapper

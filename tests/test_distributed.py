@@ -367,3 +367,29 @@ class TestRendezvous:
         ctx = barrier_context_from_env()
         assert ctx.coordinator_address == "10.0.0.1:12400"
         assert ctx.num_processes == 4 and ctx.process_id == 2
+
+
+class TestPsumWireDtype:
+    def test_bf16_wire_trains_close_to_f32(self):
+        # hist_psum_dtype="bfloat16" halves the histogram allreduce; the
+        # per-shard accumulation stays f32, so quality stays in the same
+        # class (scaling tool gates the exact tradeoff).
+        X, y = _make_binary(n=4096, F=8, seed=13)
+        params = dict(objective="binary", num_iterations=10, num_leaves=15,
+                      min_data_in_leaf=5, tree_learner="data")
+        bm = BinMapper(max_bin=63).fit(X)
+        f32 = train(dict(params), Dataset(X, y), bin_mapper=bm)
+        bf16 = train(dict(params, hist_psum_dtype="bfloat16"),
+                     Dataset(X, y), bin_mapper=bm)
+        assert abs(_auc(y, f32.predict(X)) - _auc(y, bf16.predict(X))) < 5e-3
+
+    def test_serial_ignores_wire_dtype(self):
+        # no axis_name → no psum → identical program output
+        X, y = _make_binary(n=1024, F=6, seed=14)
+        bm = BinMapper(max_bin=31).fit(X)
+        params = dict(objective="binary", num_iterations=4, num_leaves=7,
+                      min_data_in_leaf=5)
+        a = train(dict(params), Dataset(X, y), bin_mapper=bm)
+        b = train(dict(params, hist_psum_dtype="bfloat16"), Dataset(X, y),
+                  bin_mapper=bm)
+        np.testing.assert_allclose(a.predict(X), b.predict(X))

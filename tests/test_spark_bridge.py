@@ -228,6 +228,7 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
+@pytest.mark.slow
 def test_barrier_eval_early_stop_and_lambdarank(tmp_path):
     """VERDICT r3 #1: the scalable multi-host path runs the north-star
     shape — valid_sets + early stopping + lambdarank — as 2 REAL
@@ -260,6 +261,7 @@ def test_barrier_eval_early_stop_and_lambdarank(tmp_path):
     assert r0["rank_curve_close"], r0
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("nproc", [2, 4])
 def test_barrier_train_task_multi_process(tmp_path, nproc):
     port = _free_port()

@@ -160,6 +160,13 @@ def bench_resnet50(n_images=512, batch=64):
     return payload
 
 
+def _sync_booster(b):
+    """train() returns an async device-resident forest (r4); a tiny fetch
+    is the reliable completion sync through the tunnel."""
+    import numpy as _np
+
+    _np.asarray(b.trees.num_leaves)
+
 def bench_ranker():
     from mmlspark_tpu.engine.booster import Dataset, train
 
@@ -191,9 +198,11 @@ def bench_ranker():
     ds = Dataset(X, y, group=group)
     t0 = time.perf_counter()
     booster = train(params, ds)
+    _sync_booster(booster)
     cold = time.perf_counter() - t0
     t0 = time.perf_counter()
     booster = train(params, ds)
+    _sync_booster(booster)
     steady = time.perf_counter() - t0
     from mmlspark_tpu.engine.eval_metrics import get_metric
 
@@ -267,11 +276,13 @@ def bench_catmix():
     ds = Dataset(X, y)
     t0 = time.perf_counter()
     booster = train(params, ds)
+    _sync_booster(booster)
     cold = time.perf_counter() - t0
     steadies = []
     for _ in range(2):
         t0 = time.perf_counter()
         booster = train(params, ds)
+        _sync_booster(booster)
         steadies.append(time.perf_counter() - t0)
     steady = min(steadies)
     tpu_auc = _auc(y[:100_000], booster.predict(X[:100_000]))
@@ -358,9 +369,11 @@ def bench_adult():
     )
     t0 = time.perf_counter()
     model = est.fit(df)  # COLD facade fit (warm persistent compile cache)
+    _sync_booster(model.getBooster())
     cold = time.perf_counter() - t0
     t0 = time.perf_counter()
     model = est.fit(df)
+    _sync_booster(model.getBooster())
     steady = time.perf_counter() - t0
     tpu_auc = _auc(yte, model.getBooster().predict(Xte))
 
@@ -406,9 +419,10 @@ def bench_boston():
     params = dict(objective="regression", num_iterations=100, num_leaves=31,
                   min_data_in_leaf=5)
     ds = Dataset(X, yv)
-    train(params, ds)
+    _sync_booster(train(params, ds))  # warm-up must COMPLETE before timing
     t0 = time.perf_counter()
     booster = train(params, ds)
+    _sync_booster(booster)
     steady = time.perf_counter() - t0
     mse = float(np.mean((booster.predict(X) - yv) ** 2))
 
