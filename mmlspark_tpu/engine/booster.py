@@ -1222,6 +1222,15 @@ def train(
         max_cat_threshold=(
             cfg.max_cat_threshold if cfg.max_cat_threshold > 0 else cfg.max_bin
         ),
+        # cap the cat scan's value-bin axis at the max observed cat
+        # cardinality (bins past it are unused for every cat feature)
+        cat_value_bins=max(
+            (
+                len(getattr(bin_mapper, "cat_maps", {}).get(f, ()))
+                for f in cfg.categorical_feature
+            ),
+            default=0,
+        ),
         voting=voting,
         top_k=cfg.top_k,
         # classes grow sequentially (lax.map below), so the grower's

@@ -88,6 +88,11 @@ class GrowConfig:
     cat_smooth: float = 10.0
     cat_l2: float = 10.0
     max_cat_threshold: int = 32
+    # Static cap on the categorical scan's value-bin axis: the max used
+    # bins over the categorical features (from the BinMapper), 0 = B-1.
+    # Bins past every cat feature's cardinality are provably unused, so
+    # capping shrinks the sorts + prefix contraction with zero effect.
+    cat_value_bins: int = 0
     # Voting-parallel (SURVEY.md §2 parallelism table; LightGBM
     # tree_learner=voting): workers keep LOCAL histograms, vote their
     # top_k features per leaf by local gain, and only the globally
@@ -293,7 +298,13 @@ def _cat_candidates(cfg: GrowConfig, hists, leaf_stats, feat_mask):
     (max_cat_to_onehot) is subsumed by the k=0 prefix candidate.
     """
     _, L, F, B = hists.shape
+    # Value-bin axis capped at the max CATEGORICAL cardinality (static,
+    # from the BinMapper): bins past it are provably unused for every cat
+    # feature (count 0 → sorted last, never in a proper-subset prefix), so
+    # the sorts + rank-mask contraction shrink exactly (255 → ~card_max).
     VB = B - 1
+    if 0 < cfg.cat_value_bins < VB:
+        VB = cfg.cat_value_bins
     hist_vb = hists[:, :, :, :VB]  # (3, L, F, VB)
     # (feat_mask may be (F,) shared or (L, F) per-leaf — see numeric)
     l2 = cfg.lambda_l2 + cfg.cat_l2
@@ -401,6 +412,8 @@ def _cat_members(cfg: GrowConfig, hist_cb, k_len, descending):
     """
     B = hist_cb.shape[-1]
     VB = B - 1
+    if 0 < cfg.cat_value_bins < VB:
+        VB = cfg.cat_value_bins  # same static cap as _cat_candidates
     descending = jnp.asarray(descending)
     key, used = _cat_sort_key(cfg, hist_cb[..., :VB])
     order = jnp.argsort(key, axis=-1)
@@ -408,8 +421,8 @@ def _cat_members(cfg: GrowConfig, hist_cb, k_len, descending):
     nuse = used.sum(axis=-1, keepdims=True)
     rank_eff = jnp.where(descending[..., None], nuse - 1 - rank, rank)
     members = (rank_eff <= jnp.asarray(k_len)[..., None]) & used
-    pad = [(0, 0)] * (members.ndim - 1) + [(0, 1)]
-    return jnp.pad(members, pad)  # missing bin: False
+    pad = [(0, 0)] * (members.ndim - 1) + [(0, B - VB)]
+    return jnp.pad(members, pad)  # bins past the cap + missing: False
 
 
 def _member_lookup(members, col, B: int):
