@@ -81,6 +81,8 @@ def barrier_train_task(
     params: dict,
     timeout_s: int = 1200,
     valid_rows: Optional[np.ndarray] = None,
+    group_sizes: Optional[np.ndarray] = None,
+    valid_group_sizes: Optional[np.ndarray] = None,
 ) -> Optional[str]:
     """The per-task body for ``rdd.barrier().mapPartitions`` (SURVEY.md
     §3.1 ``TrainUtils.trainLightGBM`` translated): rendezvous, bin with a
@@ -106,6 +108,13 @@ def barrier_train_task(
     early stopping ride psum-able sufficient statistics inside the jitted
     scan (engine/dist_metrics).  SPMD contract: every task passes either a
     (possibly empty) array or None uniformly — mixing is undefined.
+
+    ``group_sizes``/``valid_group_sizes``: per-query group sizes for
+    lambdarank, PROCESS-ALIGNED — every query's rows live wholly inside
+    this task's partition (the reference's ``repartitionByGroupingColumn``
+    contract, SURVEY.md §2.3.1); sizes must sum to the respective row
+    counts.  Only group METADATA crosses processes (the global padded
+    index matrices — engine/dist_metrics.assemble_global_groups).
     """
     initialize_distributed(context, timeout_s=timeout_s)
     mesh = global_mesh()
@@ -131,11 +140,15 @@ def barrier_train_task(
     if valid_rows is not None:
         valid_rows = np.ascontiguousarray(valid_rows)
         valid_sets = [
-            Dataset(valid_rows[:, :-1], np.ascontiguousarray(valid_rows[:, -1]))
+            Dataset(
+                valid_rows[:, :-1],
+                np.ascontiguousarray(valid_rows[:, -1]),
+                group=valid_group_sizes,
+            )
         ]
     booster = train(
-        params, Dataset(X_local, y_local), valid_sets=valid_sets,
-        bin_mapper=bm, mesh=mesh, process_local=True,
+        params, Dataset(X_local, y_local, group=group_sizes),
+        valid_sets=valid_sets, bin_mapper=bm, mesh=mesh, process_local=True,
     )
     if context.process_id == 0:
         return booster.save_model_string()

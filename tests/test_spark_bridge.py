@@ -174,6 +174,17 @@ _WORKER_EVAL = textwrap.dedent(
     )
     rank_curve = rank_booster.evals_result["valid_0"]["ndcg@5"]
 
+    # the SPARK BODY carries the groups too (repartitionByGroupingColumn
+    # moral): same data through barrier_train_task's group plumbing
+    rk_str = barrier_train_task(
+        np.column_stack([Xr, yr]), ctx, dict(RPARAMS), timeout_s=60,
+        group_sizes=grp,
+        valid_rows=np.column_stack([Xr, yr]), valid_group_sizes=grp,
+    )
+    out["rank_bridge_ok"] = bool(
+        pid != 0 or (rk_str or "").startswith("tree")
+    )
+
     if pid == 0:
         # Oracle: single-process training on the MERGED rows (meshless
         # serial learner, host metrics) — stopped iteration must match.
@@ -259,6 +270,7 @@ def test_barrier_eval_early_stop_and_lambdarank(tmp_path):
     assert r0["preds_close"], r0
     assert r0["rank_preds_match"], r0
     assert r0["rank_curve_close"], r0
+    assert r0["rank_bridge_ok"], r0
 
 
 @pytest.mark.slow
