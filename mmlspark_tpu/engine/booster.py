@@ -21,7 +21,7 @@ from __future__ import annotations
 import dataclasses
 import math
 from functools import partial
-from typing import Dict, List, Optional, Sequence, Tuple
+from typing import Dict, List, Optional, Sequence, Tuple, Union
 
 import jax
 import jax.numpy as jnp
@@ -80,7 +80,9 @@ class TrainConfig:
     poisson_max_delta_step: float = 0.7
     tweedie_variance_power: float = 1.5
     early_stopping_round: int = 0
-    metric: Optional[str] = None
+    # One metric name, a LightGBM comma-separated list ("auc,binary_logloss"),
+    # or a Python list; None = the objective's default metric.
+    metric: Optional[Union[str, Sequence[str]]] = None
     # Record the metric on TRAINING data each iteration under
     # evals_result["training"] (the reference's isProvideTrainingMetric --
     # SURVEY.md 2.3.1/5.5; unlike the reference, the values surface on
@@ -1436,9 +1438,11 @@ def train(
         vsets.append({"bins": vb, "scores": jnp.asarray(vscore), "data": vs})
 
     if cfg.is_provide_training_metric:
-        # The training set joins the eval loop as a LAST pseudo-valid (so
-        # early stopping, which watches names[0], never keys on it).  Its
-        # scores snapshot reuses the sharded padded bins already on device.
+        # The training set joins the eval loop as a LAST pseudo-valid;
+        # early stopping excludes it via the explicit is_train_pseudo
+        # check in _es_update (the ANY-pair rule watches every real
+        # (valid set, metric) pair).  Its scores snapshot reuses the
+        # sharded padded bins already on device.
         names.append("training")
         vsets.append({
             "bins": bins_dev, "scores": scores, "data": train_set,
@@ -1453,14 +1457,36 @@ def train(
     )
 
     # ---- metrics / early stopping --------------------------------------
-    metric_name = cfg.metric or obj.default_metric
-    metric_fn, higher_better, needs_groups = eval_metrics.get_metric(
-        metric_name, alpha=cfg.alpha
-    )
+    # LightGBM accepts a COMMA-SEPARATED metric list ("auc,binary_logloss")
+    # or a Python list; every metric is recorded per eval set.  Early
+    # stopping follows LightGBM's documented rule — training stops when
+    # ANY (validation set, metric) pair fails to improve for
+    # early_stopping_round iterations (the training pseudo-valid never
+    # participates); ``best_iteration`` reports the FIRST metric on the
+    # FIRST valid set, matching the single-metric surface.
+    raw_metric = cfg.metric or obj.default_metric
+    if isinstance(raw_metric, str):
+        metric_names = [m.strip() for m in raw_metric.split(",") if m.strip()]
+    else:
+        metric_names = [str(m) for m in raw_metric]
+    if not metric_names:
+        metric_names = [obj.default_metric]
+    # dedupe, order-preserving (LightGBM dedups metric lists; a repeated
+    # name would double-append into one evals_result curve)
+    metric_names = list(dict.fromkeys(metric_names))
+    metric_name = metric_names[0]
+    metric_infos = [
+        eval_metrics.get_metric(m, alpha=cfg.alpha) for m in metric_names
+    ]
+    needs_groups = any(mi[2] for mi in metric_infos)
+    higher_better = metric_infos[0][1]
     best_score, best_iter = (-np.inf if higher_better else np.inf), -1
+    # (vset index, metric index) → (best value, best iteration)
+    es_state: Dict[Tuple[int, int], Tuple[float, int]] = {}
 
     if device_eval and vsets:
-        # Attach the device evaluator + its aux arrays to every eval set.
+        # Attach the device evaluators (one per metric) + aux arrays to
+        # every eval set; shared group matrices upload once.
         from jax.sharding import PartitionSpec as P
 
         from mmlspark_tpu.engine.dist_metrics import (
@@ -1468,6 +1494,13 @@ def train(
             get_device_metric,
         )
         from mmlspark_tpu.parallel.distributed import make_global_array
+
+        _uploaded: Dict[int, object] = {}
+
+        def _up(a):
+            if id(a) not in _uploaded:
+                _uploaded[id(a)] = make_global_array(mesh, P(), a)
+            return _uploaded[id(a)]
 
         for vi, vs in enumerate(vsets):
             gi = gv = None
@@ -1481,35 +1514,55 @@ def train(
                     dset = vs["data"]
                     if dset.group is None:
                         raise ValueError(
-                            f"metric {metric_name!r} needs group sizes on "
+                            f"metric {metric_names!r} needs group sizes on "
                             f"eval set {names[vi]!r}"
                         )
                     gi, gv = assemble_global_groups(
                         dset.group, vs["row_offset"]
                     )
-            ev = get_device_metric(
-                metric_name, alpha=cfg.alpha, group_idx=gi, group_valid=gv
-            )
-            vs["evaluator"] = ev
+            evs = [
+                get_device_metric(
+                    m, alpha=cfg.alpha, group_idx=gi, group_valid=gv
+                )
+                for m in metric_names
+            ]
+            vs["evaluators"] = evs
             vs["aux"] = vs["eval_arrays"] + (
                 tuple(
-                    make_global_array(mesh, P(), a) for a in ev.aux_host()
+                    tuple(_up(a) for a in ev.aux_host()) for ev in evs
                 ),
             )
 
-    def eval_metric(scores_arr, dset: Dataset):
+    def eval_metric(mi: int, scores_arr, dset: Dataset):
+        fn, _, ng = metric_infos[mi]
         s = np.asarray(scores_arr)
         s_eval = s if K > 1 else s[0]
         kw = {}
-        if needs_groups:
+        if ng:
             kw["group_sizes"] = dset.group
-        return metric_fn(dset.label, s_eval[..., : dset.num_rows] if K > 1 else s_eval[: dset.num_rows], w=dset.weight, **kw)
+        return fn(dset.label, s_eval[..., : dset.num_rows] if K > 1 else s_eval[: dset.num_rows], w=dset.weight, **kw)
+
+    def _es_update(vs_i: int, mi: int, m: float, it: int, is_train_pseudo: bool):
+        """ANY-pair stall rule; returns True when this pair stalls."""
+        nonlocal best_score, best_iter
+        if cfg.early_stopping_round <= 0 or is_train_pseudo:
+            return False
+        hb = metric_infos[mi][1]
+        bs, bi = es_state.get((vs_i, mi), (-np.inf if hb else np.inf, -1))
+        if (m > bs) if hb else (m < bs):
+            es_state[(vs_i, mi)] = (m, it)
+            if vs_i == 0 and mi == 0:
+                best_score, best_iter = m, it
+            return False
+        return it - bi >= cfg.early_stopping_round
 
     # ---- DART / RF state ----------------------------------------------
     trees_host: List[Tree] = []
     tree_weights: List[float] = []
     rng = np.random.default_rng(cfg.drop_seed)
-    evals_result: Dict[str, Dict[str, List[float]]] = {nm: {metric_name: []} for nm in names}
+    evals_result: Dict[str, Dict[str, List[float]]] = {
+        nm: {m: [] for m in metric_names} for nm in names
+    }
     # All per-iteration keys in one device call, pulled to host once: a
     # jax.random.split per iteration is a dispatch round-trip each (adds up
     # fast over remote-dispatch links).
@@ -1586,7 +1639,7 @@ def train(
         vaux_t = (
             tuple(vs["aux"] for vs in vsets) if device_eval and vsets else ()
         )
-        evaluators = [vs.get("evaluator") for vs in vsets]
+        evaluators = [vs.get("evaluators") for vs in vsets]
         it_global = np.arange(key_start, total_keyed, dtype=np.int32)
         # ONE packed xs upload per chunk: each host→device transfer pays a
         # full RPC latency on remote-dispatch links (~120ms measured), so
@@ -1723,15 +1776,20 @@ def train(
                         # score snapshot — the §5.8 Network-reduced eval.
                         stats_out = []
                         for vi2, vsc in enumerate(vscores_c):
-                            ay, aw, am, aextra = vaux_a[vi2]
+                            ay, aw, am, aextras = vaux_a[vi2]
                             sc = (
                                 vsc / (it_g.astype(jnp.float32) + 1.0)
                                 if cfg.boosting == "rf" else vsc
                             )
-                            st = evaluators[vi2].stats(sc, ay, aw, am, *aextra)
-                            if _rep is not None:
-                                st = jax.lax.with_sharding_constraint(st, _rep)
-                            stats_out.append(st)
+                            per_metric = []
+                            for mi2, ev in enumerate(evaluators[vi2]):
+                                st = ev.stats(sc, ay, aw, am, *aextras[mi2])
+                                if _rep is not None:
+                                    st = jax.lax.with_sharding_constraint(
+                                        st, _rep
+                                    )
+                                per_metric.append(st)
+                            stats_out.append(tuple(per_metric))
                         ys_v = tuple(stats_out)
                     else:
                         ys_v = vscores_c
@@ -1892,24 +1950,26 @@ def train(
                 # — per-array np.asarray pulls pay a full dispatch RTT each.
                 # Device-eval: each snap is (c, S) replicated stats, so the
                 # transfer is O(iters × stats), independent of valid size.
-                snaps = jax.device_get(list(vsnap_c))  # each (c, K, nv)|(c, S)
+                # each snap: (c, K, nv) host snapshot | per-metric (c, S)
+                snaps = jax.device_get(list(vsnap_c))
                 for j in range(c):
                     it = n_done + j
                     stop = False
-                    for nm, vs, sn in zip(names, vsets, snaps):
-                        if device_eval:
-                            m = vs["evaluator"].finalize(sn[j])
-                        else:
-                            div = (it + 1) if cfg.boosting == "rf" else 1
-                            m = eval_metric(sn[j] / div, vs["data"])
-                        evals_result[nm][metric_name].append(m)
-                        if cfg.early_stopping_round > 0 and nm == names[0]:
-                            improved = (
-                                m > best_score if higher_better else m < best_score
-                            )
-                            if improved:
-                                best_score, best_iter = m, it
-                            elif it - best_iter >= cfg.early_stopping_round:
+                    for vs_i, (nm, vs, sn) in enumerate(
+                        zip(names, vsets, snaps)
+                    ):
+                        is_tp = (
+                            cfg.is_provide_training_metric
+                            and vs_i == len(vsets) - 1
+                        )
+                        for mi, mname in enumerate(metric_names):
+                            if device_eval:
+                                m = vs["evaluators"][mi].finalize(sn[mi][j])
+                            else:
+                                div = (it + 1) if cfg.boosting == "rf" else 1
+                                m = eval_metric(mi, sn[j] / div, vs["data"])
+                            evals_result[nm][mname].append(m)
+                            if _es_update(vs_i, mi, m, it, is_tp):
                                 stop = True
                     if stop:
                         stop_at = it
@@ -1941,7 +2001,8 @@ def train(
                 stacked = _fold_bias(stacked, init)
         if vsets:
             for nm in names:
-                evals_result[nm][metric_name] = evals_result[nm][metric_name][:kept]
+                for mname in metric_names:
+                    evals_result[nm][mname] = evals_result[nm][mname][:kept]
         if dart_scan:
             # dart forbids early stopping (ValueError above), so
             # kept == n_iter and the final carry's weight vector IS the
@@ -1973,17 +2034,23 @@ def train(
 
         _rep_leg = NamedSharding(mesh, _PS())
 
-        def _make_stats_fn(ev):
+        def _make_stats_fn(evs):
+            # ONE jitted dispatch returns every metric's stats tuple (a
+            # per-metric fn would multiply the per-iteration RPC count by
+            # the metric count on remote-dispatch links)
             @jax.jit
             def f(s, aux):
-                ay, aw, am, aextra = aux
-                return jax.lax.with_sharding_constraint(
-                    ev.stats(s, ay, aw, am, *aextra), _rep_leg
+                ay, aw, am, aextras = aux
+                return tuple(
+                    jax.lax.with_sharding_constraint(
+                        ev.stats(s, ay, aw, am, *aextras[mi]), _rep_leg
+                    )
+                    for mi, ev in enumerate(evs)
                 )
 
             return f
 
-        _legacy_stats = [_make_stats_fn(vs["evaluator"]) for vs in vsets]
+        _legacy_stats = [_make_stats_fn(vs["evaluators"]) for vs in vsets]
     for it in range(cfg.num_iterations):
         sub = all_keys[it]
         if do_bagging and it % cfg.bagging_freq == 0:
@@ -2061,18 +2128,21 @@ def train(
                     ) * vp
             vs["scores"] = vs["scores"] + w_new * vdelta
             div = (it + 1) if cfg.boosting == "rf" else 1
+            is_tp = (
+                cfg.is_provide_training_metric and vi_l == len(vsets) - 1
+            )
             if device_eval:
-                m = vs["evaluator"].finalize(
-                    np.asarray(_legacy_stats[vi_l](vs["scores"] / div, vs["aux"]))
+                # one dispatch + one batched pull for ALL metrics
+                sts = jax.device_get(
+                    _legacy_stats[vi_l](vs["scores"] / div, vs["aux"])
                 )
-            else:
-                m = eval_metric(vs["scores"] / div, vs["data"])
-            evals_result[nm][metric_name].append(m)
-            if cfg.early_stopping_round > 0 and nm == names[0]:
-                improved = m > best_score if higher_better else m < best_score
-                if improved:
-                    best_score, best_iter = m, it
-                elif it - best_iter >= cfg.early_stopping_round:
+            for mi, mname in enumerate(metric_names):
+                if device_eval:
+                    m = vs["evaluators"][mi].finalize(sts[mi])
+                else:
+                    m = eval_metric(mi, vs["scores"] / div, vs["data"])
+                evals_result[nm][mname].append(m)
+                if _es_update(vi_l, mi, m, it, is_tp):
                     stop = True
         if stop:
             break

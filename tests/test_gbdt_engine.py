@@ -8,6 +8,7 @@ HistGradientBoosting as the offline stand-in oracle for stock LightGBM
 import numpy as np
 import pytest
 
+from mmlspark_tpu.engine.booster import Dataset, train
 from mmlspark_tpu.ops.binning import BinMapper, merge_samples_and_fit
 from mmlspark_tpu.ops.objectives import get_objective
 
@@ -538,3 +539,77 @@ class TestAutoBackendResolution:
                   Dataset(X, y))
         assert b.config.hist_backend == "onehot"
         assert b.config.hist_chunk == 256
+
+
+class TestMultiMetric:
+    """LightGBM comma-separated metric lists (r4): every metric recorded
+    per eval set; early stopping = ANY (valid set, metric) pair stalls."""
+
+    def _data(self, seed=21):
+        rng = np.random.default_rng(seed)
+        X = rng.normal(size=(1500, 6))
+        y = (X[:, 0] - 0.6 * X[:, 1]
+             + rng.normal(scale=0.5, size=1500) > 0).astype(np.float64)
+        return X[:1100], y[:1100], X[1100:], y[1100:]
+
+    def test_comma_separated_metrics_recorded(self):
+        X, y, Xv, yv = self._data()
+        b = train(dict(objective="binary", num_iterations=6, num_leaves=7,
+                       min_data_in_leaf=5, metric="auc,binary_logloss"),
+                  Dataset(X, y), valid_sets=[Dataset(Xv, yv)])
+        res = b.evals_result["valid_0"]
+        assert set(res) == {"auc", "binary_logloss"}
+        assert len(res["auc"]) == len(res["binary_logloss"]) == 6
+        # each curve matches a single-metric run exactly (same trees)
+        b_auc = train(dict(objective="binary", num_iterations=6, num_leaves=7,
+                           min_data_in_leaf=5, metric="auc"),
+                      Dataset(X, y), valid_sets=[Dataset(Xv, yv)])
+        np.testing.assert_allclose(
+            res["auc"], b_auc.evals_result["valid_0"]["auc"])
+
+    def test_metric_list_param(self):
+        X, y, Xv, yv = self._data()
+        b = train(dict(objective="binary", num_iterations=4, num_leaves=7,
+                       min_data_in_leaf=5, metric=["binary_error", "auc"]),
+                  Dataset(X, y), valid_sets=[Dataset(Xv, yv)])
+        assert set(b.evals_result["valid_0"]) == {"binary_error", "auc"}
+
+    def test_any_pair_early_stopping(self):
+        # The second valid set is pure noise: its metric stalls early and
+        # must trigger the stop even though valid_0 keeps improving —
+        # LightGBM's "one metric of one validation data" rule.
+        X, y, Xv, yv = self._data()
+        rng = np.random.default_rng(99)
+        Xn = rng.normal(size=(400, 6))
+        yn = rng.integers(0, 2, 400).astype(np.float64)
+        b = train(dict(objective="binary", num_iterations=60, num_leaves=15,
+                       min_data_in_leaf=5, metric="binary_logloss",
+                       early_stopping_round=5, learning_rate=0.3),
+                  Dataset(X, y),
+                  valid_sets=[Dataset(Xv, yv), Dataset(Xn, yn)],
+                  valid_names=["good", "noise"])
+        b_single = train(dict(objective="binary", num_iterations=60,
+                              num_leaves=15, min_data_in_leaf=5,
+                              metric="binary_logloss",
+                              early_stopping_round=5, learning_rate=0.3),
+                         Dataset(X, y), valid_sets=[Dataset(Xv, yv)],
+                         valid_names=["good"])
+        # the noise fold stalls almost immediately (random labels), so the
+        # ANY-pair rule must stop STRICTLY earlier than watching only the
+        # good fold would — equality here would mean the noise set was
+        # ignored (the pre-r4 names[0]-only behavior)
+        assert b.num_iterations < b_single.num_iterations, (
+            b.num_iterations, b_single.num_iterations)
+        assert b.num_iterations < 20
+
+    def test_training_pseudo_valid_never_stops(self):
+        # is_provide_training_metric joins the eval loop but must not
+        # participate in the ANY-pair stopping rule
+        X, y, Xv, yv = self._data()
+        b = train(dict(objective="binary", num_iterations=12, num_leaves=7,
+                       min_data_in_leaf=5, metric="auc",
+                       early_stopping_round=3,
+                       is_provide_training_metric=True),
+                  Dataset(X, y), valid_sets=[Dataset(Xv, yv)])
+        assert "training" in b.evals_result
+        assert len(b.evals_result["training"]["auc"]) == b.num_iterations
