@@ -1,0 +1,163 @@
+"""AOT trace cache: persist EXPORTED training programs across processes.
+
+The persistent compile cache (core/jit_cache) eliminates XLA compilation
+on warm starts, but a fresh process still pays Python TRACING of the
+whole-run scan program — measured ~15 s of the ~21 s warm-cache cold fit
+at the bench shape (BASELINE.md r4 decomposition), against a reference
+with zero compile/trace cost (SURVEY.md §3.1).  ``jax.export`` captures
+the traced+lowered StableHLO; serializing it per (program config, arg
+signature, source hash) lets every LATER process skip tracing entirely:
+deserialize → call, with XLA compilation still served by the compile
+cache.
+
+Safety model — a stale trace is a CORRECTNESS bug, so the cache key
+includes:
+- the full training-config fingerprint + objective state (the caller's
+  ``key_material``),
+- the shapes/dtypes of every argument (chunk sizes, row counts, ...),
+- a SHA-256 over the source bytes of every module the program traces
+  through (``mmlspark_tpu/{engine,ops,parallel}``), so ANY code edit
+  invalidates,
+- the jax version and backend platform.
+
+Scope: the single-device (meshless) training path — sharded programs
+carry device topology in their lowering and stay on the normal jit path.
+Opt out with ``MMLSPARK_TPU_NO_TRACE_CACHE=1``.  Any failure (old jax,
+unserializable graph, corrupt blob) silently falls back to the jitted
+callable.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+from typing import Callable, Optional
+
+import numpy as np
+
+_SRC_HASH: Optional[str] = None
+_REGISTERED = False
+# In-process memo of deserialized/exported programs: repeated train()
+# calls build fresh wrappers, and re-deserializing the scan blob per fit
+# would tax steady-state runs.
+_EXP_MEMO: dict = {}
+_EXP_MEMO_MAX = 8
+
+
+def _source_hash() -> str:
+    global _SRC_HASH
+    if _SRC_HASH is None:
+        import mmlspark_tpu
+
+        root = os.path.dirname(os.path.abspath(mmlspark_tpu.__file__))
+        h = hashlib.sha256()
+        for sub in ("engine", "ops", "parallel"):
+            d = os.path.join(root, sub)
+            if not os.path.isdir(d):
+                continue
+            for fn in sorted(os.listdir(d)):
+                if fn.endswith(".py"):
+                    h.update(fn.encode())
+                    with open(os.path.join(d, fn), "rb") as f:
+                        h.update(f.read())
+        _SRC_HASH = h.hexdigest()
+    return _SRC_HASH
+
+
+def cache_dir() -> str:
+    override = os.environ.get("MMLSPARK_TPU_TRACE_CACHE_DIR")
+    if override:
+        return override
+    base = os.environ.get("XDG_CACHE_HOME") or os.path.join(
+        os.path.expanduser("~"), ".cache"
+    )
+    return os.path.join(base, "mmlspark_tpu", "traces")
+
+
+def enabled() -> bool:
+    return not os.environ.get("MMLSPARK_TPU_NO_TRACE_CACHE")
+
+
+def _register_trees():
+    global _REGISTERED
+    if _REGISTERED:
+        return
+    try:
+        from jax import export as jexport
+
+        from mmlspark_tpu.engine.tree import Tree
+
+        jexport.register_namedtuple_serialization(
+            Tree, serialized_name="mmlspark_tpu.engine.tree.Tree"
+        )
+    except Exception:
+        pass
+    _REGISTERED = True
+
+
+def _arg_signature(args) -> str:
+    import jax
+
+    leaves, treedef = jax.tree_util.tree_flatten(args)
+    parts = [str(treedef)]
+    for a in leaves:
+        parts.append(f"{tuple(np.shape(a))}:{np.result_type(a)}")
+    return "|".join(parts)
+
+
+def wrap_aot(jitted: Callable, key_material: str) -> Callable:
+    """Wrap a jitted function so its traced program persists across
+    processes.  First call per argument signature: load the exported
+    blob if present (NO tracing), else export once (one trace — the same
+    price the plain jit path pays) and save for future processes."""
+    import jax
+
+    state: dict = {}
+
+    def call(*args):
+        if state.get("off"):
+            return jitted(*args)
+        sig = _arg_signature(args)
+        exp = state.get(sig)
+        if exp is not None:
+            return exp.call(*args)
+        try:
+            from jax import export as jexport
+
+            _register_trees()
+            digest = hashlib.sha256(
+                "\x1e".join(
+                    [
+                        key_material,
+                        sig,
+                        _source_hash(),
+                        jax.__version__,
+                        jax.default_backend(),
+                    ]
+                ).encode()
+            ).hexdigest()
+            exp = _EXP_MEMO.get(digest)
+            if exp is None:
+                path = os.path.join(cache_dir(), digest + ".jaxexp")
+                if os.path.exists(path):
+                    with open(path, "rb") as f:
+                        exp = jexport.deserialize(bytearray(f.read()))
+                else:
+                    exp = jexport.export(jitted)(*args)
+                    os.makedirs(cache_dir(), exist_ok=True)
+                    tmp = path + f".tmp{os.getpid()}"
+                    with open(tmp, "wb") as f:
+                        f.write(exp.serialize())
+                    os.replace(tmp, path)
+                if len(_EXP_MEMO) >= _EXP_MEMO_MAX:
+                    _EXP_MEMO.pop(next(iter(_EXP_MEMO)))
+                _EXP_MEMO[digest] = exp
+            out = exp.call(*args)
+            state[sig] = exp
+            return out
+        except Exception:
+            # old jax / unserializable graph / corrupt blob → plain jit
+            state["off"] = True
+            return jitted(*args)
+
+    return call

@@ -656,6 +656,11 @@ _XS_CACHE_MAX = 8
 # per-iteration loop (tests monkeypatch this to force the legacy path).
 _DART_SCAN_MAX_ELS = 128_000_000
 
+# The AOT trace cache engages only for programs big enough that tracing
+# hurts (rows × iterations): exporting costs one extra serialize per
+# first-ever program, which would tax small fits/test suites for no win.
+_TRACE_CACHE_MIN_WORK = 1 << 21
+
 
 # Jitted device-side chunk stackers, cached by (chunk count, kept,
 # has-bias) — a fresh jax.jit per train() call would retrace every fit,
@@ -1829,6 +1834,29 @@ def train(
                 if len(_SCAN_CACHE) >= _SCAN_CACHE_MAX:
                     _SCAN_CACHE.pop(next(iter(_SCAN_CACHE)))
                 _SCAN_CACHE[cache_key] = scan_chunk
+
+        if (
+            mesh is None and not (device_eval and vsets)
+            and n * n_iter >= _TRACE_CACHE_MIN_WORK
+        ):
+            # AOT trace cache (core/trace_cache): later processes skip the
+            # ~15s Python trace of this program entirely — deserialize the
+            # exported StableHLO and call (the compile cache still serves
+            # XLA).  Single-device path only; key covers config, objective
+            # state, arg shapes, source hash, jax version, platform.
+            from mmlspark_tpu.core.trace_cache import enabled as _tc_on
+            from mmlspark_tpu.core.trace_cache import wrap_aot
+
+            if _tc_on():
+                scan_chunk = wrap_aot(
+                    scan_chunk,
+                    key_material=repr((
+                        _cfg_cache_key(cfg), K, F, B,
+                        type(obj).__name__, state_key, dart_scan,
+                        len(vsets), cfg.is_provide_training_metric,
+                        tuple(metric_names) if device_eval else None,
+                    )),
+                )
 
         if cfg.early_stopping_round > 0 and vsets:
             chunk_iters = min(n_iter, max(cfg.early_stopping_round, 1))

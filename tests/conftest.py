@@ -23,6 +23,12 @@ os.environ["XLA_FLAGS"] = (
     + " --xla_cpu_collective_call_terminate_timeout_seconds=1200"
 ).strip()
 
+# The AOT trace cache (core/trace_cache) pays an export per first-ever
+# program — pure overhead across hundreds of small test configs, and it
+# would write into the user cache dir.  The feature has its own dedicated
+# test (tests/test_trace_cache.py), which re-enables it explicitly.
+os.environ.setdefault("MMLSPARK_TPU_NO_TRACE_CACHE", "1")
+
 # The session interpreter imports jax at startup (a sitecustomize registers
 # the tunneled real-TPU "axon" PJRT platform and env presets
 # JAX_PLATFORMS=axon), so env-var changes here are too late — jax captured

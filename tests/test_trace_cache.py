@@ -1,0 +1,115 @@
+"""AOT trace cache (core/trace_cache): cross-process trace skipping.
+
+A fresh process on a warm cache must produce a bit-identical model by
+DESERIALIZING the exported program instead of re-tracing; the key must
+invalidate on config and source changes.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import numpy as np
+import pytest
+
+import mmlspark_tpu.engine.booster as bo
+from mmlspark_tpu.core import trace_cache as tc
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _train_once(monkeypatch, tmp_path, cache_dir):
+    monkeypatch.delenv("MMLSPARK_TPU_NO_TRACE_CACHE", raising=False)
+    monkeypatch.setenv("MMLSPARK_TPU_TRACE_CACHE_DIR", str(cache_dir))
+    monkeypatch.setattr(bo, "_TRACE_CACHE_MIN_WORK", 0)
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(512, 4))
+    y = (X[:, 0] > 0).astype(np.float64)
+    b = bo.train(dict(objective="binary", num_iterations=4, num_leaves=7,
+                      min_data_in_leaf=2, max_bin=31),
+                 bo.Dataset(X, y))
+    return b.predict(X)
+
+
+def test_export_written_and_replayed(monkeypatch, tmp_path):
+    cache = tmp_path / "traces"
+    p1 = _train_once(monkeypatch, tmp_path, cache)
+    blobs = list(cache.glob("*.jaxexp"))
+    assert blobs, "no exported program written"
+    # memo cleared → the next fit must REPLAY the blob (mtime untouched)
+    tc._EXP_MEMO.clear()
+    before = {b: b.stat().st_mtime_ns for b in blobs}
+    p2 = _train_once(monkeypatch, tmp_path, cache)
+    np.testing.assert_array_equal(p1, p2)
+    after = {b: b.stat().st_mtime_ns for b in cache.glob("*.jaxexp")}
+    assert before == after  # replayed, not re-exported
+
+
+def test_key_invalidates_on_config_change(monkeypatch, tmp_path):
+    cache = tmp_path / "traces"
+    _train_once(monkeypatch, tmp_path, cache)
+    n1 = len(list(cache.glob("*.jaxexp")))
+    # different num_leaves → different program → new blob
+    monkeypatch.setattr(bo, "_TRACE_CACHE_MIN_WORK", 0)
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(512, 4))
+    y = (X[:, 0] > 0).astype(np.float64)
+    bo.train(dict(objective="binary", num_iterations=4, num_leaves=15,
+                  min_data_in_leaf=2, max_bin=31), bo.Dataset(X, y))
+    assert len(list(cache.glob("*.jaxexp"))) > n1
+
+
+def test_source_hash_covers_engine(monkeypatch):
+    h1 = tc._source_hash()
+    assert isinstance(h1, str) and len(h1) == 64
+    # deterministic within a process
+    assert tc._source_hash() == h1
+
+
+def test_fresh_process_replays_without_retracing(tmp_path):
+    """The actual contract: process 2 loads process 1's blob and trains
+    bit-identically (subprocess so nothing is memoized)."""
+    cache = tmp_path / "traces"
+    script = tmp_path / "w.py"
+    script.write_text(textwrap.dedent(f"""
+        import json, sys
+        sys.path.insert(0, {REPO!r})
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+        import numpy as np
+        import mmlspark_tpu.engine.booster as bo
+        bo._TRACE_CACHE_MIN_WORK = 0
+        rng = np.random.default_rng(0)
+        X = rng.normal(size=(512, 4))
+        y = (X[:, 0] > 0).astype(np.float64)
+        b = bo.train(dict(objective="binary", num_iterations=4,
+                          num_leaves=7, min_data_in_leaf=2, max_bin=31),
+                     bo.Dataset(X, y))
+        print(json.dumps({{"p": b.predict(X)[:8].tolist()}}))
+    """))
+    env = {"PATH": "/usr/bin:/bin:/usr/local/bin", "HOME": "/root",
+           "JAX_PLATFORMS": "cpu", "PYTHONDONTWRITEBYTECODE": "1",
+           "MMLSPARK_TPU_TRACE_CACHE_DIR": str(cache),
+           "MMLSPARK_TPU_NO_COMPILE_CACHE": "1"}
+    outs = []
+    for _ in range(2):
+        r = subprocess.run([sys.executable, str(script)], env=env,
+                           capture_output=True, text=True, timeout=240)
+        assert r.returncode == 0, r.stderr[-2000:]
+        outs.append(json.loads(r.stdout.strip().splitlines()[-1])["p"])
+    assert list(cache.glob("*.jaxexp"))
+    np.testing.assert_array_equal(outs[0], outs[1])
+
+
+def test_opt_out(monkeypatch, tmp_path):
+    monkeypatch.setenv("MMLSPARK_TPU_NO_TRACE_CACHE", "1")
+    monkeypatch.setenv("MMLSPARK_TPU_TRACE_CACHE_DIR", str(tmp_path / "t2"))
+    monkeypatch.setattr(bo, "_TRACE_CACHE_MIN_WORK", 0)
+    rng = np.random.default_rng(1)
+    X = rng.normal(size=(256, 3))
+    y = (X[:, 0] > 0).astype(np.float64)
+    bo.train(dict(objective="binary", num_iterations=2, num_leaves=4,
+                  min_data_in_leaf=2, max_bin=15), bo.Dataset(X, y))
+    assert not (tmp_path / "t2").exists()
