@@ -83,6 +83,10 @@ class TrainConfig:
     # One metric name, a LightGBM comma-separated list ("auc,binary_logloss"),
     # or a Python list; None = the objective's default metric.
     metric: Optional[Union[str, Sequence[str]]] = None
+    # LightGBM first_metric_only: early stopping watches only the FIRST
+    # metric (still across every validation set); False = the default
+    # ANY-(set, metric)-pair rule.
+    first_metric_only: bool = False
     # Record the metric on TRAINING data each iteration under
     # evals_result["training"] (the reference's isProvideTrainingMetric --
     # SURVEY.md 2.3.1/5.5; unlike the reference, the values surface on
@@ -1551,6 +1555,8 @@ def train(
         """ANY-pair stall rule; returns True when this pair stalls."""
         nonlocal best_score, best_iter
         if cfg.early_stopping_round <= 0 or is_train_pseudo:
+            return False
+        if cfg.first_metric_only and mi > 0:
             return False
         hb = metric_infos[mi][1]
         bs, bi = es_state.get((vs_i, mi), (-np.inf if hb else np.inf, -1))

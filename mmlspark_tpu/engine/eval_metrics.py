@@ -149,6 +149,8 @@ def get_metric(name: str, **params):
     name = name.lower()
     if name == "quantile" and "alpha" in params:
         return quantile_loss(float(params["alpha"])), False, False
+    if name.startswith("ndcg@"):  # any position (the facade's evalAt)
+        return ndcg_at(int(name.split("@", 1)[1])), True, True
     if name not in _METRICS:
         raise ValueError(f"unknown metric {name!r}; known: {sorted(_METRICS)}")
     return _METRICS[name]

@@ -576,6 +576,12 @@ class LightGBMRanker(_LightGBMEstimator):
         if self.getLabelGain():
             p["label_gain"] = [float(v) for v in self.getLabelGain()]
         p["max_position"] = self.getMaxPosition()
+        if not self.getMetric() and self.getEvalAt():
+            # the reference's evalAt: record NDCG at each position per
+            # iteration (rides the engine's multi-metric lists)
+            p["metric"] = ",".join(
+                f"ndcg@{int(k)}" for k in self.getEvalAt()
+            )
         return p
 
     def _model_class(self):

@@ -613,3 +613,28 @@ class TestMultiMetric:
                   Dataset(X, y), valid_sets=[Dataset(Xv, yv)])
         assert "training" in b.evals_result
         assert len(b.evals_result["training"]["auc"]) == b.num_iterations
+
+    def test_first_metric_only(self):
+        # the noise metric (auc on a noise fold... here: second metric)
+        # must NOT stop training when first_metric_only is set
+        X, y, Xv, yv = self._data()
+        rng = np.random.default_rng(77)
+        Xn = rng.normal(size=(400, 6))
+        yn = rng.integers(0, 2, 400).astype(np.float64)
+        base = dict(objective="binary", num_iterations=40, num_leaves=15,
+                    min_data_in_leaf=5, metric="binary_logloss",
+                    early_stopping_round=5, learning_rate=0.3)
+        any_pair = train(dict(base), Dataset(X, y),
+                         valid_sets=[Dataset(Xv, yv), Dataset(Xn, yn)])
+        # first_metric_only still watches ALL valid sets (LightGBM), so to
+        # isolate the metric dimension, make the NOISE the second METRIC
+        fmo = train(dict(base, metric="binary_logloss,binary_error",
+                         first_metric_only=True),
+                    Dataset(X, y), valid_sets=[Dataset(Xv, yv)])
+        both = train(dict(base, metric="binary_logloss,binary_error"),
+                     Dataset(X, y), valid_sets=[Dataset(Xv, yv)])
+        # with only the first metric watched, fmo runs at least as long as
+        # the two-metric ANY-pair run (binary_error is a coarser/noisier
+        # curve that tends to stall earlier)
+        assert fmo.num_iterations >= both.num_iterations
+        assert any_pair.num_iterations < 40  # noise fold stops the run

@@ -212,3 +212,29 @@ class TestRanker:
             parallelism="serial",
         ).fit(ranking_df)
         assert model.getBooster().num_iterations == 3
+
+
+class TestRankerEvalAt:
+    def test_eval_at_records_each_position(self):
+        import numpy as np
+
+        from mmlspark_tpu import DataFrame
+        from mmlspark_tpu.models.lightgbm import LightGBMRanker
+
+        rng = np.random.default_rng(5)
+        G, M = 24, 8
+        n = G * M
+        X = rng.normal(size=(n, 4))
+        rel = np.clip(X[:, 0] + rng.normal(scale=0.4, size=n) + 1.2, 0, 3)
+        df = DataFrame({
+            "features": list(X), "label": np.floor(rel),
+            "group": np.repeat(np.arange(G), M).astype(np.float64),
+        })
+        est = LightGBMRanker(numIterations=4, numLeaves=7, minDataInLeaf=2,
+                             evalAt=[1, 3, 5])
+        # engine-level check: evalAt maps to the multi-metric list
+        p = est._train_params()
+        assert p["metric"] == "ndcg@1,ndcg@3,ndcg@5"
+        model = est.fit(df)
+        assert np.isfinite(
+            model.transform(df)["prediction"]).all()
