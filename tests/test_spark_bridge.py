@@ -211,6 +211,13 @@ _WORKER_EVAL = textwrap.dedent(
             dist.predict(Xv_all), serial.predict(Xv_all),
             rtol=1e-2, atol=1e-2,
         ))
+        # model-quality parity (stable at any shard count; pointwise
+        # closeness can flip on a near-tie split under D-shard psum order)
+        from mmlspark_tpu.engine.eval_metrics import auc as _auc
+        out["auc_gap"] = abs(
+            float(_auc(yv_all, dist.predict(Xv_all)))
+            - float(_auc(yv_all, serial.predict(Xv_all)))
+        )
 
         # lambdarank oracle: merged groups in process order
         rparts = [rank_partition(p) for p in range(nproc)]
@@ -240,12 +247,12 @@ def _free_port() -> int:
 
 
 @pytest.mark.slow
-def test_barrier_eval_early_stop_and_lambdarank(tmp_path):
+@pytest.mark.parametrize("nproc", [2, 4])
+def test_barrier_eval_early_stop_and_lambdarank(tmp_path, nproc):
     """VERDICT r3 #1: the scalable multi-host path runs the north-star
-    shape — valid_sets + early stopping + lambdarank — as 2 REAL
+    shape — valid_sets + early stopping + lambdarank — as 2/4 REAL
     processes, with metrics from in-scan psum-able stats, matching
     single-process training on the merged rows."""
-    nproc = 2
     port = _free_port()
     script = tmp_path / "task_eval.py"
     script.write_text(_WORKER_EVAL.format(repo=REPO))
@@ -267,7 +274,12 @@ def test_barrier_eval_early_stop_and_lambdarank(tmp_path):
     r0 = {r["pid"]: r for r in results}[0]
     assert r0["early_stopped"], r0
     assert r0["stopped_iters"][0] == r0["stopped_iters"][1], r0
-    assert r0["preds_close"], r0
+    # pointwise parity is stable at 2 shards; at 4+ a near-tie split can
+    # flip under psum ordering (the data-parallel caveat) — the gate
+    # there is model quality + the stop-iteration contract above
+    if nproc == 2:
+        assert r0["preds_close"], r0
+    assert r0["auc_gap"] < 0.02, r0
     assert r0["rank_preds_match"], r0
     assert r0["rank_curve_close"], r0
     assert r0["rank_bridge_ok"], r0
