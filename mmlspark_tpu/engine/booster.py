@@ -1478,8 +1478,22 @@ def train(
         metric_names = [m.strip() for m in raw_metric.split(",") if m.strip()]
     else:
         metric_names = [str(m) for m in raw_metric]
+    # LightGBM's metric="None"/"na"/"null"/"custom" DISABLES evaluation:
+    # valid sets are ignored (nothing recorded, no snapshot transfers);
+    # early stopping then has nothing to watch and raises.
+    metric_names = [
+        m for m in metric_names
+        if m.lower() not in ("none", "na", "null", "custom")
+    ]
     if not metric_names:
-        metric_names = [obj.default_metric]
+        if cfg.early_stopping_round > 0:
+            raise ValueError(
+                "early stopping needs at least one metric; "
+                f"metric={cfg.metric!r} disables evaluation"
+            )
+        valid_sets = ()
+        vsets, names = [], []
+        metric_names = [obj.default_metric]  # name only; nothing evaluates
     # dedupe, order-preserving (LightGBM dedups metric lists; a repeated
     # name would double-append into one evals_result curve)
     metric_names = list(dict.fromkeys(metric_names))

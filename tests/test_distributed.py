@@ -393,3 +393,22 @@ class TestPsumWireDtype:
         b = train(dict(params, hist_psum_dtype="bfloat16"), Dataset(X, y),
                   bin_mapper=bm)
         np.testing.assert_allclose(a.predict(X), b.predict(X))
+
+
+class TestProcessLocalWarmStart:
+    def test_continuation_matches_mesh_warm_start(self):
+        # the reference's modelString continuation in distributed mode:
+        # a base forest + process_local continued training must equal the
+        # device_put mesh path exactly (single process)
+        X, y = _make_binary(n=2048, F=8, seed=15)
+        params = dict(objective="binary", num_iterations=6, num_leaves=15,
+                      min_data_in_leaf=5, tree_learner="data")
+        bm = BinMapper(max_bin=63).fit(X)
+        base = train(dict(params), Dataset(X, y), bin_mapper=bm)
+        cont_pl = train(dict(params, num_iterations=4), Dataset(X, y),
+                        init_model=base, process_local=True)
+        cont_mesh = train(dict(params, num_iterations=4), Dataset(X, y),
+                          init_model=base)
+        assert cont_pl.num_iterations == 10
+        np.testing.assert_allclose(cont_pl.predict(X), cont_mesh.predict(X),
+                                   rtol=1e-5, atol=1e-6)

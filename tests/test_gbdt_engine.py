@@ -638,3 +638,16 @@ class TestMultiMetric:
         # curve that tends to stall earlier)
         assert fmo.num_iterations >= both.num_iterations
         assert any_pair.num_iterations < 40  # noise fold stops the run
+
+    def test_metric_none_disables_eval(self):
+        # LightGBM metric="None": valid sets are ignored, nothing recorded
+        X, y, Xv, yv = self._data()
+        b = train(dict(objective="binary", num_iterations=4, num_leaves=7,
+                       min_data_in_leaf=5, metric="None"),
+                  Dataset(X, y), valid_sets=[Dataset(Xv, yv)])
+        assert b.evals_result == {}
+        assert b.num_iterations == 4
+        with pytest.raises(ValueError, match="early stopping needs"):
+            train(dict(objective="binary", num_iterations=4, num_leaves=7,
+                       metric="None", early_stopping_round=2),
+                  Dataset(X, y), valid_sets=[Dataset(Xv, yv)])
