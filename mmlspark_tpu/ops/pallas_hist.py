@@ -294,12 +294,15 @@ def _prep_by_leaf_chunk(
     bf = min(bf, max(8, _round_up(F, 8)))  # don't pad tiny feature counts 4x
     # Feature-block choice minimizes PADDED width: bf=32 on F=40 (the
     # criteo schema) tiles to 64 — 37.5% of every pass histogramming
-    # padding.  A single block of round_up(F, 8) ≤ 48 removes the waste
-    # (48·B stays inside the VMEM budget the bf-sweep established; 64
-    # blew it).
-    alt = _round_up(F, 8)
-    if alt <= 48 and alt < _round_up(F, bf):
-        bf = alt
+    # padding; F=136 (the MSLR schema) tiles to 160 where bf=48 gives 144.
+    # Candidates stay ≤ 48 (inside the VMEM budget the bf-sweep
+    # established; 64 blew it); ties prefer the LARGER block (fewer grid
+    # steps amortize the per-block leaf-side rhs build better).
+    cands = sorted({bf, 24, 40, 48, max(8, min(48, _round_up(F, 8)))})
+    bf = min(
+        (c for c in cands if c <= 48),
+        key=lambda c: (_round_up(F, c), -c),
+    )
     # VMEM guard: (num_bins, rm) one-hot tiles were swept at B=256.  rm
     # must stay a power of two ≥ 256: pl.ds offsets need 128 alignment and
     # the in-kernel loop needs rm | bm (an 8-aligned guard silently dropped
