@@ -412,3 +412,24 @@ class TestProcessLocalWarmStart:
         assert cont_pl.num_iterations == 10
         np.testing.assert_allclose(cont_pl.predict(X), cont_mesh.predict(X),
                                    rtol=1e-5, atol=1e-6)
+
+
+class TestDistributedGoss:
+    def test_goss_mesh_matches_serial(self):
+        # GOSS resamples from |gradients| every iteration; the top-k rank
+        # computation runs over the globally sharded gradient vector, so
+        # mesh and serial runs draw the same keep/sample decisions (same
+        # keys) — predictions match to psum-order drift.
+        X, y = _make_binary(n=4096, F=8, seed=17)
+        params = dict(objective="binary", num_iterations=10, num_leaves=15,
+                      min_data_in_leaf=5, boosting="goss",
+                      top_rate=0.3, other_rate=0.2)
+        bm = BinMapper(max_bin=63).fit(X)
+        serial = train(dict(params), Dataset(X, y), bin_mapper=bm)
+        dist = train(dict(params, tree_learner="data"), Dataset(X, y),
+                     bin_mapper=bm)
+        pl = train(dict(params, tree_learner="data"), Dataset(X, y),
+                   bin_mapper=bm, process_local=True)
+        assert abs(_auc(y, serial.predict(X)) - _auc(y, dist.predict(X))) < 5e-3
+        np.testing.assert_allclose(pl.predict(X), dist.predict(X),
+                                   rtol=1e-5, atol=1e-6)
