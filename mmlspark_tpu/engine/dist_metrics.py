@@ -207,7 +207,7 @@ def get_device_metric(
     ``group_idx``/``group_valid``: padded global group matrices, required
     for ndcg (built process-aligned by the booster's ingestion path)."""
     name = name.lower()
-    if name.startswith("ndcg"):
+    if name.startswith("ndcg") or name == "lambdarank":
         if group_idx is None:
             raise ValueError("ndcg needs process-aligned group matrices")
         k = int(name.split("@", 1)[1]) if "@" in name else 5
@@ -232,6 +232,18 @@ def get_device_metric(
         "quantile": lambda: _Pointwise(_quantile(float(alpha))),
         "multi_logloss": lambda: _Pointwise(_multi_logloss),
         "multi_error": lambda: _Pointwise(_multi_error),
+        # LightGBM objective-name aliases (mirror engine/eval_metrics)
+        "binary": lambda: _Pointwise(_binary_logloss),
+        "regression": lambda: _Pointwise(_l2),
+        "regression_l2": lambda: _Pointwise(_l2),
+        "regression_l1": lambda: _Pointwise(_l1),
+        "l2_root": lambda: _Pointwise(_l2, post=lambda v: float(np.sqrt(v))),
+        "root_mean_squared_error": lambda: _Pointwise(
+            _l2, post=lambda v: float(np.sqrt(v))
+        ),
+        "mean_absolute_percentage_error": lambda: _Pointwise(_mape),
+        "multiclass": lambda: _Pointwise(_multi_logloss),
+        "softmax": lambda: _Pointwise(_multi_logloss),
     }
     if name not in table:
         raise ValueError(

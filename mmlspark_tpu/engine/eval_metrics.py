@@ -140,6 +140,18 @@ _METRICS: Dict[str, Tuple[Callable, bool, bool]] = {
     "gamma": (poisson_nll, False, False),
     "tweedie": (poisson_nll, False, False),
     "ndcg": (ndcg_at(5), True, True),
+    # LightGBM metric aliases (config.h: the objective names double as
+    # their default metric's alias)
+    "binary": (binary_logloss, False, False),
+    "regression": (l2, False, False),
+    "regression_l2": (l2, False, False),
+    "regression_l1": (l1, False, False),
+    "l2_root": (rmse, False, False),
+    "root_mean_squared_error": (rmse, False, False),
+    "mean_absolute_percentage_error": (mape, False, False),
+    "multiclass": (multi_logloss, False, False),
+    "softmax": (multi_logloss, False, False),
+    "lambdarank": (ndcg_at(5), True, True),
 }
 for _k in (1, 2, 3, 4, 5, 10, 20):
     _METRICS[f"ndcg@{_k}"] = (ndcg_at(_k), True, True)

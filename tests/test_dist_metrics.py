@@ -129,3 +129,25 @@ def test_global_group_matrix_offsets_and_ragged_assembly():
     # empty local group list is legal (a process with no queries)
     gi0, gv0 = assemble_global_groups(None, 0)
     assert gi0.shape[0] == 0 and gv0.shape[0] == 0
+
+
+@pytest.mark.parametrize("alias,canon", [
+    ("binary", "binary_logloss"), ("regression", "l2"),
+    ("l2_root", "rmse"), ("multiclass", "multi_logloss"),
+])
+def test_objective_name_aliases_match(alias, canon):
+    multi = canon == "multi_logloss"
+    score, y, w = _inputs(multiclass=multi)
+    if canon == "binary_logloss":
+        y = (y > 0).astype(np.float32)
+    ha, _, _ = eval_metrics.get_metric(alias)
+    hc, _, _ = eval_metrics.get_metric(canon)
+    np.testing.assert_allclose(ha(y, score if multi else score[0], w=w),
+                               hc(y, score if multi else score[0], w=w))
+    ea, ec = get_device_metric(alias), get_device_metric(canon)
+    sa = ea.stats(jnp.asarray(score), jnp.asarray(y), jnp.asarray(w),
+                  jnp.ones(N, bool))
+    sc = ec.stats(jnp.asarray(score), jnp.asarray(y), jnp.asarray(w),
+                  jnp.ones(N, bool))
+    np.testing.assert_allclose(ea.finalize(np.asarray(sa)),
+                               ec.finalize(np.asarray(sc)))
