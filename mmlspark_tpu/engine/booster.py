@@ -1844,9 +1844,14 @@ def train(
         elif obj.stateful and state_key is None:
             scan_chunk = _build_scan_chunk()
         else:
+            # gcfg carries every data-derived static baked into the traced
+            # program (cat_value_bins from the bin mapper, onehot_stats from
+            # n, resolved split_batch/grow_policy, hist_chunk) — keying on
+            # the whole frozen dataclass keeps the key honest as fields are
+            # added, instead of re-enumerating cfg fields that feed it.
             cache_key = (
-                _cfg_cache_key(cfg), K, F, B, _mesh_cache_key(mesh),
-                type(obj).__name__, state_key,
+                _cfg_cache_key(cfg), K, F, F_real, B, _mesh_cache_key(mesh),
+                type(obj).__name__, state_key, gcfg, _delta_onehot,
             )
             scan_chunk = _SCAN_CACHE.get(cache_key)
             if scan_chunk is None:
@@ -1871,10 +1876,12 @@ def train(
                 scan_chunk = wrap_aot(
                     scan_chunk,
                     key_material=repr((
-                        _cfg_cache_key(cfg), K, F, B,
+                        _cfg_cache_key(cfg), K, F, F_real, B,
                         type(obj).__name__, state_key, dart_scan,
                         len(vsets), cfg.is_provide_training_metric,
                         tuple(metric_names) if device_eval else None,
+                        gcfg,  # data-derived statics (cat_value_bins, ...)
+                        _delta_onehot,
                     )),
                 )
 

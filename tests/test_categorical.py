@@ -128,3 +128,45 @@ class TestCategoricalSplits:
         assert "prediction" in out.columns
         sc = np.asarray(model.getBooster().trees.split_cat)
         assert sc.any()  # categorical splits were actually used
+
+
+class TestScanCacheCatStatics:
+    def test_cross_fit_cache_respects_cat_cardinality(self):
+        """Regression (r4 advisor, high): ``cat_value_bins`` — the static cap
+        on the cat scan's value-bin axis, derived from the bin mapper, NOT
+        from TrainConfig — was missing from the cross-call ``_SCAN_CACHE``
+        key.  A fit on low-cardinality data followed by a same-shape,
+        same-config fit on high-cardinality data silently reused a program
+        that statically drops every category bin above the stale cap,
+        producing wrong splits with no error."""
+        from mmlspark_tpu.engine import booster as booster_mod
+
+        rng = np.random.default_rng(7)
+        n = 3000
+
+        def make(card):
+            c = rng.integers(0, card, size=n)
+            x = rng.normal(size=n)  # many distinct values -> B = max_bin+1
+            eff = rng.normal(size=card) * 2.0
+            y = (eff[c] + 0.2 * x + rng.logistic(size=n) * 0.3 > 0)
+            X = np.column_stack([c.astype(np.float64), x])
+            return X, y.astype(np.float64)
+
+        params = dict(
+            objective="binary", num_iterations=15, num_leaves=15,
+            max_bin=63, min_data_in_leaf=20, learning_rate=0.2,
+            categorical_feature=[0],
+        )
+        X_lo, y_lo = make(6)     # cat_value_bins = 6
+        X_hi, y_hi = make(48)    # cat_value_bins = 48, same (n, F) and B
+
+        # ground truth: high-card fit with a cold cache
+        booster_mod._SCAN_CACHE.clear()
+        ref = train(params, Dataset(X_hi, y_hi)).predict(X_hi)
+
+        # poisoned order: low-card fit first populates the cache
+        booster_mod._SCAN_CACHE.clear()
+        train(params, Dataset(X_lo, y_lo))
+        got = train(params, Dataset(X_hi, y_hi)).predict(X_hi)
+
+        np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-6)

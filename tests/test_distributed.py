@@ -433,3 +433,35 @@ class TestDistributedGoss:
         assert abs(_auc(y, serial.predict(X)) - _auc(y, dist.predict(X))) < 5e-3
         np.testing.assert_allclose(pl.predict(X), dist.predict(X),
                                    rtol=1e-5, atol=1e-6)
+
+
+class TestScanCacheFRealStatic:
+    def test_feature_parallel_cache_respects_real_feature_count(self):
+        """Regression (r5 review): under tree_learner='feature' the column
+        count is padded to a multiple of the shard count, and the padded
+        ``F`` — not the real one — reached the ``_SCAN_CACHE`` key, while
+        the cached program bakes ``F_real`` in via the ``_fmask_one``
+        closure.  F_real=12 and F_real=14 both pad to F=16 on 8 shards, so
+        the second fit reused a program that statically masks features
+        12-13 out of every split search."""
+        from mmlspark_tpu.engine import booster as booster_mod
+
+        params = dict(objective="binary", num_iterations=10, num_leaves=15,
+                      min_data_in_leaf=5, tree_learner="feature")
+
+        X14, y14 = _make_binary(n=2048, F=14, seed=3)
+        # concentrate signal on the tail columns the stale mask would drop
+        X14[:, 12] = X14[:, 0]
+        X14[:, 13] = X14[:, 1]
+        X14[:, 0] = 0.0
+        X14[:, 1] = 0.0
+        X12, y12 = _make_binary(n=2048, F=12, seed=4)
+
+        booster_mod._SCAN_CACHE.clear()
+        ref = train(params, Dataset(X14, y14)).predict(X14)
+
+        booster_mod._SCAN_CACHE.clear()
+        train(params, Dataset(X12, y12))
+        got = train(params, Dataset(X14, y14)).predict(X14)
+
+        np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-6)
