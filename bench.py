@@ -49,7 +49,6 @@ N_NUM, N_CAT = 13, 26  # criteo display-ads schema
 N_ITER = 50
 NUM_LEAVES = 63
 MAX_BIN = 255
-SPLIT_BATCH = 12
 
 
 def _log(*a):
@@ -108,18 +107,16 @@ def bench_config(categorical_feature=()):
     import jax
 
     enable_compile_cache()
+    # ENGINE DEFAULTS, for real (r4 verdict: the benchmarked config must
+    # be what a default fit() runs).  grow_policy/split_batch/hist_backend/
+    # hist_chunk/hist_precision all ride the engine's auto-resolution:
+    # on TPU that lands pallas + one-chunk + split_batch=12 + bf16
+    # histograms; the resolved knobs are asserted and reported by main().
+    del jax  # only problem params below — nothing backend-conditional
     return dict(
         objective="binary", num_iterations=N_ITER, num_leaves=NUM_LEAVES,
         max_bin=MAX_BIN, min_data_in_leaf=20, learning_rate=0.1,
-        # k-batched best-first growth: lossguide-quality splits at
-        # depthwise-like pass counts (see module docstring).
-        grow_policy="lossguide", split_batch=SPLIT_BATCH,
         categorical_feature=list(categorical_feature),
-        hist_backend="pallas" if jax.default_backend() == "tpu" else "scatter",
-        hist_chunk=N_ROWS,
-        # bf16 multiplies / f32 accumulation on the MXU: ~2.4x over f32
-        # passes; the AUC-parity assertion below is the quality gate.
-        hist_precision="default",
     )
 
 
@@ -164,6 +161,20 @@ def bench_tpu(X, y, categorical_feature=(), tag="tpu"):
         steadies.append(time.perf_counter() - t0)
     wall = min(steadies)
     a = auc(y[:100_000], booster.predict(X[:100_000]))
+    # The knobs the engine's auto-resolution actually picked (they live on
+    # the returned model) — reported so the gate's metric string describes
+    # the REAL configuration, and asserted on TPU so a default-resolution
+    # regression can't silently change what this bench measures.
+    rc = booster.config
+    resolved = (
+        f"auto-resolved: split_batch={rc.split_batch}, "
+        f"hist_backend={rc.hist_backend}, hist_precision={rc.hist_precision}"
+    )
+    _log(f"[{tag}] {resolved}")
+    if jax.default_backend() == "tpu":
+        assert rc.hist_backend == "pallas", rc.hist_backend
+        assert rc.split_batch == 12, rc.split_batch
+        assert rc.hist_precision == "default", rc.hist_precision
     _log(
         f"[{tag}] train: cold(incl. compile+upload)={cold:.2f}s "
         f"steady_runs={[round(s, 2) for s in steadies]} best={wall:.2f}s  "
@@ -175,7 +186,7 @@ def bench_tpu(X, y, categorical_feature=(), tag="tpu"):
         f"{max(cold - wall, 0.0):.2f}s (amortized by the persistent jit "
         f"cache), steady device+dispatch {wall:.2f}s"
     )
-    return wall, max(cold - wall, 0.0), a
+    return wall, max(cold - wall, 0.0), a, resolved
 
 
 def bench_cpu_baseline(X, y, categorical_feature=(), tag="cpu"):
@@ -204,7 +215,7 @@ def bench_cpu_baseline(X, y, categorical_feature=(), tag="cpu"):
 
 
 def _one_config(X, y, cat_idx, tag):
-    tpu_s, compile_s, tpu_auc = bench_tpu(X, y, cat_idx, tag=tag)
+    tpu_s, compile_s, tpu_auc, resolved = bench_tpu(X, y, cat_idx, tag=tag)
     try:
         cpu_s, cpu_auc = bench_cpu_baseline(X, y, cat_idx, tag=f"{tag}-cpu")
         gap = abs(tpu_auc - cpu_auc)
@@ -222,20 +233,22 @@ def _one_config(X, y, cat_idx, tag):
     except Exception as e:  # baseline unavailable → report raw time only
         _log(f"[{tag}] baseline failed: {e!r}")
         vs, gap = 1.0, None
-    return tpu_s, compile_s, vs, gap
+    return tpu_s, compile_s, vs, gap, resolved
 
 
 def main():
     # HEADLINE: the criteo-schema categorical mix at engine defaults.
     Xc, yc, cat_idx = make_catmix_data()
-    cat_s, cat_compile, cat_vs, cat_gap = _one_config(Xc, yc, cat_idx, "catmix")
+    cat_s, cat_compile, cat_vs, cat_gap, resolved = _one_config(
+        Xc, yc, cat_idx, "catmix"
+    )
     # Secondary: the all-numeric proxy (round-over-round comparability).
     Xn, yn = make_data()
-    num_s, num_compile, num_vs, num_gap = _one_config(Xn, yn, (), "numeric")
+    num_s, num_compile, num_vs, num_gap, _ = _one_config(Xn, yn, (), "numeric")
     out = {
         "metric": f"criteo-schema {N_ROWS//1000}kx({N_NUM}num+{N_CAT}cat) "
                   f"GBDT train wall-clock ({N_ITER} iters, {NUM_LEAVES} "
-                  f"leaves, engine defaults)",
+                  f"leaves, default fit(); {resolved})",
         "value": round(cat_s, 3),
         "unit": "s",
         "compile_s": round(cat_compile, 3),

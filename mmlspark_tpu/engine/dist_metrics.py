@@ -116,6 +116,40 @@ def _poisson(score_kn, y):
     return jnp.exp(score_kn[0]) - y * score_kn[0]
 
 
+def _huber(alpha):
+    # LightGBM huber metric: 0.5 d^2 in-band, alpha(|d| - 0.5 alpha) out —
+    # mirrors eval_metrics.huber_loss (r4 verdict missing #4).
+    def f(score_kn, y):
+        d = jnp.abs(y - score_kn[0])
+        return jnp.where(d <= alpha, 0.5 * d * d, alpha * (d - 0.5 * alpha))
+
+    return f
+
+
+def _fair(c):
+    def f(score_kn, y):
+        x = jnp.abs(y - score_kn[0])
+        return c * x - c * c * jnp.log1p(x / c)
+
+    return f
+
+
+def _gamma(score_kn, y):
+    # label/pred + log(pred), pred = exp(raw) — eval_metrics.gamma_nll
+    return y * jnp.exp(-score_kn[0]) + score_kn[0]
+
+
+def _tweedie(rho):
+    def f(score_kn, y):
+        pred = jnp.exp(score_kn[0])
+        return (
+            -y * pred ** (1.0 - rho) / (1.0 - rho)
+            + pred ** (2.0 - rho) / (2.0 - rho)
+        )
+
+    return f
+
+
 def _quantile(alpha):
     def f(score_kn, y):
         d = y - score_kn[0]
@@ -137,23 +171,33 @@ def _multi_error(score_kn, y):
 
 
 class _BinnedAUC(DeviceMetric):
-    """Weighted ROC-AUC from a pos/neg score histogram (one allreduce)."""
+    """Weighted ROC-AUC from a pos/neg score histogram (one allreduce).
+
+    The quantization (~1/bins) can flip improvement comparisons near a
+    plateau, so a process_local run early-stopping on metric="auc" may
+    stop at a different iteration than a single-controller run (other
+    metrics are f32-exact) — raise ``auc_eval_bins`` (TrainConfig) to
+    tighten it at the cost of a larger allreduce (r4 advisor low #4).
+    """
 
     higher_better = True
+
+    def __init__(self, bins: int = _AUC_BINS):
+        self.bins = int(bins)
 
     def stats(self, score_kn, y, w, mask):
         wm = _eff_w(y, w, mask)
         p = _sig(score_kn[0])
-        b = jnp.clip((p * _AUC_BINS).astype(jnp.int32), 0, _AUC_BINS - 1)
+        b = jnp.clip((p * self.bins).astype(jnp.int32), 0, self.bins - 1)
         pos_w = jnp.where(y > 0, wm, 0.0)
         neg_w = jnp.where(y > 0, 0.0, wm)
-        pos_h = jnp.zeros(_AUC_BINS, jnp.float32).at[b].add(pos_w)
-        neg_h = jnp.zeros(_AUC_BINS, jnp.float32).at[b].add(neg_w)
+        pos_h = jnp.zeros(self.bins, jnp.float32).at[b].add(pos_w)
+        neg_h = jnp.zeros(self.bins, jnp.float32).at[b].add(neg_w)
         return jnp.concatenate([pos_h, neg_h])
 
     def finalize(self, s):
-        pos, neg = np.asarray(s[:_AUC_BINS], np.float64), np.asarray(
-            s[_AUC_BINS:], np.float64
+        pos, neg = np.asarray(s[: self.bins], np.float64), np.asarray(
+            s[self.bins :], np.float64
         )
         tp, tn = pos.sum(), neg.sum()
         if tp == 0 or tn == 0:
@@ -199,6 +243,9 @@ class _GroupedNDCG(DeviceMetric):
 def get_device_metric(
     name: str,
     alpha: float = 0.9,
+    fair_c: float = 1.0,
+    tweedie_variance_power: float = 1.5,
+    auc_eval_bins: int = _AUC_BINS,
     group_idx: Optional[np.ndarray] = None,
     group_valid: Optional[np.ndarray] = None,
 ) -> DeviceMetric:
@@ -213,7 +260,7 @@ def get_device_metric(
         k = int(name.split("@", 1)[1]) if "@" in name else 5
         return _GroupedNDCG(k, group_idx, group_valid)
     table = {
-        "auc": lambda: _BinnedAUC(),
+        "auc": lambda: _BinnedAUC(int(auc_eval_bins)),
         "binary_logloss": lambda: _Pointwise(_binary_logloss),
         "binary_error": lambda: _Pointwise(_binary_error),
         "l2": lambda: _Pointwise(_l2),
@@ -225,10 +272,12 @@ def get_device_metric(
         "mean_absolute_error": lambda: _Pointwise(_l1),
         "mape": lambda: _Pointwise(_mape),
         "poisson": lambda: _Pointwise(_poisson),
-        "gamma": lambda: _Pointwise(_poisson),
-        "tweedie": lambda: _Pointwise(_poisson),
-        "huber": lambda: _Pointwise(_l2),
-        "fair": lambda: _Pointwise(_l1),
+        "gamma": lambda: _Pointwise(_gamma),
+        "tweedie": lambda: _Pointwise(
+            _tweedie(float(tweedie_variance_power))
+        ),
+        "huber": lambda: _Pointwise(_huber(float(alpha))),
+        "fair": lambda: _Pointwise(_fair(float(fair_c))),
         "quantile": lambda: _Pointwise(_quantile(float(alpha))),
         "multi_logloss": lambda: _Pointwise(_multi_logloss),
         "multi_error": lambda: _Pointwise(_multi_error),

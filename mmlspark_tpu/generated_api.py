@@ -1154,7 +1154,7 @@ class LightGBMClassificationModel(_LightGBMClassificationModel):
       earlyStoppingRound: Early stopping patience (0 = off)
       featureFraction: Feature subsample fraction
       featuresCol: The name of the features column
-      growPolicy: lossguide (LightGBM-exact leaf-wise) | depthwise (level-batched histograms — the fast TPU path, one pass per level)
+      growPolicy: lossguide (leaf-wise; auto-batches splits on TPU — see splitBatch) | lossguide_exact (LightGBM's one-split-per-pass sequence, never batched) | depthwise (level-batched histograms, one pass per level)
       initScoreCol: Initial (margin) score column
       isProvideTrainingMetric: Record metrics on training data too
       isUnbalance: Reweight unbalanced binary labels
@@ -1182,7 +1182,7 @@ class LightGBMClassificationModel(_LightGBMClassificationModel):
       rawPredictionCol: Raw margin output column
       seed: Master random seed
       slotNames: Feature vector slot names
-      splitBatch: k-batched best-first growth: apply up to k best splits per histogram pass (0 = policy default; 1 = exact lossguide; ~12 gives leaf-wise quality at level-wise pass counts — the bench setting; see BASELINE.md)
+      splitBatch: k-batched best-first growth: apply up to k best splits per histogram pass (0 = auto: ~12 on the TPU lossguide path — the benchmarked default, see BASELINE.md — policy default elsewhere; 1 = exact lossguide; -1 = never batch)
       thresholds: Per-class prediction thresholds
       timeout: Distributed initialization timeout in seconds
       topK: Top-k features voted per worker in voting_parallel
@@ -1215,7 +1215,7 @@ class LightGBMClassifier(_LightGBMClassifier):
       earlyStoppingRound: Early stopping patience (0 = off)
       featureFraction: Feature subsample fraction
       featuresCol: The name of the features column
-      growPolicy: lossguide (LightGBM-exact leaf-wise) | depthwise (level-batched histograms — the fast TPU path, one pass per level)
+      growPolicy: lossguide (leaf-wise; auto-batches splits on TPU — see splitBatch) | lossguide_exact (LightGBM's one-split-per-pass sequence, never batched) | depthwise (level-batched histograms, one pass per level)
       initScoreCol: Initial (margin) score column
       isProvideTrainingMetric: Record metrics on training data too
       isUnbalance: Reweight unbalanced binary labels
@@ -1243,7 +1243,7 @@ class LightGBMClassifier(_LightGBMClassifier):
       rawPredictionCol: Raw margin output column
       seed: Master random seed
       slotNames: Feature vector slot names
-      splitBatch: k-batched best-first growth: apply up to k best splits per histogram pass (0 = policy default; 1 = exact lossguide; ~12 gives leaf-wise quality at level-wise pass counts — the bench setting; see BASELINE.md)
+      splitBatch: k-batched best-first growth: apply up to k best splits per histogram pass (0 = auto: ~12 on the TPU lossguide path — the benchmarked default, see BASELINE.md — policy default elsewhere; 1 = exact lossguide; -1 = never batch)
       thresholds: Per-class prediction thresholds
       timeout: Distributed initialization timeout in seconds
       topK: Top-k features voted per worker in voting_parallel
@@ -1278,7 +1278,7 @@ class LightGBMRanker(_LightGBMRanker):
       featureFraction: Feature subsample fraction
       featuresCol: The name of the features column
       groupCol: Query group column
-      growPolicy: lossguide (LightGBM-exact leaf-wise) | depthwise (level-batched histograms — the fast TPU path, one pass per level)
+      growPolicy: lossguide (leaf-wise; auto-batches splits on TPU — see splitBatch) | lossguide_exact (LightGBM's one-split-per-pass sequence, never batched) | depthwise (level-batched histograms, one pass per level)
       initScoreCol: Initial (margin) score column
       isProvideTrainingMetric: Record metrics on training data too
       isUnbalance: Reweight unbalanced binary labels
@@ -1307,7 +1307,7 @@ class LightGBMRanker(_LightGBMRanker):
       repartitionByGroupingColumn: Keep each query group within one worker shard
       seed: Master random seed
       slotNames: Feature vector slot names
-      splitBatch: k-batched best-first growth: apply up to k best splits per histogram pass (0 = policy default; 1 = exact lossguide; ~12 gives leaf-wise quality at level-wise pass counts — the bench setting; see BASELINE.md)
+      splitBatch: k-batched best-first growth: apply up to k best splits per histogram pass (0 = auto: ~12 on the TPU lossguide path — the benchmarked default, see BASELINE.md — policy default elsewhere; 1 = exact lossguide; -1 = never batch)
       timeout: Distributed initialization timeout in seconds
       topK: Top-k features voted per worker in voting_parallel
       useBarrierExecutionMode: Gang-schedule training (the SPMD program launch is inherently gang-scheduled on TPU; kept for API parity)
@@ -1340,7 +1340,7 @@ class LightGBMRankerModel(_LightGBMRankerModel):
       earlyStoppingRound: Early stopping patience (0 = off)
       featureFraction: Feature subsample fraction
       featuresCol: The name of the features column
-      growPolicy: lossguide (LightGBM-exact leaf-wise) | depthwise (level-batched histograms — the fast TPU path, one pass per level)
+      growPolicy: lossguide (leaf-wise; auto-batches splits on TPU — see splitBatch) | lossguide_exact (LightGBM's one-split-per-pass sequence, never batched) | depthwise (level-batched histograms, one pass per level)
       initScoreCol: Initial (margin) score column
       isProvideTrainingMetric: Record metrics on training data too
       isUnbalance: Reweight unbalanced binary labels
@@ -1366,7 +1366,7 @@ class LightGBMRankerModel(_LightGBMRankerModel):
       predictionCol: The name of the prediction column
       seed: Master random seed
       slotNames: Feature vector slot names
-      splitBatch: k-batched best-first growth: apply up to k best splits per histogram pass (0 = policy default; 1 = exact lossguide; ~12 gives leaf-wise quality at level-wise pass counts — the bench setting; see BASELINE.md)
+      splitBatch: k-batched best-first growth: apply up to k best splits per histogram pass (0 = auto: ~12 on the TPU lossguide path — the benchmarked default, see BASELINE.md — policy default elsewhere; 1 = exact lossguide; -1 = never batch)
       timeout: Distributed initialization timeout in seconds
       topK: Top-k features voted per worker in voting_parallel
       useBarrierExecutionMode: Gang-schedule training (the SPMD program launch is inherently gang-scheduled on TPU; kept for API parity)
@@ -1399,7 +1399,7 @@ class LightGBMRegressionModel(_LightGBMRegressionModel):
       earlyStoppingRound: Early stopping patience (0 = off)
       featureFraction: Feature subsample fraction
       featuresCol: The name of the features column
-      growPolicy: lossguide (LightGBM-exact leaf-wise) | depthwise (level-batched histograms — the fast TPU path, one pass per level)
+      growPolicy: lossguide (leaf-wise; auto-batches splits on TPU — see splitBatch) | lossguide_exact (LightGBM's one-split-per-pass sequence, never batched) | depthwise (level-batched histograms, one pass per level)
       initScoreCol: Initial (margin) score column
       isProvideTrainingMetric: Record metrics on training data too
       isUnbalance: Reweight unbalanced binary labels
@@ -1425,7 +1425,7 @@ class LightGBMRegressionModel(_LightGBMRegressionModel):
       predictionCol: The name of the prediction column
       seed: Master random seed
       slotNames: Feature vector slot names
-      splitBatch: k-batched best-first growth: apply up to k best splits per histogram pass (0 = policy default; 1 = exact lossguide; ~12 gives leaf-wise quality at level-wise pass counts — the bench setting; see BASELINE.md)
+      splitBatch: k-batched best-first growth: apply up to k best splits per histogram pass (0 = auto: ~12 on the TPU lossguide path — the benchmarked default, see BASELINE.md — policy default elsewhere; 1 = exact lossguide; -1 = never batch)
       timeout: Distributed initialization timeout in seconds
       topK: Top-k features voted per worker in voting_parallel
       useBarrierExecutionMode: Gang-schedule training (the SPMD program launch is inherently gang-scheduled on TPU; kept for API parity)
@@ -1458,7 +1458,7 @@ class LightGBMRegressor(_LightGBMRegressor):
       earlyStoppingRound: Early stopping patience (0 = off)
       featureFraction: Feature subsample fraction
       featuresCol: The name of the features column
-      growPolicy: lossguide (LightGBM-exact leaf-wise) | depthwise (level-batched histograms — the fast TPU path, one pass per level)
+      growPolicy: lossguide (leaf-wise; auto-batches splits on TPU — see splitBatch) | lossguide_exact (LightGBM's one-split-per-pass sequence, never batched) | depthwise (level-batched histograms, one pass per level)
       initScoreCol: Initial (margin) score column
       isProvideTrainingMetric: Record metrics on training data too
       isUnbalance: Reweight unbalanced binary labels
@@ -1484,7 +1484,7 @@ class LightGBMRegressor(_LightGBMRegressor):
       predictionCol: The name of the prediction column
       seed: Master random seed
       slotNames: Feature vector slot names
-      splitBatch: k-batched best-first growth: apply up to k best splits per histogram pass (0 = policy default; 1 = exact lossguide; ~12 gives leaf-wise quality at level-wise pass counts — the bench setting; see BASELINE.md)
+      splitBatch: k-batched best-first growth: apply up to k best splits per histogram pass (0 = auto: ~12 on the TPU lossguide path — the benchmarked default, see BASELINE.md — policy default elsewhere; 1 = exact lossguide; -1 = never batch)
       timeout: Distributed initialization timeout in seconds
       topK: Top-k features voted per worker in voting_parallel
       tweedieVariancePower: Tweedie variance power (1..2)

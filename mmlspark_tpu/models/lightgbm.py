@@ -140,17 +140,21 @@ class _LightGBMParams(
     seed = Param("seed", "Master random seed", default=0, dtype=int)
     growPolicy = Param(
         "growPolicy",
-        "lossguide (LightGBM-exact leaf-wise) | depthwise (level-batched "
-        "histograms — the fast TPU path, one pass per level)",
+        "lossguide (leaf-wise; auto-batches splits on TPU — see "
+        "splitBatch) | lossguide_exact (LightGBM's one-split-per-pass "
+        "sequence, never batched) | depthwise (level-batched histograms, "
+        "one pass per level)",
         default="lossguide", dtype=str,
-        validator=ParamValidators.inList(["lossguide", "depthwise"]),
+        validator=ParamValidators.inList(
+            ["lossguide", "lossguide_exact", "depthwise"]
+        ),
     )
     splitBatch = Param(
         "splitBatch",
         "k-batched best-first growth: apply up to k best splits per "
-        "histogram pass (0 = policy default; 1 = exact lossguide; ~12 "
-        "gives leaf-wise quality at level-wise pass counts — the bench "
-        "setting; see BASELINE.md)",
+        "histogram pass (0 = auto: ~12 on the TPU lossguide path — the "
+        "benchmarked default, see BASELINE.md — policy default elsewhere; "
+        "1 = exact lossguide; -1 = never batch)",
         default=0, dtype=int,
     )
 

@@ -602,6 +602,31 @@ class TestMultiMetric:
             b.num_iterations, b_single.num_iterations)
         assert b.num_iterations < 20
 
+    def test_stall_reports_triggering_pair_best(self):
+        # LightGBM's early_stopping callback reports the TRIGGERING pair's
+        # best iteration/score; when the noise fold (valid index 1) stops
+        # the run, best_iteration must be that fold's best — not the
+        # still-improving good fold's latest (r4 advisor low #2).
+        X, y, Xv, yv = self._data()
+        rng = np.random.default_rng(99)
+        Xn = rng.normal(size=(400, 6))
+        yn = rng.integers(0, 2, 400).astype(np.float64)
+        b = train(dict(objective="binary", num_iterations=60, num_leaves=15,
+                       min_data_in_leaf=5, metric="binary_logloss",
+                       early_stopping_round=5, learning_rate=0.3),
+                  Dataset(X, y),
+                  valid_sets=[Dataset(Xv, yv), Dataset(Xn, yn)],
+                  valid_names=["good", "noise"])
+        assert b.num_iterations < 60  # the noise fold stopped the run
+        noise_curve = b.evals_result["noise"]["binary_logloss"]
+        good_curve = b.evals_result["good"]["binary_logloss"]
+        trig_best = int(np.argmin(noise_curve))
+        # distinguishing scenario: the good fold's best is NOT the
+        # triggering fold's best (else this test can't tell them apart)
+        assert int(np.argmin(good_curve)) != trig_best
+        assert b.best_iteration == trig_best, (
+            b.best_iteration, trig_best, np.argmin(good_curve))
+
     def test_training_pseudo_valid_never_stops(self):
         # is_provide_training_metric joins the eval loop but must not
         # participate in the ANY-pair stopping rule

@@ -59,7 +59,7 @@ def main():
     for name, extra in configs:
         # the EXACT bench config, varying only the ablation axes (the
         # bench pins split_batch, which depthwise configs override)
-        params = dict(bench_config(), split_batch=0, **extra)
+        params = dict(bench_config(), split_batch=-1, **extra)  # -1 = never batch (0 now auto-resolves on TPU)
         t0 = time.perf_counter()
         booster = train(params, ds, bin_mapper=bm)
         cold = time.perf_counter() - t0

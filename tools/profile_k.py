@@ -26,7 +26,7 @@ def main():
 
     ks = [int(a) for a in sys.argv[1:]] or [0, 16, 8, 4, 1]
     for k in ks:
-        params = dict(bench_config(), split_batch=0)  # k set below
+        params = dict(bench_config(), split_batch=-1)  # k set below (-1 = never batch)
         if k == 0:
             params["grow_policy"] = "depthwise"
             name = "depthwise(k=0)"
