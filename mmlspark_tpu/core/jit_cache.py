@@ -15,6 +15,8 @@ therefore every estimator facade).  Controls:
 - ``MMLSPARK_TPU_NO_COMPILE_CACHE=1`` — opt out.
 - ``MMLSPARK_TPU_COMPILE_CACHE_DIR`` — override the default
   ``~/.cache/mmlspark_tpu/jit`` (honors ``XDG_CACHE_HOME``).
+- ``MMLSPARK_TPU_COMPILE_CACHE_MAX_MB`` — size cap for best-effort
+  LRU pruning (default 2048).
 
 A user-set ``jax_compilation_cache_dir`` (jax config or ``JAX_COMPILATION_
 CACHE_DIR``) always wins — we never override an explicit choice.
@@ -62,7 +64,51 @@ def enable_compile_cache() -> bool:
         # Cache even fast compiles: the scan-program zoo is many small
         # programs and the write cost is trivial next to any compile.
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+        # Min-time-0 writes EVERY program, so the dir grows without bound
+        # across shapes/configs (r4 advisor low #5) — prune to a size cap,
+        # oldest-access first, at enable time (once per process).
+        prune_cache_dir(path)
         _done = True
         return True
     except Exception:
         return False
+
+
+def prune_cache_dir(path: str, max_mb: float | None = None) -> int:
+    """Best-effort LRU prune of ``path`` to ``max_mb``; returns files removed.
+
+    Eviction order is access time (a cache hit refreshes atime on most
+    filesystems; mtime is the fallback) — never raises, concurrent
+    processes racing on the same file just skip it.
+    """
+    if max_mb is None:
+        try:
+            max_mb = float(
+                os.environ.get("MMLSPARK_TPU_COMPILE_CACHE_MAX_MB", 2048)
+            )
+        except ValueError:  # e.g. "2g" — keep the never-raises contract
+            max_mb = 2048.0
+    budget = max_mb * (1 << 20)
+    try:
+        entries = []
+        with os.scandir(path) as it:
+            for e in it:
+                if e.is_file():
+                    st = e.stat()
+                    entries.append((max(st.st_atime, st.st_mtime), st.st_size, e.path))
+        total = sum(s for _, s, _ in entries)
+        if total <= budget:
+            return 0
+        removed = 0
+        for _, size, p in sorted(entries):
+            try:
+                os.remove(p)
+                removed += 1
+                total -= size
+            except OSError:
+                continue
+            if total <= budget:
+                break
+        return removed
+    except OSError:
+        return 0

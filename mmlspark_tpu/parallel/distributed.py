@@ -146,8 +146,12 @@ def host_allgather(arr) -> "np.ndarray":
 
 def host_allgather_ragged_rows(arr) -> "np.ndarray":
     """Concatenate every process's rows (differing counts allowed), in
-    process order — for BOUNDED payloads (e.g. binning samples ≤
-    ``bin_construct_sample_cnt`` rows total), never the raw dataset."""
+    process order.  Intended for BOUNDED payloads (binning samples ≤
+    ``bin_construct_sample_cnt`` rows) and for the ONE sanctioned
+    full-dataset use: feature-parallel ingestion, whose LightGBM contract
+    is that every machine holds the full data anyway — note the gather
+    transiently pads to ``nproc × max_rows``, so callers moving datasets
+    accept ~2× the merged size in peak host memory."""
     import numpy as np
 
     arr = np.ascontiguousarray(arr)

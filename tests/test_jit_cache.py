@@ -69,3 +69,30 @@ def test_train_enables_cache(monkeypatch, tmp_path):
     train(dict(objective="binary", num_iterations=2, num_leaves=4,
                min_data_in_leaf=2, max_bin=15), Dataset(X, y))
     assert jax.config.jax_compilation_cache_dir == str(tmp_path / "jc")
+
+
+def test_prune_cache_dir_lru(tmp_path):
+    """r4 advisor low #5: min-compile-time-0 writes every program, so the
+    cache dir needs a size cap; pruning evicts oldest-access first."""
+    import os
+    import time
+
+    from mmlspark_tpu.core.jit_cache import prune_cache_dir
+
+    d = tmp_path / "jit"
+    d.mkdir()
+    for i in range(5):
+        p = d / f"prog{i}.bin"
+        p.write_bytes(b"x" * 1024)
+        t = time.time() - (100 - i)  # prog0 oldest
+        os.utime(p, (t, t))
+    # cap at 3 KiB -> the two oldest go
+    removed = prune_cache_dir(str(d), max_mb=3 / 1024)
+    assert removed == 2
+    assert sorted(f.name for f in d.iterdir()) == [
+        "prog2.bin", "prog3.bin", "prog4.bin"
+    ]
+    # under budget -> no-op
+    assert prune_cache_dir(str(d), max_mb=1.0) == 0
+    # missing dir -> harmless
+    assert prune_cache_dir(str(d / "nope"), max_mb=1.0) == 0
