@@ -19,13 +19,23 @@ implication": invert the direction), so the generator's jobs are:
 2. ``generate_smoke_tests(path)`` — emit a pytest module with one test per
    stage: construct → per-param kwarg acceptance → setter/getter round
    trip (the reference's ``PySparkWrapperTest`` output).
+3. ``render_r_api()`` — emit ``R/mmlspark_tpu_generated.R``: the R half of
+   the reference's codegen surface (SURVEY.md §2.2 — upstream's
+   ``RCodegen`` emits one sparklyr-style ``ml_*`` function per stage).
+   Here each function is a reticulate bridge to the SAME Python stage:
+   snake_case arguments (sparklyr convention) mapped back to the Param
+   names, defaults rendered as R literals from the Param metadata.  R is
+   not installed in this image, so the emitted file is validated by the
+   staleness gate + structural checks, not execution.
 
-Run ``python -m mmlspark_tpu.codegen`` to regenerate both.
+Run ``python -m mmlspark_tpu.codegen`` to regenerate all three.
 """
 
 from __future__ import annotations
 
+import math
 import os
+import re
 from typing import List
 
 from mmlspark_tpu.core.params import ComplexParam, Param
@@ -150,15 +160,115 @@ def render_smoke_tests() -> str:
     return "\n".join(lines)
 
 
+def _snake(name: str) -> str:
+    return re.sub(r"(?<!^)(?=[A-Z])", "_", name).lower()
+
+
+def _r_literal(v):
+    """R source literal for a Param default, or None if unrepresentable
+    (the wrapper then defaults the argument to NULL and omits it)."""
+    if v is None:
+        return "NULL"
+    if isinstance(v, bool):
+        return "TRUE" if v else "FALSE"
+    if isinstance(v, int):
+        return f"{v}L"
+    if isinstance(v, float):
+        if math.isnan(v):
+            return "NaN"
+        if math.isinf(v):
+            return "Inf" if v > 0 else "-Inf"
+        return repr(v)
+    if isinstance(v, str):
+        return '"' + v.replace("\\", "\\\\").replace('"', '\\"') + '"'
+    if isinstance(v, (list, tuple)):
+        items = [_r_literal(x) for x in v]
+        if any(i is None for i in items):
+            return None
+        return "list(" + ", ".join(items) + ")"
+    return None
+
+
+def _emit_r_function(cls) -> List[str]:
+    params = sorted(cls._params.values(), key=lambda p: p.name)
+    fname = "ml_" + _snake(cls.__name__)
+    args, py_names = [], []
+    for p in params:
+        d = getattr(p, "default", _NO_DEFAULT)
+        lit = None if type(d).__name__ == "object" else _r_literal(d)
+        rname = _snake(p.name)
+        args.append(f"{rname} = {lit if lit is not None else 'NULL'}")
+        py_names.append(f'{rname} = "{p.name}"')
+    lines = [f"#' {cls.__name__} (generated wrapper over"
+             f" {cls.__module__}.{cls.__qualname__})"]
+    for p in params:
+        doc = (p.doc or "").replace("\n", " ").strip()
+        lines.append(f"#' @param {_snake(p.name)} {doc}")
+    lines.append("#' @export")
+    sig = ",\n".join(f"    {a}" for a in args)
+    body_map = ",\n".join(f"    {m}" for m in py_names)
+    lines += [
+        f"{fname} <- function(",
+        sig + ") {",
+        "  .py_names <- c(",
+        body_map + ")",
+        "  .args <- as.list(environment())",
+        "  .args <- .args[!vapply(.args, is.null, logical(1))]",
+        "  .args <- .args[names(.args) %in% names(.py_names)]",
+        "  names(.args) <- .py_names[names(.args)]",
+        "  .mod <- .mmlspark_tpu_module()",
+        f'  do.call(.mod$generated_api${cls.__name__}, .args)',
+        "}",
+        "",
+    ]
+    return lines
+
+
+def render_r_api() -> str:
+    classes = _package_stages()
+    lines = [
+        "# GENERATED FILE - do not edit by hand.",
+        "#",
+        "# Regenerate with `python -m mmlspark_tpu.codegen` (the codegen",
+        "# meta-test diffs this file against the registry - SURVEY.md 2.2;",
+        "# the reference's RCodegen emits the same sparklyr-style surface).",
+        "#",
+        "# Each ml_* function constructs the corresponding Python stage via",
+        "# reticulate; fit()/transform() on the returned stage accept R",
+        "# data.frames coerced by reticulate.  NULL arguments are omitted",
+        "# (the stage keeps its Python-side default).",
+        "",
+        ".mmlspark_tpu_env <- new.env(parent = emptyenv())",
+        "",
+        ".mmlspark_tpu_module <- function() {",
+        "  if (is.null(.mmlspark_tpu_env$mod)) {",
+        '    if (!requireNamespace("reticulate", quietly = TRUE)) {',
+        '      stop("mmlspark_tpu R bindings require the reticulate package")',
+        "    }",
+        '    .mmlspark_tpu_env$mod <- reticulate::import("mmlspark_tpu")',
+        "  }",
+        "  .mmlspark_tpu_env$mod",
+        "}",
+        "",
+    ]
+    for cls in classes:
+        lines += _emit_r_function(cls)
+    return "\n".join(lines) + "\n"
+
+
 def generate(repo_root: str | None = None) -> None:
     root = repo_root or os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     api_path = os.path.join(root, "mmlspark_tpu", "generated_api.py")
     test_path = os.path.join(root, "tests", "test_codegen_generated.py")
+    r_path = os.path.join(root, "R", "mmlspark_tpu_generated.R")
+    os.makedirs(os.path.dirname(r_path), exist_ok=True)
     with open(api_path, "w") as f:
         f.write(render_api())
     with open(test_path, "w") as f:
         f.write(render_smoke_tests())
-    print(f"wrote {api_path}\nwrote {test_path}")
+    with open(r_path, "w") as f:
+        f.write(render_r_api())
+    print(f"wrote {api_path}\nwrote {test_path}\nwrote {r_path}")
 
 
 if __name__ == "__main__":
