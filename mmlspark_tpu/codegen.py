@@ -194,8 +194,7 @@ def _emit_r_function(cls) -> List[str]:
     fname = "ml_" + _snake(cls.__name__)
     args, py_names = [], []
     for p in params:
-        d = getattr(p, "default", _NO_DEFAULT)
-        lit = None if type(d).__name__ == "object" else _r_literal(d)
+        lit = _r_literal(p.default) if p.has_default else None
         rname = _snake(p.name)
         args.append(f"{rname} = {lit if lit is not None else 'NULL'}")
         py_names.append(f'{rname} = "{p.name}"')

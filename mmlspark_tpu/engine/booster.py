@@ -681,6 +681,13 @@ _XS_CACHE_MAX = 8
 # per-iteration loop (tests monkeypatch this to force the legacy path).
 _DART_SCAN_MAX_ELS = 128_000_000
 
+# HBM-budget guard for the one-hot leaf-stat/leaf-delta contractions: the
+# (L, n) / (K, L, n) f32 operands buy MXU throughput below this element
+# count and blow HBM above it (a gather serves instead).  At 63 leaves the
+# crossover is n ≈ 2.03M rows/chip — measured in BASELINE.md's r5
+# row-scaling envelope; tests cross it by monkeypatching this constant.
+_ONEHOT_BUDGET_ELS = 128_000_000
+
 # The AOT trace cache engages only for programs big enough that tracing
 # hurts (rows × iterations): exporting costs one extra serialize per
 # first-ever program, which would tax small fits/test suites for no win.
@@ -712,10 +719,17 @@ def resolve_auto_config(cfg: "TrainConfig", n: int, backend: str) -> "TrainConfi
         )
     if cfg.hist_chunk == 0:
         if cfg.hist_backend == "pallas":
-            # one chunk when it fits (fewer scan steps; the kernel's grid
-            # streams row blocks anyway); beyond 4M rows fall back to 1M
-            # chunks so the multiple-of-chunk padding stays ≤ 25%
-            auto_chunk = (1 << 22) if n <= (1 << 22) else (1 << 20)
+            # One chunk when it fits (fewer scan steps; the kernel's grid
+            # streams row blocks anyway).  Beyond 4M rows, 2M chunks when
+            # the multiple-of-chunk padding stays ≤ 12.5%, else 1M —
+            # measured at 8M rows (BASELINE.md r5 envelope): 2M chunks
+            # 0.93 s/iter vs 1.11 (one 4M-chunk pair) vs 1.24 (1M chunks).
+            if n <= (1 << 22):
+                auto_chunk = 1 << 22
+            elif ((-n) % (1 << 21)) <= n // 8:
+                auto_chunk = 1 << 21
+            else:
+                auto_chunk = 1 << 20
         else:
             auto_chunk = DEFAULT_CHUNK
         cfg = dataclasses.replace(cfg, hist_chunk=auto_chunk)
@@ -1341,7 +1355,7 @@ def train(
         top_k=cfg.top_k,
         # classes grow sequentially (lax.map below), so the grower's
         # one-hot stats operand is (L, n) f32 for ONE class at a time
-        onehot_stats=cfg.num_leaves * n <= 128_000_000,
+        onehot_stats=cfg.num_leaves * n <= _ONEHOT_BUDGET_ELS,
     )
 
     def _grow_classes(gcfg_):
@@ -1418,7 +1432,7 @@ def train(
     # The one-hot delta is vmapped over classes, so its operand is
     # (K, L, n) f32 — fall back to the gather when that blows the budget
     # (the gather needs only the (K, n) output).
-    _delta_onehot = K * cfg.num_leaves * n <= 128_000_000
+    _delta_onehot = K * cfg.num_leaves * n <= _ONEHOT_BUDGET_ELS
 
     def _leaf_delta(tree, leaf_ids):
         # delta[k] = leaf_value[k][leaf_ids[k]] as a one-hot contraction:

@@ -108,7 +108,10 @@ class TestRCodegen:
             # every Param appears as a snake_case argument of its function
             body = src.split(f"{fname} <- function", 1)[1].split("\n}\n", 1)[0]
             for p in cls._params.values():
-                assert f"{_snake(p.name)} = " in body, (fname, p.name)
+                # anchored: 'leaves = ' must not false-pass on 'num_leaves = '
+                assert re.search(
+                    rf"^\s*{re.escape(_snake(p.name))} = ", body, re.M
+                ), (fname, p.name)
                 assert f'"{p.name}"' in body, (fname, p.name)
 
     def test_r_source_is_balanced(self):
@@ -117,10 +120,16 @@ class TestRCodegen:
         depth = {"{": 0, "(": 0}
         for line in src.splitlines():
             in_str = None
-            prev = ""
+            escaped = False
             for ch in line:
                 if in_str:
-                    if ch == in_str and prev != "\\":
+                    # escape PARITY, not just the previous char: a string
+                    # ending in an escaped backslash ("...\\\\") closes
+                    if escaped:
+                        escaped = False
+                    elif ch == "\\":
+                        escaped = True
+                    elif ch == in_str:
                         in_str = None
                 elif ch == "#":
                     break  # comment to end of line (R has no block strings here)
@@ -135,6 +144,5 @@ class TestRCodegen:
                 elif ch == ")":
                     depth["("] -= 1
                 assert depth["{"] >= 0 and depth["("] >= 0, line
-                prev = ch
             assert in_str is None, line  # no unterminated string literals
         assert depth == {"{": 0, "(": 0}

@@ -676,3 +676,30 @@ class TestMultiMetric:
             train(dict(objective="binary", num_iterations=4, num_leaves=7,
                        metric="None", early_stopping_round=2),
                   Dataset(X, y), valid_sets=[Dataset(Xv, yv)])
+
+
+class TestOnehotBudgetCrossover:
+    def test_gather_fallback_matches_onehot_path(self, monkeypatch):
+        """HBM-budget guard (BASELINE.md r5 row-scaling envelope): past
+        num_leaves*n = _ONEHOT_BUDGET_ELS the (L, n) one-hot leaf-stat /
+        leaf-delta contractions fall back to gathers.  Both sides of the
+        crossover must train the same model at this (small, fixed
+        summation order) scale — the budget is a memory trade, not a
+        semantics change.  At millions of rows f32 summation-order
+        reassociation can flip near-tie splits, so the large-n gate is
+        quality (AUC gap ~1e-6 measured at 1M rows on TPU — BASELINE.md
+        r5 envelope), like the feature-parallel caveat."""
+        import mmlspark_tpu.engine.booster as bo
+
+        rng = np.random.default_rng(5)
+        X = rng.normal(size=(1500, 6))
+        y = (X[:, 0] - 0.5 * X[:, 1] > 0).astype(np.float64)
+        params = dict(objective="binary", num_iterations=8, num_leaves=15,
+                      min_data_in_leaf=5, max_bin=63)
+        p_onehot = bo.train(params, bo.Dataset(X, y)).predict(X)
+        assert 15 * 1500 <= bo._ONEHOT_BUDGET_ELS  # sanity: was one-hot
+        monkeypatch.setattr(bo, "_ONEHOT_BUDGET_ELS", 0)  # force gathers
+        bo._SCAN_CACHE.clear()
+        p_gather = bo.train(params, bo.Dataset(X, y)).predict(X)
+        bo._SCAN_CACHE.clear()
+        np.testing.assert_allclose(p_onehot, p_gather, rtol=1e-6, atol=1e-7)

@@ -285,3 +285,20 @@ class TestDefaultConfigIsBenchedConfig:
     def test_feature_parallel_stays_exact(self):
         rc = self._resolved("tpu", parallelism="feature_parallel")
         assert rc.split_batch == 0
+
+    def test_auto_chunk_rule(self):
+        # measured at 8M rows (BASELINE.md r5 envelope): one chunk ≤4M;
+        # 2M chunks above when padding ≤12.5%; else 1M
+        from mmlspark_tpu.engine.booster import (
+            TrainConfig, resolve_auto_config,
+        )
+
+        def chunk(n):
+            return resolve_auto_config(
+                TrainConfig(objective="binary"), n=n, backend="tpu"
+            ).hist_chunk
+
+        assert chunk(262_144) == 1 << 22
+        assert chunk(1 << 22) == 1 << 22
+        assert chunk(8_388_608) == 1 << 21   # exact multiple -> 2M
+        assert chunk(5_000_000) == 1 << 20   # 2M padding >12.5% -> 1M

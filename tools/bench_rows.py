@@ -1,0 +1,100 @@
+"""Row-scaling / HBM-capacity envelope on one chip (r4 verdict next #3).
+
+The north star is Criteo-1TB on v5e-32 — O(100M) rows per chip — but
+nothing had ever measured training beyond 262k rows.  This sweeps the
+criteo-schema shape at 1M/2M/4M rows on the real chip at ENGINE DEFAULTS,
+reporting steady s/iter, device peak memory, and which static fallbacks
+engaged (the (L, n) one-hot leaf-stat operands cap at 128M elements —
+`GrowConfig.onehot_stats` / `_delta_onehot` switch to gathers past
+n = 128e6/num_leaves ≈ 2.03M rows at 63 leaves).
+
+Each cell runs in its own subprocess (tunneled-worker crash isolation).
+
+Run: python tools/bench_rows.py [rows ...]
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+_CELL = r"""
+import json, sys, time
+sys.path.insert(0, ".")
+import numpy as np
+
+N = int(sys.argv[1])
+ITERS = int(sys.argv[2])
+
+rng = np.random.default_rng(11)
+N_NUM, N_CAT = 13, 26
+Xn = rng.normal(size=(N, N_NUM)).astype(np.float32)
+cards = rng.integers(4, 200, size=N_CAT)
+Xc = np.column_stack([rng.integers(0, c, size=N) for c in cards])
+logits = (Xn @ (rng.normal(size=N_NUM) * 0.5).astype(np.float32)
+          + 0.8 * (Xc[:, 0] % 5 == 2) - 0.6 * (Xc[:, 1] % 7 == 3))
+y = (logits + rng.logistic(size=N).astype(np.float32) > 0).astype(np.float64)
+X = np.column_stack([Xn.astype(np.float64), Xc.astype(np.float64)])
+del Xn, Xc, logits
+
+from mmlspark_tpu.engine.booster import Dataset, train
+from mmlspark_tpu.ops.binning import BinMapper
+import jax
+
+cats = tuple(range(N_NUM, N_NUM + N_CAT))
+t0 = time.perf_counter()
+bm = BinMapper(max_bin=255, categorical_features=cats).fit(X)
+ds = Dataset(X, y)
+ds.binned(bm)
+bin_s = time.perf_counter() - t0
+
+params = dict(objective="binary", num_iterations=ITERS, num_leaves=63,
+              max_bin=255, min_data_in_leaf=20, learning_rate=0.1,
+              categorical_feature=list(cats))
+walls = []
+b = None
+for i in range(3):
+    t0 = time.perf_counter()
+    b = train(params, ds, bin_mapper=bm)
+    np.asarray(b.trees.num_leaves)
+    w = time.perf_counter() - t0
+    if i:
+        walls.append(w)
+mem = {}
+try:
+    ms = jax.local_devices()[0].memory_stats() or {}
+    mem = {k: int(v) for k, v in ms.items()
+           if k in ("bytes_in_use", "peak_bytes_in_use", "bytes_limit")}
+except Exception:
+    pass
+rc = b.config
+print(json.dumps(dict(
+    rows=N, iters=ITERS, bin_s=round(bin_s, 2),
+    steady_s=round(min(walls), 3),
+    s_per_iter=round(min(walls) / ITERS, 4),
+    onehot_stats=bool(63 * (N if N % (1 << 20) == 0 else N) <= 128_000_000),
+    hist_chunk=rc.hist_chunk, split_batch=rc.split_batch,
+    mem=mem,
+)))
+"""
+
+
+def main():
+    rows = [int(a) for a in sys.argv[1:]] or [1 << 20, 1 << 21, 1 << 22]
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    for n in rows:
+        iters = 20
+        r = subprocess.run(
+            [sys.executable, "-c", _CELL, str(n), str(iters)],
+            capture_output=True, text=True, timeout=1800, cwd=repo,
+        )
+        if r.returncode != 0:
+            print(json.dumps(dict(rows=n, crashed=True,
+                                  tail=r.stderr.strip().splitlines()[-1:])),
+                  flush=True)
+            continue
+        print(r.stdout.strip().splitlines()[-1], flush=True)
+
+
+if __name__ == "__main__":
+    main()
