@@ -1909,7 +1909,7 @@ ml_k_n_n_model <- function(
 #' @param raw_prediction_col Raw margin output column
 #' @param seed Master random seed
 #' @param slot_names Feature vector slot names
-#' @param split_batch k-batched best-first growth: apply up to k best splits per histogram pass (0 = auto: ~12 on the TPU lossguide path — the benchmarked default, see BASELINE.md — policy default elsewhere; 1 = exact lossguide; -1 = never batch)
+#' @param split_batch k-batched best-first growth: apply up to k best splits per histogram pass (0 = auto: 8 on the TPU lossguide path — the benchmarked default, see BASELINE.md — policy default elsewhere; 1 = exact lossguide; -1 = never batch)
 #' @param thresholds Per-class prediction thresholds
 #' @param timeout Distributed initialization timeout in seconds
 #' @param top_k Top-k features voted per worker in voting_parallel
@@ -2070,7 +2070,7 @@ ml_light_g_b_m_classification_model <- function(
 #' @param raw_prediction_col Raw margin output column
 #' @param seed Master random seed
 #' @param slot_names Feature vector slot names
-#' @param split_batch k-batched best-first growth: apply up to k best splits per histogram pass (0 = auto: ~12 on the TPU lossguide path — the benchmarked default, see BASELINE.md — policy default elsewhere; 1 = exact lossguide; -1 = never batch)
+#' @param split_batch k-batched best-first growth: apply up to k best splits per histogram pass (0 = auto: 8 on the TPU lossguide path — the benchmarked default, see BASELINE.md — policy default elsewhere; 1 = exact lossguide; -1 = never batch)
 #' @param thresholds Per-class prediction thresholds
 #' @param timeout Distributed initialization timeout in seconds
 #' @param top_k Top-k features voted per worker in voting_parallel
@@ -2232,7 +2232,7 @@ ml_light_g_b_m_classifier <- function(
 #' @param repartition_by_grouping_column Keep each query group within one worker shard
 #' @param seed Master random seed
 #' @param slot_names Feature vector slot names
-#' @param split_batch k-batched best-first growth: apply up to k best splits per histogram pass (0 = auto: ~12 on the TPU lossguide path — the benchmarked default, see BASELINE.md — policy default elsewhere; 1 = exact lossguide; -1 = never batch)
+#' @param split_batch k-batched best-first growth: apply up to k best splits per histogram pass (0 = auto: 8 on the TPU lossguide path — the benchmarked default, see BASELINE.md — policy default elsewhere; 1 = exact lossguide; -1 = never batch)
 #' @param timeout Distributed initialization timeout in seconds
 #' @param top_k Top-k features voted per worker in voting_parallel
 #' @param use_barrier_execution_mode Gang-schedule training (the SPMD program launch is inherently gang-scheduled on TPU; kept for API parity)
@@ -2393,7 +2393,7 @@ ml_light_g_b_m_ranker <- function(
 #' @param prediction_col The name of the prediction column
 #' @param seed Master random seed
 #' @param slot_names Feature vector slot names
-#' @param split_batch k-batched best-first growth: apply up to k best splits per histogram pass (0 = auto: ~12 on the TPU lossguide path — the benchmarked default, see BASELINE.md — policy default elsewhere; 1 = exact lossguide; -1 = never batch)
+#' @param split_batch k-batched best-first growth: apply up to k best splits per histogram pass (0 = auto: 8 on the TPU lossguide path — the benchmarked default, see BASELINE.md — policy default elsewhere; 1 = exact lossguide; -1 = never batch)
 #' @param timeout Distributed initialization timeout in seconds
 #' @param top_k Top-k features voted per worker in voting_parallel
 #' @param use_barrier_execution_mode Gang-schedule training (the SPMD program launch is inherently gang-scheduled on TPU; kept for API parity)
@@ -2546,7 +2546,7 @@ ml_light_g_b_m_ranker_model <- function(
 #' @param prediction_col The name of the prediction column
 #' @param seed Master random seed
 #' @param slot_names Feature vector slot names
-#' @param split_batch k-batched best-first growth: apply up to k best splits per histogram pass (0 = auto: ~12 on the TPU lossguide path — the benchmarked default, see BASELINE.md — policy default elsewhere; 1 = exact lossguide; -1 = never batch)
+#' @param split_batch k-batched best-first growth: apply up to k best splits per histogram pass (0 = auto: 8 on the TPU lossguide path — the benchmarked default, see BASELINE.md — policy default elsewhere; 1 = exact lossguide; -1 = never batch)
 #' @param timeout Distributed initialization timeout in seconds
 #' @param top_k Top-k features voted per worker in voting_parallel
 #' @param use_barrier_execution_mode Gang-schedule training (the SPMD program launch is inherently gang-scheduled on TPU; kept for API parity)
@@ -2699,7 +2699,7 @@ ml_light_g_b_m_regression_model <- function(
 #' @param prediction_col The name of the prediction column
 #' @param seed Master random seed
 #' @param slot_names Feature vector slot names
-#' @param split_batch k-batched best-first growth: apply up to k best splits per histogram pass (0 = auto: ~12 on the TPU lossguide path — the benchmarked default, see BASELINE.md — policy default elsewhere; 1 = exact lossguide; -1 = never batch)
+#' @param split_batch k-batched best-first growth: apply up to k best splits per histogram pass (0 = auto: 8 on the TPU lossguide path — the benchmarked default, see BASELINE.md — policy default elsewhere; 1 = exact lossguide; -1 = never batch)
 #' @param timeout Distributed initialization timeout in seconds
 #' @param top_k Top-k features voted per worker in voting_parallel
 #' @param tweedie_variance_power Tweedie variance power (1..2)

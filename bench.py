@@ -19,9 +19,10 @@ BASELINE.md).  AUC parity is GATED at ±0.005 (headline target ≤0.002): if
 the gap exceeds it, ``vs_baseline`` is reported as 0.0 (a speedup at
 degraded quality never counts).  Details go to stderr, never stdout.
 
-Growth config: best-first (lossguide) growth with ``split_batch=12`` — up
-to 12 best-first splits applied per windowed histogram pass (r3 ablation,
-tools/profile_k.py).  Categorical splits run UNCAPPED set sizes (engine
+Growth config: best-first (lossguide) growth at the ENGINE DEFAULT
+``split_batch`` auto-resolution (r5: k=8 best-first splits per windowed
+histogram pass — the r5 k-sweep found it matches k=12's wall inside run
+variance while recovering 2-7e-4 train-AUC; BASELINE.md defaults table).  Categorical splits run UNCAPPED set sizes (engine
 default ``max_cat_threshold=0`` = auto: the vectorized TPU candidate scan
 evaluates every sorted prefix anyway; LightGBM's 32-cap is a CPU-cost
 artifact that costs ~0.009 AUC at these cardinalities).
@@ -110,7 +111,7 @@ def bench_config(categorical_feature=()):
     # ENGINE DEFAULTS, for real (r4 verdict: the benchmarked config must
     # be what a default fit() runs).  grow_policy/split_batch/hist_backend/
     # hist_chunk/hist_precision all ride the engine's auto-resolution:
-    # on TPU that lands pallas + one-chunk + split_batch=12 + bf16
+    # on TPU that lands pallas + one-chunk + split_batch=8 + bf16
     # histograms; the resolved knobs are asserted and reported by main().
     del jax  # only problem params below — nothing backend-conditional
     return dict(
@@ -173,7 +174,7 @@ def bench_tpu(X, y, categorical_feature=(), tag="tpu"):
     _log(f"[{tag}] {resolved}")
     if jax.default_backend() == "tpu":
         assert rc.hist_backend == "pallas", rc.hist_backend
-        assert rc.split_batch == 12, rc.split_batch
+        assert rc.split_batch == 8, rc.split_batch
         assert rc.hist_precision == "default", rc.hist_precision
     _log(
         f"[{tag}] train: cold(incl. compile+upload)={cold:.2f}s "

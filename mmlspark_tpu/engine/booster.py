@@ -158,6 +158,16 @@ class TrainConfig:
     # checkpoint's own vocabulary, §5.4 "resume = load tree array + rebin").
     checkpoint_dir: Optional[str] = None
     checkpoint_every: int = 0
+    # Cap on boosting iterations per DEVICE DISPATCH (0 = uncapped: the
+    # whole run is one scan dispatch when nothing else chunks it).
+    # Chunking is pure dispatch granularity — the scan state carries
+    # across chunks, so results are identical.  Set it when a very long
+    # single dispatch is undesirable: remote-dispatch links can kill
+    # multi-minute dispatches (BASELINE.md r5: the 50-iter exact-lossguide
+    # catmix program reproducibly crashed the tunneled worker; 10-iter
+    # chunks ran fine), and finer chunks also bound time-to-first-
+    # checkpoint and keep-alive behavior.
+    scan_dispatch_iters: int = 0
     verbosity: int = 1
 
     _ALIASES = {
@@ -694,12 +704,13 @@ _ONEHOT_BUDGET_ELS = 128_000_000
 _TRACE_CACHE_MIN_WORK = 1 << 21
 
 # split_batch="auto" (0) resolution on the TPU pallas lossguide path.
-# Swept on the criteo-schema bench shape (262k x 39, 63 leaves): 12 best
-# splits per histogram pass lands leaf-wise quality (AUC gap vs exact
-# ≤1e-3, inside run noise) at ~6x fewer passes; larger batches stop
-# helping (the pass count bottoms out near num_leaves/split_batch) and
-# smaller ones leave wall-clock on the table.  BASELINE.md r5 table.
-_AUTO_SPLIT_BATCH = 12
+# Swept on BOTH bench shapes (262k rows, 63 leaves, BASELINE.md r5
+# defaults table + k-sweep): k=8 matches k=12's wall inside run variance
+# (catmix 1.33 vs 1.34 s; numeric 1.37 vs 1.34 s) while recovering
+# +2e-4 (numeric) to +7e-4 (catmix) train-AUC — halving the batching
+# trade vs exact lossguide.  Larger k is strictly worse (k=16: 1.46 s
+# AND -1.5e-3 AUC; k=24: 2.24 s), smaller k pays wall (k=6: 1.65 s).
+_AUTO_SPLIT_BATCH = 8
 
 
 def resolve_auto_config(cfg: "TrainConfig", n: int, backend: str) -> "TrainConfig":
@@ -829,7 +840,7 @@ def _hashable(v):
 # reuse the compiled program (scan length retraces by shape anyway).
 _CACHE_KEY_EXCLUDE = frozenset(
     {"num_iterations", "checkpoint_dir", "checkpoint_every", "verbosity",
-     "metric", "early_stopping_round"}
+     "metric", "early_stopping_round", "scan_dispatch_iters"}
 )
 
 
@@ -2028,6 +2039,8 @@ def train(
             chunk_iters = n_iter
         if ckpt_path is not None:
             chunk_iters = min(chunk_iters, max(cfg.checkpoint_every, 1))
+        if cfg.scan_dispatch_iters > 0:
+            chunk_iters = min(chunk_iters, cfg.scan_dispatch_iters)
         ckpt_host_chunks: List[Tree] = []  # fetched once per chunk, reused
 
         def _write_snapshot(booster_snap):

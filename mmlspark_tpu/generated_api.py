@@ -1182,7 +1182,7 @@ class LightGBMClassificationModel(_LightGBMClassificationModel):
       rawPredictionCol: Raw margin output column
       seed: Master random seed
       slotNames: Feature vector slot names
-      splitBatch: k-batched best-first growth: apply up to k best splits per histogram pass (0 = auto: ~12 on the TPU lossguide path — the benchmarked default, see BASELINE.md — policy default elsewhere; 1 = exact lossguide; -1 = never batch)
+      splitBatch: k-batched best-first growth: apply up to k best splits per histogram pass (0 = auto: 8 on the TPU lossguide path — the benchmarked default, see BASELINE.md — policy default elsewhere; 1 = exact lossguide; -1 = never batch)
       thresholds: Per-class prediction thresholds
       timeout: Distributed initialization timeout in seconds
       topK: Top-k features voted per worker in voting_parallel
@@ -1243,7 +1243,7 @@ class LightGBMClassifier(_LightGBMClassifier):
       rawPredictionCol: Raw margin output column
       seed: Master random seed
       slotNames: Feature vector slot names
-      splitBatch: k-batched best-first growth: apply up to k best splits per histogram pass (0 = auto: ~12 on the TPU lossguide path — the benchmarked default, see BASELINE.md — policy default elsewhere; 1 = exact lossguide; -1 = never batch)
+      splitBatch: k-batched best-first growth: apply up to k best splits per histogram pass (0 = auto: 8 on the TPU lossguide path — the benchmarked default, see BASELINE.md — policy default elsewhere; 1 = exact lossguide; -1 = never batch)
       thresholds: Per-class prediction thresholds
       timeout: Distributed initialization timeout in seconds
       topK: Top-k features voted per worker in voting_parallel
@@ -1307,7 +1307,7 @@ class LightGBMRanker(_LightGBMRanker):
       repartitionByGroupingColumn: Keep each query group within one worker shard
       seed: Master random seed
       slotNames: Feature vector slot names
-      splitBatch: k-batched best-first growth: apply up to k best splits per histogram pass (0 = auto: ~12 on the TPU lossguide path — the benchmarked default, see BASELINE.md — policy default elsewhere; 1 = exact lossguide; -1 = never batch)
+      splitBatch: k-batched best-first growth: apply up to k best splits per histogram pass (0 = auto: 8 on the TPU lossguide path — the benchmarked default, see BASELINE.md — policy default elsewhere; 1 = exact lossguide; -1 = never batch)
       timeout: Distributed initialization timeout in seconds
       topK: Top-k features voted per worker in voting_parallel
       useBarrierExecutionMode: Gang-schedule training (the SPMD program launch is inherently gang-scheduled on TPU; kept for API parity)
@@ -1366,7 +1366,7 @@ class LightGBMRankerModel(_LightGBMRankerModel):
       predictionCol: The name of the prediction column
       seed: Master random seed
       slotNames: Feature vector slot names
-      splitBatch: k-batched best-first growth: apply up to k best splits per histogram pass (0 = auto: ~12 on the TPU lossguide path — the benchmarked default, see BASELINE.md — policy default elsewhere; 1 = exact lossguide; -1 = never batch)
+      splitBatch: k-batched best-first growth: apply up to k best splits per histogram pass (0 = auto: 8 on the TPU lossguide path — the benchmarked default, see BASELINE.md — policy default elsewhere; 1 = exact lossguide; -1 = never batch)
       timeout: Distributed initialization timeout in seconds
       topK: Top-k features voted per worker in voting_parallel
       useBarrierExecutionMode: Gang-schedule training (the SPMD program launch is inherently gang-scheduled on TPU; kept for API parity)
@@ -1425,7 +1425,7 @@ class LightGBMRegressionModel(_LightGBMRegressionModel):
       predictionCol: The name of the prediction column
       seed: Master random seed
       slotNames: Feature vector slot names
-      splitBatch: k-batched best-first growth: apply up to k best splits per histogram pass (0 = auto: ~12 on the TPU lossguide path — the benchmarked default, see BASELINE.md — policy default elsewhere; 1 = exact lossguide; -1 = never batch)
+      splitBatch: k-batched best-first growth: apply up to k best splits per histogram pass (0 = auto: 8 on the TPU lossguide path — the benchmarked default, see BASELINE.md — policy default elsewhere; 1 = exact lossguide; -1 = never batch)
       timeout: Distributed initialization timeout in seconds
       topK: Top-k features voted per worker in voting_parallel
       useBarrierExecutionMode: Gang-schedule training (the SPMD program launch is inherently gang-scheduled on TPU; kept for API parity)
@@ -1484,7 +1484,7 @@ class LightGBMRegressor(_LightGBMRegressor):
       predictionCol: The name of the prediction column
       seed: Master random seed
       slotNames: Feature vector slot names
-      splitBatch: k-batched best-first growth: apply up to k best splits per histogram pass (0 = auto: ~12 on the TPU lossguide path — the benchmarked default, see BASELINE.md — policy default elsewhere; 1 = exact lossguide; -1 = never batch)
+      splitBatch: k-batched best-first growth: apply up to k best splits per histogram pass (0 = auto: 8 on the TPU lossguide path — the benchmarked default, see BASELINE.md — policy default elsewhere; 1 = exact lossguide; -1 = never batch)
       timeout: Distributed initialization timeout in seconds
       topK: Top-k features voted per worker in voting_parallel
       tweedieVariancePower: Tweedie variance power (1..2)

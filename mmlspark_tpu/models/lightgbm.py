@@ -152,7 +152,7 @@ class _LightGBMParams(
     splitBatch = Param(
         "splitBatch",
         "k-batched best-first growth: apply up to k best splits per "
-        "histogram pass (0 = auto: ~12 on the TPU lossguide path — the "
+        "histogram pass (0 = auto: 8 on the TPU lossguide path — the "
         "benchmarked default, see BASELINE.md — policy default elsewhere; "
         "1 = exact lossguide; -1 = never batch)",
         default=0, dtype=int,

@@ -703,3 +703,29 @@ class TestOnehotBudgetCrossover:
         p_gather = bo.train(params, bo.Dataset(X, y)).predict(X)
         bo._SCAN_CACHE.clear()
         np.testing.assert_allclose(p_onehot, p_gather, rtol=1e-6, atol=1e-7)
+
+
+class TestScanDispatchIters:
+    def test_chunked_dispatch_is_bitwise_identical(self):
+        """scan_dispatch_iters caps iterations per device dispatch; the
+        scan state carries across chunks, so chunking is pure dispatch
+        granularity — bitwise-identical models (the workaround for
+        remote links that kill very long dispatches, BASELINE.md r5)."""
+        rng = np.random.default_rng(8)
+        X = rng.normal(size=(1200, 6))
+        y = (X[:, 0] - 0.4 * X[:, 1] > 0).astype(np.float64)
+        base = dict(objective="binary", num_iterations=12, num_leaves=15,
+                    min_data_in_leaf=5, max_bin=63)
+        p_full = train(base, Dataset(X, y)).predict(X)
+        p_chunk = train(dict(base, scan_dispatch_iters=5),
+                        Dataset(X, y)).predict(X)
+        np.testing.assert_array_equal(p_full, p_chunk)
+        # composes with eval/early stopping
+        b = train(dict(base, scan_dispatch_iters=4, metric="auc",
+                       early_stopping_round=3),
+                  Dataset(X[:900], y[:900]),
+                  valid_sets=[Dataset(X[900:], y[900:])])
+        b2 = train(dict(base, metric="auc", early_stopping_round=3),
+                   Dataset(X[:900], y[:900]),
+                   valid_sets=[Dataset(X[900:], y[900:])])
+        assert b.best_iteration == b2.best_iteration
