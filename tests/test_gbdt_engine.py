@@ -721,7 +721,7 @@ class TestScanDispatchIters:
                         Dataset(X, y)).predict(X)
         np.testing.assert_array_equal(p_full, p_chunk)
         # composes with eval/early stopping
-        b = train(dict(base, scan_dispatch_iters=4, metric="auc",
+        b = train(dict(base, scan_dispatch_iters=2, metric="auc",
                        early_stopping_round=3),
                   Dataset(X[:900], y[:900]),
                   valid_sets=[Dataset(X[900:], y[900:])])

@@ -243,7 +243,7 @@ class TestRankerEvalAt:
 class TestDefaultConfigIsBenchedConfig:
     """r4 verdict weak #1: the default configuration must BE the
     benchmarked configuration — a bare facade fit() on TPU lands on the
-    headline path (pallas + split_batch=12 + bf16 histograms) with no
+    headline path (pallas + split_batch=8 + bf16 histograms) with no
     opt-in knobs, while CPU keeps the scatter-exact oracle numerics."""
 
     def _resolved(self, backend, **overrides):

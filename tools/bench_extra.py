@@ -186,15 +186,9 @@ def bench_ranker():
     params = dict(
         objective="lambdarank", num_iterations=50, num_leaves=63,
         max_bin=255, min_data_in_leaf=20, learning_rate=0.1,
-        grow_policy="lossguide", split_batch=12,
-    )
-    import jax
-    if jax.default_backend() == "tpu":
-        # Same precision protocol as bench.py: bf16 multiplies / f32
-        # accumulation.  Measured NDCG@5 0.8323 bf16 vs 0.8303 f32 at this
-        # config — the quality check below is the gate either way.
-        params.update(hist_backend="pallas", hist_chunk=n,
-                      hist_precision="default")
+    )  # growth/precision knobs ride the engine auto-resolution (r5);
+    # measured NDCG@5 0.8323 bf16 vs 0.8303 f32 at this config — the
+    # quality check below is the gate either way.
     ds = Dataset(X, y, group=group)
     t0 = time.perf_counter()
     booster = train(params, ds)
@@ -257,9 +251,6 @@ def bench_catmix():
     from mmlspark_tpu.engine.booster import Dataset, train
 
     X, y, cat_idx = make_catmix_data()
-    n = len(y)
-
-    import jax
 
     params = dict(
         objective="binary", num_iterations=50, num_leaves=63, max_bin=255,
@@ -268,11 +259,7 @@ def bench_catmix():
         # engine defaults: max_cat_threshold=0 = auto/uncapped (the
         # vectorized candidate scan evaluates every sorted prefix anyway;
         # LightGBM's 32-cap is a CPU-cost artifact costing ~0.009 AUC here)
-        grow_policy="lossguide", split_batch=12,
-    )
-    if jax.default_backend() == "tpu":
-        params.update(hist_backend="pallas", hist_chunk=n,
-                      hist_precision="default")
+    )  # growth/precision knobs ride the engine auto-resolution (r5)
     ds = Dataset(X, y)
     t0 = time.perf_counter()
     booster = train(params, ds)
@@ -365,8 +352,7 @@ def bench_adult():
     })
     est = LightGBMClassifier(
         numIterations=100, numLeaves=31, categoricalSlotIndexes=cat_idx,
-        splitBatch=8,
-    )
+    )  # splitBatch rides the auto default (r5)
     t0 = time.perf_counter()
     model = est.fit(df)  # COLD facade fit (warm persistent compile cache)
     _sync_booster(model.getBooster())
