@@ -85,6 +85,18 @@ def _bind_binner(lib):
         c_u8_p, ctypes.c_int,
     ]
     lib.mml_binner_transform.restype = None
+    # Optional symbol (r5): a cached pre-r5 .so must only lose the cat
+    # kernel (numpy cats + C++ numerics), not the whole library.
+    cat_fn = getattr(lib, "mml_binner_transform_cat", None)
+    if cat_fn is not None:
+        c_long_p = ctypes.POINTER(ctypes.c_long)
+        c_ll_p = ctypes.POINTER(ctypes.c_longlong)
+        cat_fn.argtypes = [
+            c_double_p, ctypes.c_long, ctypes.c_long,
+            c_long_p, ctypes.c_long, c_ll_p, c_long_p,
+            ctypes.c_int, c_u8_p, ctypes.c_int,
+        ]
+        cat_fn.restype = None
 
 
 def get_binner_lib():

@@ -197,4 +197,75 @@ void mml_binner_transform(const double* X, long n, long F,
   });
 }
 
+// Bin CATEGORICAL columns: out[i, f] = index of the exact match of
+// (long long)X[i, f] in that column's sorted category array, else
+// missing_bin; NaN → missing_bin.  Matches the numpy reference pass
+// (searchsorted "left" + equality check) bit for bit.  Same branchless
+// fixed-depth search as the numeric transform — on the criteo-schema
+// shapes the 26 categorical columns were the ~10.8 s/4M-row numpy tail
+// of Dataset construction (r5 profile), vs ~1.2 s for the 13 numeric
+// columns through this kernel.
+//
+// cols[k] (k < n_cols): feature index of the k-th categorical column.
+// cat_vals: concatenated per-column sorted int64 category values;
+// cat_off[k]..cat_off[k+1] delimits column k's slice.
+void mml_binner_transform_cat(const double* X, long n, long F,
+                              const long* cols, long n_cols,
+                              const long long* cat_vals, const long* cat_off,
+                              int missing_bin, uint8_t* out, int n_threads) {
+  // Padded (power-of-two, +max-sentinel) per-column bounds, prebuilt once:
+  // all columns' tables total ≲ n_cols * max_bin * 8 B (tens of KB), so
+  // they stay cache-hot while the ROW-MAJOR loop below streams X exactly
+  // once — the column-major variant re-streamed the full matrix per
+  // column (26 strided passes on the criteo schema) and measured ~2x
+  // slower at 4M rows.
+  std::vector<long long> padded;
+  std::vector<long> off(static_cast<size_t>(n_cols) + 1, 0);
+  std::vector<long> pow2(static_cast<size_t>(n_cols), 0);
+  for (long k = 0; k < n_cols; ++k) {
+    const long m = cat_off[k + 1] - cat_off[k];
+    long P = m > 0 ? 1 : 0;
+    while (P < m) P <<= 1;
+    pow2[k] = P;
+    off[k + 1] = off[k] + P;
+  }
+  padded.assign(static_cast<size_t>(off[n_cols]),
+                std::numeric_limits<long long>::max());
+  for (long k = 0; k < n_cols; ++k) {
+    std::copy(cat_vals + cat_off[k], cat_vals + cat_off[k + 1],
+              padded.begin() + off[k]);
+  }
+  parallel_over(n, n_threads, [&](long i0, long i1) {
+    for (long i = i0; i < i1; ++i) {
+      const double* row = X + i * F;
+      uint8_t* orow = out + i * F;
+      for (long k = 0; k < n_cols; ++k) {
+        const long m = cat_off[k + 1] - cat_off[k];
+        if (m <= 0) continue;
+        const long f = cols[k];
+        const double x = row[f];
+        if (std::isnan(x)) {
+          orow[f] = static_cast<uint8_t>(missing_bin);
+          continue;
+        }
+        // numpy's astype(int64) on x86 (cvttsd2si): out-of-range and
+        // non-finite convert to INT64_MIN — the fit-time tables are built
+        // through the same cast, so transform must match it (a plain
+        // static_cast is UB out of range).
+        const long long v =
+            (x >= 9223372036854775808.0 || x < -9223372036854775808.0)
+                ? std::numeric_limits<long long>::min()
+                : static_cast<long long>(x);
+        const long long* pb = padded.data() + off[k];
+        long j = 0;
+        for (long step = pow2[k] >> 1; step > 0; step >>= 1) {
+          j += (pb[j + step - 1] < v) ? step : 0;
+        }
+        const bool hit = (j < m) && (pb[j] == v);
+        orow[f] = static_cast<uint8_t>(hit ? j : missing_bin);
+      }
+    }
+  });
+}
+
 }  // extern "C"

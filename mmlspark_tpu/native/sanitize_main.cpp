@@ -24,6 +24,9 @@ void mml_binner_fit(const double*, long, long, int, int, const uint8_t*,
                     double*, int*, int);
 void mml_binner_transform(const double*, long, long, const double*,
                           const int*, int, int, uint8_t*, int);
+void mml_binner_transform_cat(const double*, long, long, const long*, long,
+                              const long long*, const long*, int, uint8_t*,
+                              int);
 }
 
 namespace {
@@ -90,6 +93,49 @@ int run_case(long n, long F, int max_bin, int threads) {
   return 0;
 }
 
+// Categorical transform: ragged category tables (incl. an EMPTY one),
+// NaN / unseen / negative values, row-parallel thread splits.
+int run_cat_case(long n, long n_cols, int threads) {
+  const long F = n_cols + 1;  // one numeric column left untouched
+  std::vector<double> X(static_cast<size_t>(n) * F);
+  std::vector<long> cols(static_cast<size_t>(n_cols));
+  std::vector<long long> vals;
+  std::vector<long> off(static_cast<size_t>(n_cols) + 1, 0);
+  for (long k = 0; k < n_cols; ++k) {
+    cols[k] = k;  // cat columns first, numeric last
+    const long m = (k % 5 == 3) ? 0 : 1 + (k * 7) % 40;  // one empty table
+    for (long j = 0; j < m; ++j)
+      vals.push_back(static_cast<long long>(j * 3 - 5));  // negatives too
+    off[k + 1] = off[k] + m;
+  }
+  for (long i = 0; i < n; ++i) {
+    for (long k = 0; k < n_cols; ++k) {
+      const double r = urand();
+      if (r < 0.05) X[i * F + k] = std::nan("");
+      else if (r < 0.15) X[i * F + k] = 1e6;  // unseen category
+      else X[i * F + k] = std::floor(r * 120.0) * 3 - 5;
+    }
+    X[i * F + n_cols] = urand();
+  }
+  const int missing = 254;
+  std::vector<uint8_t> out(static_cast<size_t>(n) * F, 255);
+  mml_binner_transform_cat(X.data(), n, F, cols.data(), n_cols, vals.data(),
+                           off.data(), missing, out.data(), threads);
+  for (long i = 0; i < n; ++i) {
+    for (long k = 0; k < n_cols; ++k) {
+      const long m = off[k + 1] - off[k];
+      const uint8_t b = out[static_cast<size_t>(i) * F + k];
+      if (m == 0) {
+        if (b != 255) return 10;  // empty table -> untouched by contract
+        continue;
+      }
+      if (b != missing && b >= m) return 11;
+    }
+    if (out[static_cast<size_t>(i) * F + n_cols] != 255) return 12;
+  }
+  return 0;
+}
+
 }  // namespace
 
 int main() {
@@ -108,6 +154,20 @@ int main() {
     if (rc != 0) {
       std::fprintf(stderr, "case n=%ld F=%ld max_bin=%d threads=%d -> %d\n",
                    c.n, c.F, c.max_bin, c.threads, rc);
+      return rc;
+    }
+  }
+  struct {
+    long n, n_cols;
+    int threads;
+  } cat_cases[] = {
+      {1, 1, 1}, {997, 6, 1}, {5000, 26, 4}, {20000, 9, 16},
+  };
+  for (auto& c : cat_cases) {
+    int rc = run_cat_case(c.n, c.n_cols, c.threads);
+    if (rc != 0) {
+      std::fprintf(stderr, "cat case n=%ld cols=%ld threads=%d -> %d\n",
+                   c.n, c.n_cols, c.threads, rc);
       return rc;
     }
   }

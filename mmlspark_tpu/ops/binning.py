@@ -171,17 +171,25 @@ class BinMapper:
             raise ValueError(f"expected {self.num_features} features, got {F}")
         dtype = np.uint8 if self.num_bins <= 256 else np.int32
         cat_set = set(self.categorical_features)
-        out = self._transform_native(X, cat_set) if dtype == np.uint8 else None
+        out, cats_native = (
+            self._transform_native(X, cat_set)
+            if dtype == np.uint8 else (None, False)
+        )
         native = out is not None
         if out is None:
             out = np.empty((n, F), dtype=dtype)
         for f in range(F):
-            if native and f not in cat_set:
-                continue  # the C++ pass already binned this feature
+            if native and (f not in cat_set or cats_native):
+                continue  # the C++ passes already binned this feature
             col = X[:, f]
             nan = np.isnan(col)
             if f in cat_set:
-                cats = self.cat_maps[f]
+                cats = self.cat_maps.get(f, np.empty(0, np.int64))
+                if len(cats) == 0:
+                    # a column with NO fitted categories (e.g. all-NaN at
+                    # fit time) is all-missing by definition
+                    out[:, f] = self.missing_bin
+                    continue
                 pos = np.searchsorted(cats, col.astype(np.int64), side="left")
                 pos_c = np.clip(pos, 0, len(cats) - 1)
                 hit = (pos < len(cats)) & (cats[pos_c] == col.astype(np.int64)) & ~nan
@@ -191,14 +199,18 @@ class BinMapper:
                 out[:, f] = np.where(nan, self.missing_bin, bins).astype(dtype)
         return out
 
-    def _transform_native(self, X: np.ndarray, cat_set) -> Optional[np.ndarray]:
-        """Threaded C++ transform of the numeric features; categorical
-        columns are left for the caller's numpy pass.  None → full numpy."""
+    def _transform_native(self, X: np.ndarray, cat_set):
+        """Threaded C++ transform: numeric columns via the boundary-search
+        kernel, categorical columns via the sorted-category exact-match
+        kernel (the 26 criteo-schema cat columns were a ~10.8 s/4M-row
+        numpy tail — BASELINE.md r5 ingestion note).  Returns
+        (out | None, cats_handled): (None, False) → full numpy fallback;
+        cats_handled=False → the caller's numpy pass bins the cats."""
         from mmlspark_tpu.native import default_threads, get_binner_lib
 
         lib = get_binner_lib()
         if lib is None:
-            return None
+            return None, False
         import ctypes
 
         X = np.ascontiguousarray(X, dtype=np.float64)
@@ -212,6 +224,7 @@ class BinMapper:
             counts[f] = len(ub)
             uppers[f, : len(ub)] = ub
         out = np.empty((n, F), np.uint8)
+        threads = ctypes.c_int(self.threads or default_threads())
         lib.mml_binner_transform(
             X.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
             ctypes.c_long(n), ctypes.c_long(F),
@@ -219,9 +232,34 @@ class BinMapper:
             counts.ctypes.data_as(ctypes.POINTER(ctypes.c_int)),
             ctypes.c_int(self.max_bin), ctypes.c_int(self.missing_bin),
             out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
-            ctypes.c_int(self.threads or default_threads()),
+            threads,
         )
-        return out
+        cats = sorted(f for f in cat_set if 0 <= f < F)
+        if not cats or not hasattr(lib, "mml_binner_transform_cat"):
+            return out, not cats
+        # columns with NO fitted categories (all-NaN at fit time) are
+        # all-missing by definition; the C++ kernel skips m==0 tables, so
+        # fill them here (out starts uninitialized)
+        maps = {f: np.asarray(self.cat_maps.get(f, ()), np.int64) for f in cats}
+        for f in cats:
+            if len(maps[f]) == 0:
+                out[:, f] = self.missing_bin
+        cols = np.asarray(cats, dtype=np.int64)
+        cat_vals = np.concatenate([maps[f] for f in cats])
+        cat_off = np.zeros(len(cats) + 1, np.int64)
+        np.cumsum([len(maps[f]) for f in cats], out=cat_off[1:])
+        lib.mml_binner_transform_cat(
+            X.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+            ctypes.c_long(n), ctypes.c_long(F),
+            cols.ctypes.data_as(ctypes.POINTER(ctypes.c_long)),
+            ctypes.c_long(len(cats)),
+            cat_vals.ctypes.data_as(ctypes.POINTER(ctypes.c_longlong)),
+            cat_off.ctypes.data_as(ctypes.POINTER(ctypes.c_long)),
+            ctypes.c_int(self.missing_bin),
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+            threads,
+        )
+        return out, True
 
     def fit_transform(self, X: np.ndarray) -> np.ndarray:
         return self.fit(X).transform(X)
