@@ -68,18 +68,72 @@ def enable_compile_cache() -> bool:
         # across shapes/configs (r4 advisor low #5) — prune to a size cap,
         # oldest-access first, at enable time (once per process).
         prune_cache_dir(path)
+        _install_hit_recorder(path)
         _done = True
         return True
     except Exception:
         return False
 
 
+def record_cache_hit(path: str) -> None:
+    """Refresh ``path``'s timestamps after a cache hit.
+
+    Most Linux mounts use relatime (atime refreshed at most once per 24 h),
+    so a hot entry's atime looks cold and :func:`prune_cache_dir`'s LRU
+    would evict it ahead of genuinely stale entries.  ``os.utime`` bumps
+    mtime too, which every mount option keeps accurate.
+    """
+    try:
+        os.utime(path)
+    except OSError:
+        pass
+
+
+def _install_hit_recorder(cache_dir: str) -> None:
+    """Touch compile-cache entries when jax serves them (best-effort).
+
+    jax's persistent cache reads entries without updating any timestamp we
+    can rely on under relatime, so wrap its module-level getter and
+    :func:`record_cache_hit` the backing file(s) on every hit.  Layouts
+    differ across jax versions (``<key>`` flat files vs ``<key>-cache``
+    LRU entries), so any file beginning with the key is touched.  Any
+    internals mismatch leaves caching fully functional, just with the
+    weaker atime-based eviction order.
+    """
+    try:
+        import jax._src.compilation_cache as cc
+
+        if getattr(cc.get_executable_and_time, "_mmlspark_tpu_touch", False):
+            return
+        orig = cc.get_executable_and_time
+
+        def get_and_touch(cache_key, compile_options, backend):
+            result = orig(cache_key, compile_options, backend)
+            if result[0] is not None:
+                try:
+                    with os.scandir(cache_dir) as it:
+                        for e in it:
+                            if e.name.startswith(cache_key):
+                                record_cache_hit(e.path)
+                except OSError:
+                    pass
+            return result
+
+        get_and_touch._mmlspark_tpu_touch = True
+        cc.get_executable_and_time = get_and_touch
+    except Exception:
+        pass
+
+
 def prune_cache_dir(path: str, max_mb: float | None = None) -> int:
     """Best-effort LRU prune of ``path`` to ``max_mb``; returns files removed.
 
-    Eviction order is access time (a cache hit refreshes atime on most
-    filesystems; mtime is the fallback) — never raises, concurrent
-    processes racing on the same file just skip it.
+    Eviction order is max(atime, mtime).  Relatime mounts refresh atime at
+    most once per 24 h, so hits are recorded explicitly by bumping mtime
+    (:func:`record_cache_hit`, wired into jax's cache getter by
+    :func:`_install_hit_recorder`) — a freshly-hit entry therefore always
+    outlives a stale one regardless of mount options.  Never raises;
+    concurrent processes racing on the same file just skip it.
     """
     if max_mb is None:
         try:

@@ -31,7 +31,11 @@ must execute a BYTE-IDENTICAL program (the replicated-model contract is
 psum-determinism, which mixing a freshly-traced program on one process
 with a deserialized one on another could break in ulps), so load-vs-
 export is AGREED via a tiny host allgather: all processes load only when
-every process has the blob; otherwise all export.
+every process has the blob; otherwise all export.  The agreement runs
+only when the caller attests the program IS multi-controller
+(``wrap_aot(..., multi_controller=True)``, from the mesh topology) —
+never merely because the job has multiple processes, which would let a
+meshless rank-local train deadlock in a collective no other rank enters.
 
 Opt out with ``MMLSPARK_TPU_NO_TRACE_CACHE=1``.  Any failure (old jax,
 unserializable graph, corrupt blob) silently falls back to the jitted
@@ -132,13 +136,31 @@ def mesh_trace_key(mesh) -> str:
     )
 
 
-def _all_processes_ok(local_ok: bool) -> bool:
+def mesh_spans_processes(mesh) -> bool:
+    """True iff ``mesh`` places devices on more than one process — the
+    program lowered over it is genuinely multi-controller, so every
+    process executes it in lockstep (the SPMD contract)."""
+    if mesh is None:
+        return False
+    procs = {getattr(d, "process_index", 0) for d in mesh.devices.flat}
+    return len(procs) > 1
+
+
+def _all_processes_ok(local_ok: bool, multi_controller: bool) -> bool:
     """Collective AND over processes (multi-controller agreement — see the
-    module docstring's byte-identical-program contract).  Single process:
-    the local flag."""
+    module docstring's byte-identical-program contract).
+
+    The collective runs ONLY for genuinely multi-controller programs
+    (``multi_controller`` — derived by the caller from the mesh topology /
+    process_local flag, never from ``jax.process_count()`` alone): a
+    meshless program inside a multi-process job is NOT executed by every
+    rank, so a process-count-gated allgather here would block forever
+    waiting on ranks that never enter it, and ranks wrapping different
+    local programs would pair unrelated agreement collectives.
+    """
     import jax
 
-    if jax.process_count() == 1:
+    if not multi_controller or jax.process_count() == 1:
         return local_ok
     from mmlspark_tpu.parallel.distributed import host_allgather
 
@@ -146,16 +168,25 @@ def _all_processes_ok(local_ok: bool) -> bool:
     return bool(flags.reshape(-1).min())
 
 
-def _all_processes_have(path: str) -> bool:
-    """True iff EVERY process's cache holds the blob."""
-    return _all_processes_ok(os.path.exists(path))
+def _all_processes_have(path: str, multi_controller: bool) -> bool:
+    """True iff EVERY participating process's cache holds the blob."""
+    return _all_processes_ok(os.path.exists(path), multi_controller)
 
 
-def wrap_aot(jitted: Callable, key_material: str) -> Callable:
+def wrap_aot(
+    jitted: Callable, key_material: str, multi_controller: bool = False
+) -> Callable:
     """Wrap a jitted function so its traced program persists across
     processes.  First call per argument signature: load the exported
     blob if present (NO tracing), else export once (one trace — the same
-    price the plain jit path pays) and save for future processes."""
+    price the plain jit path pays) and save for future processes.
+
+    ``multi_controller`` asserts the wrapped program is executed by EVERY
+    process (a mesh spanning processes / process_local ingestion — the
+    booster derives it via :func:`mesh_spans_processes`).  Only then is
+    load-vs-export agreed collectively; meshless programs load/export
+    purely locally even inside a multi-process job, so a rank-local train
+    (e.g. a rank-0-only serial comparator) can never deadlock here."""
     import jax
 
     state: dict = {}
@@ -192,13 +223,13 @@ def wrap_aot(jitted: Callable, key_material: str) -> Callable:
                 # (old jax, unserializable graph) are deterministic
                 # properties of the program, failing identically on every
                 # process, so the per-process `off` fallback stays safe.
-                if _all_processes_have(path):
+                if _all_processes_have(path, multi_controller):
                     try:
                         with open(path, "rb") as f:
                             exp = jexport.deserialize(bytearray(f.read()))
                     except Exception:
                         exp = None  # corrupt blob on SOME process
-                    if not _all_processes_ok(exp is not None):
+                    if not _all_processes_ok(exp is not None, multi_controller):
                         exp = None  # any process failed → everyone exports
                 if exp is None:
                     exp = jexport.export(jitted)(*args)

@@ -1395,8 +1395,10 @@ def train(
         # (SURVEY.md §2 parallelism table).
         from jax.sharding import PartitionSpec as P
 
+        from mmlspark_tpu.parallel.mesh import shard_map_compat
+
         tree_spec = Tree(*([P()] * len(Tree._fields)))
-        grow = jax.shard_map(
+        grow = shard_map_compat(
             _grow_classes(
                 dataclasses.replace(
                     gcfg, axis_name=DATA_AXIS, feature_parallel=True
@@ -1417,8 +1419,10 @@ def train(
         # static checker cannot see through argmax.
         from jax.sharding import PartitionSpec as P
 
+        from mmlspark_tpu.parallel.mesh import shard_map_compat
+
         tree_spec = Tree(*([P()] * len(Tree._fields)))
-        grow = jax.shard_map(
+        grow = shard_map_compat(
             _grow_classes(dataclasses.replace(gcfg, axis_name=DATA_AXIS)),
             mesh=mesh,
             in_specs=(P(DATA_AXIS, None), P(None, DATA_AXIS), P(None, DATA_AXIS), P(DATA_AXIS), P(None, None)),
@@ -2010,7 +2014,11 @@ def train(
             # fingerprint can never trace-cache (their state is baked into
             # the traced program).
             from mmlspark_tpu.core.trace_cache import enabled as _tc_on
-            from mmlspark_tpu.core.trace_cache import mesh_trace_key, wrap_aot
+            from mmlspark_tpu.core.trace_cache import (
+                mesh_trace_key,
+                mesh_spans_processes,
+                wrap_aot,
+            )
 
             if _tc_on():
                 scan_chunk = wrap_aot(
@@ -2024,6 +2032,14 @@ def train(
                         _delta_onehot,
                         mesh_trace_key(mesh), process_local, feature_par,
                     )),
+                    # Load-vs-export agreement only for programs every rank
+                    # runs: a meshless train inside a multi-process job
+                    # (rank-local comparator, per-rank AutoML worker) must
+                    # load/export purely locally — the collective would
+                    # deadlock against ranks that never enter it.
+                    multi_controller=(
+                        process_local or mesh_spans_processes(mesh)
+                    ),
                 )
 
         if cfg.early_stopping_round > 0 and vsets:

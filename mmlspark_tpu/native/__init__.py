@@ -70,17 +70,19 @@ def load_native_lib(src: str, so: str, bind) -> "ctypes.CDLL | None":
 
 
 def _bind_binner(lib):
+    # Fixed-width c_int64 throughout: the C side declares int64_t, and a
+    # platform-width c_long would misread the tables on LLP64 (Windows).
     c_double_p = ctypes.POINTER(ctypes.c_double)
     c_int_p = ctypes.POINTER(ctypes.c_int)
     c_u8_p = ctypes.POINTER(ctypes.c_uint8)
     lib.mml_binner_fit.argtypes = [
-        c_double_p, ctypes.c_long, ctypes.c_long,
+        c_double_p, ctypes.c_int64, ctypes.c_int64,
         ctypes.c_int, ctypes.c_int, c_u8_p,
         c_double_p, c_int_p, ctypes.c_int,
     ]
     lib.mml_binner_fit.restype = None
     lib.mml_binner_transform.argtypes = [
-        c_double_p, ctypes.c_long, ctypes.c_long,
+        c_double_p, ctypes.c_int64, ctypes.c_int64,
         c_double_p, c_int_p, ctypes.c_int, ctypes.c_int,
         c_u8_p, ctypes.c_int,
     ]
@@ -89,11 +91,10 @@ def _bind_binner(lib):
     # kernel (numpy cats + C++ numerics), not the whole library.
     cat_fn = getattr(lib, "mml_binner_transform_cat", None)
     if cat_fn is not None:
-        c_long_p = ctypes.POINTER(ctypes.c_long)
-        c_ll_p = ctypes.POINTER(ctypes.c_longlong)
+        c_i64_p = ctypes.POINTER(ctypes.c_int64)
         cat_fn.argtypes = [
-            c_double_p, ctypes.c_long, ctypes.c_long,
-            c_long_p, ctypes.c_long, c_ll_p, c_long_p,
+            c_double_p, ctypes.c_int64, ctypes.c_int64,
+            c_i64_p, ctypes.c_int64, c_i64_p, c_i64_p,
             ctypes.c_int, c_u8_p, ctypes.c_int,
         ]
         cat_fn.restype = None

@@ -63,12 +63,12 @@ static void radix_sort_doubles(std::vector<double>& v,
 // Greedy equal-count boundary placement over distinct values — the exact
 // LightGBM-compatible rule ops/binning.py::_fit_numeric implements:
 // accumulate counts until >= target, place the midpoint boundary, reset.
-int fit_numeric_col(const double* col, long n, long stride, int max_bin,
+int fit_numeric_col(const double* col, int64_t n, int64_t stride, int max_bin,
                     int min_data_in_bin, double* out_uppers,
                     std::vector<uint64_t>& keys, std::vector<uint64_t>& tmp) {
   std::vector<double> v;
   v.reserve(static_cast<size_t>(n));
-  for (long i = 0; i < n; ++i) {
+  for (int64_t i = 0; i < n; ++i) {
     double x = col[i * stride];
     if (!std::isnan(x)) v.push_back(x);
   }
@@ -78,13 +78,13 @@ int fit_numeric_col(const double* col, long n, long stride, int max_bin,
   }
   radix_sort_doubles(v, keys, tmp);
   std::vector<double> distinct;
-  std::vector<long> counts;
+  std::vector<int64_t> counts;
   distinct.reserve(v.size());
   for (size_t i = 0; i < v.size();) {
     size_t j = i;
     while (j < v.size() && v[j] == v[i]) ++j;
     distinct.push_back(v[i]);
-    counts.push_back(static_cast<long>(j - i));
+    counts.push_back(static_cast<int64_t>(j - i));
     i = j;
   }
   const size_t nd = distinct.size();
@@ -110,18 +110,18 @@ int fit_numeric_col(const double* col, long n, long stride, int max_bin,
   return k;
 }
 
-void parallel_over(long count, int n_threads,
-                   const std::function<void(long, long)>& body) {
+void parallel_over(int64_t count, int n_threads,
+                   const std::function<void(int64_t, int64_t)>& body) {
   if (n_threads <= 1 || count <= 1) {
     body(0, count);
     return;
   }
-  int workers = static_cast<int>(std::min<long>(n_threads, count));
+  int workers = static_cast<int>(std::min<int64_t>(n_threads, count));
   std::vector<std::thread> pool;
   pool.reserve(workers);
-  long per = (count + workers - 1) / workers;
+  int64_t per = (count + workers - 1) / workers;
   for (int w = 0; w < workers; ++w) {
-    long lo = w * per, hi = std::min(count, lo + per);
+    int64_t lo = w * per, hi = std::min(count, lo + per);
     if (lo >= hi) break;
     pool.emplace_back([&body, lo, hi] { body(lo, hi); });
   }
@@ -135,12 +135,12 @@ extern "C" {
 // Fit every feature's bin uppers from a row-major sample Xs (n, F).
 // skip[f] != 0 → feature handled elsewhere (categorical), 0 uppers written.
 // out_uppers is (F, max_bin) row-major; out_counts[f] = #uppers for f.
-void mml_binner_fit(const double* Xs, long n, long F, int max_bin,
+void mml_binner_fit(const double* Xs, int64_t n, int64_t F, int max_bin,
                     int min_data_in_bin, const uint8_t* skip,
                     double* out_uppers, int* out_counts, int n_threads) {
-  parallel_over(F, n_threads, [&](long f0, long f1) {
+  parallel_over(F, n_threads, [&](int64_t f0, int64_t f1) {
     std::vector<uint64_t> keys, tmp;  // per-thread radix scratch
-    for (long f = f0; f < f1; ++f) {
+    for (int64_t f = f0; f < f1; ++f) {
       if (skip[f]) {
         out_counts[f] = 0;
         continue;
@@ -163,31 +163,31 @@ void mml_binner_fit(const double* Xs, long n, long F, int max_bin,
 // std::lower_bound's unpredictable branch — ~2x on the 16M-value
 // transform that dominates train() fixed overhead on the single-core
 // host.
-void mml_binner_transform(const double* X, long n, long F,
+void mml_binner_transform(const double* X, int64_t n, int64_t F,
                           const double* uppers, const int* counts,
                           int max_bin, int missing_bin, uint8_t* out,
                           int n_threads) {
-  parallel_over(F, n_threads, [&](long f0, long f1) {
+  parallel_over(F, n_threads, [&](int64_t f0, int64_t f1) {
     std::vector<double> padded;
-    for (long f = f0; f < f1; ++f) {
+    for (int64_t f = f0; f < f1; ++f) {
       const int m = counts[f];
       if (m == 0) continue;
       const double* ub = uppers + f * max_bin;
       // pad boundaries to the next power of two with +inf
-      long P = 1;
+      int64_t P = 1;
       while (P < m) P <<= 1;
       padded.assign(static_cast<size_t>(P),
                     std::numeric_limits<double>::infinity());
       std::copy(ub, ub + m, padded.begin());
       const double* pb = padded.data();
-      for (long i = 0; i < n; ++i) {
+      for (int64_t i = 0; i < n; ++i) {
         const double x = X[i * F + f];
         if (std::isnan(x)) {
           out[i * F + f] = static_cast<uint8_t>(missing_bin);
           continue;
         }
-        long j = 0;
-        for (long step = P >> 1; step > 0; step >>= 1) {
+        int64_t j = 0;
+        for (int64_t step = P >> 1; step > 0; step >>= 1) {
           // first index with pb[idx] >= x (searchsorted "left")
           j += (pb[j + step - 1] < x) ? step : 0;
         }
@@ -198,7 +198,7 @@ void mml_binner_transform(const double* X, long n, long F,
 }
 
 // Bin CATEGORICAL columns: out[i, f] = index of the exact match of
-// (long long)X[i, f] in that column's sorted category array, else
+// (int64_t)X[i, f] in that column's sorted category array, else
 // missing_bin; NaN → missing_bin.  Matches the numpy reference pass
 // (searchsorted "left" + equality check) bit for bit.  Same branchless
 // fixed-depth search as the numeric transform — on the criteo-schema
@@ -209,9 +209,9 @@ void mml_binner_transform(const double* X, long n, long F,
 // cols[k] (k < n_cols): feature index of the k-th categorical column.
 // cat_vals: concatenated per-column sorted int64 category values;
 // cat_off[k]..cat_off[k+1] delimits column k's slice.
-void mml_binner_transform_cat(const double* X, long n, long F,
-                              const long* cols, long n_cols,
-                              const long long* cat_vals, const long* cat_off,
+void mml_binner_transform_cat(const double* X, int64_t n, int64_t F,
+                              const int64_t* cols, int64_t n_cols,
+                              const int64_t* cat_vals, const int64_t* cat_off,
                               int missing_bin, uint8_t* out, int n_threads) {
   // Padded (power-of-two, +max-sentinel) per-column bounds, prebuilt once:
   // all columns' tables total ≲ n_cols * max_bin * 8 B (tens of KB), so
@@ -219,46 +219,58 @@ void mml_binner_transform_cat(const double* X, long n, long F,
   // once — the column-major variant re-streamed the full matrix per
   // column (26 strided passes on the criteo schema) and measured ~2x
   // slower at 4M rows.
-  std::vector<long long> padded;
-  std::vector<long> off(static_cast<size_t>(n_cols) + 1, 0);
-  std::vector<long> pow2(static_cast<size_t>(n_cols), 0);
-  for (long k = 0; k < n_cols; ++k) {
-    const long m = cat_off[k + 1] - cat_off[k];
-    long P = m > 0 ? 1 : 0;
+  std::vector<int64_t> padded;
+  std::vector<int64_t> off(static_cast<size_t>(n_cols) + 1, 0);
+  std::vector<int64_t> pow2(static_cast<size_t>(n_cols), 0);
+  for (int64_t k = 0; k < n_cols; ++k) {
+    const int64_t m = cat_off[k + 1] - cat_off[k];
+    int64_t P = m > 0 ? 1 : 0;
     while (P < m) P <<= 1;
     pow2[k] = P;
     off[k + 1] = off[k] + P;
   }
   padded.assign(static_cast<size_t>(off[n_cols]),
-                std::numeric_limits<long long>::max());
-  for (long k = 0; k < n_cols; ++k) {
+                std::numeric_limits<int64_t>::max());
+  for (int64_t k = 0; k < n_cols; ++k) {
     std::copy(cat_vals + cat_off[k], cat_vals + cat_off[k + 1],
               padded.begin() + off[k]);
   }
-  parallel_over(n, n_threads, [&](long i0, long i1) {
-    for (long i = i0; i < i1; ++i) {
+  parallel_over(n, n_threads, [&](int64_t i0, int64_t i1) {
+    for (int64_t i = i0; i < i1; ++i) {
       const double* row = X + i * F;
       uint8_t* orow = out + i * F;
-      for (long k = 0; k < n_cols; ++k) {
-        const long m = cat_off[k + 1] - cat_off[k];
+      for (int64_t k = 0; k < n_cols; ++k) {
+        const int64_t m = cat_off[k + 1] - cat_off[k];
         if (m <= 0) continue;
-        const long f = cols[k];
+        const int64_t f = cols[k];
         const double x = row[f];
         if (std::isnan(x)) {
           orow[f] = static_cast<uint8_t>(missing_bin);
           continue;
         }
-        // numpy's astype(int64) on x86 (cvttsd2si): out-of-range and
-        // non-finite convert to INT64_MIN — the fit-time tables are built
-        // through the same cast, so transform must match it (a plain
-        // static_cast is UB out of range).
-        const long long v =
-            (x >= 9223372036854775808.0 || x < -9223372036854775808.0)
-                ? std::numeric_limits<long long>::min()
-                : static_cast<long long>(x);
-        const long long* pb = padded.data() + off[k];
-        long j = 0;
-        for (long step = pow2[k] >> 1; step > 0; step >>= 1) {
+        // Out-of-range doubles must convert exactly as the numpy
+        // astype(int64) that built the fit-time tables on THIS host (a
+        // plain static_cast is UB out of range): x86 cvttsd2si collapses
+        // every out-of-range value to INT64_MIN, while aarch64 fcvtzs
+        // SATURATES (positive overflow -> INT64_MAX) — so the clamp
+        // branches on sign everywhere except x86, keeping fit tables and
+        // this transform in agreement on every architecture.
+        int64_t v;
+        if (x >= 9223372036854775808.0) {
+#if defined(__x86_64__) || defined(__i386__) || defined(_M_X64) || \
+    defined(_M_IX86)
+          v = std::numeric_limits<int64_t>::min();
+#else
+          v = std::numeric_limits<int64_t>::max();
+#endif
+        } else if (x < -9223372036854775808.0) {
+          v = std::numeric_limits<int64_t>::min();
+        } else {
+          v = static_cast<int64_t>(x);
+        }
+        const int64_t* pb = padded.data() + off[k];
+        int64_t j = 0;
+        for (int64_t step = pow2[k] >> 1; step > 0; step >>= 1) {
           j += (pb[j + step - 1] < v) ? step : 0;
         }
         const bool hit = (j < m) && (pb[j] == v);

@@ -77,7 +77,7 @@ void parse_u32s(const std::string& v, std::vector<uint32_t>& out) {
                [](const char* p, char** e) { return strtoul(p, e, 10); });
 }
 
-double score_tree(const Tree& t, const double* x, long n_feat) {
+double score_tree(const Tree& t, const double* x, int64_t n_feat) {
     if (t.split_feature.empty()) {
         return t.leaf_value.empty() ? 0.0 : t.leaf_value[0];
     }
@@ -89,15 +89,15 @@ double score_tree(const Tree& t, const double* x, long n_feat) {
         bool left;
         if (dt & 1) {  // categorical membership split
             // NaN or out-of-range category values are never members (the
-            // range check also keeps the double->long cast defined).
+            // range check also keeps the double->int64_t cast defined).
             if (!(v >= 0.0 && v < 2147483647.0)) {
                 left = false;
             } else {
                 const int ci = static_cast<int>(t.threshold[node]);
                 const int lo = t.cat_boundaries[ci];
                 const int hi = t.cat_boundaries[ci + 1];
-                const long c = static_cast<long>(v);
-                const long w = c / 32, bit = c % 32;
+                const int64_t c = static_cast<int64_t>(v);
+                const int64_t w = c / 32, bit = c % 32;
                 left = w < (hi - lo) &&
                        ((t.cat_threshold[lo + w] >> bit) & 1u);
             }
@@ -240,12 +240,12 @@ void mml_model_info(void* h, int* num_class, int* num_trees,
 
 // out has n * K doubles (K = classes); raw=0 applies the objective
 // transform (sigmoid / softmax), raw=1 returns margin sums.
-void mml_model_predict(void* h, const double* X, long n, long n_feat,
+void mml_model_predict(void* h, const double* X, int64_t n, int64_t n_feat,
                        int raw, double* out) {
     auto* m = static_cast<Model*>(h);
     const int K = m->num_tree_per_iteration > 1 ? m->num_tree_per_iteration
                                                 : (m->num_class > 1 ? m->num_class : 1);
-    for (long i = 0; i < n; ++i) {
+    for (int64_t i = 0; i < n; ++i) {
         double* o = out + i * K;
         for (int k = 0; k < K; ++k) o[k] = 0.0;
         const double* x = X + i * n_feat;

@@ -31,7 +31,7 @@ def _bind(lib):
     lib.mml_model_info.argtypes = [ctypes.c_void_p, ip, ip, ip]
     lib.mml_model_info.restype = None
     lib.mml_model_predict.argtypes = [
-        ctypes.c_void_p, dp, ctypes.c_long, ctypes.c_long,
+        ctypes.c_void_p, dp, ctypes.c_int64, ctypes.c_int64,
         ctypes.c_int, dp,
     ]
     lib.mml_model_predict.restype = None

@@ -1,10 +1,12 @@
-// Sanitizer harness for binner.cpp (SURVEY.md §5.2: the reference's C++
-// gets ASAN/TSAN jobs; here the native binner gets an ASAN+UBSAN pass).
+// Sanitizer harness for binner.cpp AND predictor.cpp (SURVEY.md §5.2: the
+// reference's C++ gets ASAN/TSAN jobs; here the native components get an
+// ASAN+UBSAN pass, and the threaded binner additionally runs under TSAN).
 //
-// Built and run by tests/test_native_binner.py::test_sanitizer_pass and the
+// Built and run by tests/test_native_binner.py::TestSanitizers and the
 // CI sanitize job:
 //   g++ -std=c++17 -O1 -g -pthread -fsanitize=address,undefined \
-//       -fno-sanitize-recover=all binner.cpp sanitize_main.cpp -o harness
+//       -fno-sanitize-recover=all binner.cpp predictor.cpp \
+//       sanitize_main.cpp -o harness
 // Exit 0 = no sanitizer findings; any finding aborts with non-zero.
 //
 // Exercises the edge cases the Python fallback parity tests cover, plus
@@ -17,16 +19,21 @@
 #include <cstdio>
 #include <cstdlib>
 #include <limits>
+#include <string>
 #include <vector>
 
 extern "C" {
-void mml_binner_fit(const double*, long, long, int, int, const uint8_t*,
-                    double*, int*, int);
-void mml_binner_transform(const double*, long, long, const double*,
+void mml_binner_fit(const double*, int64_t, int64_t, int, int,
+                    const uint8_t*, double*, int*, int);
+void mml_binner_transform(const double*, int64_t, int64_t, const double*,
                           const int*, int, int, uint8_t*, int);
-void mml_binner_transform_cat(const double*, long, long, const long*, long,
-                              const long long*, const long*, int, uint8_t*,
-                              int);
+void mml_binner_transform_cat(const double*, int64_t, int64_t,
+                              const int64_t*, int64_t, const int64_t*,
+                              const int64_t*, int, uint8_t*, int);
+void* mml_model_load(const char*);
+void mml_model_info(void*, int*, int*, int*);
+void mml_model_predict(void*, const double*, int64_t, int64_t, int, double*);
+void mml_model_free(void*);
 }
 
 namespace {
@@ -98,14 +105,14 @@ int run_case(long n, long F, int max_bin, int threads) {
 int run_cat_case(long n, long n_cols, int threads) {
   const long F = n_cols + 1;  // one numeric column left untouched
   std::vector<double> X(static_cast<size_t>(n) * F);
-  std::vector<long> cols(static_cast<size_t>(n_cols));
-  std::vector<long long> vals;
-  std::vector<long> off(static_cast<size_t>(n_cols) + 1, 0);
+  std::vector<int64_t> cols(static_cast<size_t>(n_cols));
+  std::vector<int64_t> vals;
+  std::vector<int64_t> off(static_cast<size_t>(n_cols) + 1, 0);
   for (long k = 0; k < n_cols; ++k) {
     cols[k] = k;  // cat columns first, numeric last
     const long m = (k % 5 == 3) ? 0 : 1 + (k * 7) % 40;  // one empty table
     for (long j = 0; j < m; ++j)
-      vals.push_back(static_cast<long long>(j * 3 - 5));  // negatives too
+      vals.push_back(static_cast<int64_t>(j * 3 - 5));  // negatives too
     off[k + 1] = off[k] + m;
   }
   for (long i = 0; i < n; ++i) {
@@ -123,7 +130,7 @@ int run_cat_case(long n, long n_cols, int threads) {
                            off.data(), missing, out.data(), threads);
   for (long i = 0; i < n; ++i) {
     for (long k = 0; k < n_cols; ++k) {
-      const long m = off[k + 1] - off[k];
+      const long m = static_cast<long>(off[k + 1] - off[k]);
       const uint8_t b = out[static_cast<size_t>(i) * F + k];
       if (m == 0) {
         if (b != 255) return 10;  // empty table -> untouched by contract
@@ -132,6 +139,101 @@ int run_cat_case(long n, long n_cols, int threads) {
       if (b != missing && b >= m) return 11;
     }
     if (out[static_cast<size_t>(i) * F + n_cols] != 255) return 12;
+  }
+  return 0;
+}
+
+// Predictor (predictor.cpp) under the same sanitizers: parse a small v3
+// model (numerical + categorical + default-direction splits), score rows
+// stressing the walker (NaN, negative/huge category values, exact
+// thresholds), and verify malformed models are REJECTED at load rather
+// than walked (cycles, bad cat_boundaries, arity mismatches).
+int run_predictor_case() {
+  const char* model_text =
+      "num_class=1\n"
+      "num_tree_per_iteration=1\n"
+      "max_feature_idx=2\n"
+      "objective=binary sigmoid:1\n"
+      "\n"
+      "Tree=0\n"
+      "num_leaves=3\n"
+      "split_feature=0 1\n"
+      "threshold=0.5 1.5\n"
+      "decision_type=2 0\n"
+      "left_child=1 -2\n"
+      "right_child=-1 -3\n"
+      "leaf_value=0.1 -0.2 0.3\n"
+      "\n"
+      "Tree=1\n"
+      "num_leaves=2\n"
+      "split_feature=2\n"
+      "threshold=0\n"
+      "decision_type=1\n"
+      "left_child=-1\n"
+      "right_child=-2\n"
+      "leaf_value=0.5 -0.5\n"
+      "cat_boundaries=0 1\n"
+      "cat_threshold=10\n"
+      "\n"
+      "end of trees\n";
+  void* h = mml_model_load(model_text);
+  if (h == nullptr) return 20;
+  int nc = 0, nt = 0, mf = 0;
+  mml_model_info(h, &nc, &nt, &mf);
+  if (nc != 1 || nt != 2 || mf != 2) {
+    mml_model_free(h);
+    return 21;
+  }
+  const double nan = std::nan("");
+  const double rows[] = {
+      0.5,  1.5, 1.0,   // exact thresholds, cat 1 (member of bitset 10)
+      -1.0, 2.0, 3.0,   // cat 3 (member)
+      nan,  nan, nan,   // all missing: default directions
+      2.0,  0.0, -7.0,  // negative category: never a member
+      1e300, -1e300, 1e18,  // huge values through the cat range check
+  };
+  const long n = 5;
+  std::vector<double> out(static_cast<size_t>(n), -1.0);
+  for (int raw = 0; raw <= 1; ++raw) {
+    mml_model_predict(h, rows, n, 3, raw, out.data());
+    for (long i = 0; i < n; ++i) {
+      if (std::isnan(out[i])) {
+        mml_model_free(h);
+        return 22;
+      }
+      if (!raw && !(out[i] >= 0.0 && out[i] <= 1.0)) {
+        mml_model_free(h);
+        return 23;
+      }
+    }
+  }
+  mml_model_free(h);
+  // malformed models must fail load (nullptr), never walk
+  const char* bad_models[] = {
+      // child index <= parent: the walker would cycle forever
+      "Tree=0\nnum_leaves=2\nsplit_feature=0\nthreshold=0.5\n"
+      "decision_type=0\nleft_child=0\nright_child=-1\n"
+      "leaf_value=0.1 0.2\nend of trees\n",
+      // decreasing cat_boundaries: bitset lookup would read out of bounds
+      "Tree=0\nnum_leaves=2\nsplit_feature=0\nthreshold=0\n"
+      "decision_type=1\nleft_child=-1\nright_child=-2\n"
+      "leaf_value=0.1 0.2\ncat_boundaries=2 0\ncat_threshold=1\n"
+      "end of trees\n",
+      // arity mismatch: threshold list shorter than split_feature
+      "Tree=0\nnum_leaves=3\nsplit_feature=0 1\nthreshold=0.5\n"
+      "decision_type=0 0\nleft_child=1 -2\nright_child=-1 -3\n"
+      "leaf_value=0.1 0.2 0.3\nend of trees\n",
+      // leaf reference past leaf_value
+      "Tree=0\nnum_leaves=2\nsplit_feature=0\nthreshold=0.5\n"
+      "decision_type=0\nleft_child=-5\nright_child=-1\n"
+      "leaf_value=0.1 0.2\nend of trees\n",
+  };
+  for (const char* bad : bad_models) {
+    void* hb = mml_model_load(bad);
+    if (hb != nullptr) {
+      mml_model_free(hb);
+      return 24;
+    }
   }
   return 0;
 }
@@ -168,6 +270,13 @@ int main() {
     if (rc != 0) {
       std::fprintf(stderr, "cat case n=%ld cols=%ld threads=%d -> %d\n",
                    c.n, c.n_cols, c.threads, rc);
+      return rc;
+    }
+  }
+  {
+    int rc = run_predictor_case();
+    if (rc != 0) {
+      std::fprintf(stderr, "predictor case -> %d\n", rc);
       return rc;
     }
   }

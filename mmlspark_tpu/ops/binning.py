@@ -114,7 +114,7 @@ class BinMapper:
         counts = np.zeros(F, np.int32)
         lib.mml_binner_fit(
             Xs.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
-            ctypes.c_long(n), ctypes.c_long(F),
+            ctypes.c_int64(n), ctypes.c_int64(F),
             ctypes.c_int(self.max_bin), ctypes.c_int(self.min_data_in_bin),
             skip.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
             uppers.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
@@ -227,7 +227,7 @@ class BinMapper:
         threads = ctypes.c_int(self.threads or default_threads())
         lib.mml_binner_transform(
             X.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
-            ctypes.c_long(n), ctypes.c_long(F),
+            ctypes.c_int64(n), ctypes.c_int64(F),
             uppers.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
             counts.ctypes.data_as(ctypes.POINTER(ctypes.c_int)),
             ctypes.c_int(self.max_bin), ctypes.c_int(self.missing_bin),
@@ -250,11 +250,11 @@ class BinMapper:
         np.cumsum([len(maps[f]) for f in cats], out=cat_off[1:])
         lib.mml_binner_transform_cat(
             X.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
-            ctypes.c_long(n), ctypes.c_long(F),
-            cols.ctypes.data_as(ctypes.POINTER(ctypes.c_long)),
-            ctypes.c_long(len(cats)),
-            cat_vals.ctypes.data_as(ctypes.POINTER(ctypes.c_longlong)),
-            cat_off.ctypes.data_as(ctypes.POINTER(ctypes.c_long)),
+            ctypes.c_int64(n), ctypes.c_int64(F),
+            cols.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            ctypes.c_int64(len(cats)),
+            cat_vals.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            cat_off.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
             ctypes.c_int(self.missing_bin),
             out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
             threads,
@@ -350,8 +350,12 @@ def distributed_fit(
         host_allgather_ragged_rows,
     )
 
+    # All-ranks by contract: this function's documented API is "every
+    # process calls distributed_fit" (the unconditional ragged allgather
+    # below enforces it), so the rank-count test here is only a local
+    # fast path, not a reachability gate.
     n_total = int(
-        host_allgather(np.asarray([len(local_X)])).sum()
+        host_allgather(np.asarray([len(local_X)])).sum()  # analyze: ignore[COL001]
     ) if jax.process_count() > 1 else len(local_X)
     sample = sample_rows_for_binning(
         local_X, n_total, seed=seed, process_id=jax.process_index()

@@ -46,3 +46,24 @@ def mesh_num_devices(mesh: Optional[Mesh]) -> int:
     if mesh is None:
         return 1
     return int(np.prod(mesh.devices.shape))
+
+
+def shard_map_compat(f, *, mesh, in_specs, out_specs, check_vma=True):
+    """``jax.shard_map`` across jax versions.
+
+    Newer jax exposes ``jax.shard_map(..., check_vma=...)``; before that it
+    lived at ``jax.experimental.shard_map.shard_map`` with the same knob
+    named ``check_rep``.  Single call site for both so the engine never
+    version-sniffs inline.
+    """
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            check_vma=check_vma,
+        )
+    from jax.experimental.shard_map import shard_map
+
+    return shard_map(
+        f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+        check_rep=check_vma,
+    )

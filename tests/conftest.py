@@ -10,19 +10,6 @@ the analog is ``xla_force_host_platform_device_count=8`` so distributed
 import os
 import sys
 
-# XLA's CPU collectives have a watchdog that ABORTS the process (not a
-# Python exception) when a psum straggles past the default 30s — on a
-# loaded host, 8 virtual devices sharing cores can trip it nondeterministically
-# (observed as "Fatal Python error: Aborted" inside the shard_map/psum
-# train path).  XLA_FLAGS is parsed lazily at first compile, so setting it
-# here (before any test compiles) still takes effect even though jax itself
-# was imported at interpreter startup.
-os.environ["XLA_FLAGS"] = (
-    os.environ.get("XLA_FLAGS", "")
-    + " --xla_cpu_collective_timeout_seconds=600"
-    + " --xla_cpu_collective_call_terminate_timeout_seconds=1200"
-).strip()
-
 # The AOT trace cache (core/trace_cache) pays an export per first-ever
 # program — pure overhead across hundreds of small test configs, and it
 # would write into the user cache dir.  The feature has its own dedicated
@@ -39,7 +26,32 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import jax
 
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 8)
+try:
+    jax.config.update("jax_num_cpu_devices", 8)
+    # XLA's CPU collectives have a watchdog that ABORTS the process (not a
+    # Python exception) when a psum straggles past the default 30s — on a
+    # loaded host, 8 virtual devices sharing cores can trip it
+    # nondeterministically (observed as "Fatal Python error: Aborted" inside
+    # the shard_map/psum train path).  XLA_FLAGS is parsed lazily at first
+    # backend init, so appending here (before any test compiles) still takes
+    # effect even though jax itself was imported at interpreter startup.
+    # Only newer XLA (the builds shipping jax_num_cpu_devices) knows these
+    # flags — older XLA ABORTS on unknown XLA_FLAGS, hence the gating.
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + " --xla_cpu_collective_timeout_seconds=600"
+        + " --xla_cpu_collective_call_terminate_timeout_seconds=1200"
+    ).strip()
+except AttributeError:
+    # Older jax (< 0.5) spells the virtual-device count as an XLA flag;
+    # backends initialize lazily, so appending here (before the first
+    # backend touch) still takes effect — the device_count assert below
+    # verifies it.  No watchdog flags: that XLA has no collective watchdog
+    # and rejects the flags at process level.
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=8"
+    ).strip()
 
 assert jax.default_backend() == "cpu", "tests must run on the CPU backend"
 assert jax.device_count() == 8, (

@@ -96,3 +96,68 @@ def test_prune_cache_dir_lru(tmp_path):
     assert prune_cache_dir(str(d), max_mb=1.0) == 0
     # missing dir -> harmless
     assert prune_cache_dir(str(d / "nope"), max_mb=1.0) == 0
+
+
+def test_freshly_hit_entry_survives_eviction(tmp_path):
+    """ADVICE r5 low #4: relatime mounts refresh atime at most daily, so
+    LRU keyed on atime alone would evict a hot entry ahead of a stale one.
+    record_cache_hit bumps mtime; a freshly-hit OLD entry must outlive a
+    never-hit newer-but-stale one."""
+    import os
+    import time
+
+    from mmlspark_tpu.core.jit_cache import prune_cache_dir, record_cache_hit
+
+    d = tmp_path / "jit"
+    d.mkdir()
+    hot = d / "hot.bin"  # oldest by creation, but hit just now
+    stale = d / "stale.bin"
+    fresh = d / "fresh.bin"
+    for i, p in enumerate((hot, stale, fresh)):
+        p.write_bytes(b"x" * 1024)
+        t = time.time() - (300 - 100 * i)  # hot oldest ... fresh newest
+        os.utime(p, (t, t))
+    record_cache_hit(str(hot))  # the relatime-proof hit record
+    # cap at 2 KiB -> one file must go; without the hit record it would
+    # be `hot` (oldest timestamps), with it the stale entry goes instead
+    assert prune_cache_dir(str(d), max_mb=2 / 1024) == 1
+    names = sorted(f.name for f in d.iterdir())
+    assert names == ["fresh.bin", "hot.bin"]
+    # on a missing path the hit record is a silent no-op
+    record_cache_hit(str(d / "gone.bin"))
+
+
+def test_hit_recorder_wraps_jax_cache(monkeypatch, tmp_path):
+    """The hit hook is installed by enable_compile_cache and touches the
+    entry file when jax's getter reports a hit (idempotent wrap)."""
+    import jax._src.compilation_cache as cc
+
+    import mmlspark_tpu.core.jit_cache as jc
+
+    monkeypatch.delenv("MMLSPARK_TPU_NO_COMPILE_CACHE", raising=False)
+    monkeypatch.delenv("JAX_COMPILATION_CACHE_DIR", raising=False)
+    cache_dir = tmp_path / "jit"
+    monkeypatch.setenv("MMLSPARK_TPU_COMPILE_CACHE_DIR", str(cache_dir))
+    jax.config.update("jax_compilation_cache_dir", None)
+
+    calls = []
+
+    def fake_get(cache_key, compile_options, backend):
+        calls.append(cache_key)
+        return object(), 1  # a "hit"
+
+    monkeypatch.setattr(cc, "get_executable_and_time", fake_get)
+    assert jc.enable_compile_cache() is True
+    wrapped = cc.get_executable_and_time
+    assert getattr(wrapped, "_mmlspark_tpu_touch", False)
+
+    entry = cache_dir / "k123-cache"
+    entry.write_bytes(b"blob")
+    old = entry.stat().st_mtime - 500
+    os.utime(entry, (old, old))
+    exe, t = wrapped("k123", None, None)
+    assert exe is not None and calls == ["k123"]
+    assert entry.stat().st_mtime > old + 400  # touched on hit
+    # re-install is a no-op (no double wrap)
+    jc._install_hit_recorder(str(cache_dir))
+    assert cc.get_executable_and_time is wrapped

@@ -79,10 +79,11 @@ class TestNativeBinner:
 
 class TestSanitizers:
     def test_asan_ubsan_harness_passes(self):
-        """SURVEY.md §5.2 (rebuild note): the C++ binner gets an ASAN/UBSAN
-        pass.  Compiles native/sanitize_main.cpp with both sanitizers
-        (-fno-sanitize-recover aborts on any finding) and runs the
-        edge-case suite; exit 0 = memory- and UB-clean."""
+        """SURVEY.md §5.2 (rebuild note): the C++ binner AND predictor get
+        an ASAN/UBSAN pass.  Compiles native/sanitize_main.cpp (binner
+        edge cases + predictor model-walk/malformed-load cases) with both
+        sanitizers (-fno-sanitize-recover aborts on any finding); exit 0 =
+        memory- and UB-clean."""
         import shutil
         import subprocess
         import tempfile
@@ -100,6 +101,7 @@ class TestSanitizers:
                     "-fsanitize=address,undefined",
                     "-fno-sanitize-recover=all",
                     os.path.join(src_dir, "binner.cpp"),
+                    os.path.join(src_dir, "predictor.cpp"),
                     os.path.join(src_dir, "sanitize_main.cpp"),
                     "-o", exe,
                 ],
@@ -137,6 +139,7 @@ class TestSanitizers:
                     "-fsanitize=thread",
                     "-fno-sanitize-recover=all",
                     os.path.join(src_dir, "binner.cpp"),
+                    os.path.join(src_dir, "predictor.cpp"),
                     os.path.join(src_dir, "sanitize_main.cpp"),
                     "-o", exe,
                 ],

@@ -1,0 +1,599 @@
+"""tools/analyze — the repo-native static-analysis suite (ISSUE 1).
+
+Three layers:
+1. the tier-1 gate: a clean run over the REAL tree (any finding fails),
+2. seeded-bug fixtures: every rule demonstrably fires on a known-bad
+   snippet and stays silent on the corresponding fixed shape,
+3. ADVICE r5 regression demos: the literal pre-fix patterns from the
+   four advisor findings, each caught by its rule.
+"""
+
+import os
+import textwrap
+
+import pytest
+
+from tools.analyze import repo_root, run_all
+from tools.analyze.abi import check_abi, check_float_casts
+from tools.analyze.collectives import check_collectives_file
+from tools.analyze.common import Finding, apply_suppressions
+from tools.analyze.hygiene import check_hygiene_file
+from tools.analyze.tracer import check_host_only_file, check_tracer_file
+
+
+def rules(findings):
+    return [f.rule for f in findings]
+
+
+def _write(path, text):
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with open(path, "w") as fh:
+        fh.write(textwrap.dedent(text))
+    return path
+
+
+def _abi_tree(tmp_path, cpp=None, py=None):
+    """A minimal root/mmlspark_tpu/native tree for check_abi."""
+    root = str(tmp_path)
+    native = os.path.join(root, "mmlspark_tpu", "native")
+    for name, text in (cpp or {}).items():
+        _write(os.path.join(native, name), text)
+    for name, text in (py or {}).items():
+        _write(os.path.join(native, name), text)
+    return root
+
+
+# ---------------------------------------------------------------- tier-1
+
+
+def test_real_tree_is_clean():
+    findings = run_all(repo_root())
+    assert findings == [], "\n".join(str(f) for f in findings)
+
+
+# ------------------------------------------------------------ ABI fixtures
+
+
+def test_abi001_platform_width_c_type(tmp_path):
+    root = _abi_tree(tmp_path, cpp={"k.cpp": """
+        extern "C" {
+        void f(const double* x, long n);
+        }
+    """})
+    found = check_abi(root)
+    assert "ABI001" in rules(found)
+    assert "int64_t" in next(f for f in found if f.rule == "ABI001").message
+
+
+def test_abi001_silent_on_fixed_width(tmp_path):
+    root = _abi_tree(tmp_path, cpp={"k.cpp": """
+        extern "C" {
+        void f(const double* x, int64_t n);
+        }
+    """})
+    assert "ABI001" not in rules(check_abi(root))
+
+
+def test_abi002_platform_width_ctypes(tmp_path):
+    root = _abi_tree(tmp_path, py={"b.py": """
+        import ctypes
+        def bind(lib):
+            lib.f.argtypes = [ctypes.c_long, ctypes.POINTER(ctypes.c_longlong)]
+            lib.f.restype = None
+    """})
+    found = [f for f in check_abi(root) if f.rule == "ABI002"]
+    assert len(found) == 2  # both the scalar and the pointer
+
+
+def test_abi003_arity_mismatch(tmp_path):
+    root = _abi_tree(
+        tmp_path,
+        cpp={"k.cpp": """
+            extern "C" {
+            void f(const double* x, int64_t n, int threads);
+            }
+        """},
+        py={"b.py": """
+            import ctypes
+            def bind(lib):
+                lib.f.argtypes = [ctypes.POINTER(ctypes.c_double),
+                                  ctypes.c_int64]
+                lib.f.restype = None
+        """},
+    )
+    assert "ABI003" in rules(check_abi(root))
+
+
+def test_abi004_per_arg_and_restype_mismatch(tmp_path):
+    root = _abi_tree(
+        tmp_path,
+        cpp={"k.cpp": """
+            extern "C" {
+            int64_t f(const double* x, int64_t n, const int64_t* cols);
+            }
+        """},
+        py={"b.py": """
+            import ctypes
+            def bind(lib):
+                lib.f.argtypes = [ctypes.POINTER(ctypes.c_double),
+                                  ctypes.c_int,          # width mismatch
+                                  ctypes.c_int64]        # pointer-depth
+                lib.f.restype = None                     # restype mismatch
+        """},
+    )
+    found = [f for f in check_abi(root) if f.rule == "ABI004"]
+    assert len(found) == 3
+    msgs = " ".join(f.message for f in found)
+    assert "arg 2" in msgs and "arg 3" in msgs and "restype" in msgs
+
+
+def test_abi004_silent_when_binding_matches(tmp_path):
+    root = _abi_tree(
+        tmp_path,
+        cpp={"k.cpp": """
+            extern "C" {
+            void* f(const char* text, int64_t n, uint8_t* out);
+            }
+        """},
+        py={"b.py": """
+            import ctypes
+            def bind(lib):
+                lib.f.argtypes = [ctypes.c_char_p, ctypes.c_int64,
+                                  ctypes.POINTER(ctypes.c_uint8)]
+                lib.f.restype = ctypes.c_void_p
+        """},
+    )
+    assert rules(check_abi(root)) == []
+
+
+def test_abi005_decl_sites_disagree(tmp_path):
+    root = _abi_tree(tmp_path, cpp={
+        "k.cpp": """
+            extern "C" {
+            void f(const double* x, int64_t n) { (void)x; (void)n; }
+            }
+        """,
+        "harness.cpp": """
+            extern "C" {
+            void f(const double*, int);
+            }
+        """,
+    })
+    found = [f for f in check_abi(root) if f.rule == "ABI005"]
+    assert len(found) == 1
+    assert found[0].file.endswith("k.cpp") or found[0].file.endswith(
+        "harness.cpp")
+
+
+def test_abi_resolves_getattr_bound_symbols(tmp_path):
+    # the repo's own idiom: optional symbol via getattr + local alias
+    root = _abi_tree(
+        tmp_path,
+        cpp={"k.cpp": """
+            extern "C" {
+            void g(const int64_t* cols, int64_t n);
+            }
+        """},
+        py={"b.py": """
+            import ctypes
+            def bind(lib):
+                fn = getattr(lib, "g", None)
+                if fn is not None:
+                    p = ctypes.POINTER(ctypes.c_int64)
+                    fn.argtypes = [p, ctypes.c_int]
+                    fn.restype = None
+        """},
+    )
+    found = [f for f in check_abi(root) if f.rule == "ABI004"]
+    assert len(found) == 1 and "arg 2" in found[0].message
+
+
+def test_nat001_unclamped_float_cast(tmp_path):
+    p = _write(str(tmp_path / "k.cpp"), """
+        extern "C" {
+        void t(const double* row, uint8_t* out) {
+          const double x = row[0];
+          int64_t v = static_cast<int64_t>(x);
+          out[0] = v > 0;
+        }
+        }
+    """)
+    found = check_float_casts(p)
+    assert rules(found) == ["NAT001"]
+
+
+def test_nat001_silent_with_clamp(tmp_path):
+    p = _write(str(tmp_path / "k.cpp"), """
+        extern "C" {
+        void t(const double* row, uint8_t* out) {
+          const double x = row[0];
+          int64_t v;
+          if (x >= 9223372036854775808.0) {
+            v = 0;
+          } else {
+            v = static_cast<int64_t>(x);
+          }
+          out[0] = v > 0;
+        }
+        }
+    """)
+    assert check_float_casts(p) == []
+
+
+def test_nat001_silent_on_integer_cast(tmp_path):
+    p = _write(str(tmp_path / "k.cpp"), """
+        void h() {
+          int64_t n = 7;
+          size_t m = static_cast<size_t>(n);
+          (void)m;
+        }
+    """)
+    assert check_float_casts(p) == []
+
+
+# ----------------------------------------------------- collective fixtures
+
+
+def test_col001_process_count_gate_without_evidence(tmp_path):
+    p = _write(str(tmp_path / "m.py"), """
+        import jax
+        def agree(local_ok):
+            if jax.process_count() == 1:
+                return local_ok
+            flags = host_allgather([1 if local_ok else 0])
+            return min(flags)
+    """)
+    found = check_collectives_file(p)
+    assert rules(found) == ["COL001"]
+
+
+def test_col001_silent_with_multi_controller_evidence(tmp_path):
+    # the FIXED trace_cache shape: evidence token in the guard chain
+    p = _write(str(tmp_path / "m.py"), """
+        import jax
+        def agree(local_ok, multi_controller):
+            if not multi_controller or jax.process_count() == 1:
+                return local_ok
+            flags = host_allgather([1 if local_ok else 0])
+            return min(flags)
+    """)
+    assert check_collectives_file(p) == []
+
+
+def test_col001_silent_on_unconditional_collective(tmp_path):
+    # no rank-dependent guard = an all-ranks caller contract, not a bug
+    p = _write(str(tmp_path / "m.py"), """
+        def merge(x):
+            return host_allgather_ragged_rows(x)
+    """)
+    assert check_collectives_file(p) == []
+
+
+def test_col001_ternary_guard(tmp_path):
+    p = _write(str(tmp_path / "m.py"), """
+        import jax
+        def total(x):
+            return host_allgather([len(x)]).sum() if jax.process_count() > 1 else len(x)
+    """)
+    assert rules(check_collectives_file(p)) == ["COL001"]
+
+
+def test_col002_mismatched_branch_sequences(tmp_path):
+    p = _write(str(tmp_path / "m.py"), """
+        def stats(x, fast):
+            if fast:
+                a = host_allgather(x)
+                b = host_allgather_ragged_rows(x)
+            else:
+                b = host_allgather_ragged_rows(x)
+                a = host_allgather(x)
+            return a, b
+    """)
+    assert rules(check_collectives_file(p)) == ["COL002"]
+
+
+def test_col002_silent_when_sequences_match(tmp_path):
+    p = _write(str(tmp_path / "m.py"), """
+        def stats(x, fast):
+            if fast:
+                a = host_allgather(x + 1)
+            else:
+                a = host_allgather(x - 1)
+            return a
+    """)
+    assert check_collectives_file(p) == []
+
+
+def test_col003_rank_pinned_guard(tmp_path):
+    p = _write(str(tmp_path / "m.py"), """
+        import jax
+        def save(x):
+            if jax.process_index() == 0:
+                host_allgather(x)
+    """)
+    assert rules(check_collectives_file(p)) == ["COL003"]
+
+
+# --------------------------------------------------------- tracer fixtures
+
+
+def test_trc001_if_on_traced_param(tmp_path):
+    p = _write(str(tmp_path / "m.py"), """
+        import jax
+        @jax.jit
+        def f(x):
+            if x > 0:
+                return x
+            return -x
+    """)
+    assert rules(check_tracer_file(p)) == ["TRC001"]
+
+
+def test_trc001_while_and_jit_call_form(tmp_path):
+    p = _write(str(tmp_path / "m.py"), """
+        import jax
+        def outer():
+            def g(x):
+                while x < 10:
+                    x = x * 2
+                return x
+            return jax.jit(g)
+    """)
+    assert rules(check_tracer_file(p)) == ["TRC001"]
+
+
+def test_trc001_silent_on_static_tests(tmp_path):
+    p = _write(str(tmp_path / "m.py"), """
+        import jax
+        from functools import partial
+        @partial(jax.jit, static_argnames=("k",))
+        def f(x, y, k):
+            if x.shape[0] > 2:     # shapes are static
+                y = y + 1
+            if y is None:          # identity, not value
+                return x
+            if len(x) > 3:         # len is static
+                y = y * 2
+            if k:                  # static_argnames-exempt
+                return y
+            return x + y
+    """)
+    assert check_tracer_file(p) == []
+
+
+def test_trc002_np_call_on_traced_arg(tmp_path):
+    p = _write(str(tmp_path / "m.py"), """
+        import jax
+        import numpy as np
+        @jax.jit
+        def f(x):
+            return np.sum(x)
+    """)
+    assert rules(check_tracer_file(p)) == ["TRC002"]
+
+
+def test_trc002_silent_on_np_constants(tmp_path):
+    p = _write(str(tmp_path / "m.py"), """
+        import jax
+        import numpy as np
+        @jax.jit
+        def f(x):
+            return x + np.float32(1.5) + np.zeros(3)
+    """)
+    assert check_tracer_file(p) == []
+
+
+def test_trc003_jnp_in_host_only_module(tmp_path):
+    p = _write(str(tmp_path / "frame.py"), """
+        import jax.numpy as jnp
+        def to_cols(df):
+            return jnp.asarray(df)
+    """)
+    assert rules(check_host_only_file(p)) == ["TRC003"]
+    clean = _write(str(tmp_path / "frame2.py"), """
+        import numpy as np
+        def to_cols(df):
+            return np.asarray(df)
+    """)
+    assert check_host_only_file(clean) == []
+
+
+# -------------------------------------------------------- hygiene fixtures
+
+
+def test_hyg001_atime_eviction_without_utime(tmp_path):
+    p = _write(str(tmp_path / "cache.py"), """
+        import os
+        def prune(path):
+            entries = []
+            with os.scandir(path) as it:
+                for e in it:
+                    st = e.stat()
+                    entries.append((st.st_atime, e.path))
+            for _, p in sorted(entries)[:-10]:
+                os.remove(p)
+    """)
+    assert rules(check_hygiene_file(p)) == ["HYG001"]
+
+
+def test_hyg001_silent_with_utime_on_hit(tmp_path):
+    p = _write(str(tmp_path / "cache.py"), """
+        import os
+        def record_hit(path):
+            os.utime(path)
+        def prune(path):
+            entries = []
+            with os.scandir(path) as it:
+                for e in it:
+                    st = e.stat()
+                    entries.append((max(st.st_atime, st.st_mtime), e.path))
+            for _, p in sorted(entries)[:-10]:
+                os.remove(p)
+    """)
+    assert check_hygiene_file(p) == []
+
+
+# ------------------------------------------------------------ suppressions
+
+
+def test_suppression_round_trip(tmp_path):
+    bad = """
+        import jax
+        def save(x):
+            if jax.process_index() == 0:
+                host_allgather(x){supp}
+    """
+    fires = _write(str(tmp_path / "a.py"), bad.format(supp=""))
+    assert rules(apply_suppressions(check_collectives_file(fires))) == [
+        "COL003"]
+
+    silenced = _write(str(tmp_path / "b.py"),
+                      bad.format(supp="  # analyze: ignore[COL003]"))
+    assert apply_suppressions(check_collectives_file(silenced)) == []
+
+    wrong_rule = _write(str(tmp_path / "c.py"),
+                        bad.format(supp="  # analyze: ignore[COL001]"))
+    assert rules(apply_suppressions(check_collectives_file(wrong_rule))) == [
+        "COL003"]
+
+
+def test_suppression_line_above_and_cpp_style(tmp_path):
+    p = _write(str(tmp_path / "m.py"), """
+        import jax
+        def total(x):
+            # analyze: ignore[COL001]
+            return host_allgather(x) if jax.process_count() > 1 else x
+    """)
+    assert apply_suppressions(check_collectives_file(p)) == []
+
+    cpp = _write(str(tmp_path / "k.cpp"), """
+        extern "C" {
+        void t(const double* row, int64_t* out) {
+          const double x = row[0];
+          // analyze: ignore[NAT001]
+          out[0] = static_cast<int64_t>(x);
+        }
+        }
+    """)
+    assert apply_suppressions(check_float_casts(cpp)) == []
+
+
+def test_unsuppressed_findings_pass_through(tmp_path):
+    f = Finding(str(tmp_path / "nope.py"), 3, "COL001", "msg")
+    assert apply_suppressions([f]) == [f]
+
+
+# ------------------------------------- ADVICE r5 regression demonstrations
+
+
+def test_advice_trace_cache_deadlock_would_be_caught(tmp_path):
+    """ADVICE r5 medium: the literal pre-fix wrap_aot agreement helper —
+    collective gated on process_count with no program-level evidence."""
+    p = _write(str(tmp_path / "trace_cache.py"), """
+        import numpy as np
+        def _all_processes_ok(local_ok):
+            import jax
+            if jax.process_count() == 1:
+                return local_ok
+            from mmlspark_tpu.parallel.distributed import host_allgather
+            flags = host_allgather(np.asarray([1 if local_ok else 0]))
+            return bool(flags.reshape(-1).min())
+    """)
+    assert rules(check_collectives_file(p)) == ["COL001"]
+
+
+def test_advice_c_long_bindings_would_be_caught(tmp_path):
+    """ADVICE r5 low: the literal pre-fix _bind_binner ctypes block."""
+    root = _abi_tree(
+        tmp_path,
+        cpp={"binner.cpp": """
+            extern "C" {
+            void mml_binner_fit(const double* Xs, long n, long F,
+                                int max_bin, int min_data_in_bin,
+                                const uint8_t* skip, double* out_uppers,
+                                int* out_counts, int n_threads) {}
+            }
+        """},
+        py={"__init__.py": """
+            import ctypes
+            def _bind_binner(lib):
+                c_double_p = ctypes.POINTER(ctypes.c_double)
+                c_int_p = ctypes.POINTER(ctypes.c_int)
+                c_u8_p = ctypes.POINTER(ctypes.c_uint8)
+                lib.mml_binner_fit.argtypes = [
+                    c_double_p, ctypes.c_long, ctypes.c_long,
+                    ctypes.c_int, ctypes.c_int, c_u8_p,
+                    c_double_p, c_int_p, ctypes.c_int,
+                ]
+                lib.mml_binner_fit.restype = None
+        """},
+    )
+    got = set(rules(check_abi(root)))
+    # platform-width flagged on BOTH sides of the boundary
+    assert {"ABI001", "ABI002"} <= got
+
+
+def test_advice_clamp_divergence_would_be_caught(tmp_path):
+    """ADVICE r5 low: the pre-fix transform_cat cast — a bare
+    static_cast<int64_t> of an out-of-range-able double."""
+    p = _write(str(tmp_path / "binner.cpp"), """
+        extern "C" {
+        void cat(const double* row, int64_t f, uint8_t* orow) {
+          const double x = row[f];
+          const int64_t v = static_cast<int64_t>(x);
+          orow[f] = v > 0;
+        }
+        }
+    """)
+    assert rules(check_float_casts(p)) == ["NAT001"]
+
+
+def test_advice_relatime_lru_would_be_caught(tmp_path):
+    """ADVICE r5 low: the pre-fix jit_cache prune — atime-ordered LRU
+    with no utime-on-hit anywhere in the module."""
+    p = _write(str(tmp_path / "jit_cache.py"), """
+        import os
+        def prune_cache_dir(path, budget):
+            entries = []
+            with os.scandir(path) as it:
+                for e in it:
+                    if e.is_file():
+                        st = e.stat()
+                        entries.append(
+                            (max(st.st_atime, st.st_mtime), st.st_size, e.path))
+            total = sum(s for _, s, _ in entries)
+            removed = 0
+            for _, size, p in sorted(entries):
+                if total <= budget:
+                    break
+                os.remove(p)
+                removed += 1
+                total -= size
+            return removed
+    """)
+    assert rules(check_hygiene_file(p)) == ["HYG001"]
+
+
+# ------------------------------------------------------------------- CLI
+
+
+def test_cli_exit_codes_and_json(tmp_path, capsys):
+    from tools.analyze.__main__ import main
+
+    assert main([]) == 0  # the real tree is clean
+    out = capsys.readouterr().out
+    assert "0 finding(s)" in out
+
+    assert main(["--json"]) == 0
+    assert capsys.readouterr().out.strip() == "[]"
+
+    # a dirty root exits 1 and reports file:line
+    _write(str(tmp_path / "mmlspark_tpu" / "native" / "k.cpp"), """
+        extern "C" {
+        void f(long n);
+        }
+    """)
+    _write(str(tmp_path / "mmlspark_tpu" / "__init__.py"), "")
+    assert main(["--root", str(tmp_path)]) == 1
+    out = capsys.readouterr().out
+    assert "ABI001" in out and "k.cpp:3" in out

@@ -1,0 +1,39 @@
+"""Repo-native static-analysis suite (see README.md in this directory).
+
+Three main passes plus a hygiene pass, each returning
+:class:`tools.analyze.common.Finding` rows; :func:`run_all` runs them
+over a repo root and applies inline ``# analyze: ignore[RULE]``
+suppressions.  CLI: ``python -m tools.analyze [--json]``.
+"""
+
+from __future__ import annotations
+
+import os
+
+from tools.analyze.abi import check_abi
+from tools.analyze.collectives import check_collectives
+from tools.analyze.common import Finding, apply_suppressions
+from tools.analyze.hygiene import check_hygiene
+from tools.analyze.tracer import check_tracer
+
+__all__ = [
+    "Finding", "run_all", "repo_root",
+    "check_abi", "check_collectives", "check_tracer", "check_hygiene",
+]
+
+
+def repo_root() -> str:
+    return os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+
+
+def run_all(root: "str | None" = None) -> list:
+    root = root or repo_root()
+    findings: list = []
+    findings.extend(check_abi(root))
+    findings.extend(check_collectives(root))
+    findings.extend(check_tracer(root))
+    findings.extend(check_hygiene(root))
+    findings = apply_suppressions(findings)
+    findings.sort(key=lambda f: (f.file, f.line, f.rule))
+    return findings

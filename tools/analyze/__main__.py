@@ -1,0 +1,38 @@
+"""``python -m tools.analyze [--json] [--root PATH]`` — run every pass.
+
+Exit 0 when the tree is clean, 1 when any finding survives suppression
+(the same contract the CI job and tests/test_static_analysis.py rely
+on).
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import sys
+
+from tools.analyze import repo_root, run_all
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="python -m tools.analyze")
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable findings on stdout")
+    ap.add_argument("--root", default=None,
+                    help="repo root to analyze (default: this checkout)")
+    opts = ap.parse_args(argv)
+    findings = run_all(opts.root)
+    if opts.json:
+        print(json.dumps([dataclasses.asdict(f) for f in findings],
+                         indent=2))
+    else:
+        for f in findings:
+            print(f)
+        root = opts.root or repo_root()
+        print(f"tools.analyze: {len(findings)} finding(s) in {root}")
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

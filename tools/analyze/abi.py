@@ -1,0 +1,411 @@
+"""Pass 1 — ctypes <-> extern "C" ABI cross-checker.
+
+The native kernels are reached through hand-written ctypes bindings, so
+nothing in the toolchain verifies that the Python argtypes and the C
+signatures agree — the r5 advisor found `c_long` bindings against
+`int64_t`-shaped tables exactly because no machine was looking.  This
+pass parses every ``extern "C"`` block in ``native/*.cpp`` and every
+``argtypes``/``restype`` assignment in ``native/*.py`` and cross-checks
+them argument-by-argument.
+
+Rules
+-----
+- ABI001: platform-width C type (``long`` family) in an extern "C"
+  signature — 32-bit on LLP64 (Windows); use a fixed-width ``int64_t``.
+- ABI002: platform-width ctypes type (``c_long``/``c_longlong`` family)
+  in a binding — same LLP64 hazard from the Python side.
+- ABI003: arity disagreement between a binding and the C declaration.
+- ABI004: per-argument base-type or pointer-depth disagreement (also
+  covers the return type).
+- ABI005: two C declaration sites (e.g. the kernel definition and the
+  sanitizer harness's forward decls) disagree with each other.
+- NAT001: ``static_cast<int-type>(x)`` on a ``double``/``float`` local
+  with no range clamp in sight — UB out of range, and x86 (cvttsd2si →
+  INT64_MIN) and aarch64 (fcvtzs → saturate) resolve the UB differently,
+  which is how fit tables and the C++ transform diverged in ADVICE r5.
+
+Parsing is deliberately a few hundred lines of regex + ast over the
+repo's own idioms (block-form ``extern "C"``, list-literal argtypes) —
+not a C front end.  Unrecognized constructs are skipped, never guessed.
+"""
+
+from __future__ import annotations
+
+import ast
+import glob
+import os
+import re
+from dataclasses import dataclass, field
+
+from tools.analyze.common import Finding
+
+# Canonical C base types the parser recognizes (param names are whatever
+# identifier is left over after these).
+_C_TYPE_WORDS = {
+    "void", "char", "short", "int", "long", "unsigned", "signed", "float",
+    "double", "bool", "size_t", "ssize_t", "ptrdiff_t", "intptr_t",
+    "uintptr_t", "int8_t", "uint8_t", "int16_t", "uint16_t", "int32_t",
+    "uint32_t", "int64_t", "uint64_t",
+}
+_C_QUALIFIERS = {"const", "volatile", "restrict", "struct", "register"}
+
+# Platform-width bases: 32-bit on LLP64 Windows, 64-bit on LP64 — never a
+# safe width to marshal tables through.
+_PLATFORM_WIDTH = {
+    "long", "unsigned long", "signed long", "long long",
+    "unsigned long long", "signed long long",
+}
+
+# ctypes name -> (canonical C base, pointer depth)
+_CTYPES_MAP = {
+    "c_double": ("double", 0), "c_float": ("float", 0),
+    "c_int": ("int", 0), "c_uint": ("unsigned int", 0),
+    "c_int8": ("int8_t", 0), "c_uint8": ("uint8_t", 0),
+    "c_int16": ("int16_t", 0), "c_uint16": ("uint16_t", 0),
+    "c_int32": ("int32_t", 0), "c_uint32": ("uint32_t", 0),
+    "c_int64": ("int64_t", 0), "c_uint64": ("uint64_t", 0),
+    "c_long": ("long", 0), "c_ulong": ("unsigned long", 0),
+    "c_longlong": ("long long", 0), "c_ulonglong": ("unsigned long long", 0),
+    "c_size_t": ("size_t", 0), "c_ssize_t": ("ssize_t", 0),
+    "c_char": ("char", 0), "c_bool": ("bool", 0),
+    "c_char_p": ("char", 1), "c_void_p": ("void", 1),
+}
+
+
+@dataclass(frozen=True)
+class CType:
+    base: str
+    ptr: int
+
+    def __str__(self) -> str:
+        return self.base + "*" * self.ptr
+
+
+@dataclass
+class CDecl:
+    name: str
+    ret: CType
+    args: list
+    file: str
+    line: int
+
+
+@dataclass
+class PyBinding:
+    name: str
+    args: list = field(default_factory=list)  # CType | None per arg
+    restype: object = None  # CType | None (unresolved)
+    args_line: int = 0
+    restype_line: int = 0
+    file: str = ""
+
+
+# ---------------------------------------------------------------- C side
+
+_LINE_COMMENT = re.compile(r"//[^\n]*")
+_BLOCK_COMMENT = re.compile(r"/\*.*?\*/", re.S)
+_DECL_RE = re.compile(
+    r"((?:[A-Za-z_][A-Za-z0-9_]*[\s*]+)+?)"  # return type tokens
+    r"([A-Za-z_][A-Za-z0-9_]*)\s*"           # function name
+    r"\(([^()]*)\)\s*(\{|;)",                # params, then body or proto
+    re.S,
+)
+
+
+def _strip_comments(text: str) -> str:
+    # keep offsets stable: replace comment chars with spaces, not deletion
+    def blank(m):
+        return re.sub(r"[^\n]", " ", m.group(0))
+
+    return _LINE_COMMENT.sub(blank, _BLOCK_COMMENT.sub(blank, text))
+
+
+def _parse_type(tokens: str, drop_name: bool) -> "CType | None":
+    parts = re.findall(r"[A-Za-z_][A-Za-z0-9_]*|\*", tokens)
+    ptr = parts.count("*")
+    words = [p for p in parts if p != "*" and p not in _C_QUALIFIERS]
+    if drop_name and len(words) > 1 and words[-1] not in _C_TYPE_WORDS:
+        words = words[:-1]  # trailing parameter name
+    if not words or any(w not in _C_TYPE_WORDS for w in words):
+        return None
+    return CType(" ".join(words), ptr)
+
+
+def _match_brace(text: str, open_pos: int) -> int:
+    depth = 0
+    for i in range(open_pos, len(text)):
+        if text[i] == "{":
+            depth += 1
+        elif text[i] == "}":
+            depth -= 1
+            if depth == 0:
+                return i
+    return len(text) - 1
+
+
+def parse_c_decls(path: str) -> list:
+    """Every function declared/defined inside ``extern "C" { ... }``."""
+    with open(path, encoding="utf-8", errors="replace") as fh:
+        text = _strip_comments(fh.read())
+    decls = []
+    for em in re.finditer(r'extern\s+"C"\s*\{', text):
+        start = em.end()
+        end = _match_brace(text, em.end() - 1)
+        pos = start
+        while pos < end:
+            m = _DECL_RE.search(text, pos, end)
+            if not m:
+                break
+            ret = _parse_type(m.group(1), drop_name=False)
+            if ret is None:  # not a decl (e.g. a static initializer)
+                pos = m.end()
+                continue
+            params = m.group(3).strip()
+            args = []
+            if params and params != "void":
+                for p in params.split(","):
+                    args.append(_parse_type(p, drop_name=True))
+            line = text.count("\n", 0, m.start(2)) + 1
+            decls.append(CDecl(m.group(2), ret, args, path, line))
+            if m.group(4) == "{":  # skip the body before the next search
+                pos = _match_brace(text, m.end() - 1) + 1
+            else:
+                pos = m.end()
+    return decls
+
+
+_CAST_RE = re.compile(
+    r"static_cast<\s*((?:unsigned\s+|signed\s+)?(?:long\s+long|long|int|"
+    r"int64_t|int32_t|uint64_t|uint32_t|size_t))\s*>\s*\(\s*"
+    r"([A-Za-z_][A-Za-z0-9_]*)\s*\)"
+)
+_RANGE_TOKENS = (
+    "9223372036854775808", "2147483647", "numeric_limits", "INT64_MAX",
+    "INT64_MIN", "INT32_MAX", "isfinite", "llrint", "lrint",
+)
+_NAT_LOOKBACK = 12  # lines of context that count as "a clamp in sight"
+
+
+def check_float_casts(path: str) -> list:
+    """NAT001: unclamped float->int static_casts (identifier-arg only)."""
+    with open(path, encoding="utf-8", errors="replace") as fh:
+        text = _strip_comments(fh.read())
+    lines = text.splitlines()
+    findings = []
+    for m in _CAST_RE.finditer(text):
+        var = m.group(2)
+        line = text.count("\n", 0, m.start()) + 1
+        # float-ness: the variable is declared double/float earlier in the
+        # file (function-locality is approximated by the whole file — the
+        # kernels are short and param names don't collide across types).
+        # The name must directly follow the type word: `double x`, not a
+        # pointer (`double* x` casts of x are address-width, not value).
+        decl_re = re.compile(
+            r"\b(?:double|float)\s+" + re.escape(var) + r"\b"
+        )
+        before = "\n".join(lines[:line])
+        if not decl_re.search(before):
+            continue
+        ctx = "\n".join(lines[max(0, line - 1 - _NAT_LOOKBACK):line + 1])
+        if any(tok in ctx for tok in _RANGE_TOKENS):
+            continue
+        findings.append(Finding(
+            path, line, "NAT001",
+            f"static_cast<{m.group(1)}>({var}) on a floating value with no "
+            "range clamp nearby: out-of-range float->int is UB and "
+            "x86/aarch64 materialize it differently (INT64_MIN vs "
+            "saturate) — clamp explicitly (see binner.cpp transform_cat)",
+        ))
+    return findings
+
+
+# ----------------------------------------------------------- Python side
+
+
+def _ctypes_name(node) -> "str | None":
+    """The trailing ctypes identifier of ``ctypes.c_x`` / bare ``c_x``."""
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return None
+
+
+def _resolve_ctype(node, env) -> "CType | None":
+    if node is None or (isinstance(node, ast.Constant) and node.value is None):
+        return CType("void", 0)  # restype = None
+    if isinstance(node, ast.Call):
+        fn = _ctypes_name(node.func)
+        if fn == "POINTER" and node.args:
+            inner = _resolve_ctype(node.args[0], env)
+            if inner is not None:
+                return CType(inner.base, inner.ptr + 1)
+        return None
+    name = _ctypes_name(node)
+    if name is None:
+        return None
+    if isinstance(node, ast.Name) and name in env:
+        return env[name]
+    if name in _CTYPES_MAP:
+        return CType(*_CTYPES_MAP[name])
+    return None
+
+
+def _symbol_of_target(node, sym_env) -> "str | None":
+    """The C symbol a ``<x>.argtypes`` target refers to.
+
+    ``lib.mml_fit.argtypes`` -> mml_fit; ``fn.argtypes`` where
+    ``fn = getattr(lib, "mml_cat", None)`` or ``fn = lib.mml_cat``.
+    """
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return sym_env.get(node.id)
+    return None
+
+
+def parse_ctypes_bindings(path: str) -> list:
+    with open(path, encoding="utf-8", errors="replace") as fh:
+        tree = ast.parse(fh.read(), filename=path)
+    bindings: dict[str, PyBinding] = {}
+
+    def visit_body(body, type_env, sym_env):
+        for stmt in body:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                visit_body(stmt.body, dict(type_env), dict(sym_env))
+                continue
+            for node in ast.walk(stmt):
+                if not isinstance(node, ast.Assign) or len(node.targets) != 1:
+                    continue
+                tgt = node.targets[0]
+                if isinstance(tgt, ast.Name):
+                    # alias?  c_i64_p = ctypes.POINTER(ctypes.c_int64)
+                    ct = _resolve_ctype(node.value, type_env)
+                    if ct is not None and not (
+                        isinstance(node.value, ast.Constant)
+                    ):
+                        type_env[tgt.id] = ct
+                    # symbol alias?  fn = getattr(lib, "name", ...) | lib.name
+                    v = node.value
+                    if (isinstance(v, ast.Call)
+                            and isinstance(v.func, ast.Name)
+                            and v.func.id == "getattr"
+                            and len(v.args) >= 2
+                            and isinstance(v.args[1], ast.Constant)):
+                        sym_env[tgt.id] = v.args[1].value
+                    elif isinstance(v, ast.Attribute):
+                        sym_env[tgt.id] = v.attr
+                    continue
+                if not isinstance(tgt, ast.Attribute):
+                    continue
+                if tgt.attr not in ("argtypes", "restype"):
+                    continue
+                sym = _symbol_of_target(tgt.value, sym_env)
+                if sym is None:
+                    continue
+                b = bindings.setdefault(sym, PyBinding(sym, file=path))
+                if tgt.attr == "argtypes":
+                    if isinstance(node.value, (ast.List, ast.Tuple)):
+                        b.args = [
+                            _resolve_ctype(e, type_env)
+                            for e in node.value.elts
+                        ]
+                        b.args_line = node.lineno
+                else:
+                    b.restype = _resolve_ctype(node.value, type_env)
+                    b.restype_line = node.lineno
+
+    visit_body(tree.body, {}, {})
+    return list(bindings.values())
+
+
+# ---------------------------------------------------------------- checks
+
+
+def _types_equal(a: CType, b: CType) -> bool:
+    # small alias set; platform-width bases are flagged separately so no
+    # equivalence is granted to them here
+    alias = {"signed int": "int", "unsigned char": "uint8_t",
+             "signed char": "int8_t"}
+    return (alias.get(a.base, a.base), a.ptr) == \
+        (alias.get(b.base, b.base), b.ptr)
+
+
+def check_abi(root: str) -> list:
+    native = os.path.join(root, "mmlspark_tpu", "native")
+    findings: list = []
+
+    c_by_name: dict[str, list] = {}
+    for cpp in sorted(glob.glob(os.path.join(native, "*.cpp"))):
+        for d in parse_c_decls(cpp):
+            c_by_name.setdefault(d.name, []).append(d)
+            for i, t in enumerate([d.ret] + d.args):
+                if t is not None and t.base in _PLATFORM_WIDTH:
+                    where = "return" if i == 0 else f"arg {i}"
+                    findings.append(Finding(
+                        d.file, d.line, "ABI001",
+                        f"{d.name} {where} uses platform-width '{t}' "
+                        "(32-bit on LLP64) — use a fixed-width int64_t",
+                    ))
+        findings.extend(check_float_casts(cpp))
+
+    # ABI005: the declaration sites must agree among themselves
+    for name, decls in c_by_name.items():
+        ref = decls[0]
+        for other in decls[1:]:
+            if len(other.args) != len(ref.args):
+                findings.append(Finding(
+                    other.file, other.line, "ABI005",
+                    f"{name} declared with {len(other.args)} args here but "
+                    f"{len(ref.args)} at {ref.file}:{ref.line}",
+                ))
+                continue
+            for i, (a, b) in enumerate(zip(
+                    [other.ret] + other.args, [ref.ret] + ref.args)):
+                if a is None or b is None or _types_equal(a, b):
+                    continue
+                where = "return" if i == 0 else f"arg {i}"
+                findings.append(Finding(
+                    other.file, other.line, "ABI005",
+                    f"{name} {where} is '{a}' here but '{b}' at "
+                    f"{ref.file}:{ref.line}",
+                ))
+
+    for py in sorted(glob.glob(os.path.join(native, "*.py"))):
+        for b in parse_ctypes_bindings(py):
+            for i, t in enumerate([b.restype] + b.args):
+                if isinstance(t, CType) and t.base in _PLATFORM_WIDTH:
+                    where = "restype" if i == 0 else f"arg {i}"
+                    line = b.restype_line if i == 0 else b.args_line
+                    findings.append(Finding(
+                        b.file, line, "ABI002",
+                        f"{b.name} {where} uses platform-width ctypes "
+                        f"'{t}' — use ctypes.c_int64 / POINTER(c_int64)",
+                    ))
+            decls = c_by_name.get(b.name)
+            if not decls:
+                continue
+            d = decls[0]
+            if b.args and len(b.args) != len(d.args):
+                findings.append(Finding(
+                    b.file, b.args_line, "ABI003",
+                    f"{b.name} bound with {len(b.args)} argtypes but the C "
+                    f"declaration at {d.file}:{d.line} takes {len(d.args)}",
+                ))
+            elif b.args:
+                for i, (pt, ct) in enumerate(zip(b.args, d.args), start=1):
+                    if pt is None or ct is None or _types_equal(pt, ct):
+                        continue
+                    findings.append(Finding(
+                        b.file, b.args_line, "ABI004",
+                        f"{b.name} arg {i} bound as '{pt}' but declared "
+                        f"'{ct}' at {d.file}:{d.line}",
+                    ))
+            if (isinstance(b.restype, CType) and d.ret is not None
+                    and not _types_equal(b.restype, d.ret)):
+                findings.append(Finding(
+                    b.file, b.restype_line, "ABI004",
+                    f"{b.name} restype bound as '{b.restype}' but declared "
+                    f"'{d.ret}' at {d.file}:{d.line}",
+                ))
+    return findings

@@ -1,0 +1,220 @@
+"""Pass 2 — collective-safety lint over ``mmlspark_tpu/``.
+
+Blocking host collectives (``host_allgather``, ``multihost_utils.*``,
+barrier calls) deadlock the whole job when one rank enters and another
+does not.  The r5 advisor's ``trace_cache.wrap_aot`` finding is the
+archetype: the agreement allgather was gated on ``jax.process_count() >
+1`` — a property of the JOB — instead of on whether the wrapped program
+is actually executed by every rank (a property of the PROGRAM, e.g. a
+mesh spanning processes).  A meshless rank-local train inside a
+multi-process job satisfied the guard on one rank only and hung.
+
+Rules
+-----
+- COL001: a collective guarded by a condition that inspects
+  ``jax.process_count()`` / ``jax.process_index()`` with no all-ranks
+  evidence in the guard chain (``process_local``, ``multi_controller``,
+  ``mesh_spans_processes`` — tokens the engine uses for "every rank runs
+  this path by construction").  Unconditional collectives are QUIET: a
+  collective with no rank-dependent guard states an all-ranks contract
+  the caller must honor (and booster.py's are all under
+  ``process_local``).
+- COL002: both branches of one ``if``/``else`` issue collectives but in
+  different sequences — ranks taking different branches pair unrelated
+  collectives and deadlock or exchange garbage.
+- COL003: a collective under a rank-PINNED guard
+  (``process_index() == 0``-style) — guaranteed single-rank entry.
+
+Guards counted for a statement: every enclosing ``if``/ternary test plus
+any earlier same-block ``if`` whose body unconditionally leaves the
+function (early ``return``/``raise`` — the negated test governs what
+follows).  ``mmlspark_tpu/parallel/distributed.py`` is exempt: it
+DEFINES the primitives.
+"""
+
+from __future__ import annotations
+
+import ast
+import glob
+import os
+import re
+
+from tools.analyze.common import Finding
+
+COLLECTIVE_NAMES = {
+    "host_allgather", "host_allgather_ragged_rows", "process_allgather",
+    "sync_global_devices", "broadcast_one_to_all",
+    "reached_preemption_sync_point", "global_barrier",
+}
+# any attribute reached through these modules is treated as a collective
+COLLECTIVE_MODULES = {"multihost_utils", "mhu"}
+
+# tokens that attest "every participating rank executes this path"
+EVIDENCE_TOKENS = (
+    "process_local", "multi_controller", "mesh_spans_processes",
+    "spans_processes", "all_ranks",
+)
+
+_RANK_QUERY = re.compile(r"process_(?:count|index)\s*\(")
+_RANK_PINNED = re.compile(
+    r"process_index\s*\(\s*\)\s*(?:==|!=)\s*\d+"
+    r"|\d+\s*(?:==|!=)\s*(?:\w+\.)*process_index\s*\(\s*\)"
+)
+
+EXEMPT = (os.path.join("parallel", "distributed.py"),)
+
+
+def _collective_name(call: ast.Call) -> "str | None":
+    fn = call.func
+    if isinstance(fn, ast.Name) and fn.id in COLLECTIVE_NAMES:
+        return fn.id
+    if isinstance(fn, ast.Attribute):
+        if fn.attr in COLLECTIVE_NAMES:
+            return fn.attr
+        base = fn.value
+        if isinstance(base, ast.Name) and base.id in COLLECTIVE_MODULES:
+            return f"{base.id}.{fn.attr}"
+        if (isinstance(base, ast.Attribute)
+                and base.attr in COLLECTIVE_MODULES):
+            return f"{base.attr}.{fn.attr}"
+    return None
+
+
+def _collective_sequence(node) -> list:
+    """Ordered collective call names anywhere under ``node``."""
+    out = []
+    for n in ast.walk(node):
+        if isinstance(n, ast.Call):
+            name = _collective_name(n)
+            if name:
+                out.append(name)
+    return out
+
+
+def _terminates(body: list) -> bool:
+    return bool(body) and isinstance(
+        body[-1], (ast.Return, ast.Raise, ast.Continue, ast.Break)
+    )
+
+
+class _Scanner:
+    def __init__(self, path: str):
+        self.path = path
+        self.findings: list = []
+
+    # -- guard bookkeeping ------------------------------------------------
+    def _check_call(self, call: ast.Call, guards: list):
+        name = _collective_name(call)
+        if name is None:
+            return
+        src = " || ".join(guards)
+        if not _RANK_QUERY.search(src):
+            return  # unconditional / opaque-boolean guards: caller contract
+        if _RANK_PINNED.search(src):
+            self.findings.append(Finding(
+                self.path, call.lineno, "COL003",
+                f"collective {name}() under a rank-pinned guard "
+                f"({src!r}) — only one rank ever enters; every other "
+                "rank deadlocks waiting",
+            ))
+            return
+        if any(tok in src for tok in EVIDENCE_TOKENS):
+            return
+        self.findings.append(Finding(
+            self.path, call.lineno, "COL001",
+            f"collective {name}() gated on a rank query ({src!r}) with no "
+            "all-ranks evidence (process_local / multi_controller / "
+            "mesh_spans_processes) — a rank not executing this path "
+            "deadlocks the job (the trace_cache.wrap_aot class)",
+        ))
+
+    def _scan_expr(self, node, guards: list):
+        """Walk an expression, descending through ternaries with their
+        tests added to the guard chain."""
+        if isinstance(node, ast.IfExp):
+            test_src = ast.unparse(node.test)
+            self._scan_expr(node.test, guards)
+            self._scan_expr(node.body, guards + [test_src])
+            self._scan_expr(node.orelse, guards + [f"not ({test_src})"])
+            return
+        if isinstance(node, ast.Call):
+            self._check_call(node, guards)
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.IfExp):
+                self._scan_expr(child, guards)
+            elif isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                    ast.Lambda)):
+                continue
+            else:
+                self._scan_expr(child, guards)
+
+    def scan_body(self, body: list, guards: list):
+        negated: list = []  # tests of earlier early-return ifs
+        for stmt in body:
+            g = guards + negated
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self.scan_body(stmt.body, [])  # fresh frame: called elsewhere
+            elif isinstance(stmt, ast.ClassDef):
+                self.scan_body(stmt.body, [])
+            elif isinstance(stmt, ast.If):
+                test_src = ast.unparse(stmt.test)
+                self._scan_expr(stmt.test, g)
+                self.scan_body(stmt.body, g + [test_src])
+                if stmt.orelse:
+                    self.scan_body(stmt.orelse, g + [f"not ({test_src})"])
+                    self._check_branch_order(stmt)
+                if _terminates(stmt.body) and not stmt.orelse:
+                    negated.append(f"not ({test_src})")
+            elif isinstance(stmt, (ast.For, ast.AsyncFor, ast.While)):
+                if isinstance(stmt, ast.While):
+                    self._scan_expr(stmt.test, g)
+                else:
+                    self._scan_expr(stmt.iter, g)
+                self.scan_body(stmt.body, g)
+                self.scan_body(stmt.orelse, g)
+            elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+                for item in stmt.items:
+                    self._scan_expr(item.context_expr, g)
+                self.scan_body(stmt.body, g)
+            elif isinstance(stmt, ast.Try):
+                self.scan_body(stmt.body, g)
+                for h in stmt.handlers:
+                    self.scan_body(h.body, g)
+                self.scan_body(stmt.orelse, g)
+                self.scan_body(stmt.finalbody, g)
+            else:
+                self._scan_expr(stmt, g)
+
+    def _check_branch_order(self, stmt: ast.If):
+        a = _collective_sequence(ast.Module(body=stmt.body, type_ignores=[]))
+        b = _collective_sequence(ast.Module(body=stmt.orelse, type_ignores=[]))
+        if a and b and a != b:
+            self.findings.append(Finding(
+                self.path, stmt.lineno, "COL002",
+                f"if/else branches issue different collective sequences "
+                f"({a} vs {b}) — ranks taking different branches pair "
+                "unrelated collectives",
+            ))
+
+
+def check_collectives(root: str) -> list:
+    findings: list = []
+    pkg = os.path.join(root, "mmlspark_tpu")
+    for py in sorted(glob.glob(os.path.join(pkg, "**", "*.py"),
+                               recursive=True)):
+        rel = os.path.relpath(py, pkg)
+        if rel in EXEMPT:
+            continue
+        findings.extend(check_collectives_file(py))
+    return findings
+
+
+def check_collectives_file(path: str) -> list:
+    try:
+        with open(path, encoding="utf-8", errors="replace") as fh:
+            tree = ast.parse(fh.read(), filename=path)
+    except SyntaxError:
+        return []
+    s = _Scanner(path)
+    s.scan_body(tree.body, [])
+    return s.findings

@@ -1,0 +1,65 @@
+"""Shared plumbing for the analysis passes: findings + suppressions.
+
+Every pass emits :class:`Finding` rows (file, line, rule, message) and the
+driver filters them through inline suppression comments:
+
+    x = risky_thing()  # analyze: ignore[COL001]
+    // analyze: ignore[ABI001]          (C++ sources)
+
+A suppression silences the named rule(s) on its own line and on the line
+directly below it (so a comment can sit above a multi-line statement).
+``ignore[RULE1,RULE2]`` lists several rules; the rule id must match
+exactly — there is deliberately no bare ``ignore`` wildcard, so every
+suppression documents WHICH class of bug was judged acceptable.
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass
+
+_SUPPRESS_RE = re.compile(r"(?:#|//)\s*analyze:\s*ignore\[([A-Z0-9_,\s]+)\]")
+
+
+@dataclass(frozen=True)
+class Finding:
+    file: str
+    line: int
+    rule: str
+    message: str
+
+    def __str__(self) -> str:  # the CLI's human format
+        return f"{self.file}:{self.line}: {self.rule} {self.message}"
+
+
+def parse_suppressions(text: str) -> dict[int, set[str]]:
+    """Map line number -> rule ids suppressed ON that line.
+
+    A comment on line N suppresses findings reported at N and N+1.
+    """
+    out: dict[int, set[str]] = {}
+    for i, line in enumerate(text.splitlines(), start=1):
+        m = _SUPPRESS_RE.search(line)
+        if m:
+            rules = {r.strip() for r in m.group(1).split(",") if r.strip()}
+            out.setdefault(i, set()).update(rules)
+            out.setdefault(i + 1, set()).update(rules)
+    return out
+
+
+def apply_suppressions(findings: list[Finding]) -> list[Finding]:
+    """Drop findings silenced by an inline comment in their source file."""
+    cache: dict[str, dict[int, set[str]]] = {}
+    kept = []
+    for f in findings:
+        supp = cache.get(f.file)
+        if supp is None:
+            try:
+                with open(f.file, encoding="utf-8", errors="replace") as fh:
+                    supp = parse_suppressions(fh.read())
+            except OSError:
+                supp = {}
+            cache[f.file] = supp
+        if f.rule not in supp.get(f.line, ()):
+            kept.append(f)
+    return kept

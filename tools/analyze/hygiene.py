@@ -1,0 +1,57 @@
+"""Pass 4 — host/filesystem hygiene.
+
+Rules
+-----
+- HYG001: ``st_atime`` used for cache-eviction ordering in a module that
+  never calls ``os.utime``.  Linux mounts default to relatime (atime
+  refreshed at most once per 24 h), so atime-ordered LRU evicts HOT
+  entries ahead of stale ones unless every cache hit explicitly bumps a
+  timestamp — the ``core/jit_cache.py`` finding from ADVICE r5.
+"""
+
+from __future__ import annotations
+
+import ast
+import glob
+import os
+
+from tools.analyze.common import Finding
+
+
+def check_hygiene_file(path: str) -> list:
+    try:
+        with open(path, encoding="utf-8", errors="replace") as fh:
+            tree = ast.parse(fh.read(), filename=path)
+    except SyntaxError:
+        return []
+    atime_uses = []
+    has_utime = False
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Attribute):
+            if node.attr == "st_atime":
+                atime_uses.append(node)
+            elif node.attr == "utime":
+                has_utime = True
+        elif isinstance(node, ast.Name) and node.id == "utime":
+            has_utime = True
+    if has_utime:
+        return []
+    return [
+        Finding(
+            path, n.lineno, "HYG001",
+            "st_atime used for eviction ordering but the module never "
+            "calls os.utime — relatime mounts refresh atime at most once "
+            "per 24h, so hot entries look cold; bump mtime on every "
+            "cache hit (see core/jit_cache.record_cache_hit)",
+        )
+        for n in atime_uses
+    ]
+
+
+def check_hygiene(root: str) -> list:
+    findings: list = []
+    pkg = os.path.join(root, "mmlspark_tpu")
+    for py in sorted(glob.glob(os.path.join(pkg, "**", "*.py"),
+                               recursive=True)):
+        findings.extend(check_hygiene_file(py))
+    return findings

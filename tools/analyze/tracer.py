@@ -1,0 +1,281 @@
+"""Pass 3 — JAX tracer hygiene over ``mmlspark_tpu/``.
+
+Rules
+-----
+- TRC001: Python ``if``/``while`` on a traced value inside a jitted
+  function — concretizes a tracer (``TracerBoolConversionError`` at
+  best, silent trace-time specialization at worst).  Shape/dtype/ndim
+  attribute tests, ``len()``/``isinstance()`` and ``is None`` checks are
+  static and stay quiet; parameters named in ``static_argnums``/
+  ``static_argnames`` are exempt.
+- TRC002: ``np.*`` called on a traced argument inside a jitted function
+  — numpy silently concretizes (or errors) instead of tracing; use
+  ``jnp``.
+- TRC003: ``jax.numpy`` imported in a host-only module
+  (``core/frame.py``, ``featurize/``) — those run before any device is
+  configured and must stay importable without pulling in a backend.
+
+Jitted functions are found by decorator (``@jax.jit``, ``@jit``,
+``@partial(jax.jit, ...)``) and by direct wrapping (``jax.jit(f)`` /
+``jax.jit(lambda ...)``) where ``f`` is defined in the same lexical
+scope.  Only the jitted function's OWN parameters are treated as traced
+— closed-over values are usually Python statics, and assuming otherwise
+drowns the signal.
+"""
+
+from __future__ import annotations
+
+import ast
+import glob
+import os
+
+from tools.analyze.common import Finding
+
+HOST_ONLY = ("core/frame.py", "featurize/")
+
+_STATIC_ATTRS = {"shape", "ndim", "dtype", "size", "aval", "weak_type"}
+_STATIC_CALLS = {"len", "isinstance", "getattr", "hasattr", "type"}
+
+
+def _is_jit_expr(node) -> bool:
+    """``jax.jit`` / ``jit`` as an expression."""
+    return (isinstance(node, ast.Attribute) and node.attr == "jit") or (
+        isinstance(node, ast.Name) and node.id == "jit"
+    )
+
+
+def _jit_call_static(call: ast.Call) -> "tuple[set, set] | None":
+    """If ``call`` is ``jax.jit(...)`` or ``partial(jax.jit, ...)``,
+    return (static_argnums, static_argnames); else None."""
+    if _is_jit_expr(call.func):
+        pass
+    elif (isinstance(call.func, (ast.Name, ast.Attribute))
+          and (getattr(call.func, "id", None) == "partial"
+               or getattr(call.func, "attr", None) == "partial")
+          and call.args and _is_jit_expr(call.args[0])):
+        pass
+    else:
+        return None
+    nums: set = set()
+    names: set = set()
+    for kw in call.keywords:
+        if kw.arg == "static_argnums":
+            for n in ast.walk(kw.value):
+                if isinstance(n, ast.Constant) and isinstance(n.value, int):
+                    nums.add(n.value)
+        elif kw.arg == "static_argnames":
+            for n in ast.walk(kw.value):
+                if isinstance(n, ast.Constant) and isinstance(n.value, str):
+                    names.add(n.value)
+    return nums, names
+
+
+def _traced_params(fn, static: "tuple[set, set]") -> set:
+    nums, names = static
+    params = [a.arg for a in fn.args.args]
+    if params and params[0] in ("self", "cls"):
+        params = params[1:]
+    return {
+        p for i, p in enumerate(params)
+        if i not in nums and p not in names
+    } | {a.arg for a in fn.args.kwonlyargs if a.arg not in names}
+
+
+def _uses_traced_value(node, traced: set) -> "ast.Name | None":
+    """First traced-param Name used BY VALUE under ``node`` (static uses
+    — .shape/.dtype, len(), isinstance(), `is None` — don't count)."""
+    if isinstance(node, ast.Attribute) and node.attr in _STATIC_ATTRS:
+        return None  # x.shape[...] is trace-static however deep x is
+    if isinstance(node, ast.Call):
+        fn = node.func
+        fname = getattr(fn, "id", getattr(fn, "attr", None))
+        if fname in _STATIC_CALLS:
+            return None
+    if isinstance(node, ast.Compare) and all(
+        isinstance(op, (ast.Is, ast.IsNot)) for op in node.ops
+    ):
+        return None  # `x is None` — identity, not value
+    if isinstance(node, ast.Name) and node.id in traced:
+        return node
+    for child in ast.iter_child_nodes(node):
+        hit = _uses_traced_value(child, traced)
+        if hit is not None:
+            return hit
+    return None
+
+
+def _is_np_call(call: ast.Call) -> bool:
+    fn = call.func
+    while isinstance(fn, ast.Attribute):  # np.linalg.norm -> np
+        fn = fn.value
+    return isinstance(fn, ast.Name) and fn.id in ("np", "numpy")
+
+
+class _JitBodyScanner:
+    """Scan one jitted function body for TRC001/TRC002."""
+
+    def __init__(self, path: str, traced: set, findings: list):
+        self.path = path
+        self.traced = traced
+        self.findings = findings
+
+    def scan(self, fn):
+        body = fn.body if isinstance(fn, (ast.FunctionDef,
+                                          ast.AsyncFunctionDef)) else [fn.body]
+        for stmt in body:
+            self._walk(stmt)
+
+    def _walk(self, node):
+        if isinstance(node, (ast.If, ast.While)):
+            hit = _uses_traced_value(node.test, self.traced)
+            if hit is not None:
+                kind = "if" if isinstance(node, ast.If) else "while"
+                self.findings.append(Finding(
+                    self.path, node.test.lineno, "TRC001",
+                    f"Python `{kind}` on traced value '{hit.id}' inside a "
+                    "jitted function — concretizes the tracer; use "
+                    "jnp.where / lax.cond / lax.while_loop",
+                ))
+        if isinstance(node, ast.Call) and _is_np_call(node):
+            for arg in list(node.args) + [kw.value for kw in node.keywords]:
+                hit = _uses_traced_value(arg, self.traced)
+                if hit is not None:
+                    self.findings.append(Finding(
+                        self.path, node.lineno, "TRC002",
+                        f"np.* call on traced value '{hit.id}' inside a "
+                        "jitted function — numpy concretizes instead of "
+                        "tracing; use jnp",
+                    ))
+                    break
+        for child in ast.iter_child_nodes(node):
+            # nested defs still trace, and their params shadow
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.Lambda)):
+                inner = {a.arg for a in child.args.args}
+                sub = _JitBodyScanner(self.path, self.traced - inner,
+                                      self.findings)
+                sub.scan(child)
+            else:
+                self._walk(child)
+
+
+def _decorated_static(fn) -> "tuple[set, set] | None":
+    """(static_argnums, static_argnames) if ``fn`` is jit-decorated."""
+    for dec in fn.decorator_list:
+        if _is_jit_expr(dec):
+            return set(), set()
+        if isinstance(dec, ast.Call):
+            st = _jit_call_static(dec)
+            if st is not None:
+                return st
+    return None
+
+
+def check_tracer_file(path: str) -> list:
+    try:
+        with open(path, encoding="utf-8", errors="replace") as fh:
+            tree = ast.parse(fh.read(), filename=path)
+    except SyntaxError:
+        return []
+    findings: list = []
+
+    # defs by (enclosing function node or None, name) for jax.jit(f) lookup
+    defs: dict = {}
+    parents: dict = {}
+    for node in ast.walk(tree):
+        for child in ast.iter_child_nodes(node):
+            parents[child] = node
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            scope = parents.get(node)
+            while scope is not None and not isinstance(
+                scope, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Module)
+            ):
+                scope = parents.get(scope)
+            defs[(scope, node.name)] = node
+
+    scanned: set = set()
+
+    def scan_fn(fn, static):
+        if id(fn) in scanned:
+            return
+        scanned.add(id(fn))
+        traced = _traced_params(fn, static)
+        if isinstance(fn, ast.Lambda):
+            traced = {a.arg for a in fn.args.args}
+        _JitBodyScanner(path, traced, findings).scan(fn)
+
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            st = _decorated_static(node)
+            if st is not None:
+                scan_fn(node, st)
+        elif isinstance(node, ast.Call):
+            st = _jit_call_static(node)
+            if st is None:
+                continue
+            # jax.jit(f) / jax.jit(lambda ...) — resolve the callee
+            target = None
+            if _is_jit_expr(node.func) and node.args:
+                target = node.args[0]
+            elif node.args and _is_jit_expr(node.args[0]):
+                continue  # partial(jax.jit, ...) used as decorator factory
+            if isinstance(target, ast.Lambda):
+                scan_fn(target, st)
+            elif isinstance(target, ast.Name):
+                scope = parents.get(node)
+                while scope is not None:
+                    fn = defs.get((scope if isinstance(
+                        scope, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                ast.Module)) else None, target.id))
+                    if fn is not None:
+                        scan_fn(fn, st)
+                        break
+                    scope = parents.get(scope)
+    return findings
+
+
+def check_host_only_file(path: str) -> list:
+    """TRC003 for one file inside the host-only set."""
+    try:
+        with open(path, encoding="utf-8", errors="replace") as fh:
+            tree = ast.parse(fh.read(), filename=path)
+    except SyntaxError:
+        return []
+    findings: list = []
+    for node in ast.walk(tree):
+        bad = None
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                if alias.name.startswith("jax.numpy"):
+                    bad = alias.name
+        elif isinstance(node, ast.ImportFrom):
+            mod = node.module or ""
+            if mod.startswith("jax.numpy") or (
+                mod == "jax" and any(a.name == "numpy" for a in node.names)
+            ):
+                bad = "jax.numpy"
+        elif (isinstance(node, ast.Attribute) and node.attr == "numpy"
+              and isinstance(node.value, ast.Name)
+              and node.value.id == "jax"):
+            bad = "jax.numpy"
+        if bad:
+            findings.append(Finding(
+                path, node.lineno, "TRC003",
+                f"{bad} used in a host-only module — core/frame.py and "
+                "featurize/ must import (and run) without touching a jax "
+                "backend",
+            ))
+    return findings
+
+
+def check_tracer(root: str) -> list:
+    findings: list = []
+    pkg = os.path.join(root, "mmlspark_tpu")
+    for py in sorted(glob.glob(os.path.join(pkg, "**", "*.py"),
+                               recursive=True)):
+        findings.extend(check_tracer_file(py))
+        rel = os.path.relpath(py, pkg).replace(os.sep, "/")
+        if any(rel == h or rel.startswith(h) for h in HOST_ONLY):
+            findings.extend(check_host_only_file(py))
+    return findings

@@ -167,10 +167,12 @@ def run_child(n_dev: int):
                 jax.tree_util.tree_leaves(r)[0].block_until_ready()
             return (time.perf_counter() - t0) / 5
 
-        psum_f = jax.jit(jax.shard_map(
+        from mmlspark_tpu.parallel.mesh import shard_map_compat
+
+        psum_f = jax.jit(shard_map_compat(
             lambda x: jax.lax.psum(x[0], "data"), mesh=mesh,
             in_specs=P("data"), out_specs=P()))
-        scat_f = jax.jit(jax.shard_map(
+        scat_f = jax.jit(shard_map_compat(
             lambda x: jax.lax.psum_scatter(
                 x[0], "data", scatter_dimension=3, tiled=True),
             mesh=mesh, in_specs=P("data"), out_specs=P(None, None, None, "data")))
