@@ -238,6 +238,11 @@ def _one_config(X, y, cat_idx, tag):
 
 
 def main():
+    # Per-phase breakdowns (cache counters, span aggregates) ride along in
+    # the output so BENCH_*.json rounds carry more than totals.
+    from mmlspark_tpu import obs
+
+    obs.enable()
     # HEADLINE: the criteo-schema categorical mix at engine defaults.
     Xc, yc, cat_idx = make_catmix_data()
     cat_s, cat_compile, cat_vs, cat_gap, resolved = _one_config(
@@ -262,6 +267,7 @@ def main():
         out["auc_gap"] = round(cat_gap, 5)
     if num_gap is not None:
         out["numeric_auc_gap"] = round(num_gap, 5)
+    out["obs"] = obs.snapshot()
     print(json.dumps(out))
 
 

@@ -267,7 +267,8 @@ def generate(repo_root: str | None = None) -> None:
         f.write(render_smoke_tests())
     with open(r_path, "w") as f:
         f.write(render_r_api())
-    print(f"wrote {api_path}\nwrote {test_path}\nwrote {r_path}")
+    # CLI entry point — stdout is the contract here, not library logging
+    print(f"wrote {api_path}\nwrote {test_path}\nwrote {r_path}")  # analyze: ignore[OBS001]
 
 
 if __name__ == "__main__":

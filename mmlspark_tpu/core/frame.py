@@ -295,7 +295,8 @@ class DataFrame:
         return self._pdf.copy()
 
     def show(self, n: int = 20) -> None:
-        print(self._pdf.head(n).to_string())
+        # Spark's df.show() contract IS stdout
+        print(self._pdf.head(n).to_string())  # analyze: ignore[OBS001]
 
     def groupBy(self, *cols):
         return _GroupedData(self, list(cols))

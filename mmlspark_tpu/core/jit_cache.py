@@ -26,6 +26,8 @@ from __future__ import annotations
 
 import os
 
+from mmlspark_tpu import obs
+
 _done = False
 
 
@@ -110,6 +112,7 @@ def _install_hit_recorder(cache_dir: str) -> None:
         def get_and_touch(cache_key, compile_options, backend):
             result = orig(cache_key, compile_options, backend)
             if result[0] is not None:
+                obs.inc("jit_cache.hit")
                 try:
                     with os.scandir(cache_dir) as it:
                         for e in it:
@@ -117,6 +120,8 @@ def _install_hit_recorder(cache_dir: str) -> None:
                                 record_cache_hit(e.path)
                 except OSError:
                     pass
+            else:
+                obs.inc("jit_cache.miss")
             return result
 
         get_and_touch._mmlspark_tpu_touch = True
@@ -163,6 +168,8 @@ def prune_cache_dir(path: str, max_mb: float | None = None) -> int:
                 continue
             if total <= budget:
                 break
+        if removed:
+            obs.inc("jit_cache.pruned", removed)
         return removed
     except OSError:
         return 0

@@ -12,6 +12,7 @@ from __future__ import annotations
 import json
 import os
 import time
+from datetime import datetime, timezone
 from typing import Any, Dict, List, Optional
 
 from mmlspark_tpu.core.frame import DataFrame
@@ -51,9 +52,14 @@ class PipelineStage(Params):
                 complex_names.append(p.name)
             else:
                 simple[p.name] = value
+        now = time.time()
         meta = {
             "class": f"{type(self).__module__}.{type(self).__qualname__}",
-            "timestamp": time.time(),
+            "timestamp": now,
+            # Human-readable provenance twin of the raw float above.
+            "saved_at": datetime.fromtimestamp(now, timezone.utc).isoformat(
+                timespec="seconds"
+            ),
             "uid": self.uid,
             "paramMap": simple,
             "complexParams": complex_names,

@@ -50,6 +50,8 @@ from typing import Callable, Optional
 
 import numpy as np
 
+from mmlspark_tpu import obs
+
 _SRC_HASH: Optional[str] = None
 _REGISTERED = False
 # In-process memo of deserialized/exported programs: repeated train()
@@ -197,6 +199,7 @@ def wrap_aot(
         sig = _arg_signature(args)
         exp = state.get(sig)
         if exp is not None:
+            obs.inc("trace_cache.memo_hit")
             return exp.call(*args)
         try:
             from jax import export as jexport
@@ -214,7 +217,9 @@ def wrap_aot(
                 ).encode()
             ).hexdigest()
             exp = _EXP_MEMO.get(digest)
-            if exp is None:
+            if exp is not None:
+                obs.inc("trace_cache.memo_hit")
+            else:
                 path = os.path.join(cache_dir(), digest + ".jaxexp")
                 # Every non-deterministic step below is COLLECTIVE-agreed
                 # under multiple controllers (blob existence, deserialize
@@ -225,14 +230,18 @@ def wrap_aot(
                 # process, so the per-process `off` fallback stays safe.
                 if _all_processes_have(path, multi_controller):
                     try:
-                        with open(path, "rb") as f:
+                        with obs.span("trace_cache.load"), open(path, "rb") as f:
                             exp = jexport.deserialize(bytearray(f.read()))
                     except Exception:
                         exp = None  # corrupt blob on SOME process
                     if not _all_processes_ok(exp is not None, multi_controller):
                         exp = None  # any process failed → everyone exports
-                if exp is None:
-                    exp = jexport.export(jitted)(*args)
+                if exp is not None:
+                    obs.inc("trace_cache.hit")
+                else:
+                    obs.inc("trace_cache.miss")
+                    with obs.span("trace_cache.export"):
+                        exp = jexport.export(jitted)(*args)
                     try:
                         os.makedirs(cache_dir(), exist_ok=True)
                         tmp = path + f".tmp{os.getpid()}"
@@ -251,6 +260,7 @@ def wrap_aot(
             # old jax / unserializable graph → plain jit (deterministic
             # per-program, so every process lands here together)
             state["off"] = True
+            obs.inc("trace_cache.off")
             return jitted(*args)
 
     return call

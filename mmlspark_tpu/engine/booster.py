@@ -20,6 +20,7 @@ from __future__ import annotations
 
 import dataclasses
 import math
+import time
 from functools import partial
 from typing import Dict, List, Optional, Sequence, Tuple, Union
 
@@ -27,6 +28,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from mmlspark_tpu import obs
 from mmlspark_tpu.engine import eval_metrics
 from mmlspark_tpu.engine.tree import (
     GrowConfig,
@@ -894,6 +896,41 @@ def train(
     so thresholds agree across processes.  Every process must call train()
     collectively (SPMD) and receives the identical replicated Booster.
     """
+    t0 = time.perf_counter()
+    with obs.span("booster.train", process_local=bool(process_local)):
+        booster = _train_impl(
+            params, train_set, valid_sets, valid_names,
+            bin_mapper, init_model, mesh, process_local,
+        )
+    if obs.enabled():
+        wall = time.perf_counter() - t0
+        obs.gauge("booster.train_wall_s", wall)
+        try:
+            n_rows = int(np.shape(train_set.X)[0])
+        except Exception:
+            n_rows = 0
+        if n_rows and wall > 0:
+            # Throughput as row-iterations/s over THIS process's partition
+            # (multiply by process count for the global rate under
+            # process_local ingestion).
+            obs.gauge(
+                "booster.rows_per_s", n_rows * booster.num_iterations / wall
+            )
+    return booster
+
+
+def _train_impl(
+    params: dict,
+    train_set: Dataset,
+    valid_sets: Sequence[Dataset] = (),
+    valid_names: Optional[Sequence[str]] = None,
+    bin_mapper: Optional[BinMapper] = None,
+    init_model: Optional[Booster] = None,
+    mesh=None,
+    process_local: bool = False,
+) -> Booster:
+    """Body of :func:`train` — see its docstring.  Split out so the
+    ``booster.train`` obs span wraps every return path."""
     import warnings
 
     from mmlspark_tpu.core.jit_cache import enable_compile_cache
@@ -1103,7 +1140,8 @@ def train(
                 train_set._mapper_cache = {key: bin_mapper}
         else:
             bin_mapper = train_set.fitted_mapper(cfg)
-    bins_np = train_set.binned(bin_mapper)
+    with obs.span("booster.binning"):
+        bins_np = train_set.binned(bin_mapper)
     n, F = bins_np.shape
     B = bin_mapper.num_bins
 
@@ -2143,19 +2181,29 @@ def train(
         tree_chunks: List[Tree] = []
         n_done = 0
         stop_at: Optional[int] = None
+        chunk_idx = 0
         while n_done < n_iter and stop_at is None:
+            t_chunk = time.perf_counter()
             c = min(chunk_iters, n_iter - n_done)
             dart_xs = (
                 (jnp.asarray(drop_rows[n_done : n_done + c]),
                  jnp.asarray(it_indices[n_done : n_done + c]))
                 if dart_scan else ()
             )
-            carry, (trees_c, vsnap_c) = scan_chunk(
-                bins_dev, y_dev, w_dev, valid_mask, init_scores_dev, vbins_t,
-                vaux_t, carry, jax.lax.slice(xs_dev, (n_done, 0), (n_done + c, 5))
-                if c < n_iter else xs_dev,
-                *dart_xs,
-            )
+            # cold=True marks the chunk whose dispatch blocks on Python
+            # tracing + XLA compile (or trace/compile-cache loads); later
+            # chunks measure pure async-dispatch cost.
+            with obs.span(
+                "booster.scan_dispatch",
+                chunk=chunk_idx, iters=c, cold=(chunk_idx == 0),
+            ):
+                carry, (trees_c, vsnap_c) = scan_chunk(
+                    bins_dev, y_dev, w_dev, valid_mask, init_scores_dev,
+                    vbins_t, vaux_t, carry,
+                    jax.lax.slice(xs_dev, (n_done, 0), (n_done + c, 5))
+                    if c < n_iter else xs_dev,
+                    *dart_xs,
+                )
             tree_chunks.append(trees_c)
             if ckpt_path is not None:
                 _write_checkpoint(trees_c)
@@ -2189,6 +2237,17 @@ def train(
                         stop_at = it
                         break
             n_done += c
+            if obs.enabled() and c:
+                # The whole-run scan fuses iterations on-device, so
+                # per-iteration wall is DERIVED: the chunk's wall (dispatch
+                # + eval sync) split evenly across its iterations.  The
+                # legacy/DART loop below records REAL per-iteration spans.
+                per_it = (time.perf_counter() - t_chunk) / c
+                for j in range(n_done - c, n_done):
+                    obs.record_span(
+                        "booster.iteration", per_it, it=j, derived=True
+                    )
+            chunk_idx += 1
 
         kept = (stop_at + 1) if stop_at is not None else n_iter
         if ckpt_path is None and init_model is None:
@@ -2266,6 +2325,7 @@ def train(
 
         _legacy_stats = [_make_stats_fn(vs["evaluators"]) for vs in vsets]
     for it in range(cfg.num_iterations):
+        t_it = time.perf_counter()
         sub = all_keys[it]
         if do_bagging and it % cfg.bagging_freq == 0:
             current_bag = resample_bag(all_keys[cfg.num_iterations + it], valid_mask)
@@ -2358,6 +2418,10 @@ def train(
                 evals_result[nm][mname].append(m)
                 if _es_update(vi_l, mi, m, it, is_tp):
                     stop = True
+        # Real per-iteration wall (grow dispatch + validation) — the
+        # legacy/DART loop is iteration-at-a-time in Python, unlike the
+        # fused scan path above.
+        obs.record_span("booster.iteration", time.perf_counter() - t_it, it=it)
         if stop:
             break
 

@@ -17,14 +17,23 @@ inference then batches whole micro-batches through one jitted call
 from __future__ import annotations
 
 import json
+import os
 import queue
 import threading
+import time
 import uuid
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Callable, Dict, Optional
 
+from mmlspark_tpu import obs
 from mmlspark_tpu.core.frame import DataFrame
 from mmlspark_tpu.io.http.http_schema import HTTPRequestData, HTTPResponseData
+
+# Entity-size ceiling: a request larger than this is rejected with 413 (and
+# counted) instead of buffering unbounded bytes into the micro-batch queue.
+_MAX_ENTITY_BYTES = int(
+    os.environ.get("MMLSPARK_TPU_SERVING_MAX_ENTITY_BYTES", 16 << 20)
+)
 
 
 class HTTPServer:
@@ -37,11 +46,44 @@ class HTTPServer:
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
-            def log_message(self, *a):  # quiet
-                pass
+            def log_message(self, fmt, *args):
+                # BaseHTTPRequestHandler's per-request lines used to be
+                # discarded; keep them available at debug level instead.
+                obs.get_logger("mmlspark_tpu.serving").debug(
+                    "%s - %s", self.address_string(), fmt % args
+                )
+
+            def _finish(self, status, entity=None, headers=None, t0=None):
+                self.send_response(status)
+                for k, v in (headers or {}).items():
+                    if k.lower() not in ("content-length", "date", "server"):
+                        self.send_header(k, v)
+                self.send_header("Content-Length", str(len(entity or b"")))
+                self.end_headers()
+                if entity:
+                    self.wfile.write(entity)
+                obs.inc("http.requests", status=status)
+                if t0 is not None:
+                    obs.observe(
+                        "http.request_latency_s", time.perf_counter() - t0
+                    )
 
             def _handle(self, method):
-                length = int(self.headers.get("Content-Length") or 0)
+                t0 = time.perf_counter()
+                try:
+                    length = int(self.headers.get("Content-Length") or 0)
+                except ValueError:
+                    obs.inc("http.malformed")
+                    self._finish(400, b"bad Content-Length", t0=t0)
+                    return
+                if length < 0:
+                    obs.inc("http.malformed")
+                    self._finish(400, b"bad Content-Length", t0=t0)
+                    return
+                if length > _MAX_ENTITY_BYTES:
+                    obs.inc("http.oversized")
+                    self._finish(413, b"entity too large", t0=t0)
+                    return
                 body = self.rfile.read(length) if length else None
                 rid = str(uuid.uuid4())
                 req = HTTPRequestData(
@@ -51,19 +93,19 @@ class HTTPServer:
                 ev = threading.Event()
                 outer._responders[rid] = ev
                 outer._requests.put((rid, req))
+                obs.gauge("http.queue_depth", outer._requests.qsize())
                 if not ev.wait(timeout=60.0):
-                    self.send_response(504)
-                    self.end_headers()
+                    outer._responders.pop(rid, None)
+                    obs.inc("http.timeouts")
+                    self._finish(504, t0=t0)
                     return
                 resp = outer._responses.pop(rid)
-                self.send_response(resp.statusCode or 200)
-                for k, v in resp.headers.items():
-                    if k.lower() not in ("content-length", "date", "server"):
-                        self.send_header(k, v)
-                self.send_header("Content-Length", str(len(resp.entity or b"")))
-                self.end_headers()
-                if resp.entity:
-                    self.wfile.write(resp.entity)
+                self._finish(
+                    resp.statusCode or 200,
+                    entity=resp.entity,
+                    headers=resp.headers,
+                    t0=t0,
+                )
 
             def do_GET(self):
                 self._handle("GET")

@@ -16,13 +16,56 @@ import ctypes
 import os
 import subprocess
 import threading
+import time
+
+from mmlspark_tpu import obs
 
 _HERE = os.path.dirname(__file__)
 _SRC = os.path.join(_HERE, "binner.cpp")
 _SO = os.path.join(_HERE, "_binner.so")
 
 _lock = threading.Lock()
-_libs: dict = {}  # so-path -> CDLL | None (None = tried, unavailable)
+_libs: dict = {}  # so-path -> _TimedLib | None (None = tried, unavailable)
+
+
+class _TimedLib:
+    """Transparent CDLL proxy timing every ``mml_*`` entry point.
+
+    Records call count + cumulative wall ns per symbol into the obs
+    registry (``native.calls{symbol=...}`` / ``native.ns{symbol=...}``).
+    Symbol lookup semantics are preserved exactly: a missing symbol still
+    raises ``AttributeError`` (``hasattr``/``getattr(..., None)`` probes
+    for optional symbols like ``mml_binner_transform_cat`` behave as on
+    the raw CDLL), and non-``mml_`` attributes pass straight through.
+    ctypes signatures are bound on the RAW library before wrapping, so
+    ``argtypes``/``restype`` setup never sees the proxy.
+    """
+
+    def __init__(self, lib):
+        self._lib = lib
+        self._timed: dict = {}
+
+    def __getattr__(self, name):
+        fn = getattr(self._lib, name)  # AttributeError propagates
+        if not name.startswith("mml_") or not callable(fn):
+            return fn
+        timed = self._timed.get(name)
+        if timed is None:
+
+            def timed(*args, _fn=fn, _name=name):
+                t0 = time.perf_counter_ns()
+                try:
+                    return _fn(*args)
+                finally:
+                    try:
+                        dt = time.perf_counter_ns() - t0
+                        obs.inc("native.calls", symbol=_name)
+                        obs.inc("native.ns", dt, symbol=_name)
+                    except Exception:
+                        pass  # never let accounting break a native call
+
+            self._timed[name] = timed
+        return timed
 
 
 def load_native_lib(src: str, so: str, bind) -> "ctypes.CDLL | None":
@@ -63,6 +106,7 @@ def load_native_lib(src: str, so: str, bind) -> "ctypes.CDLL | None":
                 if fresh:
                     lib = ctypes.CDLL(so)
                     bind(lib)
+                    lib = _TimedLib(lib)
             except Exception:
                 lib = None
         _libs[so] = lib

@@ -1,0 +1,74 @@
+"""Shared mutable state for mmlspark_tpu.obs.
+
+Kept in its own leaf module so every obs submodule (metrics, tracing,
+watchdog) and the package ``__init__`` can read the enable flag without
+import cycles.  ``enabled`` is the module-level fast-path flag the ISSUE's
+near-zero-overhead contract hangs on: every recording entry point checks it
+first and returns immediately when False.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+from typing import Optional
+
+enabled: bool = False
+
+# Resolved lazily (jax may not be importable/initialized at obs import).
+_rank: Optional[int] = None
+
+
+def process_index() -> int:
+    """This process's rank for metric/span stamping.
+
+    Resolution order: the launcher's ``MMLSPARK_TPU_PROCESS_ID`` (set by
+    the Spark-side integration alongside the coordinator address — see
+    ``parallel.distributed``), then ``jax.process_index()`` if jax is
+    already imported (never import jax from here: obs is dependency-free
+    and must not force backend initialization), else 0.
+    """
+    global _rank
+    if _rank is None:
+        _rank = _resolve_rank()
+    return _rank
+
+
+def _resolve_rank() -> int:
+    v = os.environ.get("MMLSPARK_TPU_PROCESS_ID")
+    if v is not None:
+        try:
+            return int(v)
+        except ValueError:
+            pass
+    if "jax" in sys.modules:
+        try:
+            import jax
+
+            return int(jax.process_index())
+        except Exception:
+            return 0
+    return 0
+
+
+def process_count_hint() -> int:
+    """Best-effort process count (for per-rank export-file suffixing)."""
+    v = os.environ.get("MMLSPARK_TPU_NUM_PROCESSES")
+    if v is not None:
+        try:
+            return max(1, int(v))
+        except ValueError:
+            pass
+    if "jax" in sys.modules:
+        try:
+            import jax
+
+            return int(jax.process_count())
+        except Exception:
+            return 1
+    return 1
+
+
+def reset_rank_cache() -> None:
+    global _rank
+    _rank = None

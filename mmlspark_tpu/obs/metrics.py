@@ -1,0 +1,151 @@
+"""mmlspark_tpu.obs.metrics — the in-process metric registry.
+
+Counters, gauges, and histograms with label support, plus a dedicated
+span-aggregate table fed by the tracer.  Pure stdlib; thread-safe; the
+caller-facing fast path (``obs.inc`` etc. in the package ``__init__``)
+checks the enable flag BEFORE reaching this module, so nothing here needs
+to be branch-free.
+
+Naming conventions (documented in tools/obs/README.md):
+- dot-separated lowercase names scoped by subsystem
+  (``jit_cache.hit``, ``http.requests``, ``native.calls``);
+- labels for bounded cardinality only (status codes, symbol names) —
+  never row counts or iteration indices (those are span attrs);
+- durations are seconds and suffixed ``_s``; byte sizes suffixed
+  ``_bytes``.
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+from typing import Dict, Tuple
+
+# Bounded per-histogram reservoir: exact count/sum/min/max, approximate
+# percentiles from the most recent observations (ring buffer).
+_SAMPLE_CAP = 512
+
+
+def _label_key(labels: dict) -> Tuple:
+    if not labels:
+        return ()
+    return tuple(sorted((str(k), str(v)) for k, v in labels.items()))
+
+
+def _fmt_key(name: str, lk: Tuple) -> str:
+    if not lk:
+        return name
+    inner = ",".join(f"{k}={v}" for k, v in lk)
+    return f"{name}{{{inner}}}"
+
+
+class _Hist:
+    __slots__ = ("count", "total", "vmin", "vmax", "_samples", "_i")
+
+    def __init__(self):
+        self.count = 0
+        self.total = 0.0
+        self.vmin = math.inf
+        self.vmax = -math.inf
+        self._samples: list = []
+        self._i = 0
+
+    def observe(self, value: float) -> None:
+        self.count += 1
+        self.total += value
+        if value < self.vmin:
+            self.vmin = value
+        if value > self.vmax:
+            self.vmax = value
+        if len(self._samples) < _SAMPLE_CAP:
+            self._samples.append(value)
+        else:
+            self._samples[self._i] = value
+            self._i = (self._i + 1) % _SAMPLE_CAP
+
+    def summary(self) -> dict:
+        if not self.count:
+            return {"count": 0}
+        s = sorted(self._samples)
+
+        def pct(p: float) -> float:
+            return s[min(len(s) - 1, int(round(p * (len(s) - 1))))]
+
+        return {
+            "count": self.count,
+            "sum": self.total,
+            "mean": self.total / self.count,
+            "min": self.vmin,
+            "max": self.vmax,
+            "p50": pct(0.50),
+            "p95": pct(0.95),
+            "p99": pct(0.99),
+        }
+
+
+class Registry:
+    """Thread-safe metric store.  One process-global instance lives in
+    this module (``registry``); tests may build private ones."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._counters: Dict[Tuple[str, Tuple], float] = {}
+        self._gauges: Dict[Tuple[str, Tuple], float] = {}
+        self._hists: Dict[Tuple[str, Tuple], _Hist] = {}
+        self._spans: Dict[str, _Hist] = {}
+
+    def inc(self, name: str, value: float = 1.0, /, **labels) -> None:
+        k = (name, _label_key(labels))
+        with self._lock:
+            self._counters[k] = self._counters.get(k, 0.0) + value
+
+    def gauge(self, name: str, value: float, /, **labels) -> None:
+        k = (name, _label_key(labels))
+        with self._lock:
+            self._gauges[k] = float(value)
+
+    def observe(self, name: str, value: float, /, **labels) -> None:
+        k = (name, _label_key(labels))
+        with self._lock:
+            h = self._hists.get(k)
+            if h is None:
+                h = self._hists[k] = _Hist()
+            h.observe(float(value))
+
+    def observe_span(self, name: str, dur_s: float) -> None:
+        with self._lock:
+            h = self._spans.get(name)
+            if h is None:
+                h = self._spans[name] = _Hist()
+            h.observe(float(dur_s))
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            counters = {_fmt_key(n, lk): v for (n, lk), v in self._counters.items()}
+            gauges = {_fmt_key(n, lk): v for (n, lk), v in self._gauges.items()}
+            hists = {_fmt_key(n, lk): h.summary() for (n, lk), h in self._hists.items()}
+            spans = {
+                n: {
+                    "count": h.count,
+                    "total_s": h.total,
+                    "mean_s": (h.total / h.count) if h.count else 0.0,
+                    "max_s": h.vmax if h.count else 0.0,
+                }
+                for n, h in self._spans.items()
+            }
+        return {
+            "counters": counters,
+            "gauges": gauges,
+            "histograms": hists,
+            "spans": spans,
+        }
+
+    def reset(self) -> None:
+        with self._lock:
+            self._counters.clear()
+            self._gauges.clear()
+            self._hists.clear()
+            self._spans.clear()
+
+
+registry = Registry()

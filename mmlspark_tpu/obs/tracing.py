@@ -1,0 +1,253 @@
+"""mmlspark_tpu.obs.tracing — spans, the JSONL exporter, and the library
+logger.
+
+Spans are monotonic (``time.perf_counter_ns``) wall-time measurements with
+nesting tracked per thread.  Each completed span is (a) aggregated into the
+metric registry's span table and (b) appended as one JSON line to the
+export file when ``MMLSPARK_TPU_OBS=path`` (or ``obs.enable(path=...)``)
+is active.  When jax is already imported, spans also enter a
+``jax.profiler.TraceAnnotation`` so they show up in XLA device profiles —
+jax is never imported from here (obs stays dependency-free).
+
+JSONL record shapes::
+
+    {"kind": "span", "ts": <unix>, "rank": R, "name": ..., "dur_s": ...,
+     "depth": D, "parent": <name|null>, "attrs": {...}}
+    {"kind": "snapshot", "ts": <unix>, "rank": R, "snapshot": {...}}
+
+Under multiple processes every rank writes its own file
+(``<path>.rank<R>``) so lines never interleave across writers.
+"""
+
+from __future__ import annotations
+
+import atexit
+import json
+import logging
+import os
+import sys
+import threading
+import time
+from typing import Optional
+
+from mmlspark_tpu.obs import _state, metrics
+
+_LOGGER_NAME = "mmlspark_tpu"
+
+
+def get_logger(name: str = _LOGGER_NAME) -> logging.Logger:
+    return logging.getLogger(name)
+
+
+class _LiveStderrHandler(logging.Handler):
+    """StreamHandler variant resolving ``sys.stderr`` at EMIT time, so
+    stream redirection (pytest capture, contextlib.redirect_stderr) sees
+    library log lines instead of the stderr object alive at obs import."""
+
+    def emit(self, record):
+        try:
+            sys.stderr.write(self.format(record) + "\n")
+        except Exception:
+            pass
+
+
+def _configure_logger() -> logging.Logger:
+    """Attach a stderr handler to the library logger (once).
+
+    The pre-obs library printed its (two) diagnostics with bare ``print``;
+    routing through logging must keep them visible by default, so the
+    library logger gets its own handler rather than relying on the root
+    logger being configured.  Propagation stays on so pytest's ``caplog``
+    (and any app-level root handlers) still see the records.
+    """
+    logger = logging.getLogger(_LOGGER_NAME)
+    if not any(getattr(h, "_mmlspark_tpu_obs", False) for h in logger.handlers):
+        h = _LiveStderrHandler()
+        h.setFormatter(
+            logging.Formatter("%(asctime)s %(levelname)s %(name)s: %(message)s")
+        )
+        h._mmlspark_tpu_obs = True
+        logger.addHandler(h)
+        level = os.environ.get("MMLSPARK_TPU_OBS_LOG_LEVEL", "INFO").upper()
+        logger.setLevel(getattr(logging, level, logging.INFO))
+    return logger
+
+
+_tls = threading.local()
+
+
+def _stack() -> list:
+    s = getattr(_tls, "stack", None)
+    if s is None:
+        s = _tls.stack = []
+    return s
+
+
+_TA_CLS: object = 0  # 0 = unresolved, None = unavailable
+
+
+def _trace_annotation():
+    """``jax.profiler.TraceAnnotation`` iff jax is already imported."""
+    global _TA_CLS
+    if _TA_CLS == 0:
+        if "jax" in sys.modules:
+            try:
+                from jax.profiler import TraceAnnotation
+
+                _TA_CLS = TraceAnnotation
+            except Exception:
+                _TA_CLS = None
+        else:
+            return None  # keep unresolved: jax may be imported later
+    return _TA_CLS
+
+
+class Span:
+    """Context manager measuring one named region.  Construct via
+    ``obs.span(name, **attrs)`` — which returns a shared null context when
+    obs is disabled, so this class only ever runs enabled."""
+
+    __slots__ = ("name", "attrs", "_t0", "_ta", "_depth", "_parent")
+
+    def __init__(self, name: str, attrs: dict):
+        self.name = name
+        self.attrs = attrs
+
+    def __enter__(self):
+        stack = _stack()
+        self._parent = stack[-1].name if stack else None
+        self._depth = len(stack)
+        stack.append(self)
+        ta_cls = _trace_annotation()
+        self._ta = ta_cls(self.name) if ta_cls else None
+        if self._ta is not None:
+            self._ta.__enter__()
+        self._t0 = time.perf_counter_ns()
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        dur_s = (time.perf_counter_ns() - self._t0) / 1e9
+        if self._ta is not None:
+            try:
+                self._ta.__exit__(exc_type, exc, tb)
+            except Exception:
+                pass
+        stack = _stack()
+        if stack and stack[-1] is self:
+            stack.pop()
+        record_span(
+            self.name, dur_s, self.attrs, depth=self._depth, parent=self._parent
+        )
+        return False
+
+
+def record_span(
+    name: str,
+    dur_s: float,
+    attrs: Optional[dict] = None,
+    depth: int = 0,
+    parent: Optional[str] = None,
+) -> None:
+    """Record a completed (pre-measured) span: aggregate + export."""
+    metrics.registry.observe_span(name, dur_s)
+    exp = _EXPORTER
+    if exp is not None:
+        exp.write(
+            {
+                "kind": "span",
+                "ts": time.time(),
+                "rank": _state.process_index(),
+                "name": name,
+                "dur_s": dur_s,
+                "depth": depth,
+                "parent": parent,
+                "attrs": attrs or {},
+            }
+        )
+
+
+class _Exporter:
+    """Line-buffered JSONL writer; per-rank file under multi-process."""
+
+    def __init__(self, path: str):
+        self._requested = path
+        self._lock = threading.Lock()
+        self._f = None
+        self.path: Optional[str] = None
+
+    def _open(self):
+        if self._f is None:
+            path = self._requested
+            if _state.process_count_hint() > 1:
+                path = f"{path}.rank{_state.process_index()}"
+            d = os.path.dirname(os.path.abspath(path))
+            os.makedirs(d, exist_ok=True)
+            self._f = open(path, "a", buffering=1)
+            self.path = path
+        return self._f
+
+    def write(self, rec: dict) -> None:
+        try:
+            line = json.dumps(rec, separators=(",", ":"), default=str)
+            with self._lock:
+                self._open().write(line + "\n")
+        except Exception:
+            pass  # export is best-effort; never break the caller
+
+    def close(self) -> None:
+        with self._lock:
+            if self._f is not None:
+                try:
+                    self._f.close()
+                except Exception:
+                    pass
+                self._f = None
+
+
+_EXPORTER: Optional[_Exporter] = None
+_ATEXIT_DONE = False
+
+
+def open_exporter(path: str) -> None:
+    global _EXPORTER, _ATEXIT_DONE
+    close_exporter()
+    _EXPORTER = _Exporter(path)
+    if not _ATEXIT_DONE:
+        atexit.register(_at_exit)
+        _ATEXIT_DONE = True
+
+
+def exporter_path() -> Optional[str]:
+    exp = _EXPORTER
+    if exp is None:
+        return None
+    return exp.path or exp._requested
+
+
+def write_record(rec: dict) -> None:
+    exp = _EXPORTER
+    if exp is not None:
+        exp.write(rec)
+
+
+def close_exporter() -> None:
+    global _EXPORTER
+    if _EXPORTER is not None:
+        _EXPORTER.close()
+        _EXPORTER = None
+
+
+def _at_exit() -> None:
+    """Final snapshot line so the report CLI sees counters, not just spans."""
+    if _EXPORTER is not None:
+        snap = metrics.registry.snapshot()
+        snap["process_index"] = _state.process_index()
+        write_record(
+            {
+                "kind": "snapshot",
+                "ts": time.time(),
+                "rank": _state.process_index(),
+                "snapshot": snap,
+            }
+        )
+        close_exporter()

@@ -1,0 +1,106 @@
+"""mmlspark_tpu.obs.watchdog — soft-timeout guard for host collectives.
+
+PR 1's deadlock class (``trace_cache.wrap_aot`` agreement collectives
+entered by a subset of ranks) hung SILENTLY: nothing was logged, nothing
+identified which collective or which rank.  ``collective_watchdog`` wraps
+each control-plane collective in a timer thread that logs a rank-stamped
+"stuck in collective X for Ns" diagnostic when the call overstays its soft
+timeout — it never kills the call (jax owns the real transport timeout);
+it makes the hang diagnosable from any one rank's log.
+
+The watchdog is ALWAYS armed (independent of the metrics enable flag —
+a hang diagnostic is exactly what you need when you didn't think to turn
+observability on).  Tune or disable via
+``MMLSPARK_TPU_OBS_COLLECTIVE_TIMEOUT_S`` (seconds; ``0`` disables).
+When metrics are enabled it additionally records a ``collective.<name>``
+span plus call-count/duration metrics.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Optional
+
+from mmlspark_tpu.obs import _state, metrics, tracing
+
+DEFAULT_TIMEOUT_S = 120.0
+# Re-arm and re-log this many times so long hangs stay visible in a
+# tailed log, then go quiet (the message carries cumulative elapsed).
+_MAX_BARKS = 5
+
+
+def _default_timeout() -> float:
+    try:
+        return float(
+            os.environ.get(
+                "MMLSPARK_TPU_OBS_COLLECTIVE_TIMEOUT_S", DEFAULT_TIMEOUT_S
+            )
+        )
+    except ValueError:
+        return DEFAULT_TIMEOUT_S
+
+
+class collective_watchdog:
+    """``with collective_watchdog("host_allgather"): <collective call>``"""
+
+    def __init__(self, name: str, timeout_s: Optional[float] = None, **attrs):
+        self.name = name
+        self.attrs = attrs
+        self.timeout_s = _default_timeout() if timeout_s is None else timeout_s
+        self.barks = 0
+        self._timer: Optional[threading.Timer] = None
+        self._t0 = 0.0
+        self._done = threading.Event()
+
+    def __enter__(self):
+        self._t0 = time.perf_counter()
+        if self.timeout_s > 0:
+            self._arm()
+        return self
+
+    def _arm(self) -> None:
+        t = threading.Timer(self.timeout_s, self._bark)
+        t.daemon = True
+        self._timer = t
+        t.start()
+
+    def _bark(self) -> None:
+        if self._done.is_set():
+            return
+        self.barks += 1
+        elapsed = time.perf_counter() - self._t0
+        tracing.get_logger().warning(
+            "rank %d: stuck in collective %s for %.1fs "
+            "(soft watchdog, still waiting; attrs=%s)",
+            _state.process_index(),
+            self.name,
+            elapsed,
+            self.attrs or {},
+        )
+        metrics.registry.inc("collective.stuck", name=self.name)
+        if self.barks < _MAX_BARKS:
+            self._arm()
+
+    def __exit__(self, exc_type, exc, tb):
+        self._done.set()
+        if self._timer is not None:
+            self._timer.cancel()
+        dur_s = time.perf_counter() - self._t0
+        if self.barks:
+            tracing.get_logger().warning(
+                "rank %d: collective %s completed after %.1fs "
+                "(watchdog had fired %d time(s))",
+                _state.process_index(),
+                self.name,
+                dur_s,
+                self.barks,
+            )
+        if _state.enabled:
+            metrics.registry.inc("collective.calls", name=self.name)
+            metrics.registry.observe(
+                "collective.duration_s", dur_s, name=self.name
+            )
+            tracing.record_span(f"collective.{self.name}", dur_s, self.attrs)
+        return False

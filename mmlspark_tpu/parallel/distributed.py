@@ -20,6 +20,8 @@ import os
 from dataclasses import dataclass
 from typing import Optional
 
+from mmlspark_tpu import obs
+
 
 @dataclass(frozen=True)
 class BarrierContext:
@@ -117,7 +119,12 @@ def make_global_array(mesh, spec, local_rows):
     sharding = NamedSharding(mesh, spec)
     if jax.process_count() == 1:
         return jax.device_put(local_rows, sharding)
-    return jax.make_array_from_process_local_data(sharding, local_rows)
+    # Cross-process assembly blocks until every process contributes — run
+    # it under the watchdog so a missing rank is diagnosed, not silent.
+    with obs.collective_watchdog(
+        "make_global_array", shape=tuple(getattr(local_rows, "shape", ()))
+    ):
+        return jax.make_array_from_process_local_data(sharding, local_rows)
 
 
 def host_allgather(arr) -> "np.ndarray":
@@ -140,7 +147,12 @@ def host_allgather(arr) -> "np.ndarray":
     # binning-sample values — bin boundaries must be bit-identical to a
     # single-host fit.
     raw = a.reshape(-1).view(np.uint8)
-    gathered = np.asarray(mhu.process_allgather(raw))  # (nproc, nbytes)
+    # The PR 1 deadlock class lived exactly here: a subset of ranks inside
+    # an allgather no other rank entered hangs FOREVER with no diagnostic.
+    # The watchdog logs a rank-stamped "stuck in collective" line past a
+    # soft timeout (and, when obs is enabled, records count/duration).
+    with obs.collective_watchdog("host_allgather", nbytes=int(raw.nbytes)):
+        gathered = np.asarray(mhu.process_allgather(raw))  # (nproc, nbytes)
     return gathered.view(a.dtype).reshape((gathered.shape[0],) + a.shape)
 
 

@@ -9,6 +9,7 @@ from typing import Any, Callable, List, Optional
 import numpy as np
 import pandas as pd
 
+from mmlspark_tpu import obs
 from mmlspark_tpu.core.frame import DataFrame
 from mmlspark_tpu.core.params import ComplexParam, Param, ParamValidators, Params
 from mmlspark_tpu.core.pipeline import Estimator, Model, Transformer
@@ -65,9 +66,12 @@ class Timer(Transformer):
     """Wrap a stage and record wall-clock of its fit/transform.
 
     The reference logs per-stage timings (UPSTREAM:.../stages/Timer.scala);
-    here timings are also kept on the instance and optionally traced via
+    here timings are kept on the instance (``lastTimings``), recorded as
+    ``stage.fit``/``stage.transform`` obs spans, and traced via
     ``jax.profiler`` ranges so device work shows up in Perfetto dumps
-    (SURVEY.md §5.1 — the "exceed the reference" hook).
+    (SURVEY.md §5.1 — the "exceed the reference" hook).  ``logToScala``
+    lines go through the obs logger (capturable/rank-stamped) instead of
+    bare ``print``.
     """
 
     stage = ComplexParam("stage", "The wrapped stage", default=None)
@@ -87,6 +91,14 @@ class Timer(Transformer):
             self.lastTimings = []
         return self.lastTimings
 
+    def _record(self, op: str, stage, dt: float) -> None:
+        self._timings().append(dt)
+        obs.record_span(f"stage.{op}", dt, stage=type(stage).__name__)
+        if self.getLogToScala():
+            obs.get_logger().info(
+                "Timer: %s(%s) took %.3fs", op, type(stage).__name__, dt
+            )
+
     def fitTimed(self, df):
         import jax.profiler
 
@@ -95,9 +107,7 @@ class Timer(Transformer):
             t0 = _time.perf_counter()
             model = stage.fit(df)
             dt = _time.perf_counter() - t0
-        self._timings().append(dt)
-        if self.getLogToScala():
-            print(f"Timer: fit({type(stage).__name__}) took {dt:.3f}s")
+        self._record("fit", stage, dt)
         return Timer(logToScala=self.getLogToScala()).setStage(model)
 
     def setStage(self, stage):
@@ -112,9 +122,7 @@ class Timer(Transformer):
             t0 = _time.perf_counter()
             out = stage.transform(df)
             dt = _time.perf_counter() - t0
-        self._timings().append(dt)
-        if self.getLogToScala():
-            print(f"Timer: transform({type(stage).__name__}) took {dt:.3f}s")
+        self._record("transform", stage, dt)
         return out
 
 

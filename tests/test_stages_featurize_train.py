@@ -98,7 +98,8 @@ class TestBasicStages:
         out = t.transform(df)
         assert out.columns == ["a"]
         assert len(t.lastTimings) == 1
-        assert "Timer: transform(DropColumns)" in capsys.readouterr().out
+        # logToScala lines go through the obs logger (stderr) now, not print
+        assert "Timer: transform(DropColumns)" in capsys.readouterr().err
 
     def test_ensemble_by_key(self):
         from mmlspark_tpu.stages import EnsembleByKey

@@ -18,6 +18,7 @@ from tools.analyze.abi import check_abi, check_float_casts
 from tools.analyze.collectives import check_collectives_file
 from tools.analyze.common import Finding, apply_suppressions
 from tools.analyze.hygiene import check_hygiene_file
+from tools.analyze.obs_rules import check_obs, check_obs_file
 from tools.analyze.tracer import check_host_only_file, check_tracer_file
 
 
@@ -431,6 +432,50 @@ def test_hyg001_silent_with_utime_on_hit(tmp_path):
                 os.remove(p)
     """)
     assert check_hygiene_file(p) == []
+
+
+# ------------------------------------------------------------ obs fixtures
+
+
+def test_obs001_bare_print_in_library_code(tmp_path):
+    p = _write(str(tmp_path / "mmlspark_tpu" / "engine" / "m.py"), """
+        def fit(x, verbose):
+            if verbose:
+                print("iteration", x)
+            return x
+    """)
+    found = check_obs_file(p)
+    assert rules(found) == ["OBS001"]
+    assert "obs logger" in found[0].message
+    # the tree walker only visits mmlspark_tpu/, so the same snippet under
+    # tests/ or tools/ never fires
+    _write(str(tmp_path / "tests" / "t.py"), "print('assert output')\n")
+    _write(str(tmp_path / "tools" / "u.py"), "print('cli output')\n")
+    assert rules(check_obs(str(tmp_path))) == ["OBS001"]
+
+
+def test_obs001_silent_on_logger_and_shadowed_print(tmp_path):
+    p = _write(str(tmp_path / "m.py"), """
+        from mmlspark_tpu import obs
+        def fit(x):
+            obs.get_logger().info("iteration %s", x)
+            return x
+        def render(print):           # a local named print is not a call
+            return print
+    """)
+    assert check_obs_file(p) == []
+
+
+def test_obs001_suppression_round_trip(tmp_path):
+    src = """
+        def show(df):
+            print(df.head()){supp}
+    """
+    fires = _write(str(tmp_path / "a.py"), src.format(supp=""))
+    assert rules(apply_suppressions(check_obs_file(fires))) == ["OBS001"]
+    silenced = _write(str(tmp_path / "b.py"),
+                      src.format(supp="  # analyze: ignore[OBS001]"))
+    assert apply_suppressions(check_obs_file(silenced)) == []
 
 
 # ------------------------------------------------------------ suppressions

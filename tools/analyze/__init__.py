@@ -14,11 +14,13 @@ from tools.analyze.abi import check_abi
 from tools.analyze.collectives import check_collectives
 from tools.analyze.common import Finding, apply_suppressions
 from tools.analyze.hygiene import check_hygiene
+from tools.analyze.obs_rules import check_obs
 from tools.analyze.tracer import check_tracer
 
 __all__ = [
     "Finding", "run_all", "repo_root",
     "check_abi", "check_collectives", "check_tracer", "check_hygiene",
+    "check_obs",
 ]
 
 
@@ -34,6 +36,7 @@ def run_all(root: "str | None" = None) -> list:
     findings.extend(check_collectives(root))
     findings.extend(check_tracer(root))
     findings.extend(check_hygiene(root))
+    findings.extend(check_obs(root))
     findings = apply_suppressions(findings)
     findings.sort(key=lambda f: (f.file, f.line, f.rule))
     return findings

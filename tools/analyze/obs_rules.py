@@ -1,0 +1,56 @@
+"""Pass 5 — observability hygiene.
+
+Rules
+-----
+- OBS001: bare ``print(`` in library code under ``mmlspark_tpu/``.
+  Library output must go through the obs logger
+  (``mmlspark_tpu.obs.get_logger()``) so it is capturable, rank-stamped,
+  and level-filterable — a bare print from 8 TPU processes interleaves
+  uselessly and cannot be silenced by serving embedders.  Tests and
+  ``tools/`` are exempt (prints there are CLI/diagnostic output by
+  contract), as is the rare intentional case marked
+  ``# analyze: ignore[OBS001]`` (e.g. ``DataFrame.show()``, whose
+  contract IS stdout).
+"""
+
+from __future__ import annotations
+
+import ast
+import glob
+import os
+
+from tools.analyze.common import Finding
+
+
+def check_obs_file(path: str) -> list:
+    try:
+        with open(path, encoding="utf-8", errors="replace") as fh:
+            tree = ast.parse(fh.read(), filename=path)
+    except SyntaxError:
+        return []
+    findings = []
+    for node in ast.walk(tree):
+        if (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Name)
+            and node.func.id == "print"
+        ):
+            findings.append(
+                Finding(
+                    path, node.lineno, "OBS001",
+                    "bare print() in library code — route through the obs "
+                    "logger (mmlspark_tpu.obs.get_logger()) so output is "
+                    "capturable and rank-stamped, or mark an intentional "
+                    "stdout contract with # analyze: ignore[OBS001]",
+                )
+            )
+    return findings
+
+
+def check_obs(root: str) -> list:
+    findings: list = []
+    pkg = os.path.join(root, "mmlspark_tpu")
+    for py in sorted(glob.glob(os.path.join(pkg, "**", "*.py"),
+                               recursive=True)):
+        findings.extend(check_obs_file(py))
+    return findings

@@ -114,10 +114,12 @@ def run_child(n_dev: int):
     jax.config.update("jax_platforms", "cpu")
     jax.config.update("jax_num_cpu_devices", n_dev)
 
+    from mmlspark_tpu import obs
     from mmlspark_tpu.engine.booster import Dataset, train
     from mmlspark_tpu.ops.binning import BinMapper
     from mmlspark_tpu.parallel.mesh import default_mesh
 
+    obs.enable()  # per-phase breakdowns ride along in the JSON row
     n = ROWS_PER_DEV * n_dev  # weak scaling: fixed rows per device
     X, y = make_data(n)
     bm = BinMapper(max_bin=B - 1).fit(X)
@@ -181,6 +183,7 @@ def run_child(n_dev: int):
             "psum_s": round(timed(psum_f, h), 5),
             "psum_scatter_s": round(timed(scat_f, h), 5),
         }
+    results["obs"] = obs.snapshot()
     print(json.dumps(results))
 
 

@@ -1,0 +1,145 @@
+"""tools.obs — offline reporting over ``mmlspark_tpu.obs`` JSONL exports.
+
+``python -m tools.obs report [--json] [path]`` aggregates the span records
+(and the final snapshot record each rank appends at exit) from a
+``MMLSPARK_TPU_OBS=<path>`` run.  Multi-process runs write per-rank files
+(``<path>.rank<R>``); the report reads the base path plus every rank
+sibling it finds.
+
+Pure stdlib — usable on a machine without jax installed.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+from typing import Dict, List, Optional
+
+
+def discover_files(path: str) -> List[str]:
+    """The base export file plus any ``<path>.rank<R>`` siblings."""
+    files = []
+    if os.path.isfile(path):
+        files.append(path)
+    files.extend(sorted(glob.glob(glob.escape(path) + ".rank*")))
+    return files
+
+
+def load_records(path: str) -> List[dict]:
+    """All well-formed JSONL records across the export's rank files.
+    Malformed lines (torn writes from a killed process) are skipped."""
+    records: List[dict] = []
+    for fn in discover_files(path):
+        with open(fn, "r") as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    continue
+                if isinstance(rec, dict):
+                    records.append(rec)
+    return records
+
+
+def aggregate(records: List[dict]) -> dict:
+    """Fold span records into per-name stats; keep the LAST snapshot per
+    rank (the exit-time one supersedes any mid-run export_snapshot)."""
+    spans: Dict[str, dict] = {}
+    snapshots: Dict[str, dict] = {}
+    ranks = set()
+    for rec in records:
+        kind = rec.get("kind")
+        if kind == "span":
+            name = rec.get("name", "?")
+            dur = float(rec.get("dur_s", 0.0))
+            ranks.add(rec.get("rank", 0))
+            agg = spans.get(name)
+            if agg is None:
+                agg = spans[name] = {
+                    "count": 0,
+                    "total_s": 0.0,
+                    "max_s": 0.0,
+                    "ranks": set(),
+                }
+            agg["count"] += 1
+            agg["total_s"] += dur
+            agg["max_s"] = max(agg["max_s"], dur)
+            agg["ranks"].add(rec.get("rank", 0))
+        elif kind == "snapshot":
+            rank = rec.get("rank", 0)
+            ranks.add(rank)
+            snapshots[str(rank)] = rec.get("snapshot", {})
+    for agg in spans.values():
+        agg["mean_s"] = agg["total_s"] / agg["count"]
+        agg["ranks"] = sorted(agg.pop("ranks"))
+    return {
+        "span_records": sum(a["count"] for a in spans.values()),
+        "ranks": sorted(ranks),
+        "spans": spans,
+        "snapshots": snapshots,
+    }
+
+
+def render_text(report: dict, files: List[str]) -> str:
+    out: List[str] = []
+    out.append(
+        f"obs report — {len(files)} file(s), "
+        f"{report['span_records']} span record(s), "
+        f"rank(s) {report['ranks'] or [0]}"
+    )
+    if report["spans"]:
+        out.append("")
+        out.append(
+            f"  {'span':<40} {'count':>7} {'total_s':>10} "
+            f"{'mean_s':>10} {'max_s':>10}"
+        )
+        for name in sorted(
+            report["spans"], key=lambda n: -report["spans"][n]["total_s"]
+        ):
+            a = report["spans"][name]
+            out.append(
+                f"  {name:<40} {a['count']:>7} {a['total_s']:>10.4f} "
+                f"{a['mean_s']:>10.4f} {a['max_s']:>10.4f}"
+            )
+    for rank in sorted(report["snapshots"]):
+        snap = report["snapshots"][rank]
+        counters = snap.get("counters", {})
+        gauges = snap.get("gauges", {})
+        hists = snap.get("histograms", {})
+        out.append("")
+        out.append(f"  snapshot (rank {rank}):")
+        for k in sorted(counters):
+            out.append(f"    counter  {k} = {counters[k]:g}")
+        for k in sorted(gauges):
+            out.append(f"    gauge    {k} = {gauges[k]:g}")
+        for k in sorted(hists):
+            h = hists[k]
+            if h.get("count"):
+                out.append(
+                    f"    hist     {k}: count={h['count']} "
+                    f"mean={h['mean']:.6g} p50={h['p50']:.6g} "
+                    f"p95={h['p95']:.6g} max={h['max']:.6g}"
+                )
+            else:
+                out.append(f"    hist     {k}: count=0")
+    if not report["spans"] and not report["snapshots"]:
+        out.append("  (no records)")
+    return "\n".join(out)
+
+
+def build_report(path: str) -> dict:
+    files = discover_files(path)
+    report = aggregate(load_records(path))
+    report["files"] = files
+    return report
+
+
+def default_path() -> Optional[str]:
+    raw = os.environ.get("MMLSPARK_TPU_OBS", "").strip()
+    if raw and raw.lower() not in ("0", "1", "false", "true", "off", "on"):
+        return raw
+    return None

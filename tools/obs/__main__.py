@@ -1,0 +1,52 @@
+"""``python -m tools.obs report [--json] [path]`` — summarize a
+``MMLSPARK_TPU_OBS`` JSONL export (path defaults to that env var).
+
+Exit 0 on success (even for an empty export), 2 when no export file can
+be found — so CI smoke steps fail loudly if instrumentation vanished.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from tools.obs import build_report, default_path, discover_files, render_text
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="python -m tools.obs")
+    sub = ap.add_subparsers(dest="cmd", required=True)
+    rep = sub.add_parser("report", help="aggregate a JSONL export")
+    rep.add_argument(
+        "path",
+        nargs="?",
+        default=None,
+        help="export file (default: $MMLSPARK_TPU_OBS)",
+    )
+    rep.add_argument("--json", action="store_true", help="machine output")
+    ns = ap.parse_args(argv)
+
+    path = ns.path or default_path()
+    if not path:
+        print(
+            "tools.obs report: no path given and MMLSPARK_TPU_OBS unset",
+            file=sys.stderr,
+        )
+        return 2
+    if not discover_files(path):
+        print(f"tools.obs report: no export found at {path}", file=sys.stderr)
+        return 2
+    report = build_report(path)
+    try:
+        if ns.json:
+            print(json.dumps(report, indent=2, sort_keys=True, default=str))
+        else:
+            print(render_text(report, report["files"]))
+    except BrokenPipeError:
+        return 0  # report | head is fine
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
