@@ -130,6 +130,20 @@ def _install_hit_recorder(cache_dir: str) -> None:
         pass
 
 
+def cache_counters() -> dict:
+    """Current hit/miss/pruned counters for the persistent cache (from the
+    obs registry; zeros while obs is disabled).  The serving readiness
+    gate snapshots these at startup: pre-warming is proven by the miss
+    AND hit counters staying flat across first real requests — a warmed
+    shape never reaches the compilation cache at all.
+    """
+    counters = obs.snapshot().get("counters", {})
+    return {
+        key: float(counters.get(f"jit_cache.{key}", 0.0))
+        for key in ("hit", "miss", "pruned")
+    }
+
+
 def prune_cache_dir(path: str, max_mb: float | None = None) -> int:
     """Best-effort LRU prune of ``path`` to ``max_mb``; returns files removed.
 

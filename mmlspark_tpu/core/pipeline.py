@@ -212,6 +212,15 @@ class PipelineModel(_StagesPersistence, Model):
         return df
 
 
+def saved_stage_metadata(path: str) -> dict:
+    """Read a saved stage directory's ``metadata.json`` without loading
+    any payloads.  The serving registry uses this to validate a model
+    directory (and report its class/uid on ``/readyz``) before committing
+    to a full — possibly off-thread — load."""
+    with open(os.path.join(path, "metadata.json")) as f:
+        return json.load(f)
+
+
 def _save_stage_list(stages, path):
     os.makedirs(os.path.join(path, "stages"), exist_ok=True)
     order = []

@@ -515,6 +515,45 @@ class Booster:
         tr = np.asarray(self.objective.transform(jnp.asarray(raw)))
         return tr[0] if tr.shape[0] == 1 else tr.T
 
+    def predict_padded(
+        self,
+        X: np.ndarray,
+        n_valid: int,
+        raw_score: bool = False,
+        num_iteration: Optional[int] = None,
+    ) -> np.ndarray:
+        """Serving entry for padded bucket batches (mmlspark_tpu.serve).
+
+        ``X`` has a FIXED bucket shape (B, F) where only the first
+        ``n_valid`` rows are real; the tail is zero padding so repeated
+        calls reuse one jitted program per bucket instead of compiling a
+        fresh program for every distinct row count (the compile churn
+        that kills the naive fixed-batch loop under variable traffic).
+        Returns predictions for the real rows only.
+        """
+        out = self.predict(
+            np.asarray(X, dtype=np.float64),
+            raw_score=raw_score,
+            num_iteration=num_iteration,
+        )
+        return out[: int(n_valid)]
+
+    def prewarm_predict(
+        self, batch_sizes: Sequence[int], raw_score: bool = False
+    ) -> None:
+        """Compile (and persistent-cache, via core/jit_cache) the predict
+        program for each serving bucket shape up front, so a serving
+        process answers its first real request without a compile stall."""
+        from mmlspark_tpu.core.jit_cache import enable_compile_cache
+
+        enable_compile_cache()
+        F = self.num_features
+        for b in batch_sizes:
+            with obs.span("serve.prewarm", bucket=int(b)):
+                self.predict_padded(
+                    np.zeros((int(b), F)), 1, raw_score=raw_score
+                )
+
     def feature_importance(self, importance_type: str = "split") -> np.ndarray:
         """Split-count or total-gain importances (parity:
         ``LightGBMBooster.getFeatureImportances`` — SURVEY.md §2.3)."""

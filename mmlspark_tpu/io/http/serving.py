@@ -1,4 +1,4 @@
-"""Serving: turn a pipeline into a web service.
+"""Serving transport: micro-batch HTTP source/sink on one port.
 
 Reference parity (SURVEY.md §2.6 "Spark Serving", §3.4 request lifecycle):
 ``HTTPSource``/``DistributedHTTPSource`` embed an ``HttpServer`` whose
@@ -12,6 +12,25 @@ micro-batch into a DataFrame; :meth:`HTTPServer.reply` sends responses by
 id.  ``serve_transformer`` wires a Transformer into that loop — model
 inference then batches whole micro-batches through one jitted call
 (SURVEY.md §3.3), which is the serving win on TPU.
+
+This module is deliberately only the TRANSPORT.  The production serving
+engine — deadline-aware dynamic batching, model registry with hot-swap,
+admission control — lives in :mod:`mmlspark_tpu.serve` and plugs in
+through :attr:`HTTPServer.intake`: when set, every accepted request is
+handed to the engine (which owns routing/queueing/replying) instead of
+the built-in micro-batch queue.
+
+Env knobs:
+
+- ``MMLSPARK_TPU_SERVING_REQUEST_TIMEOUT_S`` — server-side cap on how
+  long a handler thread waits for a correlated reply (default 60).
+  Clients may lower (never raise) their own wait via an
+  ``X-Request-Deadline-Ms`` header.
+- ``MMLSPARK_TPU_SERVING_QUEUE_DEPTH`` — bound on the built-in request
+  queue (default 1024); excess requests are shed with 503 + Retry-After
+  instead of buffering unbounded memory.
+- ``MMLSPARK_TPU_SERVING_MAX_ENTITY_BYTES`` — entity-size ceiling
+  (default 16 MiB); larger requests are rejected with 413.
 """
 
 from __future__ import annotations
@@ -35,14 +54,66 @@ _MAX_ENTITY_BYTES = int(
     os.environ.get("MMLSPARK_TPU_SERVING_MAX_ENTITY_BYTES", 16 << 20)
 )
 
+_DEADLINE_HEADER = "X-Request-Deadline-Ms"
+
+
+def request_timeout_s() -> float:
+    """Server-side reply-wait cap (read per request so tests and embedders
+    can adjust the env without rebuilding servers)."""
+    try:
+        return float(
+            os.environ.get("MMLSPARK_TPU_SERVING_REQUEST_TIMEOUT_S", 60.0)
+        )
+    except ValueError:
+        return 60.0
+
+
+def _queue_depth_limit() -> int:
+    try:
+        return int(os.environ.get("MMLSPARK_TPU_SERVING_QUEUE_DEPTH", 1024))
+    except ValueError:
+        return 1024
+
+
+def effective_wait_s(headers, cap_s: Optional[float] = None) -> float:
+    """The reply wait for one request: the server cap, lowered (never
+    raised) by a client ``X-Request-Deadline-Ms`` header."""
+    cap = request_timeout_s() if cap_s is None else cap_s
+    raw = headers.get(_DEADLINE_HEADER) if headers is not None else None
+    if raw is None:
+        return cap
+    try:
+        client_s = float(raw) / 1000.0
+    except (TypeError, ValueError):
+        return cap
+    if client_s <= 0:
+        return cap
+    return min(cap, client_s)
+
 
 class HTTPServer:
-    """Micro-batch HTTP source/sink pair on one port."""
+    """Micro-batch HTTP source/sink pair on one port.
+
+    Reply/timeout correlation is atomic: one lock guards the responder
+    event and the response slot, so a ``reply`` racing the handler's
+    timeout either delivers (the handler returns the response even if the
+    wait just expired) or cleanly no-ops (the handler already withdrew the
+    responder) — the stored response can never be orphaned.
+    """
+
+    #: Optional engine hook: ``intake(rid, request, wait_s)`` is called for
+    #: every accepted request INSTEAD of the built-in queue.  Return an
+    #: HTTPResponseData to answer immediately (e.g. health/shed verdicts),
+    #: or None to take ownership — the engine must eventually ``reply``
+    #: within ``wait_s`` seconds or the handler answers 504.
+    intake: Optional[Callable[[str, HTTPRequestData, float], Optional[HTTPResponseData]]]
 
     def __init__(self, host: str = "127.0.0.1", port: int = 0, api_path: str = "/"):
-        self._requests: "queue.Queue" = queue.Queue()
+        self._requests: "queue.Queue" = queue.Queue(maxsize=_queue_depth_limit())
+        self._lock = threading.Lock()
         self._responders: Dict[str, threading.Event] = {}
         self._responses: Dict[str, HTTPResponseData] = {}
+        self.intake = None
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -90,16 +161,54 @@ class HTTPServer:
                     url=self.path, method=method,
                     headers=dict(self.headers.items()), entity=body,
                 )
+                wait_s = effective_wait_s(self.headers)
                 ev = threading.Event()
-                outer._responders[rid] = ev
-                outer._requests.put((rid, req))
-                obs.gauge("http.queue_depth", outer._requests.qsize())
-                if not ev.wait(timeout=60.0):
-                    outer._responders.pop(rid, None)
+                with outer._lock:
+                    outer._responders[rid] = ev
+                if outer.intake is not None:
+                    try:
+                        immediate = outer.intake(rid, req, wait_s)
+                    except Exception as e:  # engine bug → 500, not a hang
+                        obs.inc("http.intake_errors")
+                        immediate = HTTPResponseData(
+                            statusCode=500, statusReason=repr(e)
+                        )
+                    if immediate is not None:
+                        with outer._lock:
+                            outer._responders.pop(rid, None)
+                            outer._responses.pop(rid, None)
+                        self._finish(
+                            immediate.statusCode or 200,
+                            entity=immediate.entity,
+                            headers=immediate.headers,
+                            t0=t0,
+                        )
+                        return
+                else:
+                    try:
+                        outer._requests.put_nowait((rid, req))
+                    except queue.Full:
+                        with outer._lock:
+                            outer._responders.pop(rid, None)
+                        obs.inc("http.shed")
+                        self._finish(
+                            503, b"request queue full",
+                            headers={"Retry-After": "1"}, t0=t0,
+                        )
+                        return
+                    obs.gauge("http.queue_depth", outer._requests.qsize())
+                ev.wait(timeout=wait_s)
+                # Atomic resolution: whichever side got here first wins,
+                # and a reply that raced the wait expiry is still
+                # delivered instead of leaking in _responses.
+                with outer._lock:
+                    resp = outer._responses.pop(rid, None)
+                    if resp is None:
+                        outer._responders.pop(rid, None)
+                if resp is None:
                     obs.inc("http.timeouts")
                     self._finish(504, t0=t0)
                     return
-                resp = outer._responses.pop(rid)
                 self._finish(
                     resp.statusCode or 200,
                     entity=resp.entity,
@@ -138,15 +247,26 @@ class HTTPServer:
                 rows.append({"id": rid, "request": req.to_row()})
         except queue.Empty:
             pass
+        if rows:
+            # keep the gauge honest on the drain side too (it used to be
+            # updated only on enqueue, so it read permanently high)
+            obs.gauge("http.queue_depth", self._requests.qsize())
         return DataFrame(rows or {"id": [], "request": []})
 
     # -- sink ------------------------------------------------------------
     def reply(self, request_id: str, response: HTTPResponseData) -> None:
-        ev = self._responders.pop(request_id, None)
-        if ev is None:
-            return
-        self._responses[request_id] = response
+        with self._lock:
+            ev = self._responders.pop(request_id, None)
+            if ev is None:
+                return  # handler timed out and withdrew — nothing to leak
+            self._responses[request_id] = response
         ev.set()
+
+    def pending_replies(self) -> int:
+        """Responders still waiting for a correlated reply (diagnostics +
+        the graceful-drain invariant: zero after a clean shutdown)."""
+        with self._lock:
+            return len(self._responders)
 
     def reply_batch(self, df: DataFrame, response_col: str = "response") -> None:
         for row in df.collect():

@@ -1,0 +1,33 @@
+"""mmlspark_tpu.serve — production inference serving.
+
+The serving engine on top of the :mod:`mmlspark_tpu.io.http.serving`
+transport: deadline-aware dynamic micro-batching with bucket padding
+(:mod:`~mmlspark_tpu.serve.batcher`), a versioned model registry with
+atomic hot-swap and rollback (:mod:`~mmlspark_tpu.serve.registry`),
+admission control with load shedding and graceful drain
+(:mod:`~mmlspark_tpu.serve.admission`), all composed by
+:class:`~mmlspark_tpu.serve.app.ServingApp`.
+
+See ``mmlspark_tpu/serve/README.md`` for architecture, env knobs, and the
+hot-swap protocol; ``tools/bench_serving.py`` for the load generator.
+"""
+
+from mmlspark_tpu.serve.admission import AdmissionController
+from mmlspark_tpu.serve.app import ServingApp, default_predictor
+from mmlspark_tpu.serve.batcher import (
+    DEFAULT_BUCKETS,
+    BatchItem,
+    DynamicBatcher,
+)
+from mmlspark_tpu.serve.registry import ModelRegistry, ModelVersion
+
+__all__ = [
+    "AdmissionController",
+    "BatchItem",
+    "DEFAULT_BUCKETS",
+    "DynamicBatcher",
+    "ModelRegistry",
+    "ModelVersion",
+    "ServingApp",
+    "default_predictor",
+]

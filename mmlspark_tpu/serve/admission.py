@@ -1,0 +1,168 @@
+"""serve.admission — bounded queues, load shedding, and graceful drain.
+
+Every predict request passes through :meth:`AdmissionController.admit`
+BEFORE it costs anything: the verdict is taken on the transport thread,
+so an overloaded server answers cheap 429/503s instead of buffering
+unbounded work it will answer late (or never).  Verdicts:
+
+- ``accept``   — enqueued on the route's bounded queue;
+- ``shed``     — 429 + ``Retry-After`` (queue full, or the route's
+  in-flight concurrency cap is reached);
+- ``not_ready``— 503 (startup: models still loading/pre-warming);
+- ``draining`` — 503 (shutdown: flushing in-flight, accepting nothing).
+
+Graceful drain (:meth:`begin_drain`) is the shutdown half: stop accepting,
+wait for every admitted request to be answered, then let the caller tear
+the transport down — no unanswered responders left behind.
+
+Every verdict is counted (``serve.admission{verdict=,route=}``) and queue
+depths are gauged, all through :mod:`mmlspark_tpu.obs`.
+"""
+
+from __future__ import annotations
+
+import math
+import queue
+import threading
+from typing import Dict, Optional
+
+from mmlspark_tpu import obs
+from mmlspark_tpu.io.http.http_schema import HTTPResponseData
+
+
+def _verdict_response(status: int, reason: str, retry_after_s: float) -> HTTPResponseData:
+    return HTTPResponseData(
+        statusCode=status,
+        statusReason=reason,
+        headers={
+            "Retry-After": str(max(1, int(math.ceil(retry_after_s)))),
+            "Content-Type": "text/plain",
+        },
+        entity=reason.encode(),
+    )
+
+
+class _RouteState:
+    __slots__ = ("queue", "inflight", "max_inflight")
+
+    def __init__(self, depth: int, max_inflight: int):
+        self.queue: "queue.Queue" = queue.Queue(maxsize=depth)
+        self.inflight = 0
+        self.max_inflight = max_inflight
+
+
+class AdmissionController:
+    """Per-route bounded queues + concurrency caps + lifecycle gates."""
+
+    def __init__(
+        self,
+        max_queue_depth: int = 256,
+        max_inflight: int = 1024,
+        retry_after_s: float = 1.0,
+    ):
+        self._depth = int(max_queue_depth)
+        self._max_inflight = int(max_inflight)
+        self._retry_after_s = float(retry_after_s)
+        self._lock = threading.Lock()
+        self._routes: Dict[str, _RouteState] = {}
+        self._ready = False
+        self._draining = False
+        self._idle = threading.Event()
+        self._idle.set()
+
+    # -- lifecycle -------------------------------------------------------
+    @property
+    def ready(self) -> bool:
+        return self._ready and not self._draining
+
+    def set_ready(self, ready: bool = True) -> None:
+        self._ready = bool(ready)
+
+    @property
+    def draining(self) -> bool:
+        return self._draining
+
+    def begin_drain(self, timeout_s: float = 30.0) -> bool:
+        """Stop accepting; True once every admitted request was answered."""
+        with self._lock:
+            self._draining = True
+            if self._total_inflight_locked() == 0:
+                self._idle.set()
+            else:
+                self._idle.clear()
+        drained = self._idle.wait(timeout=timeout_s)
+        obs.inc("serve.drains", clean=drained)
+        return drained
+
+    def _total_inflight_locked(self) -> int:
+        return sum(st.inflight for st in self._routes.values())
+
+    # -- routes ----------------------------------------------------------
+    def register_route(
+        self, route: str, max_inflight: Optional[int] = None
+    ) -> "queue.Queue":
+        """Create (or return) the route's bounded queue."""
+        with self._lock:
+            st = self._routes.get(route)
+            if st is None:
+                st = self._routes[route] = _RouteState(
+                    self._depth, int(max_inflight or self._max_inflight)
+                )
+            return st.queue
+
+    def queue_for(self, route: str) -> Optional["queue.Queue"]:
+        with self._lock:
+            st = self._routes.get(route)
+            return st.queue if st else None
+
+    # -- the verdict -----------------------------------------------------
+    def admit(self, route: str, item) -> Optional[HTTPResponseData]:
+        """None = accepted (item enqueued); otherwise the shed/unready
+        response to send immediately."""
+        with self._lock:
+            st = self._routes.get(route)
+            if st is None or not self._ready:
+                verdict = "not_ready"
+            elif self._draining:
+                verdict = "draining"
+            elif st.inflight >= st.max_inflight:
+                verdict = "shed_inflight"
+            else:
+                verdict = "accept"
+            if verdict == "accept":
+                try:
+                    st.queue.put_nowait(item)
+                except queue.Full:
+                    verdict = "shed_queue"
+                else:
+                    st.inflight += 1
+                    self._idle.clear()
+                    obs.gauge("serve.queue_depth", st.queue.qsize(), route=route)
+        obs.inc("serve.admission", verdict=verdict, route=route)
+        if verdict == "accept":
+            return None
+        if verdict in ("shed_inflight", "shed_queue"):
+            return _verdict_response(
+                429, "overloaded, retry later", self._retry_after_s
+            )
+        if verdict == "draining":
+            return _verdict_response(503, "draining", self._retry_after_s)
+        return _verdict_response(503, "not ready", self._retry_after_s)
+
+    def complete(self, route: str, n: int = 1) -> None:
+        """Mark ``n`` admitted requests answered (called after reply)."""
+        with self._lock:
+            st = self._routes.get(route)
+            if st is None:
+                return
+            st.inflight = max(0, st.inflight - n)
+            obs.gauge("serve.queue_depth", st.queue.qsize(), route=route)
+            if self._draining and self._total_inflight_locked() == 0:
+                self._idle.set()
+
+    def inflight(self, route: Optional[str] = None) -> int:
+        with self._lock:
+            if route is not None:
+                st = self._routes.get(route)
+                return st.inflight if st else 0
+            return self._total_inflight_locked()

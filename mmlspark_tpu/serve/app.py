@@ -1,0 +1,402 @@
+"""serve.app — the serving composition root.
+
+Turns saved pipelines into a production-shaped HTTP service on top of the
+:class:`~mmlspark_tpu.io.http.serving.HTTPServer` transport:
+
+- ``GET  /healthz``               — process liveness (always 200);
+- ``GET  /readyz``                — 200 once models are loaded AND every
+  bucket shape is pre-warmed (503 while starting or draining);
+- ``GET  /metrics``               — the full obs snapshot as JSON;
+- ``POST /models/<name>/predict`` — admission → dynamic batcher →
+  bucket-padded jitted predict → correlated reply.
+
+Request body: ``{"features": [f0, f1, ...]}`` for one row, or
+``{"instances": [[...], [...], ...]}`` for several.  Responses carry an
+``X-Model-Version`` header so hot-swaps are observable from the client
+side.  Clients may lower their wait with ``X-Request-Deadline-Ms``
+(clamped to the server cap) — the batcher uses the same deadline for its
+earliest-deadline close rule.
+
+Hot-swap: :meth:`ServingApp.swap_model` loads the new version (off-thread
+with ``block=False``), pre-warms its bucket shapes, atomically flips the
+route, drains the old version, and keeps it for :meth:`rollback`.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import threading
+import time
+from typing import Callable, Dict, Optional, Sequence
+
+import numpy as np
+
+from mmlspark_tpu import obs
+from mmlspark_tpu.core.frame import DataFrame
+from mmlspark_tpu.core.jit_cache import cache_counters, enable_compile_cache
+from mmlspark_tpu.io.http.http_schema import HTTPRequestData, HTTPResponseData
+from mmlspark_tpu.io.http.serving import HTTPServer
+from mmlspark_tpu.serve.admission import AdmissionController
+from mmlspark_tpu.serve.batcher import DEFAULT_BUCKETS, BatchItem, DynamicBatcher
+from mmlspark_tpu.serve.registry import ModelRegistry, ModelVersion
+
+_PREDICT_RE = re.compile(r"^/models/([A-Za-z0-9_.-]+)/predict$")
+
+
+def _json_response(status: int, payload, headers: Optional[dict] = None) -> HTTPResponseData:
+    h = {"Content-Type": "application/json"}
+    if headers:
+        h.update(headers)
+    return HTTPResponseData(
+        statusCode=status,
+        headers=h,
+        entity=json.dumps(payload, default=str).encode(),
+    )
+
+
+def _find_booster(model):
+    """The Booster inside a model, if there is one (LightGBM facades or a
+    PipelineModel ending in one) — enables the padded fast path."""
+    if hasattr(model, "getBooster"):
+        try:
+            return model.getBooster()
+        except Exception:
+            return None
+    stages = None
+    if hasattr(model, "getStages"):
+        try:
+            stages = model.getStages()
+        except Exception:
+            stages = None
+    for stage in reversed(list(stages or [])):
+        b = _find_booster(stage)
+        if b is not None:
+            return b
+    return None
+
+
+def default_predictor(model):
+    """``(predict_fn, feature_dim)`` for a model.
+
+    Boosters get the padded serving entry (one jitted program per bucket
+    shape); any other Transformer falls back to the generic
+    ``transform(DataFrame)`` path reading its ``prediction`` column.
+    ``predict_fn(model, padded_X, n_valid)`` must accept the CURRENT model
+    (hot-swaps hand it a different instance of the same shape).
+    """
+    booster = _find_booster(model)
+    if booster is not None:
+        def fn(m, X, n):
+            return _find_booster(m).predict_padded(X, n)
+
+        return fn, int(booster.num_features)
+
+    def fn(m, X, n):
+        out = m.transform(DataFrame({"features": list(X)}))
+        return np.asarray(out["prediction"])[: int(n)]
+
+    return fn, None
+
+
+class _Route:
+    def __init__(self, name: str, batcher: DynamicBatcher, q,
+                 predict: Callable, feature_dim: Optional[int]):
+        self.name = name
+        self.batcher = batcher
+        self.queue = q
+        self.predict = predict
+        self.feature_dim = feature_dim
+        self.prewarmed = False
+        self.thread: Optional[threading.Thread] = None
+
+
+class ServingApp:
+    """Compose transport + admission + batcher + registry into a service.
+
+    Typical use::
+
+        app = ServingApp(port=8900)
+        app.add_model("churn", path="/models/churn_v1")
+        app.start()                      # pre-warms, then accepts traffic
+        ...
+        app.swap_model("churn", path="/models/churn_v2", block=False)
+        ...
+        app.stop()                       # graceful drain, then exit
+    """
+
+    def __init__(
+        self,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        buckets: Sequence[int] = DEFAULT_BUCKETS,
+        max_wait_ms: float = 25.0,
+        deadline_slack_ms: float = 50.0,
+        max_queue_depth: int = 256,
+        max_inflight: int = 1024,
+        prewarm: bool = True,
+        registry: Optional[ModelRegistry] = None,
+    ):
+        self.registry = registry or ModelRegistry()
+        self.admission = AdmissionController(
+            max_queue_depth=max_queue_depth, max_inflight=max_inflight
+        )
+        self._batcher_cfg = dict(
+            buckets=tuple(buckets),
+            max_wait_ms=max_wait_ms,
+            deadline_slack_ms=deadline_slack_ms,
+        )
+        self._prewarm = prewarm
+        self._routes: Dict[str, _Route] = {}
+        self._stop = threading.Event()
+        self._started = False
+        self._jit_counters_at_ready: Dict[str, float] = {}
+        self._server = HTTPServer(host, port)
+        self._server.intake = self._intake
+
+    # -- properties ------------------------------------------------------
+    @property
+    def host(self) -> str:
+        return self._server.host
+
+    @property
+    def port(self) -> int:
+        return self._server.port
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}"
+
+    @property
+    def buckets(self) -> tuple:
+        return tuple(self._batcher_cfg["buckets"])
+
+    @property
+    def ready(self) -> bool:
+        return self.admission.ready and bool(self._routes)
+
+    def jit_counters_at_ready(self) -> Dict[str, float]:
+        """jit_cache hit/miss counters snapshotted when the app reported
+        ready — the pre-warming acceptance check is that serving traffic
+        does not move them."""
+        return dict(self._jit_counters_at_ready)
+
+    # -- models ----------------------------------------------------------
+    def add_model(
+        self,
+        name: str,
+        path: Optional[str] = None,
+        model=None,
+        feature_dim: Optional[int] = None,
+        predictor: Optional[Callable] = None,
+    ) -> ModelVersion:
+        """Register a route.  ``path`` loads a ``Pipeline.save`` directory;
+        ``model`` takes an in-memory Transformer directly."""
+        if name in self._routes:
+            raise ValueError(f"route {name!r} already exists; use swap_model")
+        mv = self.registry.register(name, model=model, path=path)
+        if predictor is None:
+            predict, inferred_dim = default_predictor(mv.model)
+        else:
+            predict, inferred_dim = predictor, None
+        route = _Route(
+            name,
+            DynamicBatcher(**self._batcher_cfg),
+            self.admission.register_route(name),
+            predict,
+            feature_dim if feature_dim is not None else inferred_dim,
+        )
+        self._routes[name] = route
+        route.thread = threading.Thread(
+            target=self._worker, args=(route,), daemon=True,
+            name=f"serve-{name}",
+        )
+        route.thread.start()
+        if self._started:
+            self._prewarm_route(route, mv)
+            # Routes added post-start re-baseline the ready snapshot so
+            # their own warm compiles aren't misread as traffic compiles.
+            self._jit_counters_at_ready = cache_counters()
+        return mv
+
+    def swap_model(self, name: str, path: Optional[str] = None, model=None,
+                   block: bool = True):
+        """Zero-downtime replacement of a route's model (load → warm →
+        flip → drain old); see :meth:`ModelRegistry.swap`."""
+        route = self._routes[name]
+
+        def warm(mv: ModelVersion) -> None:
+            if self._prewarm and route.feature_dim is not None:
+                route.batcher.prewarm(
+                    lambda X, n: route.predict(mv.model, X, n),
+                    route.feature_dim,
+                )
+
+        return self.registry.swap(name, path=path, model=model, warm=warm,
+                                  block=block)
+
+    def rollback(self, name: str) -> ModelVersion:
+        return self.registry.rollback(name)
+
+    # -- lifecycle -------------------------------------------------------
+    def start(self) -> "ServingApp":
+        """Enable obs + the persistent compile cache, pre-warm every
+        route's bucket shapes, then open for traffic."""
+        if self._started:
+            return self
+        if not obs.enabled():
+            obs.enable()  # /metrics must have something to say
+        enable_compile_cache()
+        self._server.start()
+        self._started = True
+        for name, route in self._routes.items():
+            mv = self.registry.get(name)
+            if mv is not None:
+                self._prewarm_route(route, mv)
+        self._jit_counters_at_ready = cache_counters()
+        self.admission.set_ready(True)
+        obs.inc("serve.starts")
+        return self
+
+    def stop(self, drain_s: float = 10.0) -> bool:
+        """Graceful shutdown: stop accepting, flush in-flight, stop the
+        workers and the transport.  True when the drain was clean."""
+        drained = self.admission.begin_drain(timeout_s=drain_s)
+        self._stop.set()
+        for route in self._routes.values():
+            if route.thread is not None:
+                route.thread.join(timeout=5.0)
+        self._server.stop()
+        self.admission.set_ready(False)
+        return drained
+
+    def _prewarm_route(self, route: _Route, mv: ModelVersion) -> None:
+        if not self._prewarm or route.prewarmed:
+            return
+        if route.feature_dim is None:
+            obs.get_logger("mmlspark_tpu.serve").warning(
+                "route %s: unknown feature_dim, skipping pre-warm "
+                "(first request per bucket will compile)", route.name,
+            )
+            return
+        with obs.span("serve.prewarm_route", model=route.name):
+            route.batcher.prewarm(
+                lambda X, n: route.predict(mv.model, X, n), route.feature_dim
+            )
+        route.prewarmed = True
+
+    # -- transport intake -------------------------------------------------
+    def _intake(self, rid: str, req: HTTPRequestData, wait_s: float
+                ) -> Optional[HTTPResponseData]:
+        path = req.url.split("?", 1)[0]
+        if req.method == "GET":
+            if path == "/healthz":
+                return _json_response(200, {"status": "ok"})
+            if path == "/readyz":
+                body = {
+                    "ready": self.ready,
+                    "draining": self.admission.draining,
+                    "models": self.registry.describe(),
+                    "jit_cache": cache_counters(),
+                }
+                return _json_response(200 if self.ready else 503, body)
+            if path == "/metrics":
+                return _json_response(200, obs.snapshot())
+            return _json_response(404, {"error": f"no such path: {path}"})
+        if req.method != "POST":
+            return _json_response(405, {"error": f"method {req.method}"})
+        m = _PREDICT_RE.match(path)
+        if not m:
+            return _json_response(404, {"error": f"no such path: {path}"})
+        name = m.group(1)
+        route = self._routes.get(name)
+        if route is None:
+            return _json_response(404, {"error": f"no such model: {name}"})
+        item, err = self._parse_predict(rid, req, route, wait_s)
+        if err is not None:
+            return err
+        return self.admission.admit(name, item)
+
+    def _parse_predict(self, rid: str, req: HTTPRequestData, route: _Route,
+                       wait_s: float):
+        try:
+            payload = json.loads((req.entity or b"").decode() or "{}")
+        except (ValueError, UnicodeDecodeError) as e:
+            obs.inc("http.malformed")
+            return None, _json_response(400, {"error": f"bad JSON: {e}"})
+        single = "features" in payload
+        rows = [payload["features"]] if single else payload.get("instances")
+        if not rows:
+            return None, _json_response(
+                400, {"error": 'body needs "features" or "instances"'}
+            )
+        try:
+            X = np.asarray(rows, dtype=np.float64)
+        except (TypeError, ValueError) as e:
+            return None, _json_response(400, {"error": f"bad rows: {e}"})
+        if X.ndim != 2:
+            return None, _json_response(
+                400, {"error": f"rows must be rank-2, got shape {X.shape}"}
+            )
+        if route.feature_dim is not None and X.shape[1] != route.feature_dim:
+            return None, _json_response(
+                400,
+                {"error": f"expected {route.feature_dim} features, "
+                          f"got {X.shape[1]}"},
+            )
+        largest = route.batcher.buckets[-1]
+        if X.shape[0] > largest:
+            return None, _json_response(
+                413, {"error": f"at most {largest} instances per request"}
+            )
+        item = BatchItem(
+            rid=rid, rows=X, deadline=time.monotonic() + wait_s, single=single
+        )
+        return item, None
+
+    # -- the per-route batch loop -----------------------------------------
+    def _worker(self, route: _Route) -> None:
+        while not self._stop.is_set():
+            items = route.batcher.collect(route.queue)
+            if not items:
+                continue
+            self._process(route, items)
+
+    def _process(self, route: _Route, items) -> None:
+        X = (
+            items[0].rows
+            if len(items) == 1
+            else np.concatenate([it.rows for it in items], axis=0)
+        )
+        padded, n = route.batcher.pad(X)
+        try:
+            with self.registry.lease(route.name) as mv:
+                with obs.span(
+                    "serve.batch", model=route.name,
+                    bucket=int(padded.shape[0]), rows=n,
+                ):
+                    preds = np.asarray(route.predict(mv.model, padded, n))
+                version = mv.version
+            headers = {"X-Model-Version": str(version)}
+            off = 0
+            for it in items:
+                k = it.n_rows
+                chunk = preds[off:off + k]
+                off += k
+                body = (
+                    {"prediction": chunk[0].tolist()
+                     if chunk.ndim > 1 else float(chunk[0])}
+                    if it.single
+                    else {"predictions": chunk.tolist()}
+                )
+                self._server.reply(it.rid, _json_response(200, body, headers))
+        except Exception as e:
+            obs.inc("serve.errors", model=route.name)
+            obs.get_logger("mmlspark_tpu.serve").exception(
+                "batch failed on route %s", route.name
+            )
+            err = _json_response(500, {"error": repr(e)})
+            for it in items:
+                self._server.reply(it.rid, err)
+        finally:
+            self.admission.complete(route.name, len(items))

@@ -1,0 +1,151 @@
+"""serve.batcher — deadline-aware dynamic micro-batching with bucket padding.
+
+Clipper-style adaptive micro-batching (Crankshaw et al., NSDI '17) shaped
+for a jitted padded-batch predictor: throughput wants large batches,
+latency wants small ones, and XLA wants a FIXED set of input shapes so the
+steady state never compiles.  The batcher closes a batch on whichever
+fires first:
+
+1. **size** — accumulated rows reach ``max_rows``;
+2. **wait** — the oldest request has waited ``max_wait_ms``;
+3. **deadline pressure** — the earliest admission deadline in the batch is
+   within ``deadline_slack_ms`` of now (the slack is the processing-time
+   allowance), so waiting longer would blow an SLO.
+
+The closed batch is padded up to the smallest **bucket** shape that fits
+(default 8/64/512 rows), so the predictor sees at most ``len(buckets)``
+distinct shapes ever — all pre-warmed at startup through the persistent
+``jit_cache`` by :meth:`DynamicBatcher.prewarm`, which is why the first
+real request never pays a compile.
+
+One batcher serves one route and is drained by ONE worker thread (the
+carry-over slot for items that would overflow the largest bucket is not
+consumer-thread-safe).
+"""
+
+from __future__ import annotations
+
+import queue
+import time
+from dataclasses import dataclass, field
+from typing import Callable, List, Optional, Sequence
+
+import numpy as np
+
+from mmlspark_tpu import obs
+
+#: Default bucket shapes: small/medium/large padded row counts.
+DEFAULT_BUCKETS = (8, 64, 512)
+
+
+@dataclass
+class BatchItem:
+    """One admitted request: its correlation id, feature rows, and the
+    absolute (monotonic-clock) deadline it must be answered by."""
+
+    rid: str
+    rows: np.ndarray  # (k, F) float64
+    deadline: float  # time.monotonic() based
+    single: bool = False  # request carried one row (reply shape differs)
+    enqueued: float = field(default_factory=time.monotonic)
+
+    @property
+    def n_rows(self) -> int:
+        return int(self.rows.shape[0])
+
+
+class DynamicBatcher:
+    """Accumulates :class:`BatchItem`\\ s from a bounded queue into
+    bucket-padded batches.  See the module docstring for close rules."""
+
+    def __init__(
+        self,
+        buckets: Sequence[int] = DEFAULT_BUCKETS,
+        max_rows: Optional[int] = None,
+        max_wait_ms: float = 25.0,
+        deadline_slack_ms: float = 50.0,
+        poll_ms: float = 50.0,
+    ):
+        if not buckets:
+            raise ValueError("at least one bucket shape is required")
+        self.buckets = tuple(sorted(int(b) for b in buckets))
+        if self.buckets[0] <= 0:
+            raise ValueError(f"bucket shapes must be positive: {buckets}")
+        self.max_rows = min(
+            int(max_rows) if max_rows else self.buckets[-1], self.buckets[-1]
+        )
+        self._max_wait_s = max_wait_ms / 1000.0
+        self._slack_s = deadline_slack_ms / 1000.0
+        self._poll_s = poll_ms / 1000.0
+        self._carry: Optional[BatchItem] = None
+
+    # -- bucket geometry -------------------------------------------------
+    def bucket_for(self, n: int) -> int:
+        """Smallest bucket holding ``n`` rows (callers cap ``n`` at the
+        largest bucket via ``max_rows`` + the carry-over slot)."""
+        for b in self.buckets:
+            if n <= b:
+                return b
+        return self.buckets[-1]
+
+    def pad(self, X: np.ndarray):
+        """Zero-pad ``X`` (n, F) up to its bucket shape; returns
+        ``(padded, n)``.  Pad rows are discarded after predict."""
+        n = int(X.shape[0])
+        b = self.bucket_for(n)
+        if n == b:
+            return X, n
+        out = np.zeros((b,) + X.shape[1:], dtype=X.dtype)
+        out[:n] = X
+        return out, n
+
+    # -- batch assembly --------------------------------------------------
+    def collect(self, q: "queue.Queue[BatchItem]") -> Optional[List[BatchItem]]:
+        """Block (up to the poll interval) for the next batch; None when
+        the queue stayed empty — callers loop on a stop flag."""
+        if self._carry is not None:
+            items = [self._carry]
+            self._carry = None
+        else:
+            try:
+                items = [q.get(timeout=self._poll_s)]
+            except queue.Empty:
+                return None
+        total = items[0].n_rows
+        t0 = time.monotonic()
+        close_at = t0 + self._max_wait_s
+        earliest = items[0].deadline
+        while total < self.max_rows:
+            horizon = min(close_at, earliest - self._slack_s)
+            remaining = horizon - time.monotonic()
+            if remaining <= 0:
+                break  # max_wait elapsed or deadline pressure
+            try:
+                item = q.get(timeout=remaining)
+            except queue.Empty:
+                break
+            if total + item.n_rows > self.buckets[-1]:
+                self._carry = item  # would overflow the largest bucket
+                break
+            items.append(item)
+            total += item.n_rows
+            earliest = min(earliest, item.deadline)
+        obs.observe("serve.batch_rows", total)
+        obs.observe("serve.batch_wait_s", time.monotonic() - t0)
+        obs.inc("serve.batches", bucket=self.bucket_for(total))
+        return items
+
+    # -- startup pre-warming ---------------------------------------------
+    def prewarm(
+        self,
+        predict: Callable[[np.ndarray, int], np.ndarray],
+        feature_dim: int,
+    ) -> None:
+        """Run ``predict(padded, n_valid)`` once per bucket shape so every
+        jit compile (and persistent jit_cache write) happens at startup.
+        After this returns, steady-state traffic only ever presents the
+        pre-compiled shapes."""
+        for b in self.buckets:
+            with obs.span("serve.prewarm", bucket=b):
+                predict(np.zeros((b, int(feature_dim)), dtype=np.float64), 1)
+            obs.inc("serve.prewarm.buckets")

@@ -1,0 +1,197 @@
+"""serve.registry — versioned model registry with atomic hot-swap.
+
+Routes (``/models/<name>/predict``) resolve through this registry.  Each
+route points at ONE current :class:`ModelVersion`; a swap follows the
+zero-downtime protocol:
+
+1. **load** the new version (off the serving threads when ``block=False``);
+2. **warm** it (the caller passes the route's bucket pre-warmer, so the
+   new version's jit programs compile before any traffic sees it);
+3. **flip** the route pointer under the registry lock (atomic: in-flight
+   batches hold a lease on the old version, new batches lease the new one);
+4. **drain** — wait for the old version's lease count to hit zero, then
+   drop the reference so its device arrays can be released.
+
+``rollback`` re-flips to the previous version (kept after every swap).
+Leases are refcounts: :meth:`ModelRegistry.lease` is the only way serving
+code touches a model, which is what makes the flip safe under concurrent
+traffic.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from contextlib import contextmanager
+from typing import Callable, Dict, List, Optional
+
+from mmlspark_tpu import obs
+from mmlspark_tpu.core.pipeline import PipelineStage, saved_stage_metadata
+
+
+class ModelVersion:
+    """One loaded model + its lease refcount."""
+
+    def __init__(self, name: str, version: int, model, path: Optional[str] = None,
+                 meta: Optional[dict] = None):
+        self.name = name
+        self.version = version
+        self.model = model
+        self.path = path
+        self.meta = dict(meta or {})
+        self.loaded_at = time.time()
+        self._lock = threading.Lock()
+        self._refs = 0
+        self._idle = threading.Event()
+        self._idle.set()
+
+    def acquire(self) -> None:
+        with self._lock:
+            self._refs += 1
+            self._idle.clear()
+
+    def release(self) -> None:
+        with self._lock:
+            self._refs -= 1
+            if self._refs <= 0:
+                self._idle.set()
+
+    @property
+    def refs(self) -> int:
+        with self._lock:
+            return self._refs
+
+    def wait_idle(self, timeout_s: float) -> bool:
+        """True once no leases remain (the drain step of a swap)."""
+        return self._idle.wait(timeout=timeout_s)
+
+    def describe(self) -> dict:
+        return {
+            "name": self.name,
+            "version": self.version,
+            "path": self.path,
+            "class": self.meta.get("class", type(self.model).__name__),
+            "loaded_at": self.loaded_at,
+        }
+
+
+class ModelRegistry:
+    """Named routes → current model version, with hot-swap + rollback."""
+
+    def __init__(self, drain_timeout_s: float = 30.0):
+        self._lock = threading.Lock()
+        self._routes: Dict[str, ModelVersion] = {}
+        self._previous: Dict[str, ModelVersion] = {}
+        self._next_version: Dict[str, int] = {}
+        self._drain_timeout_s = drain_timeout_s
+
+    # -- loading ---------------------------------------------------------
+    def _build_version(self, name: str, path: Optional[str], model) -> ModelVersion:
+        meta: dict = {}
+        if model is None:
+            if path is None:
+                raise ValueError("either path or model is required")
+            # validate + describe the directory before the (heavier) load
+            meta = saved_stage_metadata(path)
+            with obs.span("serve.model_load", model=name):
+                model = PipelineStage.load(path)
+        with self._lock:
+            version = self._next_version.get(name, 0) + 1
+            self._next_version[name] = version
+        return ModelVersion(name, version, model, path=path, meta=meta)
+
+    def register(self, name: str, model=None, path: Optional[str] = None) -> ModelVersion:
+        """Load (or wrap) a model and make it the route's current version.
+        Used for initial loads; use :meth:`swap` for zero-downtime updates."""
+        mv = self._build_version(name, path, model)
+        with self._lock:
+            old = self._routes.get(name)
+            self._routes[name] = mv
+            if old is not None:
+                self._previous[name] = old
+        obs.inc("serve.models_loaded", model=name)
+        return mv
+
+    # alias matching the "load a saved directory" reading of the API
+    def load(self, name: str, path: str) -> ModelVersion:
+        return self.register(name, path=path)
+
+    # -- hot-swap --------------------------------------------------------
+    def swap(
+        self,
+        name: str,
+        path: Optional[str] = None,
+        model=None,
+        warm: Optional[Callable[[ModelVersion], None]] = None,
+        block: bool = True,
+    ):
+        """Atomic hot-swap: load → warm → flip → drain old.
+
+        ``warm`` receives the NEW version before the flip (route code
+        passes its bucket pre-warmer).  With ``block=False`` the whole
+        protocol runs on a daemon thread and the thread is returned;
+        otherwise the new :class:`ModelVersion` is returned."""
+        if name not in self._routes:
+            raise KeyError(f"unknown route {name!r}; register() it first")
+
+        def _do() -> ModelVersion:
+            with obs.span("serve.swap", model=name):
+                mv = self._build_version(name, path, model)
+                if warm is not None:
+                    with obs.span("serve.swap_warm", model=name, version=mv.version):
+                        warm(mv)
+                with self._lock:
+                    old = self._routes.get(name)
+                    self._routes[name] = mv
+                    self._previous[name] = old
+                obs.inc("serve.swaps", model=name)
+                if old is not None and not old.wait_idle(self._drain_timeout_s):
+                    obs.inc("serve.swap_drain_timeouts", model=name)
+            return mv
+
+        if block:
+            return _do()
+        t = threading.Thread(target=_do, daemon=True, name=f"swap-{name}")
+        t.start()
+        return t
+
+    def rollback(self, name: str) -> ModelVersion:
+        """Flip the route back to the previous version (one step)."""
+        with self._lock:
+            prev = self._previous.get(name)
+            if prev is None:
+                raise KeyError(f"no previous version for route {name!r}")
+            cur = self._routes[name]
+            self._routes[name] = prev
+            self._previous[name] = cur
+        obs.inc("serve.rollbacks", model=name)
+        if not cur.wait_idle(self._drain_timeout_s):
+            obs.inc("serve.swap_drain_timeouts", model=name)
+        return prev
+
+    # -- resolution ------------------------------------------------------
+    def get(self, name: str) -> Optional[ModelVersion]:
+        with self._lock:
+            return self._routes.get(name)
+
+    def names(self) -> List[str]:
+        with self._lock:
+            return sorted(self._routes)
+
+    @contextmanager
+    def lease(self, name: str):
+        """``with registry.lease(name) as mv: mv.model...`` — pins the
+        current version for the duration (swaps drain around it)."""
+        with self._lock:
+            mv = self._routes.get(name)
+            if mv is None:
+                raise KeyError(f"unknown route {name!r}")
+            mv.acquire()
+        try:
+            yield mv
+        finally:
+            mv.release()
+
+    def describe(self) -> dict:
+        with self._lock:
+            return {n: mv.describe() for n, mv in self._routes.items()}

@@ -241,11 +241,14 @@ class TestFaceIdentity:
             .setOutputCol("who")
         ).transform(df)
         assert out["who"][0] is not None and out["who"][1] is not None
-        # list cell and csv cell both normalize to an ID list
-        assert stub.requests[-2]["body"]["faceIds"] == ["f1", "f2"]
-        assert stub.requests[-1]["body"]["faceIds"] == ["f3", "f4"]
-        assert stub.requests[-1]["body"]["personGroupId"] == "pg1"
-        assert stub.requests[-1]["body"]["maxNumOfCandidatesReturned"] == 2
+        # list cell and csv cell both normalize to an ID list (the
+        # concurrency pool may deliver the two POSTs in either order)
+        bodies = [r["body"] for r in stub.requests[-2:]]
+        assert sorted(b["faceIds"] for b in bodies) == [
+            ["f1", "f2"], ["f3", "f4"]]
+        for b in bodies:
+            assert b["personGroupId"] == "pg1"
+            assert b["maxNumOfCandidatesReturned"] == 2
 
     def test_verify_faces_both_modes(self, stub):
         df = DataFrame({"a": ["fa"], "b": ["fb"]})

@@ -1,0 +1,465 @@
+"""mmlspark_tpu.serve — the production serving engine (ISSUE 3).
+
+Layers:
+1. batcher units: every close condition (size / max-wait / deadline
+   pressure), bucket padding correctness, the carry-over slot;
+2. registry units: versioning, swap protocol ordering, rollback, leases;
+3. admission units: every verdict, drain semantics;
+4. ServingApp end-to-end over real HTTP: predictions match the offline
+   model, pre-warm keeps the compile cache flat, hot-swap under
+   concurrent traffic produces zero 5xx, overload sheds 429s, graceful
+   drain leaves no unanswered responders.
+"""
+
+import json
+import queue
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu import obs
+from mmlspark_tpu.serve.admission import AdmissionController
+from mmlspark_tpu.serve.batcher import BatchItem, DynamicBatcher
+from mmlspark_tpu.serve.registry import ModelRegistry
+
+N_FEATURES = 3
+
+
+# --------------------------------------------------------------- fixtures
+@pytest.fixture(scope="module")
+def saved_models(tmp_path_factory):
+    """Two trained+saved regressors (v1/v2) and the training matrix."""
+    from mmlspark_tpu.core.frame import DataFrame
+    from mmlspark_tpu.models.lightgbm import LightGBMRegressor
+
+    rng = np.random.default_rng(7)
+    X = rng.normal(size=(200, N_FEATURES))
+    paths = []
+    for k in (1, 2):
+        y = X[:, 0] * k + 0.1 * rng.normal(size=len(X))
+        model = LightGBMRegressor(
+            numIterations=4, numLeaves=4, minDataInLeaf=2
+        ).fit(DataFrame({"features": list(X), "label": y}))
+        p = str(tmp_path_factory.mktemp("serve_models") / f"v{k}")
+        model.save(p)
+        paths.append(p)
+    return {"v1": paths[0], "v2": paths[1], "X": X}
+
+
+def _item(n_rows, deadline_in_s=60.0, rid="r"):
+    return BatchItem(
+        rid=rid,
+        rows=np.zeros((n_rows, N_FEATURES)),
+        deadline=time.monotonic() + deadline_in_s,
+    )
+
+
+def _post(url, payload, headers=None, timeout=30.0):
+    req = urllib.request.Request(
+        url, data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json", **(headers or {})},
+        method="POST",
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.status, json.loads(r.read().decode()), dict(r.headers)
+    except urllib.error.HTTPError as e:
+        body = e.read().decode()
+        try:
+            body = json.loads(body)
+        except ValueError:
+            pass
+        return e.code, body, dict(e.headers)
+
+
+def _get(url, timeout=30.0):
+    try:
+        with urllib.request.urlopen(url, timeout=timeout) as r:
+            return r.status, json.loads(r.read().decode())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read().decode())
+
+
+# ---------------------------------------------------------- batcher units
+class TestDynamicBatcher:
+    def test_bucket_geometry_and_padding(self):
+        b = DynamicBatcher(buckets=(8, 64, 512))
+        assert [b.bucket_for(n) for n in (1, 8, 9, 64, 65, 512)] == [
+            8, 8, 64, 64, 512, 512]
+        X = np.arange(15.0).reshape(5, 3)
+        padded, n = b.pad(X)
+        assert padded.shape == (8, 3) and n == 5
+        assert np.array_equal(padded[:5], X)
+        assert not padded[5:].any()
+        # exact-fit input is passed through unpadded (no copy needed)
+        same, n = b.pad(np.zeros((8, 3)))
+        assert same.shape == (8, 3) and n == 8
+
+    def test_rejects_empty_or_nonpositive_buckets(self):
+        with pytest.raises(ValueError):
+            DynamicBatcher(buckets=())
+        with pytest.raises(ValueError):
+            DynamicBatcher(buckets=(0, 8))
+
+    def test_closes_on_size(self):
+        b = DynamicBatcher(buckets=(8,), max_rows=8, max_wait_ms=5000)
+        q = queue.Queue()
+        for _ in range(3):
+            q.put(_item(3))
+        t0 = time.monotonic()
+        items = b.collect(q)
+        # 3+3 fit; the third 3-rower would overflow the 8-bucket → carried
+        assert len(items) == 2 and sum(i.n_rows for i in items) == 6
+        assert time.monotonic() - t0 < 2.0  # did NOT wait out max_wait
+        carried = b.collect(q)
+        assert len(carried) == 1 and carried[0].n_rows == 3
+
+    def test_closes_on_max_wait(self):
+        b = DynamicBatcher(buckets=(64,), max_wait_ms=60)
+        q = queue.Queue()
+        q.put(_item(2))
+        t0 = time.monotonic()
+        items = b.collect(q)
+        elapsed = time.monotonic() - t0
+        assert len(items) == 1
+        assert 0.04 <= elapsed < 2.0
+
+    def test_closes_on_deadline_pressure(self):
+        # max_wait alone would hold the batch open for 5 s; the item's
+        # deadline minus the slack must close it in ~50 ms instead
+        b = DynamicBatcher(buckets=(64,), max_wait_ms=5000,
+                           deadline_slack_ms=50)
+        q = queue.Queue()
+        q.put(_item(2, deadline_in_s=0.1))
+        t0 = time.monotonic()
+        items = b.collect(q)
+        elapsed = time.monotonic() - t0
+        assert len(items) == 1
+        assert elapsed < 2.5, "deadline pressure did not close the batch"
+
+    def test_empty_queue_returns_none(self):
+        b = DynamicBatcher(buckets=(8,), poll_ms=10)
+        assert b.collect(queue.Queue()) is None
+
+    def test_batch_metrics_recorded(self):
+        obs.enable()
+        obs.reset()
+        b = DynamicBatcher(buckets=(8,), max_rows=4, max_wait_ms=10)
+        q = queue.Queue()
+        q.put(_item(4))
+        b.collect(q)
+        snap = obs.snapshot()
+        assert snap["histograms"]["serve.batch_rows"]["max"] == 4.0
+        assert snap["counters"]["serve.batches{bucket=8}"] == 1.0
+
+
+class TestPaddedPredict:
+    def test_padded_equals_unpadded(self, saved_models):
+        from mmlspark_tpu.core.pipeline import PipelineStage
+
+        model = PipelineStage.load(saved_models["v1"])
+        booster = model.getBooster()
+        X = saved_models["X"][:5]
+        want = booster.predict(X)
+        padded = np.zeros((8, N_FEATURES))
+        padded[:5] = X
+        got = booster.predict_padded(padded, 5)
+        assert got.shape == (5,)
+        assert np.allclose(got, want)
+
+
+# --------------------------------------------------------- registry units
+class TestModelRegistry:
+    def test_register_versions_and_rollback(self):
+        reg = ModelRegistry()
+        v1 = reg.register("m", model=object())
+        assert (v1.version, reg.get("m")) == (1, v1)
+        v2 = reg.register("m", model=object())
+        assert v2.version == 2 and reg.get("m") is v2
+        assert reg.rollback("m") is v1 and reg.get("m") is v1
+        # rollback is a flip, so it can flip back again
+        assert reg.rollback("m") is v2
+        with pytest.raises(KeyError):
+            ModelRegistry().rollback("never-registered")
+
+    def test_swap_warms_before_flip(self):
+        reg = ModelRegistry()
+        old = reg.register("m", model="old")
+        seen = {}
+
+        def warm(mv):
+            # the flip must not have happened yet: traffic still sees old
+            seen["during_warm"] = reg.get("m")
+            seen["warmed"] = mv.model
+
+        new = reg.swap("m", model="new", warm=warm)
+        assert seen == {"during_warm": old, "warmed": "new"}
+        assert reg.get("m") is new and new.version == 2
+
+    def test_swap_unknown_route_raises(self):
+        with pytest.raises(KeyError):
+            ModelRegistry().swap("m", model="x")
+
+    def test_lease_pins_version_through_swap(self):
+        reg = ModelRegistry(drain_timeout_s=0.2)
+        reg.register("m", model="old")
+        with reg.lease("m") as mv:
+            assert mv.model == "old" and mv.refs == 1
+            # swap flips immediately; the drain times out on our lease
+            obs.enable()
+            obs.reset()
+            new = reg.swap("m", model="new")
+            assert reg.get("m") is new
+            assert obs.snapshot()["counters"][
+                "serve.swap_drain_timeouts{model=m}"] == 1.0
+            assert not mv.wait_idle(timeout_s=0.01)
+        assert mv.refs == 0 and mv.wait_idle(timeout_s=1.0)
+
+    def test_nonblocking_swap_runs_off_thread(self):
+        reg = ModelRegistry()
+        reg.register("m", model="old")
+        t = reg.swap("m", model="new", block=False)
+        t.join(timeout=10)
+        assert reg.get("m").model == "new"
+
+    def test_describe_reports_saved_class(self, saved_models):
+        reg = ModelRegistry()
+        reg.load("m", saved_models["v1"])
+        d = reg.describe()["m"]
+        assert d["version"] == 1 and "LightGBMRegressionModel" in d["class"]
+
+
+# -------------------------------------------------------- admission units
+class TestAdmissionController:
+    def test_not_ready_then_accept(self):
+        adm = AdmissionController()
+        adm.register_route("r")
+        resp = adm.admit("r", "item")
+        assert resp.statusCode == 503
+        adm.set_ready(True)
+        assert adm.admit("r", "item") is None
+        assert adm.inflight("r") == 1
+        assert adm.queue_for("r").get_nowait() == "item"
+
+    def test_unknown_route_is_not_ready(self):
+        adm = AdmissionController()
+        adm.set_ready(True)
+        assert adm.admit("ghost", "x").statusCode == 503
+
+    def test_sheds_on_queue_depth_with_retry_after(self):
+        adm = AdmissionController(max_queue_depth=1, retry_after_s=2.0)
+        adm.register_route("r")
+        adm.set_ready(True)
+        assert adm.admit("r", "a") is None
+        resp = adm.admit("r", "b")
+        assert resp.statusCode == 429
+        assert resp.headers["Retry-After"] == "2"
+
+    def test_sheds_on_inflight_cap(self):
+        adm = AdmissionController(max_queue_depth=64)
+        adm.register_route("r", max_inflight=2)
+        adm.set_ready(True)
+        assert adm.admit("r", "a") is None and adm.admit("r", "b") is None
+        assert adm.admit("r", "c").statusCode == 429
+        adm.complete("r")  # one answered → capacity again
+        assert adm.admit("r", "d") is None
+
+    def test_drain_rejects_and_waits_for_inflight(self):
+        adm = AdmissionController()
+        adm.register_route("r")
+        adm.set_ready(True)
+        adm.admit("r", "a")
+        done = []
+        t = threading.Thread(
+            target=lambda: done.append(adm.begin_drain(timeout_s=10))
+        )
+        t.start()
+        time.sleep(0.05)
+        assert adm.admit("r", "b").statusCode == 503  # draining sheds
+        adm.complete("r")  # the in-flight request finishes
+        t.join(timeout=10)
+        assert done == [True]
+
+    def test_drain_with_nothing_inflight_is_immediate(self):
+        adm = AdmissionController()
+        adm.set_ready(True)
+        assert adm.begin_drain(timeout_s=0.1) is True
+
+
+# ----------------------------------------------------- ServingApp over HTTP
+@pytest.fixture()
+def app(saved_models):
+    from mmlspark_tpu.serve import ServingApp
+
+    a = ServingApp(max_wait_ms=10.0).start()
+    a.add_model("m", path=saved_models["v1"])
+    yield a
+    a.stop(drain_s=5.0)
+
+
+class TestServingApp:
+    def test_predictions_match_offline_model(self, app, saved_models):
+        from mmlspark_tpu.core.frame import DataFrame
+        from mmlspark_tpu.core.pipeline import PipelineStage
+
+        model = PipelineStage.load(saved_models["v1"])
+        X = saved_models["X"][:6]
+        want = model.transform(
+            DataFrame({"features": list(X)}))["prediction"]
+
+        url = f"{app.url}/models/m/predict"
+        status, body, headers = _post(url, {"instances": X.tolist()})
+        assert status == 200
+        assert headers["X-Model-Version"] == "1"
+        assert np.allclose(body["predictions"], want)
+
+        status, body, _ = _post(url, {"features": X[0].tolist()})
+        assert status == 200
+        assert np.isclose(body["prediction"], want[0])
+
+    def test_health_ready_metrics_endpoints(self, app):
+        assert _get(f"{app.url}/healthz") == (200, {"status": "ok"})
+        status, ready = _get(f"{app.url}/readyz")
+        assert status == 200 and ready["ready"] is True
+        assert ready["models"]["m"]["version"] == 1
+        status, metrics = _get(f"{app.url}/metrics")
+        assert status == 200 and metrics["counters"]
+
+    def test_bad_requests(self, app):
+        url = f"{app.url}/models/m/predict"
+        assert _post(f"{app.url}/models/ghost/predict",
+                     {"features": [0, 0, 0]})[0] == 404
+        assert _post(url, {})[0] == 400
+        assert _post(url, {"instances": [[1, 2]]})[0] == 400  # wrong dim
+        assert _post(url, {"instances": [[[1]]]})[0] == 400  # rank 3
+        too_many = [[0.0] * N_FEATURES] * 513
+        assert _post(url, {"instances": too_many})[0] == 413
+
+    def test_prewarm_keeps_compile_cache_flat(self, app, saved_models):
+        """The acceptance check: the first request per bucket shape is
+        served entirely from the pre-warmed jit programs — the persistent
+        compile cache sees zero lookups (hit OR miss) after ready."""
+        from mmlspark_tpu.core.jit_cache import cache_counters
+
+        at_ready = app.jit_counters_at_ready()
+        X = saved_models["X"]
+        url = f"{app.url}/models/m/predict"
+        # one request landing in each bucket: 8, 64, 512
+        for n in (2, 20, 200):
+            status, _, _ = _post(url, {"instances": X[:n].tolist()})
+            assert status == 200
+        after = cache_counters()
+        lookups = (after["hit"] + after["miss"]
+                   - at_ready["hit"] - at_ready["miss"])
+        assert lookups == 0, f"traffic reached the compile cache: {after}"
+
+    def test_hot_swap_under_traffic_zero_5xx(self, app, saved_models):
+        url = f"{app.url}/models/m/predict"
+        X = saved_models["X"]
+        statuses, versions = [], set()
+        stop = threading.Event()
+        lock = threading.Lock()
+
+        def hammer(wid):
+            rng = np.random.default_rng(wid)
+            while not stop.is_set():
+                n = int(rng.integers(1, 10))
+                s, _, h = _post(url, {"instances": X[:n].tolist()})
+                with lock:
+                    statuses.append(s)
+                    if "X-Model-Version" in h:
+                        versions.add(h["X-Model-Version"])
+
+        threads = [threading.Thread(target=hammer, args=(i,))
+                   for i in range(4)]
+        [t.start() for t in threads]
+        time.sleep(0.3)
+        app.swap_model("m", path=saved_models["v2"])  # load→warm→flip→drain
+        time.sleep(0.3)
+        stop.set()
+        [t.join(timeout=30) for t in threads]
+
+        assert statuses and all(s == 200 for s in statuses), (
+            f"hot-swap surfaced errors: { {s for s in statuses} }")
+        assert "2" in versions, "no request ever saw the new version"
+        # post-swap requests are answered by v2; rollback flips back
+        assert _post(url, {"features": X[0].tolist()})[2][
+            "X-Model-Version"] == "2"
+        app.rollback("m")
+        assert _post(url, {"features": X[0].tolist()})[2][
+            "X-Model-Version"] == "1"
+
+    def test_overload_sheds_429_not_5xx(self):
+        from mmlspark_tpu.serve import ServingApp
+
+        def slow_predict(model, X, n):
+            time.sleep(0.15)
+            return np.zeros(len(X))
+
+        app = ServingApp(
+            buckets=(4,), max_wait_ms=5.0, max_queue_depth=1, max_inflight=2
+        ).start()
+        app.add_model("s", model=object(), feature_dim=2,
+                      predictor=slow_predict)
+        try:
+            url = f"{app.url}/models/s/predict"
+            results = []
+            lock = threading.Lock()
+
+            def fire():
+                s, _, h = _post(url, {"features": [0.0, 0.0]})
+                with lock:
+                    results.append((s, h.get("Retry-After")))
+
+            threads = [threading.Thread(target=fire) for _ in range(10)]
+            [t.start() for t in threads]
+            [t.join(timeout=30) for t in threads]
+
+            got = [s for s, _ in results]
+            assert got.count(200) >= 1
+            assert got.count(429) >= 1, f"2x overload never shed: {got}"
+            assert not any(500 <= s < 600 for s in got)
+            assert all(ra for s, ra in results if s == 429)
+        finally:
+            app.stop(drain_s=5.0)
+
+    def test_graceful_drain_answers_everything(self, app, saved_models):
+        url = f"{app.url}/models/m/predict"
+        X = saved_models["X"]
+        statuses = []
+        lock = threading.Lock()
+
+        def fire():
+            s = _post(url, {"instances": X[:4].tolist()})[0]
+            with lock:
+                statuses.append(s)
+
+        threads = [threading.Thread(target=fire) for _ in range(8)]
+        [t.start() for t in threads]
+        assert app.stop(drain_s=10.0) is True
+        [t.join(timeout=30) for t in threads]
+        # every request admitted before the drain was answered, and the
+        # transport holds no orphaned responders
+        assert all(s in (200, 503) for s in statuses)
+        assert app._server.pending_replies() == 0
+
+    def test_predict_exception_is_500_per_item(self):
+        from mmlspark_tpu.serve import ServingApp
+
+        def boom(model, X, n):
+            raise RuntimeError("kernel exploded")
+
+        app = ServingApp(buckets=(4,), max_wait_ms=5.0, prewarm=False).start()
+        app.add_model("b", model=object(), feature_dim=2, predictor=boom)
+        try:
+            status, body, _ = _post(
+                f"{app.url}/models/b/predict", {"features": [0.0, 0.0]})
+            assert status == 500 and "kernel exploded" in body["error"]
+            # the failed item still completes admission accounting
+            assert app.admission.inflight("b") == 0
+        finally:
+            app.stop(drain_s=2.0)

@@ -1,15 +1,21 @@
 """Spark Serving DSL tests: streaming source/sink, reply correlation,
 distributed (multi-replica) serving, error replies (SURVEY.md §2.6, §3.4 —
 the reference tests run a streaming query against localhost and assert on
-real HTTP replies; same here)."""
+real HTTP replies; same here).  Plus transport-hardening regressions:
+the configurable reply timeout, the client deadline header, the
+queue-depth gauge, and the reply/timeout correlation race."""
 
 import json
+import random
 import threading
+import time
 import urllib.request
 
 import numpy as np
 import pytest
 
+from mmlspark_tpu import obs
+from mmlspark_tpu.io.http.serving import HTTPServer, effective_wait_s
 from mmlspark_tpu.io.http.serving_streams import readStream
 
 
@@ -130,3 +136,142 @@ class TestServingDSL:
             assert body["prediction"] in (0.0, 1.0)
         finally:
             q.stop()
+
+
+class TestTransportHardening:
+    def test_effective_wait_clamps_client_deadline(self):
+        # no header → the server cap; lower client deadline wins;
+        # a higher (or garbage, or non-positive) one never raises the cap
+        assert effective_wait_s({}, cap_s=60.0) == 60.0
+        assert effective_wait_s(None, cap_s=60.0) == 60.0
+        assert effective_wait_s(
+            {"X-Request-Deadline-Ms": "250"}, cap_s=60.0) == 0.25
+        assert effective_wait_s(
+            {"X-Request-Deadline-Ms": "120000"}, cap_s=60.0) == 60.0
+        assert effective_wait_s(
+            {"X-Request-Deadline-Ms": "soon"}, cap_s=60.0) == 60.0
+        assert effective_wait_s(
+            {"X-Request-Deadline-Ms": "-5"}, cap_s=60.0) == 60.0
+
+    def test_timeout_env_knob_gives_504(self, monkeypatch):
+        monkeypatch.setenv("MMLSPARK_TPU_SERVING_REQUEST_TIMEOUT_S", "0.2")
+        server = HTTPServer().start()
+        try:
+            req = urllib.request.Request(
+                f"http://{server.host}:{server.port}/", data=b"{}",
+                method="POST",
+            )
+            t0 = time.monotonic()
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(req, timeout=30)  # nobody replies
+            assert ei.value.code == 504
+            assert time.monotonic() - t0 < 10.0  # not the 60 s default
+        finally:
+            server.stop()
+
+    def test_client_deadline_header_lowers_wait(self):
+        server = HTTPServer().start()  # server cap stays the 60 s default
+        try:
+            req = urllib.request.Request(
+                f"http://{server.host}:{server.port}/", data=b"{}",
+                headers={"X-Request-Deadline-Ms": "200"}, method="POST",
+            )
+            t0 = time.monotonic()
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(req, timeout=30)
+            assert ei.value.code == 504
+            assert time.monotonic() - t0 < 10.0
+        finally:
+            server.stop()
+
+    def test_queue_depth_gauge_drops_on_drain(self):
+        obs.enable()
+        obs.reset()
+        server = HTTPServer().start()
+        threads = []
+        try:
+            def fire():
+                req = urllib.request.Request(
+                    f"http://{server.host}:{server.port}/", data=b"{}",
+                    headers={"X-Request-Deadline-Ms": "5000"}, method="POST",
+                )
+                try:
+                    urllib.request.urlopen(req, timeout=30).read()
+                except urllib.error.HTTPError:
+                    pass
+
+            threads = [threading.Thread(target=fire) for _ in range(3)]
+            [t.start() for t in threads]
+            deadline = time.monotonic() + 10
+            while server._requests.qsize() < 3:
+                assert time.monotonic() < deadline, "requests never queued"
+                time.sleep(0.01)
+            assert obs.snapshot()["gauges"]["http.queue_depth"] == 3.0
+
+            batch = server.get_batch(max_rows=10)
+            assert batch.count() == 3
+            # the regression: the gauge used to stay at the enqueue-side
+            # high-water mark forever once the consumer drained the queue
+            assert obs.snapshot()["gauges"]["http.queue_depth"] == 0.0
+            server.reply_batch(batch.withColumn(
+                "response", [{"ok": True}] * 3))
+        finally:
+            [t.join(timeout=30) for t in threads]
+            server.stop()
+
+    def test_reply_timeout_race_leaks_nothing(self, monkeypatch):
+        """Hammer the exact race from the seed: replies landing right at
+        the handler's wait expiry.  Whichever side wins, the correlation
+        tables must end empty — the seed leaked the response (and grew
+        ``_responses`` forever) whenever ``reply`` lost the race."""
+        monkeypatch.setenv("MMLSPARK_TPU_SERVING_REQUEST_TIMEOUT_S", "0.08")
+        server = HTTPServer().start()
+        stop = threading.Event()
+
+        from mmlspark_tpu.core.frame import DataFrame
+
+        def consumer():
+            rng = random.Random(0)
+            while not stop.is_set():
+                batch = server.get_batch(max_rows=8, timeout=0.02)
+                for row in batch.collect():
+                    # straddle the 80 ms expiry from both sides
+                    time.sleep(rng.uniform(0.04, 0.12))
+                    server.reply_batch(DataFrame(
+                        [{"id": row["id"], "response": {"ok": 1}}]))
+
+        consumer_t = threading.Thread(target=consumer, daemon=True)
+        consumer_t.start()
+        statuses = []
+        lock = threading.Lock()
+
+        def client():
+            for _ in range(5):
+                req = urllib.request.Request(
+                    f"http://{server.host}:{server.port}/", data=b"{}",
+                    method="POST",
+                )
+                try:
+                    with urllib.request.urlopen(req, timeout=30) as r:
+                        code = r.status
+                        r.read()
+                except urllib.error.HTTPError as e:
+                    code = e.code
+                    e.read()
+                with lock:
+                    statuses.append(code)
+
+        clients = [threading.Thread(target=client) for _ in range(6)]
+        try:
+            [t.start() for t in clients]
+            [t.join(timeout=60) for t in clients]
+        finally:
+            stop.set()
+            consumer_t.join(timeout=10)
+            server.stop()
+
+        assert len(statuses) == 30
+        assert set(statuses) <= {200, 504}
+        # the invariant the seed violated: no orphaned responder OR response
+        assert server.pending_replies() == 0
+        assert server._responses == {}

@@ -19,6 +19,7 @@ from tools.analyze.collectives import check_collectives_file
 from tools.analyze.common import Finding, apply_suppressions
 from tools.analyze.hygiene import check_hygiene_file
 from tools.analyze.obs_rules import check_obs, check_obs_file
+from tools.analyze.serving_rules import check_serving, check_serving_file
 from tools.analyze.tracer import check_host_only_file, check_tracer_file
 
 
@@ -476,6 +477,111 @@ def test_obs001_suppression_round_trip(tmp_path):
     silenced = _write(str(tmp_path / "b.py"),
                       src.format(supp="  # analyze: ignore[OBS001]"))
     assert apply_suppressions(check_obs_file(silenced)) == []
+
+
+# -------------------------------------------------------- serving fixtures
+
+
+def test_srv001_unbounded_queue_constructors(tmp_path):
+    p = _write(str(tmp_path / "m.py"), """
+        import queue
+        class Server:
+            def __init__(self):
+                self._requests = queue.Queue()          # unbounded
+                self._events = queue.SimpleQueue()      # always unbounded
+                self._zero = queue.Queue(maxsize=0)     # 0 = unbounded too
+    """)
+    found = check_serving_file(p)
+    assert rules(found) == ["SRV001"] * 3
+    assert "OOM" in found[0].message
+
+
+def test_srv001_silent_on_bounded_queues(tmp_path):
+    p = _write(str(tmp_path / "m.py"), """
+        import queue, os
+        def make(depth):
+            a = queue.Queue(maxsize=128)
+            b = queue.Queue(64)
+            c = queue.Queue(maxsize=depth)   # computed bound: trusted
+            return a, b, c
+    """)
+    assert check_serving_file(p) == []
+
+
+def test_srv001_blocking_get_and_wait_without_timeout(tmp_path):
+    p = _write(str(tmp_path / "m.py"), """
+        import queue, threading
+        class Worker:
+            def __init__(self):
+                self._q = queue.Queue(maxsize=8)
+                self._done = threading.Event()
+            def run(self):
+                item = self._q.get()        # blocks forever
+                self._done.wait()           # blocks forever
+                return item
+    """)
+    found = check_serving_file(p)
+    assert rules(found) == ["SRV001"] * 2
+    assert "timeout" in found[0].message
+
+
+def test_srv001_silent_on_bounded_blocking_and_foreign_get(tmp_path):
+    p = _write(str(tmp_path / "m.py"), """
+        import os, queue, threading
+        def run(config):
+            q = queue.Queue(maxsize=8)
+            ev = threading.Event()
+            a = q.get(timeout=0.5)          # bounded
+            b = q.get(False)                # non-blocking
+            c = q.get(block=False)          # non-blocking
+            d = q.get(True, 5)              # bounded positionally
+            ev.wait(5)                      # bounded
+            ev.wait(timeout=1.0)            # bounded
+            # .get on receivers this module did NOT construct never fires
+            e = config.get("key")
+            f = os.environ.get("HOME")
+            return a, b, c, d, e, f
+    """)
+    assert check_serving_file(p) == []
+
+
+def test_srv001_tree_walker_only_visits_library_code(tmp_path):
+    bad = "import queue\nq = queue.Queue()\n"
+    _write(str(tmp_path / "mmlspark_tpu" / "m.py"), bad)
+    _write(str(tmp_path / "tests" / "t.py"), bad)   # exempt by contract
+    _write(str(tmp_path / "tools" / "u.py"), bad)   # exempt by contract
+    assert rules(check_serving(str(tmp_path))) == ["SRV001"]
+
+
+def test_srv001_suppression_round_trip(tmp_path):
+    src = """
+        import queue
+        q = queue.Queue(){supp}
+    """
+    fires = _write(str(tmp_path / "a.py"), src.format(supp=""))
+    assert rules(apply_suppressions(check_serving_file(fires))) == ["SRV001"]
+    silenced = _write(str(tmp_path / "b.py"),
+                      src.format(supp="  # analyze: ignore[SRV001]"))
+    assert apply_suppressions(check_serving_file(silenced)) == []
+
+
+def test_srv001_would_have_caught_the_seed_transport(tmp_path):
+    """The literal pre-fix shape from io/http/serving.py: an unbounded
+    request queue plus a reply-event wait with no timeout."""
+    p = _write(str(tmp_path / "serving.py"), """
+        import queue, threading
+        class HTTPServer:
+            def __init__(self):
+                self._requests = queue.Queue()
+                self._responders = {}
+            def handle(self, rid):
+                ev = threading.Event()
+                self._responders[rid] = ev
+                ev.wait()
+                return self._responders.pop(rid)
+    """)
+    got = rules(check_serving_file(p))
+    assert got == ["SRV001"] * 2
 
 
 # ------------------------------------------------------------ suppressions

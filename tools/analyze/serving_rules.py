@@ -1,0 +1,167 @@
+"""Pass 6 — serving robustness.
+
+Rules
+-----
+- SRV001: unbounded blocking primitives in library (non-test) code:
+
+  * ``queue.Queue()`` (or ``LifoQueue``/``PriorityQueue``) constructed
+    without a positive ``maxsize``, and ``SimpleQueue()`` (always
+    unbounded) — an unbounded request/work queue is the memory-exhaustion
+    half of an overload failure: a serving process that cannot shed load
+    buffers it until the OOM killer sheds the whole process;
+  * ``Queue.get()`` / ``Event.wait()`` without a timeout on objects the
+    module itself constructed — the hang half: a worker blocked forever
+    on a queue whose producer died (or an event whose setter raced an
+    exception) can never drain, honor a shutdown, or report anything.
+
+  Tests and ``tools/`` are exempt (bounded lifetimes by contract);
+  deliberate cases carry ``# analyze: ignore[SRV001]``.
+
+Detection is intentionally modest: only ``.get``/``.wait`` receivers that
+this module ASSIGNED from a ``Queue``/``Event`` constructor are checked
+(by variable or attribute name), so ``dict.get``/``os.environ.get`` and
+friends never false-positive.
+"""
+
+from __future__ import annotations
+
+import ast
+import glob
+import os
+
+from tools.analyze.common import Finding
+
+_QUEUE_CTORS = {"Queue", "LifoQueue", "PriorityQueue"}
+_ALWAYS_UNBOUNDED = {"SimpleQueue"}
+_EVENT_CTORS = {"Event"}
+
+
+def _ctor_name(call: ast.Call) -> str | None:
+    fn = call.func
+    if isinstance(fn, ast.Name):
+        return fn.id
+    if isinstance(fn, ast.Attribute):
+        return fn.attr
+    return None
+
+
+def _is_unbounded_queue(call: ast.Call, name: str) -> bool:
+    if name in _ALWAYS_UNBOUNDED:
+        return True
+    maxsize = call.args[0] if call.args else None
+    for kw in call.keywords:
+        if kw.arg == "maxsize":
+            maxsize = kw.value
+    if maxsize is None:
+        return True  # Queue() — the stdlib default is unbounded
+    if isinstance(maxsize, ast.Constant) and isinstance(maxsize.value, (int, float)):
+        return maxsize.value <= 0  # Queue(0) is unbounded too
+    return False  # computed bound — benefit of the doubt
+
+
+def _target_names(node: ast.Assign | ast.AnnAssign) -> list[str]:
+    targets = node.targets if isinstance(node, ast.Assign) else [node.target]
+    out = []
+    for t in targets:
+        if isinstance(t, ast.Name):
+            out.append(t.id)
+        elif isinstance(t, ast.Attribute):  # self._requests = ...
+            out.append(t.attr)
+    return out
+
+
+def _receiver_name(call: ast.Call) -> str | None:
+    fn = call.func
+    if not isinstance(fn, ast.Attribute):
+        return None
+    obj = fn.value
+    if isinstance(obj, ast.Name):
+        return obj.id
+    if isinstance(obj, ast.Attribute):  # self._requests.get(...)
+        return obj.attr
+    return None
+
+
+def _blocks_forever(call: ast.Call, method: str) -> bool:
+    kw = {k.arg: k.value for k in call.keywords}
+    if "timeout" in kw:
+        return False
+    if method == "wait":
+        return not call.args  # wait(5) is bounded
+    # get(): get(False)/get(block=False) don't block; get(True, 5) is bounded
+    if len(call.args) >= 2:
+        return False
+    if call.args and isinstance(call.args[0], ast.Constant) and call.args[0].value is False:
+        return False
+    b = kw.get("block")
+    if isinstance(b, ast.Constant) and b.value is False:
+        return False
+    return True
+
+
+def check_serving_file(path: str) -> list:
+    try:
+        with open(path, encoding="utf-8", errors="replace") as fh:
+            tree = ast.parse(fh.read(), filename=path)
+    except SyntaxError:
+        return []
+    findings: list = []
+    queue_names: set = set()
+    event_names: set = set()
+    # pass 1: ctor sites — flag unbounded queues, learn receiver names
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.Assign, ast.AnnAssign)) and isinstance(
+            node.value, ast.Call
+        ):
+            name = _ctor_name(node.value)
+            if name in _QUEUE_CTORS or name in _ALWAYS_UNBOUNDED:
+                queue_names.update(_target_names(node))
+            elif name in _EVENT_CTORS:
+                event_names.update(_target_names(node))
+        if isinstance(node, ast.Call):
+            name = _ctor_name(node)
+            if (
+                name in _QUEUE_CTORS or name in _ALWAYS_UNBOUNDED
+            ) and _is_unbounded_queue(node, name):
+                findings.append(
+                    Finding(
+                        path, node.lineno, "SRV001",
+                        f"unbounded {name}() in library code — an "
+                        "overloaded server buffers memory until the OOM "
+                        "killer sheds the whole process; pass a maxsize "
+                        "and shed load explicitly (see "
+                        "mmlspark_tpu/serve/admission.py)",
+                    )
+                )
+    # pass 2: blocking calls on the queues/events this module constructed
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        fn = node.func
+        if not isinstance(fn, ast.Attribute) or fn.attr not in ("get", "wait"):
+            continue
+        recv = _receiver_name(node)
+        tracked = queue_names if fn.attr == "get" else event_names
+        if recv not in tracked:
+            continue
+        if _blocks_forever(node, fn.attr):
+            findings.append(
+                Finding(
+                    path, node.lineno, "SRV001",
+                    f"{recv}.{fn.attr}() without a timeout in library code "
+                    "— a dead producer (or a setter that raced an "
+                    "exception) parks this thread forever, so it can "
+                    "never drain, honor a shutdown, or report anything; "
+                    "pass timeout= and loop on a stop flag",
+                )
+            )
+    return findings
+
+
+def check_serving(root: str) -> list:
+    findings: list = []
+    pkg = os.path.join(root, "mmlspark_tpu")
+    for py in sorted(glob.glob(os.path.join(pkg, "**", "*.py"),
+                               recursive=True)):
+        findings.extend(check_serving_file(py))
+    return findings

@@ -1,0 +1,417 @@
+"""Serving benchmark: seed fixed-batch loop vs the mmlspark_tpu.serve engine.
+
+Gives serving a perf trajectory like training has (BENCH-style JSON):
+
+- **baseline** — the seed ``serve_transformer`` micro-batch loop: drain
+  whatever is queued, predict the UNPADDED batch.  Under variable request
+  sizes every novel total-row-count is a fresh XLA compile, so the loop
+  stalls for tens-to-hundreds of ms at a time.
+- **dynamic**  — :class:`mmlspark_tpu.serve.ServingApp`: deadline-aware
+  batching padded to pre-warmed bucket shapes, so the steady state never
+  compiles.  A hot-swap fires mid-run (the acceptance gate is zero 5xx
+  across it).
+- **overload** — an open-loop phase at 2× the measured dynamic throughput
+  against a deliberately small admission envelope, to exercise load
+  shedding (shed rate = 429s / attempts; 5xx must stay zero).
+
+Both phases serve the same model from the same saved directory and the
+same traffic shape (closed-loop clients, variable instances/request).
+
+Usage::
+
+    JAX_PLATFORMS=cpu python -m tools.bench_serving [--smoke] [--json PATH]
+        [--duration S] [--clients N] [--seed K]
+
+``--smoke`` shrinks the run for CI and exits non-zero unless the serving
+invariants hold (zero 5xx incl. across the swap, non-empty /metrics).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import random
+import sys
+import tempfile
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+
+N_FEATURES = 4
+MAX_INSTANCES = 24  # per request; keeps baseline shape-space honest
+
+
+# --------------------------------------------------------------------------
+# HTTP helpers
+# --------------------------------------------------------------------------
+def _post(url: str, payload: dict, timeout: float = 30.0):
+    """(status, latency_s); urllib errors map to their status or 599."""
+    data = json.dumps(payload).encode()
+    req = urllib.request.Request(
+        url, data=data, headers={"Content-Type": "application/json"},
+        method="POST",
+    )
+    t0 = time.perf_counter()
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            r.read()
+            return r.status, time.perf_counter() - t0
+    except urllib.error.HTTPError as e:
+        e.read()
+        return e.code, time.perf_counter() - t0
+    except (urllib.error.URLError, OSError):
+        return 599, time.perf_counter() - t0
+
+
+def _pct(sorted_vals, p):
+    if not sorted_vals:
+        return 0.0
+    i = min(len(sorted_vals) - 1, int(round(p * (len(sorted_vals) - 1))))
+    return sorted_vals[i]
+
+
+class _LoadResult:
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.latencies = []
+        self.statuses = {}
+
+    def record(self, status, latency):
+        with self.lock:
+            self.statuses[status] = self.statuses.get(status, 0) + 1
+            if status == 200:
+                self.latencies.append(latency)
+
+    def summary(self, wall_s):
+        lat = sorted(self.latencies)
+        n_ok = len(lat)
+        total = sum(self.statuses.values())
+        # 599 is this client's own transport-error sentinel (reset/refused
+        # under churn), not a server response — report it separately so
+        # the zero-5xx gate only trips on genuine server errors.
+        fivexx = sum(v for k, v in self.statuses.items() if 500 <= k < 599)
+        shed = self.statuses.get(429, 0)
+        return {
+            "requests": total,
+            "ok": n_ok,
+            "shed": shed,
+            "fivexx": fivexx,
+            "transport_errors": self.statuses.get(599, 0),
+            "statuses": {str(k): v for k, v in sorted(self.statuses.items())},
+            "wall_s": round(wall_s, 3),
+            "throughput_rps": round(n_ok / wall_s, 1) if wall_s else 0.0,
+            "shed_rate": round(shed / total, 4) if total else 0.0,
+            "p50_ms": round(_pct(lat, 0.50) * 1e3, 2),
+            "p95_ms": round(_pct(lat, 0.95) * 1e3, 2),
+            "p99_ms": round(_pct(lat, 0.99) * 1e3, 2),
+        }
+
+
+def _closed_loop(url, duration_s, clients, seed, feature_rng):
+    """Each client fires back-to-back requests with 1..MAX_INSTANCES rows."""
+    res = _LoadResult()
+    stop_at = time.monotonic() + duration_s
+
+    def worker(wid):
+        rng = random.Random(seed * 1000 + wid)
+        while time.monotonic() < stop_at:
+            k = rng.randint(1, MAX_INSTANCES)
+            rows = feature_rng.normal(size=(k, N_FEATURES)).tolist()
+            res.record(*_post(url, {"instances": rows}))
+
+    threads = [
+        threading.Thread(target=worker, args=(i,), daemon=True)
+        for i in range(clients)
+    ]
+    t0 = time.monotonic()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=duration_s + 60)
+    return res.summary(time.monotonic() - t0)
+
+
+def _open_loop(url, duration_s, target_rps, workers, seed, feature_rng):
+    """Paced arrivals at ``target_rps`` split across a worker pool; a
+    worker that falls >1 s behind schedule skips (client saturated) so
+    the measurement stays open-loop."""
+    res = _LoadResult()
+    t0 = time.monotonic()
+    skipped = [0]
+
+    def worker(wid):
+        rng = random.Random(seed * 7777 + wid)
+        j = wid
+        while True:
+            sched = t0 + j / target_rps
+            j += workers
+            now = time.monotonic()
+            if sched - t0 > duration_s:
+                return
+            if now < sched:
+                time.sleep(sched - now)
+            elif now - sched > 1.0:
+                with res.lock:
+                    skipped[0] += 1
+                continue
+            k = rng.randint(1, MAX_INSTANCES)
+            rows = feature_rng.normal(size=(k, N_FEATURES)).tolist()
+            res.record(*_post(url, {"instances": rows}, timeout=10.0))
+
+    threads = [
+        threading.Thread(target=worker, args=(i,), daemon=True)
+        for i in range(workers)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=duration_s + 60)
+    out = res.summary(time.monotonic() - t0)
+    out["target_rps"] = round(target_rps, 1)
+    out["client_skipped"] = skipped[0]
+    return out
+
+
+# --------------------------------------------------------------------------
+# fixtures
+# --------------------------------------------------------------------------
+def _train_and_save(tmp, seed):
+    from mmlspark_tpu.core.frame import DataFrame
+    from mmlspark_tpu.models.lightgbm import LightGBMRegressor
+
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(400, N_FEATURES))
+    y = X[:, 0] * 2.0 + np.sin(X[:, 1]) + 0.1 * rng.normal(size=400)
+    model = LightGBMRegressor(
+        numIterations=8, numLeaves=8, minDataInLeaf=4
+    ).fit(DataFrame({"features": list(X), "label": y}))
+    path = os.path.join(tmp, f"model_v{seed}")
+    model.save(path)
+    return path
+
+
+def _seed_loop_server(model_path, batch_size=64):
+    """The seed serving shape: HTTPServer + serve_transformer, predicting
+    each micro-batch at its natural (unpadded) row count."""
+    from mmlspark_tpu.io.http.serving import HTTPServer, serve_transformer
+    from mmlspark_tpu.models.lightgbm import LightGBMRegressionModel
+
+    booster = LightGBMRegressionModel.load(model_path).getBooster()
+
+    def transform(batch):
+        rows = batch.collect()
+        feats, counts = [], []
+        for r in rows:
+            body = (r["request"].get("entity") or {}).get("content")
+            inst = np.asarray(json.loads(body.decode())["instances"])
+            feats.append(inst)
+            counts.append(len(inst))
+        X = np.concatenate(feats, axis=0)
+        preds = booster.predict(X)  # unpadded: every new shape compiles
+        out, off = [], 0
+        for k in counts:
+            out.append({"predictions": preds[off:off + k].tolist()})
+            off += k
+        return batch.withColumn("response", out)
+
+    server = HTTPServer().start()
+    stop = threading.Event()
+    thread = threading.Thread(
+        target=serve_transformer, args=(server, transform, stop, batch_size),
+        daemon=True,
+    )
+    thread.start()
+    return server, stop, thread
+
+
+# --------------------------------------------------------------------------
+# main
+# --------------------------------------------------------------------------
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--duration", type=float, default=8.0,
+                    help="seconds per closed-loop phase")
+    ap.add_argument("--clients", type=int, default=8)
+    ap.add_argument("--overload-duration", type=float, default=4.0)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--json", dest="json_path", default=None,
+                    help="also write the report to this path")
+    ap.add_argument("--smoke", action="store_true",
+                    help="short CI run + hard-assert serving invariants")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="skip the seed-loop phase")
+    args = ap.parse_args(argv)
+    if args.smoke:
+        args.duration = min(args.duration, 2.5)
+        args.overload_duration = min(args.overload_duration, 2.0)
+        args.clients = min(args.clients, 6)
+
+    tmp = tempfile.mkdtemp(prefix="bench_serving_")
+    # fresh compile cache so neither phase rides a previous run's warmth
+    os.environ["MMLSPARK_TPU_COMPILE_CACHE_DIR"] = os.path.join(tmp, "jit")
+
+    from mmlspark_tpu import obs
+    from mmlspark_tpu.serve import ServingApp
+
+    obs.enable()
+    report = {
+        "bench": "serving",
+        "config": {
+            "duration_s": args.duration,
+            "clients": args.clients,
+            "max_instances": MAX_INSTANCES,
+            "n_features": N_FEATURES,
+            "smoke": args.smoke,
+        },
+    }
+    feature_rng = np.random.default_rng(args.seed + 1)
+    v1 = _train_and_save(tmp, args.seed)
+    v2 = _train_and_save(tmp, args.seed + 1)
+
+    # ---- phase 1: seed fixed-batch loop --------------------------------
+    if not args.no_baseline:
+        server, stop, thread = _seed_loop_server(v1)
+        base_url = f"http://{server.host}:{server.port}/"
+        report["baseline"] = _closed_loop(
+            base_url, args.duration, args.clients, args.seed, feature_rng
+        )
+        stop.set()
+        thread.join(timeout=10)
+        server.stop()
+        print(f"[serving] baseline (seed loop): "
+              f"{report['baseline']['throughput_rps']} rps  "
+              f"p99={report['baseline']['p99_ms']}ms")
+
+    # ---- phase 2: dynamic batcher + hot-swap ---------------------------
+    obs.reset()  # isolate the dynamic phase's batch histogram
+    app = ServingApp(max_wait_ms=10.0).start()
+    app.add_model("bench", path=v1)  # re-baselines the ready jit snapshot
+    jit_at_ready = app.jit_counters_at_ready()
+
+    swap_result = {}
+
+    def swapper():
+        time.sleep(args.duration / 2)
+        t0 = time.perf_counter()
+        app.swap_model("bench", path=v2)
+        swap_result["swap_wall_s"] = round(time.perf_counter() - t0, 3)
+
+    swap_thread = threading.Thread(target=swapper, daemon=True)
+    swap_thread.start()
+    dyn_url = f"{app.url}/models/bench/predict"
+    dynamic = _closed_loop(
+        dyn_url, args.duration, args.clients, args.seed, feature_rng
+    )
+    swap_thread.join(timeout=60)
+    from mmlspark_tpu.core.jit_cache import cache_counters
+
+    jit_after = cache_counters()
+    snap = obs.snapshot()
+    dynamic["batch_rows_hist"] = snap["histograms"].get("serve.batch_rows", {})
+    dynamic["batches_by_bucket"] = {
+        k: v for k, v in snap["counters"].items() if k.startswith("serve.batches")
+    }
+    dynamic["swap"] = {
+        **swap_result,
+        "swaps": snap["counters"].get("serve.swaps{model=bench}", 0),
+        "fivexx_during_run": dynamic["fivexx"],
+    }
+    # prewarm proof: serving traffic after ready never reaches the
+    # compilation cache — the only lookups after the ready baseline are
+    # the swap's own pre-flip warm compiles (one per bucket, done BEFORE
+    # v2 takes traffic, so no request ever waits on them).
+    swap_warm_budget = len(app.buckets) if swap_result else 0
+    dynamic["jit_cache"] = {
+        "at_ready": jit_at_ready,
+        "after_run": jit_after,
+        "lookups_after_ready": (
+            jit_after["miss"] + jit_after["hit"]
+            - jit_at_ready["miss"] - jit_at_ready["hit"]
+        ),
+        "swap_warm_budget": swap_warm_budget,
+    }
+    report["dynamic"] = dynamic
+    print(f"[serving] dynamic batcher: {dynamic['throughput_rps']} rps  "
+          f"p99={dynamic['p99_ms']}ms  5xx={dynamic['fivexx']} "
+          f"(swap mid-run: {swap_result.get('swap_wall_s')}s)")
+
+    # ---- phase 3: open-loop overload vs a small admission envelope -----
+    app.stop()
+    obs.reset()
+    overload_app = ServingApp(
+        max_wait_ms=10.0, max_queue_depth=8, max_inflight=8
+    ).start()
+    overload_app.add_model("bench", path=v1)
+    target = max(50.0, 2.0 * dynamic["throughput_rps"])
+    overload = _open_loop(
+        f"{overload_app.url}/models/bench/predict",
+        args.overload_duration, target,
+        workers=min(64, max(32, args.clients * 4)),
+        seed=args.seed, feature_rng=feature_rng,
+    )
+    overload_snap = obs.snapshot()
+    overload["admission"] = {
+        k: v for k, v in overload_snap["counters"].items()
+        if k.startswith("serve.admission")
+    }
+    overload_app.stop()
+    report["overload"] = overload
+    print(f"[serving] overload @2x: shed_rate={overload['shed_rate']} "
+          f"5xx={overload['fivexx']} "
+          f"({overload['requests']} attempts at {overload['target_rps']} rps)")
+
+    # ---- metrics endpoint sanity (CI gate) -----------------------------
+    check_app = ServingApp().start()
+    check_app.add_model("bench", path=v1)
+    with urllib.request.urlopen(check_app.url + "/metrics", timeout=10) as r:
+        metrics_body = json.loads(r.read().decode())
+    check_app.stop()
+    report["metrics_nonempty"] = bool(metrics_body.get("counters"))
+
+    if "baseline" in report and report["baseline"]["throughput_rps"]:
+        report["speedup_vs_seed"] = round(
+            report["dynamic"]["throughput_rps"]
+            / report["baseline"]["throughput_rps"], 2,
+        )
+        print(f"[serving] dynamic/seed throughput: "
+              f"{report['speedup_vs_seed']}x")
+
+    out = json.dumps(report, indent=2, default=str)
+    print(out)
+    if args.json_path:
+        with open(args.json_path, "w") as f:
+            f.write(out)
+
+    if args.smoke:
+        failures = []
+        if report["dynamic"]["fivexx"]:
+            failures.append(f"dynamic phase saw {report['dynamic']['fivexx']} 5xx")
+        if report["overload"]["fivexx"]:
+            failures.append(f"overload phase saw {report['overload']['fivexx']} 5xx")
+        if not report["dynamic"]["ok"]:
+            failures.append("dynamic phase served zero requests")
+        if not report["metrics_nonempty"]:
+            failures.append("/metrics snapshot was empty")
+        if report["dynamic"]["swap"]["swaps"] < 1:
+            failures.append("hot-swap did not complete")
+        jc = report["dynamic"]["jit_cache"]
+        if jc["lookups_after_ready"] > jc["swap_warm_budget"]:
+            failures.append(
+                "serving traffic reached the compile cache "
+                f"({jc['lookups_after_ready']} lookups after ready, "
+                f"swap warm budget {jc['swap_warm_budget']}) — prewarm broken"
+            )
+        if failures:
+            print("[serving] SMOKE FAILED: " + "; ".join(failures),
+                  file=sys.stderr)
+            return 1
+        print("[serving] smoke OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
