@@ -1882,6 +1882,7 @@ ml_k_n_n_model <- function(
 #' @param feature_fraction Feature subsample fraction
 #' @param features_col The name of the features column
 #' @param grow_policy lossguide (leaf-wise; auto-batches splits on TPU — see splitBatch) | lossguide_exact (LightGBM's one-split-per-pass sequence, never batched) | depthwise (level-batched histograms, one pass per level)
+#' @param hist_merge Distributed histogram-merge strategy: auto (reduce_scatter when the mesh/feature shape profits — the benchmarked default, see BASELINE.md) | allreduce (every device receives the full merged histogram) | reduce_scatter (each device receives only its feature slice + a best-split allgather)
 #' @param init_score_col Initial (margin) score column
 #' @param is_provide_training_metric Record metrics on training data too
 #' @param is_unbalance Reweight unbalanced binary labels
@@ -1934,6 +1935,7 @@ ml_light_g_b_m_classification_model <- function(
     feature_fraction = 1.0,
     features_col = "features",
     grow_policy = "lossguide",
+    hist_merge = "auto",
     init_score_col = NULL,
     is_provide_training_metric = FALSE,
     is_unbalance = FALSE,
@@ -1985,6 +1987,7 @@ ml_light_g_b_m_classification_model <- function(
     feature_fraction = "featureFraction",
     features_col = "featuresCol",
     grow_policy = "growPolicy",
+    hist_merge = "histMerge",
     init_score_col = "initScoreCol",
     is_provide_training_metric = "isProvideTrainingMetric",
     is_unbalance = "isUnbalance",
@@ -2043,6 +2046,7 @@ ml_light_g_b_m_classification_model <- function(
 #' @param feature_fraction Feature subsample fraction
 #' @param features_col The name of the features column
 #' @param grow_policy lossguide (leaf-wise; auto-batches splits on TPU — see splitBatch) | lossguide_exact (LightGBM's one-split-per-pass sequence, never batched) | depthwise (level-batched histograms, one pass per level)
+#' @param hist_merge Distributed histogram-merge strategy: auto (reduce_scatter when the mesh/feature shape profits — the benchmarked default, see BASELINE.md) | allreduce (every device receives the full merged histogram) | reduce_scatter (each device receives only its feature slice + a best-split allgather)
 #' @param init_score_col Initial (margin) score column
 #' @param is_provide_training_metric Record metrics on training data too
 #' @param is_unbalance Reweight unbalanced binary labels
@@ -2094,6 +2098,7 @@ ml_light_g_b_m_classifier <- function(
     feature_fraction = 1.0,
     features_col = "features",
     grow_policy = "lossguide",
+    hist_merge = "auto",
     init_score_col = NULL,
     is_provide_training_metric = FALSE,
     is_unbalance = FALSE,
@@ -2144,6 +2149,7 @@ ml_light_g_b_m_classifier <- function(
     feature_fraction = "featureFraction",
     features_col = "featuresCol",
     grow_policy = "growPolicy",
+    hist_merge = "histMerge",
     init_score_col = "initScoreCol",
     is_provide_training_metric = "isProvideTrainingMetric",
     is_unbalance = "isUnbalance",
@@ -2204,6 +2210,7 @@ ml_light_g_b_m_classifier <- function(
 #' @param features_col The name of the features column
 #' @param group_col Query group column
 #' @param grow_policy lossguide (leaf-wise; auto-batches splits on TPU — see splitBatch) | lossguide_exact (LightGBM's one-split-per-pass sequence, never batched) | depthwise (level-batched histograms, one pass per level)
+#' @param hist_merge Distributed histogram-merge strategy: auto (reduce_scatter when the mesh/feature shape profits — the benchmarked default, see BASELINE.md) | allreduce (every device receives the full merged histogram) | reduce_scatter (each device receives only its feature slice + a best-split allgather)
 #' @param init_score_col Initial (margin) score column
 #' @param is_provide_training_metric Record metrics on training data too
 #' @param is_unbalance Reweight unbalanced binary labels
@@ -2257,6 +2264,7 @@ ml_light_g_b_m_ranker <- function(
     features_col = "features",
     group_col = "group",
     grow_policy = "lossguide",
+    hist_merge = "auto",
     init_score_col = NULL,
     is_provide_training_metric = FALSE,
     is_unbalance = FALSE,
@@ -2309,6 +2317,7 @@ ml_light_g_b_m_ranker <- function(
     features_col = "featuresCol",
     group_col = "groupCol",
     grow_policy = "growPolicy",
+    hist_merge = "histMerge",
     init_score_col = "initScoreCol",
     is_provide_training_metric = "isProvideTrainingMetric",
     is_unbalance = "isUnbalance",
@@ -2368,6 +2377,7 @@ ml_light_g_b_m_ranker <- function(
 #' @param feature_fraction Feature subsample fraction
 #' @param features_col The name of the features column
 #' @param grow_policy lossguide (leaf-wise; auto-batches splits on TPU — see splitBatch) | lossguide_exact (LightGBM's one-split-per-pass sequence, never batched) | depthwise (level-batched histograms, one pass per level)
+#' @param hist_merge Distributed histogram-merge strategy: auto (reduce_scatter when the mesh/feature shape profits — the benchmarked default, see BASELINE.md) | allreduce (every device receives the full merged histogram) | reduce_scatter (each device receives only its feature slice + a best-split allgather)
 #' @param init_score_col Initial (margin) score column
 #' @param is_provide_training_metric Record metrics on training data too
 #' @param is_unbalance Reweight unbalanced binary labels
@@ -2417,6 +2427,7 @@ ml_light_g_b_m_ranker_model <- function(
     feature_fraction = 1.0,
     features_col = "features",
     grow_policy = "lossguide",
+    hist_merge = "auto",
     init_score_col = NULL,
     is_provide_training_metric = FALSE,
     is_unbalance = FALSE,
@@ -2465,6 +2476,7 @@ ml_light_g_b_m_ranker_model <- function(
     feature_fraction = "featureFraction",
     features_col = "featuresCol",
     grow_policy = "growPolicy",
+    hist_merge = "histMerge",
     init_score_col = "initScoreCol",
     is_provide_training_metric = "isProvideTrainingMetric",
     is_unbalance = "isUnbalance",
@@ -2521,6 +2533,7 @@ ml_light_g_b_m_ranker_model <- function(
 #' @param feature_fraction Feature subsample fraction
 #' @param features_col The name of the features column
 #' @param grow_policy lossguide (leaf-wise; auto-batches splits on TPU — see splitBatch) | lossguide_exact (LightGBM's one-split-per-pass sequence, never batched) | depthwise (level-batched histograms, one pass per level)
+#' @param hist_merge Distributed histogram-merge strategy: auto (reduce_scatter when the mesh/feature shape profits — the benchmarked default, see BASELINE.md) | allreduce (every device receives the full merged histogram) | reduce_scatter (each device receives only its feature slice + a best-split allgather)
 #' @param init_score_col Initial (margin) score column
 #' @param is_provide_training_metric Record metrics on training data too
 #' @param is_unbalance Reweight unbalanced binary labels
@@ -2570,6 +2583,7 @@ ml_light_g_b_m_regression_model <- function(
     feature_fraction = 1.0,
     features_col = "features",
     grow_policy = "lossguide",
+    hist_merge = "auto",
     init_score_col = NULL,
     is_provide_training_metric = FALSE,
     is_unbalance = FALSE,
@@ -2618,6 +2632,7 @@ ml_light_g_b_m_regression_model <- function(
     feature_fraction = "featureFraction",
     features_col = "featuresCol",
     grow_policy = "growPolicy",
+    hist_merge = "histMerge",
     init_score_col = "initScoreCol",
     is_provide_training_metric = "isProvideTrainingMetric",
     is_unbalance = "isUnbalance",
@@ -2674,6 +2689,7 @@ ml_light_g_b_m_regression_model <- function(
 #' @param feature_fraction Feature subsample fraction
 #' @param features_col The name of the features column
 #' @param grow_policy lossguide (leaf-wise; auto-batches splits on TPU — see splitBatch) | lossguide_exact (LightGBM's one-split-per-pass sequence, never batched) | depthwise (level-batched histograms, one pass per level)
+#' @param hist_merge Distributed histogram-merge strategy: auto (reduce_scatter when the mesh/feature shape profits — the benchmarked default, see BASELINE.md) | allreduce (every device receives the full merged histogram) | reduce_scatter (each device receives only its feature slice + a best-split allgather)
 #' @param init_score_col Initial (margin) score column
 #' @param is_provide_training_metric Record metrics on training data too
 #' @param is_unbalance Reweight unbalanced binary labels
@@ -2724,6 +2740,7 @@ ml_light_g_b_m_regressor <- function(
     feature_fraction = 1.0,
     features_col = "features",
     grow_policy = "lossguide",
+    hist_merge = "auto",
     init_score_col = NULL,
     is_provide_training_metric = FALSE,
     is_unbalance = FALSE,
@@ -2773,6 +2790,7 @@ ml_light_g_b_m_regressor <- function(
     feature_fraction = "featureFraction",
     features_col = "featuresCol",
     grow_policy = "growPolicy",
+    hist_merge = "histMerge",
     init_score_col = "initScoreCol",
     is_provide_training_metric = "isProvideTrainingMetric",
     is_unbalance = "isUnbalance",

@@ -136,6 +136,18 @@ class TrainConfig:
     # Wire dtype of the cross-shard histogram allreduce: float32 | bfloat16
     # (halves the dominant data-parallel collective; see GrowConfig)
     hist_psum_dtype: str = "float32"
+    # Cross-shard histogram merge strategy for the data-parallel learner:
+    # "allreduce" (every device receives all F×B histogram floats per
+    # node — SURVEY §3.1 direct allreduce), "reduce_scatter" (each device
+    # receives only the merged histograms for its contiguous 1/D feature
+    # slice, finds its local best split, and a tiny per-node candidate
+    # allgather selects the global winner — LightGBM/NeurIPS-2017 data-
+    # parallel merge, ~D× less wire volume), or "auto" (resolved at
+    # train() time by resolve_auto_config from mesh size × feature count:
+    # reduce_scatter whenever the mesh has >1 device and enough features
+    # to shard, allreduce otherwise).  Ignored by the voting and
+    # feature-parallel learners, which have their own comm patterns.
+    hist_merge: str = "auto"
     # Histogram resolution of the process_local (device-eval) AUC: its
     # ~1/bins quantization can flip improvement comparisons near a plateau,
     # so distributed early stopping on metric="auc" may stop at a different
@@ -754,15 +766,27 @@ _TRACE_CACHE_MIN_WORK = 1 << 21
 _AUTO_SPLIT_BATCH = 8
 
 
-def resolve_auto_config(cfg: "TrainConfig", n: int, backend: str) -> "TrainConfig":
+def resolve_auto_config(
+    cfg: "TrainConfig",
+    n: int,
+    backend: str,
+    *,
+    num_devices: int = 1,
+    num_features: int = 0,
+    num_bins: int = 0,
+) -> "TrainConfig":
     """Resolve every "auto" knob to the value train() will run with.
 
     The default configuration IS the benchmarked configuration (r4 verdict
     weak #1): a bare ``train(params, ds)`` / facade ``fit()`` must land on
     the headline path without opt-in knobs, and anything quality-affecting
     the auto picks is measured in BASELINE.md's r5 defaults table.  Pure
-    function of (cfg, row count, jax backend) so the facade tests can
-    assert the resolution without TPU hardware.
+    function of (cfg, row count, jax backend, mesh/feature geometry) so
+    the facade tests can assert the resolution without TPU hardware.
+
+    ``num_devices``/``num_features``/``num_bins`` feed the ``hist_merge``
+    resolution (mesh size × feature count); callers that never reach the
+    distributed grower may omit them (defaults resolve to "allreduce").
     """
     if cfg.hist_backend == "auto":
         cfg = dataclasses.replace(
@@ -808,6 +832,35 @@ def resolve_auto_config(cfg: "TrainConfig", n: int, backend: str) -> "TrainConfi
             hist_precision=(
                 "default" if cfg.hist_backend == "pallas" else "highest"
             ),
+        )
+    if cfg.hist_merge not in ("auto", "allreduce", "reduce_scatter"):
+        raise ValueError(
+            f"hist_merge must be 'auto', 'allreduce' or 'reduce_scatter', "
+            f"got {cfg.hist_merge!r}"
+        )
+    if cfg.hist_merge == "auto":
+        # Reduce-scatter wins whenever there is a mesh to scatter over and
+        # enough features that every device owns a non-degenerate slice
+        # (≥2 features/device keeps the per-slice split scan worthwhile;
+        # below that the candidate-exchange overhead eats the wire saving).
+        # Voting and feature-parallel learners own their comm patterns —
+        # the knob only steers the plain data-parallel merge.  The winner
+        # exchange lives in the WINDOWED grower, so auto only flips when
+        # that grower is already the resolved path (depthwise or a
+        # positive split_batch — note split_batch resolved above): pushing
+        # an exact-sequence lossguide run (split_batch=0) into the
+        # windowed grower can flip near-tie split ORDER (the documented
+        # k-batching trade), which auto must never do behind the user's
+        # back.  Explicit hist_merge="reduce_scatter" still opts in.
+        use_rs = (
+            num_devices > 1
+            and num_features >= 2 * num_devices
+            and (cfg.grow_policy == "depthwise" or cfg.split_batch > 0)
+            and cfg.tree_learner
+            not in ("voting", "voting_parallel", "feature", "feature_parallel")
+        )
+        cfg = dataclasses.replace(
+            cfg, hist_merge="reduce_scatter" if use_rs else "allreduce"
         )
     return cfg
 
@@ -918,10 +971,18 @@ def train(
     With ``mesh`` set (or ``tree_learner`` in data/voting modes, which builds
     a default mesh over all visible devices), rows are sharded over the
     mesh's ``"data"`` axis and the grower runs under ``shard_map`` with
-    per-shard histograms ``psum``-med across the axis — the direct
-    replacement for the reference's ``LGBM_NetworkInit`` + socket histogram
-    allreduce (SURVEY.md §3.1, §5.8 N2).  Every shard then computes an
-    identical best split, exactly LightGBM's ``tree_learner=data`` semantics.
+    per-shard histograms merged across the axis — the direct replacement
+    for the reference's ``LGBM_NetworkInit`` + socket histogram allreduce
+    (SURVEY.md §3.1, §5.8 N2).  How they merge is ``hist_merge``:
+    ``"allreduce"`` ``psum``s the full (3, F, B) stack so every shard then
+    computes an identical best split (exactly LightGBM's
+    ``tree_learner=data`` semantics), while ``"reduce_scatter"`` (the
+    ``"auto"`` pick on real meshes) scatters merged histograms over
+    contiguous feature slices — each shard scans only its F/D features and
+    a tiny per-node candidate allgather elects the identical global winner
+    on every shard (LightGBM's reduce-scatter data-parallel merge, Ke et
+    al. 2017; ~D× less wire volume).  Either way tree growth stays
+    replicated: the decision inputs are bit-identical across shards.
 
     ``process_local=True`` is the MULTI-CONTROLLER ingestion contract
     (SURVEY.md §3.1 ``generateDataset``, §7.3.4): ``train_set`` holds ONLY
@@ -1187,7 +1248,14 @@ def _train_impl(
     # ---- "auto" knob resolution ----------------------------------------
     # The resolved values live on cfg from here on (GrowConfig, the scan
     # cache key, and the padding math all read them).
-    cfg = resolve_auto_config(cfg, n=n, backend=jax.default_backend())
+    cfg = resolve_auto_config(
+        cfg,
+        n=n,
+        backend=jax.default_backend(),
+        num_devices=D,
+        num_features=F,
+        num_bins=B,
+    )
 
     # ---- feature-parallel: columns sharded, rows replicated ------------
     feature_par = (
@@ -1195,12 +1263,24 @@ def _train_impl(
         and mesh is not None
         and D > 1
     )
+    # ---- reduce-scatter histogram merge (data-parallel only) -----------
+    # Rows stay sharded exactly as data-parallel; the merge collective
+    # scatters merged histograms over contiguous feature blocks, so the
+    # feature axis needs the same multiple-of-D padding feature-parallel
+    # uses.  Voting/feature-parallel keep their own comm patterns.
+    reduce_scatter = (
+        cfg.hist_merge == "reduce_scatter"
+        and mesh is not None
+        and D > 1
+        and not feature_par
+        and cfg.tree_learner not in ("voting", "voting_parallel")
+    )
     F_real = F
-    if feature_par:
+    if feature_par or reduce_scatter:
         # Pad columns to a multiple of the shard count; padded columns are
         # masked out of every candidate search (feat_valid below).
         # Categoricals: each shard derives its local columns' kinds at RUN
-        # time from axis_index (tree.py _fp_local_cat_mask) — right-padding
+        # time from axis_index (tree.py _local_cat_mask) — right-padding
         # never renumbers real columns, so the global indices stay valid.
         f_pad = (-F) % D
         if f_pad:
@@ -1404,7 +1484,11 @@ def _train_impl(
         )
         grow_policy = "depthwise"
     split_batch = cfg.split_batch
-    if feature_par and grow_policy == "lossguide" and split_batch == 0:
+    if (
+        (feature_par or reduce_scatter)
+        and grow_policy == "lossguide"
+        and split_batch == 0
+    ):
         # The winner exchange lives in the windowed grower; one split per
         # pass reproduces LightGBM's exact leaf-wise sequence there.
         split_batch = 1
@@ -1422,6 +1506,7 @@ def _train_impl(
         hist_chunk=chunk,
         hist_precision=cfg.hist_precision,
         hist_psum_dtype=cfg.hist_psum_dtype,
+        hist_merge="reduce_scatter" if reduce_scatter else "allreduce",
         grow_policy=grow_policy,
         split_batch=split_batch,
         categorical_features=tuple(int(f) for f in cfg.categorical_feature),

@@ -79,6 +79,21 @@ class GrowConfig:
     # (per-shard accumulation stays f32).  Quality-gate with AUC before
     # enabling (tools/bench_scaling.py measures both).
     hist_psum_dtype: str = "float32"
+    # Cross-shard histogram merge of the data-parallel learner (depthwise/
+    # windowed grower only).  "allreduce": every device receives ALL F
+    # features' merged bins per pass (the reference's socket allreduce).
+    # "reduce_scatter": each device receives the merged histogram for only
+    # its contiguous F/D feature slice (LightGBM's data-parallel
+    # Reduce-Scatter merge — Ke et al. NeurIPS 2017), finds best splits
+    # for those features locally, and a per-leaf all-gather of (gain,
+    # feature, threshold, flags) candidates elects the global best on
+    # every shard identically — F·B·3/D received floats per device per
+    # pass instead of F·B·3, at the cost of a tiny (D, 5, L) exchange.
+    # Requires F to be a multiple of the mesh axis size (the booster
+    # right-pads columns and masks the pads out of every candidate
+    # search).  Ignored under voting/feature-parallel, which never
+    # allreduce full histograms in the first place.
+    hist_merge: str = "allreduce"
     grow_policy: str = "lossguide"  # lossguide (LightGBM-exact) | depthwise
     # Categorical membership splits (LightGBM's sorted-category algorithm —
     # SURVEY.md §7.4.5; defaults are LightGBM's cat_smooth/cat_l2/
@@ -153,6 +168,18 @@ class GrowConfig:
     @property
     def feature_parallel_active(self) -> bool:
         return self.feature_parallel and self.axis_name is not None
+
+    @property
+    def reduce_scatter_active(self) -> bool:
+        """Reduce-scatter histogram merging engages only for the plain
+        data-parallel learner: voting psums elected slices and
+        feature-parallel never merges histograms at all."""
+        return (
+            self.hist_merge == "reduce_scatter"
+            and self.axis_name is not None
+            and not self.voting
+            and not self.feature_parallel
+        )
 
     @property
     def level_window(self) -> int:
@@ -537,7 +564,7 @@ def _voting_leaf_candidates(cfg: GrowConfig, hists_local, leaf_stats_local, feat
     hists_sel = jnp.take_along_axis(
         hists_local, sel[None, :, :, None], axis=2
     )  # (3, L, k2, B)
-    hists_sel = lax.psum(hists_sel, cfg.axis_name)
+    hists_sel = lax.psum(hists_sel, cfg.axis_name)  # analyze: ignore[COL004]
     leaf_stats = lax.psum(leaf_stats_local, cfg.axis_name)
 
     fm = jnp.broadcast_to(feat_mask, (L, F))
@@ -560,8 +587,10 @@ def _voting_leaf_candidates(cfg: GrowConfig, hists_local, leaf_stats_local, feat
     return take(gain_s), f, take(t_s), take(d_s), is_cat, hists_sel, sel, j
 
 
-def _fp_local_cat_mask(cfg: GrowConfig, F_local: int):
-    """Runtime (F_local,) categorical mask of THIS shard's column block.
+def _local_cat_mask(cfg: GrowConfig, F_local: int):
+    """Runtime (F_local,) categorical mask of THIS shard's column block
+    (feature-parallel column shards and reduce-scatter feature slices are
+    both contiguous ascending blocks of ``F_local`` global columns).
 
     ``cfg.categorical_features`` holds GLOBAL column indices, but one SPMD
     program cannot specialize statically per shard — so the mask is
@@ -577,21 +606,80 @@ def _fp_local_cat_mask(cfg: GrowConfig, F_local: int):
     return m
 
 
-def _fp_leaf_candidates(cfg: GrowConfig, hists, leaf_stats, feat_mask, cmask):
-    """Per-leaf best over a feature-parallel LOCAL block with a RUNTIME
-    categorical mask: numeric and sorted-category candidates are both
-    computed for every local column and selected per column by ``cmask``
-    (the voting path's dynamic-election technique) — a static per-shard
-    column subset cannot exist inside one SPMD program."""
-    _, L, F, B = hists.shape
+def _local_candidate_matrix(cfg: GrowConfig, hists, leaf_stats, feat_mask, cmask):
+    """(L, F_local) candidate matrices over a LOCAL column block with a
+    RUNTIME categorical mask: numeric and sorted-category candidates are
+    both computed for every local column and selected per column by
+    ``cmask`` (the voting path's dynamic-election technique) — a static
+    per-shard column subset cannot exist inside one SPMD program, so
+    :func:`_candidate_matrix`'s static take/scatter-back is unusable here.
+    """
     gain, t, d = _numeric_candidates(cfg, hists, leaf_stats, feat_mask)
-    cgain, ck, cdesc = _cat_candidates(cfg, hists, leaf_stats, feat_mask)
-    gain = jnp.where(cmask[None, :], cgain, gain)
-    t = jnp.where(cmask[None, :], ck, t)
-    d = jnp.where(cmask[None, :], cdesc, d)
-    f = jnp.argmax(gain, axis=1).astype(jnp.int32)  # (L,)
+    if cfg.has_categoricals:
+        cgain, ck, cdesc = _cat_candidates(cfg, hists, leaf_stats, feat_mask)
+        gain = jnp.where(cmask[None, :], cgain, gain)
+        t = jnp.where(cmask[None, :], ck, t)
+        d = jnp.where(cmask[None, :], cdesc, d)
+    return gain, t, d
+
+
+def _reduce_local_candidates(gain_m, t_m, d_m, cmask):
+    """(L, F_local) candidate matrices → per-leaf best, with ``is_cat``
+    from the RUNTIME column mask (the static :func:`_reduce_candidates`
+    lookup indexes global columns and is wrong for local blocks)."""
+    f = jnp.argmax(gain_m, axis=1).astype(jnp.int32)  # (L,) LOCAL index
     take = lambda a: jnp.take_along_axis(a, f[:, None], axis=1)[:, 0]  # noqa: E731
-    return take(gain), f, take(t), take(d), cmask[f]
+    return take(gain_m), f, take(t_m), take(d_m), cmask[f]
+
+
+def _fp_leaf_candidates(cfg: GrowConfig, hists, leaf_stats, feat_mask, cmask):
+    """Per-leaf best over a feature-parallel LOCAL block (runtime
+    categorical mask) — :func:`_local_candidate_matrix` + local reduce."""
+    gain, t, d = _local_candidate_matrix(cfg, hists, leaf_stats, feat_mask, cmask)
+    return _reduce_local_candidates(gain, t, d, cmask)
+
+
+def _exchange_best(cfg: GrowConfig, gain_l, f_l, t_l, d_l, ic_l, F_block):
+    """Per-leaf winner exchange for the feature-sharded modes
+    (feature-parallel column shards, reduce-scatter feature slices).
+
+    All-gathers each shard's per-leaf best (5 scalars per leaf) and
+    argmaxes across shards — every shard elects the identical global
+    winner from the identical gathered matrix.  Ties pick the lowest
+    shard (argmax-first), whose within-shard winner is its lowest local
+    index — together the lowest GLOBAL feature index, identical to the
+    serial argmax tie-break (both column layouts are contiguous ascending
+    blocks of ``F_block`` columns per shard).
+
+    Returns (gain, f_global, t, dleft, is_cat, own, f_local): ``own``
+    marks the leaves whose winning feature lives on THIS shard and
+    ``f_local`` is its local column there (clipped garbage elsewhere).
+    """
+    from mmlspark_tpu.parallel.distributed import device_all_gather
+
+    ax = cfg.axis_name
+    shard = lax.axis_index(ax)
+    cand = jnp.stack([
+        gain_l,
+        (f_l + shard * F_block).astype(jnp.float32),  # global feature id
+        t_l.astype(jnp.float32),
+        d_l.astype(jnp.float32),
+        ic_l.astype(jnp.float32),
+    ])  # (5, L)
+    allc = device_all_gather(cand, ax)  # (D, 5, L)
+    win_shard = jnp.argmax(allc[:, 0, :], axis=0)  # (L,)
+
+    def take_s(c):
+        return jnp.take_along_axis(allc[:, c, :], win_shard[None], axis=0)[0]
+
+    gain = take_s(0)
+    f = take_s(1).astype(jnp.int32)  # GLOBAL index (for the record)
+    t = take_s(2).astype(jnp.int32)
+    dleft = take_s(3) > 0.5
+    is_cat = take_s(4) > 0.5
+    own = win_shard == shard  # (L,) leaf's winner lives here
+    f_local = jnp.clip(f - shard * F_block, 0, F_block - 1)
+    return gain, f, t, dleft, is_cat, own, f_local
 
 
 def _best_split(cfg: GrowConfig, hists, leaf_stats, leaf_depth, num_leaves, feat_mask):
@@ -767,11 +855,14 @@ def grow_tree_depthwise(
     # shard (votes + elected slices are the only collectives); under
     # feature-parallel it is local by CONSTRUCTION (each shard owns its
     # columns outright — no histogram collective exists in the mode);
-    # otherwise the builders psum so the buffer is globally replicated.
+    # otherwise the builders merge so the buffer is globally replicated
+    # (hist_merge="allreduce") or feature-sliced per shard
+    # (hist_merge="reduce_scatter").
     hist_axis = (
         None if (cfg.voting_active or cfg.feature_parallel_active)
         else cfg.axis_name
     )
+    rs = cfg.reduce_scatter_active
 
     def window_hist(win_leaf):
         return build_histogram_by_leaf(
@@ -779,14 +870,47 @@ def grow_tree_depthwise(
             backend=cfg.hist_backend, chunk=cfg.hist_chunk, axis_name=hist_axis,
             psum_dtype=cfg.hist_psum_dtype,
             precision=cfg.hist_precision, transposed=True,
+            merge="reduce_scatter" if rs else "allreduce",
         )
 
     # Root histogram through the SAME windowed kernel (all rows in slot 0):
     # the plain per-feature kernel's M=3 matmuls cost 2.8ms/pass at the
     # bench shape vs 1.9ms for the factorized windowed kernel, and reusing
     # it drops one compiled kernel from the program.
-    root_hist = window_hist(jnp.zeros(n, jnp.int32))[:, 0]  # (3, F, B)
-    hists0 = jnp.zeros((3, LB, F, B), jnp.float32).at[:, 0].set(root_hist)
+    root_hist = window_hist(jnp.zeros(n, jnp.int32))[:, 0]  # (3, F_loc, B)
+    # Under reduce_scatter the merged buffer holds only THIS shard's
+    # contiguous feature slice: F_loc = F/D is STATIC at trace time
+    # (psum_scatter's result shape; the booster pads F to a multiple of
+    # the axis size).  Every other mode has F_loc == F.
+    F_loc = root_hist.shape[1]
+    hists0 = jnp.zeros((3, LB, F_loc, B), jnp.float32).at[:, 0].set(root_hist)
+
+    if rs:
+        from mmlspark_tpu.parallel.distributed import device_psum
+
+        rs_shard = lax.axis_index(cfg.axis_name)
+        # This shard's slice of the global feature mask + the runtime
+        # categorical mask of its column block (global indices cannot be
+        # specialized statically per shard in one SPMD program).
+        fm_loc = lax.dynamic_slice(feat_mask, (rs_shard * F_loc,), (F_loc,))
+        cmask_loc = (
+            _local_cat_mask(cfg, F_loc)
+            if cfg.has_categoricals
+            else jnp.zeros(F_loc, bool)
+        )
+
+        def _global_leaf_stats(h):
+            # Per-leaf totals summed from GLOBAL feature 0's merged bins on
+            # its owning shard (shard 0), broadcast with one tiny (3, nL)
+            # psum — identical on every shard AND the same bins-of-feature-0
+            # float summation the serial/allreduce paths use, so near-tied
+            # gains round the same way (a per-shard local feature's bin-sum
+            # or a rows segment-sum would each round DIFFERENTLY, visibly
+            # reordering lossguide's gain-ranked split sequence).
+            s = h[:, :, 0, :].sum(axis=-1)  # (3, nL) on shard 0
+            return device_psum(
+                jnp.where(rs_shard == 0, s, 0.0), cfg.axis_name
+            )
 
     # Incremental candidate cache (serial + data-parallel paths): only the
     # ≤ 2W leaves whose histograms a pass touches (split parents + new
@@ -795,16 +919,23 @@ def grow_tree_depthwise(
     # bitwise stable.  Kills the full (3·L·F·B) cumsum+argmax chain every
     # pass (L/2W of it is redundant).  Voting re-scores LOCAL candidates
     # against re-psum-ed stats and feature-parallel re-scores local blocks
-    # per shard, so both keep the full per-pass compute.
+    # per shard, so both keep the full per-pass compute.  Reduce-scatter
+    # keeps the cache — its matrices are (L, F_loc) local slices reduced
+    # per shard and exchanged per pass.
     use_cand_cache = not (cfg.voting_active or cfg.feature_parallel_active)
-    if use_cand_cache:
+    if use_cand_cache and rs:
+        stats0 = _global_leaf_stats(hists0[:, :L])
+        cand0 = _local_candidate_matrix(
+            cfg, hists0[:, :L], stats0, fm_loc, cmask_loc
+        )
+    elif use_cand_cache:
         stats0 = hists0[:, :L, 0, :].sum(axis=-1)
         cand0 = _candidate_matrix(cfg, hists0[:, :L], stats0, feat_mask)
     else:  # dummy carry slot (shapes must match across the while_loop)
         cand0 = (
-            jnp.full((L, F), -jnp.inf, jnp.float32),
-            jnp.zeros((L, F), jnp.int32),
-            jnp.zeros((L, F), bool),
+            jnp.full((L, F_loc), -jnp.inf, jnp.float32),
+            jnp.zeros((L, F_loc), jnp.int32),
+            jnp.zeros((L, F_loc), bool),
         )
 
     # Split-record arrays get one extra scratch slot (index S) that
@@ -834,7 +965,22 @@ def grow_tree_depthwise(
             # feature 0's bins tile all rows → per-leaf totals
             leaf_stats = hists[:, :L, 0, :].sum(axis=-1)  # (3, L)
         if use_cand_cache:
-            gain, f, t, dleft, is_cat = _reduce_candidates(cfg, gain_m, t_m, d_m)
+            if rs:
+                # Local reduce over this shard's feature slice, then the
+                # winner exchange: the only per-pass collectives are the
+                # windowed reduce-scatter merge, the (D, 5, L) candidate
+                # all-gather, and the tiny leaf-stat psum — vs the full
+                # (3, W, F, B) allreduce of hist_merge="allreduce".
+                gain_l, f_l, t_l, d_l, ic_l = _reduce_local_candidates(
+                    gain_m, t_m, d_m, cmask_loc
+                )
+                gain, f, t, dleft, is_cat, xch_own, xch_f_local = (
+                    _exchange_best(cfg, gain_l, f_l, t_l, d_l, ic_l, F_loc)
+                )
+            else:
+                gain, f, t, dleft, is_cat = _reduce_candidates(
+                    cfg, gain_m, t_m, d_m
+                )
         elif cfg.voting_active:
             gain, f, t, dleft, is_cat, hists_sel, sel_feats, sel_j = (
                 _voting_leaf_candidates(cfg, hists[:, :L], leaf_stats, feat_mask)
@@ -850,7 +996,7 @@ def grow_tree_depthwise(
             if cfg.has_categoricals:
                 # runtime per-shard column kinds (a static per-shard set
                 # cannot exist in one SPMD program — VERDICT r3 #7)
-                fp_cmask = _fp_local_cat_mask(cfg, F)
+                fp_cmask = _local_cat_mask(cfg, F_loc)
                 gain_l, f_l, t_l, d_l, ic_l = _fp_leaf_candidates(
                     cfg, hists[:, :L], leaf_stats, feat_mask, fp_cmask
                 )
@@ -858,28 +1004,9 @@ def grow_tree_depthwise(
                 gain_l, f_l, t_l, d_l, ic_l = _leaf_candidates(
                     cfg, hists[:, :L], leaf_stats, feat_mask
                 )
-            ax = cfg.axis_name
-            shard = lax.axis_index(ax)
-            cand = jnp.stack([
-                gain_l,
-                (f_l + shard * F).astype(jnp.float32),  # global feature id
-                t_l.astype(jnp.float32),
-                d_l.astype(jnp.float32),
-                ic_l.astype(jnp.float32),
-            ])  # (5, L)
-            allc = lax.all_gather(cand, ax)  # (D, 5, L)
-            win_shard = jnp.argmax(allc[:, 0, :], axis=0)  # (L,)
-
-            def take_s(c):
-                return jnp.take_along_axis(allc[:, c, :], win_shard[None], axis=0)[0]
-
-            gain = take_s(0)
-            f = take_s(1).astype(jnp.int32)  # GLOBAL index (for the record)
-            t = take_s(2).astype(jnp.int32)
-            dleft = take_s(3) > 0.5
-            is_cat = take_s(4) > 0.5
-            fp_own = win_shard == shard  # (L,) leaf's winner lives here
-            fp_f_local = jnp.clip(f - shard * F, 0, F - 1)
+            gain, f, t, dleft, is_cat, xch_own, xch_f_local = (
+                _exchange_best(cfg, gain_l, f_l, t_l, d_l, ic_l, F_loc)
+            )
         leaf_ok = leaf_arange < cur_leaves
         if cfg.max_depth > 0:
             leaf_ok &= leaf_depth < cfg.max_depth
@@ -910,18 +1037,22 @@ def grow_tree_depthwise(
                 hist_lf = jnp.take_along_axis(
                     hists_sel, sel_j[None, :, None, None], axis=2
                 )[:, :, 0]  # (3, L, B)
-            elif cfg.feature_parallel_active:
-                # The winner's histogram lives whole on its OWNING shard
-                # (rows replicated ⇒ local histograms are complete); one
+            elif cfg.feature_parallel_active or rs:
+                # The winner's MERGED histogram lives whole on its OWNING
+                # shard (feature-parallel: rows replicated ⇒ local
+                # histograms are complete; reduce_scatter: the merge
+                # already summed the owner's slice across shards); one
                 # small psum of the owner's (3, L, B) slice replicates it,
                 # so every shard derives the identical membership set —
                 # the exchange rides the same owner-broadcast structure as
-                # the row partition below.
+                # the feature-parallel row partition below.
+                from mmlspark_tpu.parallel.distributed import device_psum
+
                 hist_own = jnp.take_along_axis(
-                    hists[:, :L], fp_f_local[None, :, None, None], axis=2
+                    hists[:, :L], xch_f_local[None, :, None, None], axis=2
                 )[:, :, 0]  # (3, L, B)
-                hist_lf = lax.psum(
-                    jnp.where(fp_own[None, :, None], hist_own, 0.0),
+                hist_lf = device_psum(
+                    jnp.where(xch_own[None, :, None], hist_own, 0.0),
                     cfg.axis_name,
                 )
             else:
@@ -940,7 +1071,7 @@ def grow_tree_depthwise(
             # computes the row partition and broadcasts it with one psum —
             # LightGBM feature-parallel's "winner broadcasts the split
             # result" step (its n-bit bitset → an n-vector reduction here).
-            f_row = fp_f_local[leaf_ids]
+            f_row = xch_f_local[leaf_ids]
             fcol = jnp.take_along_axis(bins_t, f_row[None, :], axis=0)[0]
             is_missing = fcol == (B - 1)
             gl_local = jnp.where(is_missing, dleft[leaf_ids], fcol <= t[leaf_ids])
@@ -961,7 +1092,7 @@ def grow_tree_depthwise(
                 )
                 gl_cat = ((wsel >> (fcol & 31).astype(jnp.uint32)) & 1) > 0
                 gl_local = jnp.where(is_cat[leaf_ids], gl_cat, gl_local)
-            own_row = fp_own[leaf_ids]
+            own_row = xch_own[leaf_ids]
             goes_left = lax.psum(
                 jnp.where(own_row, gl_local.astype(jnp.float32), 0.0),
                 cfg.axis_name,
@@ -1011,8 +1142,17 @@ def grow_tree_depthwise(
             child_ids = jnp.where(warange < k, base + warange, LB)
             changed = jnp.concatenate([parent_ids, child_ids])  # (2W,)
             h_ch = jnp.take(hists, jnp.minimum(changed, LB - 1), axis=1)
-            stats_ch = h_ch[:, :, 0, :].sum(axis=-1)  # (3, 2W)
-            cg, ct, cd = _candidate_matrix(cfg, h_ch, stats_ch, feat_mask)
+            if rs:
+                # Shard-identical per-leaf totals from the merged slices
+                # (see _global_leaf_stats); parked slots clip to garbage
+                # the mode="drop" scatter below discards.
+                stats_ch = _global_leaf_stats(h_ch)  # (3, 2W)
+                cg, ct, cd = _local_candidate_matrix(
+                    cfg, h_ch, stats_ch, fm_loc, cmask_loc
+                )
+            else:
+                stats_ch = h_ch[:, :, 0, :].sum(axis=-1)  # (3, 2W)
+                cg, ct, cd = _candidate_matrix(cfg, h_ch, stats_ch, feat_mask)
             gain_m = gain_m.at[changed].set(cg, mode="drop")
             t_m = t_m.at[changed].set(ct, mode="drop")
             d_m = d_m.at[changed].set(cd, mode="drop")
@@ -1102,6 +1242,7 @@ def grow_tree_auto(cfg: GrowConfig, *args):
         cfg.grow_policy == "depthwise"
         or cfg.split_batch > 0
         or cfg.feature_parallel_active
+        or cfg.reduce_scatter_active
     ):
         return grow_tree_depthwise(cfg, *args)
     return grow_tree(cfg, *args)

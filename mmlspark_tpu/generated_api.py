@@ -1155,6 +1155,7 @@ class LightGBMClassificationModel(_LightGBMClassificationModel):
       featureFraction: Feature subsample fraction
       featuresCol: The name of the features column
       growPolicy: lossguide (leaf-wise; auto-batches splits on TPU — see splitBatch) | lossguide_exact (LightGBM's one-split-per-pass sequence, never batched) | depthwise (level-batched histograms, one pass per level)
+      histMerge: Distributed histogram-merge strategy: auto (reduce_scatter when the mesh/feature shape profits — the benchmarked default, see BASELINE.md) | allreduce (every device receives the full merged histogram) | reduce_scatter (each device receives only its feature slice + a best-split allgather)
       initScoreCol: Initial (margin) score column
       isProvideTrainingMetric: Record metrics on training data too
       isUnbalance: Reweight unbalanced binary labels
@@ -1192,7 +1193,7 @@ class LightGBMClassificationModel(_LightGBMClassificationModel):
       weightCol: The name of the sample-weight column
     """
 
-    def __init__(self, *, baggingFraction=1.0, baggingFreq=0, baggingSeed=3, boostFromAverage=True, booster=_UNSET, boostingType='gbdt', categoricalSlotIndexes=None, categoricalSlotNames=None, defaultListenPort=12400, deviceType='tpu', driverListenPort=0, earlyStoppingRound=0, featureFraction=1.0, featuresCol='features', growPolicy='lossguide', initScoreCol=_UNSET, isProvideTrainingMetric=False, isUnbalance=False, labelCol='label', lambdaL1=0.0, lambdaL2=0.0, leafPredictionCol='', learningRate=0.1, matrixType='auto', maxBin=255, maxDepth=-1, metric='', minDataInLeaf=20, minSumHessianInLeaf=0.001, modelString='', numBatches=0, numIterations=100, numLeaves=31, numTasks=0, numThreads=0, objective='regression', parallelism='data_parallel', predictionCol='prediction', probabilityCol='probability', rawPredictionCol='rawPrediction', seed=0, slotNames=None, splitBatch=0, thresholds=None, timeout=1200.0, topK=20, useBarrierExecutionMode=False, validationIndicatorCol=_UNSET, verbosity=1, weightCol=_UNSET):
+    def __init__(self, *, baggingFraction=1.0, baggingFreq=0, baggingSeed=3, boostFromAverage=True, booster=_UNSET, boostingType='gbdt', categoricalSlotIndexes=None, categoricalSlotNames=None, defaultListenPort=12400, deviceType='tpu', driverListenPort=0, earlyStoppingRound=0, featureFraction=1.0, featuresCol='features', growPolicy='lossguide', histMerge='auto', initScoreCol=_UNSET, isProvideTrainingMetric=False, isUnbalance=False, labelCol='label', lambdaL1=0.0, lambdaL2=0.0, leafPredictionCol='', learningRate=0.1, matrixType='auto', maxBin=255, maxDepth=-1, metric='', minDataInLeaf=20, minSumHessianInLeaf=0.001, modelString='', numBatches=0, numIterations=100, numLeaves=31, numTasks=0, numThreads=0, objective='regression', parallelism='data_parallel', predictionCol='prediction', probabilityCol='probability', rawPredictionCol='rawPrediction', seed=0, slotNames=None, splitBatch=0, thresholds=None, timeout=1200.0, topK=20, useBarrierExecutionMode=False, validationIndicatorCol=_UNSET, verbosity=1, weightCol=_UNSET):
         kw = {k: v for k, v in locals().items()
               if k not in ('self', '__class__') and v is not _UNSET}
         super().__init__(**kw)
@@ -1216,6 +1217,7 @@ class LightGBMClassifier(_LightGBMClassifier):
       featureFraction: Feature subsample fraction
       featuresCol: The name of the features column
       growPolicy: lossguide (leaf-wise; auto-batches splits on TPU — see splitBatch) | lossguide_exact (LightGBM's one-split-per-pass sequence, never batched) | depthwise (level-batched histograms, one pass per level)
+      histMerge: Distributed histogram-merge strategy: auto (reduce_scatter when the mesh/feature shape profits — the benchmarked default, see BASELINE.md) | allreduce (every device receives the full merged histogram) | reduce_scatter (each device receives only its feature slice + a best-split allgather)
       initScoreCol: Initial (margin) score column
       isProvideTrainingMetric: Record metrics on training data too
       isUnbalance: Reweight unbalanced binary labels
@@ -1253,7 +1255,7 @@ class LightGBMClassifier(_LightGBMClassifier):
       weightCol: The name of the sample-weight column
     """
 
-    def __init__(self, *, baggingFraction=1.0, baggingFreq=0, baggingSeed=3, boostFromAverage=True, boostingType='gbdt', categoricalSlotIndexes=None, categoricalSlotNames=None, defaultListenPort=12400, deviceType='tpu', driverListenPort=0, earlyStoppingRound=0, featureFraction=1.0, featuresCol='features', growPolicy='lossguide', initScoreCol=_UNSET, isProvideTrainingMetric=False, isUnbalance=False, labelCol='label', lambdaL1=0.0, lambdaL2=0.0, leafPredictionCol='', learningRate=0.1, matrixType='auto', maxBin=255, maxDepth=-1, metric='', minDataInLeaf=20, minSumHessianInLeaf=0.001, modelString='', numBatches=0, numIterations=100, numLeaves=31, numTasks=0, numThreads=0, objective='binary', parallelism='data_parallel', predictionCol='prediction', probabilityCol='probability', rawPredictionCol='rawPrediction', seed=0, slotNames=None, splitBatch=0, thresholds=None, timeout=1200.0, topK=20, useBarrierExecutionMode=False, validationIndicatorCol=_UNSET, verbosity=1, weightCol=_UNSET):
+    def __init__(self, *, baggingFraction=1.0, baggingFreq=0, baggingSeed=3, boostFromAverage=True, boostingType='gbdt', categoricalSlotIndexes=None, categoricalSlotNames=None, defaultListenPort=12400, deviceType='tpu', driverListenPort=0, earlyStoppingRound=0, featureFraction=1.0, featuresCol='features', growPolicy='lossguide', histMerge='auto', initScoreCol=_UNSET, isProvideTrainingMetric=False, isUnbalance=False, labelCol='label', lambdaL1=0.0, lambdaL2=0.0, leafPredictionCol='', learningRate=0.1, matrixType='auto', maxBin=255, maxDepth=-1, metric='', minDataInLeaf=20, minSumHessianInLeaf=0.001, modelString='', numBatches=0, numIterations=100, numLeaves=31, numTasks=0, numThreads=0, objective='binary', parallelism='data_parallel', predictionCol='prediction', probabilityCol='probability', rawPredictionCol='rawPrediction', seed=0, slotNames=None, splitBatch=0, thresholds=None, timeout=1200.0, topK=20, useBarrierExecutionMode=False, validationIndicatorCol=_UNSET, verbosity=1, weightCol=_UNSET):
         kw = {k: v for k, v in locals().items()
               if k not in ('self', '__class__') and v is not _UNSET}
         super().__init__(**kw)
@@ -1279,6 +1281,7 @@ class LightGBMRanker(_LightGBMRanker):
       featuresCol: The name of the features column
       groupCol: Query group column
       growPolicy: lossguide (leaf-wise; auto-batches splits on TPU — see splitBatch) | lossguide_exact (LightGBM's one-split-per-pass sequence, never batched) | depthwise (level-batched histograms, one pass per level)
+      histMerge: Distributed histogram-merge strategy: auto (reduce_scatter when the mesh/feature shape profits — the benchmarked default, see BASELINE.md) | allreduce (every device receives the full merged histogram) | reduce_scatter (each device receives only its feature slice + a best-split allgather)
       initScoreCol: Initial (margin) score column
       isProvideTrainingMetric: Record metrics on training data too
       isUnbalance: Reweight unbalanced binary labels
@@ -1316,7 +1319,7 @@ class LightGBMRanker(_LightGBMRanker):
       weightCol: The name of the sample-weight column
     """
 
-    def __init__(self, *, baggingFraction=1.0, baggingFreq=0, baggingSeed=3, boostFromAverage=True, boostingType='gbdt', categoricalSlotIndexes=None, categoricalSlotNames=None, defaultListenPort=12400, deviceType='tpu', driverListenPort=0, earlyStoppingRound=0, evalAt=[1, 2, 3, 4, 5], featureFraction=1.0, featuresCol='features', groupCol='group', growPolicy='lossguide', initScoreCol=_UNSET, isProvideTrainingMetric=False, isUnbalance=False, labelCol='label', labelGain=None, lambdaL1=0.0, lambdaL2=0.0, leafPredictionCol='', learningRate=0.1, matrixType='auto', maxBin=255, maxDepth=-1, maxPosition=20, metric='', minDataInLeaf=20, minSumHessianInLeaf=0.001, modelString='', numBatches=0, numIterations=100, numLeaves=31, numTasks=0, numThreads=0, objective='lambdarank', parallelism='data_parallel', predictionCol='prediction', repartitionByGroupingColumn=True, seed=0, slotNames=None, splitBatch=0, timeout=1200.0, topK=20, useBarrierExecutionMode=False, validationIndicatorCol=_UNSET, verbosity=1, weightCol=_UNSET):
+    def __init__(self, *, baggingFraction=1.0, baggingFreq=0, baggingSeed=3, boostFromAverage=True, boostingType='gbdt', categoricalSlotIndexes=None, categoricalSlotNames=None, defaultListenPort=12400, deviceType='tpu', driverListenPort=0, earlyStoppingRound=0, evalAt=[1, 2, 3, 4, 5], featureFraction=1.0, featuresCol='features', groupCol='group', growPolicy='lossguide', histMerge='auto', initScoreCol=_UNSET, isProvideTrainingMetric=False, isUnbalance=False, labelCol='label', labelGain=None, lambdaL1=0.0, lambdaL2=0.0, leafPredictionCol='', learningRate=0.1, matrixType='auto', maxBin=255, maxDepth=-1, maxPosition=20, metric='', minDataInLeaf=20, minSumHessianInLeaf=0.001, modelString='', numBatches=0, numIterations=100, numLeaves=31, numTasks=0, numThreads=0, objective='lambdarank', parallelism='data_parallel', predictionCol='prediction', repartitionByGroupingColumn=True, seed=0, slotNames=None, splitBatch=0, timeout=1200.0, topK=20, useBarrierExecutionMode=False, validationIndicatorCol=_UNSET, verbosity=1, weightCol=_UNSET):
         kw = {k: v for k, v in locals().items()
               if k not in ('self', '__class__') and v is not _UNSET}
         super().__init__(**kw)
@@ -1341,6 +1344,7 @@ class LightGBMRankerModel(_LightGBMRankerModel):
       featureFraction: Feature subsample fraction
       featuresCol: The name of the features column
       growPolicy: lossguide (leaf-wise; auto-batches splits on TPU — see splitBatch) | lossguide_exact (LightGBM's one-split-per-pass sequence, never batched) | depthwise (level-batched histograms, one pass per level)
+      histMerge: Distributed histogram-merge strategy: auto (reduce_scatter when the mesh/feature shape profits — the benchmarked default, see BASELINE.md) | allreduce (every device receives the full merged histogram) | reduce_scatter (each device receives only its feature slice + a best-split allgather)
       initScoreCol: Initial (margin) score column
       isProvideTrainingMetric: Record metrics on training data too
       isUnbalance: Reweight unbalanced binary labels
@@ -1375,7 +1379,7 @@ class LightGBMRankerModel(_LightGBMRankerModel):
       weightCol: The name of the sample-weight column
     """
 
-    def __init__(self, *, baggingFraction=1.0, baggingFreq=0, baggingSeed=3, boostFromAverage=True, booster=_UNSET, boostingType='gbdt', categoricalSlotIndexes=None, categoricalSlotNames=None, defaultListenPort=12400, deviceType='tpu', driverListenPort=0, earlyStoppingRound=0, featureFraction=1.0, featuresCol='features', growPolicy='lossguide', initScoreCol=_UNSET, isProvideTrainingMetric=False, isUnbalance=False, labelCol='label', lambdaL1=0.0, lambdaL2=0.0, leafPredictionCol='', learningRate=0.1, matrixType='auto', maxBin=255, maxDepth=-1, metric='', minDataInLeaf=20, minSumHessianInLeaf=0.001, modelString='', numBatches=0, numIterations=100, numLeaves=31, numTasks=0, numThreads=0, objective='regression', parallelism='data_parallel', predictionCol='prediction', seed=0, slotNames=None, splitBatch=0, timeout=1200.0, topK=20, useBarrierExecutionMode=False, validationIndicatorCol=_UNSET, verbosity=1, weightCol=_UNSET):
+    def __init__(self, *, baggingFraction=1.0, baggingFreq=0, baggingSeed=3, boostFromAverage=True, booster=_UNSET, boostingType='gbdt', categoricalSlotIndexes=None, categoricalSlotNames=None, defaultListenPort=12400, deviceType='tpu', driverListenPort=0, earlyStoppingRound=0, featureFraction=1.0, featuresCol='features', growPolicy='lossguide', histMerge='auto', initScoreCol=_UNSET, isProvideTrainingMetric=False, isUnbalance=False, labelCol='label', lambdaL1=0.0, lambdaL2=0.0, leafPredictionCol='', learningRate=0.1, matrixType='auto', maxBin=255, maxDepth=-1, metric='', minDataInLeaf=20, minSumHessianInLeaf=0.001, modelString='', numBatches=0, numIterations=100, numLeaves=31, numTasks=0, numThreads=0, objective='regression', parallelism='data_parallel', predictionCol='prediction', seed=0, slotNames=None, splitBatch=0, timeout=1200.0, topK=20, useBarrierExecutionMode=False, validationIndicatorCol=_UNSET, verbosity=1, weightCol=_UNSET):
         kw = {k: v for k, v in locals().items()
               if k not in ('self', '__class__') and v is not _UNSET}
         super().__init__(**kw)
@@ -1400,6 +1404,7 @@ class LightGBMRegressionModel(_LightGBMRegressionModel):
       featureFraction: Feature subsample fraction
       featuresCol: The name of the features column
       growPolicy: lossguide (leaf-wise; auto-batches splits on TPU — see splitBatch) | lossguide_exact (LightGBM's one-split-per-pass sequence, never batched) | depthwise (level-batched histograms, one pass per level)
+      histMerge: Distributed histogram-merge strategy: auto (reduce_scatter when the mesh/feature shape profits — the benchmarked default, see BASELINE.md) | allreduce (every device receives the full merged histogram) | reduce_scatter (each device receives only its feature slice + a best-split allgather)
       initScoreCol: Initial (margin) score column
       isProvideTrainingMetric: Record metrics on training data too
       isUnbalance: Reweight unbalanced binary labels
@@ -1434,7 +1439,7 @@ class LightGBMRegressionModel(_LightGBMRegressionModel):
       weightCol: The name of the sample-weight column
     """
 
-    def __init__(self, *, baggingFraction=1.0, baggingFreq=0, baggingSeed=3, boostFromAverage=True, booster=_UNSET, boostingType='gbdt', categoricalSlotIndexes=None, categoricalSlotNames=None, defaultListenPort=12400, deviceType='tpu', driverListenPort=0, earlyStoppingRound=0, featureFraction=1.0, featuresCol='features', growPolicy='lossguide', initScoreCol=_UNSET, isProvideTrainingMetric=False, isUnbalance=False, labelCol='label', lambdaL1=0.0, lambdaL2=0.0, leafPredictionCol='', learningRate=0.1, matrixType='auto', maxBin=255, maxDepth=-1, metric='', minDataInLeaf=20, minSumHessianInLeaf=0.001, modelString='', numBatches=0, numIterations=100, numLeaves=31, numTasks=0, numThreads=0, objective='regression', parallelism='data_parallel', predictionCol='prediction', seed=0, slotNames=None, splitBatch=0, timeout=1200.0, topK=20, useBarrierExecutionMode=False, validationIndicatorCol=_UNSET, verbosity=1, weightCol=_UNSET):
+    def __init__(self, *, baggingFraction=1.0, baggingFreq=0, baggingSeed=3, boostFromAverage=True, booster=_UNSET, boostingType='gbdt', categoricalSlotIndexes=None, categoricalSlotNames=None, defaultListenPort=12400, deviceType='tpu', driverListenPort=0, earlyStoppingRound=0, featureFraction=1.0, featuresCol='features', growPolicy='lossguide', histMerge='auto', initScoreCol=_UNSET, isProvideTrainingMetric=False, isUnbalance=False, labelCol='label', lambdaL1=0.0, lambdaL2=0.0, leafPredictionCol='', learningRate=0.1, matrixType='auto', maxBin=255, maxDepth=-1, metric='', minDataInLeaf=20, minSumHessianInLeaf=0.001, modelString='', numBatches=0, numIterations=100, numLeaves=31, numTasks=0, numThreads=0, objective='regression', parallelism='data_parallel', predictionCol='prediction', seed=0, slotNames=None, splitBatch=0, timeout=1200.0, topK=20, useBarrierExecutionMode=False, validationIndicatorCol=_UNSET, verbosity=1, weightCol=_UNSET):
         kw = {k: v for k, v in locals().items()
               if k not in ('self', '__class__') and v is not _UNSET}
         super().__init__(**kw)
@@ -1459,6 +1464,7 @@ class LightGBMRegressor(_LightGBMRegressor):
       featureFraction: Feature subsample fraction
       featuresCol: The name of the features column
       growPolicy: lossguide (leaf-wise; auto-batches splits on TPU — see splitBatch) | lossguide_exact (LightGBM's one-split-per-pass sequence, never batched) | depthwise (level-batched histograms, one pass per level)
+      histMerge: Distributed histogram-merge strategy: auto (reduce_scatter when the mesh/feature shape profits — the benchmarked default, see BASELINE.md) | allreduce (every device receives the full merged histogram) | reduce_scatter (each device receives only its feature slice + a best-split allgather)
       initScoreCol: Initial (margin) score column
       isProvideTrainingMetric: Record metrics on training data too
       isUnbalance: Reweight unbalanced binary labels
@@ -1494,7 +1500,7 @@ class LightGBMRegressor(_LightGBMRegressor):
       weightCol: The name of the sample-weight column
     """
 
-    def __init__(self, *, alpha=0.9, baggingFraction=1.0, baggingFreq=0, baggingSeed=3, boostFromAverage=True, boostingType='gbdt', categoricalSlotIndexes=None, categoricalSlotNames=None, defaultListenPort=12400, deviceType='tpu', driverListenPort=0, earlyStoppingRound=0, featureFraction=1.0, featuresCol='features', growPolicy='lossguide', initScoreCol=_UNSET, isProvideTrainingMetric=False, isUnbalance=False, labelCol='label', lambdaL1=0.0, lambdaL2=0.0, leafPredictionCol='', learningRate=0.1, matrixType='auto', maxBin=255, maxDepth=-1, metric='', minDataInLeaf=20, minSumHessianInLeaf=0.001, modelString='', numBatches=0, numIterations=100, numLeaves=31, numTasks=0, numThreads=0, objective='regression', parallelism='data_parallel', predictionCol='prediction', seed=0, slotNames=None, splitBatch=0, timeout=1200.0, topK=20, tweedieVariancePower=1.5, useBarrierExecutionMode=False, validationIndicatorCol=_UNSET, verbosity=1, weightCol=_UNSET):
+    def __init__(self, *, alpha=0.9, baggingFraction=1.0, baggingFreq=0, baggingSeed=3, boostFromAverage=True, boostingType='gbdt', categoricalSlotIndexes=None, categoricalSlotNames=None, defaultListenPort=12400, deviceType='tpu', driverListenPort=0, earlyStoppingRound=0, featureFraction=1.0, featuresCol='features', growPolicy='lossguide', histMerge='auto', initScoreCol=_UNSET, isProvideTrainingMetric=False, isUnbalance=False, labelCol='label', lambdaL1=0.0, lambdaL2=0.0, leafPredictionCol='', learningRate=0.1, matrixType='auto', maxBin=255, maxDepth=-1, metric='', minDataInLeaf=20, minSumHessianInLeaf=0.001, modelString='', numBatches=0, numIterations=100, numLeaves=31, numTasks=0, numThreads=0, objective='regression', parallelism='data_parallel', predictionCol='prediction', seed=0, slotNames=None, splitBatch=0, timeout=1200.0, topK=20, tweedieVariancePower=1.5, useBarrierExecutionMode=False, validationIndicatorCol=_UNSET, verbosity=1, weightCol=_UNSET):
         kw = {k: v for k, v in locals().items()
               if k not in ('self', '__class__') and v is not _UNSET}
         super().__init__(**kw)

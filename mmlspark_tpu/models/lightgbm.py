@@ -65,6 +65,18 @@ class _LightGBMExecutionParams(Params):
     topK = Param(
         "topK", "Top-k features voted per worker in voting_parallel", default=20, dtype=int
     )
+    histMerge = Param(
+        "histMerge",
+        "Distributed histogram-merge strategy: auto (reduce_scatter when "
+        "the mesh/feature shape profits — the benchmarked default, see "
+        "BASELINE.md) | allreduce (every device receives the full merged "
+        "histogram) | reduce_scatter (each device receives only its "
+        "feature slice + a best-split allgather)",
+        default="auto", dtype=str,
+        validator=ParamValidators.inList(
+            ["auto", "allreduce", "reduce_scatter"]
+        ),
+    )
     useBarrierExecutionMode = Param(
         "useBarrierExecutionMode",
         "Gang-schedule training (the SPMD program launch is inherently "
@@ -198,6 +210,7 @@ class _LightGBMParams(
         }[self.getParallelism()]
         p["tree_learner"] = learner
         p["top_k"] = self.getTopK()
+        p["hist_merge"] = self.getHistMerge()
         p["grow_policy"] = self.getGrowPolicy()
         p["split_batch"] = self.getSplitBatch()
         p["num_threads"] = self.getNumThreads()

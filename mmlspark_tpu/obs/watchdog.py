@@ -99,6 +99,15 @@ class collective_watchdog:
             )
         if _state.enabled:
             metrics.registry.inc("collective.calls", name=self.name)
+            nbytes = self.attrs.get("nbytes")
+            if nbytes:
+                # Wire-volume ledger: callers attach the bytes each rank
+                # receives (host collectives pass it up front; the traced
+                # device wrappers in parallel/distributed.py set it from
+                # the result shape inside the context).
+                metrics.registry.inc(
+                    "collective.bytes", float(nbytes), name=self.name
+                )
             metrics.registry.observe(
                 "collective.duration_s", dur_s, name=self.name
             )

@@ -43,6 +43,57 @@ from jax import lax
 DEFAULT_CHUNK = 16_384
 
 
+def merge_shard_histograms(
+    hist: jnp.ndarray,
+    axis_name: str,
+    merge: str = "allreduce",
+    psum_dtype: str = "float32",
+    feature_axis: int = 1,
+) -> jnp.ndarray:
+    """Cross-shard histogram merge — the one collective of the
+    data-parallel learner.
+
+    - ``"allreduce"``: every device receives ALL F features' merged bins
+      (the reference's socket allreduce, SURVEY.md §3.1/§5.8 N2).
+    - ``"reduce_scatter"``: each device receives the merged histogram of
+      only its contiguous ``F/D`` feature slice — LightGBM's data-parallel
+      Reduce-Scatter merge (Ke et al., NeurIPS 2017): split finding then
+      runs per-slice and a tiny per-leaf winner all-gather elects the
+      global best, cutting received bytes per device per pass from
+      ``3·F·B`` floats to ``3·F·B/D``.  The ``feature_axis`` size must be
+      a multiple of the mesh axis size (the booster right-pads columns).
+
+    ``psum_dtype="bfloat16"`` halves the wire for either strategy: local
+    f32 partial sums are cast down for the cross-shard reduction only.
+    Both delegate to the watchdog-wrapped device collectives in
+    :mod:`mmlspark_tpu.parallel.distributed`, so call counts and received
+    bytes land in the obs ``collective.*`` ledger.
+    """
+    from mmlspark_tpu.parallel.distributed import (
+        device_psum,
+        device_psum_scatter,
+    )
+
+    if merge == "reduce_scatter":
+        op = functools.partial(
+            device_psum_scatter,
+            axis_name=axis_name,
+            scatter_dimension=feature_axis,
+            tiled=True,
+        )
+    elif merge == "allreduce":
+        op = functools.partial(device_psum, axis_name=axis_name)
+    else:
+        raise ValueError(
+            f"unknown hist_merge {merge!r}; expected allreduce|reduce_scatter"
+        )
+    if psum_dtype == "bfloat16":
+        # halve the wire: per-shard sums stay f32; only the cross-shard
+        # reduction rides bf16 (tools/bench_scaling.py gates it)
+        return op(hist.astype(jnp.bfloat16)).astype(jnp.float32)
+    return op(hist)
+
+
 def _scatter_hist_chunk(bins_c, vals_c, num_bins: int):
     """(C, F) int bins, (3, C) vals → (3, F, B) via scatter-add."""
     C, F = bins_c.shape
@@ -85,9 +136,11 @@ def build_histogram(
     precision: str = "highest",
     transposed: bool = False,
     psum_dtype: str = "float32",
+    merge: str = "allreduce",
 ) -> jnp.ndarray:
     """Histogram of ``vals`` (3, n) over (feature, bin), rows gated by
-    ``mask``; returns (3, F, B).
+    ``mask``; returns (3, F, B) — or (3, F/D, B), this shard's merged
+    feature slice, under ``merge="reduce_scatter"``.
 
     ``transposed=True`` means ``bins`` arrives as (F, n) int32 — growers
     hoist the convert+transpose out of their per-pass loop (pallas wants
@@ -140,14 +193,10 @@ def build_histogram(
 
         hist, _ = lax.scan(body, jnp.zeros((3, F, num_bins), jnp.float32), (bc, vc))
     if axis_name is not None:
-        if psum_dtype == "bfloat16":
-            # halve the wire: per-shard sums stay f32; only the cross-
-            # shard reduction rides bf16 (tools/bench_scaling.py gates it)
-            hist = lax.psum(hist.astype(jnp.bfloat16), axis_name).astype(
-                jnp.float32
-            )
-        else:
-            hist = lax.psum(hist, axis_name)
+        hist = merge_shard_histograms(
+            hist, axis_name, merge=merge, psum_dtype=psum_dtype,
+            feature_axis=1,
+        )
     return hist
 
 
@@ -184,8 +233,11 @@ def build_histogram_by_leaf(
     precision: str = "highest",
     transposed: bool = False,
     psum_dtype: str = "float32",
+    merge: str = "allreduce",
 ) -> jnp.ndarray:
-    """Per-leaf histograms in ONE pass over the data: (3, L, F, B).
+    """Per-leaf histograms in ONE pass over the data: (3, L, F, B) — or
+    (3, L, F/D, B), this shard's merged feature slice, under
+    ``merge="reduce_scatter"``.
 
     The depthwise grower's workhorse (SURVEY.md §7.4.2): one pass histograms
     every leaf slot in ``[0, num_leaves)`` together.  Rows to exclude
@@ -253,12 +305,8 @@ def build_histogram_by_leaf(
             (bc, vc, lc),
         )
     if axis_name is not None:
-        if psum_dtype == "bfloat16":
-            # halve the wire: per-shard sums stay f32; only the cross-
-            # shard reduction rides bf16 (tools/bench_scaling.py gates it)
-            hist = lax.psum(hist.astype(jnp.bfloat16), axis_name).astype(
-                jnp.float32
-            )
-        else:
-            hist = lax.psum(hist, axis_name)
+        hist = merge_shard_histograms(
+            hist, axis_name, merge=merge, psum_dtype=psum_dtype,
+            feature_axis=2,
+        )
     return hist

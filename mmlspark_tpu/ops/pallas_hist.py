@@ -27,6 +27,14 @@ Layout choices (TPU tiling wants the last dim lane-sized):
 
 VMEM budget per grid cell (by-leaf defaults bm=8192, bf=8, rm=1024):
 one-hot (256, 1024) f32 = 1 MiB + rhs/out tiles ≪ 16 MiB/core.
+
+Distributed merge layout (ISSUE 4): the engine keeps features CONTIGUOUS
+on the feature axis of the kernel's output, so the reduce-scatter merge
+(``ops/histogram.py::merge_shard_histograms``) can ``psum_scatter`` that
+axis tiled — block i of the feature axis lands merged on mesh shard i
+with no re-layout between the kernel and the collective.  Feature padding
+for ``F % D != 0`` happens host-side before binning, so the kernel never
+sees a ragged feature axis.
 """
 
 from __future__ import annotations

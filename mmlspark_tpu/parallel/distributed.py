@@ -127,6 +127,72 @@ def make_global_array(mesh, spec, local_rows):
         return jax.make_array_from_process_local_data(sharding, local_rows)
 
 
+def _leaf_nbytes(x) -> int:
+    """Total payload bytes of a pytree's leaves (trace-time shapes)."""
+    import jax
+    import numpy as np
+
+    return int(
+        sum(
+            int(np.prod(leaf.shape)) * np.dtype(leaf.dtype).itemsize
+            for leaf in jax.tree_util.tree_leaves(x)
+        )
+    )
+
+
+# ---------------------------------------------------------------------------
+# Device-collective wrappers (traced): the sanctioned call sites for the
+# big in-program collectives.  Each delegates to jax.lax at CALL time (so
+# tracing shims like tools/bench_scaling.CollectiveRecorder still see the
+# call) and rides the collective watchdog, which — when obs is enabled —
+# emits ``collective.calls`` / ``collective.bytes`` counters labeled by op
+# (psum, reduce_scatter, all_gather).  The counters are TRACE-TIME
+# accounting: one increment per traced call site, with nbytes = the bytes
+# each device RECEIVES per execution of that site (psum: the full reduced
+# array; reduce_scatter: the 1/D slice; all_gather: the D-fold result) —
+# i.e. per-pass wire volume, the quantity the MULTICHIP comms ledger and
+# ``python -m tools.obs report`` track.  The analyzer's COL004 rule points
+# full-histogram ``lax.psum`` call sites at these helpers.
+# ---------------------------------------------------------------------------
+
+
+def device_psum(x, axis_name):
+    """``lax.psum`` under the collective watchdog + byte accounting."""
+    from jax import lax
+
+    with obs.collective_watchdog("psum") as wd:
+        out = lax.psum(x, axis_name)
+        wd.attrs["nbytes"] = _leaf_nbytes(out)
+    return out
+
+
+def device_psum_scatter(x, axis_name, scatter_dimension: int = 0,
+                        tiled: bool = True):
+    """``lax.psum_scatter``: reduce + scatter contiguous blocks of
+    ``scatter_dimension`` over the mesh axis — each device receives the
+    fully-reduced values for its 1/D block (``tiled=True`` keeps the axis
+    in place at size/D).  The block size must divide the axis size; callers
+    pad (the booster right-pads feature columns)."""
+    from jax import lax
+
+    with obs.collective_watchdog("reduce_scatter") as wd:
+        out = lax.psum_scatter(
+            x, axis_name, scatter_dimension=scatter_dimension, tiled=tiled
+        )
+        wd.attrs["nbytes"] = _leaf_nbytes(out)
+    return out
+
+
+def device_all_gather(x, axis_name, **kw):
+    """``lax.all_gather`` under the collective watchdog + byte accounting."""
+    from jax import lax
+
+    with obs.collective_watchdog("all_gather") as wd:
+        out = lax.all_gather(x, axis_name, **kw)
+        wd.attrs["nbytes"] = _leaf_nbytes(out)
+    return out
+
+
 def host_allgather(arr) -> "np.ndarray":
     """Allgather a SMALL host array across processes → (nproc, *shape).
 

@@ -358,6 +358,153 @@ class TestDataParallelTraining:
         assert np.mean(np.abs(serial.predict(X) - dist.predict(X))) < 1e-3
 
 
+class TestReduceScatterMerge:
+    """ISSUE 4: hist_merge="reduce_scatter" — feature-sliced histogram
+    merge + per-node candidate allgather.  Same replication contract as
+    allreduce (identical gathered candidates → identical argmax on every
+    shard), so the gates are the existing data-parallel drift tolerances.
+    """
+
+    def test_reduce_scatter_matches_serial_and_allreduce(self):
+        X, y = _make_binary()
+        params = dict(objective="binary", num_iterations=15, num_leaves=15,
+                      min_data_in_leaf=5, tree_learner="data")
+        bm = BinMapper(max_bin=63).fit(X)
+        serial = train(dict(params, tree_learner="serial"), Dataset(X, y),
+                       bin_mapper=bm)
+        ar = train(dict(params, hist_merge="allreduce"), Dataset(X, y),
+                   bin_mapper=bm)
+        rs = train(dict(params, hist_merge="reduce_scatter"), Dataset(X, y),
+                   bin_mapper=bm)
+        ps, pa, pr = serial.predict(X), ar.predict(X), rs.predict(X)
+        assert np.mean(np.abs(pr - ps)) < 1e-3
+        assert np.mean(np.abs(pr - pa)) < 1e-3
+        assert abs(_auc(y, pr) - _auc(y, ps)) < 5e-3
+        assert _auc(y, pr) > 0.9
+
+    def test_auto_resolves_to_reduce_scatter_on_mesh(self):
+        # the benchmarked default path: a bare tree_learner="data"
+        # depthwise train lands on reduce_scatter whenever the mesh is
+        # real (D>1, F>=2D) and the windowed grower is the resolved path
+        X, y = _make_binary()
+        b = train(dict(objective="binary", num_iterations=5, num_leaves=15,
+                       min_data_in_leaf=5, tree_learner="data",
+                       grow_policy="depthwise"),
+                  Dataset(X, y))
+        assert b.config.hist_merge == "reduce_scatter"
+        # serial training never touches a mesh → allreduce (inert)
+        s = train(dict(objective="binary", num_iterations=2, num_leaves=7),
+                  Dataset(*_make_binary(n=512, F=4, seed=2)))
+        assert s.config.hist_merge == "allreduce"
+
+    def test_resolve_auto_config_rule(self):
+        import dataclasses
+
+        from mmlspark_tpu.engine.booster import TrainConfig, resolve_auto_config
+
+        cfg = TrainConfig(tree_learner="data", grow_policy="depthwise")
+        r = lambda **kw: resolve_auto_config(  # noqa: E731
+            cfg, n=1000, backend="cpu", **kw
+        ).hist_merge
+        assert r(num_devices=8, num_features=64) == "reduce_scatter"
+        assert r(num_devices=1, num_features=64) == "allreduce"
+        assert r(num_devices=8, num_features=15) == "allreduce"  # F < 2D
+        for tl in ("voting", "feature"):
+            assert resolve_auto_config(
+                dataclasses.replace(cfg, tree_learner=tl),
+                n=1000, backend="cpu", num_devices=8, num_features=64,
+            ).hist_merge == "allreduce"
+        # exact-sequence lossguide (split_batch=0 on the CPU backend)
+        # never auto-flips: the windowed grower can reorder near-tie
+        # splits, which auto must not do behind the user's back...
+        lg = dataclasses.replace(cfg, grow_policy="lossguide")
+        assert resolve_auto_config(
+            lg, n=1000, backend="cpu", num_devices=8, num_features=64,
+        ).hist_merge == "allreduce"
+        # ...but the TPU auto-batched lossguide (split_batch=8) is already
+        # windowed, so reduce_scatter is the default there
+        assert resolve_auto_config(
+            lg, n=1000, backend="tpu", num_devices=8, num_features=64,
+        ).hist_merge == "reduce_scatter"
+        # explicit settings pass through untouched
+        assert resolve_auto_config(
+            dataclasses.replace(cfg, hist_merge="allreduce"),
+            n=1000, backend="cpu", num_devices=8, num_features=64,
+        ).hist_merge == "allreduce"
+        with pytest.raises(ValueError, match="hist_merge"):
+            resolve_auto_config(
+                dataclasses.replace(cfg, hist_merge="ring"),
+                n=1000, backend="cpu",
+            )
+
+    def test_feature_count_not_divisible_by_shards(self):
+        # F=13 on 8 shards pads to 16; padded columns masked out of every
+        # local slice's candidate search, global feature ids preserved
+        X, y = _make_binary(n=2048, F=13, seed=21)
+        params = dict(objective="binary", num_iterations=10, num_leaves=15,
+                      min_data_in_leaf=5)
+        bm = BinMapper(max_bin=63).fit(X)
+        serial = train(dict(params), Dataset(X, y), bin_mapper=bm)
+        rs = train(dict(params, tree_learner="data",
+                        hist_merge="reduce_scatter"),
+                   Dataset(X, y), bin_mapper=bm)
+        assert np.mean(np.abs(rs.predict(X) - serial.predict(X))) < 1e-3
+        feats = np.asarray(rs.trees.split_feat)[
+            np.asarray(rs.trees.split_leaf) >= 0
+        ]
+        assert (feats < 13).all()
+
+    def test_bf16_wire_under_reduce_scatter(self):
+        # hist_psum_dtype="bfloat16" composes: the scatter runs on the
+        # bf16 wire, split scan on the f32 upcast (same contract as psum)
+        X, y = _make_binary(n=4096, F=8, seed=13)
+        params = dict(objective="binary", num_iterations=10, num_leaves=15,
+                      min_data_in_leaf=5, tree_learner="data",
+                      hist_merge="reduce_scatter")
+        bm = BinMapper(max_bin=63).fit(X)
+        f32 = train(dict(params), Dataset(X, y), bin_mapper=bm)
+        bf16 = train(dict(params, hist_psum_dtype="bfloat16"),
+                     Dataset(X, y), bin_mapper=bm)
+        assert abs(_auc(y, f32.predict(X)) - _auc(y, bf16.predict(X))) < 5e-3
+
+    def test_categoricals_under_reduce_scatter(self):
+        # membership splits: the owning shard's merged slice is psum-
+        # broadcast so every shard routes rows identically
+        rng = np.random.default_rng(22)
+        n = 2048
+        Xn = rng.normal(size=(n, 6))
+        c0 = rng.integers(0, 9, size=n)
+        c1 = rng.integers(0, 5, size=n)
+        logits = (Xn[:, 0] - 0.8 * Xn[:, 1] + 1.2 * np.isin(c0, [2, 5])
+                  - 0.7 * (c1 == 3))
+        y = (logits + rng.normal(scale=0.4, size=n) > 0).astype(np.float64)
+        X = np.column_stack([Xn, c0.astype(np.float64), c1.astype(np.float64)])
+        params = dict(objective="binary", num_iterations=10, num_leaves=15,
+                      min_data_in_leaf=5, categorical_feature=[6, 7])
+        bm = BinMapper(max_bin=63, categorical_features=(6, 7)).fit(X)
+        serial = train(dict(params), Dataset(X, y), bin_mapper=bm)
+        rs = train(dict(params, tree_learner="data",
+                        hist_merge="reduce_scatter"),
+                   Dataset(X, y), bin_mapper=bm)
+        assert np.mean(np.abs(rs.predict(X) - serial.predict(X))) < 1e-3
+        assert _auc(y, rs.predict(X)) > 0.9
+        assert bool(np.asarray(rs.trees.split_cat).any())
+
+    def test_lossguide_under_reduce_scatter(self):
+        # lossguide routes through the windowed grower (split_batch=1 when
+        # unset — the winner exchange lives there), preserving LightGBM's
+        # exact leaf-wise split sequence
+        X, y = _make_binary(n=2048, F=16, seed=23)
+        params = dict(objective="binary", num_iterations=10, num_leaves=15,
+                      min_data_in_leaf=5, grow_policy="lossguide")
+        bm = BinMapper(max_bin=63).fit(X)
+        serial = train(dict(params), Dataset(X, y), bin_mapper=bm)
+        rs = train(dict(params, tree_learner="data",
+                        hist_merge="reduce_scatter"),
+                   Dataset(X, y), bin_mapper=bm)
+        assert np.mean(np.abs(rs.predict(X) - serial.predict(X))) < 1e-3
+
+
 class TestRendezvous:
     def test_barrier_context_roundtrip(self, monkeypatch):
         from mmlspark_tpu.parallel import barrier_context_from_env

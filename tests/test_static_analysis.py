@@ -316,6 +316,61 @@ def test_col003_rank_pinned_guard(tmp_path):
     assert rules(check_collectives_file(p)) == ["COL003"]
 
 
+def test_col004_full_histogram_psum(tmp_path):
+    # the pre-ISSUE-4 merge shape: every device receives all F×B floats
+    p = _write(str(tmp_path / "m.py"), """
+        from jax import lax
+        def merge(hist, axis_name):
+            return lax.psum(hist, axis_name)
+    """)
+    assert rules(check_collectives_file(p)) == ["COL004"]
+
+
+def test_col004_bare_name_and_derived_operand(tmp_path):
+    p = _write(str(tmp_path / "m.py"), """
+        from jax.lax import psum
+        def merge(hists_local, axis_name):
+            return psum(hists_local.astype("bfloat16"), axis_name)
+    """)
+    assert rules(check_collectives_file(p)) == ["COL004"]
+
+
+def test_col004_silent_on_sanctioned_paths(tmp_path):
+    # the reduce-scatter helper, non-histogram psums, and psum_scatter
+    # itself are all fine
+    p = _write(str(tmp_path / "m.py"), """
+        from jax import lax
+        def merge(hist, grad_tot, axis_name):
+            a = device_psum_scatter(hist, axis_name, scatter_dimension=1)
+            b = lax.psum(grad_tot, axis_name)
+            c = lax.psum_scatter(hist, axis_name, scatter_dimension=1)
+            d = device_psum(hist, axis_name)
+            return a, b, c, d
+    """)
+    assert check_collectives_file(p) == []
+
+
+def test_col004_suppression(tmp_path):
+    # voting's elected-slice psum: operand is already a reduced slice
+    p = _write(str(tmp_path / "m.py"), """
+        from jax import lax
+        def merge(hists_sel, axis_name):
+            return lax.psum(hists_sel, axis_name)  # analyze: ignore[COL004]
+    """)
+    assert apply_suppressions(check_collectives_file(p)) == []
+
+
+def test_col004_library_voting_site_is_suppressed():
+    # the one sanctioned raw psum-of-histograms in the package carries the
+    # inline suppression; the analyzer stays clean over mmlspark_tpu/
+    import tools.analyze.collectives as col
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(col.__file__)))
+    repo = os.path.dirname(root)
+    found = apply_suppressions(col.check_collectives(repo))
+    assert [f for f in found if f.rule == "COL004"] == []
+
+
 # --------------------------------------------------------- tracer fixtures
 
 

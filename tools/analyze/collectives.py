@@ -24,6 +24,14 @@ Rules
   collectives and deadlock or exchange garbage.
 - COL003: a collective under a rank-PINNED guard
   (``process_index() == 0``-style) — guaranteed single-rank entry.
+- COL004: a full-histogram ``lax.psum`` on node-statistics arrays (the
+  first argument's source mentions ``hist``) in library code.  Since the
+  reduce-scatter merge exists (``parallel.distributed.device_psum_scatter``
+  / ``ops.histogram.merge_shard_histograms``), every device paying for
+  all F×B histogram floats it will immediately argmax away is a comms
+  bug, not a style choice (ISSUE 4; Ke et al. 2017).  Sites that psum an
+  already-reduced slice (e.g. voting's elected features) carry
+  ``# analyze: ignore[COL004]``.
 
 Guards counted for a statement: every enclosing ``if``/ternary test plus
 any earlier same-block ``if`` whose body unconditionally leaves the
@@ -45,6 +53,11 @@ COLLECTIVE_NAMES = {
     "host_allgather", "host_allgather_ragged_rows", "process_allgather",
     "sync_global_devices", "broadcast_one_to_all",
     "reached_preemption_sync_point", "global_barrier",
+    # sanctioned traced device-collective wrappers (parallel/distributed):
+    # COL001-003's guard rules apply to their call sites the same way — a
+    # rank-divergent guard around an in-program collective desyncs the
+    # SPMD program exactly like a host collective hangs the job
+    "device_psum", "device_psum_scatter", "device_all_gather",
 }
 # any attribute reached through these modules is treated as a collective
 COLLECTIVE_MODULES = {"multihost_utils", "mhu"}
@@ -103,7 +116,32 @@ class _Scanner:
         self.findings: list = []
 
     # -- guard bookkeeping ------------------------------------------------
+    def _check_psum_hist(self, call: ast.Call):
+        """COL004: raw ``lax.psum`` of a histogram array (arg source
+        mentions ``hist``) — the reduce-scatter merge moves 1/D the bytes.
+        Only the raw primitive is flagged: ``device_psum`` call sites are
+        the sanctioned wrapper and small-slice psums suppress inline."""
+        fn = call.func
+        is_psum = (isinstance(fn, ast.Name) and fn.id == "psum") or (
+            isinstance(fn, ast.Attribute) and fn.attr == "psum"
+        )
+        if not is_psum or not call.args:
+            return
+        arg_src = ast.unparse(call.args[0]).lower()
+        if "hist" not in arg_src:
+            return
+        self.findings.append(Finding(
+            self.path, call.lineno, "COL004",
+            f"full-histogram lax.psum({ast.unparse(call.args[0])!r}) — "
+            "every device receives all F×B node-statistics floats; use "
+            "parallel.distributed.device_psum_scatter / "
+            "ops.histogram.merge_shard_histograms(merge='reduce_scatter') "
+            "for the feature-sliced merge, or suppress if the operand is "
+            "already a reduced slice",
+        ))
+
     def _check_call(self, call: ast.Call, guards: list):
+        self._check_psum_hist(call)
         name = _collective_name(call)
         if name is None:
             return

@@ -10,16 +10,22 @@ scale.  This tool produces the table BASELINE.md commits:
    one core, so WALL numbers measure collective/overhead growth (the
    shape of the curve), not real ICI speedup — the BYTES are the part
    that predicts v5e-32 behavior.
-2. **Measured collective bytes**: every ``lax.psum``/``all_gather`` the
-   training program actually traces is recorded (shape × dtype at the
-   call site — a tracing shim, so the numbers come from the real program,
-   not a hand formula), scaled by the statically known pass count.  For
-   the bench-shape depthwise config the dominant term is the histogram
-   allreduce: 3·W·F·B floats/pass for data-parallel vs the elected
-   top-2k slices (3·W·2k·B) + votes for voting-parallel.
+2. **Measured collective bytes**: every ``lax.psum`` / ``psum_scatter`` /
+   ``all_gather`` the training program actually traces is recorded as the
+   bytes each device RECEIVES from that call site (result shape × dtype —
+   a tracing shim, so the numbers come from the real program, not a hand
+   formula).  Each in-loop site executes once per grower pass, so the
+   traced bytes ARE the per-pass wire volume.  For the bench-shape
+   depthwise config the dominant term is the histogram merge: 3·W·F·B
+   floats/pass under ``hist_merge="allreduce"`` vs the 3·W·F/D·B slice +
+   a (D, 5, L) candidate all-gather under ``"reduce_scatter"`` (ISSUE 4),
+   vs the elected top-2k slices (3·W·2k·B) + votes for voting-parallel.
+   The ``data`` mode runs the AUTO-resolved default (asserted to be
+   reduce_scatter on a real mesh — the benchmarked configuration IS the
+   default configuration); ``data_allreduce`` pins the old merge so the
+   comms ledger records the measured ratio.
 3. **psum vs psum_scatter microbench** on a histogram-shaped array — the
-   upper bound for a future reduce_scatter split search (each shard
-   electing candidates for its own bin slice).
+   transport-level bound for the reduce-scatter merge.
 
 Usage:  python tools/bench_scaling.py            # full table (spawns children)
         python tools/bench_scaling.py --child D  # one device count (internal)
@@ -48,41 +54,52 @@ def _log(*a):
 
 
 class CollectiveRecorder:
-    """Tracing shim over lax.psum / lax.all_gather: records operand bytes
-    per traced call site.  Numbers reflect the REAL program's collectives
-    (anything the grower adds or removes shows up here unprompted)."""
+    """Tracing shim over lax.psum / lax.psum_scatter / lax.all_gather:
+    records the bytes each device RECEIVES per traced call site (result
+    shape × dtype — psum: the full reduced array; psum_scatter: the 1/D
+    slice; all_gather: the D-fold result).  Numbers reflect the REAL
+    program's collectives (anything the grower adds or removes shows up
+    here unprompted)."""
 
     def __init__(self):
         self.calls = []
+
+    def _record(self, kind, out):
+        import jax
+
+        for leaf in jax.tree_util.tree_leaves(out):
+            self.calls.append((kind, tuple(leaf.shape), str(leaf.dtype),
+                               int(np.prod(leaf.shape)) * leaf.dtype.itemsize))
 
     def __enter__(self):
         from jax import lax
 
         self._lax = lax
         self._psum, self._ag = lax.psum, lax.all_gather
-        rec = self.calls
+        self._pscat = lax.psum_scatter
 
         def psum(x, axis_name, **kw):
-            import jax
-
-            for leaf in jax.tree_util.tree_leaves(x):
-                rec.append(("psum", tuple(leaf.shape), str(leaf.dtype),
-                            int(np.prod(leaf.shape)) * leaf.dtype.itemsize))
-            return self._psum(x, axis_name, **kw)
+            out = self._psum(x, axis_name, **kw)
+            self._record("psum", out)
+            return out
 
         def all_gather(x, axis_name, **kw):
-            import jax
+            out = self._ag(x, axis_name, **kw)
+            self._record("all_gather", out)
+            return out
 
-            for leaf in jax.tree_util.tree_leaves(x):
-                rec.append(("all_gather", tuple(leaf.shape), str(leaf.dtype),
-                            int(np.prod(leaf.shape)) * leaf.dtype.itemsize))
-            return self._ag(x, axis_name, **kw)
+        def psum_scatter(x, axis_name, **kw):
+            out = self._pscat(x, axis_name, **kw)
+            self._record("reduce_scatter", out)
+            return out
 
         self._lax.psum, self._lax.all_gather = psum, all_gather
+        self._lax.psum_scatter = psum_scatter
         return self
 
     def __exit__(self, *exc):
         self._lax.psum, self._lax.all_gather = self._psum, self._ag
+        self._lax.psum_scatter = self._pscat
 
     def summary(self):
         out = {}
@@ -91,6 +108,11 @@ class CollectiveRecorder:
             ent = out.setdefault(key, {"bytes": nbytes, "traced_calls": 0})
             ent["traced_calls"] += 1
         return out
+
+    def total_bytes(self):
+        """Σ received-bytes over every traced call — the per-pass wire
+        volume of the in-loop sites plus one-off setup collectives."""
+        return int(sum(nbytes for _, _, _, nbytes in self.calls))
 
 
 def make_data(n, seed=0):
@@ -109,10 +131,28 @@ def _auc(y, p):
 
 
 def run_child(n_dev: int):
+    # Must run BEFORE jax initializes a backend: newer jax exposes the
+    # device count as a config option; older builds only honor the XLA
+    # host-platform flag (main() also sets it in the child env).
+    import re
+
+    flags = re.sub(r"--xla_force_host_platform_device_count=\d+", "",
+                   os.environ.get("XLA_FLAGS", ""))
+    os.environ["XLA_FLAGS"] = (
+        f"{flags} --xla_force_host_platform_device_count={n_dev}".strip()
+    )
+    # The collective-bytes ledger reads the PYTHON trace — an AOT
+    # trace-cache replay skips tracing and would record zero collectives,
+    # so the bench always re-traces (the compile cache still applies).
+    os.environ["MMLSPARK_TPU_NO_TRACE_CACHE"] = "1"
     import jax
 
     jax.config.update("jax_platforms", "cpu")
-    jax.config.update("jax_num_cpu_devices", n_dev)
+    try:
+        jax.config.update("jax_num_cpu_devices", n_dev)
+    except AttributeError:
+        pass  # old jax: XLA_FLAGS above is the only knob
+    assert jax.device_count() == n_dev, jax.device_count()
 
     from mmlspark_tpu import obs
     from mmlspark_tpu.engine.booster import Dataset, train
@@ -131,9 +171,20 @@ def run_child(n_dev: int):
         max_bin=B - 1, min_data_in_leaf=20, grow_policy="depthwise",
         top_k=TOP_K,
     )
-    results = {"n_devices": n_dev, "rows": n, "modes": {}}
+    results = {
+        "n_devices": n_dev, "rows": n,
+        "mesh_shape": [n_dev] if n_dev > 1 else [],
+        "modes": {},
+    }
+    # "data" is the AUTO default path (resolves to reduce_scatter on a
+    # real mesh — asserted below the same way bench.py pins the other
+    # auto knobs); "data_allreduce" pins the pre-ISSUE-4 merge so the
+    # comms ledger records the measured bytes ratio on identical trees.
     modes = [("data", dict(tree_learner="data")),
+             ("data_allreduce", dict(tree_learner="data",
+                                     hist_merge="allreduce")),
              ("data_bf16wire", dict(tree_learner="data",
+                                    hist_merge="allreduce",
                                     hist_psum_dtype="bfloat16")),
              ("voting", dict(tree_learner="voting"))]
     if n_dev == 1:
@@ -141,13 +192,21 @@ def run_child(n_dev: int):
     for name, extra in modes:
         params = dict(base, **extra)
         with CollectiveRecorder() as rec:
-            train(params, ds, bin_mapper=bm, mesh=mesh)  # compile + trace
+            booster = train(params, ds, bin_mapper=bm, mesh=mesh)  # trace
+        if name == "data" and n_dev > 1:
+            # The benchmarked default IS the default configuration: a bare
+            # tree_learner="data" run must land on the reduce-scatter
+            # merge at this mesh/feature shape without opt-in knobs.
+            assert booster.config.hist_merge == "reduce_scatter", \
+                booster.config.hist_merge
         t0 = time.perf_counter()
         booster = train(params, ds, bin_mapper=bm, mesh=mesh)
         wall = time.perf_counter() - t0
         results["modes"][name] = {
             "steady_wall_s": round(wall, 3),
             "auc": round(_auc(y, booster.predict(X)), 5),
+            "hist_merge": booster.config.hist_merge,
+            "comm_traced_bytes": rec.total_bytes(),
             "collectives": rec.summary(),
         }
 
@@ -193,10 +252,14 @@ def main():
         env = dict(os.environ)
         env["JAX_PLATFORMS"] = "cpu"
         env.pop("JAX_NUM_CPU_DEVICES", None)
-        proc = subprocess.run(
-            [sys.executable, os.path.abspath(__file__), "--child", str(d)],
-            env=env, capture_output=True, text=True, timeout=1200,
-        )
+        try:
+            proc = subprocess.run(
+                [sys.executable, os.path.abspath(__file__), "--child", str(d)],
+                env=env, capture_output=True, text=True, timeout=2700,
+            )
+        except subprocess.TimeoutExpired:
+            _log(f"child D={d} timed out")
+            continue
         if proc.returncode != 0:
             _log(f"child D={d} failed:\n{proc.stderr[-3000:]}")
             continue
@@ -204,15 +267,23 @@ def main():
         _log(f"D={d} done")
     print(json.dumps(rows, indent=1))
     # Human summary table
-    _log("\nD  rows    mode            wall(s)  AUC      hist-allreduce/pass")
+    _log("\nD  rows    mode            wall(s)  AUC     merge           "
+         "comm/pass  dominant collective")
     for r in rows:
         for mode, m in r["modes"].items():
-            hist_key = next(
-                (k for k in m["collectives"] if "psum[3," in k), "-"
+            # Dominant term = the largest single traced collective (the
+            # histogram merge in every mode; keyed psum[...] under
+            # allreduce, reduce_scatter[...] under the ISSUE-4 merge).
+            hist_key = max(
+                m["collectives"],
+                key=lambda k: m["collectives"][k]["bytes"],
+                default="-",
             )
             hb = m["collectives"].get(hist_key, {}).get("bytes", 0)
             _log(f"{r['n_devices']}  {r['rows']:>7} {mode:<15} "
-                 f"{m['steady_wall_s']:>7} {m['auc']:.4f}  "
+                 f"{m['steady_wall_s']:>7} {m['auc']:.4f} "
+                 f"{m['hist_merge']:<15} "
+                 f"{m['comm_traced_bytes']/1e6:>7.2f}MB  "
                  f"{hb/1e6:.2f} MB ({hist_key})")
         if "microbench" in r:
             mb = r["microbench"]

@@ -23,8 +23,9 @@ DOC = os.path.join(REPO, "BASELINE.md")
 BEGIN, END = "<!-- scaling-table:begin -->", "<!-- scaling-table:end -->"
 
 _MODE_LABEL = {
-    "data": "data",
-    "data_bf16wire": "data + bf16 wire",
+    "data": "data (auto merge)",
+    "data_allreduce": "data + allreduce",
+    "data_bf16wire": "data + allreduce + bf16 wire",
     "voting": "voting",
 }
 
@@ -42,9 +43,9 @@ def render() -> str:
     with open(ARTIFACT) as f:
         data = json.load(f)
     lines = [
-        "| D | mode | steady wall | AUC | hist-allreduce bytes/pass "
-        "(traced from the real program) |",
-        "|---|---|---|---|---|",
+        "| D | mode | hist merge | steady wall | AUC | comm bytes/pass "
+        "| dominant collective (traced from the real program) |",
+        "|---|---|---|---|---|---|---|",
     ]
     for entry in data:
         d = entry["n_devices"]
@@ -52,9 +53,13 @@ def render() -> str:
             label = _MODE_LABEL.get(mode, mode)
             if d == 1:
                 label = "serial"
+            merge = r.get("hist_merge", "allreduce")
+            total = r.get("comm_traced_bytes")
+            total_s = f"{total / 1e6:.2f} MB" if total else "—"
             lines.append(
-                f"| {d} | {label} | {r['steady_wall_s']:.1f} s "
-                f"| {r['auc']:.4f} | {_bytes_label(r['collectives'])} |"
+                f"| {d} | {label} | {merge} | {r['steady_wall_s']:.1f} s "
+                f"| {r['auc']:.4f} | {total_s} "
+                f"| {_bytes_label(r['collectives'])} |"
             )
     return "\n".join(lines)
 
