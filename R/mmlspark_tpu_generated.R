@@ -1905,6 +1905,7 @@ ml_k_n_n_model <- function(
 #' @param num_threads Host-side threads for binning (0 = default)
 #' @param objective Training objective
 #' @param parallelism Tree learner parallelism: data_parallel|voting_parallel|serial|feature_parallel
+#' @param predict_backend Predict traversal backend: auto (pallas on TPU, packed elsewhere; re-resolved against the backend each predict runs on) | packed (depth-stepped device-resident node table) | pallas (fused VMEM row-tile kernel, TPU) | pallas_interpret (that kernel interpreted on CPU — tests/parity) | scan (legacy sequential per-tree lax.scan).  All backends score bitwise-identically.
 #' @param prediction_col The name of the prediction column
 #' @param probability_col Class probability output column
 #' @param raw_prediction_col Raw margin output column
@@ -1958,6 +1959,7 @@ ml_light_g_b_m_classification_model <- function(
     num_threads = 0L,
     objective = "regression",
     parallelism = "data_parallel",
+    predict_backend = "auto",
     prediction_col = "prediction",
     probability_col = "probability",
     raw_prediction_col = "rawPrediction",
@@ -2010,6 +2012,7 @@ ml_light_g_b_m_classification_model <- function(
     num_threads = "numThreads",
     objective = "objective",
     parallelism = "parallelism",
+    predict_backend = "predictBackend",
     prediction_col = "predictionCol",
     probability_col = "probabilityCol",
     raw_prediction_col = "rawPredictionCol",
@@ -2069,6 +2072,7 @@ ml_light_g_b_m_classification_model <- function(
 #' @param num_threads Host-side threads for binning (0 = default)
 #' @param objective Training objective
 #' @param parallelism Tree learner parallelism: data_parallel|voting_parallel|serial|feature_parallel
+#' @param predict_backend Predict traversal backend: auto (pallas on TPU, packed elsewhere; re-resolved against the backend each predict runs on) | packed (depth-stepped device-resident node table) | pallas (fused VMEM row-tile kernel, TPU) | pallas_interpret (that kernel interpreted on CPU — tests/parity) | scan (legacy sequential per-tree lax.scan).  All backends score bitwise-identically.
 #' @param prediction_col The name of the prediction column
 #' @param probability_col Class probability output column
 #' @param raw_prediction_col Raw margin output column
@@ -2121,6 +2125,7 @@ ml_light_g_b_m_classifier <- function(
     num_threads = 0L,
     objective = "binary",
     parallelism = "data_parallel",
+    predict_backend = "auto",
     prediction_col = "prediction",
     probability_col = "probability",
     raw_prediction_col = "rawPrediction",
@@ -2172,6 +2177,7 @@ ml_light_g_b_m_classifier <- function(
     num_threads = "numThreads",
     objective = "objective",
     parallelism = "parallelism",
+    predict_backend = "predictBackend",
     prediction_col = "predictionCol",
     probability_col = "probabilityCol",
     raw_prediction_col = "rawPredictionCol",
@@ -2235,6 +2241,7 @@ ml_light_g_b_m_classifier <- function(
 #' @param num_threads Host-side threads for binning (0 = default)
 #' @param objective Training objective
 #' @param parallelism Tree learner parallelism: data_parallel|voting_parallel|serial|feature_parallel
+#' @param predict_backend Predict traversal backend: auto (pallas on TPU, packed elsewhere; re-resolved against the backend each predict runs on) | packed (depth-stepped device-resident node table) | pallas (fused VMEM row-tile kernel, TPU) | pallas_interpret (that kernel interpreted on CPU — tests/parity) | scan (legacy sequential per-tree lax.scan).  All backends score bitwise-identically.
 #' @param prediction_col The name of the prediction column
 #' @param repartition_by_grouping_column Keep each query group within one worker shard
 #' @param seed Master random seed
@@ -2289,6 +2296,7 @@ ml_light_g_b_m_ranker <- function(
     num_threads = 0L,
     objective = "lambdarank",
     parallelism = "data_parallel",
+    predict_backend = "auto",
     prediction_col = "prediction",
     repartition_by_grouping_column = TRUE,
     seed = 0L,
@@ -2342,6 +2350,7 @@ ml_light_g_b_m_ranker <- function(
     num_threads = "numThreads",
     objective = "objective",
     parallelism = "parallelism",
+    predict_backend = "predictBackend",
     prediction_col = "predictionCol",
     repartition_by_grouping_column = "repartitionByGroupingColumn",
     seed = "seed",
@@ -2400,6 +2409,7 @@ ml_light_g_b_m_ranker <- function(
 #' @param num_threads Host-side threads for binning (0 = default)
 #' @param objective Training objective
 #' @param parallelism Tree learner parallelism: data_parallel|voting_parallel|serial|feature_parallel
+#' @param predict_backend Predict traversal backend: auto (pallas on TPU, packed elsewhere; re-resolved against the backend each predict runs on) | packed (depth-stepped device-resident node table) | pallas (fused VMEM row-tile kernel, TPU) | pallas_interpret (that kernel interpreted on CPU — tests/parity) | scan (legacy sequential per-tree lax.scan).  All backends score bitwise-identically.
 #' @param prediction_col The name of the prediction column
 #' @param seed Master random seed
 #' @param slot_names Feature vector slot names
@@ -2450,6 +2460,7 @@ ml_light_g_b_m_ranker_model <- function(
     num_threads = 0L,
     objective = "regression",
     parallelism = "data_parallel",
+    predict_backend = "auto",
     prediction_col = "prediction",
     seed = 0L,
     slot_names = NULL,
@@ -2499,6 +2510,7 @@ ml_light_g_b_m_ranker_model <- function(
     num_threads = "numThreads",
     objective = "objective",
     parallelism = "parallelism",
+    predict_backend = "predictBackend",
     prediction_col = "predictionCol",
     seed = "seed",
     slot_names = "slotNames",
@@ -2556,6 +2568,7 @@ ml_light_g_b_m_ranker_model <- function(
 #' @param num_threads Host-side threads for binning (0 = default)
 #' @param objective Training objective
 #' @param parallelism Tree learner parallelism: data_parallel|voting_parallel|serial|feature_parallel
+#' @param predict_backend Predict traversal backend: auto (pallas on TPU, packed elsewhere; re-resolved against the backend each predict runs on) | packed (depth-stepped device-resident node table) | pallas (fused VMEM row-tile kernel, TPU) | pallas_interpret (that kernel interpreted on CPU — tests/parity) | scan (legacy sequential per-tree lax.scan).  All backends score bitwise-identically.
 #' @param prediction_col The name of the prediction column
 #' @param seed Master random seed
 #' @param slot_names Feature vector slot names
@@ -2606,6 +2619,7 @@ ml_light_g_b_m_regression_model <- function(
     num_threads = 0L,
     objective = "regression",
     parallelism = "data_parallel",
+    predict_backend = "auto",
     prediction_col = "prediction",
     seed = 0L,
     slot_names = NULL,
@@ -2655,6 +2669,7 @@ ml_light_g_b_m_regression_model <- function(
     num_threads = "numThreads",
     objective = "objective",
     parallelism = "parallelism",
+    predict_backend = "predictBackend",
     prediction_col = "predictionCol",
     seed = "seed",
     slot_names = "slotNames",
@@ -2712,6 +2727,7 @@ ml_light_g_b_m_regression_model <- function(
 #' @param num_threads Host-side threads for binning (0 = default)
 #' @param objective Training objective
 #' @param parallelism Tree learner parallelism: data_parallel|voting_parallel|serial|feature_parallel
+#' @param predict_backend Predict traversal backend: auto (pallas on TPU, packed elsewhere; re-resolved against the backend each predict runs on) | packed (depth-stepped device-resident node table) | pallas (fused VMEM row-tile kernel, TPU) | pallas_interpret (that kernel interpreted on CPU — tests/parity) | scan (legacy sequential per-tree lax.scan).  All backends score bitwise-identically.
 #' @param prediction_col The name of the prediction column
 #' @param seed Master random seed
 #' @param slot_names Feature vector slot names
@@ -2763,6 +2779,7 @@ ml_light_g_b_m_regressor <- function(
     num_threads = 0L,
     objective = "regression",
     parallelism = "data_parallel",
+    predict_backend = "auto",
     prediction_col = "prediction",
     seed = 0L,
     slot_names = NULL,
@@ -2813,6 +2830,7 @@ ml_light_g_b_m_regressor <- function(
     num_threads = "numThreads",
     objective = "objective",
     parallelism = "parallelism",
+    predict_backend = "predictBackend",
     prediction_col = "predictionCol",
     seed = "seed",
     slot_names = "slotNames",

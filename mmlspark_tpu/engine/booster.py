@@ -120,6 +120,17 @@ class TrainConfig:
     # interpret mode — orders of magnitude slower).  Without this, the
     # user-facing estimators silently trained on the slow path on TPU.
     hist_backend: str = "auto"
+    # Predict-path traversal backend (ISSUE 5): "packed" = depth-stepped
+    # device-resident node table (engine/forest), "pallas" = fused VMEM
+    # row-tile kernel (ops/pallas_predict, TPU), "pallas_interpret" = that
+    # kernel under the Pallas interpreter on CPU (tests/parity), "scan" =
+    # the legacy sequential per-tree lax.scan.  "auto" resolves the same
+    # way hist_backend does (pallas on a TPU backend, packed elsewhere) —
+    # and is RE-resolved against the backend each predict actually runs
+    # on, so a model trained on TPU serves correctly from a CPU process.
+    # All backends produce bitwise-identical raw scores (the pallas
+    # kernel's one documented -0.0 leaf-value caveat aside).
+    predict_backend: str = "auto"
     # 0 = auto: one chunk (the whole padded row count, capped) under the
     # pallas backend — fewer scan steps; DEFAULT_CHUNK for the
     # memory-bound scatter/onehot builders.
@@ -387,6 +398,15 @@ class Booster:
         self.objective = get_objective(config.objective, **config.objective_params())
         self.evals_result: Dict[str, Dict[str, List[float]]] = {}
         self._predict_cache: Dict[Tuple, callable] = {}
+        # Device-resident predict state, all keyed by T (used iterations)
+        # and built at most once per instance: continued training
+        # constructs a NEW Booster, so per-instance caching needs no
+        # invalidation hook.  None of it enters pickles (__getstate__).
+        self._dev_slices: Dict[int, Tuple[Tree, jnp.ndarray]] = {}
+        self._packed_forests: Dict[int, object] = {}
+        self._pallas_forests: Dict[int, object] = {}
+        self._device_binner = None
+        self._predict_warm: set = set()
 
     def _host_trees(self) -> Tree:
         """Host (numpy) copy of the forest, materialized LAZILY via ONE
@@ -420,11 +440,23 @@ class Booster:
         state["_predict_cache"] = {}
         state.pop("_native_predictor", None)  # ctypes handle: rebuild lazily
         state.pop("_trees_np", None)
+        # device-resident predict caches: rebuild lazily after unpickle
+        state["_dev_slices"] = {}
+        state["_packed_forests"] = {}
+        state["_pallas_forests"] = {}
+        state["_device_binner"] = None
+        state["_predict_warm"] = set()
         state["trees"] = self._host_trees()
         return state
 
     def __setstate__(self, state):
         self.__dict__.update(state)
+        # pickles from before the packed-forest PR lack the predict caches
+        self.__dict__.setdefault("_dev_slices", {})
+        self.__dict__.setdefault("_packed_forests", {})
+        self.__dict__.setdefault("_pallas_forests", {})
+        self.__dict__.setdefault("_device_binner", None)
+        self.__dict__.setdefault("_predict_warm", set())
         self.trees = Tree(*[jnp.asarray(a) for a in self.trees])
 
     # -- introspection ---------------------------------------------------
@@ -487,6 +519,102 @@ class Booster:
     def _slice_trees(self, T: int) -> Tree:
         return Tree(*[a[:T] for a in self.trees])
 
+    def _dev_forest(self, T: int) -> Tuple[Tree, jnp.ndarray]:
+        """Device-resident (trees, weights) slice for the legacy scan
+        path, built ONCE per T.  The seed re-sliced the tree arrays and
+        re-uploaded the f32 weights on every predict call (the per-call
+        forest re-upload bug); repeat predicts now do zero host→device
+        model transfer even on the scan backend."""
+        cached = self._dev_slices.get(T)
+        if cached is None:
+            cached = (
+                Tree(*[jnp.asarray(a[:T]) for a in self.trees]),
+                jnp.asarray(self.tree_weights[:T], dtype=jnp.float32),
+            )
+            self._dev_slices[T] = cached
+        return cached
+
+    def _has_cat_splits(self) -> bool:
+        """Does any tree carry a categorical (membership) split?  Gates
+        the numeric-only pallas predict kernel."""
+        if getattr(self, "_has_cats", None) is None:
+            self._has_cats = bool(
+                getattr(self.config, "categorical_feature", ())
+            ) or bool(np.asarray(self.trees.split_cat).any())
+        return self._has_cats
+
+    def _resolved_predict_backend(self, T: int) -> str:
+        """The backend THIS predict call runs on: config.predict_backend
+        re-resolved against jax.default_backend(), with the pallas kernel
+        additionally gated on its numeric-only + SMEM-budget support."""
+        from mmlspark_tpu.engine.forest import resolve_predict_backend
+        from mmlspark_tpu.ops.pallas_predict import pallas_supported
+
+        requested = getattr(self.config, "predict_backend", "auto") or "auto"
+        resolved = resolve_predict_backend(
+            requested, has_cats=self._has_cat_splits()
+        )
+        if resolved in ("pallas", "pallas_interpret") and not pallas_supported(
+            T, self.num_class, int(self.trees.split_leaf.shape[-1]), False
+        ):
+            resolved = "packed"
+        return resolved
+
+    def _packed_forest(self, T: int):
+        """Device-resident packed SoA node table (engine/forest), built +
+        uploaded once per T and cached."""
+        from mmlspark_tpu.engine import forest as _forest
+
+        pf = self._packed_forests.get(T)
+        if pf is None:
+            pf = _forest.pack_forest(
+                self._host_trees(), self.tree_weights, T,
+                self.bin_mapper.num_bins,
+            )
+            self._packed_forests[T] = pf
+        return pf
+
+    def _pallas_forest(self, T: int):
+        pf = self._pallas_forests.get(T)
+        if pf is None:
+            from mmlspark_tpu.ops.pallas_predict import build_pallas_forest
+
+            pf = build_pallas_forest(self._host_trees(), self.tree_weights, T)
+            self._pallas_forests[T] = pf
+        return pf
+
+    def device_binner(self):
+        """Uploaded-once on-device binning state (ops/device_binning) for
+        the raw-f32-rows serving hot path."""
+        from mmlspark_tpu.ops.device_binning import DeviceBinner
+
+        if getattr(self, "_device_binner", None) is None:
+            self._device_binner = DeviceBinner.from_mapper(self.bin_mapper)
+        return self._device_binner
+
+    def _raw_scores_dispatch(
+        self, bins: jnp.ndarray, T: int, backend: str
+    ) -> jnp.ndarray:
+        """(K, n) raw scores from a binned matrix on the given backend.
+        Every backend runs the identical per-class f32 add sequence
+        (trees in serial order), so outputs are bitwise-equal."""
+        if backend == "scan":
+            trees, weights = self._dev_forest(T)
+            return self._forest_fn(T, "raw")(trees, weights, bins)
+        if backend in ("pallas", "pallas_interpret"):
+            from mmlspark_tpu.ops.pallas_predict import pallas_raw_scores
+
+            return pallas_raw_scores(
+                self._pallas_forest(T), jnp.asarray(bins),
+                self.bin_mapper.num_bins,
+                interpret=backend == "pallas_interpret",
+            )
+        from mmlspark_tpu.engine import forest as _forest
+
+        return _forest.packed_raw_scores(
+            self._packed_forest(T), jnp.asarray(bins)
+        )
+
     def _raw_scores_binned(
         self, bins: jnp.ndarray, num_iteration: Optional[int] = None
     ) -> jnp.ndarray:
@@ -494,9 +622,7 @@ class Booster:
         binning pass — used by warm start, which bins once for training and
         reuses the same matrix here)."""
         T = self._used_iters(num_iteration)
-        trees = self._slice_trees(T)
-        weights = jnp.asarray(self.tree_weights[:T], dtype=jnp.float32)
-        raw = self._forest_fn(T, "raw")(trees, weights, bins)
+        raw = self._raw_scores_dispatch(bins, T, self._resolved_predict_backend(T))
         if self.average_output:
             raw = raw / max(T, 1)
         return raw
@@ -510,22 +636,49 @@ class Booster:
     ) -> np.ndarray:
         """Batch scoring.  Replaces the reference's per-row JNI
         ``LGBM_BoosterPredictForMat`` crossing (SURVEY.md §3.2) with one
-        jitted whole-batch program."""
-        X = np.asarray(X, dtype=np.float64)
-        bins = jnp.asarray(self.bin_mapper.transform(X))
+        jitted whole-batch program.  Binning stays on the host here (the
+        offline float64 contract); the traversal backend is
+        ``config.predict_backend`` re-resolved per call — all backends
+        score bitwise-identically."""
+        # API entry: normalize user input to the host f64 contract
+        X = np.asarray(X, dtype=np.float64)  # analyze: ignore[PRED001]
+        n = X.shape[0]
         T = self._used_iters(num_iteration)
-        if pred_leaf:
-            trees = self._slice_trees(T)
-            weights = jnp.asarray(self.tree_weights[:T], dtype=jnp.float32)
-            leaves = self._forest_fn(T, "leaf")(trees, weights, bins)
-            out = np.asarray(leaves)  # (K, T, n)
-            K, _, n = out.shape
-            return out.transpose(2, 1, 0).reshape(n, T * K)
-        raw = np.asarray(self._raw_scores_binned(bins, num_iteration))  # (K, n)
-        if raw_score:
-            return raw[0] if raw.shape[0] == 1 else raw.T
-        tr = np.asarray(self.objective.transform(jnp.asarray(raw)))
-        return tr[0] if tr.shape[0] == 1 else tr.T
+        backend = self._resolved_predict_backend(T)
+        kind = "leaf" if pred_leaf else "raw"
+        key = (kind, backend, T, n)
+        cold = key not in self._predict_warm
+        t0 = time.perf_counter()
+        with obs.span("predict", rows=n, backend=backend, cold=cold):
+            bins = jnp.asarray(self.bin_mapper.transform(X))
+            if pred_leaf:
+                if backend == "scan":
+                    trees, weights = self._dev_forest(T)
+                    leaves = self._forest_fn(T, "leaf")(trees, weights, bins)
+                else:
+                    from mmlspark_tpu.engine import forest as _forest
+
+                    leaves = _forest.packed_leaf_indices(
+                        self._packed_forest(T), bins
+                    )
+                # API exit: host ndarray is the return contract
+                out = np.asarray(leaves)  # analyze: ignore[PRED001]
+                K, _, _ = out.shape
+                out = out.transpose(2, 1, 0).reshape(n, T * K)
+            else:
+                raw = self._raw_scores_dispatch(bins, T, backend)
+                if self.average_output:
+                    raw = raw / max(T, 1)
+                if not raw_score:
+                    raw = self.objective.transform(raw)
+                # API exit: host ndarray is the return contract
+                out = np.asarray(raw)  # analyze: ignore[PRED001]
+                out = out[0] if out.shape[0] == 1 else out.T
+        self._predict_warm.add(key)
+        elapsed = time.perf_counter() - t0
+        if obs.enabled() and elapsed > 0:
+            obs.gauge("predict.rows_per_s", n / elapsed, backend=backend)
+        return out
 
     def predict_padded(
         self,
@@ -542,12 +695,63 @@ class Booster:
         fresh program for every distinct row count (the compile churn
         that kills the naive fixed-batch loop under variable traffic).
         Returns predictions for the real rows only.
+
+        On the packed/pallas backends this is the RESIDENT hot path: the
+        batch is shipped as raw **float32** rows and binned on device
+        (ops/device_binning — f64-exact boundary compares for every
+        f32-representable input), so nothing touches the host BinMapper
+        and the model/bin-edge uploads happened once at build time.  The
+        f32 row contract is the serving interface (serve/README.md);
+        inputs carrying float64 precision beyond f32 round to it here.
+        The scan backend keeps the seed's host-binned f64 path.
         """
-        out = self.predict(
-            np.asarray(X, dtype=np.float64),
-            raw_score=raw_score,
-            num_iteration=num_iteration,
+        T = self._used_iters(num_iteration)
+        backend = self._resolved_predict_backend(T)
+        if backend == "scan":
+            out = self.predict(
+                np.asarray(X, dtype=np.float64),  # analyze: ignore[PRED001]
+                raw_score=raw_score,
+                num_iteration=num_iteration,
+            )
+            return out[: int(n_valid)]
+        # API entry: the serving wire contract is raw f32 rows
+        rows = jnp.asarray(
+            np.ascontiguousarray(X, dtype=np.float32)  # analyze: ignore[PRED001]
         )
+        key = ("padded", backend, T, rows.shape[0], bool(raw_score))
+        cold = key not in self._predict_warm
+        t0 = time.perf_counter()
+        with obs.span(
+            "predict", rows=int(n_valid), bucket=int(rows.shape[0]),
+            backend=backend, cold=cold,
+        ):
+            if backend in ("pallas", "pallas_interpret"):
+                from mmlspark_tpu.ops.pallas_predict import pallas_raw_scores
+
+                bins = self.device_binner().transform(rows)
+                raw = pallas_raw_scores(
+                    self._pallas_forest(T), bins, self.bin_mapper.num_bins,
+                    interpret=backend == "pallas_interpret",
+                )
+            else:
+                from mmlspark_tpu.engine import forest as _forest
+
+                raw = _forest.packed_raw_scores_rows(
+                    self._packed_forest(T), self.device_binner(), rows
+                )
+            if self.average_output:
+                raw = raw / max(T, 1)
+            if not raw_score:
+                raw = self.objective.transform(raw)
+            # API exit: host ndarray is the return contract
+            out = np.asarray(raw)  # analyze: ignore[PRED001]
+            out = out[0] if out.shape[0] == 1 else out.T
+        self._predict_warm.add(key)
+        elapsed = time.perf_counter() - t0
+        if obs.enabled() and elapsed > 0:
+            obs.gauge(
+                "predict.rows_per_s", int(n_valid) / elapsed, backend=backend
+            )
         return out[: int(n_valid)]
 
     def prewarm_predict(
@@ -793,6 +997,16 @@ def resolve_auto_config(
             cfg,
             hist_backend="pallas" if backend == "tpu" else "scatter",
         )
+    if cfg.predict_backend == "auto":
+        # Same shape as hist_backend: the fused Pallas kernel on TPU, the
+        # depth-stepped packed-node-table path elsewhere.  Predict-time
+        # code re-resolves against jax.default_backend() again
+        # (engine/forest.resolve_predict_backend) so a TPU-trained config
+        # degrades gracefully on a CPU serving host.
+        cfg = dataclasses.replace(
+            cfg,
+            predict_backend="pallas" if backend == "tpu" else "packed",
+        )
     if cfg.hist_chunk == 0:
         if cfg.hist_backend == "pallas":
             # One chunk when it fits (fewer scan steps; the kernel's grid
@@ -934,7 +1148,8 @@ def _hashable(v):
 # reuse the compiled program (scan length retraces by shape anyway).
 _CACHE_KEY_EXCLUDE = frozenset(
     {"num_iterations", "checkpoint_dir", "checkpoint_every", "verbosity",
-     "metric", "early_stopping_round", "scan_dispatch_iters"}
+     "metric", "early_stopping_round", "scan_dispatch_iters",
+     "predict_backend"}
 )
 
 

@@ -1,0 +1,413 @@
+"""Device-resident packed-forest inference (ISSUE 5 tentpole).
+
+The seed predict path (``Booster._forest_fn``) walks the forest with a
+sequential ``lax.scan`` over T trees — O(T) *dependent* device steps for a
+50–500 tree forest, each step replaying that tree's full split list.  This
+module flattens the trained forest ONCE into a contiguous SoA **node
+table** (the RAPIDS-FIL / Treelite layout, adapted to the replay-format
+trees the grower emits) and traverses it **depth-stepped and
+forest-parallel**: one gather per depth level advances all (rows × trees)
+cursors simultaneously — O(max_depth) parallel steps instead of O(T)
+sequential scans — while the final weighted accumulation stays a serial
+fold over trees so raw scores are **bitwise identical** to the scan path
+(same f32 add order per class: trees in serial order, ``acc + w·v``).
+
+Node table (one slot per internal node AND per leaf, all T×K trees
+concatenated, per-tree root offsets).  Nodes are numbered **BFS with
+sibling adjacency** — each internal node's two children occupy
+consecutive slots — and the traversal fields are bit-packed into two
+int32 words so one level step costs THREE gathers (``nav``, ``ft``, the
+bin column) instead of six (the gathers are the memory-bound cost on
+every backend):
+
+- ``nav`` int32 — ``child_base << 2 | is_cat << 1 | default_left``;
+  ``child = child_base + !go_left`` (left child at ``base``, right
+  sibling at ``base + 1``).  Leaves carry ``child_base == self`` and
+  always route left, so traversal past a leaf is a no-op and a single
+  static ``max_depth`` loop serves every tree;
+- ``ft``  int32 — ``feat << 16 | thr`` (split feature id + bin
+  threshold; ``bin <= thr`` goes left — leaves store a sentinel ``thr``
+  that every bin satisfies);
+- ``catrow`` int32 — row into the packed ``(C, B)`` membership table
+  (row 0 is all-False so non-cat gathers stay in bounds);
+- ``leafv`` f32 — leaf value (internal nodes: 0);
+- ``leafid`` int32 — LightGBM leaf index (for ``pred_leaf`` parity).
+
+Build happens on the host from the booster's packed-fetched tree arrays
+(one transfer — see ``Booster._host_trees``), uploads once, and the device
+arrays are cached per ``(booster, T)`` so repeat predicts do **zero**
+host→device model transfer.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from mmlspark_tpu import obs
+
+
+class PackedArrays(NamedTuple):
+    """The device-resident SoA node table (a pytree of arrays)."""
+
+    nav: jnp.ndarray       # (N,) int32: child_base<<2 | is_cat<<1 | dleft
+    ft: jnp.ndarray        # (N,) int32: feat<<16 | thr
+    catrow: jnp.ndarray    # (N,) int32
+    leafv: jnp.ndarray     # (N,) float32
+    leafid: jnp.ndarray    # (N,) int32
+    root: jnp.ndarray      # (T*K,) int32
+    weight: jnp.ndarray    # (T,) float32
+    cat_table: jnp.ndarray  # (C, B) bool; row 0 all-False
+
+
+@dataclasses.dataclass(frozen=True)
+class PackedForest:
+    """One flattened forest: device node table + static traversal meta."""
+
+    arrays: PackedArrays
+    num_trees: int      # T (iterations)
+    num_class: int      # K (models per iteration)
+    max_depth: int      # deepest leaf across the whole forest
+    num_bins: int       # incl. the missing bin
+    has_cats: bool
+    nbytes: int         # uploaded bytes (node table + cat table + roots)
+
+
+def _pack_one_tree(sl, sf, sb, dl, sc, ct, lv, n_leaves):
+    """Node rows for ONE tree from its replay-format split arrays.
+
+    Replay semantics (``tree._replay_leaf_ids``): rows start in leaf 0;
+    step ``s`` (active iff ``split_leaf[s] >= 0``) splits leaf
+    ``split_leaf[s]``, keeping the left child in the parent's leaf slot
+    and assigning the right child leaf id ``s+1``.  The topology is
+    therefore recoverable exactly: the child of an internal node is the
+    NEXT active step that splits the child's leaf id, else the terminal
+    leaf itself.  Returns dict of numpy columns + (root_local, depth).
+    """
+    S = sl.shape[0]
+    active = np.nonzero(sl >= 0)[0]
+    n_int = len(active)
+    L_used = max(int(n_leaves), 1)
+    iid = {int(s): i for i, s in enumerate(active)}  # step -> internal slot
+
+    n_nodes = n_int + L_used
+    feat = np.zeros(n_nodes, np.int32)
+    thr = np.zeros(n_nodes, np.int16)
+    dleft = np.zeros(n_nodes, bool)
+    iscat = np.zeros(n_nodes, bool)
+    cat_rows = []                      # (node_idx, (B,) membership) pairs
+    left = np.arange(n_nodes, dtype=np.int32)   # leaves self-loop
+    right = np.arange(n_nodes, dtype=np.int32)
+    leafv = np.zeros(n_nodes, np.float32)
+    leafid = np.zeros(n_nodes, np.int32)
+    depth = np.zeros(n_nodes, np.int32)
+
+    leafv[n_int:] = lv[:L_used]
+    leafid[n_int:] = np.arange(L_used, dtype=np.int32)
+
+    # next active split of each leaf AFTER step s: fill children walking
+    # the active steps in reverse, so ``nxt`` always holds the next-after.
+    nxt = np.full(S + 1, -1, np.int64)  # leaf id -> next step splitting it
+    left_step = np.full(n_int, -1, np.int64)
+    right_step = np.full(n_int, -1, np.int64)
+    for i in range(n_int - 1, -1, -1):
+        s = int(active[i])
+        l = int(sl[s])
+        left_step[i] = nxt[l]
+        right_step[i] = nxt[s + 1]
+        nxt[l] = s
+    root_local = iid[int(nxt[0])] if nxt[0] >= 0 else n_int  # leaf 0
+
+    for i in range(n_int):
+        s = int(active[i])
+        feat[i] = sf[s]
+        thr[i] = sb[s]
+        dleft[i] = bool(dl[s])
+        if bool(sc[s]):
+            iscat[i] = True
+            cat_rows.append((i, np.asarray(ct[s], bool)))
+        l = int(sl[s])
+        left[i] = iid[int(left_step[i])] if left_step[i] >= 0 else n_int + l
+        right[i] = (
+            iid[int(right_step[i])] if right_step[i] >= 0 else n_int + s + 1
+        )
+
+    # depth via forward pass over internal nodes: children of step s can
+    # only be split by LATER steps, so step order is topological.
+    depth[root_local] = 0
+    for i in range(n_int):
+        depth[left[i]] = depth[i] + 1
+        depth[right[i]] = depth[i] + 1
+    max_depth = int(depth.max()) if n_nodes else 0
+
+    # Sibling-adjacent BFS renumbering: the root takes slot 0 and each
+    # internal node's children take the next two consecutive slots, so
+    # the traversal replaces separate left/right gathers with one
+    # ``child_base`` (left at base, right at base+1).
+    order = np.empty(n_nodes, np.int64)
+    pos = np.empty(n_nodes, np.int64)
+    order[0] = root_local
+    pos[root_local] = 0
+    filled, qi = 1, 0
+    while qi < filled:
+        v = int(order[qi])
+        qi += 1
+        if left[v] != v:
+            for c in (int(left[v]), int(right[v])):
+                pos[c] = filled
+                order[filled] = c
+                filled += 1
+    assert filled == n_nodes  # every node reachable from the root
+
+    is_leaf = left[order] == order
+    child_base = np.empty(n_nodes, np.int32)
+    child_base[is_leaf] = np.nonzero(is_leaf)[0]          # self-loop
+    child_base[~is_leaf] = pos[left[order[~is_leaf]]]
+    feat, thr = feat[order], thr[order]
+    dleft, iscat = dleft[order], iscat[order]
+    leafv, leafid = leafv[order], leafid[order]
+    # leaves always route LEFT (child = base + 0 = self): a threshold
+    # every bin satisfies, and default-left for the missing bin
+    thr[is_leaf] = np.int16(0x7FFF)
+    dleft[is_leaf] = True
+    cat_rows = [(int(pos[i]), members) for i, members in cat_rows]
+
+    return {
+        "feat": feat, "thr": thr, "dleft": dleft, "iscat": iscat,
+        "child_base": child_base, "leafv": leafv, "leafid": leafid,
+        "cat_rows": cat_rows, "root": 0, "depth": max_depth,
+    }
+
+
+def pack_forest(host_trees, tree_weights, T: int, num_bins: int) -> PackedForest:
+    """Flatten ``host_trees`` (numpy ``Tree`` arrays with (T, K, ...) axes,
+    already truncated or truncatable to ``T`` iterations) into one
+    device-resident :class:`PackedForest`."""
+    sl = np.asarray(host_trees.split_leaf)[:T]      # (T, K, S)
+    sf = np.asarray(host_trees.split_feat)[:T]
+    sb = np.asarray(host_trees.split_bin)[:T]
+    dl = np.asarray(host_trees.default_left)[:T]
+    sc = np.asarray(host_trees.split_cat)[:T]
+    ct = np.asarray(host_trees.cat_threshold)[:T]   # (T, K, S, B)
+    lv = np.asarray(host_trees.leaf_value)[:T]      # (T, K, L)
+    nl = np.asarray(host_trees.num_leaves)[:T]      # (T, K)
+    K = sl.shape[1]
+    B = ct.shape[-1] if ct.ndim == 4 else num_bins
+
+    cols = {k: [] for k in
+            ("feat", "thr", "dleft", "iscat", "child_base",
+             "leafv", "leafid")}
+    catrow_col = []
+    cat_table = [np.zeros(B, bool)]  # row 0: all-False for non-cat nodes
+    roots = np.zeros(T * K, np.int32)
+    offset, max_depth = 0, 0
+    for t in range(T):
+        for k in range(K):
+            one = _pack_one_tree(
+                sl[t, k], sf[t, k], sb[t, k], dl[t, k], sc[t, k],
+                ct[t, k], lv[t, k], nl[t, k],
+            )
+            n = one["feat"].shape[0]
+            catrow = np.zeros(n, np.int32)
+            for idx, members in one["cat_rows"]:
+                catrow[idx] = len(cat_table)
+                cat_table.append(members)
+            catrow_col.append(catrow)
+            for key in cols:
+                a = one[key]
+                if key == "child_base":
+                    a = a + offset
+                cols[key].append(a)
+            roots[t * K + k] = offset + one["root"]
+            max_depth = max(max_depth, one["depth"])
+            offset += n
+
+    cat_np = np.stack(cat_table, axis=0)
+    feat = np.concatenate(cols["feat"]).astype(np.int32)
+    thr = np.concatenate(cols["thr"]).astype(np.int32)
+    base = np.concatenate(cols["child_base"]).astype(np.int64)
+    iscat_np = np.concatenate(cols["iscat"])
+    dleft_np = np.concatenate(cols["dleft"])
+    # bit-packing headroom: feat shares an int32 with thr, child_base
+    # shifts by 2 — both hold for any realistic forest, asserted anyway
+    assert feat.max(initial=0) < (1 << 15) and num_bins <= (1 << 15)
+    assert offset < (1 << 29), "node table too large for nav packing"
+    np_arrays = dict(
+        nav=((base << 2) | (iscat_np.astype(np.int64) << 1)
+             | dleft_np.astype(np.int64)).astype(np.int32),
+        ft=((feat << 16) | (thr & 0xFFFF)).astype(np.int32),
+        catrow=np.concatenate(catrow_col),
+        leafv=np.concatenate(cols["leafv"]),
+        leafid=np.concatenate(cols["leafid"]),
+        root=roots,
+        weight=np.asarray(tree_weights[:T], np.float32),
+        cat_table=cat_np,
+    )
+    nbytes = sum(a.nbytes for a in np_arrays.values())
+    has_cats = bool(cat_np.shape[0] > 1)
+    with obs.span("predict.pack_forest", trees=T, k=K, nodes=int(offset)):
+        arrays = PackedArrays(**{k: jnp.asarray(v) for k, v in np_arrays.items()})
+    if obs.enabled():
+        obs.inc("predict.packed_builds")
+        obs.inc("predict.packed_upload_bytes", float(nbytes))
+    return PackedForest(
+        arrays=arrays, num_trees=T, num_class=K, max_depth=max_depth,
+        num_bins=num_bins, has_cats=has_cats, nbytes=nbytes,
+    )
+
+
+# ---------------------------------------------------------------------------
+# Depth-stepped traversal (the lax backend; also the pallas parity oracle)
+# ---------------------------------------------------------------------------
+def _leaf_cursors(a: PackedArrays, bins, *, depth: int, num_bins: int,
+                  has_cats: bool):
+    """(n, T·K) node cursors after ``depth`` parallel level steps — every
+    cursor rests on its leaf (leaves self-loop)."""
+    n = bins.shape[0]
+    bins_i = bins.astype(jnp.int32)
+    cur0 = jnp.broadcast_to(a.root[None, :], (n, a.root.shape[0]))
+
+    def level(_, cur):
+        ft = a.ft[cur]                                    # (n, TT)
+        nav = a.nav[cur]                                  # (n, TT)
+        b = jnp.take_along_axis(bins_i, ft >> 16, axis=1)  # (n, TT)
+        miss = b == num_bins - 1
+        go_left = jnp.where(miss, (nav & 1) == 1, b <= (ft & 0xFFFF))
+        if has_cats:
+            go_left = jnp.where(
+                (nav & 2) == 2, a.cat_table[a.catrow[cur], b], go_left
+            )
+        # sibling adjacency: left child at base, right at base + 1
+        return (nav >> 2) + jnp.where(go_left, 0, 1)
+
+    return lax.fori_loop(0, depth, level, cur0)
+
+
+@partial(jax.jit, static_argnames=("T", "K", "depth", "num_bins", "has_cats"))
+def _packed_raw(a: PackedArrays, bins, *, T: int, K: int, depth: int,
+                num_bins: int, has_cats: bool):
+    """(K, n) raw scores, bitwise-equal to the scan path: the per-class
+    accumulation is a serial fold over trees in t order (``acc + w·v``,
+    f32), exactly the add sequence ``Booster._forest_fn`` runs."""
+    n = bins.shape[0]
+    cur = _leaf_cursors(a, bins, depth=depth, num_bins=num_bins,
+                        has_cats=has_cats)
+    vals = a.leafv[cur]                                   # (n, T*K)
+    v = vals.reshape(n, T, K).transpose(1, 2, 0)          # (T, K, n)
+
+    def body(acc, tw):
+        tree_v, w = tw
+        return acc + w * tree_v, None
+
+    out, _ = lax.scan(body, jnp.zeros((K, n), jnp.float32), (v, a.weight))
+    return out
+
+
+@partial(jax.jit, static_argnames=("T", "K", "depth", "num_bins", "has_cats"))
+def _packed_leaf(a: PackedArrays, bins, *, T: int, K: int, depth: int,
+                 num_bins: int, has_cats: bool):
+    """(K, T, n) LightGBM leaf indices (``pred_leaf`` layout parity)."""
+    n = bins.shape[0]
+    cur = _leaf_cursors(a, bins, depth=depth, num_bins=num_bins,
+                        has_cats=has_cats)
+    lids = a.leafid[cur]                                  # (n, T*K)
+    return lids.reshape(n, T, K).transpose(2, 1, 0)
+
+
+def packed_raw_scores(pf: PackedForest, bins) -> jnp.ndarray:
+    return _packed_raw(
+        pf.arrays, bins, T=pf.num_trees, K=pf.num_class,
+        depth=pf.max_depth, num_bins=pf.num_bins, has_cats=pf.has_cats,
+    )
+
+
+def packed_leaf_indices(pf: PackedForest, bins) -> jnp.ndarray:
+    return _packed_leaf(
+        pf.arrays, bins, T=pf.num_trees, K=pf.num_class,
+        depth=pf.max_depth, num_bins=pf.num_bins, has_cats=pf.has_cats,
+    )
+
+
+# ---------------------------------------------------------------------------
+# Fused on-device binning + traversal (the serving hot path: raw f32 rows
+# in, raw scores out, nothing touches the host BinMapper)
+# ---------------------------------------------------------------------------
+@partial(jax.jit, static_argnames=(
+    "T", "K", "depth", "num_bins", "has_cats", "missing_bin", "n_bounds"))
+def _packed_raw_rows(a: PackedArrays, binner_arrays, rows, *, T: int, K: int,
+                     depth: int, num_bins: int, has_cats: bool,
+                     missing_bin: int, n_bounds: int):
+    from mmlspark_tpu.ops.device_binning import bin_rows_device
+
+    bins = bin_rows_device(
+        binner_arrays, rows, missing_bin=missing_bin, n_bounds=n_bounds
+    )
+    cur = _leaf_cursors(a, bins, depth=depth, num_bins=num_bins,
+                        has_cats=has_cats)
+    vals = a.leafv[cur]
+    v = vals.reshape(rows.shape[0], T, K).transpose(1, 2, 0)
+
+    def body(acc, tw):
+        tree_v, w = tw
+        return acc + w * tree_v, None
+
+    out, _ = lax.scan(
+        body, jnp.zeros((K, rows.shape[0]), jnp.float32), (v, a.weight)
+    )
+    return out
+
+
+def packed_raw_scores_rows(pf: PackedForest, device_binner, rows) -> jnp.ndarray:
+    """(K, n) raw scores straight from raw float32 rows — the resident
+    serving entry (device binning prologue + depth-stepped traversal in
+    ONE jitted program)."""
+    return _packed_raw_rows(
+        pf.arrays, device_binner.arrays, rows, T=pf.num_trees,
+        K=pf.num_class, depth=pf.max_depth, num_bins=pf.num_bins,
+        has_cats=pf.has_cats, missing_bin=device_binner.missing_bin,
+        n_bounds=device_binner.n_bounds,
+    )
+
+
+# ---------------------------------------------------------------------------
+# Predict-backend resolution (the hist_backend="auto" pattern)
+# ---------------------------------------------------------------------------
+def resolve_predict_backend(
+    requested: str,
+    jax_backend: Optional[str] = None,
+    has_cats: bool = False,
+) -> str:
+    """Resolve the ``predict_backend`` knob against the backend predict
+    actually runs on.
+
+    - ``"auto"`` → ``"pallas"`` on a TPU backend, ``"packed"`` elsewhere
+      (compiled pallas is TPU-only; on CPU the depth-stepped lax path is
+      already the parallel formulation).
+    - ``"pallas"`` → falls back to ``"packed"`` off-TPU (models trained on
+      TPU carry the resolved value but may be served on CPU) and for
+      categorical forests (the kernel is numeric-only; the lax path is
+      the documented fallback + parity oracle).
+    - ``"pallas_interpret"`` → the kernel under the Pallas interpreter on
+      CPU — debugging/parity spelling, never auto-picked.
+    - ``"packed"`` / ``"scan"`` → as named.
+    """
+    if requested not in ("auto", "packed", "pallas", "pallas_interpret", "scan"):
+        raise ValueError(
+            f"predict_backend must be one of auto|packed|pallas|"
+            f"pallas_interpret|scan, got {requested!r}"
+        )
+    be = jax_backend if jax_backend is not None else jax.default_backend()
+    resolved = requested
+    if resolved == "auto":
+        resolved = "pallas" if be == "tpu" else "packed"
+    if resolved == "pallas" and (be != "tpu" or has_cats):
+        resolved = "packed"
+    if resolved == "pallas_interpret" and has_cats:
+        resolved = "packed"
+    return resolved

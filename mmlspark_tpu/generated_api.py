@@ -1178,6 +1178,7 @@ class LightGBMClassificationModel(_LightGBMClassificationModel):
       numThreads: Host-side threads for binning (0 = default)
       objective: Training objective
       parallelism: Tree learner parallelism: data_parallel|voting_parallel|serial|feature_parallel
+      predictBackend: Predict traversal backend: auto (pallas on TPU, packed elsewhere; re-resolved against the backend each predict runs on) | packed (depth-stepped device-resident node table) | pallas (fused VMEM row-tile kernel, TPU) | pallas_interpret (that kernel interpreted on CPU — tests/parity) | scan (legacy sequential per-tree lax.scan).  All backends score bitwise-identically.
       predictionCol: The name of the prediction column
       probabilityCol: Class probability output column
       rawPredictionCol: Raw margin output column
@@ -1193,7 +1194,7 @@ class LightGBMClassificationModel(_LightGBMClassificationModel):
       weightCol: The name of the sample-weight column
     """
 
-    def __init__(self, *, baggingFraction=1.0, baggingFreq=0, baggingSeed=3, boostFromAverage=True, booster=_UNSET, boostingType='gbdt', categoricalSlotIndexes=None, categoricalSlotNames=None, defaultListenPort=12400, deviceType='tpu', driverListenPort=0, earlyStoppingRound=0, featureFraction=1.0, featuresCol='features', growPolicy='lossguide', histMerge='auto', initScoreCol=_UNSET, isProvideTrainingMetric=False, isUnbalance=False, labelCol='label', lambdaL1=0.0, lambdaL2=0.0, leafPredictionCol='', learningRate=0.1, matrixType='auto', maxBin=255, maxDepth=-1, metric='', minDataInLeaf=20, minSumHessianInLeaf=0.001, modelString='', numBatches=0, numIterations=100, numLeaves=31, numTasks=0, numThreads=0, objective='regression', parallelism='data_parallel', predictionCol='prediction', probabilityCol='probability', rawPredictionCol='rawPrediction', seed=0, slotNames=None, splitBatch=0, thresholds=None, timeout=1200.0, topK=20, useBarrierExecutionMode=False, validationIndicatorCol=_UNSET, verbosity=1, weightCol=_UNSET):
+    def __init__(self, *, baggingFraction=1.0, baggingFreq=0, baggingSeed=3, boostFromAverage=True, booster=_UNSET, boostingType='gbdt', categoricalSlotIndexes=None, categoricalSlotNames=None, defaultListenPort=12400, deviceType='tpu', driverListenPort=0, earlyStoppingRound=0, featureFraction=1.0, featuresCol='features', growPolicy='lossguide', histMerge='auto', initScoreCol=_UNSET, isProvideTrainingMetric=False, isUnbalance=False, labelCol='label', lambdaL1=0.0, lambdaL2=0.0, leafPredictionCol='', learningRate=0.1, matrixType='auto', maxBin=255, maxDepth=-1, metric='', minDataInLeaf=20, minSumHessianInLeaf=0.001, modelString='', numBatches=0, numIterations=100, numLeaves=31, numTasks=0, numThreads=0, objective='regression', parallelism='data_parallel', predictBackend='auto', predictionCol='prediction', probabilityCol='probability', rawPredictionCol='rawPrediction', seed=0, slotNames=None, splitBatch=0, thresholds=None, timeout=1200.0, topK=20, useBarrierExecutionMode=False, validationIndicatorCol=_UNSET, verbosity=1, weightCol=_UNSET):
         kw = {k: v for k, v in locals().items()
               if k not in ('self', '__class__') and v is not _UNSET}
         super().__init__(**kw)
@@ -1240,6 +1241,7 @@ class LightGBMClassifier(_LightGBMClassifier):
       numThreads: Host-side threads for binning (0 = default)
       objective: Training objective
       parallelism: Tree learner parallelism: data_parallel|voting_parallel|serial|feature_parallel
+      predictBackend: Predict traversal backend: auto (pallas on TPU, packed elsewhere; re-resolved against the backend each predict runs on) | packed (depth-stepped device-resident node table) | pallas (fused VMEM row-tile kernel, TPU) | pallas_interpret (that kernel interpreted on CPU — tests/parity) | scan (legacy sequential per-tree lax.scan).  All backends score bitwise-identically.
       predictionCol: The name of the prediction column
       probabilityCol: Class probability output column
       rawPredictionCol: Raw margin output column
@@ -1255,7 +1257,7 @@ class LightGBMClassifier(_LightGBMClassifier):
       weightCol: The name of the sample-weight column
     """
 
-    def __init__(self, *, baggingFraction=1.0, baggingFreq=0, baggingSeed=3, boostFromAverage=True, boostingType='gbdt', categoricalSlotIndexes=None, categoricalSlotNames=None, defaultListenPort=12400, deviceType='tpu', driverListenPort=0, earlyStoppingRound=0, featureFraction=1.0, featuresCol='features', growPolicy='lossguide', histMerge='auto', initScoreCol=_UNSET, isProvideTrainingMetric=False, isUnbalance=False, labelCol='label', lambdaL1=0.0, lambdaL2=0.0, leafPredictionCol='', learningRate=0.1, matrixType='auto', maxBin=255, maxDepth=-1, metric='', minDataInLeaf=20, minSumHessianInLeaf=0.001, modelString='', numBatches=0, numIterations=100, numLeaves=31, numTasks=0, numThreads=0, objective='binary', parallelism='data_parallel', predictionCol='prediction', probabilityCol='probability', rawPredictionCol='rawPrediction', seed=0, slotNames=None, splitBatch=0, thresholds=None, timeout=1200.0, topK=20, useBarrierExecutionMode=False, validationIndicatorCol=_UNSET, verbosity=1, weightCol=_UNSET):
+    def __init__(self, *, baggingFraction=1.0, baggingFreq=0, baggingSeed=3, boostFromAverage=True, boostingType='gbdt', categoricalSlotIndexes=None, categoricalSlotNames=None, defaultListenPort=12400, deviceType='tpu', driverListenPort=0, earlyStoppingRound=0, featureFraction=1.0, featuresCol='features', growPolicy='lossguide', histMerge='auto', initScoreCol=_UNSET, isProvideTrainingMetric=False, isUnbalance=False, labelCol='label', lambdaL1=0.0, lambdaL2=0.0, leafPredictionCol='', learningRate=0.1, matrixType='auto', maxBin=255, maxDepth=-1, metric='', minDataInLeaf=20, minSumHessianInLeaf=0.001, modelString='', numBatches=0, numIterations=100, numLeaves=31, numTasks=0, numThreads=0, objective='binary', parallelism='data_parallel', predictBackend='auto', predictionCol='prediction', probabilityCol='probability', rawPredictionCol='rawPrediction', seed=0, slotNames=None, splitBatch=0, thresholds=None, timeout=1200.0, topK=20, useBarrierExecutionMode=False, validationIndicatorCol=_UNSET, verbosity=1, weightCol=_UNSET):
         kw = {k: v for k, v in locals().items()
               if k not in ('self', '__class__') and v is not _UNSET}
         super().__init__(**kw)
@@ -1306,6 +1308,7 @@ class LightGBMRanker(_LightGBMRanker):
       numThreads: Host-side threads for binning (0 = default)
       objective: Training objective
       parallelism: Tree learner parallelism: data_parallel|voting_parallel|serial|feature_parallel
+      predictBackend: Predict traversal backend: auto (pallas on TPU, packed elsewhere; re-resolved against the backend each predict runs on) | packed (depth-stepped device-resident node table) | pallas (fused VMEM row-tile kernel, TPU) | pallas_interpret (that kernel interpreted on CPU — tests/parity) | scan (legacy sequential per-tree lax.scan).  All backends score bitwise-identically.
       predictionCol: The name of the prediction column
       repartitionByGroupingColumn: Keep each query group within one worker shard
       seed: Master random seed
@@ -1319,7 +1322,7 @@ class LightGBMRanker(_LightGBMRanker):
       weightCol: The name of the sample-weight column
     """
 
-    def __init__(self, *, baggingFraction=1.0, baggingFreq=0, baggingSeed=3, boostFromAverage=True, boostingType='gbdt', categoricalSlotIndexes=None, categoricalSlotNames=None, defaultListenPort=12400, deviceType='tpu', driverListenPort=0, earlyStoppingRound=0, evalAt=[1, 2, 3, 4, 5], featureFraction=1.0, featuresCol='features', groupCol='group', growPolicy='lossguide', histMerge='auto', initScoreCol=_UNSET, isProvideTrainingMetric=False, isUnbalance=False, labelCol='label', labelGain=None, lambdaL1=0.0, lambdaL2=0.0, leafPredictionCol='', learningRate=0.1, matrixType='auto', maxBin=255, maxDepth=-1, maxPosition=20, metric='', minDataInLeaf=20, minSumHessianInLeaf=0.001, modelString='', numBatches=0, numIterations=100, numLeaves=31, numTasks=0, numThreads=0, objective='lambdarank', parallelism='data_parallel', predictionCol='prediction', repartitionByGroupingColumn=True, seed=0, slotNames=None, splitBatch=0, timeout=1200.0, topK=20, useBarrierExecutionMode=False, validationIndicatorCol=_UNSET, verbosity=1, weightCol=_UNSET):
+    def __init__(self, *, baggingFraction=1.0, baggingFreq=0, baggingSeed=3, boostFromAverage=True, boostingType='gbdt', categoricalSlotIndexes=None, categoricalSlotNames=None, defaultListenPort=12400, deviceType='tpu', driverListenPort=0, earlyStoppingRound=0, evalAt=[1, 2, 3, 4, 5], featureFraction=1.0, featuresCol='features', groupCol='group', growPolicy='lossguide', histMerge='auto', initScoreCol=_UNSET, isProvideTrainingMetric=False, isUnbalance=False, labelCol='label', labelGain=None, lambdaL1=0.0, lambdaL2=0.0, leafPredictionCol='', learningRate=0.1, matrixType='auto', maxBin=255, maxDepth=-1, maxPosition=20, metric='', minDataInLeaf=20, minSumHessianInLeaf=0.001, modelString='', numBatches=0, numIterations=100, numLeaves=31, numTasks=0, numThreads=0, objective='lambdarank', parallelism='data_parallel', predictBackend='auto', predictionCol='prediction', repartitionByGroupingColumn=True, seed=0, slotNames=None, splitBatch=0, timeout=1200.0, topK=20, useBarrierExecutionMode=False, validationIndicatorCol=_UNSET, verbosity=1, weightCol=_UNSET):
         kw = {k: v for k, v in locals().items()
               if k not in ('self', '__class__') and v is not _UNSET}
         super().__init__(**kw)
@@ -1367,6 +1370,7 @@ class LightGBMRankerModel(_LightGBMRankerModel):
       numThreads: Host-side threads for binning (0 = default)
       objective: Training objective
       parallelism: Tree learner parallelism: data_parallel|voting_parallel|serial|feature_parallel
+      predictBackend: Predict traversal backend: auto (pallas on TPU, packed elsewhere; re-resolved against the backend each predict runs on) | packed (depth-stepped device-resident node table) | pallas (fused VMEM row-tile kernel, TPU) | pallas_interpret (that kernel interpreted on CPU — tests/parity) | scan (legacy sequential per-tree lax.scan).  All backends score bitwise-identically.
       predictionCol: The name of the prediction column
       seed: Master random seed
       slotNames: Feature vector slot names
@@ -1379,7 +1383,7 @@ class LightGBMRankerModel(_LightGBMRankerModel):
       weightCol: The name of the sample-weight column
     """
 
-    def __init__(self, *, baggingFraction=1.0, baggingFreq=0, baggingSeed=3, boostFromAverage=True, booster=_UNSET, boostingType='gbdt', categoricalSlotIndexes=None, categoricalSlotNames=None, defaultListenPort=12400, deviceType='tpu', driverListenPort=0, earlyStoppingRound=0, featureFraction=1.0, featuresCol='features', growPolicy='lossguide', histMerge='auto', initScoreCol=_UNSET, isProvideTrainingMetric=False, isUnbalance=False, labelCol='label', lambdaL1=0.0, lambdaL2=0.0, leafPredictionCol='', learningRate=0.1, matrixType='auto', maxBin=255, maxDepth=-1, metric='', minDataInLeaf=20, minSumHessianInLeaf=0.001, modelString='', numBatches=0, numIterations=100, numLeaves=31, numTasks=0, numThreads=0, objective='regression', parallelism='data_parallel', predictionCol='prediction', seed=0, slotNames=None, splitBatch=0, timeout=1200.0, topK=20, useBarrierExecutionMode=False, validationIndicatorCol=_UNSET, verbosity=1, weightCol=_UNSET):
+    def __init__(self, *, baggingFraction=1.0, baggingFreq=0, baggingSeed=3, boostFromAverage=True, booster=_UNSET, boostingType='gbdt', categoricalSlotIndexes=None, categoricalSlotNames=None, defaultListenPort=12400, deviceType='tpu', driverListenPort=0, earlyStoppingRound=0, featureFraction=1.0, featuresCol='features', growPolicy='lossguide', histMerge='auto', initScoreCol=_UNSET, isProvideTrainingMetric=False, isUnbalance=False, labelCol='label', lambdaL1=0.0, lambdaL2=0.0, leafPredictionCol='', learningRate=0.1, matrixType='auto', maxBin=255, maxDepth=-1, metric='', minDataInLeaf=20, minSumHessianInLeaf=0.001, modelString='', numBatches=0, numIterations=100, numLeaves=31, numTasks=0, numThreads=0, objective='regression', parallelism='data_parallel', predictBackend='auto', predictionCol='prediction', seed=0, slotNames=None, splitBatch=0, timeout=1200.0, topK=20, useBarrierExecutionMode=False, validationIndicatorCol=_UNSET, verbosity=1, weightCol=_UNSET):
         kw = {k: v for k, v in locals().items()
               if k not in ('self', '__class__') and v is not _UNSET}
         super().__init__(**kw)
@@ -1427,6 +1431,7 @@ class LightGBMRegressionModel(_LightGBMRegressionModel):
       numThreads: Host-side threads for binning (0 = default)
       objective: Training objective
       parallelism: Tree learner parallelism: data_parallel|voting_parallel|serial|feature_parallel
+      predictBackend: Predict traversal backend: auto (pallas on TPU, packed elsewhere; re-resolved against the backend each predict runs on) | packed (depth-stepped device-resident node table) | pallas (fused VMEM row-tile kernel, TPU) | pallas_interpret (that kernel interpreted on CPU — tests/parity) | scan (legacy sequential per-tree lax.scan).  All backends score bitwise-identically.
       predictionCol: The name of the prediction column
       seed: Master random seed
       slotNames: Feature vector slot names
@@ -1439,7 +1444,7 @@ class LightGBMRegressionModel(_LightGBMRegressionModel):
       weightCol: The name of the sample-weight column
     """
 
-    def __init__(self, *, baggingFraction=1.0, baggingFreq=0, baggingSeed=3, boostFromAverage=True, booster=_UNSET, boostingType='gbdt', categoricalSlotIndexes=None, categoricalSlotNames=None, defaultListenPort=12400, deviceType='tpu', driverListenPort=0, earlyStoppingRound=0, featureFraction=1.0, featuresCol='features', growPolicy='lossguide', histMerge='auto', initScoreCol=_UNSET, isProvideTrainingMetric=False, isUnbalance=False, labelCol='label', lambdaL1=0.0, lambdaL2=0.0, leafPredictionCol='', learningRate=0.1, matrixType='auto', maxBin=255, maxDepth=-1, metric='', minDataInLeaf=20, minSumHessianInLeaf=0.001, modelString='', numBatches=0, numIterations=100, numLeaves=31, numTasks=0, numThreads=0, objective='regression', parallelism='data_parallel', predictionCol='prediction', seed=0, slotNames=None, splitBatch=0, timeout=1200.0, topK=20, useBarrierExecutionMode=False, validationIndicatorCol=_UNSET, verbosity=1, weightCol=_UNSET):
+    def __init__(self, *, baggingFraction=1.0, baggingFreq=0, baggingSeed=3, boostFromAverage=True, booster=_UNSET, boostingType='gbdt', categoricalSlotIndexes=None, categoricalSlotNames=None, defaultListenPort=12400, deviceType='tpu', driverListenPort=0, earlyStoppingRound=0, featureFraction=1.0, featuresCol='features', growPolicy='lossguide', histMerge='auto', initScoreCol=_UNSET, isProvideTrainingMetric=False, isUnbalance=False, labelCol='label', lambdaL1=0.0, lambdaL2=0.0, leafPredictionCol='', learningRate=0.1, matrixType='auto', maxBin=255, maxDepth=-1, metric='', minDataInLeaf=20, minSumHessianInLeaf=0.001, modelString='', numBatches=0, numIterations=100, numLeaves=31, numTasks=0, numThreads=0, objective='regression', parallelism='data_parallel', predictBackend='auto', predictionCol='prediction', seed=0, slotNames=None, splitBatch=0, timeout=1200.0, topK=20, useBarrierExecutionMode=False, validationIndicatorCol=_UNSET, verbosity=1, weightCol=_UNSET):
         kw = {k: v for k, v in locals().items()
               if k not in ('self', '__class__') and v is not _UNSET}
         super().__init__(**kw)
@@ -1487,6 +1492,7 @@ class LightGBMRegressor(_LightGBMRegressor):
       numThreads: Host-side threads for binning (0 = default)
       objective: Training objective
       parallelism: Tree learner parallelism: data_parallel|voting_parallel|serial|feature_parallel
+      predictBackend: Predict traversal backend: auto (pallas on TPU, packed elsewhere; re-resolved against the backend each predict runs on) | packed (depth-stepped device-resident node table) | pallas (fused VMEM row-tile kernel, TPU) | pallas_interpret (that kernel interpreted on CPU — tests/parity) | scan (legacy sequential per-tree lax.scan).  All backends score bitwise-identically.
       predictionCol: The name of the prediction column
       seed: Master random seed
       slotNames: Feature vector slot names
@@ -1500,7 +1506,7 @@ class LightGBMRegressor(_LightGBMRegressor):
       weightCol: The name of the sample-weight column
     """
 
-    def __init__(self, *, alpha=0.9, baggingFraction=1.0, baggingFreq=0, baggingSeed=3, boostFromAverage=True, boostingType='gbdt', categoricalSlotIndexes=None, categoricalSlotNames=None, defaultListenPort=12400, deviceType='tpu', driverListenPort=0, earlyStoppingRound=0, featureFraction=1.0, featuresCol='features', growPolicy='lossguide', histMerge='auto', initScoreCol=_UNSET, isProvideTrainingMetric=False, isUnbalance=False, labelCol='label', lambdaL1=0.0, lambdaL2=0.0, leafPredictionCol='', learningRate=0.1, matrixType='auto', maxBin=255, maxDepth=-1, metric='', minDataInLeaf=20, minSumHessianInLeaf=0.001, modelString='', numBatches=0, numIterations=100, numLeaves=31, numTasks=0, numThreads=0, objective='regression', parallelism='data_parallel', predictionCol='prediction', seed=0, slotNames=None, splitBatch=0, timeout=1200.0, topK=20, tweedieVariancePower=1.5, useBarrierExecutionMode=False, validationIndicatorCol=_UNSET, verbosity=1, weightCol=_UNSET):
+    def __init__(self, *, alpha=0.9, baggingFraction=1.0, baggingFreq=0, baggingSeed=3, boostFromAverage=True, boostingType='gbdt', categoricalSlotIndexes=None, categoricalSlotNames=None, defaultListenPort=12400, deviceType='tpu', driverListenPort=0, earlyStoppingRound=0, featureFraction=1.0, featuresCol='features', growPolicy='lossguide', histMerge='auto', initScoreCol=_UNSET, isProvideTrainingMetric=False, isUnbalance=False, labelCol='label', lambdaL1=0.0, lambdaL2=0.0, leafPredictionCol='', learningRate=0.1, matrixType='auto', maxBin=255, maxDepth=-1, metric='', minDataInLeaf=20, minSumHessianInLeaf=0.001, modelString='', numBatches=0, numIterations=100, numLeaves=31, numTasks=0, numThreads=0, objective='regression', parallelism='data_parallel', predictBackend='auto', predictionCol='prediction', seed=0, slotNames=None, splitBatch=0, timeout=1200.0, topK=20, tweedieVariancePower=1.5, useBarrierExecutionMode=False, validationIndicatorCol=_UNSET, verbosity=1, weightCol=_UNSET):
         kw = {k: v for k, v in locals().items()
               if k not in ('self', '__class__') and v is not _UNSET}
         super().__init__(**kw)

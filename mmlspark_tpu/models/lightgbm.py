@@ -169,6 +169,20 @@ class _LightGBMParams(
         "1 = exact lossguide; -1 = never batch)",
         default=0, dtype=int,
     )
+    predictBackend = Param(
+        "predictBackend",
+        "Predict traversal backend: auto (pallas on TPU, packed "
+        "elsewhere; re-resolved against the backend each predict runs "
+        "on) | packed (depth-stepped device-resident node table) | "
+        "pallas (fused VMEM row-tile kernel, TPU) | pallas_interpret "
+        "(that kernel interpreted on CPU — tests/parity) | scan (legacy "
+        "sequential per-tree lax.scan).  All backends score "
+        "bitwise-identically.",
+        default="auto", dtype=str,
+        validator=ParamValidators.inList(
+            ["auto", "packed", "pallas", "pallas_interpret", "scan"]
+        ),
+    )
 
     def _train_params(self, num_class: int = 1) -> dict:
         """Flatten the param surface into the engine's LightGBM-vocabulary
@@ -213,6 +227,7 @@ class _LightGBMParams(
         p["hist_merge"] = self.getHistMerge()
         p["grow_policy"] = self.getGrowPolicy()
         p["split_batch"] = self.getSplitBatch()
+        p["predict_backend"] = self.getPredictBackend()
         p["num_threads"] = self.getNumThreads()
         if self.getMatrixType() == "sparse":
             import warnings
@@ -407,7 +422,17 @@ class _LightGBMModel(Model, _LightGBMParams):
         return self
 
     def getBooster(self):
-        return self.getOrDefault("booster")
+        b = self.getOrDefault("booster")
+        if b is not None and self.isSet("predictBackend"):
+            # An explicitly-set model param overrides the backend the
+            # booster was trained with (e.g. force scan for an A/B check
+            # or pallas_interpret for a CPU parity run).
+            import dataclasses
+
+            want = self.getPredictBackend()
+            if getattr(b.config, "predict_backend", "auto") != want:
+                b.config = dataclasses.replace(b.config, predict_backend=want)
+        return b
 
     # -- reference Booster API (SURVEY.md §2.3) --------------------------
     def getFeatureImportances(self, importance_type: str = "split") -> List[float]:

@@ -1,0 +1,152 @@
+"""On-device quantile binning: raw f32 rows → bin ids, inside XLA.
+
+The serving hot path (ISSUE 5) must transfer raw ``f32`` rows and never
+touch the host :class:`~mmlspark_tpu.ops.binning.BinMapper` — so the bin
+boundaries are uploaded ONCE as device arrays and the searchsorted runs
+as a fused prologue of the packed-forest predict program.
+
+Exactness.  The host transform searches **float64** boundaries
+(``np.searchsorted(upper_bounds[f], v, side="left")`` = count of bounds
+strictly below ``v``), but TPUs want f32.  Storing boundaries rounded to
+f32 would mis-bin values that land between a boundary and its f32
+rounding.  We instead store each f64 boundary ``u`` as a **double-single
+pair** ``hi = f32(u)``, ``lo = f32(u - f64(hi))`` and compare with
+
+    u < v   ⟺   (hi < v) | ((hi == v) & (lo < 0))
+
+which reproduces the f64 ordering EXACTLY for every f32-representable
+``v`` (the serving input dtype; ``|u - hi| ≤ ulp(hi)/2`` so ``u < v``
+with ``hi ≥ v`` forces ``hi == v`` and ``lo < 0``).  ``lo`` is zeroed
+where ``hi`` is ±inf (``inf - inf`` is NaN).
+
+Categorical features share the table: their rows hold the sorted raw
+category values (same double-single encoding), the search finds the
+insertion point, and a hit requires exact equality (``hi == v`` and
+``lo == 0``) — unseen categories and non-integral inputs fall to the
+missing bin, matching the host's int64 exact-match.  The host truncates
+cat columns toward zero (``col.astype(np.int64)``) before matching, so
+the device applies ``trunc`` to cat columns first.  Category values must
+be f32-representable (|v| < 2**24) for device/host parity — beyond that
+the device conservatively yields the missing bin.
+
+The search itself is a **branchless power-of-two lower bound**: rows are
+padded to ``P = 2**ceil(log2(U+1))`` with +inf (≥1 pad guarantees the
+count fits in ``P-1``), then ``log2(P)`` predicated gather steps resolve
+all (rows × features) positions in lockstep — no data-dependent control
+flow, fully fusable into the traversal program.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from mmlspark_tpu import obs
+from mmlspark_tpu.ops.binning import BinMapper
+
+
+class DeviceBinnerArrays(NamedTuple):
+    """Device-resident boundary table (a pytree of arrays)."""
+
+    hi: jnp.ndarray     # (F, P) float32 — f32(boundary)
+    lo: jnp.ndarray     # (F, P) float32 — f32(boundary - f64(hi))
+    iscat: jnp.ndarray  # (F,) bool
+
+
+@dataclasses.dataclass(frozen=True)
+class DeviceBinner:
+    """Uploaded-once binning state + static search metadata."""
+
+    arrays: DeviceBinnerArrays
+    num_features: int
+    missing_bin: int
+    n_bounds: int  # P: padded power-of-two row length
+    nbytes: int
+
+    @staticmethod
+    def from_mapper(bm: BinMapper) -> "DeviceBinner":
+        F = bm.num_features
+        cat_set = set(bm.categorical_features)
+        rows = []
+        for f in range(F):
+            if f in cat_set:
+                rows.append(np.asarray(
+                    bm.cat_maps.get(f, np.empty(0, np.int64)), np.float64))
+            else:
+                rows.append(np.asarray(bm.upper_bounds[f], np.float64))
+        max_len = max((len(r) for r in rows), default=0)
+        P = 1 << int(np.ceil(np.log2(max_len + 1))) if max_len else 1
+        table = np.full((F, P), np.inf, np.float64)
+        for f, r in enumerate(rows):
+            table[f, : len(r)] = r
+        hi = table.astype(np.float32)
+        finite = np.isfinite(hi)
+        lo = np.zeros_like(table)
+        np.subtract(table, hi.astype(np.float64), out=lo, where=finite)
+        lo = lo.astype(np.float32)
+        iscat = np.zeros(F, bool)
+        for f in cat_set:
+            if 0 <= f < F:
+                iscat[f] = True
+        nbytes = hi.nbytes + lo.nbytes + iscat.nbytes
+        with obs.span("predict.upload_bin_edges", features=F, padded=P):
+            arrays = DeviceBinnerArrays(
+                hi=jnp.asarray(hi), lo=jnp.asarray(lo), iscat=jnp.asarray(iscat)
+            )
+        if obs.enabled():
+            obs.inc("predict.binner_uploads")
+            obs.inc("predict.binner_upload_bytes", float(nbytes))
+        return DeviceBinner(
+            arrays=arrays, num_features=F, missing_bin=bm.missing_bin,
+            n_bounds=P, nbytes=nbytes,
+        )
+
+    def transform(self, rows) -> jnp.ndarray:
+        """(n, F) raw float rows → (n, F) int32 bin ids (jitted)."""
+        return _transform(
+            self.arrays, jnp.asarray(rows, jnp.float32),
+            missing_bin=self.missing_bin, n_bounds=self.n_bounds,
+        )
+
+
+def bin_rows_device(a: DeviceBinnerArrays, rows, *, missing_bin: int,
+                    n_bounds: int) -> jnp.ndarray:
+    """Trace-time body: (n, F) f32 rows → (n, F) int32 bins.
+
+    Callable from inside other jitted programs (the fused packed-forest
+    entry) — ``n_bounds`` (P) and ``missing_bin`` must be static.
+    """
+    v_raw = rows.astype(jnp.float32)
+    # host cat matching truncates toward zero (col.astype(np.int64))
+    v = jnp.where(a.iscat[None, :], jnp.trunc(v_raw), v_raw)
+
+    farange = jnp.arange(a.hi.shape[0])[None, :]            # (1, F)
+    pos = jnp.zeros(v.shape, jnp.int32)
+    step = n_bounds // 2
+    while step >= 1:
+        nxt = pos + step
+        h = a.hi[farange, nxt - 1]
+        l = a.lo[farange, nxt - 1]
+        # f64-exact "boundary < v" via the double-single pair
+        below = (h < v) | ((h == v) & (l < 0))
+        pos = jnp.where(below, nxt, pos)
+        step //= 2
+
+    # categorical: exact-match hit at the insertion point, else missing
+    h_at = a.hi[farange, pos]
+    l_at = a.lo[farange, pos]
+    hit = (h_at == v) & (l_at == 0) & jnp.isfinite(v)
+    cat_bins = jnp.where(hit, pos, missing_bin)
+
+    bins = jnp.where(a.iscat[None, :], cat_bins, pos)
+    return jnp.where(jnp.isnan(v_raw), missing_bin, bins).astype(jnp.int32)
+
+
+@partial(jax.jit, static_argnames=("missing_bin", "n_bounds"))
+def _transform(a: DeviceBinnerArrays, rows, *, missing_bin: int, n_bounds: int):
+    return bin_rows_device(a, rows, missing_bin=missing_bin, n_bounds=n_bounds)

@@ -1,0 +1,202 @@
+"""Pallas TPU kernel for fused forest inference (ISSUE 5 pallas variant).
+
+The lax packed path (:mod:`mmlspark_tpu.engine.forest`) is depth-stepped:
+each level is one HBM gather over all (rows × trees) cursors.  This
+kernel instead keeps a ROW TILE'S BINS RESIDENT IN VMEM and walks every
+tree over that tile **in-register**, accumulating the weighted leaf sum
+into a (K, bm) accumulator that only touches HBM once per tile — the
+FIL-style "block per row batch" shape, reformulated for the TPU:
+
+- bins arrive transposed (F, n) int32 so a block is (F, bm) with rows on
+  the 128-lane axis; one DMA per tile, every split of every tree then
+  reads its feature row via a SCALAR dynamic slice
+  (``bins_ref[pl.ds(f, 1), :]``) — vector gathers don't lower on TPU, so
+  the kernel replays the grower's split list (leaf-id relabelling)
+  instead of chasing node pointers;
+- per-tree split metadata (feat/threshold/split-leaf/default-left,
+  (TT, S) int32) and weights live in **SMEM** — scalars steering control
+  flow and slice offsets, the blessed Pallas TPU pattern;
+- leaf values (TT, L) f32 sit in VMEM; the per-row leaf value is a
+  one-hot (L, bm) contraction on the MXU at HIGHEST precision — exact
+  f32 (products are v·1 and v·0), with one documented caveat: a leaf
+  value of **-0.0** comes out as +0.0 (the +0·v terms of the sum are
+  +0.0 and (+0.0) + (-0.0) = +0.0).  This only perturbs raw scores when
+  an accumulator is itself ±0.0 at that tree — the parity suite pins the
+  behaviour;
+- the class accumulation uses ``jnp.where(iota_k == k, acc + w·v, acc)``
+  NOT additive masking (adding a masked 0 column would flip -0.0 the
+  same way), so per class the f32 add sequence is exactly the scan
+  path's serial ``acc + w·v`` in tree order — bitwise parity.
+
+Numeric splits only: categorical membership tables are (S, B) bool per
+tree and blow the SMEM budget; forests with cat splits resolve to the
+lax packed path (the documented fallback + parity oracle).  Backends:
+TPU compiled, CPU via the interpreter (tests/parity); anything else
+raises — same contract as :mod:`mmlspark_tpu.ops.pallas_hist`.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def _round_up(x: int, m: int) -> int:
+    return ((x + m - 1) // m) * m
+
+
+# SMEM is ~a few hundred KB/core: four (TT, S) int32 tables + weights
+# must fit with headroom.  Above this entry count the resolver falls
+# back to the lax packed path.
+SMEM_ENTRY_BUDGET = 64 * 1024
+
+
+class PallasForest(NamedTuple):
+    """Device arrays + statics for the replay kernel (host-built once)."""
+
+    feat: jnp.ndarray    # (TT, S) int32
+    thr: jnp.ndarray     # (TT, S) int32
+    sleaf: jnp.ndarray   # (TT, S) int32 (-1 = inactive step)
+    dleft: jnp.ndarray   # (TT, S) int32 (0/1)
+    weight: jnp.ndarray  # (TT, 1) float32 (per-iteration weight, expanded)
+    leafv: jnp.ndarray   # (TT, Lp) float32 (L padded to a lane multiple)
+    num_trees: int       # T
+    num_class: int       # K
+    num_steps: int       # S
+    num_leaves: int      # Lp
+    nbytes: int
+
+
+def pallas_supported(num_trees: int, num_class: int, num_steps: int,
+                     has_cats: bool) -> bool:
+    """Can this forest run on the kernel?  (numeric-only + SMEM budget)"""
+    return (not has_cats) and (
+        num_trees * num_class * num_steps <= SMEM_ENTRY_BUDGET
+    )
+
+
+def build_pallas_forest(host_trees, tree_weights, T: int) -> PallasForest:
+    """Flatten (T, K, ...) replay arrays into the kernel's (TT, ...) SMEM/
+    VMEM layout.  Trees are t-major, k-minor (idx = t·K + k) so the
+    per-class add order matches the scan path exactly."""
+    sl = np.asarray(host_trees.split_leaf)[:T]   # (T, K, S)
+    T_, K, S = sl.shape
+    lv = np.asarray(host_trees.leaf_value)[:T]   # (T, K, L)
+    L = lv.shape[-1]
+    Lp = _round_up(max(L, 1), 128)
+    leafv = np.zeros((T * K, Lp), np.float32)
+    leafv[:, :L] = lv.reshape(T * K, L)
+    w = np.repeat(np.asarray(tree_weights[:T], np.float32), K)[:, None]
+    arrays = dict(
+        feat=np.asarray(host_trees.split_feat)[:T].reshape(T * K, S).astype(np.int32),
+        thr=np.asarray(host_trees.split_bin)[:T].reshape(T * K, S).astype(np.int32),
+        sleaf=sl.reshape(T * K, S).astype(np.int32),
+        dleft=np.asarray(host_trees.default_left)[:T].reshape(T * K, S).astype(np.int32),
+        weight=w,
+        leafv=leafv,
+    )
+    nbytes = sum(a.nbytes for a in arrays.values())
+    return PallasForest(
+        **{k: jnp.asarray(v) for k, v in arrays.items()},
+        num_trees=T, num_class=K, num_steps=S, num_leaves=Lp, nbytes=nbytes,
+    )
+
+
+def _predict_kernel(bins_ref, leafv_ref, feat_ref, thr_ref, sleaf_ref,
+                    dleft_ref, w_ref, out_ref, *, TT: int, K: int, S: int,
+                    L: int, num_bins: int):
+    """One row tile: replay all TT trees over the resident (F, bm) bins."""
+    bm = bins_ref.shape[1]
+    iota_k = lax.broadcasted_iota(jnp.int32, (K, bm), 0)
+    iota_l = lax.broadcasted_iota(jnp.int32, (L, bm), 0)
+
+    def tree_body(idx, acc):
+        def step_body(s, leaf):
+            f = feat_ref[idx, s]
+            sleaf = sleaf_ref[idx, s]
+            thr = thr_ref[idx, s]
+            dl = dleft_ref[idx, s]
+            fcol = bins_ref[pl.ds(f, 1), :]          # (1, bm) int32
+            miss = fcol == num_bins - 1
+            go_left = jnp.where(miss, dl == 1, fcol <= thr)
+            # rows sitting in the split leaf that go right take the new
+            # leaf id s+1 (LightGBM leaf relabelling); inactive steps
+            # have sleaf == -1 and never match
+            move = (leaf == sleaf) & (~go_left)
+            return jnp.where(move, s + 1, leaf)
+
+        leaf = lax.fori_loop(0, S, step_body, jnp.zeros((1, bm), jnp.int32))
+        one_hot = (iota_l == leaf).astype(jnp.float32)   # (L, bm)
+        lv = leafv_ref[pl.ds(idx, 1), :]                 # (1, L)
+        val = lax.dot_general(
+            lv, one_hot,
+            dimension_numbers=(((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+            precision=lax.Precision.HIGHEST,
+        )                                                # (1, bm)
+        contrib = w_ref[idx, 0] * val
+        k = idx % K
+        # where (not additive masking): preserves the scan path's exact
+        # per-class f32 add sequence incl. signed zeros
+        return jnp.where(iota_k == k, acc + contrib, acc)
+
+    out_ref[...] = lax.fori_loop(
+        0, TT, tree_body, jnp.zeros((K, bm), jnp.float32)
+    )
+
+
+@functools.partial(jax.jit, static_argnames=(
+    "TT", "K", "S", "L", "num_bins", "bm", "interpret"))
+def _pallas_predict(bins_t, leafv, feat, thr, sleaf, dleft, weight, *,
+                    TT: int, K: int, S: int, L: int, num_bins: int,
+                    bm: int, interpret: bool):
+    F, n = bins_t.shape
+    kernel = functools.partial(
+        _predict_kernel, TT=TT, K=K, S=S, L=L, num_bins=num_bins
+    )
+    smem = pl.BlockSpec(memory_space=pltpu.SMEM)
+    return pl.pallas_call(
+        kernel,
+        grid=(n // bm,),
+        in_specs=[
+            pl.BlockSpec((F, bm), lambda i: (0, i)),   # bins tile (VMEM)
+            pl.BlockSpec(memory_space=pltpu.VMEM),     # leaf values
+            smem, smem, smem, smem, smem,              # scalar metadata
+        ],
+        out_specs=pl.BlockSpec((K, bm), lambda i: (0, i)),
+        out_shape=jax.ShapeDtypeStruct((K, n), jnp.float32),
+        interpret=interpret,
+    )(bins_t, leafv, feat, thr, sleaf, dleft, weight)
+
+
+def pallas_raw_scores(pf: PallasForest, bins, num_bins: int,
+                      bm: int = 2048, interpret: bool = False) -> jnp.ndarray:
+    """(n, F) binned matrix → (K, n) raw scores, bitwise-equal to the scan
+    path (modulo the documented -0.0 leaf-value caveat)."""
+    backend = jax.default_backend()
+    if backend not in ("cpu", "tpu"):
+        raise NotImplementedError(
+            f"predict_backend='pallas' supports tpu (compiled) and cpu "
+            f"(interpret) backends, not {backend!r}; use 'packed'"
+        )
+    n, F = bins.shape
+    bins_t = bins.astype(jnp.int32).T            # (F, n): rows on lanes
+    bm = min(bm, _round_up(max(n, 1), 128))
+    pad_r = (-n) % bm
+    pad_f = (-F) % 8                             # int32 sublane multiple
+    if pad_r or pad_f:
+        bins_t = jnp.pad(bins_t, ((0, pad_f), (0, pad_r)))
+    out = _pallas_predict(
+        bins_t, pf.leafv, pf.feat, pf.thr, pf.sleaf, pf.dleft, pf.weight,
+        TT=pf.num_trees * pf.num_class, K=pf.num_class, S=pf.num_steps,
+        L=pf.num_leaves, num_bins=num_bins, bm=bm,
+        interpret=interpret or backend == "cpu",
+    )
+    return out[:, :n]

@@ -19,6 +19,7 @@ from tools.analyze.collectives import check_collectives_file
 from tools.analyze.common import Finding, apply_suppressions
 from tools.analyze.hygiene import check_hygiene_file
 from tools.analyze.obs_rules import check_obs, check_obs_file
+from tools.analyze.predict_rules import check_predict, check_predict_file
 from tools.analyze.serving_rules import check_serving, check_serving_file
 from tools.analyze.tracer import check_host_only_file, check_tracer_file
 
@@ -778,6 +779,68 @@ def test_advice_relatime_lru_would_be_caught(tmp_path):
             return removed
     """)
     assert rules(check_hygiene_file(p)) == ["HYG001"]
+
+
+# ------------------------------------------------------------------- PRED001
+
+
+def test_pred001_host_roundtrip_in_hot_path(tmp_path):
+    p = _write(str(tmp_path / "m.py"), """
+        import numpy as np
+        class Booster:
+            def predict(self, X):
+                bins = np.asarray(self._score(X))       # device→host sync
+                return np.ascontiguousarray(bins)
+            def _raw_scores_binned(self, bins):
+                return numpy.array(bins)
+    """)
+    found = check_predict_file(p)
+    assert rules(found) == ["PRED001"] * 3
+    assert "device" in found[0].message
+
+
+def test_pred001_silent_outside_hot_paths(tmp_path):
+    p = _write(str(tmp_path / "m.py"), """
+        import numpy as np
+        def fit(X):
+            return np.asarray(X)          # training prep: host is fine
+        def _build_table(vals):
+            return np.ascontiguousarray(vals)
+    """)
+    assert check_predict_file(p) == []
+
+
+def test_pred001_serve_batch_worker_is_hot(tmp_path):
+    p = _write(str(tmp_path / "m.py"), """
+        import numpy as np
+        class Batcher:
+            def _process(self, batch):
+                return np.asarray(batch.preds)
+    """)
+    assert rules(check_predict_file(p)) == ["PRED001"]
+
+
+def test_pred001_native_package_exempt(tmp_path):
+    src = """
+        import numpy as np
+        def predict(model, X):
+            return np.asarray(walk(model, X))
+    """
+    _write(str(tmp_path / "mmlspark_tpu" / "native" / "scorer.py"), src)
+    fires = _write(str(tmp_path / "mmlspark_tpu" / "engine" / "b.py"), src)
+    found = check_predict(str(tmp_path))
+    assert rules(found) == ["PRED001"]
+    assert found[0].file == fires
+
+
+def test_pred001_suppression_marks_sanctioned_conversions(tmp_path):
+    p = _write(str(tmp_path / "m.py"), """
+        import numpy as np
+        def predict(self, X):
+            X = np.asarray(X, dtype=np.float64)  # analyze: ignore[PRED001]
+            return self._score(X)
+    """)
+    assert apply_suppressions(check_predict_file(p)) == []
 
 
 # ------------------------------------------------------------------- CLI

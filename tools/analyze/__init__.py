@@ -1,6 +1,7 @@
 """Repo-native static-analysis suite (see README.md in this directory).
 
-Six passes (ABI, collectives, tracer, hygiene, obs, serving), each returning
+Seven passes (ABI, collectives, tracer, hygiene, obs, serving, predict),
+each returning
 :class:`tools.analyze.common.Finding` rows; :func:`run_all` runs them
 over a repo root and applies inline ``# analyze: ignore[RULE]``
 suppressions.  CLI: ``python -m tools.analyze [--json]``.
@@ -15,13 +16,14 @@ from tools.analyze.collectives import check_collectives
 from tools.analyze.common import Finding, apply_suppressions
 from tools.analyze.hygiene import check_hygiene
 from tools.analyze.obs_rules import check_obs
+from tools.analyze.predict_rules import check_predict
 from tools.analyze.serving_rules import check_serving
 from tools.analyze.tracer import check_tracer
 
 __all__ = [
     "Finding", "run_all", "repo_root",
     "check_abi", "check_collectives", "check_tracer", "check_hygiene",
-    "check_obs", "check_serving",
+    "check_obs", "check_serving", "check_predict",
 ]
 
 
@@ -39,6 +41,7 @@ def run_all(root: "str | None" = None) -> list:
     findings.extend(check_hygiene(root))
     findings.extend(check_obs(root))
     findings.extend(check_serving(root))
+    findings.extend(check_predict(root))
     findings = apply_suppressions(findings)
     findings.sort(key=lambda f: (f.file, f.line, f.rule))
     return findings

@@ -1,0 +1,90 @@
+"""Pass 7 — predict/serving hot-path hygiene.
+
+Rules
+-----
+- PRED001: host ``np.asarray`` / ``np.array`` / ``np.ascontiguousarray``
+  round-trips inside library predict/serving hot paths.  The packed-
+  forest predict stack (ISSUE 5) keeps the model, bin edges, and batch
+  device-resident end to end; a stray ``np.asarray`` on a device value
+  inside ``predict*`` / ``*raw_scores*`` / the serve batch worker
+  silently inserts a device→host sync + host→device re-upload per call —
+  exactly the per-call transfer bug the packed path removed.  Sanctioned
+  conversions (the API entry that normalizes user input, the API exit
+  that returns a host ndarray) are marked ``# analyze: ignore[PRED001]``.
+
+Scope: functions under ``mmlspark_tpu/`` whose name contains ``predict``
+or ``raw_scores``, or is the serve batch worker ``_process``.  The
+``native/`` package is exempt wholesale — its predictor is a HOST-side
+scorer by contract (ctypes C++ walker), not a device path.
+"""
+
+from __future__ import annotations
+
+import ast
+import glob
+import os
+
+from tools.analyze.common import Finding
+
+_NP_NAMES = {"np", "numpy"}
+_CONVERTERS = {"asarray", "array", "ascontiguousarray"}
+
+
+def _is_hot_path_fn(name: str) -> bool:
+    return "predict" in name or "raw_scores" in name or name == "_process"
+
+
+def check_predict_file(path: str) -> list:
+    try:
+        with open(path, encoding="utf-8", errors="replace") as fh:
+            tree = ast.parse(fh.read(), filename=path)
+    except SyntaxError:
+        return []
+    findings = []
+    for node in ast.walk(tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        if not _is_hot_path_fn(node.name):
+            continue
+        for sub in ast.walk(node):
+            # nested defs get their own walk; skip re-reporting their
+            # bodies under a non-matching parent is fine (set semantics
+            # dedupe below)
+            if (
+                isinstance(sub, ast.Call)
+                and isinstance(sub.func, ast.Attribute)
+                and sub.func.attr in _CONVERTERS
+                and isinstance(sub.func.value, ast.Name)
+                and sub.func.value.id in _NP_NAMES
+            ):
+                findings.append(
+                    Finding(
+                        path, sub.lineno, "PRED001",
+                        f"host np.{sub.func.attr}() inside predict/serving "
+                        f"hot path {node.name}() — a device value here costs "
+                        "a device→host sync + re-upload per call; keep the "
+                        "batch device-resident, or mark a sanctioned API "
+                        "entry/exit conversion with "
+                        "# analyze: ignore[PRED001]",
+                    )
+                )
+    # a call nested in two matching defs would report twice
+    seen, out = set(), []
+    for f in findings:
+        k = (f.file, f.line)
+        if k not in seen:
+            seen.add(k)
+            out.append(f)
+    return out
+
+
+def check_predict(root: str) -> list:
+    findings: list = []
+    pkg = os.path.join(root, "mmlspark_tpu")
+    for py in sorted(glob.glob(os.path.join(pkg, "**", "*.py"),
+                               recursive=True)):
+        rel = os.path.relpath(py, pkg)
+        if rel.split(os.sep)[0] == "native":
+            continue  # host-side scorer by contract
+        findings.extend(check_predict_file(py))
+    return findings

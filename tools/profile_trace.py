@@ -21,6 +21,28 @@ import time
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
+def _short_source(s: str, width: int = 44) -> str:
+    """Fit a ``path/to/file.py:line`` ref into ``width`` columns keeping
+    the ``file.py:line`` TAIL intact.
+
+    The old ``s[-44:]`` left-trim chopped the front of the path mid-word
+    (``/root/repo/...`` → ``oot/repo/...``), which broke clickable
+    file:line refs in the report.  Shorten by dropping LEADING directories
+    wholesale (marking the elision with ``…/``) so whatever remains is a
+    real openable suffix of the path.
+    """
+    if len(s) <= width:
+        return s
+    parts = s.split("/")
+    # keep as many trailing components as fit after the "…/" marker
+    for i in range(1, len(parts)):
+        tail = "…/" + "/".join(parts[i:])
+        if len(tail) <= width:
+            return tail
+    # even the basename overflows: right-align it, still tail-exact
+    return "…" + s[-(width - 1):]
+
+
 def main():
     import jax
 
@@ -80,7 +102,7 @@ def main():
     for name, d in dur.most_common(20):
         print(
             f"{d/1e6:8.3f}s x{cnt[name]:<5} {name[:52]:52} "
-            f"{src.get(name, '')[-44:]}"
+            f"{_short_source(src.get(name, ''))}"
         )
 
 
