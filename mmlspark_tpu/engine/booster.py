@@ -649,7 +649,10 @@ class Booster:
         key = (kind, backend, T, n)
         cold = key not in self._predict_warm
         t0 = time.perf_counter()
-        with obs.span("predict", rows=n, backend=backend, cold=cold):
+        with obs.span(
+            "predict", rows=n, backend=backend, cold=cold,
+            **obs.trace_attrs(),
+        ):
             bins = jnp.asarray(self.bin_mapper.transform(X))
             if pred_leaf:
                 if backend == "scan":
@@ -723,7 +726,7 @@ class Booster:
         t0 = time.perf_counter()
         with obs.span(
             "predict", rows=int(n_valid), bucket=int(rows.shape[0]),
-            backend=backend, cold=cold,
+            backend=backend, cold=cold, **obs.trace_attrs(),
         ):
             if backend in ("pallas", "pallas_interpret"):
                 from mmlspark_tpu.ops.pallas_predict import pallas_raw_scores

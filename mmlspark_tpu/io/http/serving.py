@@ -47,6 +47,7 @@ from typing import Callable, Dict, Optional
 from mmlspark_tpu import obs
 from mmlspark_tpu.core.frame import DataFrame
 from mmlspark_tpu.io.http.http_schema import HTTPRequestData, HTTPResponseData
+from mmlspark_tpu.obs import flight
 
 # Entity-size ceiling: a request larger than this is rejected with 413 (and
 # counted) instead of buffering unbounded bytes into the micro-batch queue.
@@ -134,6 +135,13 @@ class HTTPServer:
                 if entity:
                     self.wfile.write(entity)
                 obs.inc("http.requests", status=status)
+                if status >= 500:
+                    # Single choke point for every server-error answer
+                    # (engine 500s, intake crashes, reply-timeout 504s):
+                    # dump the flight rings so the moments BEFORE the
+                    # failure are preserved (throttled; no-op without a
+                    # configured destination).
+                    flight.auto_dump(f"http_{status}")
                 if t0 is not None:
                     obs.observe(
                         "http.request_latency_s", time.perf_counter() - t0

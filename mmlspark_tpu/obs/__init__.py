@@ -30,6 +30,14 @@ See ``tools/obs/README.md`` for env vars and naming conventions.
 
 The collective watchdog (:class:`collective_watchdog`) is independent of
 the enable flag — hang diagnostics are emitted even with metrics off.
+So is the black-box flight recorder (:mod:`mmlspark_tpu.obs.flight`):
+span/counter/collective events always enter bounded per-thread ring
+buffers, dumped as ``blackbox.rank<R>.jsonl`` on watchdog bark, crash,
+fatal signal, serving 5xx, or ``obs.flight.dump(reason)`` — read them
+with ``python -m tools.obs timeline``.  Request-scoped trace propagation
+(:func:`bind_trace` / :func:`trace_attrs`, minted by ``serve/app.py``
+from ``X-Request-Id``) makes any one request reconstructable via
+``python -m tools.obs trace <request_id>``.
 """
 
 from __future__ import annotations
@@ -37,7 +45,12 @@ from __future__ import annotations
 import os
 from typing import Optional
 
-from mmlspark_tpu.obs import _state, metrics, tracing
+from mmlspark_tpu.obs import _state, flight, metrics, tracing
+from mmlspark_tpu.obs.context import (  # noqa: F401
+    bind_trace,
+    current_trace_id,
+    trace_attrs,
+)
 from mmlspark_tpu.obs.tracing import Span, get_logger, record_span as _record_span
 from mmlspark_tpu.obs.watchdog import collective_watchdog  # noqa: F401
 
@@ -57,6 +70,10 @@ __all__ = [
     "process_index",
     "get_logger",
     "collective_watchdog",
+    "flight",
+    "bind_trace",
+    "trace_attrs",
+    "current_trace_id",
 ]
 
 
@@ -84,6 +101,7 @@ def enable(path: Optional[str] = None) -> None:
     and a final snapshot to a JSONL file (see module docstring)."""
     if path:
         tracing.open_exporter(path)
+        flight.install_hooks()  # a dump destination now exists
     _state.enabled = True
 
 
@@ -104,12 +122,17 @@ def reset() -> None:
 
 
 def span(name: str, **attrs):
-    """``with obs.span("booster.iteration", it=i): ...`` — no-op unless
-    enabled; otherwise a monotonic timed span with nesting + JSONL export
-    + ``jax.profiler.TraceAnnotation`` pass-through."""
-    if not _state.enabled:
-        return _NULL_SPAN
-    return Span(name, attrs)
+    """``with obs.span("booster.iteration", it=i): ...`` — when enabled, a
+    monotonic timed span with nesting + JSONL export +
+    ``jax.profiler.TraceAnnotation`` pass-through.  When disabled, the
+    flight recorder still rings a begin/end event pair (bounded memory,
+    no I/O — the blackbox contract), unless flight is disarmed too, in
+    which case the shared zero-allocation null context returns."""
+    if _state.enabled:
+        return Span(name, attrs)
+    if flight._armed:
+        return flight.FlightSpan(name, attrs)
+    return _NULL_SPAN
 
 
 def record_span(name: str, dur_s: float, **attrs) -> None:
@@ -117,12 +140,16 @@ def record_span(name: str, dur_s: float, **attrs) -> None:
     timing already exists, e.g. Timer stages and derived per-iteration
     times in the fused scan path)."""
     if not _state.enabled:
+        if flight._armed:
+            flight.record("span", name, {"dur_s": dur_s, **attrs})
         return
     _record_span(name, dur_s, attrs)
 
 
 def inc(name: str, value: float = 1.0, /, **labels) -> None:
     if not _state.enabled:
+        if flight._armed:
+            flight.record("ctr", name, labels or None)
         return
     metrics.registry.inc(name, value, **labels)
 
@@ -183,3 +210,7 @@ def _init_from_env() -> None:
 
 tracing._configure_logger()
 _init_from_env()
+# The flight recorder's excepthooks always chain (dumps are no-ops
+# without a destination); signal handlers only install when
+# MMLSPARK_TPU_OBS_FLIGHT_DIR (or an export path) is configured.
+flight.install_hooks()

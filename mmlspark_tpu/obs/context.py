@@ -1,0 +1,74 @@
+"""mmlspark_tpu.obs.context — request-scoped trace propagation.
+
+A ``contextvars``-based trace context: ``serve/app.py`` mints one per
+request on the transport thread (honoring an inbound ``X-Request-Id``
+header), the batcher carries it across the queue handoff as explicit
+``BatchItem`` fields (contextvars do NOT follow objects through a
+``queue.Queue`` — the consuming worker thread re-binds), and every span
+recorded while a context is bound can attach it via :func:`trace_attrs`,
+so ``python -m tools.obs trace <request_id>`` can reconstruct the
+request end-to-end: admission → queue wait → batch close → padded
+predict → reply.
+
+Fan-in: a batch span binds a fresh *batch* trace id and records its
+member request ids (``members=[...]``) — the link from any one request
+to the shared predict work.
+
+Pure stdlib; no obs state — usable whether or not metrics are enabled
+(the flight recorder rings carry the ids too).
+"""
+
+from __future__ import annotations
+
+import contextvars
+import uuid
+from contextlib import contextmanager
+from typing import NamedTuple, Optional
+
+
+class TraceContext(NamedTuple):
+    trace_id: str
+    request_id: Optional[str] = None
+
+
+_CTX: "contextvars.ContextVar[Optional[TraceContext]]" = contextvars.ContextVar(
+    "mmlspark_tpu_trace", default=None
+)
+
+
+def new_trace_id() -> str:
+    return uuid.uuid4().hex[:16]
+
+
+def current() -> Optional[TraceContext]:
+    return _CTX.get()
+
+
+def current_trace_id() -> Optional[str]:
+    ctx = _CTX.get()
+    return ctx.trace_id if ctx is not None else None
+
+
+def trace_attrs() -> dict:
+    """Span attributes for the bound context (empty dict when none) —
+    splat into instrumentation: ``obs.span("predict", **obs.trace_attrs())``."""
+    ctx = _CTX.get()
+    if ctx is None:
+        return {}
+    if ctx.request_id and ctx.request_id != ctx.trace_id:
+        return {"trace_id": ctx.trace_id, "request_id": ctx.request_id}
+    return {"trace_id": ctx.trace_id}
+
+
+@contextmanager
+def bind_trace(trace_id: Optional[str] = None,
+               request_id: Optional[str] = None):
+    """Bind a trace context for the dynamic extent of the block (nesting
+    restores the outer context on exit).  Minting: no ``trace_id`` draws
+    a fresh id."""
+    ctx = TraceContext(trace_id or new_trace_id(), request_id)
+    token = _CTX.set(ctx)
+    try:
+        yield ctx
+    finally:
+        _CTX.reset(token)

@@ -149,3 +149,122 @@ class Registry:
 
 
 registry = Registry()
+
+
+# ---------------------------------------------------------------------------
+# Prometheus text exposition (format 0.0.4) over a snapshot() dict.
+# Dependency-free rendering for GET /metrics?format=prometheus in
+# serve/app.py: counters stay counters, gauges stay gauges, histograms
+# and span aggregates become summaries (count/sum + quantile series from
+# the reservoir percentiles).  Snapshot keys arrive pre-formatted as
+# ``name{k=v,...}`` (see _fmt_key) and are parsed back here so labels
+# survive as real Prometheus labels.
+# ---------------------------------------------------------------------------
+
+
+def _prom_name(name: str, prefix: str) -> str:
+    out = []
+    for ch in f"{prefix}_{name}" if prefix else name:
+        out.append(ch if (ch.isalnum() and ch.isascii()) or ch == "_" else "_")
+    s = "".join(out)
+    return "_" + s if s[:1].isdigit() else s
+
+
+def _prom_escape(v: str) -> str:
+    return v.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
+def _split_key(key: str):
+    """``name{k=v,k2=v2}`` → (name, [(k, v), ...])."""
+    if "{" not in key or not key.endswith("}"):
+        return key, []
+    name, inner = key[:-1].split("{", 1)
+    labels = []
+    for part in inner.split(","):
+        if "=" in part:
+            k, v = part.split("=", 1)
+            labels.append((k, v))
+    return name, labels
+
+
+def _prom_labels(labels, extra=()) -> str:
+    items = [*labels, *extra]
+    if not items:
+        return ""
+    inner = ",".join(
+        f'{_prom_name(k, "")}="{_prom_escape(str(v))}"' for k, v in items
+    )
+    return "{" + inner + "}"
+
+
+def _fmt_val(v: float) -> str:
+    f = float(v)
+    if f != f:
+        return "NaN"
+    if f in (math.inf, -math.inf):
+        return "+Inf" if f > 0 else "-Inf"
+    return repr(f) if f != int(f) else str(int(f))
+
+
+def render_prometheus(snapshot: dict, prefix: str = "mmlspark_tpu") -> str:
+    """Render an ``obs.snapshot()`` dict as Prometheus text exposition."""
+    lines: list = []
+    seen_types: set = set()
+
+    def typ(metric: str, kind: str):
+        if metric not in seen_types:
+            seen_types.add(metric)
+            lines.append(f"# TYPE {metric} {kind}")
+
+    for key in sorted(snapshot.get("counters", {})):
+        name, labels = _split_key(key)
+        metric = _prom_name(name, prefix)
+        typ(metric, "counter")
+        lines.append(
+            f"{metric}{_prom_labels(labels)} "
+            f"{_fmt_val(snapshot['counters'][key])}"
+        )
+    for key in sorted(snapshot.get("gauges", {})):
+        name, labels = _split_key(key)
+        metric = _prom_name(name, prefix)
+        typ(metric, "gauge")
+        lines.append(
+            f"{metric}{_prom_labels(labels)} "
+            f"{_fmt_val(snapshot['gauges'][key])}"
+        )
+    for key in sorted(snapshot.get("histograms", {})):
+        name, labels = _split_key(key)
+        h = snapshot["histograms"][key]
+        metric = _prom_name(name, prefix)
+        typ(metric, "summary")
+        if not h.get("count"):
+            lines.append(f"{metric}_count{_prom_labels(labels)} 0")
+            continue
+        for q in ("0.5", "0.95", "0.99"):
+            pkey = "p" + q[2:].ljust(2, "0")  # 0.5→p50, 0.95→p95, 0.99→p99
+            if pkey in h:
+                lines.append(
+                    f"{metric}{_prom_labels(labels, [('quantile', q)])} "
+                    f"{_fmt_val(h[pkey])}"
+                )
+        lines.append(
+            f"{metric}_sum{_prom_labels(labels)} {_fmt_val(h['sum'])}"
+        )
+        lines.append(
+            f"{metric}_count{_prom_labels(labels)} {_fmt_val(h['count'])}"
+        )
+    for name in sorted(snapshot.get("spans", {})):
+        s = snapshot["spans"][name]
+        metric = _prom_name(name + "_seconds", prefix)
+        typ(metric, "summary")
+        lines.append(f"{metric}_sum {_fmt_val(s.get('total_s', 0.0))}")
+        lines.append(f"{metric}_count {_fmt_val(s.get('count', 0))}")
+        lines.append(
+            f"{_prom_name(name + '_seconds_max', prefix)} "
+            f"{_fmt_val(s.get('max_s', 0.0))}"
+        )
+    if "process_index" in snapshot:
+        metric = _prom_name("process_index", prefix)
+        typ(metric, "gauge")
+        lines.append(f"{metric} {_fmt_val(snapshot['process_index'])}")
+    return "\n".join(lines) + "\n"

@@ -30,7 +30,7 @@ import threading
 import time
 from typing import Optional
 
-from mmlspark_tpu.obs import _state, metrics
+from mmlspark_tpu.obs import _state, flight, metrics
 
 _LOGGER_NAME = "mmlspark_tpu"
 
@@ -122,6 +122,7 @@ class Span:
         self._ta = ta_cls(self.name) if ta_cls else None
         if self._ta is not None:
             self._ta.__enter__()
+        flight.record("sb", self.name, self.attrs or None)
         self._t0 = time.perf_counter_ns()
         return self
 
@@ -135,6 +136,7 @@ class Span:
         stack = _stack()
         if stack and stack[-1] is self:
             stack.pop()
+        flight.record("se", self.name, None)
         record_span(
             self.name, dur_s, self.attrs, depth=self._depth, parent=self._parent
         )

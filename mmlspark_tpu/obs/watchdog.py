@@ -23,7 +23,7 @@ import threading
 import time
 from typing import Optional
 
-from mmlspark_tpu.obs import _state, metrics, tracing
+from mmlspark_tpu.obs import _state, flight, metrics, tracing
 
 DEFAULT_TIMEOUT_S = 120.0
 # Re-arm and re-log this many times so long hangs stay visible in a
@@ -55,6 +55,7 @@ class collective_watchdog:
         self._done = threading.Event()
 
     def __enter__(self):
+        flight.record("collective", self.name, self.attrs or None)
         self._t0 = time.perf_counter()
         if self.timeout_s > 0:
             self._arm()
@@ -80,6 +81,15 @@ class collective_watchdog:
             self.attrs or {},
         )
         metrics.registry.inc("collective.stuck", name=self.name)
+        flight.record(
+            "watchdog", self.name,
+            {"elapsed_s": round(elapsed, 3), "bark": self.barks},
+        )
+        if self.barks == 1:
+            # The blackbox IS the surrounding context the single log line
+            # never had: dump every thread's recent events alongside the
+            # bark (throttled; no-op without a configured destination).
+            flight.auto_dump(f"watchdog_bark:{self.name}")
         if self.barks < _MAX_BARKS:
             self._arm()
 
@@ -88,6 +98,12 @@ class collective_watchdog:
         if self._timer is not None:
             self._timer.cancel()
         dur_s = time.perf_counter() - self._t0
+        # End event carries attrs set INSIDE the context (the device
+        # wrappers attach nbytes after the collective returns).
+        flight.record(
+            "collective_end", self.name,
+            {"dur_s": round(dur_s, 6), **(self.attrs or {})} or None,
+        )
         if self.barks:
             tracing.get_logger().warning(
                 "rank %d: collective %s completed after %.1fs "

@@ -122,7 +122,8 @@ def make_global_array(mesh, spec, local_rows):
     # Cross-process assembly blocks until every process contributes — run
     # it under the watchdog so a missing rank is diagnosed, not silent.
     with obs.collective_watchdog(
-        "make_global_array", shape=tuple(getattr(local_rows, "shape", ()))
+        "make_global_array", shape=tuple(getattr(local_rows, "shape", ())),
+        **obs.trace_attrs(),
     ):
         return jax.make_array_from_process_local_data(sharding, local_rows)
 
@@ -160,7 +161,7 @@ def device_psum(x, axis_name):
     """``lax.psum`` under the collective watchdog + byte accounting."""
     from jax import lax
 
-    with obs.collective_watchdog("psum") as wd:
+    with obs.collective_watchdog("psum", **obs.trace_attrs()) as wd:
         out = lax.psum(x, axis_name)
         wd.attrs["nbytes"] = _leaf_nbytes(out)
     return out
@@ -175,7 +176,7 @@ def device_psum_scatter(x, axis_name, scatter_dimension: int = 0,
     pad (the booster right-pads feature columns)."""
     from jax import lax
 
-    with obs.collective_watchdog("reduce_scatter") as wd:
+    with obs.collective_watchdog("reduce_scatter", **obs.trace_attrs()) as wd:
         out = lax.psum_scatter(
             x, axis_name, scatter_dimension=scatter_dimension, tiled=tiled
         )
@@ -187,7 +188,7 @@ def device_all_gather(x, axis_name, **kw):
     """``lax.all_gather`` under the collective watchdog + byte accounting."""
     from jax import lax
 
-    with obs.collective_watchdog("all_gather") as wd:
+    with obs.collective_watchdog("all_gather", **obs.trace_attrs()) as wd:
         out = lax.all_gather(x, axis_name, **kw)
         wd.attrs["nbytes"] = _leaf_nbytes(out)
     return out
@@ -217,7 +218,9 @@ def host_allgather(arr) -> "np.ndarray":
     # an allgather no other rank entered hangs FOREVER with no diagnostic.
     # The watchdog logs a rank-stamped "stuck in collective" line past a
     # soft timeout (and, when obs is enabled, records count/duration).
-    with obs.collective_watchdog("host_allgather", nbytes=int(raw.nbytes)):
+    with obs.collective_watchdog(
+        "host_allgather", nbytes=int(raw.nbytes), **obs.trace_attrs()
+    ):
         gathered = np.asarray(mhu.process_allgather(raw))  # (nproc, nbytes)
     return gathered.view(a.dtype).reshape((gathered.shape[0],) + a.shape)
 

@@ -27,6 +27,7 @@ import threading
 from typing import Dict, Optional
 
 from mmlspark_tpu import obs
+from mmlspark_tpu.obs import flight
 from mmlspark_tpu.io.http.http_schema import HTTPResponseData
 
 
@@ -138,6 +139,14 @@ class AdmissionController:
                     st.inflight += 1
                     self._idle.clear()
                     obs.gauge("serve.queue_depth", st.queue.qsize(), route=route)
+        # Verdicts enter the blackbox unconditionally: when a 5xx or bark
+        # dumps the flight rings, the recent shed/not_ready history is the
+        # first thing worth seeing.
+        flight.record(
+            "admit", verdict,
+            {"route": route, "rid": getattr(item, "request_id", None)
+             or getattr(item, "rid", None)},
+        )
         obs.inc("serve.admission", verdict=verdict, route=route)
         if verdict == "accept":
             return None

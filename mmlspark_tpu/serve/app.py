@@ -28,11 +28,13 @@ import json
 import re
 import threading
 import time
+import uuid
 from typing import Callable, Dict, Optional, Sequence
 
 import numpy as np
 
 from mmlspark_tpu import obs
+from mmlspark_tpu.obs import metrics as obs_metrics
 from mmlspark_tpu.core.frame import DataFrame
 from mmlspark_tpu.core.jit_cache import cache_counters, enable_compile_cache
 from mmlspark_tpu.io.http.http_schema import HTTPRequestData, HTTPResponseData
@@ -42,6 +44,15 @@ from mmlspark_tpu.serve.batcher import DEFAULT_BUCKETS, BatchItem, DynamicBatche
 from mmlspark_tpu.serve.registry import ModelRegistry, ModelVersion
 
 _PREDICT_RE = re.compile(r"^/models/([A-Za-z0-9_.-]+)/predict$")
+
+
+def _header(req: HTTPRequestData, name: str) -> Optional[str]:
+    """Case-insensitive header lookup (the transport hands over the raw
+    client dict, whose key casing the client controls)."""
+    for k, v in (req.headers or {}).items():
+        if k.lower() == name.lower():
+            return v
+    return None
 
 
 def _json_response(status: int, payload, headers: Optional[dict] = None) -> HTTPResponseData:
@@ -302,7 +313,7 @@ class ServingApp:
                 }
                 return _json_response(200 if self.ready else 503, body)
             if path == "/metrics":
-                return _json_response(200, obs.snapshot())
+                return self._metrics_response(req)
             return _json_response(404, {"error": f"no such path: {path}"})
         if req.method != "POST":
             return _json_response(405, {"error": f"method {req.method}"})
@@ -313,10 +324,42 @@ class ServingApp:
         route = self._routes.get(name)
         if route is None:
             return _json_response(404, {"error": f"no such model: {name}"})
-        item, err = self._parse_predict(rid, req, route, wait_s)
-        if err is not None:
-            return err
-        return self.admission.admit(name, item)
+        # Honor an inbound X-Request-Id (else mint from the transport's
+        # correlation id) and bind it as the trace context for everything
+        # that happens on this transport thread; the BatchItem carries it
+        # across the queue to the worker.  Every response — immediate
+        # parse/verdict replies here, batched replies in _process — echoes
+        # the id back so clients can join their logs to ours.
+        req_id = (_header(req, "X-Request-Id") or "").strip() or rid
+        with obs.bind_trace(trace_id=req_id, request_id=req_id):
+            item, err = self._parse_predict(rid, req, route, wait_s)
+            if err is not None:
+                err.headers["X-Request-Id"] = req_id
+                return err
+            item.trace_id = req_id
+            item.request_id = req_id
+            verdict = self.admission.admit(name, item)
+        if verdict is not None:
+            verdict.headers["X-Request-Id"] = req_id
+        return verdict
+
+    def _metrics_response(self, req: HTTPRequestData) -> HTTPResponseData:
+        """JSON snapshot by default; Prometheus text exposition when asked
+        for via ``?format=prometheus`` or an Accept header preferring
+        ``text/plain`` / OpenMetrics."""
+        query = req.url.split("?", 1)[1] if "?" in req.url else ""
+        accept = (_header(req, "Accept") or "").lower()
+        want_prom = "format=prometheus" in query or (
+            "text/plain" in accept or "openmetrics" in accept
+        )
+        if not want_prom:
+            return _json_response(200, obs.snapshot())
+        text = obs_metrics.render_prometheus(obs.snapshot())
+        return HTTPResponseData(
+            statusCode=200,
+            headers={"Content-Type": "text/plain; version=0.0.4; charset=utf-8"},
+            entity=text.encode(),
+        )
 
     def _parse_predict(self, rid: str, req: HTTPRequestData, route: _Route,
                        wait_s: float):
@@ -365,24 +408,44 @@ class ServingApp:
             self._process(route, items)
 
     def _process(self, route: _Route, items) -> None:
+        # Fan-in point of the trace graph: N request traces join one batch
+        # trace.  The batch span lists its member request ids; per-request
+        # stage spans (queue_wait / batch_close_wait / reply / request)
+        # carry the request's own trace id — ``tools.obs trace <id>``
+        # stitches the two back together via the ``batch`` attr.
+        t_closed = time.monotonic()
+        batch_id = "b-" + uuid.uuid4().hex[:12]
+        members = [it.request_id or it.rid for it in items]
+        for it in items:
+            dq = it.dequeued or t_closed
+            tid = it.trace_id or it.rid
+            obs.record_span(
+                "serve.queue_wait", max(0.0, dq - it.enqueued),
+                rid=it.request_id or it.rid, trace_id=tid,
+            )
+            obs.record_span(
+                "serve.batch_close_wait", max(0.0, t_closed - dq),
+                rid=it.request_id or it.rid, trace_id=tid, batch=batch_id,
+            )
         X = (
             items[0].rows
             if len(items) == 1
             else np.concatenate([it.rows for it in items], axis=0)
         )
         padded, n = route.batcher.pad(X)
+        bucket = int(padded.shape[0])
         try:
             with self.registry.lease(route.name) as mv:
-                with obs.span(
-                    "serve.batch", model=route.name,
-                    bucket=int(padded.shape[0]), rows=n,
-                ):
-                    # API exit: responses serialize per-item host chunks
-                    preds = np.asarray(  # analyze: ignore[PRED001]
-                        route.predict(mv.model, padded, n)
-                    )
+                with obs.bind_trace(trace_id=batch_id):
+                    with obs.span(
+                        "serve.batch", model=route.name, bucket=bucket,
+                        rows=n, batch=batch_id, members=members,
+                    ):
+                        # API exit: responses serialize per-item host chunks
+                        preds = np.asarray(  # analyze: ignore[PRED001]
+                            route.predict(mv.model, padded, n)
+                        )
                 version = mv.version
-            headers = {"X-Model-Version": str(version)}
             off = 0
             for it in items:
                 k = it.n_rows
@@ -394,14 +457,33 @@ class ServingApp:
                     if it.single
                     else {"predictions": chunk.tolist()}
                 )
+                headers = {
+                    "X-Model-Version": str(version),
+                    "X-Request-Id": it.request_id or it.rid,
+                }
+                tid = it.trace_id or it.rid
+                t_reply = time.monotonic()
                 self._server.reply(it.rid, _json_response(200, body, headers))
+                now = time.monotonic()
+                obs.record_span(
+                    "serve.reply", now - t_reply,
+                    rid=it.request_id or it.rid, trace_id=tid,
+                )
+                obs.record_span(
+                    "serve.request", now - it.enqueued,
+                    rid=it.request_id or it.rid, trace_id=tid,
+                    batch=batch_id, bucket=bucket,
+                )
         except Exception as e:
             obs.inc("serve.errors", model=route.name)
             obs.get_logger("mmlspark_tpu.serve").exception(
                 "batch failed on route %s", route.name
             )
-            err = _json_response(500, {"error": repr(e)})
             for it in items:
+                err = _json_response(
+                    500, {"error": repr(e)},
+                    {"X-Request-Id": it.request_id or it.rid},
+                )
                 self._server.reply(it.rid, err)
         finally:
             self.admission.complete(route.name, len(items))

@@ -41,13 +41,22 @@ DEFAULT_BUCKETS = (8, 64, 512)
 @dataclass
 class BatchItem:
     """One admitted request: its correlation id, feature rows, and the
-    absolute (monotonic-clock) deadline it must be answered by."""
+    absolute (monotonic-clock) deadline it must be answered by.
+
+    ``trace_id``/``request_id`` carry the obs trace context ACROSS the
+    queue handoff (contextvars do not follow objects through a queue —
+    the worker thread re-binds from these fields), so every stage of a
+    request is attributable end-to-end by ``tools.obs trace``.
+    """
 
     rid: str
     rows: np.ndarray  # (k, F) float64
     deadline: float  # time.monotonic() based
     single: bool = False  # request carried one row (reply shape differs)
     enqueued: float = field(default_factory=time.monotonic)
+    trace_id: Optional[str] = None
+    request_id: Optional[str] = None
+    dequeued: float = 0.0  # stamped by collect(): queue-wait boundary
 
     @property
     def n_rows(self) -> int:
@@ -106,26 +115,35 @@ class DynamicBatcher:
         if self._carry is not None:
             items = [self._carry]
             self._carry = None
+            items[0].dequeued = items[0].dequeued or time.monotonic()
         else:
             try:
                 items = [q.get(timeout=self._poll_s)]
             except queue.Empty:
                 return None
+            items[0].dequeued = time.monotonic()
         total = items[0].n_rows
         t0 = time.monotonic()
         close_at = t0 + self._max_wait_s
         earliest = items[0].deadline
+        reason = "size"
         while total < self.max_rows:
             horizon = min(close_at, earliest - self._slack_s)
             remaining = horizon - time.monotonic()
             if remaining <= 0:
-                break  # max_wait elapsed or deadline pressure
+                # max_wait elapsed or deadline pressure
+                reason = "wait" if close_at <= earliest - self._slack_s \
+                    else "deadline"
+                break
             try:
                 item = q.get(timeout=remaining)
             except queue.Empty:
+                reason = "idle"
                 break
+            item.dequeued = time.monotonic()
             if total + item.n_rows > self.buckets[-1]:
                 self._carry = item  # would overflow the largest bucket
+                reason = "carry"
                 break
             items.append(item)
             total += item.n_rows
@@ -133,6 +151,7 @@ class DynamicBatcher:
         obs.observe("serve.batch_rows", total)
         obs.observe("serve.batch_wait_s", time.monotonic() - t0)
         obs.inc("serve.batches", bucket=self.bucket_for(total))
+        obs.inc("serve.batch_close", reason=reason)
         return items
 
     # -- startup pre-warming ---------------------------------------------

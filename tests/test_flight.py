@@ -1,0 +1,431 @@
+"""obs.flight — the black-box flight recorder + cross-rank timeline
+(PR 6 tentpole).
+
+Layers:
+1. ring mechanics: bounded memory under a thread hammer, disarm switch,
+   reset generation;
+2. dump mechanics: header/event JSONL shape, the wall/monotonic anchor,
+   destination resolution, explicit vs throttled dumps;
+3. triggers: a watchdog bark dumps the events that PRECEDED it; an
+   unhandled exception in a real child process leaves a blackbox behind;
+4. the reader: synthetic two-rank files with DIFFERENT monotonic epochs
+   merge in correct wall order (the offset alignment), and a real
+   2-process run produces mergeable ``blackbox.rank{0,1}.jsonl``;
+5. ``report --diff``: counter deltas and histogram percentile shifts
+   across run snapshots (including the bench-output ``"obs"`` embed).
+"""
+
+import json
+import logging
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+from mmlspark_tpu import obs
+from mmlspark_tpu.obs import flight, tracing
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _clean_state(monkeypatch):
+    monkeypatch.delenv("MMLSPARK_TPU_OBS_FLIGHT_DIR", raising=False)
+    monkeypatch.setenv("MMLSPARK_TPU_OBS_FLIGHT_MIN_INTERVAL_S", "0")
+    obs.disable()
+    obs.reset()
+    flight.reset()
+    yield
+    obs.disable()
+    obs.reset()
+    tracing.close_exporter()
+    flight.reset()
+    flight.set_armed(True)
+
+
+# ----------------------------------------------------------- ring bounds
+
+
+class TestRings:
+    def test_record_is_bounded_per_thread(self, monkeypatch):
+        monkeypatch.setattr(flight, "_CAP", 64)
+        flight.reset()
+        for i in range(10_000):
+            flight.record("ctr", "hammer", {"i": i})
+        ring = flight._rings[threading.get_ident()][1]
+        assert len(ring) == 64
+        # the ring keeps the most RECENT events
+        assert ring[-1][3] == {"i": 9_999}
+
+    def test_thread_hammer_never_exceeds_bound(self, monkeypatch):
+        # More threads than rings: extras share the overflow ring; total
+        # memory stays <= (max_rings + overflow) x cap regardless of event
+        # volume.
+        monkeypatch.setattr(flight, "_CAP", 128)
+        monkeypatch.setattr(flight, "_MAX_RINGS", 4)
+        flight.reset()
+
+        def pound():
+            for i in range(5_000):
+                flight.record("ctr", "hammer", None)
+                if i % 1000 == 0:
+                    with flight.FlightSpan("hammer.span", {"i": i}):
+                        pass
+
+        threads = [threading.Thread(target=pound) for _ in range(12)]
+        [t.start() for t in threads]
+        [t.join() for t in threads]
+        stats = flight.ring_stats()
+        assert stats["rings"] <= 4 + 1  # +1: the shared overflow ring
+        assert all(n <= 128 for n in stats["sizes"].values())
+        assert stats["total_events"] <= (4 + 1) * 128
+
+    def test_disarm_stops_recording(self):
+        flight.set_armed(False)
+        flight.record("ctr", "x", None)
+        with obs.span("disarmed"):
+            pass
+        assert flight.ring_stats()["total_events"] == 0
+        flight.set_armed(True)
+        flight.record("ctr", "x", None)
+        assert len(flight._rings[threading.get_ident()][1]) == 1
+
+    def test_reset_generation_invalidates_cached_rings(self):
+        flight.record("ctr", "a", None)
+        flight.reset()
+        assert flight.ring_stats()["total_events"] == 0
+        flight.record("ctr", "b", None)  # same thread, post-reset
+        assert flight.ring_stats()["total_events"] == 1
+
+
+# ----------------------------------------------------------------- dumps
+
+
+class TestDump:
+    def test_no_destination_is_noop(self):
+        assert flight.flight_dir() is None
+        assert flight.dump("no_dest") is None
+
+    def test_dump_shape_and_anchor(self, tmp_path, monkeypatch):
+        d = str(tmp_path / "bb")
+        monkeypatch.setenv("MMLSPARK_TPU_OBS_FLIGHT_DIR", d)
+        t_wall0 = time.time()
+        with obs.span("step", it=7):
+            obs.inc("work.done")
+        p = flight.dump("unit")
+        assert p == os.path.join(d, "blackbox.rank0.jsonl")
+        lines = [json.loads(l) for l in open(p) if l.strip()]
+        header, events = lines[0], lines[1:]
+        assert header["kind"] == "flight_header"
+        assert header["reason"] == "unit"
+        assert header["rank"] == 0
+        assert header["events"] == len(events) == 3  # sb + ctr + se
+        assert [e["ev"] for e in events] == ["sb", "ctr", "se"]
+        assert events[0]["detail"] == {"it": 7}
+        # events are time-sorted raw monotonic stamps
+        assert events[0]["t_ns"] <= events[1]["t_ns"] <= events[2]["t_ns"]
+        # the anchor reconstructs wall times inside the test's own window
+        from tools.obs import load_blackbox
+
+        evs = load_blackbox(p)
+        assert len(evs) == 3
+        for e in evs:
+            assert t_wall0 - 1.0 <= e["wall"] <= time.time() + 1.0
+
+    def test_dump_appends_segments(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("MMLSPARK_TPU_OBS_FLIGHT_DIR", str(tmp_path))
+        flight.record("ctr", "one", None)
+        flight.dump("first")
+        flight.record("ctr", "two", None)
+        p = flight.dump("second")
+        headers = [json.loads(l) for l in open(p)
+                   if '"flight_header"' in l]
+        assert [h["reason"] for h in headers] == ["first", "second"]
+        from tools.obs import load_blackbox
+
+        evs = load_blackbox(p)
+        # second segment re-dumps the (still-ringed) first event too
+        assert [e["name"] for e in evs] == ["one", "one", "two"]
+        assert [e["reason"] for e in evs] == ["first", "second", "second"]
+
+    def test_export_dir_is_fallback_destination(self, tmp_path):
+        obs.enable(str(tmp_path / "run.jsonl"))
+        try:
+            assert flight.flight_dir() == str(tmp_path)
+            flight.record("ctr", "x", None)
+            p = flight.dump("fallback")
+            assert p == str(tmp_path / "blackbox.rank0.jsonl")
+        finally:
+            obs.disable()
+
+    def test_auto_dump_throttles(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("MMLSPARK_TPU_OBS_FLIGHT_DIR", str(tmp_path))
+        monkeypatch.setenv("MMLSPARK_TPU_OBS_FLIGHT_MIN_INTERVAL_S", "3600")
+        flight.record("ctr", "x", None)
+        first = flight.auto_dump("burst")
+        second = flight.auto_dump("burst")
+        # one of the two was throttled away (order depends on when the
+        # previous auto-dump in this process happened)
+        assert second is None
+        # explicit dump is never throttled
+        assert flight.dump("explicit") is not None
+        assert first is None or os.path.isfile(first)
+
+
+# -------------------------------------------------------------- triggers
+
+
+class TestTriggers:
+    def test_watchdog_bark_dumps_preceding_events(
+        self, tmp_path, monkeypatch, caplog
+    ):
+        monkeypatch.setenv("MMLSPARK_TPU_OBS_FLIGHT_DIR", str(tmp_path))
+        obs.inc("pre.bark.work")  # rings even though obs is disabled
+        with caplog.at_level(logging.WARNING, logger="mmlspark_tpu"):
+            with obs.collective_watchdog("seeded_hang", timeout_s=0.05):
+                time.sleep(0.3)
+        p = str(tmp_path / "blackbox.rank0.jsonl")
+        assert os.path.isfile(p), os.listdir(tmp_path)
+        headers = [json.loads(l) for l in open(p)
+                   if '"flight_header"' in l]
+        assert headers[0]["reason"] == "watchdog_bark:seeded_hang"
+        from tools.obs import load_blackbox
+
+        evs = load_blackbox(p)
+        barks = [e for e in evs if e["ev"] == "watchdog"]
+        assert barks and barks[0]["name"] == "seeded_hang"
+        # the blackbox contains the events that PRECEDED the bark
+        pre = [e for e in evs if e["name"] == "pre.bark.work"]
+        assert pre and pre[0]["wall"] <= barks[0]["wall"]
+        entered = [e for e in evs if e["ev"] == "collective"]
+        assert entered and entered[0]["name"] == "seeded_hang"
+
+    def test_unhandled_exception_dumps_blackbox(self, tmp_path):
+        child = (
+            "from mmlspark_tpu import obs\n"
+            "obs.inc('about.to.crash')\n"
+            "raise ValueError('seeded crash')\n"
+        )
+        env = dict(
+            os.environ,
+            MMLSPARK_TPU_OBS_FLIGHT_DIR=str(tmp_path),
+            MMLSPARK_TPU_OBS_FLIGHT_MIN_INTERVAL_S="0",
+            PYTHONPATH=REPO,
+        )
+        r = subprocess.run(
+            [sys.executable, "-c", child], env=env, cwd=REPO,
+            capture_output=True, text=True, timeout=60,
+        )
+        assert r.returncode != 0
+        assert "seeded crash" in r.stderr  # the hook chains, not swallows
+        p = str(tmp_path / "blackbox.rank0.jsonl")
+        assert os.path.isfile(p), r.stderr
+        header = json.loads(open(p).readline())
+        assert header["reason"] == "unhandled_exception:ValueError"
+        from tools.obs import load_blackbox
+
+        assert any(e["name"] == "about.to.crash" for e in load_blackbox(p))
+
+
+# ------------------------------------------------- timeline reconstruction
+
+
+def _write_blackbox(path, rank, ts, mono_ns, events):
+    """events: (t_ns, ev, name, detail) tuples."""
+    with open(path, "w") as f:
+        f.write(json.dumps({
+            "kind": "flight_header", "rank": rank, "reason": "test",
+            "ts": ts, "mono_ns": mono_ns, "cap": 2048,
+            "events": len(events),
+        }) + "\n")
+        for t_ns, ev, name, detail in events:
+            rec = {"kind": "flight", "rank": rank, "t_ns": t_ns,
+                   "ev": ev, "name": name, "thread": "MainThread"}
+            if detail is not None:
+                rec["detail"] = detail
+            f.write(json.dumps(rec) + "\n")
+
+
+class TestTimeline:
+    def test_monotonic_offset_alignment_across_ranks(self, tmp_path):
+        # Two ranks whose monotonic clocks started at DIFFERENT instants:
+        # rank0's epoch is at wall 995.0 (anchor 1000.0 @ 5e9 ns), rank1's
+        # at wall 900.0 (anchor 1000.0 @ 100e9 ns).  Correct alignment
+        # interleaves r1's event BETWEEN r0's two.
+        d = str(tmp_path)
+        _write_blackbox(
+            os.path.join(d, "blackbox.rank0.jsonl"), 0,
+            ts=1000.0, mono_ns=5_000_000_000,
+            events=[
+                (4_000_000_000, "sb", "booster.iteration", {"it": 0}),
+                (4_500_000_000, "se", "booster.iteration", None),
+            ],
+        )
+        _write_blackbox(
+            os.path.join(d, "blackbox.rank1.jsonl"), 1,
+            ts=1000.0, mono_ns=100_000_000_000,
+            events=[
+                (99_250_000_000, "collective_end", "psum",
+                 {"dur_s": 0.1}),
+            ],
+        )
+        from tools.obs import build_timeline, render_timeline
+
+        tl = build_timeline([d])
+        assert tl["ranks"] == [0, 1]
+        # per-rank monotonic epoch offsets differ by exactly the epoch gap
+        off0 = tl["anchors"]["0"]["offset_s"]
+        off1 = tl["anchors"]["1"]["offset_s"]
+        assert abs(off0 - 995.0) < 1e-6
+        assert abs(off1 - 900.0) < 1e-6
+        # merged order: r0 sb (999.0), r1 collective_end (999.25),
+        # r0 se (999.5)
+        walls = [(e["rank"], round(e["wall"], 6)) for e in tl["events"]]
+        assert walls == [(0, 999.0), (1, 999.25), (0, 999.5)]
+        # attribution: rank0's 0.5s iteration contains NO rank-0
+        # collectives (rank1's psum must not leak across ranks)
+        step = tl["steps"][0]
+        assert step["rank"] == 0
+        assert abs(step["dur_s"] - 0.5) < 1e-6
+        assert step["collective_s"] == 0.0
+        assert abs(step["compute_s"] - 0.5) < 1e-6
+        assert tl["collective_totals"] == {
+            "1": {"collective.psum": 0.1}}
+        text = render_timeline(tl)
+        assert "rank(s) [0, 1]" in text and "iteration 0" in text
+
+    def test_same_rank_collective_attribution(self, tmp_path):
+        d = str(tmp_path)
+        _write_blackbox(
+            os.path.join(d, "blackbox.rank0.jsonl"), 0,
+            ts=1000.0, mono_ns=10_000_000_000,
+            events=[
+                (1_000_000_000, "sb", "booster.iteration", {"it": 3}),
+                (2_000_000_000, "collective", "psum", None),
+                (2_400_000_000, "collective_end", "psum", {"dur_s": 0.4}),
+                (3_000_000_000, "se", "booster.iteration", None),
+            ],
+        )
+        from tools.obs import build_timeline
+
+        tl = build_timeline([d])
+        step = tl["steps"][0]
+        assert abs(step["dur_s"] - 2.0) < 1e-6
+        assert abs(step["collective_s"] - 0.4) < 1e-6
+        assert abs(step["compute_s"] - 1.6) < 1e-6
+
+    def test_two_process_bark_produces_mergeable_blackboxes(self, tmp_path):
+        # Acceptance: a forced watchdog bark in a 2-process run leaves
+        # blackbox.rank{0,1}.jsonl that the timeline reader aligns.
+        child = (
+            "import time\n"
+            "from mmlspark_tpu import obs\n"
+            "with obs.span('child.step', it=0):\n"
+            "    obs.inc('child.work')\n"
+            "    with obs.collective_watchdog('forced', timeout_s=0.05):\n"
+            "        time.sleep(0.4)\n"
+            "time.sleep(0.2)\n"  # let the bark's timer-thread dump land
+        )
+        procs = []
+        for rank in range(2):
+            env = dict(
+                os.environ,
+                MMLSPARK_TPU_OBS_FLIGHT_DIR=str(tmp_path),
+                MMLSPARK_TPU_OBS_FLIGHT_MIN_INTERVAL_S="0",
+                MMLSPARK_TPU_PROCESS_ID=str(rank),
+                MMLSPARK_TPU_NUM_PROCESSES="2",
+                PYTHONPATH=REPO,
+            )
+            procs.append(subprocess.Popen(
+                [sys.executable, "-c", child], env=env, cwd=REPO,
+                stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+            ))
+        for p in procs:
+            _, err = p.communicate(timeout=120)
+            assert p.returncode == 0, err
+        files = sorted(os.listdir(tmp_path))
+        assert files == ["blackbox.rank0.jsonl", "blackbox.rank1.jsonl"]
+
+        from tools.obs import build_timeline
+
+        tl = build_timeline([str(tmp_path)])
+        assert tl["ranks"] == [0, 1]
+        for rank in ("0", "1"):
+            assert tl["anchors"][rank]["offset_s"] is not None
+            assert tl["anchors"][rank]["reasons"] == [
+                "watchdog_bark:forced"]
+        # merged stream is wall-ordered and both ranks contributed
+        walls = [e["wall"] for e in tl["events"]]
+        assert walls == sorted(walls)
+        for rank in (0, 1):
+            names = {e["name"] for e in tl["events"] if e["rank"] == rank}
+            assert {"child.step", "child.work", "forced"} <= names
+        # CLI smoke over the same directory
+        from tools.obs.__main__ import main
+
+        assert main(["timeline", str(tmp_path)]) == 0
+
+
+# ------------------------------------------------------------ report --diff
+
+
+class TestReportDiff:
+    def _snap(self, hits, p50, p99):
+        return {
+            "counters": {"jit_cache.hit": hits, "steady": 5},
+            "gauges": {},
+            "histograms": {
+                "predict.latency_s": {
+                    "count": 100, "sum": 10.0, "mean": 0.1,
+                    "min": 0.01, "max": 1.0, "p50": p50, "p95": p99,
+                    "p99": p99,
+                },
+            },
+            "spans": {"predict": {"count": 100, "total_s": 10.0,
+                                  "mean_s": 0.1, "max_s": 1.0}},
+        }
+
+    def test_diff_counters_and_percentiles(self, tmp_path):
+        a = tmp_path / "a.json"
+        b = tmp_path / "b.json"
+        a.write_text(json.dumps(self._snap(10, 0.10, 0.50)))
+        # B as a bench-style output with the snapshot under "obs"
+        b.write_text(json.dumps(
+            {"bench": "serving", "obs": self._snap(25, 0.20, 0.90)}
+        ))
+        from tools.obs import diff_snapshots, render_diff, snapshot_from
+
+        diff = diff_snapshots(snapshot_from(str(a)), snapshot_from(str(b)))
+        assert diff["counters"]["jit_cache.hit"]["delta"] == 15
+        assert diff["counters"]["steady"]["delta"] == 0
+        h = diff["histograms"]["predict.latency_s"]
+        assert abs(h["p50"]["delta"] - 0.10) < 1e-9
+        assert abs(h["p99"]["delta"] - 0.40) < 1e-9
+        text = render_diff(diff, "a.json", "b.json")
+        assert "jit_cache.hit" in text
+        assert "steady" not in text  # unchanged counters stay out
+        assert "predict.latency_s" in text
+
+    def test_diff_cli_over_jsonl_exports(self, tmp_path, capsys):
+        from tools.obs.__main__ import main
+
+        for name, n in (("a.jsonl", 2), ("b.jsonl", 7)):
+            obs.enable(str(tmp_path / name))
+            obs.reset()
+            obs.inc("runs.counter", n)
+            obs.observe("lat_s", 0.1 * n)
+            obs.disable()  # writes the final snapshot record
+        assert main([
+            "report", "--diff",
+            str(tmp_path / "a.jsonl"), str(tmp_path / "b.jsonl"),
+            "--json",
+        ]) == 0
+        diff = json.loads(capsys.readouterr().out)
+        assert diff["counters"]["runs.counter"]["delta"] == 5
+        assert main([
+            "report", "--diff", str(tmp_path / "a.jsonl"),
+            str(tmp_path / "missing.json"),
+        ]) == 2

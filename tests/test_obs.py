@@ -29,20 +29,23 @@ import numpy as np
 import pytest
 
 from mmlspark_tpu import obs
-from mmlspark_tpu.obs import metrics, tracing
+from mmlspark_tpu.obs import flight, metrics, tracing
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
 @pytest.fixture(autouse=True)
 def _clean_obs_state():
-    """Every test starts and ends disabled, empty, with no exporter."""
+    """Every test starts and ends disabled, empty, with no exporter, and
+    with empty (but armed) flight-recorder rings."""
     obs.disable()
     obs.reset()
+    flight.reset()
     yield
     obs.disable()
     obs.reset()
     tracing.close_exporter()
+    flight.reset()
 
 
 def _tiny_train(n_iter=4, seed=0):
@@ -111,9 +114,19 @@ class TestRegistry:
 class TestSpans:
     def test_disabled_is_noop(self):
         assert not obs.enabled()
-        s1, s2 = obs.span("a"), obs.span("b", it=1)
-        assert s1 is s2  # one shared null context, zero allocation
-        with s1:
+        # with the flight recorder ALSO disarmed, the pre-flight contract
+        # holds exactly: one shared null context, zero allocation
+        flight.set_armed(False)
+        try:
+            s1, s2 = obs.span("a"), obs.span("b", it=1)
+            assert s1 is s2
+            with s1:
+                pass
+        finally:
+            flight.set_armed(True)
+        # armed (the default): disabled-mode calls ring blackbox events
+        # but never touch the metric registry
+        with obs.span("a"):
             pass
         obs.inc("x")
         obs.gauge("y", 1)
@@ -122,6 +135,7 @@ class TestSpans:
         snap = obs.snapshot()
         assert snap["enabled"] is False
         assert snap["counters"] == {} and snap["spans"] == {}
+        assert flight.ring_stats()["total_events"] >= 4  # sb+se+ctr+span
 
     def test_enabled_records_nesting(self):
         obs.enable()

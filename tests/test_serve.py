@@ -463,3 +463,88 @@ class TestServingApp:
             assert app.admission.inflight("b") == 0
         finally:
             app.stop(drain_s=2.0)
+
+
+# ------------------------------------- PR 6: trace propagation + formats
+class TestTraceAndMetricsFormats:
+    def test_request_id_echoed_and_minted(self, app):
+        url = f"{app.url}/models/m/predict"
+        status, _, headers = _post(
+            url, {"features": [0.0] * N_FEATURES},
+            headers={"X-Request-Id": "req-42"},
+        )
+        assert status == 200
+        assert headers.get("X-Request-Id") == "req-42"
+        # no inbound id: the server mints one (the transport rid)
+        status, _, headers = _post(url, {"features": [0.0] * N_FEATURES})
+        assert status == 200
+        assert headers.get("X-Request-Id")
+        # immediate error replies echo too
+        status, _, headers = _post(
+            url, {"bogus": 1}, headers={"X-Request-Id": "req-err"}
+        )
+        assert status == 400
+        assert headers.get("X-Request-Id") == "req-err"
+
+    def test_prometheus_metrics_negotiation(self, app):
+        # JSON stays the default
+        status, body = _get(f"{app.url}/metrics")
+        assert status == 200 and body["counters"]
+        # query-arg opt-in
+        with urllib.request.urlopen(
+            f"{app.url}/metrics?format=prometheus", timeout=30
+        ) as r:
+            text = r.read().decode()
+            ctype = r.headers.get("Content-Type", "")
+        assert ctype.startswith("text/plain")
+        assert "# TYPE" in text
+        assert "mmlspark_tpu_serve_" in text
+        # Accept-header opt-in
+        req = urllib.request.Request(
+            f"{app.url}/metrics", headers={"Accept": "text/plain"}
+        )
+        with urllib.request.urlopen(req, timeout=30) as r:
+            assert b"# TYPE" in r.read()
+
+    def test_request_reconstructable_by_tools_obs_trace(
+        self, saved_models, tmp_path
+    ):
+        from mmlspark_tpu.serve import ServingApp
+        from tools.obs import build_trace, render_trace
+
+        path = str(tmp_path / "serve.jsonl")
+        obs.enable(path)  # start() keeps a pre-enabled obs (and its export)
+        app = ServingApp(max_wait_ms=10.0).start()
+        app.add_model("m", path=saved_models["v1"])
+        try:
+            status, _, headers = _post(
+                f"{app.url}/models/m/predict",
+                {"instances": saved_models["X"][:3].tolist()},
+                headers={"X-Request-Id": "req-trace-1"},
+            )
+            assert status == 200
+            assert headers["X-Request-Id"] == "req-trace-1"
+        finally:
+            app.stop(drain_s=5.0)
+            obs.disable()
+
+        tr = build_trace("req-trace-1", [path])
+        assert tr["found"], tr
+        for stage in ("serve.queue_wait", "serve.batch_close_wait",
+                      "serve.reply", "serve.request"):
+            assert stage in tr["stages"], (stage, tr["stages"].keys())
+            assert tr["stages"][stage]["dur_s"] >= 0.0
+        # fan-in link: the batch span lists this request as a member and
+        # binds its own trace id around the booster predict
+        assert tr["batch"] and tr["batch"]["bucket"] == 8
+        assert tr["batch"]["members"] >= 1
+        assert tr["predict"], tr
+        assert tr["stages"]["serve.request"]["attrs"]["bucket"] == 8
+        text = render_trace(tr)
+        assert "req-trace-1" in text and "batch predict" in text
+
+        # CLI contract: 0 when found, 2 when not
+        from tools.obs.__main__ import main
+
+        assert main(["trace", "req-trace-1", path]) == 0
+        assert main(["trace", "req-definitely-absent", path]) == 2

@@ -535,6 +535,69 @@ def test_obs001_suppression_round_trip(tmp_path):
     assert apply_suppressions(check_obs_file(silenced)) == []
 
 
+def test_obs002_span_drops_trace_context(tmp_path):
+    # Seeded bug: request-handling functions (they take items/rid) opening
+    # spans without any trace attr — tools.obs trace can never join them.
+    p = _write(str(tmp_path / "mmlspark_tpu" / "serve" / "m.py"), """
+        from mmlspark_tpu import obs
+        def process(route, items):
+            with obs.span("serve.batch", model=route):
+                pass
+            for item in items:
+                obs.record_span("serve.reply", 0.1)
+    """)
+    found = check_obs_file(p)
+    assert rules(found) == ["OBS002", "OBS002"]
+    assert "trace" in found[0].message
+
+
+def test_obs002_silent_when_trace_propagated(tmp_path):
+    p = _write(str(tmp_path / "mmlspark_tpu" / "parallel" / "m.py"), """
+        from mmlspark_tpu import obs
+        def process(items):
+            with obs.span("serve.batch", members=[i.rid for i in items]):
+                pass
+            obs.record_span("serve.reply", 0.1, rid="r1")
+        def scorer(rid, X):
+            with obs.span("predict", rows=len(X), **obs.trace_attrs()):
+                return X
+        def plain(X):  # no request-scoped params: rule does not apply
+            with obs.span("serve.prewarm", bucket=8):
+                return X
+    """)
+    assert check_obs_file(p) == []
+
+
+def test_obs002_only_fires_in_hot_path_dirs(tmp_path):
+    src = """
+        from mmlspark_tpu import obs
+        def fit(item):
+            with obs.span("booster.iteration"):
+                return item
+    """
+    outside = _write(str(tmp_path / "mmlspark_tpu" / "engine" / "m.py"), src)
+    assert check_obs_file(outside) == []
+    inside = _write(str(tmp_path / "mmlspark_tpu" / "serve" / "m.py"), src)
+    assert rules(check_obs_file(inside)) == ["OBS002"]
+
+
+def test_obs002_suppression_round_trip(tmp_path):
+    src = """
+        from mmlspark_tpu import obs
+        def handle(rid):{supp}
+            with obs.span("serve.anon"):
+                pass
+    """
+    base = str(tmp_path / "mmlspark_tpu" / "serve")
+    fires = _write(os.path.join(base, "a.py"), src.format(supp=""))
+    assert rules(apply_suppressions(check_obs_file(fires))) == ["OBS002"]
+    silenced = _write(
+        os.path.join(base, "b.py"),
+        src.format(supp="  # analyze: ignore[OBS002]"),
+    )
+    assert apply_suppressions(check_obs_file(silenced)) == []
+
+
 # -------------------------------------------------------- serving fixtures
 
 

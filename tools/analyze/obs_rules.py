@@ -11,6 +11,17 @@ Rules
   contract), as is the rare intentional case marked
   ``# analyze: ignore[OBS001]`` (e.g. ``DataFrame.show()``, whose
   contract IS stdout).
+- OBS002: hot-path request handling (``mmlspark_tpu/serve/`` and
+  ``mmlspark_tpu/parallel/``) opening an obs span WITHOUT propagating
+  trace context.  A function that visibly handles request-scoped work
+  (it takes ``item``/``items``/``rid``/``trace_id``/``request_id``)
+  and calls ``obs.span``/``obs.record_span`` with none of the trace
+  attrs (``trace_id``/``rid``/``request_id``/``members``) and no
+  ``**obs.trace_attrs()`` splat produces spans that ``tools.obs trace``
+  can never join to a request — the fan-in links silently break.
+  Propagate one of the trace attrs, splat ``**obs.trace_attrs()``, or
+  mark a deliberately request-anonymous span with
+  ``# analyze: ignore[OBS002]``.
 """
 
 from __future__ import annotations
@@ -20,6 +31,86 @@ import glob
 import os
 
 from tools.analyze.common import Finding
+
+# OBS002 applies only to the request/collective hot paths.
+_OBS002_SUBDIRS = (
+    os.path.join("mmlspark_tpu", "serve") + os.sep,
+    os.path.join("mmlspark_tpu", "parallel") + os.sep,
+)
+# A function visibly handling request-scoped work names one of these.
+_TRACE_PARAM_HINTS = {"item", "items", "rid", "trace_id", "request_id"}
+# Any of these keywords on the span call counts as propagation.
+_TRACE_ATTR_KEYS = {"trace_id", "rid", "request_id", "members", "trace"}
+
+
+def _is_obs_span_call(node: ast.Call) -> bool:
+    """``obs.span(...)`` or ``obs.record_span(...)``."""
+    f = node.func
+    return (
+        isinstance(f, ast.Attribute)
+        and f.attr in ("span", "record_span")
+        and isinstance(f.value, ast.Name)
+        and f.value.id == "obs"
+    )
+
+
+def _propagates_trace(node: ast.Call) -> bool:
+    """True when the span call carries trace context: a trace-attr
+    keyword, or a ``**obs.trace_attrs()`` (or any ``*trace*``-named
+    mapping) splat."""
+    for kw in node.keywords:
+        if kw.arg is None:  # **splat
+            v = kw.value
+            if (
+                isinstance(v, ast.Call)
+                and isinstance(v.func, ast.Attribute)
+                and v.func.attr == "trace_attrs"
+            ):
+                return True
+            if isinstance(v, ast.Name) and "trace" in v.id:
+                return True
+        elif kw.arg in _TRACE_ATTR_KEYS:
+            return True
+    return False
+
+
+def _check_obs002(path: str, tree: ast.AST) -> list:
+    rel = os.path.abspath(path)
+    if not any(sub in rel for sub in _OBS002_SUBDIRS):
+        return []
+    findings = []
+    for fn in ast.walk(tree):
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        args = fn.args
+        names = {
+            a.arg
+            for a in (
+                list(args.posonlyargs) + list(args.args)
+                + list(args.kwonlyargs)
+            )
+        }
+        if not names & _TRACE_PARAM_HINTS:
+            continue
+        for node in ast.walk(fn):
+            if (
+                isinstance(node, ast.Call)
+                and _is_obs_span_call(node)
+                and not _propagates_trace(node)
+            ):
+                findings.append(
+                    Finding(
+                        path, node.lineno, "OBS002",
+                        f"span in request-handling function "
+                        f"{fn.name}() drops trace context — pass "
+                        "trace_id=/rid=/members= or splat "
+                        "**obs.trace_attrs() so tools.obs trace can "
+                        "join it to the request, or mark a deliberately "
+                        "request-anonymous span with "
+                        "# analyze: ignore[OBS002]",
+                    )
+                )
+    return findings
 
 
 def check_obs_file(path: str) -> list:
@@ -44,6 +135,7 @@ def check_obs_file(path: str) -> list:
                     "stdout contract with # analyze: ignore[OBS001]",
                 )
             )
+    findings.extend(_check_obs002(path, tree))
     return findings
 
 

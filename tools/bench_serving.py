@@ -369,8 +369,16 @@ def main(argv=None) -> int:
     check_app.add_model("bench", path=v1)
     with urllib.request.urlopen(check_app.url + "/metrics", timeout=10) as r:
         metrics_body = json.loads(r.read().decode())
+    with urllib.request.urlopen(
+        check_app.url + "/metrics?format=prometheus", timeout=10
+    ) as r:
+        prom_body = r.read().decode()
+        prom_ctype = r.headers.get("Content-Type", "")
     check_app.stop()
     report["metrics_nonempty"] = bool(metrics_body.get("counters"))
+    report["prometheus_nonempty"] = (
+        "# TYPE" in prom_body and prom_ctype.startswith("text/plain")
+    )
 
     if "baseline" in report and report["baseline"]["throughput_rps"]:
         report["speedup_vs_seed"] = round(
@@ -396,6 +404,9 @@ def main(argv=None) -> int:
             failures.append("dynamic phase served zero requests")
         if not report["metrics_nonempty"]:
             failures.append("/metrics snapshot was empty")
+        if not report["prometheus_nonempty"]:
+            failures.append("/metrics?format=prometheus was empty or "
+                            "mis-typed")
         if report["dynamic"]["swap"]["swaps"] < 1:
             failures.append("hot-swap did not complete")
         jc = report["dynamic"]["jit_cache"]

@@ -1,10 +1,22 @@
-"""tools.obs — offline reporting over ``mmlspark_tpu.obs`` JSONL exports.
+"""tools.obs — offline reporting over ``mmlspark_tpu.obs`` JSONL exports
+and ``blackbox.rank<R>.jsonl`` flight-recorder dumps.
 
-``python -m tools.obs report [--json] [path]`` aggregates the span records
-(and the final snapshot record each rank appends at exit) from a
-``MMLSPARK_TPU_OBS=<path>`` run.  Multi-process runs write per-rank files
-(``<path>.rank<R>``); the report reads the base path plus every rank
-sibling it finds.
+- ``python -m tools.obs report [--json] [path]`` aggregates the span
+  records (and the final snapshot record each rank appends at exit) from
+  a ``MMLSPARK_TPU_OBS=<path>`` run.  Multi-process runs write per-rank
+  files (``<path>.rank<R>``); the report reads the base path plus every
+  rank sibling it finds.
+- ``python -m tools.obs report --diff A B`` diffs two runs' snapshots
+  (counter deltas, histogram p50/p99 shifts) — each side may be a JSONL
+  export, a raw snapshot JSON, or a ``tools/bench_*.py`` output JSON
+  (whose embedded ``"obs"`` key is found automatically).
+- ``python -m tools.obs timeline <paths...>`` merges per-rank blackbox
+  dumps (and/or exports) onto one wall clock via each dump's paired
+  wall/monotonic anchor, with per-step compute vs collective-wait
+  attribution.
+- ``python -m tools.obs trace <request_id>`` reconstructs one serving
+  request's critical path (queue wait → batch-close wait → predict →
+  reply) across the request/batch trace-id fan-in.
 
 Pure stdlib — usable on a machine without jax installed.
 """
@@ -143,3 +155,564 @@ def default_path() -> Optional[str]:
     if raw and raw.lower() not in ("0", "1", "false", "true", "off", "on"):
         return raw
     return None
+
+
+# ---------------------------------------------------------------------------
+# Flight-recorder (blackbox) reading.
+#
+# A blackbox file is a sequence of dump SEGMENTS: one ``flight_header``
+# line (with a paired ``ts``/``mono_ns`` wall/monotonic anchor) followed
+# by its ``flight`` event lines carrying raw ``t_ns`` monotonic stamps.
+# Each event's wall time is ``header.ts - (header.mono_ns - t_ns)/1e9`` —
+# per-rank monotonic clocks never cross files; only reconstructed wall
+# times are merged.
+# ---------------------------------------------------------------------------
+
+
+def discover_blackbox(path: str) -> List[str]:
+    """Blackbox files named by ``path``: a directory (its
+    ``blackbox.rank*.jsonl`` children), a blackbox file itself, or an obs
+    export base path (blackbox siblings in its directory)."""
+    if os.path.isdir(path):
+        return sorted(glob.glob(os.path.join(glob.escape(path),
+                                             "blackbox.rank*.jsonl")))
+    base = os.path.basename(path)
+    if base.startswith("blackbox.") and os.path.isfile(path):
+        return [path]
+    d = os.path.dirname(os.path.abspath(path))
+    return sorted(glob.glob(os.path.join(glob.escape(d),
+                                         "blackbox.rank*.jsonl")))
+
+
+def load_blackbox(path: str) -> List[dict]:
+    """Events from one blackbox file, each with a reconstructed ``wall``
+    timestamp and its segment's dump ``reason`` attached."""
+    events: List[dict] = []
+    header: Optional[dict] = None
+    with open(path, "r") as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue
+            kind = rec.get("kind")
+            if kind == "flight_header":
+                header = rec
+            elif kind == "flight" and header is not None:
+                try:
+                    wall = float(header["ts"]) - (
+                        int(header["mono_ns"]) - int(rec["t_ns"])
+                    ) / 1e9
+                except (KeyError, TypeError, ValueError):
+                    continue
+                events.append({
+                    "rank": rec.get("rank", header.get("rank", 0)),
+                    "wall": wall,
+                    "ev": rec.get("ev", "?"),
+                    "name": rec.get("name", "?"),
+                    "thread": rec.get("thread", "?"),
+                    "detail": rec.get("detail"),
+                    "reason": header.get("reason", "?"),
+                    "src": "flight",
+                })
+    return events
+
+
+def _blackbox_anchors(path: str) -> List[dict]:
+    """All ``flight_header`` records in a blackbox file."""
+    out = []
+    with open(path, "r") as f:
+        for line in f:
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue
+            if isinstance(rec, dict) and rec.get("kind") == "flight_header":
+                out.append(rec)
+    return out
+
+
+def _export_events(path: str) -> List[dict]:
+    """Obs-export span records as timeline events (wall START time =
+    record ``ts`` minus the measured duration; exports stamp wall time at
+    span close)."""
+    events = []
+    for rec in load_records(path):
+        if rec.get("kind") != "span":
+            continue
+        try:
+            ts = float(rec["ts"])
+            dur = float(rec.get("dur_s", 0.0))
+        except (KeyError, TypeError, ValueError):
+            continue
+        events.append({
+            "rank": rec.get("rank", 0),
+            "wall": ts - dur,
+            "ev": "span",
+            "name": rec.get("name", "?"),
+            "thread": "?",
+            "detail": {"dur_s": dur, **(rec.get("attrs") or {})},
+            "reason": "export",
+            "src": "export",
+        })
+    return events
+
+
+def _gather_timeline_events(paths: List[str]):
+    """(files, events) across blackbox dumps and obs exports."""
+    files: List[str] = []
+    events: List[dict] = []
+    for p in paths:
+        bb = discover_blackbox(p)
+        for fn in bb:
+            if fn not in files:
+                files.append(fn)
+                events.extend(load_blackbox(fn))
+        if not os.path.isdir(p) and not os.path.basename(p).startswith(
+            "blackbox."
+        ):
+            for fn in discover_files(p):
+                if fn not in files:
+                    files.append(fn)
+            events.extend(_export_events(p))
+    events.sort(key=lambda e: e["wall"])
+    return files, events
+
+
+def _pair_flight_spans(events: List[dict]) -> List[dict]:
+    """Match ``sb``/``se`` ring events into completed spans (per
+    rank+thread, stack-wise, by name) and pass through pre-measured
+    ``span`` events; returns span dicts with start/dur/attrs."""
+    spans: List[dict] = []
+    stacks: Dict[tuple, list] = {}
+    for e in events:
+        if e["ev"] == "span":
+            d = dict(e["detail"] or {})
+            dur = float(d.pop("dur_s", 0.0) or 0.0)
+            spans.append({"rank": e["rank"], "name": e["name"],
+                          "start": e["wall"] - dur, "dur_s": dur,
+                          "attrs": d})
+        elif e["ev"] == "sb":
+            stacks.setdefault((e["rank"], e["thread"]), []).append(e)
+        elif e["ev"] == "se":
+            stack = stacks.get((e["rank"], e["thread"]), [])
+            for i in range(len(stack) - 1, -1, -1):
+                if stack[i]["name"] == e["name"]:
+                    sb = stack.pop(i)
+                    spans.append({
+                        "rank": e["rank"], "name": e["name"],
+                        "start": sb["wall"],
+                        "dur_s": max(0.0, e["wall"] - sb["wall"]),
+                        "attrs": dict(sb["detail"] or {}),
+                    })
+                    break
+        elif e["ev"] == "collective_end":
+            d = dict(e["detail"] or {})
+            dur = float(d.pop("dur_s", 0.0) or 0.0)
+            spans.append({"rank": e["rank"],
+                          "name": f"collective.{e['name']}",
+                          "start": e["wall"] - dur, "dur_s": dur,
+                          "attrs": d})
+    return spans
+
+
+def build_timeline(paths: List[str], step_span: str = "booster.iteration"
+                   ) -> dict:
+    """Merge per-rank blackbox/export files onto one wall clock.
+
+    Returns anchors (per-rank wall-minus-monotonic offsets — the
+    alignment), the merged event list, per-step compute vs
+    collective-wait attribution (collective time = watchdog-wrapped
+    collective spans ENDING inside a ``step_span`` interval on the same
+    rank), and per-rank collective totals."""
+    files, events = _gather_timeline_events(paths)
+    spans = _pair_flight_spans(events)
+
+    anchors: Dict[str, dict] = {}
+    for fn in files:
+        if not os.path.basename(fn).startswith("blackbox."):
+            continue
+        for h in _blackbox_anchors(fn):
+            rank = str(h.get("rank", 0))
+            a = anchors.setdefault(
+                rank, {"offset_s": None, "reasons": [], "segments": 0}
+            )
+            a["segments"] += 1
+            a["reasons"].append(h.get("reason", "?"))
+            try:
+                # Wall-clock instant of this rank's monotonic epoch: the
+                # cross-rank alignment constant.
+                a["offset_s"] = float(h["ts"]) - int(h["mono_ns"]) / 1e9
+            except (KeyError, TypeError, ValueError):
+                pass
+
+    collectives = [s for s in spans if s["name"].startswith("collective.")]
+    col_totals: Dict[str, Dict[str, float]] = {}
+    for c in collectives:
+        per = col_totals.setdefault(str(c["rank"]), {})
+        per[c["name"]] = per.get(c["name"], 0.0) + c["dur_s"]
+
+    steps = []
+    for s in spans:
+        if s["name"] != step_span:
+            continue
+        end = s["start"] + s["dur_s"]
+        col_s = sum(
+            c["dur_s"] for c in collectives
+            if c["rank"] == s["rank"]
+            and s["start"] <= c["start"] + c["dur_s"] <= end
+        )
+        steps.append({
+            "rank": s["rank"],
+            "start": s["start"],
+            "dur_s": s["dur_s"],
+            "collective_s": col_s,
+            "compute_s": max(0.0, s["dur_s"] - col_s),
+            "attrs": s["attrs"],
+        })
+    steps.sort(key=lambda s: s["start"])
+
+    return {
+        "files": files,
+        "ranks": sorted({e["rank"] for e in events}),
+        "anchors": anchors,
+        "events": events,
+        "spans": spans,
+        "steps": steps,
+        "collective_totals": col_totals,
+    }
+
+
+def render_timeline(tl: dict, max_events: int = 200) -> str:
+    out: List[str] = []
+    out.append(
+        f"obs timeline — {len(tl['files'])} file(s), "
+        f"{len(tl['events'])} event(s), rank(s) {tl['ranks'] or [0]}"
+    )
+    for rank in sorted(tl["anchors"]):
+        a = tl["anchors"][rank]
+        off = a["offset_s"]
+        out.append(
+            f"  rank {rank}: {a['segments']} dump segment(s) "
+            f"({', '.join(a['reasons'])}); monotonic epoch at wall "
+            f"{off:.6f}" if off is not None else
+            f"  rank {rank}: {a['segments']} dump segment(s)"
+        )
+    if tl["steps"]:
+        out.append("")
+        out.append(
+            f"  {'step':<28} {'rank':>4} {'dur_s':>10} "
+            f"{'compute_s':>10} {'collective_s':>13}"
+        )
+        for i, s in enumerate(tl["steps"]):
+            label = str((s["attrs"] or {}).get("it", i))
+            out.append(
+                f"  {'iteration ' + label:<28} {s['rank']:>4} "
+                f"{s['dur_s']:>10.4f} {s['compute_s']:>10.4f} "
+                f"{s['collective_s']:>13.4f}"
+            )
+    if tl["collective_totals"]:
+        out.append("")
+        out.append("  collective wait totals:")
+        for rank in sorted(tl["collective_totals"]):
+            for name, tot in sorted(tl["collective_totals"][rank].items()):
+                out.append(f"    rank {rank} {name:<32} {tot:>10.4f}s")
+    events = tl["events"]
+    if events:
+        t0 = events[0]["wall"]
+        shown = events[-max_events:]
+        out.append("")
+        out.append(
+            f"  merged events (last {len(shown)} of {len(events)}; "
+            f"t=0 at first event):"
+        )
+        for e in shown:
+            detail = ""
+            if e["detail"]:
+                detail = " " + json.dumps(e["detail"], sort_keys=True,
+                                          default=str)
+            out.append(
+                f"    +{e['wall'] - t0:10.6f}s rank{e['rank']} "
+                f"[{e['thread']}] {e['ev']:<14} {e['name']}{detail}"
+            )
+    if not events:
+        out.append("  (no events)")
+    return "\n".join(out)
+
+
+# ---------------------------------------------------------------------------
+# Per-request trace reconstruction.
+#
+# serve/app.py mints one trace id per request (honoring X-Request-Id) and
+# records per-stage spans carrying ``rid``; the batch fan-in span
+# (``serve.batch``) lists its ``members`` and binds its OWN batch trace id
+# around predict, so the request → batch → predict chain is joined here.
+# ---------------------------------------------------------------------------
+
+_TRACE_STAGES = (
+    "serve.queue_wait",
+    "serve.batch_close_wait",
+    "serve.reply",
+    "serve.request",
+)
+
+
+def build_trace(request_id: str, paths: List[str]) -> dict:
+    """Reconstruct one request's critical path from exports/blackboxes."""
+    _, events = _gather_timeline_events(paths)
+    spans = _pair_flight_spans(events)
+
+    def attr(s, k):
+        return (s.get("attrs") or {}).get(k)
+
+    mine = [s for s in spans
+            if attr(s, "rid") == request_id
+            or attr(s, "trace_id") == request_id]
+    stages: Dict[str, dict] = {}
+    for s in mine:
+        if s["name"] in _TRACE_STAGES and s["name"] not in stages:
+            stages[s["name"]] = {"dur_s": s["dur_s"], "start": s["start"],
+                                 "attrs": s["attrs"]}
+
+    batch_id = None
+    for s in mine:
+        if attr(s, "batch"):
+            batch_id = attr(s, "batch")
+            break
+    batch = None
+    for s in spans:
+        members = attr(s, "members") or []
+        if s["name"] == "serve.batch" and (
+            (batch_id and attr(s, "batch") == batch_id)
+            or request_id in members
+        ):
+            batch_id = attr(s, "batch") or batch_id
+            batch = {
+                "batch_id": batch_id,
+                "dur_s": s["dur_s"],
+                "model": attr(s, "model"),
+                "bucket": attr(s, "bucket"),
+                "rows": attr(s, "rows"),
+                "members": len(members),
+            }
+            break
+    predict = [
+        {"dur_s": s["dur_s"], "backend": attr(s, "backend"),
+         "bucket": attr(s, "bucket"), "rows": attr(s, "rows")}
+        for s in spans
+        if s["name"] == "predict"
+        and attr(s, "trace_id") in ((batch_id, request_id) if batch_id
+                                    else (request_id,))
+    ]
+    admits = [
+        e for e in events
+        if e["ev"] == "admit" and (e["detail"] or {}).get("rid") == request_id
+    ]
+    return {
+        "request_id": request_id,
+        "found": bool(mine or admits),
+        "stages": stages,
+        "batch": batch,
+        "predict": predict,
+        "admits": [{"verdict": e["name"], "wall": e["wall"],
+                    "route": (e["detail"] or {}).get("route")}
+                   for e in admits],
+    }
+
+
+def render_trace(tr: dict) -> str:
+    out = [f"obs trace — request {tr['request_id']}"]
+    if not tr["found"]:
+        out.append("  (no records found for this request id)")
+        return "\n".join(out)
+    for a in tr["admits"]:
+        out.append(f"  admission: {a['verdict']} (route {a['route']})")
+    order = list(_TRACE_STAGES)
+    labels = {
+        "serve.queue_wait": "queue wait",
+        "serve.batch_close_wait": "batch-close wait",
+        "serve.reply": "reply",
+        "serve.request": "TOTAL (enqueue -> replied)",
+    }
+    for name in order[:2]:
+        if name in tr["stages"]:
+            out.append(
+                f"  {labels[name]:<28} {tr['stages'][name]['dur_s']:.6f}s"
+            )
+    if tr["batch"]:
+        b = tr["batch"]
+        out.append(
+            f"  {'batch predict':<28} {b['dur_s']:.6f}s  "
+            f"(batch {b['batch_id']}, model {b['model']}, "
+            f"bucket {b['bucket']}, {b['rows']} rows, "
+            f"{b['members']} member request(s))"
+        )
+    for p in tr["predict"]:
+        out.append(
+            f"  {'  booster predict':<28} {p['dur_s']:.6f}s  "
+            f"(backend {p['backend']}, bucket {p['bucket']})"
+        )
+    for name in order[2:]:
+        if name in tr["stages"]:
+            out.append(
+                f"  {labels[name]:<28} {tr['stages'][name]['dur_s']:.6f}s"
+            )
+    st = tr["stages"].get("serve.request")
+    if st and st.get("attrs", {}).get("bucket") is not None:
+        out.append(f"  padding bucket: {st['attrs']['bucket']}")
+    return "\n".join(out)
+
+
+# ---------------------------------------------------------------------------
+# Snapshot diffing (report --diff A B).
+# ---------------------------------------------------------------------------
+
+
+def _merge_snapshots(snaps: List[dict]) -> dict:
+    """Fold per-rank snapshots into one: counters/sums add, gauges take
+    the last writer, histogram percentiles take the max across ranks (a
+    conservative approximation — exact merge would need raw samples)."""
+    out = {"counters": {}, "gauges": {}, "histograms": {}, "spans": {}}
+    for snap in snaps:
+        for k, v in (snap.get("counters") or {}).items():
+            out["counters"][k] = out["counters"].get(k, 0.0) + float(v)
+        for k, v in (snap.get("gauges") or {}).items():
+            out["gauges"][k] = float(v)
+        for k, h in (snap.get("histograms") or {}).items():
+            if not h.get("count"):
+                out["histograms"].setdefault(k, {"count": 0})
+                continue
+            m = out["histograms"].get(k)
+            if not m or not m.get("count"):
+                out["histograms"][k] = dict(h)
+                continue
+            m["count"] += h["count"]
+            m["sum"] = m.get("sum", 0.0) + h.get("sum", 0.0)
+            m["mean"] = m["sum"] / m["count"]
+            m["min"] = min(m.get("min", h["min"]), h["min"])
+            m["max"] = max(m.get("max", h["max"]), h["max"])
+            for p in ("p50", "p95", "p99"):
+                if p in h:
+                    m[p] = max(m.get(p, h[p]), h[p])
+        for k, s in (snap.get("spans") or {}).items():
+            m = out["spans"].get(k)
+            if m is None:
+                out["spans"][k] = dict(s)
+                continue
+            m["count"] += s.get("count", 0)
+            m["total_s"] += s.get("total_s", 0.0)
+            m["max_s"] = max(m.get("max_s", 0.0), s.get("max_s", 0.0))
+            m["mean_s"] = m["total_s"] / m["count"] if m["count"] else 0.0
+    return out
+
+
+def snapshot_from(path: str) -> dict:
+    """A merged obs snapshot from ``path``: a JSONL export (per-rank
+    snapshots merged), a raw ``obs.snapshot()`` JSON, or a bench output
+    JSON carrying the snapshot under its ``"obs"`` key."""
+    try:
+        with open(path, "r") as f:
+            d = json.load(f)
+    except ValueError:
+        d = None  # more than one JSON document: a JSONL export
+    if isinstance(d, dict):
+        if "counters" in d or "histograms" in d:
+            return d
+        if isinstance(d.get("obs"), dict):
+            return d["obs"]
+        if isinstance(d.get("snapshot"), dict):
+            return d["snapshot"]
+        raise ValueError(f"{path}: no obs snapshot found in JSON")
+    report = aggregate(load_records(path))
+    snaps = [report["snapshots"][r] for r in sorted(report["snapshots"])]
+    if not snaps:
+        raise ValueError(f"{path}: no snapshot records in export")
+    return _merge_snapshots(snaps)
+
+
+def diff_snapshots(a: dict, b: dict) -> dict:
+    """B minus A: counter deltas, histogram p50/p99 shifts, span-aggregate
+    shifts.  Keys present on either side are included."""
+    out = {"counters": {}, "histograms": {}, "spans": {}}
+    ca, cb = a.get("counters") or {}, b.get("counters") or {}
+    for k in sorted(set(ca) | set(cb)):
+        va, vb = float(ca.get(k, 0.0)), float(cb.get(k, 0.0))
+        out["counters"][k] = {"a": va, "b": vb, "delta": vb - va}
+    ha, hb = a.get("histograms") or {}, b.get("histograms") or {}
+    for k in sorted(set(ha) | set(hb)):
+        xa, xb = ha.get(k) or {}, hb.get(k) or {}
+        ent = {"count": {"a": xa.get("count", 0), "b": xb.get("count", 0)}}
+        for p in ("p50", "p99"):
+            pa, pb = xa.get(p), xb.get(p)
+            ent[p] = {
+                "a": pa, "b": pb,
+                "delta": (pb - pa) if pa is not None and pb is not None
+                else None,
+            }
+        out["histograms"][k] = ent
+    sa, sb = a.get("spans") or {}, b.get("spans") or {}
+    for k in sorted(set(sa) | set(sb)):
+        xa, xb = sa.get(k) or {}, sb.get(k) or {}
+        out["spans"][k] = {
+            "count": {"a": xa.get("count", 0), "b": xb.get("count", 0)},
+            "total_s": {
+                "a": xa.get("total_s", 0.0), "b": xb.get("total_s", 0.0),
+                "delta": xb.get("total_s", 0.0) - xa.get("total_s", 0.0),
+            },
+        }
+    return out
+
+
+def render_diff(diff: dict, label_a: str = "A", label_b: str = "B") -> str:
+    out = [f"obs diff — {label_a} -> {label_b}"]
+    changed = {
+        k: v for k, v in diff["counters"].items() if v["delta"] != 0
+    }
+    if changed:
+        out.append("")
+        out.append(f"  {'counter':<44} {'a':>12} {'b':>12} {'delta':>12}")
+        for k, v in changed.items():
+            out.append(
+                f"  {k:<44} {v['a']:>12g} {v['b']:>12g} {v['delta']:>+12g}"
+            )
+    shifted = {
+        k: v for k, v in diff["histograms"].items()
+        if any(v[p]["delta"] for p in ("p50", "p99")
+               if v[p]["delta"] is not None)
+    }
+    if shifted:
+        out.append("")
+        out.append(
+            f"  {'histogram':<44} {'p50 a':>10} {'p50 b':>10} "
+            f"{'p99 a':>10} {'p99 b':>10}"
+        )
+
+        def g(x):
+            return f"{x:.4g}" if x is not None else "-"
+
+        for k, v in shifted.items():
+            out.append(
+                f"  {k:<44} {g(v['p50']['a']):>10} {g(v['p50']['b']):>10} "
+                f"{g(v['p99']['a']):>10} {g(v['p99']['b']):>10}"
+            )
+    spans = {
+        k: v for k, v in diff["spans"].items() if v["total_s"]["delta"]
+    }
+    if spans:
+        out.append("")
+        out.append(
+            f"  {'span':<44} {'total_s a':>12} {'total_s b':>12} "
+            f"{'delta':>12}"
+        )
+        for k, v in spans.items():
+            t = v["total_s"]
+            out.append(
+                f"  {k:<44} {t['a']:>12.4f} {t['b']:>12.4f} "
+                f"{t['delta']:>+12.4f}"
+            )
+    if len(out) == 1:
+        out.append("  (no differences)")
+    return "\n".join(out)

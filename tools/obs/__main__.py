@@ -1,8 +1,17 @@
-"""``python -m tools.obs report [--json] [path]`` — summarize a
-``MMLSPARK_TPU_OBS`` JSONL export (path defaults to that env var).
+"""``python -m tools.obs <report|timeline|trace> ...`` — offline readers
+for ``MMLSPARK_TPU_OBS`` JSONL exports and flight-recorder blackboxes.
 
-Exit 0 on success (even for an empty export), 2 when no export file can
-be found — so CI smoke steps fail loudly if instrumentation vanished.
+- ``report [--json] [--diff A B] [path]`` — aggregate one export, or diff
+  two runs' snapshots (counter deltas, histogram p50/p99 shifts).
+- ``timeline [--json] <paths...>`` — merge per-rank ``blackbox.rank<R>``
+  dumps (and/or exports) on the shared wall clock, with per-step compute
+  vs collective-wait attribution.
+- ``trace <request_id> [paths...]`` — reconstruct one serving request's
+  critical path.
+
+Exit 0 on success (even for an empty export), 2 when the named files (or
+the traced request) cannot be found — so CI smoke steps fail loudly if
+instrumentation vanished.
 """
 
 from __future__ import annotations
@@ -11,22 +20,43 @@ import argparse
 import json
 import sys
 
-from tools.obs import build_report, default_path, discover_files, render_text
+from tools.obs import (
+    build_report,
+    build_timeline,
+    build_trace,
+    default_path,
+    diff_snapshots,
+    discover_blackbox,
+    discover_files,
+    render_diff,
+    render_text,
+    render_timeline,
+    render_trace,
+    snapshot_from,
+)
 
 
-def main(argv=None) -> int:
-    ap = argparse.ArgumentParser(prog="python -m tools.obs")
-    sub = ap.add_subparsers(dest="cmd", required=True)
-    rep = sub.add_parser("report", help="aggregate a JSONL export")
-    rep.add_argument(
-        "path",
-        nargs="?",
-        default=None,
-        help="export file (default: $MMLSPARK_TPU_OBS)",
-    )
-    rep.add_argument("--json", action="store_true", help="machine output")
-    ns = ap.parse_args(argv)
+def _emit(text: str) -> int:
+    try:
+        print(text)
+    except BrokenPipeError:
+        pass  # report | head is fine
+    return 0
 
+
+def _cmd_report(ns) -> int:
+    if ns.diff:
+        a_path, b_path = ns.diff
+        try:
+            a, b = snapshot_from(a_path), snapshot_from(b_path)
+        except (OSError, ValueError) as e:
+            print(f"tools.obs report --diff: {e}", file=sys.stderr)
+            return 2
+        diff = diff_snapshots(a, b)
+        if ns.json:
+            return _emit(json.dumps(diff, indent=2, sort_keys=True,
+                                    default=str))
+        return _emit(render_diff(diff, a_path, b_path))
     path = ns.path or default_path()
     if not path:
         print(
@@ -38,14 +68,109 @@ def main(argv=None) -> int:
         print(f"tools.obs report: no export found at {path}", file=sys.stderr)
         return 2
     report = build_report(path)
-    try:
-        if ns.json:
-            print(json.dumps(report, indent=2, sort_keys=True, default=str))
-        else:
-            print(render_text(report, report["files"]))
-    except BrokenPipeError:
-        return 0  # report | head is fine
-    return 0
+    if ns.json:
+        return _emit(json.dumps(report, indent=2, sort_keys=True,
+                                default=str))
+    return _emit(render_text(report, report["files"]))
+
+
+def _default_paths(paths):
+    if paths:
+        return paths
+    p = default_path()
+    return [p] if p else []
+
+
+def _cmd_timeline(ns) -> int:
+    paths = _default_paths(ns.paths)
+    if not paths:
+        print(
+            "tools.obs timeline: no paths given and MMLSPARK_TPU_OBS unset",
+            file=sys.stderr,
+        )
+        return 2
+    if not any(discover_blackbox(p) or discover_files(p) for p in paths):
+        print(
+            f"tools.obs timeline: no blackbox or export files at {paths}",
+            file=sys.stderr,
+        )
+        return 2
+    tl = build_timeline(paths, step_span=ns.step_span)
+    if ns.json:
+        return _emit(json.dumps(tl, indent=2, sort_keys=True, default=str))
+    return _emit(render_timeline(tl, max_events=ns.max_events))
+
+
+def _cmd_trace(ns) -> int:
+    paths = _default_paths(ns.paths)
+    if not paths:
+        print(
+            "tools.obs trace: no paths given and MMLSPARK_TPU_OBS unset",
+            file=sys.stderr,
+        )
+        return 2
+    tr = build_trace(ns.request_id, paths)
+    if ns.json:
+        _emit(json.dumps(tr, indent=2, sort_keys=True, default=str))
+    else:
+        _emit(render_trace(tr))
+    return 0 if tr["found"] else 2
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="python -m tools.obs")
+    sub = ap.add_subparsers(dest="cmd", required=True)
+
+    rep = sub.add_parser("report", help="aggregate a JSONL export")
+    rep.add_argument(
+        "path",
+        nargs="?",
+        default=None,
+        help="export file (default: $MMLSPARK_TPU_OBS)",
+    )
+    rep.add_argument(
+        "--diff",
+        nargs=2,
+        metavar=("A", "B"),
+        help="diff two runs' snapshots (exports, snapshot JSONs, or "
+             "bench output JSONs)",
+    )
+    rep.add_argument("--json", action="store_true", help="machine output")
+
+    tml = sub.add_parser(
+        "timeline", help="merge per-rank blackbox dumps on one wall clock"
+    )
+    tml.add_argument(
+        "paths",
+        nargs="*",
+        help="blackbox files, directories, or export paths "
+             "(default: $MMLSPARK_TPU_OBS)",
+    )
+    tml.add_argument(
+        "--step-span",
+        default="booster.iteration",
+        help="span name used for per-step compute/collective attribution",
+    )
+    tml.add_argument("--max-events", type=int, default=200)
+    tml.add_argument("--json", action="store_true", help="machine output")
+
+    trc = sub.add_parser(
+        "trace", help="reconstruct one serving request's critical path"
+    )
+    trc.add_argument("request_id", help="the X-Request-Id to reconstruct")
+    trc.add_argument(
+        "paths",
+        nargs="*",
+        help="export/blackbox paths (default: $MMLSPARK_TPU_OBS)",
+    )
+    trc.add_argument("--json", action="store_true", help="machine output")
+
+    ns = ap.parse_args(argv)
+    if ns.cmd == "report":
+        return _cmd_report(ns)
+    if ns.cmd == "timeline":
+        return _cmd_timeline(ns)
+    return _cmd_trace(ns)
 
 
 if __name__ == "__main__":
