@@ -181,12 +181,24 @@ class ModelRegistry:
     @contextmanager
     def lease(self, name: str):
         """``with registry.lease(name) as mv: mv.model...`` — pins the
-        current version for the duration (swaps drain around it)."""
-        with self._lock:
-            mv = self._routes.get(name)
+        current version for the duration (swaps drain around it).
+
+        The version ref is taken OUTSIDE the registry lock (holding
+        ``self._lock`` across ``mv.acquire()`` nests two locks — the
+        LCK001 shape).  Acquire-then-recheck instead: if a swap flipped
+        the route between the lookup and the acquire, drop the ref and
+        lease the new current version.
+        """
+        while True:
+            with self._lock:
+                mv = self._routes.get(name)
             if mv is None:
                 raise KeyError(f"unknown route {name!r}")
             mv.acquire()
+            with self._lock:
+                if self._routes.get(name) is mv:
+                    break
+            mv.release()  # lost a race with swap(); retry on the new mv
         try:
             yield mv
         finally:

@@ -929,3 +929,554 @@ def test_cli_exit_codes_and_json(tmp_path, capsys):
     assert main(["--root", str(tmp_path)]) == 1
     out = capsys.readouterr().out
     assert "ABI001" in out and "k.cpp:3" in out
+
+
+# ----------------------------------------------------- engine (ISSUE 7)
+# The project index + the interprocedural passes.  Fixture trees are
+# full mini-repos (root/mmlspark_tpu/...) because these rules only make
+# sense across module boundaries.
+
+
+def _pkg_tree(tmp_path, files):
+    """root/mmlspark_tpu/<rel> for every (rel -> text), with package
+    __init__.py files auto-created."""
+    root = str(tmp_path)
+    pkg = os.path.join(root, "mmlspark_tpu")
+    _write(os.path.join(pkg, "__init__.py"), "")
+    for rel, text in files.items():
+        path = os.path.join(pkg, rel)
+        _write(path, text)
+        d = os.path.dirname(path)
+        while len(d) > len(pkg):
+            init = os.path.join(d, "__init__.py")
+            if not os.path.exists(init):
+                _write(init, "")
+            d = os.path.dirname(d)
+    return root
+
+
+def test_engine_index_resolves_cross_module_calls(tmp_path):
+    from tools.analyze.engine import build_index
+
+    root = _pkg_tree(tmp_path, {
+        "a.py": """
+            from mmlspark_tpu.b import helper
+
+            def top():
+                return helper()
+        """,
+        "b.py": """
+            def helper():
+                return 1
+        """,
+    })
+    index = build_index(root)
+    fi = index.modules["mmlspark_tpu.a"].defs["top"]
+    (site,) = fi.calls
+    assert site.callee is index.modules["mmlspark_tpu.b"].defs["helper"]
+
+
+def test_engine_index_attr_alias_and_guard_context(tmp_path):
+    from tools.analyze.engine import build_index
+
+    root = _pkg_tree(tmp_path, {
+        "serve/app.py": """
+            class App:
+                def __init__(self, server):
+                    server.intake = self._intake
+
+                def _intake(self, rid):
+                    if rid > 0:
+                        self._dispatch(rid)
+
+                def _dispatch(self, rid):
+                    pass
+        """,
+    })
+    index = build_index(root)
+    app = index.modules["mmlspark_tpu.serve.app"].classes["App"]
+    # the attribute assignment aliases intake -> App._intake
+    (alias,) = index.attr_aliases["intake"]
+    assert alias is app.methods["_intake"]
+    # the call site inside the if carries its guard
+    (site,) = app.methods["_intake"].calls
+    assert site.callee is app.methods["_dispatch"]
+    assert site.guards == ("rid > 0",)
+
+
+# -------------------------------------------------- COL005/COL006 fixtures
+
+
+_DIVERGENT_BOOSTER = """
+    import jax
+    from mmlspark_tpu.parallel.helpers import merge_stats
+
+    def train(params, data):
+        stats = data
+        if jax.process_index() == 0:
+            stats = merge_stats(stats)
+        return stats
+"""
+_DIVERGENT_HELPERS = """
+    from mmlspark_tpu.parallel.distributed import device_psum
+
+    def merge_stats(x):
+        return device_psum(x, "data")
+"""
+_FIXTURE_DISTRIBUTED = """
+    def device_psum(x, axis):
+        return x
+"""
+
+
+def test_col005_cross_module_divergent_collective(tmp_path):
+    """The headline regression: a rank-pinned edge in booster reaches a
+    collective defined in ANOTHER module.  The interprocedural engine
+    flags it; the per-file engine provably cannot (neither half alone
+    contains both the guard and the collective)."""
+    root = _pkg_tree(tmp_path, {
+        "engine/booster.py": _DIVERGENT_BOOSTER,
+        "parallel/helpers.py": _DIVERGENT_HELPERS,
+        "parallel/distributed.py": _FIXTURE_DISTRIBUTED,
+    })
+    found = run_all(root, rules={"COL005"})
+    assert rules(found) == ["COL005"]
+    assert "rank-gated edge" in found[0].message
+    assert found[0].file.endswith(os.path.join("engine", "booster.py"))
+
+    # file-by-file, the same two halves are silent: the guard's file has
+    # no collective and the collective's file has no guard
+    for rel in ("engine/booster.py", "parallel/helpers.py"):
+        path = os.path.join(root, "mmlspark_tpu", *rel.split("/"))
+        assert check_collectives_file(path) == [], rel
+
+
+def test_col005_silent_with_all_ranks_evidence(tmp_path):
+    root = _pkg_tree(tmp_path, {
+        "engine/booster.py": """
+            import jax
+            from mmlspark_tpu.parallel.helpers import merge_stats
+
+            def train(params, data, mesh_spans_processes):
+                if jax.process_count() > 1 and mesh_spans_processes:
+                    data = merge_stats(data)
+                return data
+        """,
+        "parallel/helpers.py": _DIVERGENT_HELPERS,
+        "parallel/distributed.py": _FIXTURE_DISTRIBUTED,
+    })
+    assert run_all(root, rules={"COL005"}) == []
+
+
+def test_col006_rank_local_loop_trip_count(tmp_path):
+    root = _pkg_tree(tmp_path, {
+        "parallel/helpers.py": """
+            from mmlspark_tpu.parallel.distributed import device_psum
+
+            def drain(local_parts):
+                out = []
+                for part in local_parts:
+                    out.append(device_psum(part, "data"))
+                return out
+        """,
+        "parallel/distributed.py": _FIXTURE_DISTRIBUTED,
+    })
+    found = run_all(root, rules={"COL006"})
+    assert rules(found) == ["COL006"]
+    assert "trip count" in found[0].message
+
+
+def test_col006_silent_on_globally_agreed_loop(tmp_path):
+    root = _pkg_tree(tmp_path, {
+        "engine/booster.py": """
+            from mmlspark_tpu.parallel.distributed import device_psum
+
+            def train(params, data):
+                for it in range(params["num_iterations"]):
+                    data = device_psum(data, "data")
+                return data
+        """,
+        "parallel/distributed.py": _FIXTURE_DISTRIBUTED,
+    })
+    assert run_all(root, rules={"COL005", "COL006"}) == []
+
+
+# ------------------------------------------------------- LCK fixtures
+
+
+def test_lck001_lock_held_across_nested_acquire(tmp_path):
+    root = _pkg_tree(tmp_path, {
+        "serve/reg.py": """
+            import threading
+
+            class Version:
+                def __init__(self):
+                    self._vlock = threading.Lock()
+                    self.refs = 0
+
+                def acquire(self):
+                    with self._vlock:
+                        self.refs += 1
+
+            class Registry:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._routes = {}
+
+                def lease(self, name):
+                    with self._lock:
+                        mv = self._routes[name]
+                        mv.acquire()
+                    return mv
+        """,
+    })
+    found = run_all(root, rules={"LCK001"})
+    assert rules(found) == ["LCK001"]
+    assert "Version._vlock" in found[0].message
+
+
+def test_lck001_silent_when_acquire_moves_outside(tmp_path):
+    root = _pkg_tree(tmp_path, {
+        "serve/reg.py": """
+            import threading
+
+            class Version:
+                def __init__(self):
+                    self._vlock = threading.Lock()
+                    self.refs = 0
+
+                def acquire(self):
+                    with self._vlock:
+                        self.refs += 1
+
+            class Registry:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._routes = {}
+
+                def lease(self, name):
+                    with self._lock:
+                        mv = self._routes[name]
+                    mv.acquire()
+                    return mv
+        """,
+    })
+    assert run_all(root, rules={"LCK001"}) == []
+
+
+def test_lck002_blocking_get_under_lock(tmp_path):
+    root = _pkg_tree(tmp_path, {
+        "serve/pump.py": """
+            import queue
+            import threading
+
+            class Pump:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._q = queue.Queue(maxsize=8)
+
+                def pull(self):
+                    with self._lock:
+                        item = self._q.get(timeout=1.0)
+                    return item
+        """,
+    })
+    found = run_all(root, rules={"LCK002"})
+    assert rules(found) == ["LCK002"]
+
+
+def test_lck002_silent_on_nonblocking_forms(tmp_path):
+    root = _pkg_tree(tmp_path, {
+        "serve/pump.py": """
+            import queue
+            import threading
+
+            class Pump:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._q = queue.Queue(maxsize=8)
+
+                def push(self, item):
+                    with self._lock:
+                        self._q.put_nowait(item)
+
+                def try_pull(self):
+                    with self._lock:
+                        return self._q.get(block=False)
+
+                def pull(self):
+                    item = self._q.get(timeout=1.0)
+                    with self._lock:
+                        pass
+                    return item
+        """,
+    })
+    assert run_all(root, rules={"LCK002"}) == []
+
+
+_LCK003_APP = """
+    import threading
+    from http.server import BaseHTTPRequestHandler
+
+    class App:
+        def __init__(self):
+            self.total = 0
+            self._t = threading.Thread(target=self._worker)
+
+        def _worker(self):
+            self.total = self.total + 1
+
+        def _handle_request(self, rid):
+            return self.total
+
+    class Handler(BaseHTTPRequestHandler):
+        def do_POST(self):
+            self.app._handle_request("r1")
+"""
+
+
+def test_lck003_cross_thread_domain_write(tmp_path):
+    root = _pkg_tree(tmp_path, {"serve/app.py": _LCK003_APP})
+    found = run_all(root, rules={"LCK003"})
+    assert rules(found) == ["LCK003"]
+    assert "self.total" in found[0].message
+    assert "worker" in found[0].message and "request" in found[0].message
+
+
+def test_lck003_silent_under_common_lock(tmp_path):
+    root = _pkg_tree(tmp_path, {
+        "serve/app.py": """
+            import threading
+            from http.server import BaseHTTPRequestHandler
+
+            class App:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.total = 0
+                    self._t = threading.Thread(target=self._worker)
+
+                def _worker(self):
+                    with self._lock:
+                        self.total = self.total + 1
+
+                def _handle_request(self, rid):
+                    with self._lock:
+                        return self.total
+
+            class Handler(BaseHTTPRequestHandler):
+                def do_POST(self):
+                    self.app._handle_request("r1")
+        """,
+    })
+    assert run_all(root, rules={"LCK003"}) == []
+
+
+# ------------------------------------------------------- DTY001 fixtures
+
+
+def test_dty001_direct_f32_narrowing(tmp_path):
+    root = _pkg_tree(tmp_path, {
+        "ops/device_binning.py": """
+            import numpy as np
+
+            def bad_pack(bm):
+                table = np.asarray(bm.upper_bounds[0], np.float64)
+                return table.astype(np.float32)
+        """,
+    })
+    found = run_all(root, rules={"DTY001"})
+    assert rules(found) == ["DTY001"]
+    assert "double-single" in found[0].message
+
+
+def test_dty001_sanctioned_double_single_is_silent(tmp_path):
+    root = _pkg_tree(tmp_path, {
+        "ops/device_binning.py": """
+            import numpy as np
+
+            def good_pack(bm):
+                table = np.asarray(bm.upper_bounds[0], np.float64)
+                hi = table.astype(np.float32)
+                lo = np.zeros_like(table)
+                np.subtract(table, hi.astype(np.float64), out=lo)
+                lo = lo.astype(np.float32)
+                return hi, lo
+        """,
+    })
+    assert run_all(root, rules={"DTY001"}) == []
+
+
+def test_dty001_interprocedural_flow_into_helper(tmp_path):
+    root = _pkg_tree(tmp_path, {
+        "engine/booster.py": """
+            import numpy as np
+
+            def _narrow(edges):
+                return np.asarray(edges, dtype=np.float32)
+
+            def _fit(params, bm):
+                edges = bm.upper_bounds[0]
+                return _narrow(edges)
+        """,
+    })
+    found = run_all(root, rules={"DTY001"})
+    assert rules(found) == ["DTY001"]
+    assert found[0].file.endswith("booster.py")
+
+
+def test_dty001_index_valued_results_drop_taint(tmp_path):
+    root = _pkg_tree(tmp_path, {
+        "ops/binning.py": """
+            import numpy as np
+
+            def assign_bins(bm, col):
+                bins = np.searchsorted(bm.upper_bounds[0], col)
+                return bins.astype(np.float32)
+        """,
+    })
+    assert run_all(root, rules={"DTY001"}) == []
+
+
+# ------------------------------------------------- golden + parity gates
+
+
+def test_engine_port_golden_parity_on_real_tree():
+    """All seven pre-existing passes produce the SAME findings through
+    the index as through the legacy per-file glob walk."""
+    from tools.analyze import (
+        check_abi, check_collectives, check_hygiene, check_obs,
+        check_predict, check_serving, check_tracer,
+    )
+    from tools.analyze.engine import build_index
+
+    root = repo_root()
+    index = build_index(root)
+    key = lambda f: (f.file, f.line, f.rule, f.message)
+    for chk in (check_abi, check_collectives, check_tracer,
+                check_hygiene, check_obs, check_serving, check_predict):
+        legacy = sorted(map(key, chk(root)))
+        indexed = sorted(map(key, chk(root, index=index)))
+        assert legacy == indexed, chk.__name__
+
+
+# ------------------------------------------- suppression edge cases
+
+
+def test_suppression_multi_rule_single_comment(tmp_path):
+    p = _write(str(tmp_path / "x.py"),
+               "risky()  # analyze: ignore[AAA001,BBB002]\n")
+    findings = [Finding(p, 1, "AAA001", "m"), Finding(p, 1, "BBB002", "m"),
+                Finding(p, 1, "CCC003", "m")]
+    assert rules(apply_suppressions(findings)) == ["CCC003"]
+
+
+def test_suppression_on_decorator_line_covers_def(tmp_path):
+    p = _write(str(tmp_path / "x.py"), """
+        @decorator  # analyze: ignore[XYZ001]
+        @other
+        def f():
+            pass
+    """)
+    # covers the comment line, subsequent decorators, the def line, and
+    # the line after the def
+    covered = [Finding(p, n, "XYZ001", "m") for n in (2, 3, 4, 5)]
+    assert apply_suppressions(covered) == []
+    # ...but not further into the body, and not other rules
+    kept = [Finding(p, 6, "XYZ001", "m"), Finding(p, 4, "OTHER1", "m")]
+    assert len(apply_suppressions(kept)) == 2
+
+
+def test_stale_ignores_report(tmp_path):
+    from tools.analyze import run_stale_ignores
+
+    root = _pkg_tree(tmp_path, {
+        "a.py": "x = 1  # analyze: ignore[OBS001]\n",
+        "b.py": 'print("hi")  # analyze: ignore[OBS001]\n',
+    })
+    stale = run_stale_ignores(root)
+    assert [f.rule for f in stale] == ["STALE"]
+    assert stale[0].file.endswith("a.py")
+    assert "ignore[OBS001]" in stale[0].message
+
+
+def test_real_tree_has_no_stale_ignores():
+    from tools.analyze import run_stale_ignores
+
+    stale = run_stale_ignores(repo_root())
+    assert stale == [], "\n".join(str(f) for f in stale)
+
+
+# ----------------------------------------------------- CLI (ISSUE 7)
+
+
+def _dirty_root(tmp_path):
+    _write(str(tmp_path / "mmlspark_tpu" / "native" / "k.cpp"), """
+        extern "C" {
+        void f(long n);
+        }
+    """)
+    _write(str(tmp_path / "mmlspark_tpu" / "__init__.py"), "")
+    _write(str(tmp_path / "mmlspark_tpu" / "core" / "__init__.py"), "")
+    _write(str(tmp_path / "mmlspark_tpu" / "core" / "x.py"),
+           'print("noisy")\n')
+    return str(tmp_path)
+
+
+def test_cli_sarif_output(tmp_path, capsys):
+    import json as _json
+
+    from tools.analyze.__main__ import main
+
+    root = _dirty_root(tmp_path)
+    assert main(["--root", root, "--sarif"]) == 1
+    doc = _json.loads(capsys.readouterr().out)
+    assert doc["version"] == "2.1.0"
+    results = doc["runs"][0]["results"]
+    assert {r["ruleId"] for r in results} == {"ABI001", "OBS001"}
+    abi = next(r for r in results if r["ruleId"] == "ABI001")
+    loc = abi["locations"][0]["physicalLocation"]
+    assert loc["artifactLocation"]["uri"] == "mmlspark_tpu/native/k.cpp"
+    assert loc["region"]["startLine"] == 3
+    rule_ids = {r["id"] for r in doc["runs"][0]["tool"]["driver"]["rules"]}
+    assert rule_ids == {"ABI001", "OBS001"}
+
+
+def test_cli_rule_and_path_filters(tmp_path, capsys):
+    from tools.analyze.__main__ import main
+
+    root = _dirty_root(tmp_path)
+    assert main(["--root", root, "--rule", "OBS001"]) == 1
+    out = capsys.readouterr().out
+    assert "OBS001" in out and "ABI001" not in out
+
+    assert main(["--root", root, "--path", "mmlspark_tpu/native"]) == 1
+    out = capsys.readouterr().out
+    assert "ABI001" in out and "OBS001" not in out
+
+    assert main(["--root", root, "--path", "mmlspark_tpu/serve"]) == 0
+
+    with pytest.raises(SystemExit):  # unknown rule id is an arg error
+        main(["--root", root, "--rule", "NOPE999"])
+
+
+def test_cli_stale_ignores_exit_codes(tmp_path, capsys):
+    from tools.analyze.__main__ import main
+
+    root = _pkg_tree(tmp_path, {
+        "a.py": "x = 1  # analyze: ignore[OBS001]\n",
+    })
+    assert main(["--root", root, "--stale-ignores"]) == 1
+    out = capsys.readouterr().out
+    assert "STALE" in out and "stale ignore(s)" in out
+
+
+def test_cli_internal_error_exits_2(tmp_path, capsys, monkeypatch):
+    import tools.analyze as pkg
+    from tools.analyze.__main__ import main
+
+    def boom(*a, **k):
+        raise RuntimeError("seeded internal failure")
+
+    monkeypatch.setattr(pkg, "run_all", boom)
+    assert main([]) == 2
+    assert "internal error" in capsys.readouterr().err

@@ -1,10 +1,15 @@
 """Repo-native static-analysis suite (see README.md in this directory).
 
-Seven passes (ABI, collectives, tracer, hygiene, obs, serving, predict),
-each returning
-:class:`tools.analyze.common.Finding` rows; :func:`run_all` runs them
-over a repo root and applies inline ``# analyze: ignore[RULE]``
-suppressions.  CLI: ``python -m tools.analyze [--json]``.
+Ten passes over a shared project index (built once per run by
+:mod:`tools.analyze.engine`): the seven per-file-portable passes (ABI,
+collectives, tracer, hygiene, obs, serving, predict) plus the
+index-native interprocedural passes (collective order COL005/COL006,
+serve-layer locks LCK001–003, dtype-contract flow DTY001).  Each pass
+returns :class:`tools.analyze.common.Finding` rows; :func:`run_all`
+builds the index, runs the passes, and applies inline
+``# analyze: ignore[RULE]`` suppressions.  CLI:
+``python -m tools.analyze [--json|--sarif] [--rule R,..] [--path P]
+[--stale-ignores]``.
 """
 
 from __future__ import annotations
@@ -13,7 +18,11 @@ import os
 
 from tools.analyze.abi import check_abi
 from tools.analyze.collectives import check_collectives
-from tools.analyze.common import Finding, apply_suppressions
+from tools.analyze.common import (
+    Finding,
+    apply_suppressions,
+    stale_suppressions,
+)
 from tools.analyze.hygiene import check_hygiene
 from tools.analyze.obs_rules import check_obs
 from tools.analyze.predict_rules import check_predict
@@ -21,7 +30,7 @@ from tools.analyze.serving_rules import check_serving
 from tools.analyze.tracer import check_tracer
 
 __all__ = [
-    "Finding", "run_all", "repo_root",
+    "Finding", "run_all", "repo_root", "PASSES",
     "check_abi", "check_collectives", "check_tracer", "check_hygiene",
     "check_obs", "check_serving", "check_predict",
 ]
@@ -32,16 +41,104 @@ def repo_root() -> str:
         os.path.abspath(__file__))))
 
 
-def run_all(root: "str | None" = None) -> list:
+def _check_collective_order(index):
+    from tools.analyze.engine import check_collective_order
+
+    return check_collective_order(index)
+
+
+def _check_locks(index):
+    from tools.analyze.engine import check_locks
+
+    return check_locks(index)
+
+
+def _check_dtype_flow(index):
+    from tools.analyze.engine import check_dtype_flow
+
+    return check_dtype_flow(index)
+
+
+#: pass name -> (runner(root, index), rule ids it can emit).  ``--rule``
+#: uses the rule sets to select which passes actually run.
+PASSES = {
+    "abi": (lambda root, index: check_abi(root, index=index),
+            {"ABI001", "ABI002", "ABI003", "ABI004", "ABI005", "NAT001"}),
+    "collectives": (
+        lambda root, index: check_collectives(root, index=index),
+        {"COL001", "COL002", "COL003", "COL004"}),
+    "tracer": (lambda root, index: check_tracer(root, index=index),
+               {"TRC001", "TRC002", "TRC003"}),
+    "hygiene": (lambda root, index: check_hygiene(root, index=index),
+                {"HYG001"}),
+    "obs": (lambda root, index: check_obs(root, index=index),
+            {"OBS001", "OBS002"}),
+    "serving": (lambda root, index: check_serving(root, index=index),
+                {"SRV001"}),
+    "predict": (lambda root, index: check_predict(root, index=index),
+                {"PRED001"}),
+    "collective_order": (
+        lambda root, index: _check_collective_order(index),
+        {"COL005", "COL006"}),
+    "locks": (lambda root, index: _check_locks(index),
+              {"LCK001", "LCK002", "LCK003"}),
+    "dtype_flow": (lambda root, index: _check_dtype_flow(index),
+                   {"DTY001"}),
+}
+
+
+def all_rules() -> set:
+    out: set = set()
+    for _, rules in PASSES.values():
+        out |= rules
+    return out
+
+
+def run_all(root: "str | None" = None, rules: "set | None" = None,
+            path_prefix: "str | None" = None,
+            suppress: bool = True) -> list:
+    """Run the analysis passes over ``root``.
+
+    ``rules`` restricts execution to the passes owning those rule ids
+    (and the returned findings to exactly those rules);
+    ``path_prefix`` keeps findings whose repo-relative path starts with
+    the prefix; ``suppress=False`` skips inline-comment filtering (the
+    ``--stale-ignores`` driver needs the raw set).
+    """
+    from tools.analyze.engine import build_index
+
     root = root or repo_root()
+    index = build_index(root)
     findings: list = []
-    findings.extend(check_abi(root))
-    findings.extend(check_collectives(root))
-    findings.extend(check_tracer(root))
-    findings.extend(check_hygiene(root))
-    findings.extend(check_obs(root))
-    findings.extend(check_serving(root))
-    findings.extend(check_predict(root))
-    findings = apply_suppressions(findings)
+    for _name, (runner, owned) in PASSES.items():
+        if rules is not None and not (owned & rules):
+            continue
+        findings.extend(runner(root, index))
+    if rules is not None:
+        findings = [f for f in findings if f.rule in rules]
+    if path_prefix is not None:
+        pfx = path_prefix.replace("\\", "/")
+        findings = [
+            f for f in findings
+            if os.path.relpath(f.file, root).replace("\\", "/")
+            .startswith(pfx)
+        ]
+    if suppress:
+        findings = apply_suppressions(findings, texts=index.texts())
     findings.sort(key=lambda f: (f.file, f.line, f.rule))
     return findings
+
+
+def run_stale_ignores(root: "str | None" = None) -> list:
+    """The ``--stale-ignores`` report: suppression comments whose rule
+    matches no raw finding on their covered lines."""
+    from tools.analyze.engine import build_index
+
+    root = root or repo_root()
+    index = build_index(root)
+    raw: list = []
+    for _name, (runner, _owned) in PASSES.items():
+        raw.extend(runner(root, index))
+    out = stale_suppressions(raw, index.texts())
+    out.sort(key=lambda f: (f.file, f.line, f.message))
+    return out

@@ -1,8 +1,13 @@
-"""``python -m tools.analyze [--json] [--root PATH]`` — run every pass.
+"""``python -m tools.analyze`` — run the analysis passes.
 
-Exit 0 when the tree is clean, 1 when any finding survives suppression
-(the same contract the CI job and tests/test_static_analysis.py rely
-on).
+Options: ``--json`` / ``--sarif`` (machine-readable output), ``--root
+PATH``, ``--rule RULE[,RULE]`` (run only the owning passes), ``--path
+PREFIX`` (keep findings under a repo-relative prefix), and
+``--stale-ignores`` (report suppression comments that no longer silence
+anything).
+
+Exit codes are explicit and CI-stable: 0 clean, 1 findings (or stale
+ignores in ``--stale-ignores`` mode), 2 internal analyzer error.
 """
 
 from __future__ import annotations
@@ -10,28 +15,95 @@ from __future__ import annotations
 import argparse
 import dataclasses
 import json
+import os
 import sys
 
-from tools.analyze import repo_root, run_all
+
+def _sarif(findings, root: str) -> dict:
+    rules = sorted({f.rule for f in findings})
+    return {
+        "$schema": "https://json.schemastore.org/sarif-2.1.0.json",
+        "version": "2.1.0",
+        "runs": [{
+            "tool": {"driver": {
+                "name": "tools.analyze",
+                "informationUri":
+                    "https://example.invalid/mmlspark_tpu/tools/analyze",
+                "rules": [{"id": r} for r in rules],
+            }},
+            "results": [{
+                "ruleId": f.rule,
+                "level": "error",
+                "message": {"text": f.message},
+                "locations": [{
+                    "physicalLocation": {
+                        "artifactLocation": {
+                            "uri": os.path.relpath(f.file, root)
+                            .replace(os.sep, "/"),
+                        },
+                        "region": {"startLine": f.line},
+                    },
+                }],
+            } for f in findings],
+        }],
+    }
 
 
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(prog="python -m tools.analyze")
     ap.add_argument("--json", action="store_true",
                     help="machine-readable findings on stdout")
+    ap.add_argument("--sarif", action="store_true",
+                    help="SARIF 2.1.0 findings on stdout")
     ap.add_argument("--root", default=None,
                     help="repo root to analyze (default: this checkout)")
+    ap.add_argument("--rule", default=None, metavar="RULE[,RULE]",
+                    help="run only the passes owning these rule ids")
+    ap.add_argument("--path", default=None, metavar="PREFIX",
+                    help="keep findings under this repo-relative prefix")
+    ap.add_argument("--stale-ignores", action="store_true",
+                    help="report analyze:ignore comments that no longer "
+                         "match any finding")
     opts = ap.parse_args(argv)
-    findings = run_all(opts.root)
-    if opts.json:
-        print(json.dumps([dataclasses.asdict(f) for f in findings],
-                         indent=2))
-    else:
-        for f in findings:
-            print(f)
+    try:
+        from tools.analyze import (
+            all_rules,
+            repo_root,
+            run_all,
+            run_stale_ignores,
+        )
+
         root = opts.root or repo_root()
-        print(f"tools.analyze: {len(findings)} finding(s) in {root}")
-    return 1 if findings else 0
+        if opts.stale_ignores:
+            findings = run_stale_ignores(root)
+            label = "stale ignore(s)"
+        else:
+            rules = None
+            if opts.rule:
+                rules = {r.strip() for r in opts.rule.split(",")
+                         if r.strip()}
+                unknown = rules - all_rules()
+                if unknown:
+                    ap.error(f"unknown rule id(s): "
+                             f"{', '.join(sorted(unknown))}")
+            findings = run_all(root, rules=rules, path_prefix=opts.path)
+            label = "finding(s)"
+        if opts.sarif:
+            print(json.dumps(_sarif(findings, root), indent=2))
+        elif opts.json:
+            print(json.dumps([dataclasses.asdict(f) for f in findings],
+                             indent=2))
+        else:
+            for f in findings:
+                print(f)
+            print(f"tools.analyze: {len(findings)} {label} in {root}")
+        return 1 if findings else 0
+    except SystemExit:
+        raise
+    except Exception as exc:  # internal analyzer error — exit 2
+        print(f"tools.analyze: internal error: {type(exc).__name__}: "
+              f"{exc}", file=sys.stderr)
+        return 2
 
 
 if __name__ == "__main__":

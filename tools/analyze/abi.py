@@ -143,10 +143,12 @@ def _match_brace(text: str, open_pos: int) -> int:
     return len(text) - 1
 
 
-def parse_c_decls(path: str) -> list:
+def parse_c_decls(path: str, text=None) -> list:
     """Every function declared/defined inside ``extern "C" { ... }``."""
-    with open(path, encoding="utf-8", errors="replace") as fh:
-        text = _strip_comments(fh.read())
+    if text is None:
+        with open(path, encoding="utf-8", errors="replace") as fh:
+            text = fh.read()
+    text = _strip_comments(text)
     decls = []
     for em in re.finditer(r'extern\s+"C"\s*\{', text):
         start = em.end()
@@ -186,10 +188,12 @@ _RANGE_TOKENS = (
 _NAT_LOOKBACK = 12  # lines of context that count as "a clamp in sight"
 
 
-def check_float_casts(path: str) -> list:
+def check_float_casts(path: str, text=None) -> list:
     """NAT001: unclamped float->int static_casts (identifier-arg only)."""
-    with open(path, encoding="utf-8", errors="replace") as fh:
-        text = _strip_comments(fh.read())
+    if text is None:
+        with open(path, encoding="utf-8", errors="replace") as fh:
+            text = fh.read()
+    text = _strip_comments(text)
     lines = text.splitlines()
     findings = []
     for m in _CAST_RE.finditer(text):
@@ -264,9 +268,10 @@ def _symbol_of_target(node, sym_env) -> "str | None":
     return None
 
 
-def parse_ctypes_bindings(path: str) -> list:
-    with open(path, encoding="utf-8", errors="replace") as fh:
-        tree = ast.parse(fh.read(), filename=path)
+def parse_ctypes_bindings(path: str, tree=None) -> list:
+    if tree is None:
+        with open(path, encoding="utf-8", errors="replace") as fh:
+            tree = ast.parse(fh.read(), filename=path)
     bindings: dict[str, PyBinding] = {}
 
     def visit_body(body, type_env, sym_env):
@@ -331,13 +336,24 @@ def _types_equal(a: CType, b: CType) -> bool:
         (alias.get(b.base, b.base), b.ptr)
 
 
-def check_abi(root: str) -> list:
+def check_abi(root: str, index=None) -> list:
     native = os.path.join(root, "mmlspark_tpu", "native")
     findings: list = []
 
+    if index is not None:
+        cpps = [(p, index.native_cpps[p])
+                for p in sorted(index.native_cpps)]
+        pys = [(m.path, m.tree) for m in index.package_modules()
+               if (m.pkg_rel or "").split(os.sep)[0] == "native"]
+    else:
+        cpps = [(p, None)
+                for p in sorted(glob.glob(os.path.join(native, "*.cpp")))]
+        pys = [(p, None)
+               for p in sorted(glob.glob(os.path.join(native, "*.py")))]
+
     c_by_name: dict[str, list] = {}
-    for cpp in sorted(glob.glob(os.path.join(native, "*.cpp"))):
-        for d in parse_c_decls(cpp):
+    for cpp, text in cpps:
+        for d in parse_c_decls(cpp, text=text):
             c_by_name.setdefault(d.name, []).append(d)
             for i, t in enumerate([d.ret] + d.args):
                 if t is not None and t.base in _PLATFORM_WIDTH:
@@ -347,7 +363,7 @@ def check_abi(root: str) -> list:
                         f"{d.name} {where} uses platform-width '{t}' "
                         "(32-bit on LLP64) — use a fixed-width int64_t",
                     ))
-        findings.extend(check_float_casts(cpp))
+        findings.extend(check_float_casts(cpp, text=text))
 
     # ABI005: the declaration sites must agree among themselves
     for name, decls in c_by_name.items():
@@ -371,8 +387,8 @@ def check_abi(root: str) -> list:
                     f"{ref.file}:{ref.line}",
                 ))
 
-    for py in sorted(glob.glob(os.path.join(native, "*.py"))):
-        for b in parse_ctypes_bindings(py):
+    for py, py_tree in pys:
+        for b in parse_ctypes_bindings(py, tree=py_tree):
             for i, t in enumerate([b.restype] + b.args):
                 if isinstance(t, CType) and t.base in _PLATFORM_WIDTH:
                     where = "restype" if i == 0 else f"arg {i}"

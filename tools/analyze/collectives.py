@@ -235,8 +235,14 @@ class _Scanner:
             ))
 
 
-def check_collectives(root: str) -> list:
+def check_collectives(root: str, index=None) -> list:
     findings: list = []
+    if index is not None:
+        for mi in index.package_modules():
+            if mi.pkg_rel in EXEMPT:
+                continue
+            findings.extend(check_collectives_file(mi.path, tree=mi.tree))
+        return findings
     pkg = os.path.join(root, "mmlspark_tpu")
     for py in sorted(glob.glob(os.path.join(pkg, "**", "*.py"),
                                recursive=True)):
@@ -247,12 +253,13 @@ def check_collectives(root: str) -> list:
     return findings
 
 
-def check_collectives_file(path: str) -> list:
-    try:
-        with open(path, encoding="utf-8", errors="replace") as fh:
-            tree = ast.parse(fh.read(), filename=path)
-    except SyntaxError:
-        return []
+def check_collectives_file(path: str, tree=None) -> list:
+    if tree is None:
+        try:
+            with open(path, encoding="utf-8", errors="replace") as fh:
+                tree = ast.parse(fh.read(), filename=path)
+        except SyntaxError:
+            return []
     s = _Scanner(path)
     s.scan_body(tree.body, [])
     return s.findings

@@ -8,6 +8,9 @@ driver filters them through inline suppression comments:
 
 A suppression silences the named rule(s) on its own line and on the line
 directly below it (so a comment can sit above a multi-line statement).
+A suppression on a DECORATOR line additionally covers the decorated
+``def``/``class`` line (and the line after it), so a rule about a
+function can be silenced at its head without counting decorators.
 ``ignore[RULE1,RULE2]`` lists several rules; the rule id must match
 exactly — there is deliberately no bare ``ignore`` wildcard, so every
 suppression documents WHICH class of bug was judged acceptable.
@@ -19,6 +22,9 @@ import re
 from dataclasses import dataclass
 
 _SUPPRESS_RE = re.compile(r"(?:#|//)\s*analyze:\s*ignore\[([A-Z0-9_,\s]+)\]")
+
+# how far a decorator-line suppression may search for its def/class
+_DECORATOR_REACH = 20
 
 
 @dataclass(frozen=True)
@@ -32,34 +38,114 @@ class Finding:
         return f"{self.file}:{self.line}: {self.rule} {self.message}"
 
 
-def parse_suppressions(text: str) -> dict[int, set[str]]:
-    """Map line number -> rule ids suppressed ON that line.
+@dataclass(frozen=True)
+class Suppression:
+    """One ``analyze: ignore[...]`` comment and the lines it covers."""
 
-    A comment on line N suppresses findings reported at N and N+1.
+    line: int                 # the comment's own line
+    rules: frozenset[str]
+    covered: frozenset[int]   # line numbers the comment silences
+
+
+def iter_suppressions(text: str) -> list[Suppression]:
+    """Every suppression comment with its covered-line set.
+
+    A comment on line N covers N and N+1.  When line N is a decorator
+    line (``@...``), coverage extends through any further decorator /
+    blank / comment lines to the decorated ``def``/``class`` line plus
+    the line after it — a suppression at a function head should not
+    stop counting at the decorators in between.
     """
-    out: dict[int, set[str]] = {}
-    for i, line in enumerate(text.splitlines(), start=1):
+    lines = text.splitlines()
+    out: list[Suppression] = []
+    for i, line in enumerate(lines, start=1):
         m = _SUPPRESS_RE.search(line)
-        if m:
-            rules = {r.strip() for r in m.group(1).split(",") if r.strip()}
-            out.setdefault(i, set()).update(rules)
-            out.setdefault(i + 1, set()).update(rules)
+        if not m:
+            continue
+        rules = frozenset(
+            r.strip() for r in m.group(1).split(",") if r.strip()
+        )
+        covered = {i, i + 1}
+        if line.lstrip().startswith("@"):
+            for j in range(i + 1, min(i + _DECORATOR_REACH, len(lines) + 1)):
+                nxt = lines[j - 1].lstrip()
+                if nxt.startswith(("def ", "class ", "async def ")):
+                    covered.update({j, j + 1})
+                    break
+                if nxt.startswith("@") or nxt.startswith("#") or not nxt:
+                    covered.add(j)
+                    continue
+                break
+        out.append(Suppression(i, rules, frozenset(covered)))
     return out
 
 
-def apply_suppressions(findings: list[Finding]) -> list[Finding]:
-    """Drop findings silenced by an inline comment in their source file."""
+def parse_suppressions(text: str) -> dict[int, set[str]]:
+    """Map line number -> rule ids suppressed ON that line."""
+    out: dict[int, set[str]] = {}
+    for s in iter_suppressions(text):
+        for ln in s.covered:
+            out.setdefault(ln, set()).update(s.rules)
+    return out
+
+
+def _read_suppressions(path: str, texts: dict | None,
+                       cache: dict) -> dict[int, set[str]]:
+    supp = cache.get(path)
+    if supp is None:
+        text = (texts or {}).get(path)
+        if text is None:
+            try:
+                with open(path, encoding="utf-8", errors="replace") as fh:
+                    text = fh.read()
+            except OSError:
+                text = ""
+        supp = parse_suppressions(text)
+        cache[path] = supp
+    return supp
+
+
+def apply_suppressions(findings: list[Finding],
+                       texts: dict | None = None) -> list[Finding]:
+    """Drop findings silenced by an inline comment in their source file.
+
+    ``texts`` (path -> source) lets the index-backed driver skip
+    re-reading files it already holds in memory.
+    """
     cache: dict[str, dict[int, set[str]]] = {}
     kept = []
     for f in findings:
-        supp = cache.get(f.file)
-        if supp is None:
-            try:
-                with open(f.file, encoding="utf-8", errors="replace") as fh:
-                    supp = parse_suppressions(fh.read())
-            except OSError:
-                supp = {}
-            cache[f.file] = supp
+        supp = _read_suppressions(f.file, texts, cache)
         if f.rule not in supp.get(f.line, ()):
             kept.append(f)
     return kept
+
+
+def stale_suppressions(raw_findings: list[Finding],
+                       texts: dict[str, str]) -> list[Finding]:
+    """Suppression comments that no longer silence anything.
+
+    ``raw_findings`` must be the UN-suppressed findings set; ``texts``
+    maps every analyzed file to its source.  Each ignore comment whose
+    rule matches no raw finding on its covered lines is reported as a
+    pseudo-finding (rule ``STALE``) so the CLI can render/exit on it.
+    """
+    by_file: dict[str, list[Finding]] = {}
+    for f in raw_findings:
+        by_file.setdefault(f.file, []).append(f)
+    out: list[Finding] = []
+    for path in sorted(texts):
+        hits = by_file.get(path, [])
+        for s in iter_suppressions(texts[path]):
+            for rule in sorted(s.rules):
+                if any(f.rule == rule and f.line in s.covered
+                       for f in hits):
+                    continue
+                out.append(Finding(
+                    path, s.line, "STALE",
+                    f"suppression ignore[{rule}] matches no {rule} "
+                    "finding on its covered lines — the underlying "
+                    "issue was fixed or moved; delete the comment so "
+                    "real regressions cannot hide behind it",
+                ))
+    return out

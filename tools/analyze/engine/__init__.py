@@ -1,0 +1,40 @@
+"""The analysis engine: a shared project index plus the passes that
+only make sense interprocedurally.
+
+``build_index`` parses the repo once (module graph, symbol table, call
+graph with guard/loop context, CFG cache); the seven original per-file
+passes consume it through their ``index=`` parameter, and the three
+index-native passes live here:
+
+- :mod:`tools.analyze.engine.collective_order` — COL005/COL006
+- :mod:`tools.analyze.engine.locks` — LCK001..LCK003
+- :mod:`tools.analyze.engine.dtype_flow` — DTY001
+"""
+
+from tools.analyze.engine.cfg import CFG, ForwardDataflow, build_cfg
+from tools.analyze.engine.collective_order import check_collective_order
+from tools.analyze.engine.dtype_flow import check_dtype_flow
+from tools.analyze.engine.index import (
+    CallSite,
+    ClassInfo,
+    FunctionInfo,
+    ModuleInfo,
+    ProjectIndex,
+    build_index,
+)
+from tools.analyze.engine.locks import check_locks
+
+__all__ = [
+    "CFG",
+    "CallSite",
+    "ClassInfo",
+    "ForwardDataflow",
+    "FunctionInfo",
+    "ModuleInfo",
+    "ProjectIndex",
+    "build_cfg",
+    "build_index",
+    "check_collective_order",
+    "check_dtype_flow",
+    "check_locks",
+]

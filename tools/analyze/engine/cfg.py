@@ -1,0 +1,187 @@
+"""Statement-level control-flow graphs and a small forward dataflow
+framework.
+
+A :class:`CFG` has basic blocks of consecutive simple statements; edges
+follow If/While/For/Try/With/Return/Break/Continue structure.  The
+:class:`ForwardDataflow` base class runs a classic worklist to a fixed
+point over it — a pass supplies ``initial``/``transfer``/``join``.  The
+dtype-contract pass (DTY001) is the first client; the framework is
+deliberately tiny so new passes can subclass it without ceremony.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from typing import Dict, List
+
+
+@dataclass
+class Block:
+    bid: int
+    stmts: List[ast.stmt] = field(default_factory=list)
+    succs: List[int] = field(default_factory=list)
+
+    def add_succ(self, bid: int) -> None:
+        if bid not in self.succs:
+            self.succs.append(bid)
+
+
+class CFG:
+    def __init__(self) -> None:
+        self.blocks: Dict[int, Block] = {}
+        self.entry = self._new().bid
+        self.exit = self._new().bid
+
+    def _new(self) -> Block:
+        b = Block(bid=len(self.blocks))
+        self.blocks[b.bid] = b
+        return b
+
+    def rpo(self) -> List[int]:
+        """Reverse-postorder from the entry (approximates topo order)."""
+        seen, order = set(), []
+
+        def visit(bid: int) -> None:
+            if bid in seen:
+                return
+            seen.add(bid)
+            for s in self.blocks[bid].succs:
+                visit(s)
+            order.append(bid)
+
+        visit(self.entry)
+        return list(reversed(order))
+
+
+class _Builder:
+    def __init__(self) -> None:
+        self.cfg = CFG()
+        self._loop_stack: List[tuple] = []  # (head_bid, after_bid)
+
+    def build(self, fn: ast.AST) -> CFG:
+        end = self._body(fn.body, self.cfg.blocks[self.cfg.entry])
+        end.add_succ(self.cfg.exit)
+        return self.cfg
+
+    def _body(self, stmts, cur: Block) -> Block:
+        for stmt in stmts:
+            if isinstance(stmt, ast.If):
+                after = self.cfg._new()
+                then = self.cfg._new()
+                cur.add_succ(then.bid)
+                self._body(stmt.body, then).add_succ(after.bid)
+                if stmt.orelse:
+                    els = self.cfg._new()
+                    cur.add_succ(els.bid)
+                    self._body(stmt.orelse, els).add_succ(after.bid)
+                else:
+                    cur.add_succ(after.bid)
+                cur = after
+            elif isinstance(stmt, (ast.While, ast.For, ast.AsyncFor)):
+                head = self.cfg._new()
+                head.stmts.append(stmt)  # the loop head itself transfers
+                cur.add_succ(head.bid)
+                after = self.cfg._new()
+                body = self.cfg._new()
+                head.add_succ(body.bid)
+                head.add_succ(after.bid)
+                self._loop_stack.append((head.bid, after.bid))
+                self._body(stmt.body, body).add_succ(head.bid)
+                self._loop_stack.pop()
+                if stmt.orelse:
+                    els = self.cfg._new()
+                    head.add_succ(els.bid)
+                    self._body(stmt.orelse, els).add_succ(after.bid)
+                cur = after
+            elif isinstance(stmt, ast.Try):
+                body = self.cfg._new()
+                cur.add_succ(body.bid)
+                after = self.cfg._new()
+                body_end = self._body(stmt.body, body)
+                tails = [body_end]
+                for h in stmt.handlers:
+                    hb = self.cfg._new()
+                    # any statement in the try may raise into the handler
+                    body.add_succ(hb.bid)
+                    body_end.add_succ(hb.bid)
+                    tails.append(self._body(h.body, hb))
+                if stmt.orelse:
+                    ob = self.cfg._new()
+                    body_end.add_succ(ob.bid)
+                    tails[0] = self._body(stmt.orelse, ob)
+                if stmt.finalbody:
+                    fb = self.cfg._new()
+                    for t in tails:
+                        t.add_succ(fb.bid)
+                    self._body(stmt.finalbody, fb).add_succ(after.bid)
+                else:
+                    for t in tails:
+                        t.add_succ(after.bid)
+                cur = after
+            elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+                cur.stmts.append(stmt)  # context exprs transfer in place
+                cur = self._body(stmt.body, cur)
+            elif isinstance(stmt, (ast.Return, ast.Raise)):
+                cur.stmts.append(stmt)
+                cur.add_succ(self.cfg.exit)
+                cur = self.cfg._new()  # unreachable continuation
+            elif isinstance(stmt, ast.Break):
+                if self._loop_stack:
+                    cur.add_succ(self._loop_stack[-1][1])
+                cur = self.cfg._new()
+            elif isinstance(stmt, ast.Continue):
+                if self._loop_stack:
+                    cur.add_succ(self._loop_stack[-1][0])
+                cur = self.cfg._new()
+            else:
+                cur.stmts.append(stmt)
+        return cur
+
+
+def build_cfg(fn: ast.AST) -> CFG:
+    """CFG of a FunctionDef/AsyncFunctionDef body."""
+    return _Builder().build(fn)
+
+
+class ForwardDataflow:
+    """Worklist fixed point over a CFG.  Subclass and supply:
+
+    - ``initial()`` — the entry state;
+    - ``bottom()`` — state for not-yet-visited blocks;
+    - ``join(a, b)`` — merge of predecessor out-states;
+    - ``transfer(stmt, state)`` — new state after one statement
+      (must not mutate ``state``).
+    """
+
+    def initial(self):  # pragma: no cover - interface
+        raise NotImplementedError
+
+    def bottom(self):  # pragma: no cover - interface
+        raise NotImplementedError
+
+    def join(self, a, b):  # pragma: no cover - interface
+        raise NotImplementedError
+
+    def transfer(self, stmt, state):  # pragma: no cover - interface
+        raise NotImplementedError
+
+    def run(self, cfg: CFG) -> Dict[int, object]:
+        """Returns block-id -> in-state at the fixed point."""
+        instates = {bid: self.bottom() for bid in cfg.blocks}
+        instates[cfg.entry] = self.initial()
+        work = cfg.rpo()
+        iters = 0
+        while work and iters < 10_000:
+            iters += 1
+            bid = work.pop(0)
+            state = instates[bid]
+            for stmt in cfg.blocks[bid].stmts:
+                state = self.transfer(stmt, state)
+            for succ in cfg.blocks[bid].succs:
+                merged = self.join(instates[succ], state)
+                if merged != instates[succ]:
+                    instates[succ] = merged
+                    if succ not in work:
+                        work.append(succ)
+        return instates

@@ -1,0 +1,161 @@
+"""COL005/COL006 — interprocedural collective program-order verification.
+
+The per-file lint (COL001–COL004) sees a rank-gated collective only when
+the gate and the collective share a function.  Multi-controller SPMD
+deadlocks do not respect file boundaries: ``booster.train`` calls a
+helper, the helper calls ``device_psum``, and the *edge into the helper*
+carries ``if jax.process_index() == 0`` — every other rank hangs in the
+collective it never reaches.  This pass walks the call graph from the
+mesh entry points and verifies the reachable collective program order:
+
+- **COL005** — a collective is reachable only through a call edge guarded
+  by a rank-/shard-dependent condition (``process_index()`` /
+  ``process_count()``) with no all-ranks evidence token.  Rank-pinned
+  guards (``process_index() == 0``) are the worst case — exactly one
+  rank enters.  Reported at the guarded call edge.
+- **COL006** — a collective executes inside a loop whose trip count can
+  diverge across ranks (the head iterates rank-local state: ``local_``/
+  ``per_rank``/``shard``-named iterables, or is bounded by a rank
+  query).  Ranks finishing the loop at different trip counts leave the
+  collective sequence misaligned — the slow rank blocks in an extra
+  collective nobody else joins.  Reported at the loop-carried edge (or
+  the collective itself when the loop is in the same function).
+
+Entry points: any ``train`` / ``dryrun_multichip`` function, plus public
+top-level functions of ``mmlspark_tpu/parallel/`` (excluding
+``distributed.py``, whose wrappers *are* the collective leaves and are
+never descended into).
+"""
+
+from __future__ import annotations
+
+import re
+from typing import List, Optional, Set, Tuple
+
+from tools.analyze.collectives import (
+    COLLECTIVE_NAMES,
+    EVIDENCE_TOKENS,
+    _RANK_PINNED,
+    _RANK_QUERY,
+    _collective_name,
+)
+from tools.analyze.common import Finding
+from tools.analyze.engine.index import CallSite, FunctionInfo, ProjectIndex
+
+_ENTRY_NAMES = {"train", "dryrun_multichip"}
+_LEAF_MODULE = "parallel/distributed.py"  # wrappers ARE the leaves
+_MAX_DEPTH = 25
+
+# loop heads whose trip counts are rank-local unless evidence says
+# otherwise ("process_local" carries evidence and wins over "local")
+_DIVERGENT_LOOP = re.compile(
+    r"\blocal_|_local\b|\bper_rank|\bmy_shard|\bshard_local|\bpending\b"
+)
+
+
+def _entries(index: ProjectIndex) -> List[FunctionInfo]:
+    out = []
+    for fi in index.functions:
+        if fi.name in _ENTRY_NAMES:
+            out.append(fi)
+        elif (
+            fi.cls is None and fi.parent is None
+            and not fi.name.startswith("_")
+            and fi.module.pkg_rel is not None
+            and fi.module.pkg_rel.replace("\\", "/").startswith("parallel/")
+            and fi.module.pkg_rel.replace("\\", "/") != _LEAF_MODULE
+        ):
+            out.append(fi)
+    return out
+
+
+def _rank_dependent(guard: str) -> Optional[str]:
+    """'pinned' | 'query' | None — with evidence tokens absolving."""
+    if not _RANK_QUERY.search(guard):
+        return None
+    if any(tok in guard for tok in EVIDENCE_TOKENS):
+        return None
+    return "pinned" if _RANK_PINNED.search(guard) else "query"
+
+
+def _divergent_loop(head: str) -> bool:
+    if any(tok in head for tok in EVIDENCE_TOKENS):
+        return False
+    if _RANK_QUERY.search(head):
+        return True  # range(process_index()) etc: trip count IS the rank
+    return bool(_DIVERGENT_LOOP.search(head))
+
+
+def check_collective_order(index: ProjectIndex) -> List[Finding]:
+    findings: List[Finding] = []
+    seen: Set[Tuple[str, int, str]] = set()
+
+    def emit(path: str, line: int, rule: str, msg: str) -> None:
+        key = (path, line, rule)
+        if key not in seen:
+            seen.add(key)
+            findings.append(Finding(path, line, rule, msg))
+
+    def walk(fi: FunctionInfo, stack: List[FunctionInfo],
+             edge_guards: List[Tuple[CallSite, str]],
+             edge_loops: List[Tuple[CallSite, str]], root: str) -> None:
+        if len(stack) > _MAX_DEPTH:
+            return
+        for site in fi.calls:
+            name = _collective_name(site.node)
+            if name is not None:
+                # COL005: guards on the CALLER edges only — the leaf
+                # site's own guards are the per-file COL001/COL003's job.
+                for gsite, guard in edge_guards:
+                    kind = _rank_dependent(guard)
+                    if kind is None:
+                        continue
+                    detail = (
+                        "only one rank ever reaches it"
+                        if kind == "pinned"
+                        else "ranks where the guard is false never reach it"
+                    )
+                    emit(
+                        gsite.caller.module.path, gsite.line, "COL005",
+                        f"call chain from {root}() reaches collective "
+                        f"{name}() through this rank-gated edge "
+                        f"({guard!r}) — {detail}; the other ranks "
+                        "deadlock in the collective (add all-ranks "
+                        "evidence or hoist the collective above the "
+                        "gate)",
+                    )
+                # COL006: loop context from caller edges AND the leaf's
+                # own enclosing loops (no per-file loop rule exists).
+                loop_ctx = list(edge_loops) + [
+                    (site, head) for head in site.loops
+                ]
+                for lsite, head in loop_ctx:
+                    if not _divergent_loop(head):
+                        continue
+                    emit(
+                        lsite.caller.module.path, lsite.line, "COL006",
+                        f"collective {name}() (reached from {root}()) "
+                        f"executes under loop ({head!r}) whose trip "
+                        "count is rank-local — ranks iterating "
+                        "different counts desynchronize the collective "
+                        "sequence and the job hangs (iterate a global "
+                        "count and mask, or gather rank-local work "
+                        "first)",
+                    )
+                continue
+            callee = site.callee
+            if callee is None or callee in stack:
+                continue
+            if (callee.module.pkg_rel or "").replace("\\", "/") == \
+                    _LEAF_MODULE:
+                continue  # collective wrappers are leaves by name already
+            walk(
+                callee, stack + [callee],
+                edge_guards + [(site, g) for g in site.guards],
+                edge_loops + [(site, h) for h in site.loops],
+                root,
+            )
+
+    for entry in _entries(index):
+        walk(entry, [entry], [], [], entry.name)
+    return findings

@@ -1,0 +1,370 @@
+"""DTY001 — f64 bin-edge contract flow.
+
+The binning contract (ROADMAP open item 2) declares bin edges
+(``BinMapper.upper_bounds`` / ``cat_maps``) **f64 single-authority**: the
+only legal route into an f32 context is the double-single ``(hi, lo)``
+split (``hi = edges.astype(f32)``, ``lo = f32(edges - f64(hi))``, compare
+``(hi < v) | ((hi == v) & (lo < 0))``), because a bare f64→f32 cast
+rounds edges onto data values and flips bin assignment at boundaries.
+
+This pass runs a forward taint dataflow (over the engine CFGs) through
+``ops/binning.py``, ``ops/device_binning.py`` and ``engine/booster.py``:
+
+- **sources** — loads of ``.upper_bounds`` / ``.cat_maps``;
+- **propagation** — assignments, subscripts/appends, numpy assembly
+  calls; *index-valued* results (``searchsorted``/``digitize``/``len``/
+  comparisons/int casts) drop the taint, since indices derived from
+  edges are not edge values;
+- **interprocedural** — call-graph-resolved calls inside the scope
+  propagate taint through parameters and tainted returns to a fixed
+  point;
+- **sinks** — ``.astype(float32)``, ``np.float32(x)`` / ``jnp.float32(x)``,
+  ``asarray/array(..., dtype=float32)``;
+- **sanction** — the enclosing function performs a subtraction
+  (``a - b`` / ``np.subtract``) mentioning the tainted root: that is the
+  double-single residual computation, so the cast is the sanctioned
+  conversion and its result is clean.
+
+A flagged path means an edge value reached f32 without the residual —
+exactly the silent parity break the contract forbids.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, FrozenSet, List, Optional, Set, Tuple
+
+from tools.analyze.common import Finding
+from tools.analyze.engine.cfg import ForwardDataflow
+from tools.analyze.engine.index import FunctionInfo, ProjectIndex
+
+_SCOPE = {"ops/binning.py", "ops/device_binning.py", "engine/booster.py"}
+_SOURCE_ATTRS = {"upper_bounds", "cat_maps"}
+#: calls whose results are index/size/bool-valued — edge taint stops
+_UNTAINTED_CALLS = {
+    "len", "int", "bool", "float", "range", "enumerate", "isinstance",
+    "min", "max", "searchsorted", "digitize", "argsort", "argmin",
+    "argmax", "nonzero", "count_nonzero", "shape", "print", "str",
+    "repr", "sorted",
+}
+_ASSEMBLY_SINKS = {"asarray", "array", "ascontiguousarray", "full",
+                   "frombuffer"}
+
+
+def _in_scope(pkg_rel: Optional[str]) -> bool:
+    return pkg_rel is not None and pkg_rel.replace("\\", "/") in _SCOPE
+
+
+def _leaf(func) -> Optional[str]:
+    if isinstance(func, ast.Name):
+        return func.id
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    return None
+
+
+def _is_f32(expr) -> bool:
+    try:
+        return "float32" in ast.unparse(expr)
+    except Exception:  # pragma: no cover
+        return False
+
+
+def _cast_dtype(call: ast.Call) -> Optional[ast.expr]:
+    """The dtype expression of an assembly call, if any."""
+    for kw in call.keywords:
+        if kw.arg == "dtype":
+            return kw.value
+    if len(call.args) >= 2:
+        return call.args[1]
+    return None
+
+
+def _sanction_names(fn_node) -> Set[str]:
+    """Names involved in any subtraction in the function — the
+    double-single residual computation mentions the edge table."""
+    out: Set[str] = set()
+    for n in ast.walk(fn_node):
+        sub = None
+        if isinstance(n, ast.BinOp) and isinstance(n.op, ast.Sub):
+            sub = n
+        elif isinstance(n, ast.Call) and _leaf(n.func) == "subtract":
+            sub = n
+        if sub is not None:
+            for m in ast.walk(sub):
+                if isinstance(m, ast.Name):
+                    out.add(m.id)
+                elif isinstance(m, ast.Attribute):
+                    out.add(m.attr)
+    return out
+
+
+class _Summaries:
+    """Grow-only interprocedural facts (params/returns), to a fixed
+    point across the scope."""
+
+    def __init__(self) -> None:
+        self.tainted_params: Dict[int, Set[str]] = {}
+        self.ret_tainted: Dict[int, bool] = {}
+        self.changed = False
+
+    def add_param(self, fi: FunctionInfo, param: str) -> None:
+        got = self.tainted_params.setdefault(id(fi), set())
+        if param not in got:
+            got.add(param)
+            self.changed = True
+
+    def set_ret(self, fi: FunctionInfo, val: bool) -> None:
+        if val and not self.ret_tainted.get(id(fi), False):
+            self.ret_tainted[id(fi)] = True
+            self.changed = True
+
+
+class _TaintFlow(ForwardDataflow):
+    def __init__(self, pass_, fi: FunctionInfo, emit) -> None:
+        self.p = pass_
+        self.fi = fi
+        self.emit = emit  # None during summary iterations
+        self.sanction = _sanction_names(fi.node)
+
+    # -- lattice ---------------------------------------------------------
+    def initial(self) -> FrozenSet[str]:
+        return frozenset(self.p.summaries.tainted_params.get(
+            id(self.fi), set()))
+
+    def bottom(self) -> FrozenSet[str]:
+        return frozenset()
+
+    def join(self, a, b):
+        return a | b
+
+    # -- taint of one expression ----------------------------------------
+    def _roots(self, expr, state: FrozenSet[str]) -> Set[str]:
+        """Tainted root names this expression carries (empty = clean)."""
+        if expr is None:
+            return set()
+        if isinstance(expr, ast.Name):
+            return {expr.id} if expr.id in state else set()
+        if isinstance(expr, ast.Attribute):
+            if expr.attr in _SOURCE_ATTRS and \
+                    isinstance(expr.ctx, ast.Load):
+                return {expr.attr}
+            return self._roots(expr.value, state)
+        if isinstance(expr, (ast.Compare, ast.BoolOp)):
+            return set()  # boolean-valued
+        if isinstance(expr, ast.Call):
+            leaf = _leaf(expr.func)
+            if leaf in _UNTAINTED_CALLS:
+                return set()
+            if leaf == "astype":
+                dtype = expr.args[0] if expr.args else None
+                if dtype is not None and not self._keeps_values(dtype):
+                    return set()  # int cast: index domain
+                roots = self._roots(expr.func.value, state)
+                if dtype is not None and _is_f32(dtype):
+                    return set()  # sink (flagged or sanctioned) -> clean
+                return roots
+            roots: Set[str] = set()
+            for a in expr.args:
+                roots |= self._roots(a, state)
+            for kw in expr.keywords:
+                roots |= self._roots(kw.value, state)
+            if isinstance(expr.func, ast.Attribute):
+                roots |= self._roots(expr.func.value, state)
+            callee = self.p.resolve(self.fi, expr)
+            if callee is not None:
+                # map tainted args onto callee params
+                self.p.map_args(self.fi, expr, callee, state)
+                if not self.p.summaries.ret_tainted.get(id(callee), False):
+                    return set()  # resolved, summary says clean return
+            if leaf in ("float32",) or (
+                    _leaf(expr.func) in _ASSEMBLY_SINKS
+                    and _cast_dtype(expr) is not None
+                    and _is_f32(_cast_dtype(expr))):
+                return set()  # f32 sinks produce non-edge values
+            return roots
+        roots = set()
+        for child in ast.iter_child_nodes(expr):
+            if isinstance(child, ast.expr):
+                roots |= self._roots(child, state)
+        return roots
+
+    @staticmethod
+    def _keeps_values(dtype_expr) -> bool:
+        try:
+            txt = ast.unparse(dtype_expr)
+        except Exception:  # pragma: no cover
+            return True
+        return not any(t in txt for t in
+                       ("int8", "int16", "int32", "int64", "uint",
+                        "bool"))
+
+    # -- sinks -----------------------------------------------------------
+    def _check_sinks(self, expr, state: FrozenSet[str]) -> None:
+        for node in ast.walk(expr):
+            if not isinstance(node, ast.Call):
+                continue
+            roots: Set[str] = set()
+            what = None
+            leaf = _leaf(node.func)
+            if leaf == "astype" and node.args and _is_f32(node.args[0]):
+                roots = self._roots(node.func.value, state)
+                what = ".astype(float32)"
+            elif leaf == "float32" and node.args:
+                roots = self._roots(node.args[0], state)
+                what = "float32()"
+            elif leaf in _ASSEMBLY_SINKS:
+                dt = _cast_dtype(node)
+                if dt is not None and _is_f32(dt):
+                    roots = {
+                        r for a in node.args
+                        for r in self._roots(a, state)
+                    }
+                    what = f"{leaf}(..., dtype=float32)"
+            if not roots or what is None:
+                continue
+            if roots & self.sanction:
+                continue  # double-single residual present: sanctioned
+            if self.emit is not None:
+                root = sorted(roots)[0]
+                self.emit(
+                    self.fi, node.lineno,
+                    f"f64 bin-edge value ({root!r}) flows into f32 via "
+                    f"{what} without the sanctioned double-single "
+                    "conversion — a rounded edge flips bin assignment "
+                    "for boundary values; split into (hi, lo) f32 pairs "
+                    "(see DeviceBinner.from_mapper) or keep the value "
+                    "f64",
+                )
+
+    # -- transfer --------------------------------------------------------
+    def transfer(self, stmt, state: FrozenSet[str]) -> FrozenSet[str]:
+        out = set(state)
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            return frozenset(out)  # separate frames, analyzed on their own
+        if isinstance(stmt, (ast.For, ast.AsyncFor)):
+            self._check_sinks(stmt.iter, state)
+            if self._roots(stmt.iter, state):
+                for t in ast.walk(stmt.target):
+                    if isinstance(t, ast.Name):
+                        out.add(t.id)
+            return frozenset(out)
+        if isinstance(stmt, ast.While):
+            self._check_sinks(stmt.test, state)
+            return frozenset(out)
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            for item in stmt.items:
+                self._check_sinks(item.context_expr, state)
+                if item.optional_vars is not None and \
+                        isinstance(item.optional_vars, ast.Name) and \
+                        self._roots(item.context_expr, state):
+                    out.add(item.optional_vars.id)
+            return frozenset(out)
+        self._check_sinks(stmt, state)
+        if isinstance(stmt, ast.Assign):
+            tainted = bool(self._roots(stmt.value, state))
+            for tgt in stmt.targets:
+                if isinstance(tgt, ast.Name):
+                    if tainted:
+                        out.add(tgt.id)
+                    else:
+                        out.discard(tgt.id)
+                elif isinstance(tgt, ast.Subscript) and tainted:
+                    base = tgt.value
+                    if isinstance(base, ast.Name):
+                        out.add(base.id)
+                elif isinstance(tgt, (ast.Tuple, ast.List)) and tainted:
+                    for el in tgt.elts:
+                        if isinstance(el, ast.Name):
+                            out.add(el.id)
+        elif isinstance(stmt, ast.AugAssign):
+            if isinstance(stmt.target, ast.Name) and \
+                    self._roots(stmt.value, state):
+                out.add(stmt.target.id)
+        elif isinstance(stmt, ast.AnnAssign):
+            if isinstance(stmt.target, ast.Name) and stmt.value is not None:
+                if self._roots(stmt.value, state):
+                    out.add(stmt.target.id)
+                else:
+                    out.discard(stmt.target.id)
+        elif isinstance(stmt, ast.Expr):
+            call = stmt.value
+            if isinstance(call, ast.Call) and \
+                    isinstance(call.func, ast.Attribute) and \
+                    call.func.attr in ("append", "extend", "insert"):
+                recv = call.func.value
+                if isinstance(recv, ast.Name) and any(
+                        self._roots(a, state) for a in call.args):
+                    out.add(recv.id)
+        elif isinstance(stmt, ast.Return):
+            if stmt.value is not None and \
+                    self._roots(stmt.value, state):
+                self.p.summaries.set_ret(self.fi, True)
+        return frozenset(out)
+
+
+class DtypeFlowPass:
+    def __init__(self, index: ProjectIndex):
+        self.index = index
+        self.scope_fns: List[FunctionInfo] = [
+            fi for mi in index.package_modules()
+            if _in_scope(mi.pkg_rel) for fi in mi.functions
+        ]
+        self.scope_fn_ids = {id(fi) for fi in self.scope_fns}
+        self.summaries = _Summaries()
+
+    def resolve(self, fi: FunctionInfo, call: ast.Call
+                ) -> Optional[FunctionInfo]:
+        for site in fi.calls:
+            if site.node is call:
+                callee = site.callee
+                if callee is not None and id(callee) in self.scope_fn_ids:
+                    return callee
+                return None
+        return None
+
+    def map_args(self, caller: FunctionInfo, call: ast.Call,
+                 callee: FunctionInfo, state: FrozenSet[str]) -> None:
+        flow = _TaintFlow(self, caller, emit=None)
+        params = [a.arg for a in callee.node.args.args]
+        if callee.cls is not None and params and params[0] in (
+                "self", "cls"):
+            params = params[1:]
+        for i, arg in enumerate(call.args):
+            if i < len(params) and flow._roots(arg, state):
+                self.summaries.add_param(callee, params[i])
+        for kw in call.keywords:
+            if kw.arg is not None and kw.arg in params and \
+                    flow._roots(kw.value, state):
+                self.summaries.add_param(callee, kw.arg)
+
+    def _analyze(self, fi: FunctionInfo, emit) -> None:
+        flow = _TaintFlow(self, fi, emit)
+        flow.run(self.index.cfg(fi))
+
+    def run(self) -> List[Finding]:
+        # fixed point on the interprocedural summaries
+        for _ in range(8):
+            self.summaries.changed = False
+            for fi in self.scope_fns:
+                self._analyze(fi, emit=None)
+            if not self.summaries.changed:
+                break
+        findings: List[Finding] = []
+        seen: Set[Tuple[str, int]] = set()
+
+        def emit(fi: FunctionInfo, line: int, msg: str) -> None:
+            key = (fi.module.path, line)
+            if key not in seen:
+                seen.add(key)
+                findings.append(Finding(fi.module.path, line, "DTY001",
+                                        msg))
+
+        for fi in self.scope_fns:
+            self._analyze(fi, emit)
+        return findings
+
+
+def check_dtype_flow(index: ProjectIndex) -> List[Finding]:
+    return DtypeFlowPass(index).run()

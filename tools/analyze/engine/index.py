@@ -1,0 +1,500 @@
+"""The shared project index every pass consumes.
+
+One parse of the repo produces:
+
+- a **module graph**: every ``mmlspark_tpu/**/*.py`` module (plus the
+  ``__graft_entry__.py`` driver) with its text, AST, and resolved import
+  map;
+- a **symbol table**: top-level functions, classes (including classes
+  nested inside functions — the HTTP transport's ``Handler``), methods,
+  and lexically nested functions;
+- a **call graph**: every call site, annotated with the guard chain and
+  enclosing-loop chain at the site (the same guard semantics the
+  per-file collective lint uses), with a best-effort resolution to the
+  :class:`FunctionInfo` it invokes;
+- cached ``native/*.cpp`` texts for the ABI pass.
+
+Resolution is deliberately heuristic (this is a linter, not a type
+checker): bare names resolve lexically then through imports; ``self.m()``
+resolves through the enclosing class (then project base classes);
+``mod.f()`` through the import map; other ``obj.m()`` receivers through
+attribute-assignment aliases (``server.intake = self._intake``) and,
+last, a unique-method-name map guarded by a blocklist of container-like
+names (``get``/``put``/``join``/... never unique-resolve — a dict ``.get``
+must not alias a registry method).  Unrecognized calls resolve to None
+and passes treat them as opaque.
+"""
+
+from __future__ import annotations
+
+import ast
+import glob
+import os
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+#: Method names too generic to resolve by project-wide uniqueness: these
+#: collide with dict/queue/threading/file receivers the index cannot type.
+_UNIQUE_METHOD_BLOCKLIST = {
+    "get", "put", "set", "pop", "add", "append", "extend", "remove",
+    "discard", "update", "clear", "copy", "keys", "values", "items",
+    "join", "start", "wait", "read", "write", "close", "send", "recv",
+    "count", "index", "sort", "reverse", "match", "search", "group",
+    "split", "strip", "format", "encode", "decode", "flush", "seek",
+}
+
+
+@dataclass
+class CallSite:
+    """One call expression with its intra-function control context."""
+
+    caller: "FunctionInfo"
+    node: ast.Call
+    line: int
+    name: str                    # best-effort callee text ("obj.meth" / "f")
+    guards: Tuple[str, ...]      # enclosing if/ternary tests (+ negations)
+    loops: Tuple[str, ...]       # enclosing loop heads ("for x in y", ...)
+    callee: Optional["FunctionInfo"] = None
+
+
+@dataclass
+class FunctionInfo:
+    """A function or method (possibly lexically nested)."""
+
+    name: str
+    qualname: str                # module.Class.meth / module.outer.inner
+    module: "ModuleInfo"
+    node: ast.AST                # FunctionDef | AsyncFunctionDef
+    cls: Optional[str] = None    # enclosing class name, if a method
+    parent: Optional["FunctionInfo"] = None  # lexically enclosing function
+    local_defs: Dict[str, "FunctionInfo"] = field(default_factory=dict)
+    calls: List[CallSite] = field(default_factory=list)
+
+    def __repr__(self) -> str:  # keep debugging output short
+        return f"<fn {self.qualname}>"
+
+
+@dataclass
+class ClassInfo:
+    name: str
+    module: "ModuleInfo"
+    node: ast.ClassDef
+    bases: List[str] = field(default_factory=list)
+    methods: Dict[str, FunctionInfo] = field(default_factory=dict)
+
+
+@dataclass
+class ModuleInfo:
+    path: str                    # absolute path
+    rel: str                     # path relative to the repo root
+    pkg_rel: Optional[str]       # relative to mmlspark_tpu/ (None outside)
+    module: str                  # dotted name ("mmlspark_tpu.serve.app")
+    tree: ast.Module
+    text: str
+    imports: Dict[str, str] = field(default_factory=dict)
+    defs: Dict[str, FunctionInfo] = field(default_factory=dict)
+    classes: Dict[str, ClassInfo] = field(default_factory=dict)
+    functions: List[FunctionInfo] = field(default_factory=list)
+
+
+class ProjectIndex:
+    """Everything the passes share; built once per :func:`run_all`."""
+
+    def __init__(self, root: str):
+        self.root = root
+        self.modules: Dict[str, ModuleInfo] = {}       # dotted -> info
+        self.by_path: Dict[str, ModuleInfo] = {}
+        self.functions: List[FunctionInfo] = []
+        self.methods_by_name: Dict[str, List[FunctionInfo]] = {}
+        self.classes_by_name: Dict[str, List[ClassInfo]] = {}
+        self.attr_aliases: Dict[str, List[FunctionInfo]] = {}
+        self.native_cpps: Dict[str, str] = {}          # path -> text
+        self._cfg_cache: Dict[int, object] = {}
+
+    # -- views -----------------------------------------------------------
+    def package_modules(self) -> List[ModuleInfo]:
+        """Modules under ``mmlspark_tpu/`` in glob (path-sorted) order."""
+        return sorted(
+            (m for m in self.modules.values() if m.pkg_rel is not None),
+            key=lambda m: m.path,
+        )
+
+    def texts(self) -> Dict[str, str]:
+        """path -> source text for every indexed file (suppression cache)."""
+        out = {m.path: m.text for m in self.modules.values()}
+        out.update(self.native_cpps)
+        return out
+
+    def cfg(self, fi: FunctionInfo):
+        """The (cached) control-flow graph of a function."""
+        from tools.analyze.engine.cfg import build_cfg
+
+        key = id(fi.node)
+        got = self._cfg_cache.get(key)
+        if got is None:
+            got = self._cfg_cache[key] = build_cfg(fi.node)
+        return got
+
+    # -- call resolution -------------------------------------------------
+    def resolve_value(self, expr, caller: FunctionInfo
+                      ) -> Optional[FunctionInfo]:
+        """A function VALUE (``target=self._worker`` / ``target=_do``)."""
+        if isinstance(expr, ast.Name):
+            p: Optional[FunctionInfo] = caller
+            while p is not None:
+                if expr.id in p.local_defs:
+                    return p.local_defs[expr.id]
+                p = p.parent
+            return caller.module.defs.get(expr.id)
+        if (isinstance(expr, ast.Attribute)
+                and isinstance(expr.value, ast.Name)
+                and expr.value.id == "self" and caller.cls):
+            return self._class_method(caller.module, caller.cls, expr.attr)
+        return None
+
+    def _class_method(self, module: ModuleInfo, cls: str, meth: str
+                      ) -> Optional[FunctionInfo]:
+        ci = module.classes.get(cls)
+        seen = set()
+        while ci is not None and ci.name not in seen:
+            seen.add(ci.name)
+            if meth in ci.methods:
+                return ci.methods[meth]
+            nxt = None
+            for base in ci.bases:
+                cands = self.classes_by_name.get(base, [])
+                if len(cands) == 1:
+                    nxt = cands[0]
+                    break
+            ci = nxt
+        return None
+
+    def _import_target(self, module: ModuleInfo, local: str):
+        """(kind, obj) for an imported name: ('module', ModuleInfo) |
+        ('func', FunctionInfo) | ('class', ClassInfo) | None."""
+        tgt = module.imports.get(local)
+        if tgt is None:
+            return None
+        if ":" in tgt:
+            mod, attr = tgt.split(":", 1)
+            mi = self.modules.get(mod)
+            if mi is None:
+                return None
+            if attr in mi.defs:
+                return ("func", mi.defs[attr])
+            if attr in mi.classes:
+                return ("class", mi.classes[attr])
+            return None
+        mi = self.modules.get(tgt)
+        return ("module", mi) if mi is not None else None
+
+    def resolve_call(self, site: CallSite,
+                     methods_by_name: Optional[Dict[str, List[FunctionInfo]]]
+                     = None) -> Optional[FunctionInfo]:
+        """Best-effort callee of a call site (see module docstring).
+
+        ``methods_by_name`` lets a pass narrow unique-method resolution to
+        a subsystem (the lock pass resolves within serve/ only).
+        """
+        func = site.node.func
+        caller = site.caller
+        if isinstance(func, ast.Name):
+            fi = self.resolve_value(func, caller)
+            if fi is not None:
+                return fi
+            got = self._import_target(caller.module, func.id)
+            if got is not None:
+                kind, obj = got
+                if kind == "func":
+                    return obj
+                if kind == "class":
+                    return obj.methods.get("__init__")
+            ci = caller.module.classes.get(func.id)
+            if ci is not None:
+                return ci.methods.get("__init__")
+            return None
+        if not isinstance(func, ast.Attribute):
+            return None
+        base = func.value
+        if isinstance(base, ast.Name):
+            if base.id in ("self", "cls") and caller.cls:
+                return self._class_method(caller.module, caller.cls,
+                                          func.attr)
+            got = self._import_target(caller.module, base.id)
+            if got is not None:
+                kind, obj = got
+                if kind == "module":
+                    if func.attr in obj.defs:
+                        return obj.defs[func.attr]
+                    ci = obj.classes.get(func.attr)
+                    if ci is not None:
+                        return ci.methods.get("__init__")
+                    return None
+                if kind == "class":
+                    return obj.methods.get(func.attr)
+        # attribute-assignment alias (server.intake = self._intake)
+        aliases = self.attr_aliases.get(func.attr, [])
+        if len(aliases) == 1:
+            return aliases[0]
+        # last resort: the method name is unique project-wide
+        if func.attr in _UNIQUE_METHOD_BLOCKLIST:
+            return None
+        table = (methods_by_name if methods_by_name is not None
+                 else self.methods_by_name)
+        cands = table.get(func.attr, [])
+        if len(cands) == 1:
+            return cands[0]
+        return None
+
+
+# ---------------------------------------------------------------- builder
+
+
+def _module_name(root: str, path: str) -> str:
+    rel = os.path.relpath(path, root)
+    parts = rel[:-3].split(os.sep)  # strip .py
+    if parts[-1] == "__init__":
+        parts = parts[:-1]
+    return ".".join(parts)
+
+
+def _collect_imports(mi: ModuleInfo, known: set) -> None:
+    """All imports anywhere in the module (the repo lazy-imports inside
+    functions heavily) -> ``local name -> "pkg.mod" | "pkg.mod:attr"``."""
+    for node in ast.walk(mi.tree):
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                if alias.asname:
+                    mi.imports[alias.asname] = alias.name
+                else:
+                    head = alias.name.split(".", 1)[0]
+                    mi.imports.setdefault(head, head)
+        elif isinstance(node, ast.ImportFrom):
+            if node.level:
+                parts = mi.module.split(".")
+                # level 1 = the containing package of this module
+                parts = parts[: len(parts) - node.level]
+                base = ".".join(parts)
+                if node.module:
+                    base = f"{base}.{node.module}" if base else node.module
+            else:
+                base = node.module or ""
+            for alias in node.names:
+                local = alias.asname or alias.name
+                as_mod = f"{base}.{alias.name}" if base else alias.name
+                if as_mod in known:
+                    mi.imports[local] = as_mod
+                elif base:
+                    mi.imports[local] = f"{base}:{alias.name}"
+
+
+class _SymbolWalker:
+    """Fills a module's functions/classes/defs tables."""
+
+    def __init__(self, index: ProjectIndex, mi: ModuleInfo):
+        self.index = index
+        self.mi = mi
+
+    def walk_module(self) -> None:
+        self._walk_body(self.mi.tree.body, qual=self.mi.module,
+                        cls=None, parent=None)
+
+    def _walk_body(self, body, qual: str, cls: Optional[str],
+                   parent: Optional[FunctionInfo]) -> None:
+        for stmt in body:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                fi = FunctionInfo(
+                    name=stmt.name, qualname=f"{qual}.{stmt.name}",
+                    module=self.mi, node=stmt, cls=cls, parent=parent,
+                )
+                self.mi.functions.append(fi)
+                self.index.functions.append(fi)
+                if parent is not None:
+                    parent.local_defs[stmt.name] = fi
+                elif cls is None:
+                    self.mi.defs[stmt.name] = fi
+                if cls is not None and parent is None:
+                    ci = self.mi.classes.get(cls)
+                    if ci is not None:
+                        ci.methods[stmt.name] = fi
+                    self.index.methods_by_name.setdefault(
+                        stmt.name, []).append(fi)
+                # nested defs/classes live inside the new function frame
+                self._walk_body(stmt.body, qual=fi.qualname, cls=None,
+                                parent=fi)
+            elif isinstance(stmt, ast.ClassDef):
+                ci = ClassInfo(
+                    name=stmt.name, module=self.mi, node=stmt,
+                    bases=[b.attr if isinstance(b, ast.Attribute) else
+                           getattr(b, "id", "") for b in stmt.bases],
+                )
+                self.mi.classes[stmt.name] = ci
+                self.index.classes_by_name.setdefault(
+                    stmt.name, []).append(ci)
+                self._walk_body(stmt.body, qual=f"{qual}.{stmt.name}",
+                                cls=stmt.name, parent=None)
+            elif isinstance(stmt, (ast.If, ast.Try, ast.With,
+                                   ast.For, ast.While)):
+                # defs under conditionals still define module/class symbols
+                for sub in ast.iter_child_nodes(stmt):
+                    if isinstance(sub, list):
+                        continue
+                for blk in (getattr(stmt, "body", []),
+                            getattr(stmt, "orelse", []),
+                            getattr(stmt, "finalbody", [])):
+                    self._walk_body(blk, qual=qual, cls=cls, parent=parent)
+                for h in getattr(stmt, "handlers", []):
+                    self._walk_body(h.body, qual=qual, cls=cls,
+                                    parent=parent)
+
+
+class _CallWalker:
+    """Records CallSites (with guard/loop context) for one function, and
+    attribute-assignment aliases module-wide.  Guard semantics mirror the
+    per-file collective lint: enclosing if/ternary tests plus negated
+    tests of earlier same-block early-return ifs."""
+
+    def __init__(self, index: ProjectIndex, fi: FunctionInfo):
+        self.index = index
+        self.fi = fi
+
+    @staticmethod
+    def _callee_text(func) -> str:
+        try:
+            return ast.unparse(func)
+        except Exception:  # pragma: no cover - unparse is total in 3.9+
+            return "<call>"
+
+    def walk(self) -> None:
+        node = self.fi.node
+        self._scan_body(node.body, guards=[], loops=[])
+
+    # -- shared with the alias collector ---------------------------------
+    def _record_call(self, call: ast.Call, guards, loops) -> None:
+        self.fi.calls.append(CallSite(
+            caller=self.fi, node=call, line=call.lineno,
+            name=self._callee_text(call.func),
+            guards=tuple(guards), loops=tuple(loops),
+        ))
+
+    def _record_alias(self, stmt: ast.Assign) -> None:
+        for tgt in stmt.targets:
+            if not isinstance(tgt, ast.Attribute):
+                continue
+            fi = self.index.resolve_value(stmt.value, self.fi)
+            if fi is not None:
+                self.index.attr_aliases.setdefault(tgt.attr, []).append(fi)
+
+    def _scan_expr(self, node, guards, loops) -> None:
+        if node is None:
+            return
+        if isinstance(node, ast.IfExp):
+            test_src = ast.unparse(node.test)
+            self._scan_expr(node.test, guards, loops)
+            self._scan_expr(node.body, guards + [test_src], loops)
+            self._scan_expr(node.orelse, guards + [f"not ({test_src})"],
+                            loops)
+            return
+        if isinstance(node, ast.Call):
+            self._record_call(node, guards, loops)
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.Lambda, ast.ClassDef)):
+                continue  # separate frames
+            self._scan_expr(child, guards, loops)
+
+    def _scan_body(self, body, guards, loops) -> None:
+        negated: list = []
+        for stmt in body:
+            g = guards + negated
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                continue  # indexed as their own FunctionInfos
+            if isinstance(stmt, ast.Assign):
+                self._record_alias(stmt)
+            if isinstance(stmt, ast.If):
+                test_src = ast.unparse(stmt.test)
+                self._scan_expr(stmt.test, g, loops)
+                self._scan_body(stmt.body, g + [test_src], loops)
+                if stmt.orelse:
+                    self._scan_body(stmt.orelse,
+                                    g + [f"not ({test_src})"], loops)
+                if _terminates(stmt.body) and not stmt.orelse:
+                    negated.append(f"not ({test_src})")
+            elif isinstance(stmt, (ast.For, ast.AsyncFor)):
+                head = (f"for {ast.unparse(stmt.target)} in "
+                        f"{ast.unparse(stmt.iter)}")
+                self._scan_expr(stmt.iter, g, loops)
+                self._scan_body(stmt.body, g, loops + [head])
+                self._scan_body(stmt.orelse, g, loops)
+            elif isinstance(stmt, ast.While):
+                head = f"while {ast.unparse(stmt.test)}"
+                self._scan_expr(stmt.test, g, loops)
+                self._scan_body(stmt.body, g, loops + [head])
+                self._scan_body(stmt.orelse, g, loops)
+            elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+                for item in stmt.items:
+                    self._scan_expr(item.context_expr, g, loops)
+                self._scan_body(stmt.body, g, loops)
+            elif isinstance(stmt, ast.Try):
+                self._scan_body(stmt.body, g, loops)
+                for h in stmt.handlers:
+                    self._scan_body(h.body, g, loops)
+                self._scan_body(stmt.orelse, g, loops)
+                self._scan_body(stmt.finalbody, g, loops)
+            else:
+                self._scan_expr(stmt, g, loops)
+
+
+def _terminates(body) -> bool:
+    return bool(body) and isinstance(
+        body[-1], (ast.Return, ast.Raise, ast.Continue, ast.Break)
+    )
+
+
+def build_index(root: str) -> ProjectIndex:
+    """Parse the repo once and build the shared index.
+
+    Tolerant of partial trees (fixture roots without ``mmlspark_tpu/`` or
+    without the driver) — missing pieces simply index as empty.
+    """
+    index = ProjectIndex(root)
+    pkg = os.path.join(root, "mmlspark_tpu")
+    paths = sorted(glob.glob(os.path.join(pkg, "**", "*.py"),
+                             recursive=True))
+    graft = os.path.join(root, "__graft_entry__.py")
+    if os.path.isfile(graft):
+        paths.append(graft)
+    for path in paths:
+        try:
+            with open(path, encoding="utf-8", errors="replace") as fh:
+                text = fh.read()
+            tree = ast.parse(text, filename=path)
+        except (OSError, SyntaxError):
+            continue
+        rel = os.path.relpath(path, root)
+        pkg_rel = (os.path.relpath(path, pkg)
+                   if path.startswith(pkg + os.sep) else None)
+        mi = ModuleInfo(
+            path=path, rel=rel, pkg_rel=pkg_rel,
+            module=_module_name(root, path), tree=tree, text=text,
+        )
+        index.modules[mi.module] = mi
+        index.by_path[path] = mi
+    known = set(index.modules)
+    for mi in index.modules.values():
+        _collect_imports(mi, known)
+        _SymbolWalker(index, mi).walk_module()
+    for mi in index.modules.values():
+        for fi in mi.functions:
+            _CallWalker(index, fi).walk()
+    for fi in index.functions:
+        for site in fi.calls:
+            site.callee = index.resolve_call(site)
+    for cpp in sorted(glob.glob(os.path.join(pkg, "native", "*.cpp"))):
+        try:
+            with open(cpp, encoding="utf-8", errors="replace") as fh:
+                index.native_cpps[cpp] = fh.read()
+        except OSError:
+            continue
+    return index

@@ -1,0 +1,402 @@
+"""LCK001–LCK003 — serve-layer concurrency analysis.
+
+Scope: ``mmlspark_tpu/serve/`` plus ``mmlspark_tpu/io/http/serving.py``
+(the subsystem where the batcher worker thread, the HTTP request
+threads, and hot-swap drains interleave).  The pass builds, from the
+project index:
+
+- the **lock table**: ``self.X = threading.Lock()/RLock()/Condition()``
+  in a scope class -> lock key ``(ClassName, X)``;
+- the **blocking-receiver table**: attrs assigned ``Queue``/``Event``/
+  ``Thread`` constructions (``.get``/``.wait``/``.join``/``.put`` on
+  those can block; a dict's ``.get`` never matches);
+- **held regions**: statements under ``with self.X:`` (or ``with obj.X:``
+  for an unidentifiable receiver, tracked as an opaque key);
+- the **thread-domain map**: functions reachable from batcher/worker
+  thread roots (``threading.Thread(target=...)`` resolutions) and from
+  request-thread roots (``do_*`` methods of ``BaseHTTPRequestHandler``
+  subclasses), via the call graph with scope-restricted resolution.
+
+Rules
+-----
+- LCK001: a call made while holding lock L resolves to a function whose
+  (transitive, depth<=3) acquired-lock set contains a different scope
+  lock M — the registry's take-``self._lock``-then-``mv.acquire()``
+  shape.  Two threads entering the two locks in opposite orders
+  deadlock; at best, M's waiters stall behind L.
+- LCK002: a blocking call (``.get``/``.put``/``.join``/``.wait`` on a
+  tracked Queue/Event/Thread receiver, or ``time.sleep``) while holding
+  a lock.  Explicitly non-blocking forms (``*_nowait``, ``block=False``,
+  ``timeout=0``) are exempt.
+- LCK003: ``self.X = ...`` writes (outside ``__init__``) in a function
+  reachable from one thread domain while ``self.X`` is also accessed
+  from the other domain, with no common lock held at both sites.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass
+from typing import Dict, FrozenSet, List, Optional, Set, Tuple
+
+from tools.analyze.common import Finding
+from tools.analyze.engine.index import FunctionInfo, ModuleInfo, ProjectIndex
+
+LockKey = Tuple[str, str]  # (class name | "<unknown>", attr)
+
+_LOCK_CTORS = {"Lock", "RLock", "Condition"}
+_BLOCKING_CTORS = {
+    "Queue": "queue", "LifoQueue": "queue", "PriorityQueue": "queue",
+    "SimpleQueue": "queue", "Event": "event", "Thread": "thread",
+    "Semaphore": "lockish", "BoundedSemaphore": "lockish",
+}
+_BLOCKING_METHODS = {
+    "queue": {"get", "put"},
+    "event": {"wait"},
+    "thread": {"join"},
+    "lockish": {"acquire"},
+}
+
+
+def _in_scope(mi: ModuleInfo) -> bool:
+    rel = (mi.pkg_rel or "").replace("\\", "/")
+    return rel.startswith("serve/") or rel == "io/http/serving.py"
+
+
+def _ctor_leaf(call: ast.Call) -> Optional[str]:
+    f = call.func
+    if isinstance(f, ast.Name):
+        return f.id
+    if isinstance(f, ast.Attribute):
+        return f.attr
+    return None
+
+
+def _is_false(node) -> bool:
+    return isinstance(node, ast.Constant) and node.value is False
+
+
+def _is_zero(node) -> bool:
+    return isinstance(node, ast.Constant) and node.value == 0
+
+
+def _nonblocking_call(call: ast.Call, attr: str) -> bool:
+    if attr.endswith("_nowait"):
+        return True
+    if call.args and _is_false(call.args[0]):
+        return True
+    for kw in call.keywords:
+        if kw.arg == "block" and _is_false(kw.value):
+            return True
+        if kw.arg == "timeout" and _is_zero(kw.value):
+            return True
+    return False
+
+
+@dataclass
+class _Access:
+    fn: FunctionInfo
+    attr: str
+    line: int
+    is_write: bool
+    held: FrozenSet[LockKey]
+
+
+class _FnScan:
+    """One function's lock-relevant facts, via a held-set body walk."""
+
+    def __init__(self, pass_, fi: FunctionInfo):
+        self.p = pass_
+        self.fi = fi
+        self.direct_locks: Set[LockKey] = set()
+        self.calls_under: List[tuple] = []   # (call, held, attr-or-None)
+        self.accesses: List[_Access] = []
+        self.resolved_calls: List[tuple] = []  # (call, callee, held)
+        self.local_blocking: Dict[str, str] = {}  # name -> kind
+
+    def lock_key(self, expr) -> Optional[LockKey]:
+        """``self.X`` / ``obj.X`` as a lock key, or None."""
+        if not isinstance(expr, ast.Attribute):
+            return None
+        attr = expr.attr
+        if isinstance(expr.value, ast.Name) and expr.value.id == "self" \
+                and self.fi.cls:
+            if (self.fi.cls, attr) in self.p.locks:
+                return (self.fi.cls, attr)
+            return None
+        if attr in self.p.lock_attr_names:
+            return ("<unknown>", attr)
+        return None
+
+    def run(self) -> None:
+        self._walk(self.fi.node.body, frozenset())
+
+    def _walk(self, body, held: FrozenSet[LockKey]) -> None:
+        for stmt in body:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                continue
+            if isinstance(stmt, (ast.With, ast.AsyncWith)):
+                inner = set(held)
+                for item in stmt.items:
+                    key = self.lock_key(item.context_expr)
+                    if key is not None:
+                        inner.add(key)
+                        self.direct_locks.add(key)
+                    else:
+                        self._exprs(item.context_expr, held)
+                self._walk(stmt.body, frozenset(inner))
+                continue
+            if isinstance(stmt, ast.Assign):
+                for tgt in stmt.targets:
+                    if (isinstance(tgt, ast.Attribute)
+                            and isinstance(tgt.value, ast.Name)
+                            and tgt.value.id == "self"):
+                        self.accesses.append(_Access(
+                            self.fi, tgt.attr, stmt.lineno, True, held))
+                    elif isinstance(tgt, ast.Name):
+                        kind = self.p.ctor_kind(stmt.value)
+                        if kind is not None:
+                            self.local_blocking[tgt.id] = kind
+            for blk in (getattr(stmt, "body", None),
+                        getattr(stmt, "orelse", None),
+                        getattr(stmt, "finalbody", None)):
+                if blk:
+                    self._walk(blk, held)
+            for h in getattr(stmt, "handlers", []) or []:
+                self._walk(h.body, held)
+            if not isinstance(stmt, (ast.If, ast.For, ast.AsyncFor,
+                                     ast.While, ast.Try)):
+                self._exprs(stmt, held)
+            else:
+                for expr in ast.iter_child_nodes(stmt):
+                    if isinstance(expr, ast.expr):
+                        self._exprs(expr, held)
+
+    def _exprs(self, node, held: FrozenSet[LockKey]) -> None:
+        for n in ast.walk(node):
+            if isinstance(n, ast.Call):
+                attr = (n.func.attr
+                        if isinstance(n.func, ast.Attribute) else None)
+                self.calls_under.append((n, held, attr))
+            elif isinstance(n, ast.Attribute) and \
+                    isinstance(n.value, ast.Name) and \
+                    n.value.id == "self" and \
+                    isinstance(n.ctx, ast.Load):
+                self.accesses.append(_Access(
+                    self.fi, n.attr, n.lineno, False, held))
+
+
+class LockPass:
+    def __init__(self, index: ProjectIndex):
+        self.index = index
+        self.scope_mods = [m for m in index.package_modules()
+                           if _in_scope(m)]
+        self.scope_fns = [fi for m in self.scope_mods for fi in m.functions]
+        self.locks: Set[LockKey] = set()
+        self.lock_attr_names: Set[str] = set()
+        self.blocking_attrs: Dict[str, str] = {}   # attr -> kind
+        self.scope_methods: Dict[str, List[FunctionInfo]] = {}
+        self.scope_fn_ids = {id(fi) for fi in self.scope_fns}
+        self.scans: Dict[int, _FnScan] = {}        # id(fi) -> scan
+        self.domains: Dict[int, Set[str]] = {}     # id(fi) -> domains
+
+    def ctor_kind(self, expr) -> Optional[str]:
+        if not isinstance(expr, ast.Call):
+            return None
+        leaf = _ctor_leaf(expr)
+        return _BLOCKING_CTORS.get(leaf) if leaf else None
+
+    # -- table building --------------------------------------------------
+    def _collect_tables(self) -> None:
+        for mi in self.scope_mods:
+            for ci in mi.classes.values():
+                for name, fi in ci.methods.items():
+                    self.scope_methods.setdefault(name, []).append(fi)
+        for fi in self.scope_fns:
+            for node in ast.walk(fi.node):
+                if not isinstance(node, ast.Assign):
+                    continue
+                for tgt in node.targets:
+                    if not isinstance(tgt, ast.Attribute):
+                        continue
+                    if not isinstance(node.value, ast.Call):
+                        continue
+                    leaf = _ctor_leaf(node.value)
+                    if leaf in _LOCK_CTORS:
+                        if isinstance(tgt.value, ast.Name) and \
+                                tgt.value.id == "self" and fi.cls:
+                            self.locks.add((fi.cls, tgt.attr))
+                            self.lock_attr_names.add(tgt.attr)
+                    elif leaf in _BLOCKING_CTORS:
+                        self.blocking_attrs[tgt.attr] = \
+                            _BLOCKING_CTORS[leaf]
+
+    def _scan(self, fi: FunctionInfo) -> _FnScan:
+        got = self.scans.get(id(fi))
+        if got is None:
+            got = _FnScan(self, fi)
+            got.run()
+            self.scans[id(fi)] = got
+        return got
+
+    def _resolve(self, fi: FunctionInfo, call: ast.Call
+                 ) -> Optional[FunctionInfo]:
+        for site in fi.calls:
+            if site.node is call:
+                return self.index.resolve_call(site, self.scope_methods)
+        return None
+
+    # -- LCK001 helpers --------------------------------------------------
+    def _acquired_closure(self, fi: FunctionInfo, depth: int = 3,
+                          _stack=None) -> Set[LockKey]:
+        _stack = _stack or set()
+        if id(fi) in _stack or depth <= 0:
+            return set()
+        scan = self._scan(fi)
+        out = {k for k in scan.direct_locks if k[0] != "<unknown>"}
+        for call, _held, _attr in scan.calls_under:
+            callee = self._resolve(fi, call)
+            if callee is not None and id(callee) in self.scope_fn_ids:
+                out |= self._acquired_closure(
+                    callee, depth - 1, _stack | {id(fi)})
+        return out
+
+    # -- thread domains --------------------------------------------------
+    def _compute_domains(self) -> None:
+        roots: List[Tuple[FunctionInfo, str]] = []
+        for fi in self.scope_fns:
+            for site in fi.calls:
+                if _ctor_leaf(site.node) != "Thread":
+                    continue
+                for kw in site.node.keywords:
+                    if kw.arg == "target":
+                        tgt = self.index.resolve_value(kw.value, fi)
+                        if tgt is not None:
+                            roots.append((tgt, "worker"))
+        for mi in self.scope_mods:
+            for ci in mi.classes.values():
+                if not any("BaseHTTPRequestHandler" in b
+                           for b in ci.bases):
+                    continue
+                for name, meth in ci.methods.items():
+                    if name.startswith("do_"):
+                        roots.append((meth, "request"))
+        for root, dom in roots:
+            stack = [root]
+            while stack:
+                fi = stack.pop()
+                doms = self.domains.setdefault(id(fi), set())
+                if dom in doms:
+                    continue
+                doms.add(dom)
+                if id(fi) not in self.scope_fn_ids:
+                    continue  # domain marks it, but don't walk out of scope
+                scan = self._scan(fi)
+                for call, _held, _attr in scan.calls_under:
+                    callee = self._resolve(fi, call)
+                    if callee is not None:
+                        stack.append(callee)
+
+    # -- rules -----------------------------------------------------------
+    def run(self) -> List[Finding]:
+        self._collect_tables()
+        self._compute_domains()
+        findings: List[Finding] = []
+        seen: Set[Tuple[str, int, str]] = set()
+
+        def emit(fi: FunctionInfo, line: int, rule: str, msg: str) -> None:
+            key = (fi.module.path, line, rule)
+            if key not in seen:
+                seen.add(key)
+                findings.append(Finding(fi.module.path, line, rule, msg))
+
+        accesses: Dict[Tuple[str, str], List[_Access]] = {}
+        for fi in self.scope_fns:
+            scan = self._scan(fi)
+            if fi.cls and fi.name != "__init__":
+                for a in scan.accesses:
+                    accesses.setdefault((fi.cls, a.attr), []).append(a)
+            for call, held, attr in scan.calls_under:
+                if not held:
+                    continue
+                # direct lock-object operations are not method calls
+                if attr in ("acquire", "release") and isinstance(
+                        call.func, ast.Attribute) and \
+                        self._scan(fi).lock_key(call.func.value):
+                    continue
+                # LCK002 — blocking primitive under a lock
+                kind = None
+                recv = call.func.value if isinstance(
+                    call.func, ast.Attribute) else None
+                if attr is not None:
+                    base_attr = attr[:-7] if attr.endswith("_nowait") \
+                        else attr
+                    if isinstance(recv, ast.Attribute) and \
+                            recv.attr in self.blocking_attrs:
+                        kind = self.blocking_attrs[recv.attr]
+                    elif isinstance(recv, ast.Name) and \
+                            recv.id in scan.local_blocking:
+                        kind = scan.local_blocking[recv.id]
+                    elif isinstance(recv, ast.Name) and \
+                            recv.id == "time" and attr == "sleep":
+                        kind, base_attr = "sleep", "sleep"
+                    if kind == "sleep" or (
+                            kind is not None
+                            and base_attr in _BLOCKING_METHODS.get(
+                                kind, ())):
+                        if not _nonblocking_call(call, attr):
+                            held_txt = ", ".join(
+                                ".".join(k) for k in sorted(held))
+                            emit(fi, call.lineno, "LCK002",
+                                 f"blocking .{attr}() while holding "
+                                 f"lock ({held_txt}) — every thread "
+                                 "needing that lock stalls for the full "
+                                 "block; move the blocking call outside "
+                                 "the critical section")
+                            continue
+                # LCK001 — callee acquires a different scope lock
+                callee = self._resolve(fi, call)
+                if callee is None:
+                    continue
+                other = {k for k in self._acquired_closure(callee)
+                         if k not in held}
+                if other:
+                    o = sorted(other)[0]
+                    held_txt = ", ".join(".".join(k) for k in sorted(held))
+                    emit(fi, call.lineno, "LCK001",
+                         f"calls {callee.qualname.split('.', 1)[-1]}() "
+                         f"(which acquires {o[0]}.{o[1]}) while holding "
+                         f"({held_txt}) — nested lock acquisition across "
+                         "objects; an opposite-order path deadlocks and "
+                         "the inner lock's waiters stall behind the "
+                         "outer critical section")
+
+        # LCK003 — cross-thread-domain unsynchronized state
+        for (cls, attr), accs in sorted(accesses.items()):
+            writes = [a for a in accs if a.is_write]
+            for w in writes:
+                dw = self.domains.get(id(w.fn), set())
+                if not dw:
+                    continue
+                for a in accs:
+                    da = self.domains.get(id(a.fn), set())
+                    cross = (("worker" in dw and "request" in da)
+                             or ("request" in dw and "worker" in da))
+                    if not cross:
+                        continue
+                    if w.held & a.held:
+                        continue
+                    emit(w.fn, w.line, "LCK003",
+                         f"write to self.{attr} in {w.fn.name}() "
+                         f"(thread domain: {'/'.join(sorted(dw))}) races "
+                         f"with access in {a.fn.name}() (domain: "
+                         f"{'/'.join(sorted(da))}) — no common lock "
+                         "held at either site; guard both with one "
+                         "lock or confine the state to a single thread")
+                    break
+        return findings
+
+
+def check_locks(index: ProjectIndex) -> List[Finding]:
+    return LockPass(index).run()

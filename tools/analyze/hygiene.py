@@ -18,12 +18,13 @@ import os
 from tools.analyze.common import Finding
 
 
-def check_hygiene_file(path: str) -> list:
-    try:
-        with open(path, encoding="utf-8", errors="replace") as fh:
-            tree = ast.parse(fh.read(), filename=path)
-    except SyntaxError:
-        return []
+def check_hygiene_file(path: str, tree=None) -> list:
+    if tree is None:
+        try:
+            with open(path, encoding="utf-8", errors="replace") as fh:
+                tree = ast.parse(fh.read(), filename=path)
+        except SyntaxError:
+            return []
     atime_uses = []
     has_utime = False
     for node in ast.walk(tree):
@@ -48,8 +49,12 @@ def check_hygiene_file(path: str) -> list:
     ]
 
 
-def check_hygiene(root: str) -> list:
+def check_hygiene(root: str, index=None) -> list:
     findings: list = []
+    if index is not None:
+        for mi in index.package_modules():
+            findings.extend(check_hygiene_file(mi.path, tree=mi.tree))
+        return findings
     pkg = os.path.join(root, "mmlspark_tpu")
     for py in sorted(glob.glob(os.path.join(pkg, "**", "*.py"),
                                recursive=True)):

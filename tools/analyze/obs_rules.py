@@ -113,12 +113,13 @@ def _check_obs002(path: str, tree: ast.AST) -> list:
     return findings
 
 
-def check_obs_file(path: str) -> list:
-    try:
-        with open(path, encoding="utf-8", errors="replace") as fh:
-            tree = ast.parse(fh.read(), filename=path)
-    except SyntaxError:
-        return []
+def check_obs_file(path: str, tree=None) -> list:
+    if tree is None:
+        try:
+            with open(path, encoding="utf-8", errors="replace") as fh:
+                tree = ast.parse(fh.read(), filename=path)
+        except SyntaxError:
+            return []
     findings = []
     for node in ast.walk(tree):
         if (
@@ -139,8 +140,12 @@ def check_obs_file(path: str) -> list:
     return findings
 
 
-def check_obs(root: str) -> list:
+def check_obs(root: str, index=None) -> list:
     findings: list = []
+    if index is not None:
+        for mi in index.package_modules():
+            findings.extend(check_obs_file(mi.path, tree=mi.tree))
+        return findings
     pkg = os.path.join(root, "mmlspark_tpu")
     for py in sorted(glob.glob(os.path.join(pkg, "**", "*.py"),
                                recursive=True)):

@@ -34,12 +34,13 @@ def _is_hot_path_fn(name: str) -> bool:
     return "predict" in name or "raw_scores" in name or name == "_process"
 
 
-def check_predict_file(path: str) -> list:
-    try:
-        with open(path, encoding="utf-8", errors="replace") as fh:
-            tree = ast.parse(fh.read(), filename=path)
-    except SyntaxError:
-        return []
+def check_predict_file(path: str, tree=None) -> list:
+    if tree is None:
+        try:
+            with open(path, encoding="utf-8", errors="replace") as fh:
+                tree = ast.parse(fh.read(), filename=path)
+        except SyntaxError:
+            return []
     findings = []
     for node in ast.walk(tree):
         if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
@@ -78,8 +79,14 @@ def check_predict_file(path: str) -> list:
     return out
 
 
-def check_predict(root: str) -> list:
+def check_predict(root: str, index=None) -> list:
     findings: list = []
+    if index is not None:
+        for mi in index.package_modules():
+            if (mi.pkg_rel or "").split(os.sep)[0] == "native":
+                continue  # host-side scorer by contract
+            findings.extend(check_predict_file(mi.path, tree=mi.tree))
+        return findings
     pkg = os.path.join(root, "mmlspark_tpu")
     for py in sorted(glob.glob(os.path.join(pkg, "**", "*.py"),
                                recursive=True)):

@@ -99,12 +99,13 @@ def _blocks_forever(call: ast.Call, method: str) -> bool:
     return True
 
 
-def check_serving_file(path: str) -> list:
-    try:
-        with open(path, encoding="utf-8", errors="replace") as fh:
-            tree = ast.parse(fh.read(), filename=path)
-    except SyntaxError:
-        return []
+def check_serving_file(path: str, tree=None) -> list:
+    if tree is None:
+        try:
+            with open(path, encoding="utf-8", errors="replace") as fh:
+                tree = ast.parse(fh.read(), filename=path)
+        except SyntaxError:
+            return []
     findings: list = []
     queue_names: set = set()
     event_names: set = set()
@@ -158,8 +159,12 @@ def check_serving_file(path: str) -> list:
     return findings
 
 
-def check_serving(root: str) -> list:
+def check_serving(root: str, index=None) -> list:
     findings: list = []
+    if index is not None:
+        for mi in index.package_modules():
+            findings.extend(check_serving_file(mi.path, tree=mi.tree))
+        return findings
     pkg = os.path.join(root, "mmlspark_tpu")
     for py in sorted(glob.glob(os.path.join(pkg, "**", "*.py"),
                                recursive=True)):

@@ -171,12 +171,13 @@ def _decorated_static(fn) -> "tuple[set, set] | None":
     return None
 
 
-def check_tracer_file(path: str) -> list:
-    try:
-        with open(path, encoding="utf-8", errors="replace") as fh:
-            tree = ast.parse(fh.read(), filename=path)
-    except SyntaxError:
-        return []
+def check_tracer_file(path: str, tree=None) -> list:
+    if tree is None:
+        try:
+            with open(path, encoding="utf-8", errors="replace") as fh:
+                tree = ast.parse(fh.read(), filename=path)
+        except SyntaxError:
+            return []
     findings: list = []
 
     # defs by (enclosing function node or None, name) for jax.jit(f) lookup
@@ -235,13 +236,14 @@ def check_tracer_file(path: str) -> list:
     return findings
 
 
-def check_host_only_file(path: str) -> list:
+def check_host_only_file(path: str, tree=None) -> list:
     """TRC003 for one file inside the host-only set."""
-    try:
-        with open(path, encoding="utf-8", errors="replace") as fh:
-            tree = ast.parse(fh.read(), filename=path)
-    except SyntaxError:
-        return []
+    if tree is None:
+        try:
+            with open(path, encoding="utf-8", errors="replace") as fh:
+                tree = ast.parse(fh.read(), filename=path)
+        except SyntaxError:
+            return []
     findings: list = []
     for node in ast.walk(tree):
         bad = None
@@ -269,8 +271,16 @@ def check_host_only_file(path: str) -> list:
     return findings
 
 
-def check_tracer(root: str) -> list:
+def check_tracer(root: str, index=None) -> list:
     findings: list = []
+    if index is not None:
+        for mi in index.package_modules():
+            findings.extend(check_tracer_file(mi.path, tree=mi.tree))
+            rel = (mi.pkg_rel or "").replace(os.sep, "/")
+            if any(rel == h or rel.startswith(h) for h in HOST_ONLY):
+                findings.extend(check_host_only_file(mi.path,
+                                                     tree=mi.tree))
+        return findings
     pkg = os.path.join(root, "mmlspark_tpu")
     for py in sorted(glob.glob(os.path.join(pkg, "**", "*.py"),
                                recursive=True)):
