@@ -20,6 +20,7 @@ from __future__ import annotations
 
 import dataclasses
 import math
+import os
 import time
 from functools import partial
 from typing import Dict, List, Optional, Sequence, Tuple, Union
@@ -397,6 +398,10 @@ class Booster:
         self.average_output = average_output
         self.objective = get_objective(config.objective, **config.objective_params())
         self.evals_result: Dict[str, Dict[str, List[float]]] = {}
+        # Training-time reference histograms for the serving drift monitor
+        # (plain dict, set by train(); rides pickles, persisted as
+        # quality_baseline.json by the model facades' _save_extra).
+        self.quality_baseline: Optional[dict] = None
         self._predict_cache: Dict[Tuple, callable] = {}
         # Device-resident predict state, all keyed by T (used iterations)
         # and built at most once per instance: continued training
@@ -457,6 +462,7 @@ class Booster:
         self.__dict__.setdefault("_pallas_forests", {})
         self.__dict__.setdefault("_device_binner", None)
         self.__dict__.setdefault("_predict_warm", set())
+        self.__dict__.setdefault("quality_baseline", None)
         self.trees = Tree(*[jnp.asarray(a) for a in self.trees])
 
     # -- introspection ---------------------------------------------------
@@ -1174,6 +1180,94 @@ def _mesh_cache_key(mesh):
     )
 
 
+def _host_replay_scores(booster: "Booster", bins: np.ndarray) -> np.ndarray:
+    """Transformed scores for a binned sample, computed ENTIRELY on the
+    host with a numpy mirror of :func:`_replay_leaf_ids`.
+
+    Used only for the training-time quality baseline: routing the sample
+    through the jitted predict path would add one XLA compile per
+    ``train()`` call, which hundreds of test-tier fits cannot afford.
+    The replay arithmetic is the same (rows start in leaf 0; each
+    recorded split moves its rows), so the score histogram matches what
+    serving will produce modulo f32-vs-f64 accumulation."""
+    trees = booster._host_trees()
+    T = booster._used_iters(None)
+    K = booster.num_class
+    nb = int(booster.bin_mapper.num_bins)
+    weights = np.asarray(booster.tree_weights, np.float64)
+    split_leaf = np.asarray(trees.split_leaf)
+    split_feat = np.asarray(trees.split_feat)
+    split_bin = np.asarray(trees.split_bin)
+    default_left = np.asarray(trees.default_left)
+    split_cat = np.asarray(trees.split_cat)
+    cat_threshold = np.asarray(trees.cat_threshold)
+    leaf_value = np.asarray(trees.leaf_value, np.float64)
+    n = bins.shape[0]
+    S = split_leaf.shape[2]
+    bins = bins.astype(np.int64)
+    raw = np.zeros((K, n), np.float64)
+    for t in range(T):
+        for k in range(K):
+            leaf = np.zeros(n, np.int64)
+            for s in range(S):
+                sl = int(split_leaf[t, k, s])
+                if sl < 0:
+                    continue
+                fcol = bins[:, int(split_feat[t, k, s])]
+                if split_cat[t, k, s]:
+                    goes_left = cat_threshold[t, k, s].astype(bool)[fcol]
+                else:
+                    goes_left = np.where(
+                        fcol == nb - 1,
+                        bool(default_left[t, k, s]),
+                        fcol <= int(split_bin[t, k, s]),
+                    )
+                move = (leaf == sl) & ~goes_left
+                leaf[move] = s + 1
+            raw[k] += weights[t] * leaf_value[t, k][leaf]
+    if booster.average_output:
+        raw = raw / max(T, 1)
+    # the objective's own transform (eager, no jit) for serving parity
+    out = np.asarray(booster.objective.transform(jnp.asarray(raw, jnp.float32)))
+    return out[0] if out.shape[0] == 1 else out.T
+
+
+def _capture_quality_baseline(
+    booster: "Booster", train_set: Dataset
+) -> Optional[dict]:
+    """Training-time reference for the serve-path drift monitor
+    (``mmlspark_tpu/obs/quality.py``): per-feature bin occupancy from the
+    already-binned training matrix plus a score histogram over a capped
+    host-replayed sample.  Disabled via ``MMLSPARK_TPU_QUALITY_BASELINE=0``."""
+    gate = os.environ.get("MMLSPARK_TPU_QUALITY_BASELINE", "").strip().lower()
+    if gate in ("0", "false", "off"):
+        return None
+    from mmlspark_tpu.obs import quality
+
+    bins = np.asarray(train_set.binned(booster.bin_mapper))
+    features = quality.feature_specs_from_binned(bins, booster.bin_mapper)
+    cap = int(float(os.environ.get(
+        "MMLSPARK_TPU_QUALITY_SCORE_SAMPLE", "4096") or 4096))
+    score = None
+    class_mix = None
+    if cap > 0 and len(bins):
+        sample = bins
+        if len(bins) > cap:
+            idx = np.random.default_rng(0).choice(len(bins), cap, replace=False)
+            sample = bins[idx]
+        preds = _host_replay_scores(booster, sample)
+        score = quality.score_spec_from_scores(
+            quality.ScoreDriftTracker.scores_of(preds)
+        )
+        if preds.ndim == 2 and preds.shape[1] > 1:
+            class_mix = np.bincount(
+                np.argmax(preds, axis=1), minlength=preds.shape[1]
+            ).astype(float).tolist()
+    return quality.QualityBaseline(
+        features, score=score, class_mix=class_mix, n_rows=len(bins)
+    ).to_dict()
+
+
 def train(
     params: dict,
     train_set: Dataset,
@@ -1220,6 +1314,17 @@ def train(
             params, train_set, valid_sets, valid_names,
             bin_mapper, init_model, mesh, process_local,
         )
+    if booster.quality_baseline is None:
+        try:
+            with obs.span("booster.quality_baseline"):
+                booster.quality_baseline = _capture_quality_baseline(
+                    booster, train_set
+                )
+        except Exception:
+            obs.get_logger("mmlspark_tpu.engine").warning(
+                "quality baseline capture failed; serving drift monitor "
+                "will run reference-less for this model", exc_info=True,
+            )
     if obs.enabled():
         wall = time.perf_counter() - t0
         obs.gauge("booster.train_wall_s", wall)

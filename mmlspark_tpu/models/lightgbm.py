@@ -20,6 +20,7 @@ TPU-first differences in the fit path (SURVEY.md §3.1 → §5.8 mapping):
 
 from __future__ import annotations
 
+import json
 import os
 from typing import List, Optional
 
@@ -420,6 +421,30 @@ class _LightGBMModel(Model, _LightGBMParams):
     def setBooster(self, b) -> "_LightGBMModel":
         self._paramMap["booster"] = b
         return self
+
+    # The booster persists as the LightGBM TEXT model (parity surface), so
+    # the training-time quality baseline cannot ride it — it goes in a
+    # sidecar ``quality_baseline.json`` that serve/registry.py hands to the
+    # drift monitor on every load/hot-swap.
+    def _save_extra(self, path: str) -> None:
+        b = self.getOrDefault("booster")
+        qb = getattr(b, "quality_baseline", None) if b is not None else None
+        if qb:
+            with open(os.path.join(path, "quality_baseline.json"), "w") as f:
+                json.dump(qb, f)
+
+    def _load_extra(self, path: str) -> None:
+        qb_path = os.path.join(path, "quality_baseline.json")
+        if not os.path.exists(qb_path):
+            return
+        b = self.getOrDefault("booster")
+        if b is None:
+            return
+        try:
+            with open(qb_path) as f:
+                b.quality_baseline = json.load(f)
+        except (ValueError, OSError):
+            pass  # a corrupt sidecar must never block a model load
 
     def getBooster(self):
         b = self.getOrDefault("booster")

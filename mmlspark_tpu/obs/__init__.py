@@ -166,10 +166,13 @@ def observe(name: str, value: float, /, **labels) -> None:
     metrics.registry.observe(name, value, **labels)
 
 
-def snapshot() -> dict:
+def snapshot(with_buckets: bool = False) -> dict:
     """Everything recorded so far: counters/gauges/histograms/span
-    aggregates, tagged with this process's rank."""
-    snap = metrics.registry.snapshot()
+    aggregates, tagged with this process's rank.  ``with_buckets=True``
+    adds cumulative bucket counts per histogram (the Prometheus
+    ``_bucket{le=}`` exposition needs them; the JSON default stays
+    unchanged)."""
+    snap = metrics.registry.snapshot(with_buckets=with_buckets)
     snap["process_index"] = _state.process_index()
     snap["enabled"] = _state.enabled
     return snap

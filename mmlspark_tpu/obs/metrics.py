@@ -17,13 +17,40 @@ Naming conventions (documented in tools/obs/README.md):
 
 from __future__ import annotations
 
+import bisect
 import math
+import os
 import threading
-from typing import Dict, Tuple
+from typing import Dict, Set, Tuple
 
 # Bounded per-histogram reservoir: exact count/sum/min/max, approximate
 # percentiles from the most recent observations (ring buffer).
 _SAMPLE_CAP = 512
+
+# Fixed bucket ladder for the Prometheus `_bucket{le=...}` exposition:
+# exact cumulative counts (unlike the reservoir percentiles) so Grafana
+# can do real quantile math.  Spans the values this codebase observes —
+# sub-millisecond serve latencies up to large row counts; everything
+# beyond the last edge lands in +Inf.
+BUCKET_EDGES: Tuple[float, ...] = (
+    0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+    1.0, 2.5, 5.0, 10.0, 30.0, 60.0, 120.0, 300.0, 1000.0,
+)
+
+# Label-cardinality cap: at most this many distinct label-value sets per
+# metric name (request-derived label values must never grow memory
+# unbounded).  Overridden by MMLSPARK_TPU_OBS_MAX_SERIES.
+DEFAULT_MAX_SERIES = 512
+
+
+def _max_series_from_env() -> int:
+    raw = os.environ.get("MMLSPARK_TPU_OBS_MAX_SERIES", "").strip()
+    if not raw:
+        return DEFAULT_MAX_SERIES
+    try:
+        return max(1, int(raw))
+    except ValueError:
+        return DEFAULT_MAX_SERIES
 
 
 def _label_key(labels: dict) -> Tuple:
@@ -40,7 +67,8 @@ def _fmt_key(name: str, lk: Tuple) -> str:
 
 
 class _Hist:
-    __slots__ = ("count", "total", "vmin", "vmax", "_samples", "_i")
+    __slots__ = ("count", "total", "vmin", "vmax", "_samples", "_i",
+                 "_buckets")
 
     def __init__(self):
         self.count = 0
@@ -49,6 +77,9 @@ class _Hist:
         self.vmax = -math.inf
         self._samples: list = []
         self._i = 0
+        # per-slot (NON-cumulative) counts over BUCKET_EDGES + one +Inf
+        # slot; exact, unlike the ring-buffer percentiles
+        self._buckets = [0] * (len(BUCKET_EDGES) + 1)
 
     def observe(self, value: float) -> None:
         self.count += 1
@@ -57,11 +88,22 @@ class _Hist:
             self.vmin = value
         if value > self.vmax:
             self.vmax = value
+        self._buckets[bisect.bisect_left(BUCKET_EDGES, value)] += 1
         if len(self._samples) < _SAMPLE_CAP:
             self._samples.append(value)
         else:
             self._samples[self._i] = value
             self._i = (self._i + 1) % _SAMPLE_CAP
+
+    def bucket_counts(self) -> dict:
+        """Cumulative counts per upper bound (Prometheus `le` semantics:
+        the +Inf slot equals the total count)."""
+        cum = []
+        running = 0
+        for c in self._buckets:
+            running += c
+            cum.append(running)
+        return {"le": list(BUCKET_EDGES), "counts": cum}
 
     def summary(self) -> dict:
         if not self.count:
@@ -87,26 +129,58 @@ class Registry:
     """Thread-safe metric store.  One process-global instance lives in
     this module (``registry``); tests may build private ones."""
 
-    def __init__(self):
+    def __init__(self, max_series: int = 0):
         self._lock = threading.Lock()
         self._counters: Dict[Tuple[str, Tuple], float] = {}
         self._gauges: Dict[Tuple[str, Tuple], float] = {}
         self._hists: Dict[Tuple[str, Tuple], _Hist] = {}
         self._spans: Dict[str, _Hist] = {}
+        # label-cardinality guard: per metric name, the distinct label
+        # sets seen so far, capped at _max_series (env
+        # MMLSPARK_TPU_OBS_MAX_SERIES) — request-derived label values
+        # (model names, routes, status strings) can never grow the
+        # registry unbounded; rejected series count into
+        # ``obs.series_dropped{metric=...}``.
+        self._max_series = max_series if max_series > 0 else _max_series_from_env()
+        self._series: Dict[str, Set[Tuple]] = {}
+
+    def _admit_series_locked(self, name: str, lk: Tuple) -> bool:
+        """Bound the distinct label sets per metric (call with the lock
+        held).  Unlabeled series always pass: the cap exists for label
+        VALUES, which request data controls; metric names are code-defined."""
+        if not lk:
+            return True
+        seen = self._series.get(name)
+        if seen is None:
+            seen = self._series[name] = set()
+        if lk in seen:
+            return True
+        if len(seen) >= self._max_series:
+            dk = ("obs.series_dropped", (("metric", name),))
+            self._counters[dk] = self._counters.get(dk, 0.0) + 1.0
+            return False
+        seen.add(lk)
+        return True
 
     def inc(self, name: str, value: float = 1.0, /, **labels) -> None:
         k = (name, _label_key(labels))
         with self._lock:
+            if not self._admit_series_locked(name, k[1]):
+                return
             self._counters[k] = self._counters.get(k, 0.0) + value
 
     def gauge(self, name: str, value: float, /, **labels) -> None:
         k = (name, _label_key(labels))
         with self._lock:
+            if not self._admit_series_locked(name, k[1]):
+                return
             self._gauges[k] = float(value)
 
     def observe(self, name: str, value: float, /, **labels) -> None:
         k = (name, _label_key(labels))
         with self._lock:
+            if not self._admit_series_locked(name, k[1]):
+                return
             h = self._hists.get(k)
             if h is None:
                 h = self._hists[k] = _Hist()
@@ -119,11 +193,19 @@ class Registry:
                 h = self._spans[name] = _Hist()
             h.observe(float(dur_s))
 
-    def snapshot(self) -> dict:
+    def snapshot(self, with_buckets: bool = False) -> dict:
+        """``with_buckets=True`` adds cumulative bucket counts to each
+        histogram (for the Prometheus ``_bucket{le=}`` exposition); the
+        default JSON shape is unchanged."""
         with self._lock:
             counters = {_fmt_key(n, lk): v for (n, lk), v in self._counters.items()}
             gauges = {_fmt_key(n, lk): v for (n, lk), v in self._gauges.items()}
-            hists = {_fmt_key(n, lk): h.summary() for (n, lk), h in self._hists.items()}
+            hists = {}
+            for (n, lk), h in self._hists.items():
+                s = h.summary()
+                if with_buckets and h.count:
+                    s["buckets"] = h.bucket_counts()
+                hists[_fmt_key(n, lk)] = s
             spans = {
                 n: {
                     "count": h.count,
@@ -146,6 +228,7 @@ class Registry:
             self._gauges.clear()
             self._hists.clear()
             self._spans.clear()
+            self._series.clear()
 
 
 registry = Registry()
@@ -236,6 +319,31 @@ def render_prometheus(snapshot: dict, prefix: str = "mmlspark_tpu") -> str:
         name, labels = _split_key(key)
         h = snapshot["histograms"][key]
         metric = _prom_name(name, prefix)
+        buckets = h.get("buckets")
+        if buckets:
+            # real histogram exposition: cumulative _bucket{le=} series
+            # (exact counts from the fixed ladder), so Prometheus-side
+            # histogram_quantile() works — the summary below is kept for
+            # anything without bucket data.
+            typ(metric, "histogram")
+            for le, c in zip(buckets["le"], buckets["counts"]):
+                lines.append(
+                    f"{metric}_bucket"
+                    f"{_prom_labels(labels, [('le', _fmt_val(le))])} "
+                    f"{_fmt_val(c)}"
+                )
+            lines.append(
+                f"{metric}_bucket{_prom_labels(labels, [('le', '+Inf')])} "
+                f"{_fmt_val(buckets['counts'][-1])}"
+            )
+            lines.append(
+                f"{metric}_sum{_prom_labels(labels)} {_fmt_val(h['sum'])}"
+            )
+            lines.append(
+                f"{metric}_count{_prom_labels(labels)} "
+                f"{_fmt_val(h['count'])}"
+            )
+            continue
         typ(metric, "summary")
         if not h.get("count"):
             lines.append(f"{metric}_count{_prom_labels(labels)} 0")

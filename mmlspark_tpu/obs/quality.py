@@ -1,0 +1,667 @@
+"""mmlspark_tpu.obs.quality — model-quality primitives for the serve path.
+
+The rest of the ``obs`` package is stdlib-only by charter; this module is
+the one numpy-using leaf, imported only by consumers that already depend
+on numpy (``serve/monitor.py``, ``engine/booster.py``, tests).  Nothing
+in ``obs/__init__.py`` imports it, so the zero-dependency contract of the
+core observability surface is unchanged.
+
+Three independent detectors, all bounded-memory and dependency-free:
+
+- **Feature drift** (:class:`FeatureDriftTracker`) — served rows are
+  counted into the model's OWN training bin edges (the exact
+  ``BinMapper.transform`` semantics: ``searchsorted(upper_bounds, col,
+  side="left")``, NaN → missing, categorical exact-match on the sorted
+  kept set), then compared against the training-time occupancy snapshot
+  with PSI.  Occupancy is re-grouped to at most
+  :data:`DEFAULT_PSI_GROUPS` roughly-equal-mass groups before the PSI —
+  255 raw bins make the statistic needlessly noisy at serving sample
+  sizes, while 10–32 groups is the classical operating range.
+- **Score drift** (:class:`ScoreDriftTracker`) — a decayed histogram
+  over transformed margins/probabilities vs the training-time score
+  baseline, plus a small reservoir of recent scores (for quantile
+  display) and the prediction-class mix for multiclass.
+- **SLO burn rate** (:class:`SLOTracker`) — availability and latency
+  objectives evaluated over a fast and a slow window; the alert fires
+  only when BOTH windows burn error budget faster than the threshold
+  (the standard multi-window guard against blips and against stale,
+  long-ago incidents).
+
+Live histograms decay exponentially per row (half-life in rows, env
+``MMLSPARK_TPU_QUALITY_HALFLIFE_ROWS``), so the reference-vs-live
+comparison tracks the recent serving distribution with O(bins) memory.
+
+The training-time reference (:class:`QualityBaseline`) is captured at
+``train()`` time (see ``engine/booster.py``), persisted next to the
+saved model by ``PipelineStage.save`` (``quality_baseline.json``), and
+handed to the monitor by ``serve/registry.py`` on every hot-swap so the
+reference resets atomically with the model.
+"""
+
+from __future__ import annotations
+
+import math
+import os
+import time
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+# Classical PSI operating range: collapse fine-grained training bins to
+# at most this many roughly-equal-reference-mass groups (+1 for missing).
+DEFAULT_PSI_GROUPS = 32
+# Smoothing mass added to every group on both sides of the PSI so empty
+# groups cannot produce infinities.
+PSI_EPS = 1e-4
+
+_BASELINE_VERSION = 1
+
+
+def _env_float(name: str, default: float) -> float:
+    raw = os.environ.get(name, "").strip()
+    if not raw:
+        return default
+    try:
+        return float(raw)
+    except ValueError:
+        return default
+
+
+def quality_env_config() -> dict:
+    """The env-tunable knobs, resolved once per monitor construction."""
+    return {
+        "psi_alert": _env_float("MMLSPARK_TPU_QUALITY_PSI_ALERT", 0.25),
+        "min_rows": int(_env_float("MMLSPARK_TPU_QUALITY_MIN_ROWS", 512)),
+        "half_life_rows": _env_float(
+            "MMLSPARK_TPU_QUALITY_HALFLIFE_ROWS", 4000.0
+        ),
+    }
+
+
+def psi(ref_counts, live_counts, eps: float = PSI_EPS) -> float:
+    """Population Stability Index between two count vectors.
+
+    Both sides are normalized to probabilities with ``eps`` smoothing per
+    slot; identical distributions → ~0, disjoint ones → large (>1).
+    """
+    r = np.asarray(ref_counts, np.float64) + eps
+    l = np.asarray(live_counts, np.float64) + eps
+    r = r / r.sum()
+    l = l / l.sum()
+    return float(np.sum((l - r) * np.log(l / r)))
+
+
+# ---------------------------------------------------------------------------
+# Baseline (training-time reference) container + serialization
+# ---------------------------------------------------------------------------
+
+
+class QualityBaseline:
+    """Training-time reference histograms for one model.
+
+    ``features`` is a list of per-feature dicts::
+
+        {"kind": "num", "edges": [...], "counts": [...]}   # len(counts) ==
+        {"kind": "cat", "cats":  [...], "counts": [...]}   #   len(edges|cats)+1
+
+    where the LAST count slot is the missing bin.  ``score`` is
+    ``{"edges": [e0..em], "counts": [c0..c{m-1}]}`` over the transformed
+    training scores; ``class_mix`` is the argmax-class histogram for
+    multiclass models (None otherwise).
+    """
+
+    def __init__(
+        self,
+        features: List[dict],
+        score: Optional[dict] = None,
+        class_mix: Optional[List[float]] = None,
+        n_rows: int = 0,
+        captured_at: Optional[float] = None,
+    ):
+        self.features = features
+        self.score = score
+        self.class_mix = class_mix
+        self.n_rows = int(n_rows)
+        self.captured_at = (
+            time.time() if captured_at is None else float(captured_at)
+        )
+
+    def to_dict(self) -> dict:
+        return {
+            "version": _BASELINE_VERSION,
+            "n_rows": self.n_rows,
+            "captured_at": self.captured_at,
+            "features": self.features,
+            "score": self.score,
+            "class_mix": self.class_mix,
+        }
+
+    @staticmethod
+    def from_dict(d: dict) -> "QualityBaseline":
+        return QualityBaseline(
+            features=list(d.get("features") or []),
+            score=d.get("score"),
+            class_mix=d.get("class_mix"),
+            n_rows=int(d.get("n_rows", 0)),
+            captured_at=d.get("captured_at"),
+        )
+
+
+# ---------------------------------------------------------------------------
+# Feature drift
+# ---------------------------------------------------------------------------
+
+
+def _group_assignment(ref_counts: np.ndarray, groups: int) -> np.ndarray:
+    """Map each value bin (missing excluded) to one of ≤ ``groups`` groups
+    of roughly equal reference mass.  Returned array has one entry per
+    value bin; the caller appends the missing bin as its own group."""
+    nv = len(ref_counts)
+    if nv <= groups:
+        return np.arange(nv, dtype=np.int64)
+    total = float(ref_counts.sum())
+    if total <= 0:
+        # no reference mass: fall back to equal-width grouping
+        return (np.arange(nv, dtype=np.int64) * groups) // nv
+    cum = np.cumsum(ref_counts, dtype=np.float64)
+    # group of bin i = floor(groups * cumulative-mass-before-i / total)
+    before = cum - ref_counts
+    g = np.floor(groups * before / total).astype(np.int64)
+    np.clip(g, 0, groups - 1, out=g)
+    # make the assignment monotone (it already is: `before` is monotone)
+    return g
+
+
+class _FeatureState:
+    __slots__ = (
+        "kind", "edges", "cats", "group_of", "n_groups", "ref", "ref_rows",
+        "live", "live_rows", "missing_live", "missing_ref_rate",
+    )
+
+    def __init__(self, spec: dict, groups: int):
+        self.kind = spec.get("kind", "num")
+        counts = np.asarray(spec.get("counts") or [0.0], np.float64)
+        value_counts, missing_count = counts[:-1], counts[-1]
+        if self.kind == "cat":
+            self.cats = np.asarray(spec.get("cats") or [], np.int64)
+            self.edges = None
+            nv = len(self.cats)
+        else:
+            self.edges = np.asarray(spec.get("edges") or [np.inf], np.float64)
+            self.cats = None
+            nv = len(self.edges)
+        if len(value_counts) < nv:  # defensive: pad a short baseline
+            value_counts = np.pad(value_counts, (0, nv - len(value_counts)))
+        g = _group_assignment(value_counts[:nv], groups)
+        self.n_groups = (int(g.max()) + 1 if len(g) else 0) + 1  # + missing
+        # bin index (0..nv-1, nv=missing) → group index; missing is last
+        self.group_of = np.concatenate(
+            [g, [self.n_groups - 1]]
+        ).astype(np.int64)
+        self.ref = np.zeros(self.n_groups, np.float64)
+        np.add.at(self.ref, g, value_counts[:nv])
+        self.ref[-1] = missing_count
+        total = counts.sum()
+        self.ref_rows = float(total)
+        self.missing_ref_rate = float(missing_count / total) if total else 0.0
+        self.live = np.zeros(self.n_groups, np.float64)
+        self.live_rows = 0.0
+        self.missing_live = 0.0
+
+    def bin_column(self, col: np.ndarray) -> np.ndarray:
+        """Exactly ``BinMapper.transform`` for one column: value bin index
+        with ``nv`` meaning missing."""
+        if self.kind == "cat":
+            nv = len(self.cats)
+            vals = np.where(np.isnan(col), -1, col).astype(np.int64)
+            pos = np.searchsorted(self.cats, vals)
+            pos_c = np.clip(pos, 0, max(nv - 1, 0))
+            hit = (
+                (self.cats[pos_c] == vals) & (pos < nv)
+                if nv
+                else np.zeros(len(col), bool)
+            )
+            return np.where(hit, pos_c, nv)
+        nv = len(self.edges)
+        bins = np.searchsorted(self.edges, col, side="left")
+        return np.where(np.isnan(col), nv, np.minimum(bins, nv - 1))
+
+    def update(self, col: np.ndarray, decay: float) -> None:
+        bins = self.bin_column(np.asarray(col, np.float64))
+        g = self.group_of[bins]
+        add = np.bincount(g, minlength=self.n_groups).astype(np.float64)
+        self.live *= decay
+        self.live_rows *= decay
+        self.live += add
+        self.live_rows += len(col)
+        self.missing_live = float(self.live[-1])
+
+    def psi(self) -> float:
+        return psi(self.ref, self.live)
+
+    def psi_bias(self) -> float:
+        """Expected PSI under NO drift: asymptotically PSI is a scaled
+        chi-square with mean ``(G-1)·(1/n_live + 1/n_ref)`` — with a
+        decayed live histogram the effective sample size is bounded by
+        ~1.44·half_life rows, so this floor never reaches zero.  Alarms
+        compare the EXCESS over this bias, not the raw statistic, which
+        is what keeps small-sample noise from paging anyone."""
+        n_live = max(self.live_rows, 1.0)
+        n_ref = max(self.ref_rows, 1.0)
+        return (self.n_groups - 1) * (1.0 / n_live + 1.0 / n_ref)
+
+    def excess_psi(self) -> float:
+        return max(0.0, self.psi() - self.psi_bias())
+
+    def missing_rate(self) -> float:
+        return (
+            float(self.live[-1] / self.live.sum()) if self.live.sum() else 0.0
+        )
+
+
+class FeatureDriftTracker:
+    """Decayed live occupancy per feature vs the training reference."""
+
+    def __init__(
+        self,
+        baseline: QualityBaseline,
+        groups: int = DEFAULT_PSI_GROUPS,
+        half_life_rows: float = 4000.0,
+    ):
+        self._states = [_FeatureState(s, groups) for s in baseline.features]
+        self._half_life = max(1.0, float(half_life_rows))
+        self.rows_seen = 0
+
+    @property
+    def num_features(self) -> int:
+        return len(self._states)
+
+    def update(self, X: np.ndarray) -> None:
+        X = np.asarray(X, np.float64)
+        if X.ndim != 2 or not len(X):
+            return
+        decay = 0.5 ** (X.shape[0] / self._half_life)
+        for f, st in enumerate(self._states):
+            if f >= X.shape[1]:
+                break
+            st.update(X[:, f], decay)
+        self.rows_seen += int(X.shape[0])
+
+    def psis(self) -> np.ndarray:
+        return np.array([st.psi() for st in self._states], np.float64)
+
+    def excess_psis(self) -> np.ndarray:
+        """Bias-corrected PSIs (see :meth:`_FeatureState.psi_bias`) — the
+        statistic alarms compare against the threshold."""
+        return np.array(
+            [st.excess_psi() for st in self._states], np.float64
+        )
+
+    def missing_rates(self) -> np.ndarray:
+        return np.array(
+            [st.missing_rate() for st in self._states], np.float64
+        )
+
+    def live_rows(self) -> float:
+        return max((st.live_rows for st in self._states), default=0.0)
+
+    def describe(self, top: int = 8) -> dict:
+        psis = self.psis()
+        excess = self.excess_psis()
+        miss = self.missing_rates()
+        order = np.argsort(excess)[::-1][:top]
+        return {
+            "rows_seen": self.rows_seen,
+            "live_rows": self.live_rows(),
+            "psi_max": float(psis.max()) if len(psis) else 0.0,
+            "excess_psi_max": float(excess.max()) if len(excess) else 0.0,
+            "top": [
+                {
+                    "feature": int(f),
+                    "psi": float(psis[f]),
+                    "excess_psi": float(excess[f]),
+                    "psi_bias": self._states[f].psi_bias(),
+                    "missing_rate": float(miss[f]),
+                    "missing_ref_rate": self._states[f].missing_ref_rate,
+                }
+                for f in order
+            ],
+        }
+
+
+# ---------------------------------------------------------------------------
+# Score drift
+# ---------------------------------------------------------------------------
+
+_RESERVOIR = 512
+
+
+class ScoreDriftTracker:
+    """Decayed score histogram + recent-score ring vs the training score
+    baseline; tracks the argmax-class mix for multiclass models."""
+
+    def __init__(
+        self, baseline: QualityBaseline, half_life_rows: float = 4000.0
+    ):
+        score = baseline.score or {}
+        edges = np.asarray(score.get("edges") or [0.0, 1.0], np.float64)
+        counts = np.asarray(
+            score.get("counts") or [0.0] * (len(edges) - 1), np.float64
+        )
+        self._edges = edges
+        self._ref = counts
+        self._live = np.zeros(len(counts), np.float64)
+        self._half_life = max(1.0, float(half_life_rows))
+        self._recent: List[float] = []
+        self._ri = 0
+        self.rows_seen = 0
+        mix = baseline.class_mix
+        self._ref_mix = (
+            np.asarray(mix, np.float64) if mix is not None else None
+        )
+        self._live_mix = (
+            np.zeros(len(mix), np.float64) if mix is not None else None
+        )
+
+    @staticmethod
+    def scores_of(preds: np.ndarray) -> np.ndarray:
+        """The scalar score stream for a prediction batch: 1-D output
+        as-is; (n, K) multiclass → max class probability per row."""
+        p = np.asarray(preds, np.float64)
+        if p.ndim <= 1:
+            return np.atleast_1d(p)
+        return p.max(axis=1)
+
+    def update(self, preds: np.ndarray) -> None:
+        p = np.asarray(preds, np.float64)
+        s = self.scores_of(p)
+        if not len(s):
+            return
+        decay = 0.5 ** (len(s) / self._half_life)
+        idx = np.clip(
+            np.searchsorted(self._edges, s, side="right") - 1,
+            0, len(self._live) - 1,
+        )
+        self._live *= decay
+        self._live += np.bincount(idx, minlength=len(self._live))
+        if self._live_mix is not None and p.ndim == 2:
+            cls = np.argmax(p, axis=1)
+            self._live_mix *= decay
+            self._live_mix += np.bincount(
+                cls, minlength=len(self._live_mix)
+            )[: len(self._live_mix)]
+        for v in s[: _RESERVOIR]:
+            if len(self._recent) < _RESERVOIR:
+                self._recent.append(float(v))
+            else:
+                self._recent[self._ri] = float(v)
+                self._ri = (self._ri + 1) % _RESERVOIR
+        self.rows_seen += len(s)
+
+    def psi(self) -> float:
+        return psi(self._ref, self._live)
+
+    def psi_bias(self) -> float:
+        """Expected no-drift PSI (chi-square mean; see
+        :meth:`_FeatureState.psi_bias`)."""
+        n_live = max(self.live_rows(), 1.0)
+        n_ref = max(float(self._ref.sum()), 1.0)
+        return (len(self._live) - 1) * (1.0 / n_live + 1.0 / n_ref)
+
+    def excess_psi(self) -> float:
+        return max(0.0, self.psi() - self.psi_bias())
+
+    def class_mix_psi(self) -> Optional[float]:
+        if self._ref_mix is None or self._live_mix is None:
+            return None
+        if not self._live_mix.sum():
+            return 0.0
+        return psi(self._ref_mix, self._live_mix)
+
+    def live_rows(self) -> float:
+        return float(self._live.sum())
+
+    def describe(self) -> dict:
+        out = {
+            "rows_seen": self.rows_seen,
+            "live_rows": self.live_rows(),
+            "psi": self.psi(),
+            "excess_psi": self.excess_psi(),
+        }
+        mix_psi = self.class_mix_psi()
+        if mix_psi is not None:
+            out["class_mix_psi"] = mix_psi
+            out["class_mix_live"] = [float(v) for v in self._live_mix]
+        if self._recent:
+            s = sorted(self._recent)
+
+            def pct(p: float) -> float:
+                return s[min(len(s) - 1, int(round(p * (len(s) - 1))))]
+
+            out["recent"] = {
+                "count": len(s),
+                "p50": pct(0.5),
+                "p95": pct(0.95),
+                "min": s[0],
+                "max": s[-1],
+            }
+        return out
+
+
+# ---------------------------------------------------------------------------
+# SLO burn rate
+# ---------------------------------------------------------------------------
+
+
+class SLOConfig:
+    """Per-route availability + latency objectives.
+
+    ``availability`` is the good-request objective (0.999 → 0.1% error
+    budget); ``latency_target`` is the fraction of requests that must
+    finish under ``latency_ms``.  Burn rate is ``bad_fraction /
+    error_budget`` — burn 1.0 spends the budget exactly on schedule; the
+    alert fires when BOTH the fast and slow windows burn above
+    ``burn_alert``.
+    """
+
+    def __init__(
+        self,
+        availability: float = 0.999,
+        latency_ms: float = 250.0,
+        latency_target: float = 0.99,
+        fast_window_s: float = 60.0,
+        slow_window_s: float = 300.0,
+        burn_alert: float = 4.0,
+        min_requests: int = 20,
+    ):
+        self.availability = float(availability)
+        self.latency_ms = float(latency_ms)
+        self.latency_target = float(latency_target)
+        self.fast_window_s = float(fast_window_s)
+        self.slow_window_s = float(slow_window_s)
+        self.burn_alert = float(burn_alert)
+        self.min_requests = int(min_requests)
+
+    def to_dict(self) -> dict:
+        return {
+            "availability": self.availability,
+            "latency_ms": self.latency_ms,
+            "latency_target": self.latency_target,
+            "fast_window_s": self.fast_window_s,
+            "slow_window_s": self.slow_window_s,
+            "burn_alert": self.burn_alert,
+            "min_requests": self.min_requests,
+        }
+
+    @staticmethod
+    def parse(spec: str) -> "SLOConfig":
+        """``"availability=0.999,latency_ms=250,latency_target=0.99"`` —
+        unknown keys are ignored, bad values raise ValueError."""
+        kwargs = {}
+        valid = set(SLOConfig().to_dict())
+        for part in (spec or "").split(","):
+            part = part.strip()
+            if not part or "=" not in part:
+                continue
+            k, v = part.split("=", 1)
+            k = k.strip()
+            if k in valid:
+                kwargs[k] = float(v)
+        if "min_requests" in kwargs:
+            kwargs["min_requests"] = int(kwargs["min_requests"])
+        return SLOConfig(**kwargs)
+
+    @staticmethod
+    def from_env(route: Optional[str] = None) -> "SLOConfig":
+        """``MMLSPARK_TPU_SLO`` (global), overridden per route by
+        ``MMLSPARK_TPU_SLO_<ROUTE>`` (route upper-cased, non-alnum → _)."""
+        spec = os.environ.get("MMLSPARK_TPU_SLO", "")
+        if route:
+            key = "MMLSPARK_TPU_SLO_" + "".join(
+                ch if ch.isalnum() else "_" for ch in route.upper()
+            )
+            spec_route = os.environ.get(key, "")
+            if spec_route:
+                spec = spec_route
+        return SLOConfig.parse(spec)
+
+
+class SLOTracker:
+    """Per-second request buckets over the slow window; burn rates over
+    [fast, slow] windows.  Memory is bounded by ``slow_window_s`` buckets.
+
+    ``record(status, latency_s)`` counts 2xx as good, 5xx as bad, and
+    anything else (4xx shed/validation) as neither — client errors and
+    load-shedding must not spend the server's error budget.
+    """
+
+    def __init__(self, config: Optional[SLOConfig] = None):
+        self.config = config or SLOConfig()
+        # sec → [total, errors, slow]; pruned past the slow window
+        self._buckets: Dict[int, List[float]] = {}
+
+    def record(
+        self, status: int, latency_s: float, now: Optional[float] = None
+    ) -> None:
+        now = time.monotonic() if now is None else now
+        sec = int(now)
+        b = self._buckets.get(sec)
+        if b is None:
+            b = self._buckets[sec] = [0.0, 0.0, 0.0]
+            self._prune(sec)
+        if 200 <= status < 300:
+            b[0] += 1
+            if latency_s * 1000.0 > self.config.latency_ms:
+                b[2] += 1
+        elif status >= 500:
+            b[0] += 1
+            b[1] += 1
+
+    def _prune(self, now_sec: int) -> None:
+        horizon = now_sec - int(self.config.slow_window_s) - 2
+        for sec in [s for s in self._buckets if s < horizon]:
+            del self._buckets[sec]
+
+    def _window(self, window_s: float, now: float):
+        lo = now - window_s
+        total = err = slow = 0.0
+        for sec, (t, e, s) in self._buckets.items():
+            if sec >= lo:
+                total += t
+                err += e
+                slow += s
+        return total, err, slow
+
+    def burn_rates(self, now: Optional[float] = None) -> dict:
+        """``{"availability": {"fast": b, "slow": b}, "latency": {...},
+        "requests": {...}}`` — burn = bad_fraction / error_budget."""
+        now = time.monotonic() if now is None else now
+        cfg = self.config
+        out: dict = {"availability": {}, "latency": {}, "requests": {}}
+        for key, window in (("fast", cfg.fast_window_s),
+                            ("slow", cfg.slow_window_s)):
+            total, err, slow = self._window(window, now)
+            avail_budget = max(1e-9, 1.0 - cfg.availability)
+            lat_budget = max(1e-9, 1.0 - cfg.latency_target)
+            out["requests"][key] = total
+            out["availability"][key] = (
+                (err / total) / avail_budget if total else 0.0
+            )
+            out["latency"][key] = (
+                (slow / total) / lat_budget if total else 0.0
+            )
+        return out
+
+    def evaluate(self, now: Optional[float] = None) -> dict:
+        """Burn rates + alert booleans (both windows over threshold AND
+        enough traffic in the fast window to mean anything)."""
+        rates = self.burn_rates(now)
+        cfg = self.config
+        enough = rates["requests"]["fast"] >= cfg.min_requests
+        out = {"config": cfg.to_dict(), **rates, "alerts": {}}
+        for kind in ("availability", "latency"):
+            out["alerts"][kind] = bool(
+                enough
+                and rates[kind]["fast"] > cfg.burn_alert
+                and rates[kind]["slow"] > cfg.burn_alert
+            )
+        return out
+
+
+# ---------------------------------------------------------------------------
+# Baseline construction helpers (used by engine/booster.py at train time)
+# ---------------------------------------------------------------------------
+
+
+def feature_specs_from_binned(
+    binned: np.ndarray, bin_mapper
+) -> List[dict]:
+    """Per-feature occupancy specs from an already-binned training matrix
+    (``Dataset.binned(bin_mapper)`` — cached by training, so this is one
+    ``bincount`` per feature, no re-binning)."""
+    specs: List[dict] = []
+    num_bins = int(bin_mapper.num_bins)
+    missing_bin = int(bin_mapper.missing_bin)
+    F = binned.shape[1]
+    for f in range(F):
+        counts_full = np.bincount(
+            binned[:, f].astype(np.int64), minlength=num_bins
+        )
+        if bin_mapper.is_categorical(f):
+            cats = np.asarray(
+                bin_mapper.cat_maps.get(f, np.empty(0, np.int64)), np.int64
+            )
+            nv = len(cats)
+            spec = {"kind": "cat", "cats": cats.tolist()}
+        else:
+            edges = np.asarray(bin_mapper.upper_bounds[f], np.float64)
+            nv = len(edges)
+            spec = {"kind": "num", "edges": edges.tolist()}
+        counts = np.concatenate(
+            [counts_full[:nv], [counts_full[missing_bin]]]
+        )
+        spec["counts"] = counts.astype(float).tolist()
+        specs.append(spec)
+    return specs
+
+
+def score_spec_from_scores(
+    scores: Sequence[float], bins: int = 24
+) -> Optional[dict]:
+    """Uniform histogram spec over a training score sample."""
+    s = np.asarray(scores, np.float64)
+    s = s[np.isfinite(s)]
+    if not len(s):
+        return None
+    lo, hi = float(s.min()), float(s.max())
+    if not math.isfinite(lo) or not math.isfinite(hi):
+        return None
+    if hi <= lo:
+        pad = max(abs(lo) * 0.05, 1e-6)
+        lo, hi = lo - pad, hi + pad
+    edges = np.linspace(lo, hi, bins + 1)
+    counts, _ = np.histogram(s, bins=edges)
+    return {
+        "edges": edges.tolist(),
+        "counts": counts.astype(float).tolist(),
+    }

@@ -25,6 +25,7 @@ route, drains the old version, and keeps it for :meth:`rollback`.
 from __future__ import annotations
 
 import json
+import os
 import re
 import threading
 import time
@@ -39,8 +40,10 @@ from mmlspark_tpu.core.frame import DataFrame
 from mmlspark_tpu.core.jit_cache import cache_counters, enable_compile_cache
 from mmlspark_tpu.io.http.http_schema import HTTPRequestData, HTTPResponseData
 from mmlspark_tpu.io.http.serving import HTTPServer
+from mmlspark_tpu.obs.quality import SLOConfig
 from mmlspark_tpu.serve.admission import AdmissionController
 from mmlspark_tpu.serve.batcher import DEFAULT_BUCKETS, BatchItem, DynamicBatcher
+from mmlspark_tpu.serve.monitor import ModelQualityMonitor, find_booster
 from mmlspark_tpu.serve.registry import ModelRegistry, ModelVersion
 
 _PREDICT_RE = re.compile(r"^/models/([A-Za-z0-9_.-]+)/predict$")
@@ -66,25 +69,9 @@ def _json_response(status: int, payload, headers: Optional[dict] = None) -> HTTP
     )
 
 
-def _find_booster(model):
-    """The Booster inside a model, if there is one (LightGBM facades or a
-    PipelineModel ending in one) — enables the padded fast path."""
-    if hasattr(model, "getBooster"):
-        try:
-            return model.getBooster()
-        except Exception:
-            return None
-    stages = None
-    if hasattr(model, "getStages"):
-        try:
-            stages = model.getStages()
-        except Exception:
-            stages = None
-    for stage in reversed(list(stages or [])):
-        b = _find_booster(stage)
-        if b is not None:
-            return b
-    return None
+# booster discovery lives in serve/monitor.py now (the registry needs it
+# too, for baseline extraction); the old name stays importable
+_find_booster = find_booster
 
 
 def default_predictor(model):
@@ -148,8 +135,19 @@ class ServingApp:
         max_inflight: int = 1024,
         prewarm: bool = True,
         registry: Optional[ModelRegistry] = None,
+        monitor: bool = True,
+        slo: Optional[SLOConfig] = None,
     ):
         self.registry = registry or ModelRegistry()
+        # Model-quality monitor (feature/score drift + SLO burn): on by
+        # default, off via monitor=False or MMLSPARK_TPU_SERVE_MONITOR=0.
+        env_gate = os.environ.get(
+            "MMLSPARK_TPU_SERVE_MONITOR", "").strip().lower()
+        self.monitor: Optional[ModelQualityMonitor] = (
+            ModelQualityMonitor(slo=slo)
+            if monitor and env_gate not in ("0", "false", "off")
+            else None
+        )
         self.admission = AdmissionController(
             max_queue_depth=max_queue_depth, max_inflight=max_inflight
         )
@@ -219,6 +217,8 @@ class ServingApp:
             feature_dim if feature_dim is not None else inferred_dim,
         )
         self._routes[name] = route
+        if self.monitor is not None:
+            self.monitor.register_route(name, mv.version, mv.quality_baseline)
         route.thread = threading.Thread(
             target=self._worker, args=(route,), daemon=True,
             name=f"serve-{name}",
@@ -244,11 +244,22 @@ class ServingApp:
                     route.feature_dim,
                 )
 
+        def on_flip(mv: ModelVersion) -> None:
+            # reset the drift reference atomically with the route flip
+            if self.monitor is not None:
+                self.monitor.register_route(
+                    name, mv.version, mv.quality_baseline
+                )
+
         return self.registry.swap(name, path=path, model=model, warm=warm,
-                                  block=block)
+                                  block=block, on_flip=on_flip)
 
     def rollback(self, name: str) -> ModelVersion:
-        return self.registry.rollback(name)
+        mv = self.registry.rollback(name)
+        if self.monitor is not None:
+            # the restored version brings its own baseline back
+            self.monitor.register_route(name, mv.version, mv.quality_baseline)
+        return mv
 
     # -- lifecycle -------------------------------------------------------
     def start(self) -> "ServingApp":
@@ -280,6 +291,8 @@ class ServingApp:
                 route.thread.join(timeout=5.0)
         self._server.stop()
         self.admission.set_ready(False)
+        if self.monitor is not None:
+            self.monitor.stop()
         return drained
 
     def _prewarm_route(self, route: _Route, mv: ModelVersion) -> None:
@@ -314,6 +327,8 @@ class ServingApp:
                 return _json_response(200 if self.ready else 503, body)
             if path == "/metrics":
                 return self._metrics_response(req)
+            if path == "/driftz":
+                return self._driftz_response()
             return _json_response(404, {"error": f"no such path: {path}"})
         if req.method != "POST":
             return _json_response(405, {"error": f"method {req.method}"})
@@ -354,12 +369,28 @@ class ServingApp:
         )
         if not want_prom:
             return _json_response(200, obs.snapshot())
-        text = obs_metrics.render_prometheus(obs.snapshot())
+        text = obs_metrics.render_prometheus(obs.snapshot(with_buckets=True))
         return HTTPResponseData(
             statusCode=200,
             headers={"Content-Type": "text/plain; version=0.0.4; charset=utf-8"},
             entity=text.encode(),
         )
+
+    def _driftz_response(self) -> HTTPResponseData:
+        """Model-quality detail: per-route drift PSIs, score quantiles,
+        SLO burn rates, active alarms.  Never 500s — a monitor hiccup
+        (e.g. racing a hot-swap) degrades to a diagnostic body, because a
+        dashboard poll must not look like a serving outage."""
+        if self.monitor is None:
+            return _json_response(200, {"status": "disabled", "routes": {}})
+        try:
+            body = self.monitor.describe()
+            body["status"] = "ok"
+            return _json_response(200, body)
+        except Exception as e:  # pragma: no cover - defensive
+            return _json_response(
+                200, {"status": "degraded", "error": repr(e), "routes": {}}
+            )
 
     def _parse_predict(self, rid: str, req: HTTPRequestData, route: _Route,
                        wait_s: float):
@@ -447,6 +478,7 @@ class ServingApp:
                         )
                 version = mv.version
             off = 0
+            latencies = []
             for it in items:
                 k = it.n_rows
                 chunk = preds[off:off + k]
@@ -465,6 +497,7 @@ class ServingApp:
                 t_reply = time.monotonic()
                 self._server.reply(it.rid, _json_response(200, body, headers))
                 now = time.monotonic()
+                latencies.append(now - it.enqueued)
                 obs.record_span(
                     "serve.reply", now - t_reply,
                     rid=it.request_id or it.rid, trace_id=tid,
@@ -474,16 +507,32 @@ class ServingApp:
                     rid=it.request_id or it.rid, trace_id=tid,
                     batch=batch_id, bucket=bucket,
                 )
+            if self.monitor is not None:
+                # one bounded-queue append; the monitor thread does the
+                # binning/decay, so the reply path stays flat
+                self.monitor.submit(
+                    route.name, version, rows=X[:n], preds=preds[:n],
+                    statuses=[200] * len(items), latencies=latencies,
+                )
         except Exception as e:
             obs.inc("serve.errors", model=route.name)
             obs.get_logger("mmlspark_tpu.serve").exception(
                 "batch failed on route %s", route.name
             )
+            now = time.monotonic()
             for it in items:
                 err = _json_response(
                     500, {"error": repr(e)},
                     {"X-Request-Id": it.request_id or it.rid},
                 )
                 self._server.reply(it.rid, err)
+            if self.monitor is not None:
+                mv_now = self.registry.get(route.name)
+                self.monitor.submit(
+                    route.name,
+                    mv_now.version if mv_now is not None else -1,
+                    statuses=[500] * len(items),
+                    latencies=[now - it.enqueued for it in items],
+                )
         finally:
             self.admission.complete(route.name, len(items))

@@ -1,0 +1,327 @@
+"""serve.monitor — the model-quality monitor on the serving spine.
+
+:class:`ModelQualityMonitor` watches every route the app serves, per
+(model, version): feature drift against the model's own training bin
+edges, score drift against the training score histogram, and SLO burn
+rate over the route's availability/latency objectives (all math in
+:mod:`mmlspark_tpu.obs.quality`).
+
+Hot-path contract: ``submit()`` is ONE bounded-queue append — binning,
+decay, and PSI all happen on the monitor's daemon thread, so the predict
+worker never pays for quality accounting.  When the queue is full the
+batch is dropped (and counted) rather than blocking the reply path.
+
+Alarms fan into the existing observability machinery, not a new one:
+
+- ``quality.drift_alarms{model=,kind=}`` / ``quality.drift_clears`` obs
+  counters on every alarm transition;
+- a ``flight`` event plus a throttled flight-recorder ``auto_dump`` (so
+  the blackbox captures what led up to the drift alarm);
+- ``quality.feature_psi_max{model=}`` / ``quality.score_psi{model=}`` /
+  ``slo.*_burn{model=,window=}`` gauges on ``/metrics`` (JSON and
+  Prometheus);
+- full per-feature detail on ``GET /driftz`` (see ``serve/app.py``).
+
+The reference (training-time baseline) swaps atomically with the model:
+``serve/registry.py`` extracts it at load time and the app calls
+:meth:`ModelQualityMonitor.register_route` from the swap's flip hook, so
+post-swap traffic is never compared against the old model's histograms.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from typing import Dict, Optional, Sequence
+
+import numpy as np
+
+from mmlspark_tpu import obs
+from mmlspark_tpu.obs import flight
+from mmlspark_tpu.obs import quality
+
+
+def find_booster(model):
+    """The Booster inside a model, if there is one (LightGBM facades or a
+    PipelineModel ending in one)."""
+    if hasattr(model, "getBooster"):
+        try:
+            return model.getBooster()
+        except Exception:
+            return None
+    stages = None
+    if hasattr(model, "getStages"):
+        try:
+            stages = model.getStages()
+        except Exception:
+            stages = None
+    for stage in reversed(list(stages or [])):
+        b = find_booster(stage)
+        if b is not None:
+            return b
+    return None
+
+
+def extract_baseline(model) -> Optional[dict]:
+    """The training-time quality baseline riding a model, or None (e.g.
+    boosters rebuilt from a LightGBM text string never carry one — the
+    monitor then runs reference-less: SLO tracking only, no drift PSI)."""
+    if model is None:
+        return None
+    qb = getattr(model, "quality_baseline", None)
+    if qb:
+        return qb
+    b = find_booster(model)
+    return getattr(b, "quality_baseline", None) if b is not None else None
+
+
+class _Batch:
+    __slots__ = ("name", "version", "rows", "preds", "statuses",
+                 "latencies", "t")
+
+    def __init__(self, name, version, rows, preds, statuses, latencies, t):
+        self.name = name
+        self.version = version
+        self.rows = rows
+        self.preds = preds
+        self.statuses = statuses
+        self.latencies = latencies
+        self.t = t
+
+
+class _RouteState:
+    def __init__(self, name: str, version: int, baseline: Optional[dict],
+                 slo: quality.SLOConfig, cfg: dict):
+        self.name = name
+        self.version = version
+        self.baseline = (
+            quality.QualityBaseline.from_dict(baseline) if baseline else None
+        )
+        hl = cfg["half_life_rows"]
+        self.feature = (
+            quality.FeatureDriftTracker(self.baseline, half_life_rows=hl)
+            if self.baseline and self.baseline.features else None
+        )
+        self.score = (
+            quality.ScoreDriftTracker(self.baseline, half_life_rows=hl)
+            if self.baseline and self.baseline.score else None
+        )
+        self.slo = quality.SLOTracker(slo)
+        self.alarms_active: Dict[str, bool] = {}
+        self.alarm_counts: Dict[str, int] = {}
+        self.stale_batches = 0
+
+
+class ModelQualityMonitor:
+    """Background model-quality accounting for a :class:`ServingApp`."""
+
+    _ALL_KINDS = ("feature_drift", "score_drift", "slo_availability",
+                  "slo_latency")
+
+    def __init__(
+        self,
+        slo: Optional[quality.SLOConfig] = None,
+        max_pending: int = 256,
+        eval_interval_s: float = 1.0,
+    ):
+        self._cfg = quality.quality_env_config()
+        self._slo_default = slo
+        self._lock = threading.Lock()
+        self._states: Dict[str, _RouteState] = {}
+        self._pending: "queue.Queue[Optional[_Batch]]" = queue.Queue(
+            maxsize=max_pending
+        )
+        self._eval_interval_s = float(eval_interval_s)
+        self._last_eval = 0.0
+        self._dropped = 0
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._run, daemon=True, name="quality-monitor"
+        )
+        self._thread.start()
+
+    # -- registration (swap/rollback reset the reference atomically) -----
+    def register_route(
+        self,
+        name: str,
+        version: int,
+        baseline: Optional[dict],
+        slo: Optional[quality.SLOConfig] = None,
+    ) -> None:
+        """(Re)point a route at a model version + its training reference.
+        Replaces ALL live drift state for the route, so post-swap traffic
+        is never compared against the previous model's histograms."""
+        slo_cfg = slo or self._slo_default or quality.SLOConfig.from_env(name)
+        state = _RouteState(name, int(version), baseline, slo_cfg, self._cfg)
+        with self._lock:
+            self._states[name] = state
+        obs.inc("quality.references_loaded", model=name,
+                has_baseline=bool(baseline))
+
+    # -- the hot-path feed ------------------------------------------------
+    def submit(
+        self,
+        name: str,
+        version: int,
+        rows: Optional[np.ndarray] = None,
+        preds: Optional[np.ndarray] = None,
+        statuses: Sequence[int] = (),
+        latencies: Sequence[float] = (),
+    ) -> None:
+        """Queue one served batch for accounting.  Never blocks: one
+        bounded-queue append; on overflow the batch is dropped and
+        counted (``quality.batches_dropped``)."""
+        b = _Batch(name, int(version), rows, preds, tuple(statuses),
+                   tuple(latencies), time.monotonic())
+        try:
+            self._pending.put_nowait(b)
+        except queue.Full:
+            self._dropped += 1
+            obs.inc("quality.batches_dropped", model=name)
+
+    # -- the monitor thread ----------------------------------------------
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            try:
+                b = self._pending.get(timeout=self._eval_interval_s)
+            except queue.Empty:
+                b = None
+            if b is not None:
+                try:
+                    self._ingest(b)
+                except Exception:
+                    obs.get_logger("mmlspark_tpu.serve").exception(
+                        "quality monitor failed to ingest a batch"
+                    )
+            now = time.monotonic()
+            if now - self._last_eval >= self._eval_interval_s:
+                self._last_eval = now
+                try:
+                    self._evaluate(now)
+                except Exception:
+                    obs.get_logger("mmlspark_tpu.serve").exception(
+                        "quality monitor evaluation failed"
+                    )
+
+    def _ingest(self, b: _Batch) -> None:
+        with self._lock:
+            st = self._states.get(b.name)
+            if st is None:
+                return
+            for status, lat in zip(
+                b.statuses, b.latencies or [0.0] * len(b.statuses)
+            ):
+                st.slo.record(status, lat, now=b.t)
+            if b.version != st.version:
+                # a batch served by a version the route no longer points
+                # at (in flight across a swap): its rows must not pollute
+                # the NEW reference's live histograms
+                st.stale_batches += 1
+                return
+            if st.feature is not None and b.rows is not None and len(b.rows):
+                st.feature.update(b.rows)
+            if st.score is not None and b.preds is not None:
+                st.score.update(b.preds)
+
+    def _evaluate(self, now: float) -> None:
+        with self._lock:
+            states = list(self._states.values())
+            min_rows = self._cfg["min_rows"]
+            psi_alert = self._cfg["psi_alert"]
+            for st in states:
+                detail: Dict[str, float] = {}
+                active: Dict[str, bool] = {}
+                if st.feature is not None:
+                    # alarm on the bias-corrected (excess) PSI: raw PSI's
+                    # no-drift expectation scales like groups/rows and
+                    # would page on small-sample noise
+                    psi_max = float(st.feature.excess_psis().max()) \
+                        if st.feature.num_features else 0.0
+                    obs.gauge("quality.feature_psi_max", psi_max,
+                              model=st.name)
+                    warm = st.feature.live_rows() >= min_rows
+                    active["feature_drift"] = warm and psi_max > psi_alert
+                    detail["feature_psi_max"] = psi_max
+                if st.score is not None:
+                    s_psi = st.score.excess_psi()
+                    obs.gauge("quality.score_psi", s_psi, model=st.name)
+                    warm = st.score.live_rows() >= min_rows
+                    active["score_drift"] = warm and s_psi > psi_alert
+                    detail["score_psi"] = s_psi
+                slo = st.slo.evaluate(now)
+                for kind in ("availability", "latency"):
+                    obs.gauge(f"slo.{kind}_burn", slo[kind]["fast"],
+                              model=st.name, window="fast")
+                    obs.gauge(f"slo.{kind}_burn", slo[kind]["slow"],
+                              model=st.name, window="slow")
+                    active[f"slo_{kind}"] = slo["alerts"][kind]
+                    detail[f"slo_{kind}_burn_fast"] = slo[kind]["fast"]
+                self._transition(st, active, detail)
+
+    def _transition(self, st: _RouteState, active: Dict[str, bool],
+                    detail: Dict[str, float]) -> None:
+        for kind, is_active in active.items():
+            was = st.alarms_active.get(kind, False)
+            st.alarms_active[kind] = is_active
+            if is_active and not was:
+                st.alarm_counts[kind] = st.alarm_counts.get(kind, 0) + 1
+                obs.inc("quality.drift_alarms", model=st.name, kind=kind)
+                flight.record(
+                    "alarm", f"quality.{kind}",
+                    {"model": st.name, "version": st.version, **detail},
+                )
+                flight.auto_dump(f"quality_alarm:{st.name}:{kind}")
+                obs.get_logger("mmlspark_tpu.serve").warning(
+                    "quality alarm %s on route %s (version %d): %s",
+                    kind, st.name, st.version, detail,
+                )
+            elif was and not is_active:
+                obs.inc("quality.drift_clears", model=st.name, kind=kind)
+
+    # -- inspection (GET /driftz, tools.obs drift --url) ------------------
+    def describe(self) -> dict:
+        with self._lock:
+            routes = {}
+            for name, st in self._states.items():
+                entry: dict = {
+                    "version": st.version,
+                    "reference": (
+                        {
+                            "n_rows": st.baseline.n_rows,
+                            "captured_at": st.baseline.captured_at,
+                            "num_features": len(st.baseline.features),
+                        }
+                        if st.baseline else None
+                    ),
+                    "alarms_active": {
+                        k: v for k, v in st.alarms_active.items() if v
+                    },
+                    "alarm_counts": dict(st.alarm_counts),
+                    "stale_batches": st.stale_batches,
+                    "slo": st.slo.evaluate(),
+                }
+                if st.feature is not None:
+                    entry["feature_drift"] = st.feature.describe()
+                if st.score is not None:
+                    entry["score_drift"] = st.score.describe()
+                routes[name] = entry
+            return {
+                "config": dict(self._cfg),
+                "dropped_batches": self._dropped,
+                "routes": routes,
+            }
+
+    def alarm_count(self, name: Optional[str] = None) -> int:
+        """Total alarm transitions (optionally for one route) — test and
+        bench hook."""
+        with self._lock:
+            total = 0
+            for st in self._states.values():
+                if name is None or st.name == name:
+                    total += sum(st.alarm_counts.values())
+            return total
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._thread.join(timeout=5.0)

@@ -27,6 +27,7 @@ from typing import Callable, Dict, List, Optional
 
 from mmlspark_tpu import obs
 from mmlspark_tpu.core.pipeline import PipelineStage, saved_stage_metadata
+from mmlspark_tpu.serve.monitor import extract_baseline
 
 
 class ModelVersion:
@@ -39,6 +40,9 @@ class ModelVersion:
         self.model = model
         self.path = path
         self.meta = dict(meta or {})
+        # training-time drift reference (rides the version so the monitor
+        # reference flips atomically with the model on swap/rollback)
+        self.quality_baseline = extract_baseline(model)
         self.loaded_at = time.time()
         self._lock = threading.Lock()
         self._refs = 0
@@ -124,13 +128,18 @@ class ModelRegistry:
         model=None,
         warm: Optional[Callable[[ModelVersion], None]] = None,
         block: bool = True,
+        on_flip: Optional[Callable[[ModelVersion], None]] = None,
     ):
         """Atomic hot-swap: load → warm → flip → drain old.
 
         ``warm`` receives the NEW version before the flip (route code
-        passes its bucket pre-warmer).  With ``block=False`` the whole
-        protocol runs on a daemon thread and the thread is returned;
-        otherwise the new :class:`ModelVersion` is returned."""
+        passes its bucket pre-warmer); ``on_flip`` receives it right
+        AFTER the flip, before the drain (the app points the quality
+        monitor's drift reference at the new version here, so post-swap
+        traffic is judged against the new model's baseline).  With
+        ``block=False`` the whole protocol runs on a daemon thread and
+        the thread is returned; otherwise the new :class:`ModelVersion`
+        is returned."""
         if name not in self._routes:
             raise KeyError(f"unknown route {name!r}; register() it first")
 
@@ -144,6 +153,8 @@ class ModelRegistry:
                     old = self._routes.get(name)
                     self._routes[name] = mv
                     self._previous[name] = old
+                if on_flip is not None:
+                    on_flip(mv)
                 obs.inc("serve.swaps", model=name)
                 if old is not None and not old.wait_idle(self._drain_timeout_s):
                     obs.inc("serve.swap_drain_timeouts", model=name)

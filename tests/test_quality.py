@@ -1,0 +1,581 @@
+"""Model-quality observability (ISSUE 8): drift math, training baseline
+capture + persistence, the serve-path monitor, and the metric-registry
+hardening that rides along.
+
+Layers:
+1. quality units: PSI + bias correction, equal-mass grouping, feature and
+   score trackers (decay, missing, shift detection), SLO burn windows;
+2. baseline lifecycle: captured at train(), persisted as the
+   ``quality_baseline.json`` sidecar, restored on load, env-gated off;
+3. monitor units: silent on in-distribution traffic, alarms on shift,
+   stale-version batches quarantined, overflow drops counted;
+4. hot-swap x monitor over real HTTP: swap under traffic raises no false
+   alarm, rollback re-registers the old reference, /driftz never 500s;
+5. registry hardening: label-cardinality guard, Prometheus ``_bucket``
+   exposition with the default JSON shape unchanged.
+"""
+
+import json
+import os
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu import obs
+from mmlspark_tpu.obs import metrics
+from mmlspark_tpu.obs.quality import (
+    DEFAULT_PSI_GROUPS,
+    FeatureDriftTracker,
+    QualityBaseline,
+    SLOConfig,
+    SLOTracker,
+    ScoreDriftTracker,
+    _group_assignment,
+    psi,
+    score_spec_from_scores,
+)
+from mmlspark_tpu.serve.monitor import ModelQualityMonitor, extract_baseline
+
+N_FEATURES = 3
+
+
+# --------------------------------------------------------------- fixtures
+def _num_spec(col, edges):
+    """A numeric feature spec from a reference sample (missing slot 0)."""
+    e = np.asarray(edges, np.float64)
+    idx = np.minimum(np.searchsorted(e, col, side="left"), len(e) - 1)
+    counts = np.bincount(idx, minlength=len(e)).astype(float)
+    return {"kind": "num", "edges": e.tolist(),
+            "counts": counts.tolist() + [0.0]}
+
+
+def _make_baseline(seed=0, n=4000, n_features=2):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, n_features))
+    edges = list(np.linspace(-2.5, 2.5, 21)) + [np.inf]
+    return QualityBaseline(
+        features=[_num_spec(X[:, f], edges) for f in range(n_features)],
+        score=score_spec_from_scores(rng.normal(size=n)),
+        n_rows=n,
+    )
+
+
+@pytest.fixture(scope="module")
+def saved_models(tmp_path_factory):
+    """Two trained+saved regressors (v1/v2) and the training matrix."""
+    from mmlspark_tpu.core.frame import DataFrame
+    from mmlspark_tpu.models.lightgbm import LightGBMRegressor
+
+    rng = np.random.default_rng(11)
+    X = rng.normal(size=(300, N_FEATURES))
+    paths = []
+    for k in (1, 2):
+        y = X[:, 0] * k + 0.1 * rng.normal(size=len(X))
+        model = LightGBMRegressor(
+            numIterations=4, numLeaves=4, minDataInLeaf=2
+        ).fit(DataFrame({"features": list(X), "label": y}))
+        p = str(tmp_path_factory.mktemp("quality_models") / f"v{k}")
+        model.save(p)
+        paths.append(p)
+    return {"v1": paths[0], "v2": paths[1], "X": X}
+
+
+# ------------------------------------------------------------ PSI + groups
+class TestPSI:
+    def test_identical_distributions_are_near_zero(self):
+        c = [100.0, 200.0, 300.0, 50.0]
+        assert psi(c, [v * 3 for v in c]) < 1e-6  # scale-invariant
+
+    def test_disjoint_distributions_are_large(self):
+        assert psi([100.0, 0.0, 0.0], [0.0, 0.0, 100.0]) > 1.0
+
+    def test_group_assignment_equal_mass(self):
+        rng = np.random.default_rng(0)
+        counts = rng.integers(1, 100, size=255).astype(np.float64)
+        g = _group_assignment(counts, DEFAULT_PSI_GROUPS)
+        assert len(g) == 255
+        assert g.max() < DEFAULT_PSI_GROUPS
+        assert (np.diff(g) >= 0).all()  # monotone over bin order
+        mass = np.zeros(g.max() + 1)
+        np.add.at(mass, g, counts)
+        # roughly equal reference mass per group
+        assert mass.max() < 3.0 * counts.sum() / DEFAULT_PSI_GROUPS
+
+    def test_group_assignment_few_bins_pass_through(self):
+        g = _group_assignment(np.array([5.0, 5.0, 5.0]), 32)
+        assert list(g) == [0, 1, 2]
+
+
+# --------------------------------------------------------- feature drift
+class TestFeatureDrift:
+    def test_silent_on_in_distribution_traffic(self):
+        b = _make_baseline()
+        t = FeatureDriftTracker(b, half_life_rows=4000.0)
+        rng = np.random.default_rng(1)
+        for _ in range(10):
+            t.update(rng.normal(size=(200, 2)))
+        assert t.live_rows() > 1000
+        assert float(t.excess_psis().max()) < 0.05
+        # the bias floor itself is nonzero (finite samples)
+        assert t._states[0].psi_bias() > 0.0
+
+    def test_alarms_on_covariate_shift(self):
+        b = _make_baseline()
+        t = FeatureDriftTracker(b, half_life_rows=4000.0)
+        rng = np.random.default_rng(2)
+        t.update(rng.normal(size=(2000, 2)) + 3.0)
+        assert float(t.excess_psis().max()) > 0.25
+
+    def test_missing_rate_counts_nans(self):
+        b = _make_baseline()
+        t = FeatureDriftTracker(b)
+        X = np.random.default_rng(3).normal(size=(500, 2))
+        X[:250, 0] = np.nan
+        t.update(X)
+        rates = t.missing_rates()
+        assert rates[0] == pytest.approx(0.5, abs=0.01)
+        assert rates[1] == 0.0
+
+    def test_decay_bounds_effective_sample(self):
+        b = _make_baseline()
+        t = FeatureDriftTracker(b, half_life_rows=100.0)
+        rng = np.random.default_rng(4)
+        for _ in range(100):
+            t.update(rng.normal(size=(10, 2)))
+        # effective live mass converges to ~half_life / ln 2, far below
+        # the total rows seen
+        assert t.live_rows() < 160.0
+        assert t.rows_seen == 1000
+
+    def test_describe_ranks_by_excess(self):
+        b = _make_baseline()
+        t = FeatureDriftTracker(b)
+        rng = np.random.default_rng(5)
+        X = rng.normal(size=(2000, 2))
+        X[:, 1] += 3.0  # only feature 1 drifts
+        t.update(X)
+        d = t.describe(top=2)
+        assert d["top"][0]["feature"] == 1
+        assert d["top"][0]["excess_psi"] > d["top"][1]["excess_psi"]
+        assert d["excess_psi_max"] == pytest.approx(
+            d["top"][0]["excess_psi"])
+
+    def test_categorical_exact_match_binning(self):
+        spec = {"kind": "cat", "cats": [2, 5, 9],
+                "counts": [10.0, 20.0, 30.0, 0.0]}
+        b = QualityBaseline(features=[spec])
+        t = FeatureDriftTracker(b)
+        st = t._states[0]
+        bins = st.bin_column(np.array([2.0, 5.0, 9.0, 7.0, np.nan]))
+        # kept categories hit their slot; unseen value and NaN -> missing
+        assert list(bins) == [0, 1, 2, 3, 3]
+
+
+# ----------------------------------------------------------- score drift
+class TestScoreDrift:
+    def test_silent_then_shifted(self):
+        rng = np.random.default_rng(6)
+        ref = rng.normal(size=4000)
+        b = QualityBaseline(features=[], score=score_spec_from_scores(ref),
+                            n_rows=4000)
+        t = ScoreDriftTracker(b)
+        t.update(rng.normal(size=2000))
+        assert t.excess_psi() < 0.05
+        t2 = ScoreDriftTracker(b)
+        t2.update(rng.normal(size=2000) + 3.0)
+        assert t2.excess_psi() > 0.25
+
+    def test_multiclass_class_mix(self):
+        rng = np.random.default_rng(7)
+        b = QualityBaseline(
+            features=[],
+            score={"edges": [0.0, 0.5, 1.0], "counts": [100.0, 100.0]},
+            class_mix=[50.0, 25.0, 25.0],
+        )
+        t = ScoreDriftTracker(b)
+        # one-hot-ish rows all predicting class 2: the mix shifts hard
+        P = np.zeros((300, 3))
+        P[:, 2] = 0.9 + 0.05 * rng.random(300)
+        t.update(P)
+        assert t.class_mix_psi() > 0.5
+        d = t.describe()
+        assert d["class_mix_live"][2] > d["class_mix_live"][0]
+
+    def test_recent_reservoir_quantiles(self):
+        b = QualityBaseline(
+            features=[],
+            score={"edges": [0.0, 1.0], "counts": [1.0]},
+        )
+        t = ScoreDriftTracker(b)
+        t.update(np.full(100, 0.25))
+        d = t.describe()
+        assert d["recent"]["p50"] == pytest.approx(0.25)
+        assert d["recent"]["count"] == 100
+
+
+# -------------------------------------------------------------- SLO burn
+class TestSLO:
+    def test_parse_and_route_override(self, monkeypatch):
+        cfg = SLOConfig.parse(
+            "availability=0.99,latency_ms=100,min_requests=5,unknown=1"
+        )
+        assert cfg.availability == 0.99
+        assert cfg.latency_ms == 100.0
+        assert cfg.min_requests == 5
+        monkeypatch.setenv("MMLSPARK_TPU_SLO", "availability=0.9")
+        monkeypatch.setenv("MMLSPARK_TPU_SLO_MY_ROUTE", "availability=0.5")
+        assert SLOConfig.from_env().availability == 0.9
+        assert SLOConfig.from_env("my-route").availability == 0.5
+        assert SLOConfig.from_env("other").availability == 0.9
+
+    def test_burn_math_and_alert(self):
+        t = SLOTracker(SLOConfig(availability=0.999, min_requests=20))
+        now = 10_000.0
+        for i in range(90):
+            t.record(200, 0.01, now=now + (i % 30))
+        for i in range(10):
+            t.record(500, 0.01, now=now + (i % 30))
+        ev = t.evaluate(now=now + 30)
+        # 10% errors / 0.1% budget = burn 100 on both windows
+        assert ev["availability"]["fast"] == pytest.approx(100.0)
+        assert ev["availability"]["slow"] == pytest.approx(100.0)
+        assert ev["alerts"]["availability"] is True
+        assert ev["alerts"]["latency"] is False
+
+    def test_4xx_spends_no_budget(self):
+        t = SLOTracker(SLOConfig(min_requests=1))
+        now = 10_000.0
+        for _ in range(50):
+            t.record(429, 0.01, now=now)
+        ev = t.evaluate(now=now)
+        assert ev["requests"]["fast"] == 0.0
+        assert ev["alerts"]["availability"] is False
+
+    def test_old_incident_does_not_alert_fast_window(self):
+        cfg = SLOConfig(fast_window_s=60, slow_window_s=300, min_requests=1)
+        t = SLOTracker(cfg)
+        now = 10_000.0
+        for _ in range(50):
+            t.record(500, 0.01, now=now - 200)  # inside slow, outside fast
+        for _ in range(50):
+            t.record(200, 0.01, now=now)
+        ev = t.evaluate(now=now)
+        assert ev["availability"]["slow"] > cfg.burn_alert
+        assert ev["availability"]["fast"] == 0.0
+        assert ev["alerts"]["availability"] is False
+
+    def test_min_requests_gate(self):
+        t = SLOTracker(SLOConfig(min_requests=20))
+        now = 10_000.0
+        for _ in range(5):
+            t.record(500, 0.01, now=now)
+        ev = t.evaluate(now=now)
+        assert ev["availability"]["fast"] >= 999.0  # burning hard...
+        assert ev["alerts"]["availability"] is False  # ...but 5 requests
+
+    def test_bucket_memory_is_bounded(self):
+        t = SLOTracker(SLOConfig(slow_window_s=300))
+        for i in range(5000):
+            t.record(200, 0.01, now=10_000.0 + i)
+        assert len(t._buckets) <= 305
+
+
+# --------------------------------------------- baseline capture + sidecar
+class TestBaselineLifecycle:
+    def test_train_captures_baseline(self, saved_models):
+        from mmlspark_tpu.core.pipeline import PipelineStage
+
+        model = PipelineStage.load(saved_models["v1"])
+        qb = extract_baseline(model)
+        assert qb and qb["version"] == 1
+        assert len(qb["features"]) == N_FEATURES
+        assert qb["n_rows"] == 300
+        # per-feature counts (incl. missing slot) account for every row
+        assert sum(qb["features"][0]["counts"]) == pytest.approx(300)
+        assert qb["score"] and len(qb["score"]["counts"]) >= 8
+
+    def test_sidecar_round_trip(self, saved_models):
+        assert os.path.exists(
+            os.path.join(saved_models["v1"], "quality_baseline.json"))
+        from mmlspark_tpu.models.lightgbm import LightGBMRegressionModel
+
+        loaded = LightGBMRegressionModel.load(saved_models["v1"])
+        qb = loaded.getBooster().quality_baseline
+        assert qb and len(qb["features"]) == N_FEATURES
+
+    def test_corrupt_sidecar_never_blocks_load(self, saved_models, tmp_path):
+        import shutil
+
+        broken = str(tmp_path / "broken")
+        shutil.copytree(saved_models["v1"], broken)
+        with open(os.path.join(broken, "quality_baseline.json"), "w") as f:
+            f.write("{not json")
+        from mmlspark_tpu.models.lightgbm import LightGBMRegressionModel
+
+        loaded = LightGBMRegressionModel.load(broken)  # must not raise
+        assert loaded.getBooster().quality_baseline is None
+
+    def test_env_gate_disables_capture(self, monkeypatch):
+        monkeypatch.setenv("MMLSPARK_TPU_QUALITY_BASELINE", "0")
+        from mmlspark_tpu.core.frame import DataFrame
+        from mmlspark_tpu.models.lightgbm import LightGBMRegressor
+
+        rng = np.random.default_rng(12)
+        X = rng.normal(size=(80, 2))
+        model = LightGBMRegressor(
+            numIterations=2, numLeaves=4, minDataInLeaf=2
+        ).fit(DataFrame({"features": list(X), "label": X[:, 0]}))
+        assert model.getBooster().quality_baseline is None
+
+
+# ---------------------------------------------------------- monitor units
+def _wait_for(pred, timeout_s=10.0, step_s=0.05):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(step_s)
+    return pred()
+
+
+class TestMonitor:
+    @pytest.fixture()
+    def monitor(self, monkeypatch):
+        monkeypatch.setenv("MMLSPARK_TPU_QUALITY_MIN_ROWS", "64")
+        m = ModelQualityMonitor(eval_interval_s=0.05)
+        yield m
+        m.stop()
+
+    def test_silent_then_alarms_on_shift(self, monitor):
+        monitor.register_route("r", 1, _make_baseline().to_dict())
+        rng = np.random.default_rng(8)
+        for _ in range(5):
+            monitor.submit("r", 1, rows=rng.normal(size=(100, 2)),
+                           statuses=[200] * 4, latencies=[0.01] * 4)
+        assert _wait_for(
+            lambda: monitor.describe()["routes"]["r"][
+                "feature_drift"]["rows_seen"] >= 500)
+        time.sleep(0.2)  # a couple of eval ticks at the warm state
+        assert monitor.alarm_count("r") == 0
+        for _ in range(10):
+            monitor.submit("r", 1, rows=rng.normal(size=(200, 2)) + 3.0)
+        assert _wait_for(lambda: monitor.alarm_count("r") > 0)
+        d = monitor.describe()["routes"]["r"]
+        assert d["alarms_active"].get("feature_drift")
+        assert d["alarm_counts"]["feature_drift"] == 1
+
+    def test_stale_version_batches_quarantined(self, monitor):
+        monitor.register_route("r", 2, _make_baseline().to_dict())
+        rng = np.random.default_rng(9)
+        # in flight across a swap: rows from version 1 arrive after the
+        # route flipped to version 2 — SLO counts them, drift must not
+        monitor.submit("r", 1, rows=rng.normal(size=(100, 2)) + 5.0,
+                       statuses=[200], latencies=[0.01])
+        assert _wait_for(
+            lambda: monitor.describe()["routes"]["r"]["stale_batches"] == 1)
+        d = monitor.describe()["routes"]["r"]
+        assert d["feature_drift"]["rows_seen"] == 0
+        assert d["slo"]["requests"]["fast"] == 1.0
+
+    def test_reference_less_route_tracks_slo_only(self, monitor):
+        monitor.register_route("r", 1, None)
+        monitor.submit("r", 1, rows=np.zeros((10, 2)),
+                       statuses=[200] * 10, latencies=[0.01] * 10)
+        assert _wait_for(
+            lambda: monitor.describe()["routes"]["r"][
+                "slo"]["requests"]["fast"] == 10.0)
+        d = monitor.describe()["routes"]["r"]
+        assert "feature_drift" not in d and "score_drift" not in d
+
+    def test_overflow_drops_are_counted(self):
+        obs.enable()
+        obs.reset()
+        m = ModelQualityMonitor(max_pending=1, eval_interval_s=0.05)
+        m.stop()  # freeze the consumer so the queue genuinely fills
+        m.register_route("r", 1, None)
+        for _ in range(3):
+            m.submit("r", 1, statuses=[200])
+        assert obs.snapshot()["counters"][
+            "quality.batches_dropped{model=r}"] == 2.0
+        assert m._dropped == 2
+
+
+# ------------------------------------------------ hot-swap x monitor HTTP
+def _get(url, timeout=30.0):
+    with urllib.request.urlopen(url, timeout=timeout) as r:
+        return r.status, json.loads(r.read().decode())
+
+
+def _post(url, payload, timeout=30.0):
+    req = urllib.request.Request(
+        url, data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"}, method="POST",
+    )
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return r.status, json.loads(r.read().decode())
+
+
+class TestSwapWithMonitor:
+    @pytest.fixture()
+    def app(self, saved_models, monkeypatch):
+        monkeypatch.setenv("MMLSPARK_TPU_QUALITY_MIN_ROWS", "64")
+        from mmlspark_tpu.serve import ServingApp
+
+        a = ServingApp(max_wait_ms=10.0).start()
+        a.add_model("m", path=saved_models["v1"])
+        yield a
+        a.stop(drain_s=5.0)
+
+    def test_swap_under_traffic_no_false_alarm(self, app, saved_models):
+        import threading
+
+        url = f"{app.url}/models/m/predict"
+        X = saved_models["X"]
+        stop = threading.Event()
+        driftz_statuses = []
+
+        def hammer():
+            rng = np.random.default_rng(13)
+            while not stop.is_set():
+                n = rng.integers(1, 12)
+                idx = rng.integers(0, len(X), size=n)
+                _post(url, {"instances": X[idx].tolist()})
+
+        def poll_driftz():
+            # /driftz must answer 200 continuously, including mid-swap
+            while not stop.is_set():
+                driftz_statuses.append(_get(f"{app.url}/driftz")[0])
+                time.sleep(0.01)
+
+        threads = [threading.Thread(target=hammer, daemon=True)
+                   for _ in range(4)]
+        threads.append(threading.Thread(target=poll_driftz, daemon=True))
+        for t in threads:
+            t.start()
+        time.sleep(0.5)
+        app.swap_model("m", path=saved_models["v2"])
+        time.sleep(0.5)
+        stop.set()
+        for t in threads:
+            t.join(timeout=10)
+
+        assert set(driftz_statuses) == {200}
+        status, d = _get(f"{app.url}/driftz")
+        assert status == 200 and d["status"] == "ok"
+        route = d["routes"]["m"]
+        assert route["version"] == 2
+        assert route["reference"] is not None  # v2's own baseline
+        # training-distribution traffic across a swap: no drift alarm
+        assert not any(
+            k in route["alarm_counts"]
+            for k in ("feature_drift", "score_drift")
+        )
+
+    def test_rollback_restores_old_reference(self, app, saved_models):
+        app.swap_model("m", path=saved_models["v2"])
+        assert _get(f"{app.url}/driftz")[1]["routes"]["m"]["version"] == 2
+        app.rollback("m")
+        route = _get(f"{app.url}/driftz")[1]["routes"]["m"]
+        assert route["version"] == 1
+        assert route["reference"] is not None
+        # the rollback re-registration reset the live state
+        assert route["feature_drift"]["rows_seen"] == 0
+
+    def test_driftz_disabled_app(self, saved_models):
+        from mmlspark_tpu.serve import ServingApp
+
+        a = ServingApp(monitor=False).start()
+        try:
+            a.add_model("m", path=saved_models["v1"])
+            status, d = _get(f"{a.url}/driftz")
+            assert status == 200 and d["status"] == "disabled"
+        finally:
+            a.stop()
+
+
+# ------------------------------------------------- registry hardening
+class TestCardinalityGuard:
+    def test_cap_admits_then_drops(self):
+        r = metrics.Registry(max_series=3)
+        for i in range(10):
+            r.inc("hits", model=f"tenant-{i}")
+        snap = r.snapshot()
+        labeled = [k for k in snap["counters"] if k.startswith("hits{")]
+        assert len(labeled) == 3
+        assert snap["counters"]["obs.series_dropped{metric=hits}"] == 7.0
+
+    def test_existing_series_keep_updating_past_cap(self):
+        r = metrics.Registry(max_series=1)
+        r.inc("hits", model="a")
+        r.inc("hits", model="b")  # dropped
+        r.inc("hits", model="a")  # still admitted
+        snap = r.snapshot()
+        assert snap["counters"]["hits{model=a}"] == 2.0
+        assert "hits{model=b}" not in snap["counters"]
+
+    def test_unlabeled_series_never_dropped(self):
+        r = metrics.Registry(max_series=1)
+        r.inc("labeled", model="a")
+        for _ in range(5):
+            r.inc("plain")
+        r.gauge("plain_gauge", 1.0)
+        snap = r.snapshot()
+        assert snap["counters"]["plain"] == 5.0
+        assert snap["gauges"]["plain_gauge"] == 1.0
+
+    def test_env_default(self, monkeypatch):
+        monkeypatch.setenv("MMLSPARK_TPU_OBS_MAX_SERIES", "2")
+        r = metrics.Registry()
+        assert r._max_series == 2
+
+    def test_guard_covers_gauges_and_hists(self):
+        r = metrics.Registry(max_series=1)
+        r.gauge("g", 1.0, model="a")
+        r.gauge("g", 2.0, model="b")  # dropped
+        r.observe("h", 0.5, model="a")
+        r.observe("h", 0.5, model="b")  # dropped
+        snap = r.snapshot()
+        assert "g{model=b}" not in snap["gauges"]
+        assert "h{model=b}" not in snap["histograms"]
+        assert snap["counters"]["obs.series_dropped{metric=g}"] == 1.0
+
+
+class TestBucketExposition:
+    def test_default_json_shape_unchanged(self):
+        r = metrics.Registry()
+        r.observe("lat", 0.003)
+        h = r.snapshot()["histograms"]["lat"]
+        assert "buckets" not in h
+        assert h["count"] == 1
+
+    def test_cumulative_buckets_on_request(self):
+        r = metrics.Registry()
+        for v in (0.003, 0.003, 0.04, 2.0):
+            r.observe("lat", v)
+        h = r.snapshot(with_buckets=True)["histograms"]["lat"]
+        b = h["buckets"]
+        assert b["le"] == list(metrics.BUCKET_EDGES)
+        assert len(b["counts"]) == len(b["le"]) + 1  # trailing +Inf slot
+        assert b["counts"][-1] == 4  # cumulative: last slot == count
+        assert (np.diff(b["counts"]) >= 0).all()
+        # 0.003 lands at the le=0.005 bound or tighter
+        assert b["counts"][b["le"].index(0.005)] >= 2
+
+    def test_prometheus_histogram_exposition(self):
+        r = metrics.Registry()
+        r.observe("serve_latency", 0.003, model="m")
+        body = metrics.render_prometheus(r.snapshot(with_buckets=True))
+        assert "# TYPE mmlspark_tpu_serve_latency histogram" in body
+        assert 'mmlspark_tpu_serve_latency_bucket{model="m",le="0.005"}' \
+            in body
+        assert 'le="+Inf"} 1' in body
+        assert "mmlspark_tpu_serve_latency_count" in body
+
+    def test_prometheus_without_buckets_falls_back_to_summary(self):
+        r = metrics.Registry()
+        r.observe("lat", 0.003)
+        body = metrics.render_prometheus(r.snapshot())
+        assert "_bucket" not in body
+        assert "# TYPE mmlspark_tpu_lat summary" in body

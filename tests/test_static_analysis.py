@@ -598,6 +598,84 @@ def test_obs002_suppression_round_trip(tmp_path):
     assert apply_suppressions(check_obs_file(silenced)) == []
 
 
+def test_obs003_unbounded_request_keyed_growth(tmp_path):
+    # Seeded bug: per-request dict/list on self with no cap — the serve
+    # process grows memory forever under request traffic.
+    p = _write(str(tmp_path / "mmlspark_tpu" / "serve" / "m.py"), """
+        class Tracker:
+            def handle(self, rid, req):
+                self._seen[rid] = req
+                self._log.append(rid)
+    """)
+    found = check_obs_file(p)
+    assert rules(found) == ["OBS003", "OBS003"]
+    assert "request-derived" in found[0].message
+    assert "rid" in found[0].message
+
+
+def test_obs003_taints_one_assignment_hop(tmp_path):
+    # The key is derived from a request param through one assignment —
+    # still request-cardinality, still fires.
+    p = _write(str(tmp_path / "mmlspark_tpu" / "obs" / "m.py"), """
+        class Reg:
+            def count(self, labels):
+                k = (1, tuple(labels))
+                self._counters[k] = 1
+    """)
+    assert rules(check_obs_file(p)) == ["OBS003"]
+
+
+def test_obs003_silent_on_bounded_shapes(tmp_path):
+    p = _write(str(tmp_path / "mmlspark_tpu" / "serve" / "m.py"), """
+        class Tracker:
+            def capped(self, rid, req):
+                if len(self._seen) < self._max_series:
+                    self._seen[rid] = req
+            def guarded(self, rid, req):
+                if not self._admit(rid):
+                    return
+                self._seen[rid] = req
+            def evicting(self, rid, req):
+                self._seen[rid] = req
+                while len(self._seen) > 10:
+                    self._seen.popitem()
+            def local_only(self, items):
+                out = []
+                for item in items:
+                    out.append(item)
+                return out
+    """)
+    assert check_obs_file(p) == []
+
+
+def test_obs003_only_fires_in_obs_and_serve_dirs(tmp_path):
+    src = """
+        class T:
+            def handle(self, rid):
+                self._seen[rid] = 1
+    """
+    outside = _write(str(tmp_path / "mmlspark_tpu" / "engine" / "m.py"), src)
+    assert check_obs_file(outside) == []
+    inside = _write(str(tmp_path / "mmlspark_tpu" / "obs" / "m.py"), src)
+    assert rules(check_obs_file(inside)) == ["OBS003"]
+
+
+def test_obs003_suppression_round_trip(tmp_path):
+    src = """
+        class T:
+            def register(self, rid, model):
+                self._routes[rid] = model{supp}
+    """
+    base = str(tmp_path / "mmlspark_tpu" / "serve")
+    fires = _write(os.path.join(base, "a.py"), src.format(supp=""))
+    assert rules(apply_suppressions(check_obs_file(fires))) == ["OBS003"]
+    silenced = _write(
+        os.path.join(base, "b.py"),
+        src.format(supp="  # analyze: ignore[OBS003]"),
+    )
+    assert apply_suppressions(check_obs_file(silenced)) == []
+
+
 # -------------------------------------------------------- serving fixtures
 
 

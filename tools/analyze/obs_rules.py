@@ -22,6 +22,22 @@ Rules
   Propagate one of the trace attrs, splat ``**obs.trace_attrs()``, or
   mark a deliberately request-anonymous span with
   ``# analyze: ignore[OBS002]``.
+- OBS003: obs/serve library code growing an UNBOUNDED attribute
+  container keyed by request-derived values — the label-cardinality
+  footgun the ``MMLSPARK_TPU_OBS_MAX_SERIES`` guard closes for the
+  metric registry, caught statically for everything else.  A function
+  whose parameters include request-derived names (``rid``,
+  ``request_id``, ``trace_id``, ``labels``, ``item``, ``req``, …) that
+  grows an attribute container (``self.x[k] = v``, ``.setdefault``,
+  ``.append``, ``.add``) with a key/value derived from those parameters
+  (one level of local assignment tracked) and shows NO bounding
+  discipline anywhere in the same function — no ``len(...)``
+  comparison, no ``pop``/``popitem``/``clear``, no
+  ``max``/``cap``/``limit``/``bound``-named threshold compare, no call
+  to an ``admit``/``evict``/``prune``-style guard — will grow memory
+  forever under request traffic.  Bound it (cap + drop counter, ring
+  buffer, TTL eviction) or mark a registration-time-bounded container
+  with ``# analyze: ignore[OBS003]``.
 """
 
 from __future__ import annotations
@@ -37,6 +53,26 @@ _OBS002_SUBDIRS = (
     os.path.join("mmlspark_tpu", "serve") + os.sep,
     os.path.join("mmlspark_tpu", "parallel") + os.sep,
 )
+# OBS003 applies to long-lived library state on the obs/serve layers
+# (the processes that hold per-request accounting across a fleet's
+# lifetime).
+_OBS003_SUBDIRS = (
+    os.path.join("mmlspark_tpu", "obs") + os.sep,
+    os.path.join("mmlspark_tpu", "serve") + os.sep,
+)
+# Parameter names that mark a value as request-derived: anything a
+# client can vary per request and therefore use to mint new container
+# keys without bound.
+_OBS003_REQ_HINTS = {
+    "rid", "request_id", "trace_id", "label", "labels", "item", "items",
+    "req", "request",
+}
+# Container-growing method calls on attribute-held containers.
+_OBS003_GROW_METHODS = {"setdefault", "append", "add"}
+# Evidence of bounding discipline (any hit anywhere in the function).
+_OBS003_EVICT_METHODS = {"pop", "popitem", "clear", "popleft"}
+_OBS003_GUARD_SUBSTRINGS = ("admit", "evict", "prune", "bounded")
+_OBS003_LIMIT_SUBSTRINGS = ("max", "cap", "limit", "bound")
 # A function visibly handling request-scoped work names one of these.
 _TRACE_PARAM_HINTS = {"item", "items", "rid", "trace_id", "request_id"}
 # Any of these keywords on the span call counts as propagation.
@@ -113,6 +149,151 @@ def _check_obs002(path: str, tree: ast.AST) -> list:
     return findings
 
 
+def _obs003_tainted_names(fn) -> set:
+    """The function's request-derived names: hinted parameters (including
+    ``*args``/``**kwargs`` names) plus one level of local assignments
+    whose right-hand side mentions a tainted name (``k = (name,
+    _label_key(labels))`` taints ``k``)."""
+    args = fn.args
+    params = [
+        a.arg
+        for a in (
+            list(args.posonlyargs) + list(args.args) + list(args.kwonlyargs)
+        )
+    ]
+    if args.vararg is not None:
+        params.append(args.vararg.arg)
+    if args.kwarg is not None:
+        params.append(args.kwarg.arg)
+    tainted = set(params) & _OBS003_REQ_HINTS
+    if not tainted:
+        return tainted
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Assign):
+            used = {
+                n.id for n in ast.walk(node.value) if isinstance(n, ast.Name)
+            }
+            if used & tainted:
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Name):
+                        tainted.add(tgt.id)
+                    elif isinstance(tgt, ast.Tuple):
+                        for el in tgt.elts:
+                            if isinstance(el, ast.Name):
+                                tainted.add(el.id)
+    return tainted
+
+
+def _obs003_mentions(node, tainted: set) -> bool:
+    return any(
+        isinstance(n, ast.Name) and n.id in tainted for n in ast.walk(node)
+    )
+
+
+def _obs003_has_bound(fn) -> bool:
+    """Any visible bounding discipline in the function body: a ``len()``
+    comparison, an eviction call (``pop``/``clear``/…), a threshold
+    compare against a ``max``/``cap``/``limit``-named value, a
+    ``deque(maxlen=…)``, or a call into an ``admit``/``evict``/``prune``
+    guard helper."""
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Compare):
+            for sub in ast.walk(node):
+                if (
+                    isinstance(sub, ast.Call)
+                    and isinstance(sub.func, ast.Name)
+                    and sub.func.id == "len"
+                ):
+                    return True
+                ident = None
+                if isinstance(sub, ast.Name):
+                    ident = sub.id
+                elif isinstance(sub, ast.Attribute):
+                    ident = sub.attr
+                if ident and any(
+                    s in ident.lower() for s in _OBS003_LIMIT_SUBSTRINGS
+                ):
+                    return True
+        elif isinstance(node, ast.Call):
+            if isinstance(node.func, ast.Attribute):
+                a = node.func.attr
+                if a in _OBS003_EVICT_METHODS or any(
+                    s in a.lower() for s in _OBS003_GUARD_SUBSTRINGS
+                ):
+                    return True
+            for kw in node.keywords:
+                if kw.arg == "maxlen":
+                    return True
+    return False
+
+
+def _obs003_grow_sites(fn, tainted: set):
+    """(lineno, description) for each attribute-container growth keyed by
+    a tainted value."""
+    sites = []
+    for node in ast.walk(fn):
+        # self.x[k] = v  /  self.x[k] += v with a tainted k
+        if isinstance(node, (ast.Assign, ast.AugAssign)):
+            targets = (
+                node.targets if isinstance(node, ast.Assign) else [node.target]
+            )
+            for tgt in targets:
+                if (
+                    isinstance(tgt, ast.Subscript)
+                    and isinstance(tgt.value, ast.Attribute)
+                    and _obs003_mentions(tgt.slice, tainted)
+                ):
+                    sites.append(
+                        (node.lineno, f"subscript-assign into "
+                                      f".{tgt.value.attr}")
+                    )
+        # self.x.setdefault(k, ...) / .append(v) / .add(v) with tainted arg
+        elif (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr in _OBS003_GROW_METHODS
+            and isinstance(node.func.value, ast.Attribute)
+            and node.args
+            and _obs003_mentions(node.args[0], tainted)
+        ):
+            sites.append(
+                (node.lineno,
+                 f".{node.func.value.attr}.{node.func.attr}(...)")
+            )
+    return sites
+
+
+def _check_obs003(path: str, tree: ast.AST) -> list:
+    rel = os.path.abspath(path)
+    if not any(sub in rel for sub in _OBS003_SUBDIRS):
+        return []
+    findings = []
+    for fn in ast.walk(tree):
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        tainted = _obs003_tainted_names(fn)
+        if not tainted:
+            continue
+        sites = _obs003_grow_sites(fn, tainted)
+        if not sites or _obs003_has_bound(fn):
+            continue
+        for lineno, what in sites:
+            findings.append(
+                Finding(
+                    path, lineno, "OBS003",
+                    f"{fn.name}() grows an attribute container "
+                    f"({what}) keyed by request-derived values "
+                    f"({', '.join(sorted(tainted & _OBS003_REQ_HINTS))}) "
+                    "with no visible bound — request traffic can grow "
+                    "this memory forever.  Cap it (size check + drop "
+                    "counter, ring buffer, or eviction), or mark a "
+                    "registration-time-bounded container with "
+                    "# analyze: ignore[OBS003]",
+                )
+            )
+    return findings
+
+
 def check_obs_file(path: str, tree=None) -> list:
     if tree is None:
         try:
@@ -137,6 +318,7 @@ def check_obs_file(path: str, tree=None) -> list:
                 )
             )
     findings.extend(_check_obs002(path, tree))
+    findings.extend(_check_obs003(path, tree))
     return findings
 
 

@@ -24,6 +24,15 @@ Usage::
 
 ``--smoke`` shrinks the run for CI and exits non-zero unless the serving
 invariants hold (zero 5xx incl. across the swap, non-empty /metrics).
+
+``--shift`` runs the model-quality drift scenario instead of the
+baseline/overload phases: steady traffic drawn from the training
+distribution (the drift monitor must stay silent), then covariate-shifted
+traffic (+3σ on every feature — the monitor must raise a drift alarm,
+drop a flight-recorder dump, and surface the alarm on /driftz and
+Prometheus).  Monitor cost is measured report-only by re-running the
+steady phase with the monitor disabled.  With ``--smoke`` the drift
+invariants are hard-asserted for CI.
 """
 
 from __future__ import annotations
@@ -176,6 +185,18 @@ def _open_loop(url, duration_s, target_rps, workers, seed, feature_rng):
     return out
 
 
+class _ShiftedRng:
+    """Feature source for the drift phase: the same normal draws the
+    closed-loop clients use, displaced by ``shift`` on every feature."""
+
+    def __init__(self, rng, shift):
+        self._rng = rng
+        self._shift = float(shift)
+
+    def normal(self, size=None):
+        return self._rng.normal(size=size) + self._shift
+
+
 # --------------------------------------------------------------------------
 # fixtures
 # --------------------------------------------------------------------------
@@ -229,6 +250,154 @@ def _seed_loop_server(model_path, batch_size=64):
 
 
 # --------------------------------------------------------------------------
+# drift scenario (--shift)
+# --------------------------------------------------------------------------
+def _drift_counts(monitor, route):
+    d = monitor.describe()["routes"].get(route, {})
+    counts = d.get("alarm_counts") or {}
+    return {
+        "drift": counts.get("feature_drift", 0) + counts.get("score_drift", 0),
+        "by_kind": dict(counts),
+        "feature_excess_psi_max": (d.get("feature_drift") or {}).get(
+            "excess_psi_max", 0.0),
+        "score_excess_psi": (d.get("score_drift") or {}).get(
+            "excess_psi", 0.0),
+    }
+
+
+def _run_shift(args, tmp, report) -> int:
+    from mmlspark_tpu import obs
+    from mmlspark_tpu.serve import ServingApp
+
+    flight_dir = os.path.join(tmp, "flight")
+    os.environ["MMLSPARK_TPU_OBS_FLIGHT_DIR"] = flight_dir
+    # every drift alarm should dump, even back-to-back in a short run
+    os.environ["MMLSPARK_TPU_OBS_FLIGHT_MIN_INTERVAL_S"] = "0"
+
+    v1 = _train_and_save(tmp, args.seed)
+    obs.reset()
+    app = ServingApp(max_wait_ms=10.0).start()
+    app.add_model("bench", path=v1)
+    url = f"{app.url}/models/bench/predict"
+    if app.monitor is None:
+        print("[serving] --shift needs the quality monitor "
+              "(unset MMLSPARK_TPU_SERVE_MONITOR)", file=sys.stderr)
+        app.stop()
+        return 1
+
+    # ---- steady phase: training-distribution traffic, monitor silent ---
+    steady = _closed_loop(
+        url, args.duration, args.clients, args.seed,
+        np.random.default_rng(args.seed + 1),
+    )
+    deadline = time.monotonic() + 5.0
+    while time.monotonic() < deadline and app.monitor._pending.qsize():
+        time.sleep(0.2)
+    time.sleep(1.5)  # one monitor eval tick past the last ingest
+    steady["quality"] = _drift_counts(app.monitor, "bench")
+    report["steady"] = steady
+    print(f"[serving] steady: {steady['throughput_rps']} rps  "
+          f"p50={steady['p50_ms']}ms  "
+          f"excess_psi={steady['quality']['feature_excess_psi_max']:.3f}  "
+          f"drift_alarms={steady['quality']['drift']}")
+
+    # ---- shifted phase: +3σ covariate shift, alarm must fire -----------
+    shifted = _closed_loop(
+        url, args.duration, args.clients, args.seed + 99,
+        _ShiftedRng(np.random.default_rng(args.seed + 2), 3.0),
+    )
+    deadline = time.monotonic() + 15.0
+    while time.monotonic() < deadline:
+        if app.monitor.alarm_count("bench") > steady["quality"]["drift"]:
+            break
+        time.sleep(0.5)
+    shifted["quality"] = _drift_counts(app.monitor, "bench")
+    report["shifted"] = shifted
+    print(f"[serving] shifted (+3σ): {shifted['throughput_rps']} rps  "
+          f"excess_psi={shifted['quality']['feature_excess_psi_max']:.3f}  "
+          f"drift_alarms={shifted['quality']['drift']}")
+
+    # ---- surfacing: /driftz, Prometheus, flight dump -------------------
+    with urllib.request.urlopen(app.url + "/driftz", timeout=10) as r:
+        driftz = json.loads(r.read().decode())
+    with urllib.request.urlopen(
+        app.url + "/metrics?format=prometheus", timeout=10
+    ) as r:
+        prom_body = r.read().decode()
+    report["driftz"] = driftz
+    report["prometheus_has_quality"] = (
+        "mmlspark_tpu_quality_feature_psi_max" in prom_body
+    )
+    try:
+        dumps = sorted(os.listdir(flight_dir))
+    except OSError:
+        dumps = []
+    report["flight_dumps"] = dumps
+    # the quality.*/slo.* series land under "obs" so the report feeds
+    # ``python -m tools.obs drift <this json>`` directly
+    report["obs"] = obs.snapshot()
+    app.stop()
+
+    # ---- monitor overhead, report-only ---------------------------------
+    obs.reset()
+    bare = ServingApp(max_wait_ms=10.0, monitor=False).start()
+    bare.add_model("bench", path=v1)
+    no_monitor = _closed_loop(
+        f"{bare.url}/models/bench/predict",
+        args.duration, args.clients, args.seed,
+        np.random.default_rng(args.seed + 1),
+    )
+    bare.stop()
+    report["no_monitor"] = no_monitor
+    if no_monitor["p50_ms"]:
+        report["monitor_p50_overhead_pct"] = round(
+            100.0 * (steady["p50_ms"] - no_monitor["p50_ms"])
+            / no_monitor["p50_ms"], 1,
+        )
+        print(f"[serving] monitor p50 overhead: "
+              f"{report['monitor_p50_overhead_pct']}% "
+              f"({no_monitor['p50_ms']}ms -> {steady['p50_ms']}ms)")
+
+    out = json.dumps(report, indent=2, default=str)
+    print(out)
+    if args.json_path:
+        with open(args.json_path, "w") as f:
+            f.write(out)
+
+    if args.smoke:
+        failures = []
+        if steady["fivexx"] or shifted["fivexx"]:
+            failures.append("drift phases saw 5xx responses")
+        if not (steady["ok"] and shifted["ok"]):
+            failures.append("a drift phase served zero requests")
+        if steady["quality"]["drift"]:
+            failures.append(
+                "drift alarm fired on UNSHIFTED traffic "
+                f"(kinds {steady['quality']['by_kind']})"
+            )
+        if shifted["quality"]["drift"] < 1:
+            failures.append(
+                "no drift alarm on +3σ shifted traffic "
+                f"(excess_psi="
+                f"{shifted['quality']['feature_excess_psi_max']:.3f})"
+            )
+        if not dumps:
+            failures.append("drift alarm produced no flight-recorder dump")
+        if not report["prometheus_has_quality"]:
+            failures.append("quality gauges missing from Prometheus export")
+        if driftz.get("status") != "ok" or "bench" not in (
+            driftz.get("routes") or {}
+        ):
+            failures.append("/driftz did not report the bench route")
+        if failures:
+            print("[serving] SHIFT SMOKE FAILED: " + "; ".join(failures),
+                  file=sys.stderr)
+            return 1
+        print("[serving] shift smoke OK")
+    return 0
+
+
+# --------------------------------------------------------------------------
 # main
 # --------------------------------------------------------------------------
 def main(argv=None) -> int:
@@ -244,6 +413,9 @@ def main(argv=None) -> int:
                     help="short CI run + hard-assert serving invariants")
     ap.add_argument("--no-baseline", action="store_true",
                     help="skip the seed-loop phase")
+    ap.add_argument("--shift", action="store_true",
+                    help="run the drift scenario (steady then +3σ shifted "
+                         "traffic) instead of the baseline/overload phases")
     args = ap.parse_args(argv)
     if args.smoke:
         args.duration = min(args.duration, 2.5)
@@ -259,7 +431,7 @@ def main(argv=None) -> int:
 
     obs.enable()
     report = {
-        "bench": "serving",
+        "bench": "serving-drift" if args.shift else "serving",
         "config": {
             "duration_s": args.duration,
             "clients": args.clients,
@@ -268,6 +440,8 @@ def main(argv=None) -> int:
             "smoke": args.smoke,
         },
     }
+    if args.shift:
+        return _run_shift(args, tmp, report)
     feature_rng = np.random.default_rng(args.seed + 1)
     v1 = _train_and_save(tmp, args.seed)
     v2 = _train_and_save(tmp, args.seed + 1)

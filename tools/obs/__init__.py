@@ -17,6 +17,10 @@ and ``blackbox.rank<R>.jsonl`` flight-recorder dumps.
 - ``python -m tools.obs trace <request_id>`` reconstructs one serving
   request's critical path (queue wait → batch-close wait → predict →
   reply) across the request/batch trace-id fan-in.
+- ``python -m tools.obs drift [--json] [path | --url URL]`` summarizes
+  the model-quality monitor's ``quality.*``/``slo.*`` series (drift
+  alarms, PSI gauges, burn rates) from any snapshot-bearing file, or
+  pulls a live app's ``GET /driftz`` for full per-feature detail.
 
 Pure stdlib — usable on a machine without jax installed.
 """
@@ -664,6 +668,184 @@ def diff_snapshots(a: dict, b: dict) -> dict:
             },
         }
     return out
+
+
+# ---------------------------------------------------------------------------
+# Model-quality drift reporting (drift [--json] [path | --url URL]).
+#
+# Two sources, one summary: a metrics snapshot's ``quality.*``/``slo.*``
+# series (offline — exports, snapshot JSONs, bench outputs), or a live
+# app's ``GET /driftz`` payload (full per-feature detail).
+# ---------------------------------------------------------------------------
+
+
+def _split_series(key: str):
+    """``name{k=v,...}`` -> (name, labels dict); plain names pass
+    through with no labels."""
+    if key.endswith("}") and "{" in key:
+        name, _, inner = key.partition("{")
+        labels = {}
+        for part in inner[:-1].split(","):
+            k, eq, v = part.partition("=")
+            if eq:
+                labels[k] = v
+        return name, labels
+    return key, {}
+
+
+def build_drift(snap: dict) -> dict:
+    """Per-model drift/SLO summary from a snapshot's quality.* and slo.*
+    series (see :func:`snapshot_from` for accepted inputs)."""
+    models: Dict[str, dict] = {}
+
+    def m(name: str) -> dict:
+        return models.setdefault(name, {
+            "alarms": {}, "clears": {}, "psi": {}, "burn": {},
+            "batches_dropped": 0.0,
+        })
+
+    for key, v in (snap.get("counters") or {}).items():
+        name, labels = _split_series(key)
+        model = labels.get("model", "?")
+        if name == "quality.drift_alarms":
+            m(model)["alarms"][labels.get("kind", "?")] = float(v)
+        elif name == "quality.drift_clears":
+            m(model)["clears"][labels.get("kind", "?")] = float(v)
+        elif name == "quality.batches_dropped":
+            m(model)["batches_dropped"] += float(v)
+    for key, v in (snap.get("gauges") or {}).items():
+        name, labels = _split_series(key)
+        model = labels.get("model", "?")
+        if name in ("quality.feature_psi_max", "quality.score_psi"):
+            m(model)["psi"][name.split(".", 1)[1]] = float(v)
+        elif name.startswith("slo.") and name.endswith("_burn"):
+            kind = name[len("slo."):-len("_burn")]
+            m(model)["burn"].setdefault(kind, {})[
+                labels.get("window", "?")] = float(v)
+    return {
+        "models": models,
+        "total_alarms": sum(
+            sum(e["alarms"].values()) for e in models.values()
+        ),
+    }
+
+
+def render_drift(d: dict) -> str:
+    out = [
+        f"obs drift — {len(d['models'])} model route(s), "
+        f"{d['total_alarms']:g} alarm transition(s)"
+    ]
+    if not d["models"]:
+        out.append(
+            "  (no quality.* series in this snapshot — monitor disabled "
+            "or no traffic served)"
+        )
+    for name in sorted(d["models"]):
+        e = d["models"][name]
+        out.append("")
+        out.append(f"  model {name}:")
+        for k in sorted(e["psi"]):
+            out.append(f"    {k:<24} {e['psi'][k]:.4f}")
+        for kind in sorted(e["burn"]):
+            w = e["burn"][kind]
+            out.append(
+                f"    {kind + '_burn':<24} fast={w.get('fast', 0.0):.3f} "
+                f"slow={w.get('slow', 0.0):.3f}"
+            )
+        for k in sorted(e["alarms"]):
+            fired, cleared = e["alarms"][k], e["clears"].get(k, 0.0)
+            state = "CLEARED" if cleared >= fired else "ACTIVE"
+            out.append(f"    alarm {k:<18} x{fired:g} ({state})")
+        if e["batches_dropped"]:
+            out.append(
+                f"    {'batches_dropped':<24} {e['batches_dropped']:g}"
+            )
+    return "\n".join(out)
+
+
+def fetch_driftz(url: str) -> dict:
+    """GET a live app's /driftz (``url`` may be the app base or the full
+    /driftz path)."""
+    import urllib.request
+
+    base = url.rstrip("/")
+    if not base.endswith("/driftz"):
+        base += "/driftz"
+    with urllib.request.urlopen(base, timeout=10) as r:
+        return json.loads(r.read().decode("utf-8"))
+
+
+def render_driftz(payload: dict) -> str:
+    status = payload.get("status")
+    if "routes" not in payload:
+        return f"obs drift — /driftz status: {status or '?'}"
+    routes = payload.get("routes") or {}
+    out = [
+        f"obs drift — /driftz ({status or 'ok'}), {len(routes)} route(s), "
+        f"{payload.get('dropped_batches', 0)} dropped batch(es)"
+    ]
+    for name in sorted(routes):
+        r = routes[name]
+        ref = r.get("reference")
+        out.append("")
+        out.append(
+            f"  route {name} (version {r.get('version')}, reference: "
+            + (f"{ref['n_rows']} rows, {ref['num_features']} features)"
+               if ref else "none — SLO tracking only)")
+        )
+        active = r.get("alarms_active") or {}
+        out.append(
+            "    alarms active: "
+            + (", ".join(sorted(active)) if active else "none")
+        )
+        counts = r.get("alarm_counts") or {}
+        if counts:
+            out.append(
+                "    alarm transitions: "
+                + ", ".join(f"{k} x{v}" for k, v in sorted(counts.items()))
+            )
+        if r.get("stale_batches"):
+            out.append(f"    stale batches (swap in flight): "
+                       f"{r['stale_batches']}")
+        fd = r.get("feature_drift")
+        if fd:
+            out.append(
+                f"    feature drift: live_rows={fd.get('live_rows', 0):.0f} "
+                f"excess_psi_max={fd.get('excess_psi_max', 0.0):.4f}"
+            )
+            for t in (fd.get("top") or [])[:5]:
+                out.append(
+                    f"      feature {t['feature']:<5} "
+                    f"excess_psi={t['excess_psi']:.4f} "
+                    f"(raw {t['psi']:.4f}, bias {t['psi_bias']:.4f}) "
+                    f"missing={t['missing_rate']:.3f}"
+                )
+        sd = r.get("score_drift")
+        if sd:
+            line = (
+                f"    score drift:   live_rows={sd.get('live_rows', 0):.0f} "
+                f"excess_psi={sd.get('excess_psi', 0.0):.4f}"
+            )
+            if "class_mix_psi" in sd:
+                line += f" class_mix_psi={sd['class_mix_psi']:.4f}"
+            out.append(line)
+            rec = sd.get("recent")
+            if rec:
+                out.append(
+                    f"      recent scores: p50={rec['p50']:.4g} "
+                    f"p95={rec['p95']:.4g} (n={rec['count']})"
+                )
+        slo = r.get("slo") or {}
+        for kind in ("availability", "latency"):
+            k = slo.get(kind)
+            if k:
+                alert = (slo.get("alerts") or {}).get(kind)
+                out.append(
+                    f"    slo {kind:<12} burn fast={k['fast']:.3f} "
+                    f"slow={k['slow']:.3f}"
+                    + ("  ** ALERT **" if alert else "")
+                )
+    return "\n".join(out)
 
 
 def render_diff(diff: dict, label_a: str = "A", label_b: str = "B") -> str:

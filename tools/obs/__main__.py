@@ -8,6 +8,9 @@ for ``MMLSPARK_TPU_OBS`` JSONL exports and flight-recorder blackboxes.
   vs collective-wait attribution.
 - ``trace <request_id> [paths...]`` — reconstruct one serving request's
   critical path.
+- ``drift [--json] [path | --url URL]`` — summarize model-quality drift
+  alarms, PSI gauges, and SLO burn rates from a snapshot-bearing file or
+  a live app's ``GET /driftz``.
 
 Exit 0 on success (even for an empty export), 2 when the named files (or
 the traced request) cannot be found — so CI smoke steps fail loudly if
@@ -21,6 +24,7 @@ import json
 import sys
 
 from tools.obs import (
+    build_drift,
     build_report,
     build_timeline,
     build_trace,
@@ -28,7 +32,10 @@ from tools.obs import (
     diff_snapshots,
     discover_blackbox,
     discover_files,
+    fetch_driftz,
     render_diff,
+    render_drift,
+    render_driftz,
     render_text,
     render_timeline,
     render_trace,
@@ -101,6 +108,37 @@ def _cmd_timeline(ns) -> int:
     return _emit(render_timeline(tl, max_events=ns.max_events))
 
 
+def _cmd_drift(ns) -> int:
+    if ns.url:
+        try:
+            payload = fetch_driftz(ns.url)
+        except (OSError, ValueError) as e:
+            print(f"tools.obs drift: GET {ns.url} failed: {e}",
+                  file=sys.stderr)
+            return 2
+        if ns.json:
+            return _emit(json.dumps(payload, indent=2, sort_keys=True,
+                                    default=str))
+        return _emit(render_driftz(payload))
+    path = ns.path or default_path()
+    if not path:
+        print(
+            "tools.obs drift: no path given and MMLSPARK_TPU_OBS unset "
+            "(or pass --url for a live app)",
+            file=sys.stderr,
+        )
+        return 2
+    try:
+        snap = snapshot_from(path)
+    except (OSError, ValueError) as e:
+        print(f"tools.obs drift: {e}", file=sys.stderr)
+        return 2
+    d = build_drift(snap)
+    if ns.json:
+        return _emit(json.dumps(d, indent=2, sort_keys=True, default=str))
+    return _emit(render_drift(d))
+
+
 def _cmd_trace(ns) -> int:
     paths = _default_paths(ns.paths)
     if not paths:
@@ -154,6 +192,25 @@ def main(argv=None) -> int:
     tml.add_argument("--max-events", type=int, default=200)
     tml.add_argument("--json", action="store_true", help="machine output")
 
+    drf = sub.add_parser(
+        "drift",
+        help="summarize model-quality drift/SLO series from a snapshot "
+             "or a live app's /driftz",
+    )
+    drf.add_argument(
+        "path",
+        nargs="?",
+        default=None,
+        help="export, snapshot JSON, or bench output JSON "
+             "(default: $MMLSPARK_TPU_OBS)",
+    )
+    drf.add_argument(
+        "--url",
+        default=None,
+        help="serving app base URL (or full /driftz URL) to query live",
+    )
+    drf.add_argument("--json", action="store_true", help="machine output")
+
     trc = sub.add_parser(
         "trace", help="reconstruct one serving request's critical path"
     )
@@ -170,6 +227,8 @@ def main(argv=None) -> int:
         return _cmd_report(ns)
     if ns.cmd == "timeline":
         return _cmd_timeline(ns)
+    if ns.cmd == "drift":
+        return _cmd_drift(ns)
     return _cmd_trace(ns)
 
 
