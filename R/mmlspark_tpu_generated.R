@@ -1883,6 +1883,7 @@ ml_k_n_n_model <- function(
 #' @param features_col The name of the features column
 #' @param grow_policy lossguide (leaf-wise; auto-batches splits on TPU — see splitBatch) | lossguide_exact (LightGBM's one-split-per-pass sequence, never batched) | depthwise (level-batched histograms, one pass per level)
 #' @param hist_merge Distributed histogram-merge strategy: auto (reduce_scatter when the mesh/feature shape profits — the benchmarked default, see BASELINE.md) | allreduce (every device receives the full merged histogram) | reduce_scatter (each device receives only its feature slice + a best-split allgather)
+#' @param hist_quantize Quantized training wire/accumulator: off (default — bitwise the f32 path) | on (resolved to int16) | int16 | int32.  Quantizes per-row grad/hess to ±127 buckets with seeded stochastic rounding, accumulates int32 histograms and merges shards over an integer collective wire (f32 winner refinement keeps AUC parity); mutually exclusive with hist_psum_dtype=bfloat16
 #' @param init_score_col Initial (margin) score column
 #' @param is_provide_training_metric Record metrics on training data too
 #' @param is_unbalance Reweight unbalanced binary labels
@@ -1937,6 +1938,7 @@ ml_light_g_b_m_classification_model <- function(
     features_col = "features",
     grow_policy = "lossguide",
     hist_merge = "auto",
+    hist_quantize = "off",
     init_score_col = NULL,
     is_provide_training_metric = FALSE,
     is_unbalance = FALSE,
@@ -1990,6 +1992,7 @@ ml_light_g_b_m_classification_model <- function(
     features_col = "featuresCol",
     grow_policy = "growPolicy",
     hist_merge = "histMerge",
+    hist_quantize = "histQuantize",
     init_score_col = "initScoreCol",
     is_provide_training_metric = "isProvideTrainingMetric",
     is_unbalance = "isUnbalance",
@@ -2050,6 +2053,7 @@ ml_light_g_b_m_classification_model <- function(
 #' @param features_col The name of the features column
 #' @param grow_policy lossguide (leaf-wise; auto-batches splits on TPU — see splitBatch) | lossguide_exact (LightGBM's one-split-per-pass sequence, never batched) | depthwise (level-batched histograms, one pass per level)
 #' @param hist_merge Distributed histogram-merge strategy: auto (reduce_scatter when the mesh/feature shape profits — the benchmarked default, see BASELINE.md) | allreduce (every device receives the full merged histogram) | reduce_scatter (each device receives only its feature slice + a best-split allgather)
+#' @param hist_quantize Quantized training wire/accumulator: off (default — bitwise the f32 path) | on (resolved to int16) | int16 | int32.  Quantizes per-row grad/hess to ±127 buckets with seeded stochastic rounding, accumulates int32 histograms and merges shards over an integer collective wire (f32 winner refinement keeps AUC parity); mutually exclusive with hist_psum_dtype=bfloat16
 #' @param init_score_col Initial (margin) score column
 #' @param is_provide_training_metric Record metrics on training data too
 #' @param is_unbalance Reweight unbalanced binary labels
@@ -2103,6 +2107,7 @@ ml_light_g_b_m_classifier <- function(
     features_col = "features",
     grow_policy = "lossguide",
     hist_merge = "auto",
+    hist_quantize = "off",
     init_score_col = NULL,
     is_provide_training_metric = FALSE,
     is_unbalance = FALSE,
@@ -2155,6 +2160,7 @@ ml_light_g_b_m_classifier <- function(
     features_col = "featuresCol",
     grow_policy = "growPolicy",
     hist_merge = "histMerge",
+    hist_quantize = "histQuantize",
     init_score_col = "initScoreCol",
     is_provide_training_metric = "isProvideTrainingMetric",
     is_unbalance = "isUnbalance",
@@ -2217,6 +2223,7 @@ ml_light_g_b_m_classifier <- function(
 #' @param group_col Query group column
 #' @param grow_policy lossguide (leaf-wise; auto-batches splits on TPU — see splitBatch) | lossguide_exact (LightGBM's one-split-per-pass sequence, never batched) | depthwise (level-batched histograms, one pass per level)
 #' @param hist_merge Distributed histogram-merge strategy: auto (reduce_scatter when the mesh/feature shape profits — the benchmarked default, see BASELINE.md) | allreduce (every device receives the full merged histogram) | reduce_scatter (each device receives only its feature slice + a best-split allgather)
+#' @param hist_quantize Quantized training wire/accumulator: off (default — bitwise the f32 path) | on (resolved to int16) | int16 | int32.  Quantizes per-row grad/hess to ±127 buckets with seeded stochastic rounding, accumulates int32 histograms and merges shards over an integer collective wire (f32 winner refinement keeps AUC parity); mutually exclusive with hist_psum_dtype=bfloat16
 #' @param init_score_col Initial (margin) score column
 #' @param is_provide_training_metric Record metrics on training data too
 #' @param is_unbalance Reweight unbalanced binary labels
@@ -2272,6 +2279,7 @@ ml_light_g_b_m_ranker <- function(
     group_col = "group",
     grow_policy = "lossguide",
     hist_merge = "auto",
+    hist_quantize = "off",
     init_score_col = NULL,
     is_provide_training_metric = FALSE,
     is_unbalance = FALSE,
@@ -2326,6 +2334,7 @@ ml_light_g_b_m_ranker <- function(
     group_col = "groupCol",
     grow_policy = "growPolicy",
     hist_merge = "histMerge",
+    hist_quantize = "histQuantize",
     init_score_col = "initScoreCol",
     is_provide_training_metric = "isProvideTrainingMetric",
     is_unbalance = "isUnbalance",
@@ -2387,6 +2396,7 @@ ml_light_g_b_m_ranker <- function(
 #' @param features_col The name of the features column
 #' @param grow_policy lossguide (leaf-wise; auto-batches splits on TPU — see splitBatch) | lossguide_exact (LightGBM's one-split-per-pass sequence, never batched) | depthwise (level-batched histograms, one pass per level)
 #' @param hist_merge Distributed histogram-merge strategy: auto (reduce_scatter when the mesh/feature shape profits — the benchmarked default, see BASELINE.md) | allreduce (every device receives the full merged histogram) | reduce_scatter (each device receives only its feature slice + a best-split allgather)
+#' @param hist_quantize Quantized training wire/accumulator: off (default — bitwise the f32 path) | on (resolved to int16) | int16 | int32.  Quantizes per-row grad/hess to ±127 buckets with seeded stochastic rounding, accumulates int32 histograms and merges shards over an integer collective wire (f32 winner refinement keeps AUC parity); mutually exclusive with hist_psum_dtype=bfloat16
 #' @param init_score_col Initial (margin) score column
 #' @param is_provide_training_metric Record metrics on training data too
 #' @param is_unbalance Reweight unbalanced binary labels
@@ -2438,6 +2448,7 @@ ml_light_g_b_m_ranker_model <- function(
     features_col = "features",
     grow_policy = "lossguide",
     hist_merge = "auto",
+    hist_quantize = "off",
     init_score_col = NULL,
     is_provide_training_metric = FALSE,
     is_unbalance = FALSE,
@@ -2488,6 +2499,7 @@ ml_light_g_b_m_ranker_model <- function(
     features_col = "featuresCol",
     grow_policy = "growPolicy",
     hist_merge = "histMerge",
+    hist_quantize = "histQuantize",
     init_score_col = "initScoreCol",
     is_provide_training_metric = "isProvideTrainingMetric",
     is_unbalance = "isUnbalance",
@@ -2546,6 +2558,7 @@ ml_light_g_b_m_ranker_model <- function(
 #' @param features_col The name of the features column
 #' @param grow_policy lossguide (leaf-wise; auto-batches splits on TPU — see splitBatch) | lossguide_exact (LightGBM's one-split-per-pass sequence, never batched) | depthwise (level-batched histograms, one pass per level)
 #' @param hist_merge Distributed histogram-merge strategy: auto (reduce_scatter when the mesh/feature shape profits — the benchmarked default, see BASELINE.md) | allreduce (every device receives the full merged histogram) | reduce_scatter (each device receives only its feature slice + a best-split allgather)
+#' @param hist_quantize Quantized training wire/accumulator: off (default — bitwise the f32 path) | on (resolved to int16) | int16 | int32.  Quantizes per-row grad/hess to ±127 buckets with seeded stochastic rounding, accumulates int32 histograms and merges shards over an integer collective wire (f32 winner refinement keeps AUC parity); mutually exclusive with hist_psum_dtype=bfloat16
 #' @param init_score_col Initial (margin) score column
 #' @param is_provide_training_metric Record metrics on training data too
 #' @param is_unbalance Reweight unbalanced binary labels
@@ -2597,6 +2610,7 @@ ml_light_g_b_m_regression_model <- function(
     features_col = "features",
     grow_policy = "lossguide",
     hist_merge = "auto",
+    hist_quantize = "off",
     init_score_col = NULL,
     is_provide_training_metric = FALSE,
     is_unbalance = FALSE,
@@ -2647,6 +2661,7 @@ ml_light_g_b_m_regression_model <- function(
     features_col = "featuresCol",
     grow_policy = "growPolicy",
     hist_merge = "histMerge",
+    hist_quantize = "histQuantize",
     init_score_col = "initScoreCol",
     is_provide_training_metric = "isProvideTrainingMetric",
     is_unbalance = "isUnbalance",
@@ -2705,6 +2720,7 @@ ml_light_g_b_m_regression_model <- function(
 #' @param features_col The name of the features column
 #' @param grow_policy lossguide (leaf-wise; auto-batches splits on TPU — see splitBatch) | lossguide_exact (LightGBM's one-split-per-pass sequence, never batched) | depthwise (level-batched histograms, one pass per level)
 #' @param hist_merge Distributed histogram-merge strategy: auto (reduce_scatter when the mesh/feature shape profits — the benchmarked default, see BASELINE.md) | allreduce (every device receives the full merged histogram) | reduce_scatter (each device receives only its feature slice + a best-split allgather)
+#' @param hist_quantize Quantized training wire/accumulator: off (default — bitwise the f32 path) | on (resolved to int16) | int16 | int32.  Quantizes per-row grad/hess to ±127 buckets with seeded stochastic rounding, accumulates int32 histograms and merges shards over an integer collective wire (f32 winner refinement keeps AUC parity); mutually exclusive with hist_psum_dtype=bfloat16
 #' @param init_score_col Initial (margin) score column
 #' @param is_provide_training_metric Record metrics on training data too
 #' @param is_unbalance Reweight unbalanced binary labels
@@ -2757,6 +2773,7 @@ ml_light_g_b_m_regressor <- function(
     features_col = "features",
     grow_policy = "lossguide",
     hist_merge = "auto",
+    hist_quantize = "off",
     init_score_col = NULL,
     is_provide_training_metric = FALSE,
     is_unbalance = FALSE,
@@ -2808,6 +2825,7 @@ ml_light_g_b_m_regressor <- function(
     features_col = "featuresCol",
     grow_policy = "growPolicy",
     hist_merge = "histMerge",
+    hist_quantize = "histQuantize",
     init_score_col = "initScoreCol",
     is_provide_training_metric = "isProvideTrainingMetric",
     is_unbalance = "isUnbalance",

@@ -39,7 +39,11 @@ from mmlspark_tpu.engine.tree import (
     predict_tree_leaf_binned,
 )
 from mmlspark_tpu.ops.binning import BinMapper
-from mmlspark_tpu.ops.histogram import DEFAULT_CHUNK
+from mmlspark_tpu.ops.histogram import (
+    DEFAULT_CHUNK,
+    quantize_channel_scales,
+    quantize_wire_plan,
+)
 from mmlspark_tpu.ops.objectives import LambdaRank, Objective, get_objective
 
 
@@ -160,6 +164,20 @@ class TrainConfig:
     # to shard, allreduce otherwise).  Ignored by the voting and
     # feature-parallel learners, which have their own comm patterns.
     hist_merge: str = "auto"
+    # Quantized training (ISSUE 9; NeurIPS'22 LightGBM quantized-training
+    # lineage): "off" (default — bitwise-identical to the pre-quantize
+    # path), "int16"/"int32" = quantize per-row grad/hess to ±127 buckets
+    # with per-iteration max-abs scales and seeded stochastic rounding,
+    # accumulate histograms as int32, and merge shards over an INTEGER
+    # psum/psum_scatter wire of this dtype ("int16" needs attested
+    # row-count headroom — ops.histogram.quantize_wire_plan picks the
+    # pre-wire shift; int sums are associative, so allreduce and
+    # reduce_scatter merges agree bit-for-bit).  "on" = resolved to
+    # "int16" by resolve_auto_config.  Supersedes hist_psum_dtype on this
+    # path: explicit bfloat16 + quantize is rejected (one coherent wire).
+    # Winning splits get an f32 refinement pass, and leaf values come
+    # from exact f32 sums, so AUC holds parity with the f32 path.
+    hist_quantize: str = "off"
     # Histogram resolution of the process_local (device-eval) AUC: its
     # ~1/bins quantization can flip improvement comparisons near a plateau,
     # so distributed early stopping on metric="auc" may stop at a different
@@ -1085,6 +1103,37 @@ def resolve_auto_config(
         cfg = dataclasses.replace(
             cfg, hist_merge="reduce_scatter" if use_rs else "allreduce"
         )
+    if cfg.hist_quantize not in ("off", "on", "int16", "int32"):
+        raise ValueError(
+            f"hist_quantize must be 'off', 'on', 'int16' or 'int32', got "
+            f"{cfg.hist_quantize!r}"
+        )
+    if cfg.hist_quantize != "off":
+        if cfg.hist_psum_dtype not in ("float32",):
+            # ONE coherent wire: quantized merges travel as integers, so a
+            # float wire dtype request on the same path is a contradiction,
+            # not a preference to silently override.
+            raise ValueError(
+                "hist_quantize and hist_psum_dtype="
+                f"{cfg.hist_psum_dtype!r} both rewire the histogram merge; "
+                "pick ONE wire — quantized histograms already merge over "
+                "the int16/int32 wire (strictly less traffic than bf16), "
+                "so drop hist_psum_dtype or set hist_quantize='off'"
+            )
+        if cfg.tree_learner in (
+            "voting", "voting_parallel", "feature", "feature_parallel"
+        ):
+            # Voting merges elected SLICES and feature-parallel never
+            # merges histograms at all — neither carries the full-histogram
+            # wire the integer path compresses, and their winner exchanges
+            # assume f32 local histograms.
+            raise ValueError(
+                f"hist_quantize is not supported with tree_learner="
+                f"{cfg.tree_learner!r}; use the data-parallel or serial "
+                "learner"
+            )
+        if cfg.hist_quantize == "on":
+            cfg = dataclasses.replace(cfg, hist_quantize="int16")
     return cfg
 
 
@@ -1815,6 +1864,19 @@ def _train_impl(
         # The winner exchange lives in the windowed grower; one split per
         # pass reproduces LightGBM's exact leaf-wise sequence there.
         split_batch = 1
+    quantize_on = cfg.hist_quantize != "off"
+    if quantize_on:
+        # Wire plan from the PADDED GLOBAL row count (the worst-case row
+        # total any merged bin can see): picks the pre-wire right-shift
+        # that fits partial sums in the wire dtype, and raises on int32
+        # ACCUMULATOR overflow (per-shard rows × 127 must fit 2³¹) —
+        # trips at config time, never silently wraps on device.
+        quantize_shift = quantize_wire_plan(
+            n + n_pad, cfg.hist_quantize,
+            num_shards=D if mesh is not None else 1,
+        )
+    else:
+        quantize_shift = 0
     gcfg = GrowConfig(
         num_bins=B,
         num_leaves=cfg.num_leaves,
@@ -1830,6 +1892,8 @@ def _train_impl(
         hist_precision=cfg.hist_precision,
         hist_psum_dtype=cfg.hist_psum_dtype,
         hist_merge="reduce_scatter" if reduce_scatter else "allreduce",
+        hist_quantize=cfg.hist_quantize,
+        quantize_shift=quantize_shift,
         grow_policy=grow_policy,
         split_batch=split_batch,
         categorical_features=tuple(int(f) for f in cfg.categorical_feature),
@@ -1860,6 +1924,22 @@ def _train_impl(
         # observed for a 63-leaf/256-bin tree on v5e), while lax.map
         # compiles the body once and runs the K trees sequentially — which
         # matches real execution anyway.
+        if quantize_on:
+            # Quantized twin: per-class SR keys and (2,) grad/hess scales
+            # ride the lax.map xs alongside the class gradients.
+            def grow_all_q(bins_a, grad_a, hess_a, bag_a, fmask_a,
+                           qkeys_a, qscales_a):
+                def one(args):
+                    g, h, fm, qk, qs = args
+                    return grow_tree_auto(gcfg_, bins_a, g, h, bag_a, fm,
+                                          qk, qs)
+
+                return jax.lax.map(
+                    one, (grad_a, hess_a, fmask_a, qkeys_a, qscales_a)
+                )
+
+            return grow_all_q
+
         def grow_all(bins_a, grad_a, hess_a, bag_a, fmask_a):
             def one(args):
                 g, h, fm = args
@@ -1907,10 +1987,13 @@ def _train_impl(
         from mmlspark_tpu.parallel.mesh import shard_map_compat
 
         tree_spec = Tree(*([P()] * len(Tree._fields)))
+        # Quantized runs append replicated (K, 2) SR keys + (K, 2) scales
+        # (global max-abs, computed once pre-shard — no pmax needed).
+        q_specs = (P(None, None), P(None, None)) if quantize_on else ()
         grow = shard_map_compat(
             _grow_classes(dataclasses.replace(gcfg, axis_name=DATA_AXIS)),
             mesh=mesh,
-            in_specs=(P(DATA_AXIS, None), P(None, DATA_AXIS), P(None, DATA_AXIS), P(DATA_AXIS), P(None, None)),
+            in_specs=(P(DATA_AXIS, None), P(None, DATA_AXIS), P(None, DATA_AXIS), P(DATA_AXIS), P(None, None)) + q_specs,
             out_specs=(tree_spec, P(None, DATA_AXIS)),
             check_vma=False,
         )
@@ -1957,6 +2040,20 @@ def _train_impl(
             )[0]
         )(tree.leaf_value, leaf_ids)
 
+    def _quantize_inputs(grad, hess, bag, key):
+        # Per-iteration channel scales over the GLOBAL bagged batch —
+        # grad/hess are still the full (sharded) arrays here, outside
+        # shard_map, so jnp.max IS the global max-abs and no pmax is
+        # needed.  One SR key per class, folded off the iteration key with
+        # a fixed tag so the stochastic-rounding stream is decoupled from
+        # the bagging/feature-sampling streams (same-seed reruns are
+        # bitwise identical; unrelated knobs don't perturb rounding).
+        qscales = jax.vmap(
+            lambda g, h: quantize_channel_scales(g, h, bag)
+        )(grad, hess)  # (K, 2)
+        qkeys = jax.random.split(jax.random.fold_in(key, 0x51AB), K)
+        return qkeys, qscales
+
     # Device data enters the jitted step as ARGUMENTS, never closure
     # captures: closed-over arrays become jaxpr constants and XLA spends
     # minutes constant-folding through the 10s-of-MB binned matrix (75s →
@@ -1977,8 +2074,14 @@ def _train_impl(
         else:
             bag = bag_in
         fmask = jax.vmap(_fmask_one)(jax.random.split(fkey, K))
-        tree, leaf_ids = grow(bins_a, grad, hess, bag, fmask)
-        return tree, _leaf_delta(tree, leaf_ids)
+        if quantize_on:
+            qkeys, qscales = _quantize_inputs(grad, hess, bag, key)
+            tree, leaf_ids = grow(bins_a, grad, hess, bag, fmask,
+                                  qkeys, qscales)
+        else:
+            qscales = None
+            tree, leaf_ids = grow(bins_a, grad, hess, bag, fmask)
+        return tree, _leaf_delta(tree, leaf_ids), qscales
 
     # LightGBM bagging semantics: a bag is drawn at iterations where
     # ``it % bagging_freq == 0`` and *reused* until the next draw.
@@ -2367,7 +2470,14 @@ def _train_impl(
                     fmask = jax.vmap(_fmask_one)(
                         jax.random.split(fkey, K)
                     )
-                    tree, leaf_ids = grow(bins_a, grad, hess, bag, fmask)
+                    if quantize_on:
+                        qkeys, qscales = _quantize_inputs(
+                            grad, hess, bag, key
+                        )
+                        tree, leaf_ids = grow(bins_a, grad, hess, bag,
+                                              fmask, qkeys, qscales)
+                    else:
+                        tree, leaf_ids = grow(bins_a, grad, hess, bag, fmask)
                     delta = _leaf_delta(tree, leaf_ids)
                     if dart_scan:
                         # DART normalization (legacy-loop semantics): new
@@ -2441,10 +2551,13 @@ def _train_impl(
                         ys_v = tuple(stats_out)
                     else:
                         ys_v = vscores_c
+                    # quantized runs stack the per-iteration (K, 2) scales
+                    # so the host can emit train.grad/hess_scale gauges
+                    out = (tree, ys_v) + ((qscales,) if quantize_on else ())
                     if dart_scan:
                         car = (scores_c, vscores_c, P, tuple(new_pvs), wts)
-                        return car, (tree, ys_v)
-                    return (scores_c, vscores_c), (tree, ys_v)
+                        return car, out
+                    return (scores_c, vscores_c), out
 
                 return jax.lax.scan(
                     body, carry, (xs_c,) + tuple(dart_xs)
@@ -2644,13 +2757,17 @@ def _train_impl(
                 "booster.scan_dispatch",
                 chunk=chunk_idx, iters=c, cold=(chunk_idx == 0),
             ):
-                carry, (trees_c, vsnap_c) = scan_chunk(
+                carry, scan_ys = scan_chunk(
                     bins_dev, y_dev, w_dev, valid_mask, init_scores_dev,
                     vbins_t, vaux_t, carry,
                     jax.lax.slice(xs_dev, (n_done, 0), (n_done + c, 5))
                     if c < n_iter else xs_dev,
                     *dart_xs,
                 )
+            if quantize_on:
+                trees_c, vsnap_c, qsc_c = scan_ys
+            else:
+                trees_c, vsnap_c = scan_ys
             tree_chunks.append(trees_c)
             if ckpt_path is not None:
                 _write_checkpoint(trees_c)
@@ -2694,6 +2811,17 @@ def _train_impl(
                     obs.record_span(
                         "booster.iteration", per_it, it=j, derived=True
                     )
+                if quantize_on:
+                    qsc_np = np.asarray(jax.device_get(qsc_c))  # (c, K, 2)
+                    for jq, j in enumerate(range(n_done - c, n_done)):
+                        obs.gauge(
+                            "train.grad_scale",
+                            float(qsc_np[jq, :, 0].max()), it=j,
+                        )
+                        obs.gauge(
+                            "train.hess_scale",
+                            float(qsc_np[jq, :, 1].max()), it=j,
+                        )
             chunk_idx += 1
 
         kept = (stop_at + 1) if stop_at is not None else n_iter
@@ -2793,9 +2921,13 @@ def _train_impl(
         else:
             train_scores = scores
 
-        tree, delta = iteration(
+        tree, delta, qsc = iteration(
             bins_dev, y_dev, w_dev, valid_mask, train_scores, sub, current_bag
         )
+        if qsc is not None and obs.enabled():
+            qsc_np = np.asarray(qsc)  # (K, 2)
+            obs.gauge("train.grad_scale", float(qsc_np[:, 0].max()), it=it)
+            obs.gauge("train.hess_scale", float(qsc_np[:, 1].max()), it=it)
 
         # boost_from_average bias folding into tree 0 (LightGBM AddBias).
         # Running scores already start at the init value, so the in-loop

@@ -44,7 +44,13 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
-from mmlspark_tpu.ops.histogram import build_histogram, build_histogram_by_leaf
+from mmlspark_tpu.ops.histogram import (
+    COUNT_SCALE,
+    HistQuantize,
+    build_histogram,
+    build_histogram_by_leaf,
+    quantize_hist_vals,
+)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -142,6 +148,22 @@ class GrowConfig:
     # small policy delay (the k-th split is chosen before the first k-1
     # splits' children are scored) for k-fold fewer passes.
     split_batch: int = 0
+    # Quantized histogram training (ISSUE 9; LightGBM quantized training,
+    # NeurIPS 2022).  "off": the f32 path, bitwise-identical to before the
+    # feature existed (all quantize logic is statically gated on this
+    # field).  "int16"/"int32": per-row grad/hess quantize to int16
+    # buckets with per-iteration max-abs scales + seeded stochastic
+    # rounding, histograms accumulate int32, and the cross-shard merge
+    # rides an integer wire of this dtype.  Split selection runs on the
+    # dequantized totals; each pass's WINNERS get an exact f32
+    # refinement re-accumulation, and final leaf values are always
+    # computed from raw f32 grad/hess.  resolve_auto_config validates
+    # the value ("on" → "int16") and rejects voting/feature-parallel
+    # and bf16-wire combinations before a GrowConfig is ever built.
+    hist_quantize: str = "off"
+    # Static pre-wire right-shift from ops.histogram.quantize_wire_plan
+    # (0 when the worst-case global bin total already fits the wire).
+    quantize_shift: int = 0
     # Use one-hot dot_general contractions for the final per-leaf stats
     # (fast lowering: ~0.2ms vs ~1.8ms for the scatter-add at 262k rows)
     # at the cost of materializing an (L, n) f32 operand per class.  The
@@ -168,6 +190,10 @@ class GrowConfig:
     @property
     def feature_parallel_active(self) -> bool:
         return self.feature_parallel and self.axis_name is not None
+
+    @property
+    def quantize_active(self) -> bool:
+        return self.hist_quantize != "off"
 
     @property
     def reduce_scatter_active(self) -> bool:
@@ -505,6 +531,32 @@ def _candidate_matrix(cfg: GrowConfig, hists, leaf_stats, feat_mask):
     return gain, t, d
 
 
+def _refine_candidates(cfg: GrowConfig, ref_hist, ref_stats, is_cat_w):
+    """Re-score already-CHOSEN (leaf, feature) winners on exact f32 columns
+    (ISSUE 9 quantized training's refinement pass).
+
+    ref_hist: (3, W, 1, B) float32 winner-column histograms, one slot per
+    refined split; ref_stats: (3, W) exact per-slot totals; is_cat_w: (W,)
+    winner-is-categorical flags.  Runs the identical numeric/sorted-category
+    candidate math the quantized pass ran — same tie-breaks — but on exact
+    operands, so the recorded threshold/direction/gain carry no
+    quantization error.  Returns (gain, t, d) each (W,); a slot whose exact
+    re-score finds NO valid candidate (quantization flipped a
+    min-hessian-type constraint) returns gain=-inf and the caller keeps the
+    quantized decision.
+    """
+    W = ref_hist.shape[1]
+    ones = jnp.ones((W, 1), bool)
+    g, t, d = _numeric_candidates(cfg, ref_hist, ref_stats, ones)
+    gain, t, d = g[:, 0], t[:, 0], d[:, 0]
+    if cfg.has_categoricals:
+        cg, ck, cdesc = _cat_candidates(cfg, ref_hist, ref_stats, ones)
+        gain = jnp.where(is_cat_w, cg[:, 0], gain)
+        t = jnp.where(is_cat_w, ck[:, 0], t)
+        d = jnp.where(is_cat_w, cdesc[:, 0], d)
+    return gain, t, d
+
+
 def _reduce_candidates(cfg: GrowConfig, gain_m, t_m, d_m):
     """(L, F) candidate matrices → per-leaf best (gain, f, t, d, is_cat)."""
     L, F = gain_m.shape
@@ -716,6 +768,8 @@ def grow_tree(
     hess: jnp.ndarray,  # (n,)
     bag_weight: jnp.ndarray,  # (n,) float; 0 = out of bag, GOSS amplification
     feat_mask: jnp.ndarray,  # (F,) bool; feature_fraction sampling
+    qkey: Optional[jnp.ndarray] = None,  # PRNG key (stochastic rounding)
+    qscale: Optional[jnp.ndarray] = None,  # (2,) grad/hess quantize scales
 ) -> Tuple[Tree, jnp.ndarray]:
     """Grow one tree (lossguide, one split per step); returns the tree and
     the final per-row leaf ids.
@@ -731,13 +785,33 @@ def grow_tree(
     vals = jnp.stack(
         [grad * bag_weight, hess * bag_weight, in_bag], axis=0
     ).astype(jnp.float32)  # (3, n) channel-major
+    if cfg.quantize_active:
+        # ISSUE 9 quantized path: ONE stochastic-rounding quantization of
+        # the (3, n) value rows per tree (the booster computes the
+        # per-iteration max-abs scales over the GLOBAL batch pre-shard).
+        # Builders accumulate int32 and dequantize right after the merge,
+        # so everything downstream of hist() stays f32 and unchanged.
+        scales3 = jnp.concatenate(
+            [qscale.astype(jnp.float32),
+             jnp.asarray([COUNT_SCALE], jnp.float32)]
+        )  # (3,)
+        if cfg.axis_name is not None:
+            # decorrelate the SR draws across shards: with one key every
+            # shard would reuse the SAME uniform pattern, correlating
+            # rounding errors across shards instead of letting them cancel
+            qkey = jax.random.fold_in(qkey, lax.axis_index(cfg.axis_name))
+        qvals = quantize_hist_vals(vals, scales3, qkey)
+        hq = HistQuantize(cfg.hist_quantize, cfg.quantize_shift, scales3)
+    else:
+        qvals, hq = vals, None
 
     def hist(mask):
         return build_histogram(
-            bins_t, vals, mask, B,
+            bins_t, qvals, mask, B,
             backend=cfg.hist_backend, chunk=cfg.hist_chunk, axis_name=cfg.axis_name,
             psum_dtype=cfg.hist_psum_dtype,
             precision=cfg.hist_precision, transposed=True,
+            quantize=hq,
         )
 
     root_hist = hist(jnp.ones(n, bool))  # (3, F, B)
@@ -756,13 +830,34 @@ def grow_tree(
         gain, l, f, t, dleft, is_cat = _best_split(
             cfg, hists, leaf_stats, leaf_depth, tree.num_leaves, feat_mask
         )
+        if cfg.quantize_active:
+            # f32 winner refinement (ISSUE 9): quantized histograms picked
+            # the winner; its ONE column is re-accumulated exactly and
+            # re-scored, so the recorded threshold/gain — and the
+            # membership set below — carry no quantization error.  A tiny
+            # (3, 1, B) allreduce vs the full quantized pass.
+            wcol = lax.dynamic_index_in_dim(bins_t, f, axis=0, keepdims=True)
+            ref = build_histogram(
+                wcol, vals, leaf_ids == l, B,
+                backend=cfg.hist_backend, chunk=cfg.hist_chunk,
+                axis_name=cfg.axis_name, psum_dtype="float32",
+                precision=cfg.hist_precision, transposed=True,
+            )[:, None]  # (3, 1, 1, B)
+            ref_col = ref[:, 0, 0]  # (3, B) exact winner column
+            ref_stats = ref_col.sum(axis=-1)[:, None]  # (3, 1)
+            rg, rt, rd = _refine_candidates(cfg, ref, ref_stats, is_cat[None])
+            ok = rg[0] > -jnp.inf
+            gain = jnp.where(ok, rg[0], gain)
+            t = jnp.where(ok, rt[0], t)
+            dleft = jnp.where(ok, rd[0], dleft)
         do = (gain > cfg.min_gain_to_split) & ~stopped
 
         fcol = lax.dynamic_index_in_dim(bins_t, f, axis=0, keepdims=False)
         is_missing = fcol == (B - 1)
         goes_left = jnp.where(is_missing, dleft, fcol <= t)
         if cfg.has_categoricals:
-            members = _cat_members(cfg, hists[:, l, f], t, dleft)  # (B,)
+            hist_lf = ref_col if cfg.quantize_active else hists[:, l, f]
+            members = _cat_members(cfg, hist_lf, t, dleft)  # (B,)
             goes_left = jnp.where(
                 is_cat, _member_lookup(members, fcol, B), goes_left
             )
@@ -798,6 +893,17 @@ def grow_tree(
     carry = (leaf_ids, hists, leaf_stats, leaf_depth, tree0, jnp.asarray(False))
     leaf_ids, hists, leaf_stats, leaf_depth, tree, _ = lax.fori_loop(0, S, step, carry)
 
+    if cfg.quantize_active:
+        # Exact f32 leaf totals for the leaf VALUES: the carried stats are
+        # dequantized bucket sums, good enough to rank splits but the
+        # model's outputs must come from exact sums (AUC/leaf parity).
+        leaf_stats = jax.vmap(
+            lambda v: jnp.zeros(L, jnp.float32).at[leaf_ids].add(
+                v, mode="drop"
+            )
+        )(vals)  # (3, L)
+        if cfg.axis_name is not None:
+            leaf_stats = lax.psum(leaf_stats, cfg.axis_name)
     leaf_value = _leaf_output(
         leaf_stats[0], leaf_stats[1], cfg.lambda_l1, cfg.lambda_l2, cfg.learning_rate
     )
@@ -816,6 +922,8 @@ def grow_tree_depthwise(
     hess: jnp.ndarray,
     bag_weight: jnp.ndarray,
     feat_mask: jnp.ndarray,
+    qkey: Optional[jnp.ndarray] = None,
+    qscale: Optional[jnp.ndarray] = None,
 ) -> Tuple[Tree, jnp.ndarray]:
     """Level-synchronous growth with windowed new-children histograms.
 
@@ -863,14 +971,30 @@ def grow_tree_depthwise(
         else cfg.axis_name
     )
     rs = cfg.reduce_scatter_active
+    if cfg.quantize_active:
+        # ISSUE 9 quantized path (see grow_tree): one SR quantization per
+        # tree; the windowed builder accumulates int32, merges over the
+        # integer wire, and dequantizes — downstream stays f32.
+        scales3 = jnp.concatenate(
+            [qscale.astype(jnp.float32),
+             jnp.asarray([COUNT_SCALE], jnp.float32)]
+        )  # (3,)
+        if cfg.axis_name is not None:
+            # decorrelate SR draws across shards (see grow_tree)
+            qkey = jax.random.fold_in(qkey, lax.axis_index(cfg.axis_name))
+        qvals = quantize_hist_vals(vals, scales3, qkey)
+        hq = HistQuantize(cfg.hist_quantize, cfg.quantize_shift, scales3)
+    else:
+        qvals, hq = vals, None
 
     def window_hist(win_leaf):
         return build_histogram_by_leaf(
-            bins_t, vals, win_leaf, W, B,
+            bins_t, qvals, win_leaf, W, B,
             backend=cfg.hist_backend, chunk=cfg.hist_chunk, axis_name=hist_axis,
             psum_dtype=cfg.hist_psum_dtype,
             precision=cfg.hist_precision, transposed=True,
             merge="reduce_scatter" if rs else "allreduce",
+            quantize=hq,
         )
 
     # Root histogram through the SAME windowed kernel (all rows in slot 0):
@@ -1028,10 +1152,66 @@ def grow_tree_depthwise(
         step_of_leaf = jnp.where(selected, step + sel_rank.astype(jnp.int32), S)
         new_id_of_leaf = (step_of_leaf + 1).astype(jnp.int32)  # right-child ids
         base = step + 1  # first new id this level
+        slot_leaves = order[:W].astype(jnp.int32)  # gain-ranked slots
+
+        # -- f32 winner refinement (ISSUE 9, quantized path) --------------
+        if cfg.quantize_active:
+            # Quantized histograms picked the level's ≤W winners; ONE
+            # windowed f32 pass re-accumulates just their winning COLUMNS
+            # (composed into a single per-row column: each row reads its
+            # own leaf's winning feature) and re-scores them exactly, so
+            # recorded thresholds/gains and the membership sets below
+            # carry no quantization error.  Rides the same small-allreduce
+            # structure as the membership owner-broadcast: (3, W, 1, B) ≪
+            # the full (3, W, F, B) quantized pass — and replicates the
+            # whole winner column even when the quantized merge itself
+            # runs reduce_scatter (rows are sharded, features are not, so
+            # every shard holds every column locally).
+            win_col = jnp.zeros(n, jnp.int32)
+            for w in range(W):
+                l_w = slot_leaves[w]
+                col_w = lax.dynamic_slice(
+                    bins_t, (f[l_w], jnp.int32(0)), (1, n)
+                )[0]
+                win_col = jnp.where(leaf_ids == l_w, col_w, win_col)
+            warange_r = jnp.arange(W, dtype=jnp.int32)
+            slot_of_leaf = jnp.full(L, W, jnp.int32).at[slot_leaves].set(
+                jnp.where(selected[slot_leaves], warange_r, W)
+            )
+            row_slot = slot_of_leaf[leaf_ids]  # non-winners park at W
+            ref_hist = build_histogram_by_leaf(
+                win_col[None, :], vals, row_slot, W, B,
+                backend=cfg.hist_backend, chunk=cfg.hist_chunk,
+                axis_name=hist_axis, psum_dtype="float32",
+                precision=cfg.hist_precision, transposed=True,
+                merge="allreduce",
+            )  # (3, W, 1, B) exact winner columns
+            stats_w = ref_hist[:, :, 0, :].sum(axis=-1)  # (3, W)
+            rg, rt, rd = _refine_candidates(
+                cfg, ref_hist, stats_w, is_cat[slot_leaves]
+            )
+            ok_w = selected[slot_leaves] & (rg > -jnp.inf)
+            gain = gain.at[slot_leaves].set(
+                jnp.where(ok_w, rg, gain[slot_leaves])
+            )
+            t = t.at[slot_leaves].set(jnp.where(ok_w, rt, t[slot_leaves]))
+            dleft = dleft.at[slot_leaves].set(
+                jnp.where(ok_w, rd, dleft[slot_leaves])
+            )
 
         # -- categorical membership sets for the level's winners ----------
         if cfg.has_categoricals:
-            if cfg.voting_active:
+            if cfg.quantize_active:
+                # The refined f32 columns already hold GLOBAL statistics
+                # for every selected leaf (allreduce merge above): no
+                # owner psum, and the membership scan runs on exact
+                # operands.  Non-selected leaves gather garbage the
+                # ``selected & is_cat`` mask below discards.
+                hist_lf = jnp.take(
+                    ref_hist[:, :, 0, :],
+                    jnp.minimum(slot_of_leaf, W - 1), axis=1,
+                )  # (3, L, B)
+            elif cfg.voting_active:
                 # GLOBAL statistics for the winning feature live in the
                 # psum-med elected block, not the local buffer.
                 hist_lf = jnp.take_along_axis(
@@ -1108,8 +1288,8 @@ def grow_tree_depthwise(
             # slices and resolve rows against their leaf's slot with
             # n-sized selects (~0.2ms/pass).  A moved row's new id is
             # ≥ base > every splittable leaf id, so later slots can never
-            # re-match it.
-            slot_leaves = order[:W].astype(jnp.int32)  # gain-ranked slots
+            # re-match it.  (slot_leaves hoisted above — the refinement
+            # pass and the candidate cache share the gain-ranked slots.)
             for w in range(W):
                 l_w = slot_leaves[w]
                 col = lax.dynamic_slice(

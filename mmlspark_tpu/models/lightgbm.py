@@ -78,6 +78,17 @@ class _LightGBMExecutionParams(Params):
             ["auto", "allreduce", "reduce_scatter"]
         ),
     )
+    histQuantize = Param(
+        "histQuantize",
+        "Quantized training wire/accumulator: off (default — bitwise the "
+        "f32 path) | on (resolved to int16) | int16 | int32.  Quantizes "
+        "per-row grad/hess to ±127 buckets with seeded stochastic "
+        "rounding, accumulates int32 histograms and merges shards over an "
+        "integer collective wire (f32 winner refinement keeps AUC "
+        "parity); mutually exclusive with hist_psum_dtype=bfloat16",
+        default="off", dtype=str,
+        validator=ParamValidators.inList(["off", "on", "int16", "int32"]),
+    )
     useBarrierExecutionMode = Param(
         "useBarrierExecutionMode",
         "Gang-schedule training (the SPMD program launch is inherently "
@@ -226,6 +237,7 @@ class _LightGBMParams(
         p["tree_learner"] = learner
         p["top_k"] = self.getTopK()
         p["hist_merge"] = self.getHistMerge()
+        p["hist_quantize"] = self.getHistQuantize()
         p["grow_policy"] = self.getGrowPolicy()
         p["split_batch"] = self.getSplitBatch()
         p["predict_backend"] = self.getPredictBackend()

@@ -33,7 +33,7 @@ not the dataset (HBM holds only the uint8 binned matrix — SURVEY.md §7.2).
 from __future__ import annotations
 
 import functools
-from typing import Optional
+from typing import NamedTuple, Optional
 
 import jax
 import jax.numpy as jnp
@@ -41,6 +41,106 @@ from jax import lax
 
 # Default rows per scan chunk; callers pad row counts to a multiple.
 DEFAULT_CHUNK = 16_384
+
+# ---------------------------------------------------------------------------
+# Quantized accumulation (ISSUE 9 — LightGBM quantized training,
+# "Quantized Training of Gradient Boosting Decision Trees", NeurIPS 2022)
+# ---------------------------------------------------------------------------
+# Per-row grad/hess quantize to signed buckets in [-QMAX, QMAX] with
+# per-iteration max-abs scales and seeded stochastic rounding; histograms
+# then accumulate as int32 adds and cross the mesh on an integer wire.
+# QMAX = 127 keeps every quantized row one int8 of information (int16 on
+# the row array for scatter/matmul convenience) and leaves the int32
+# accumulator headroom for n·QMAX row sums up to n ≈ 16.9M rows — the
+# worst case is REAL (iteration 0 of binary logloss: every |grad| equal).
+QMAX = 127
+
+# The count channel uses a FIXED power-of-two scale instead of a max-abs
+# scale: an in-bag row quantizes to exactly 1/COUNT_SCALE = 64 and
+# dequantizes to exactly 1.0 (64 · 2⁻⁶ is exact in f32), so quantized
+# leaf counts are EXACT and `count >= min_data_in_leaf` comparisons can
+# never flip versus the f32 path.
+COUNT_SCALE = 2.0 ** -6
+
+
+class HistQuantize(NamedTuple):
+    """Static plan + scales for one quantized histogram build.
+
+    ``wire``   — ``"int16"`` | ``"int32"``: dtype of the cross-shard merge.
+    ``shift``  — static rounding right-shift applied to local int32
+                 partial sums before the wire (0 when the worst-case sum
+                 already fits; see :func:`quantize_wire_plan`).
+    ``scales`` — ``(3,)`` f32 per-channel dequantization scales
+                 (grad, hess, count).
+    """
+
+    wire: str
+    shift: int
+    scales: jnp.ndarray
+
+
+def quantize_wire_plan(n_rows: int, wire: str, num_shards: int = 1) -> int:
+    """Static integer-wire plan: the pre-merge right-shift for ``wire``.
+
+    The worst-case bin total is ``n_rows × QMAX`` (every row in one bin at
+    max magnitude).  The plan guarantees, by construction:
+
+    - the LOCAL int32 accumulator never wraps: ``ceil(n/D) × QMAX < 2³¹``
+      (raises ``ValueError`` otherwise — quantize is unsupported at that
+      scale rather than silently wrong);
+    - the WIRE value fits its dtype: partial sums are right-shifted by
+      ``s`` with round-half-up, so each shifted magnitude is at most
+      ``(n·QMAX)/2^s + 1/2`` and the D-shard sum stays under
+      ``2^cap + D/2`` with cap = 14 (int16) / 30 (int32) — comfortably
+      inside the signed range.  Dequantization multiplies by ``2^s``.
+
+    The returned shift is a STATIC CEILING: the merge itself
+    (:func:`merge_shard_histograms_quantized`) sizes the wire shift
+    dynamically from the observed max partial, which on real data is
+    far smaller — this function's job is the overflow guard and the
+    attested worst-case bound.
+    """
+    if wire not in ("int16", "int32"):
+        raise ValueError(
+            f"unknown quantize wire {wire!r}; expected int16|int32"
+        )
+    n_local = -(-int(n_rows) // max(int(num_shards), 1))
+    if n_local * QMAX >= 2 ** 31:
+        raise ValueError(
+            f"hist_quantize overflow guard: {n_local} rows/shard × "
+            f"QMAX={QMAX} exceeds int32 accumulator headroom (2³¹); "
+            "quantized training is unsupported at this per-shard scale"
+        )
+    cap_bits = 14 if wire == "int16" else 30
+    return max(0, (int(n_rows) * QMAX).bit_length() - cap_bits)
+
+
+def quantize_channel_scales(grad, hess, bag_weight) -> jnp.ndarray:
+    """Per-iteration (grad, hess) quantization scales for ONE class:
+    max-abs over the bagged batch divided by QMAX (LightGBM quantized
+    training's per-iteration gradient scale).  Zero-gradient batches get
+    scale 1.0 so dequantization never divides by zero."""
+    gmax = jnp.max(jnp.abs(grad * bag_weight))
+    hmax = jnp.max(jnp.abs(hess * bag_weight))
+    one = jnp.float32(1.0)
+    return jnp.stack([
+        jnp.where(gmax > 0, gmax / QMAX, one),
+        jnp.where(hmax > 0, hmax / QMAX, one),
+    ]).astype(jnp.float32)
+
+
+def quantize_hist_vals(vals, scales, key) -> jnp.ndarray:
+    """Stochastically round ``vals`` (3, n) f32 to int16 buckets.
+
+    ``q = floor(v / scale + u)`` with ``u ~ U[0, 1)`` — unbiased
+    (E[q·scale] = v), and EXACT whenever ``v/scale`` is integral, which
+    the count channel always is (fixed 2⁻⁶ scale).  Seeded by ``key``:
+    the same (seed, iteration, class) key reproduces the same buckets
+    bitwise, making quantized training run-to-run deterministic."""
+    x = vals / scales[:, None]
+    u = jax.random.uniform(key, vals.shape, dtype=jnp.float32)
+    # clip: f32 division rounding can land x a hair above ±QMAX
+    return jnp.clip(jnp.floor(x + u), -QMAX, QMAX).astype(jnp.int16)
 
 
 def merge_shard_histograms(
@@ -94,6 +194,79 @@ def merge_shard_histograms(
     return op(hist)
 
 
+def merge_shard_histograms_quantized(
+    hist: jnp.ndarray,
+    axis_name: str,
+    merge: str,
+    wire: str,
+    shift: int,
+    feature_axis: int = 1,
+) -> jnp.ndarray:
+    """Integer-wire histogram merge: the quantized twin of
+    :func:`merge_shard_histograms`.
+
+    The wire shift is sized DYNAMICALLY per merge: a scalar ``pmax`` of
+    the largest local ``|partial|`` agrees a global bit length, and the
+    shift is just what squeezes the D-shard sum under the wire cap.  On
+    real data the largest bin magnitude sits far below the static
+    worst case ``n·QMAX``, so the int16 wire usually ships at shift 0–3
+    where the static plan would demand ~7 — enough rounding noise to
+    corrupt split selection (the AUC-parity gates in
+    ``tests/test_quantize.py`` fail on the static plan at 16k rows).
+    ``shift`` (the static ceiling from :func:`quantize_wire_plan`) is
+    retained in the plan/cache key; the dynamic shift never exceeds it
+    by more than 1 and both independently guarantee wire safety.
+
+    The shift is round-half-up in exact integer arithmetic and the
+    reduce is an integer sum, so the merge is associative and the merged
+    result is bitwise identical under either strategy.  Wire bytes land
+    under ``hist.quantized_bytes`` via the int collective wrappers.
+    Returns the merged histogram as f32 WITH the ``2^s`` shift already
+    folded back in — the caller only applies the channel scales.
+    """
+    from mmlspark_tpu.parallel.distributed import (
+        device_psum_int,
+        device_psum_scatter_int,
+    )
+
+    if merge == "reduce_scatter":
+        op = functools.partial(
+            device_psum_scatter_int,
+            axis_name=axis_name,
+            scatter_dimension=feature_axis,
+            tiled=True,
+        )
+    elif merge == "allreduce":
+        op = functools.partial(device_psum_int, axis_name=axis_name)
+    else:
+        raise ValueError(
+            f"unknown hist_merge {merge!r}; expected allreduce|reduce_scatter"
+        )
+    num_shards = int(lax.psum(1, axis_name))
+    d_bits = max(num_shards - 1, 0).bit_length()
+    cap_bits = 14 if wire == "int16" else 30
+    # global max |partial| → bit length → minimal safe shift: every
+    # shard's shifted magnitude is ≤ 2^(bl-s) + 1/2, so the D-shard sum
+    # stays under 2^(d_bits+bl-s) + D/2 ≤ 2^cap + D/2 — in range for
+    # int16 (cap 14) / int32 (cap 30)
+    m = lax.pmax(jnp.max(jnp.abs(hist)), axis_name)
+    bit_len = jnp.int32(32) - lax.clz(m)
+    s = jnp.maximum(bit_len + jnp.int32(d_bits - cap_bits), 0)
+    # round-half-up on signed int32 (arithmetic >> floors, so adding
+    # half the divisor first rounds); s == 0 adds nothing
+    half = jnp.where(s > 0, jnp.left_shift(jnp.int32(1),
+                                           jnp.maximum(s - 1, 0)), 0)
+    hist = jnp.right_shift(hist + half, s)
+    if wire == "int16":
+        # headroom: the dynamic shift above sized the D-shard sum under
+        # 2^14 + D/2, comfortably inside int16
+        hist = hist.astype(jnp.int16)
+    merged = op(hist).astype(jnp.float32)
+    # exp2 of a small integer is exact in f32 — the shifted-off scale
+    # folds back without rounding
+    return merged * jnp.exp2(s.astype(jnp.float32))
+
+
 def _scatter_hist_chunk(bins_c, vals_c, num_bins: int):
     """(C, F) int bins, (3, C) vals → (3, F, B) via scatter-add."""
     C, F = bins_c.shape
@@ -125,6 +298,40 @@ def _onehot_hist_chunk(bins_c, vals_c, num_bins: int, feat_block: int = 8):
     return hist.transpose(1, 0, 2, 3).reshape(3, Fp, num_bins)[:, :F]
 
 
+def _scatter_hist_chunk_int(bins_c, vals_c, num_bins: int):
+    """Quantized twin of ``_scatter_hist_chunk``: (3, C) int16 vals →
+    (3, F, B) int32 scatter-add.  headroom: |val| ≤ QMAX, so C·QMAX row
+    sums fit int32 for any chunk ≤ 16.9M rows (quantize_wire_plan)."""
+    C, F = bins_c.shape
+    idx = bins_c.astype(jnp.int32) + jnp.arange(F, dtype=jnp.int32)[None, :] * num_bins
+    flat = jax.vmap(
+        lambda v: jnp.zeros(F * num_bins, jnp.int32).at[idx.reshape(-1)].add(
+            jnp.broadcast_to(v.astype(jnp.int32)[:, None], (C, F)).reshape(-1)
+        )
+    )(vals_c)
+    return flat.reshape(3, F, num_bins)
+
+
+def _onehot_hist_chunk_int(bins_c, vals_c, num_bins: int, feat_block: int = 8):
+    """Quantized twin of ``_onehot_hist_chunk``: int32 matmul accumulation.
+    headroom: per-chunk sums ≤ C·QMAX ≪ 2³¹ (quantize_wire_plan)."""
+    C, F = bins_c.shape
+    pad_f = (-F) % feat_block
+    if pad_f:
+        bins_c = jnp.pad(bins_c, ((0, 0), (0, pad_f)))
+    Fp = F + pad_f
+    blocks = bins_c.reshape(C, Fp // feat_block, feat_block).transpose(1, 0, 2)
+    vals_i = vals_c.astype(jnp.int32)
+
+    def block_hist(bl):  # (C, feat_block)
+        oh = (bl[:, :, None] == jnp.arange(num_bins, dtype=bl.dtype)[None, None, :])
+        oh = oh.astype(jnp.int32).reshape(C, feat_block * num_bins)
+        return (vals_i @ oh).reshape(3, feat_block, num_bins)
+
+    hist = lax.map(block_hist, blocks)  # (Fp/fb, 3, fb, B)
+    return hist.transpose(1, 0, 2, 3).reshape(3, Fp, num_bins)[:, :F]
+
+
 def build_histogram(
     bins: jnp.ndarray,
     vals: jnp.ndarray,
@@ -137,10 +344,16 @@ def build_histogram(
     transposed: bool = False,
     psum_dtype: str = "float32",
     merge: str = "allreduce",
+    quantize: Optional[HistQuantize] = None,
 ) -> jnp.ndarray:
     """Histogram of ``vals`` (3, n) over (feature, bin), rows gated by
     ``mask``; returns (3, F, B) — or (3, F/D, B), this shard's merged
     feature slice, under ``merge="reduce_scatter"``.
+
+    With ``quantize`` set, ``vals`` must arrive as int16 buckets from
+    :func:`quantize_hist_vals`; accumulation is int32, the cross-shard
+    merge rides the integer wire, and the returned histogram is
+    DEQUANTIZED f32 — downstream gain math is unchanged.
 
     ``transposed=True`` means ``bins`` arrives as (F, n) int32 — growers
     hoist the convert+transpose out of their per-pass loop (pallas wants
@@ -157,25 +370,39 @@ def build_histogram(
         F, n = bins.shape
     else:
         n, F = bins.shape
+    quant = quantize is not None
     if backend == "pallas":
-        from mmlspark_tpu.ops.pallas_hist import pallas_hist_chunk
+        from mmlspark_tpu.ops.pallas_hist import (
+            pallas_hist_chunk,
+            pallas_hist_chunk_int,
+        )
 
         fn = functools.partial(
-            pallas_hist_chunk, precision=precision, transposed=transposed
+            pallas_hist_chunk_int if quant else pallas_hist_chunk,
+            precision=precision, transposed=transposed,
         )
     elif backend == "onehot":
-        fn = _onehot_hist_chunk if not transposed else (
-            lambda b, v, nb: _onehot_hist_chunk(b.T, v, nb)
+        base = _onehot_hist_chunk_int if quant else _onehot_hist_chunk
+        fn = base if not transposed else (
+            lambda b, v, nb, _f=base: _f(b.T, v, nb)
         )
     elif backend == "scatter":
-        fn = _scatter_hist_chunk if not transposed else (
-            lambda b, v, nb: _scatter_hist_chunk(b.T, v, nb)
+        base = _scatter_hist_chunk_int if quant else _scatter_hist_chunk
+        fn = base if not transposed else (
+            lambda b, v, nb, _f=base: _f(b.T, v, nb)
         )
     else:
         raise ValueError(
             f"unknown hist backend {backend!r}; expected scatter|onehot|pallas"
         )
-    vals = jnp.where(mask[None, :], vals, 0.0).astype(jnp.float32)
+    if quant:
+        vals = jnp.where(mask[None, :], vals, jnp.int16(0))
+        # headroom: n·QMAX bin sums fit the int32 accumulator for any
+        # n ≤ 16.9M rows/shard — guarded statically by quantize_wire_plan
+        acc0 = jnp.zeros((3, F, num_bins), jnp.int32)
+    else:
+        vals = jnp.where(mask[None, :], vals, 0.0).astype(jnp.float32)
+        acc0 = jnp.zeros((3, F, num_bins), jnp.float32)
     if n <= chunk:
         hist = fn(bins, vals, num_bins)
     else:
@@ -191,12 +418,22 @@ def build_histogram(
             b, v = xs
             return acc + fn(b, v, num_bins), None
 
-        hist, _ = lax.scan(body, jnp.zeros((3, F, num_bins), jnp.float32), (bc, vc))
+        hist, _ = lax.scan(body, acc0, (bc, vc))
     if axis_name is not None:
-        hist = merge_shard_histograms(
-            hist, axis_name, merge=merge, psum_dtype=psum_dtype,
-            feature_axis=1,
-        )
+        if quant:
+            hist = merge_shard_histograms_quantized(
+                hist, axis_name, merge=merge, wire=quantize.wire,
+                shift=quantize.shift, feature_axis=1,
+            )
+        else:
+            hist = merge_shard_histograms(
+                hist, axis_name, merge=merge, psum_dtype=psum_dtype,
+                feature_axis=1,
+            )
+    if quant:
+        # dequantize ONCE post-merge (the merge already folded back its
+        # dynamic wire shift; serial hists are plain int32 sums)
+        hist = hist.astype(jnp.float32) * quantize.scales[:, None, None]
     return hist
 
 
@@ -221,6 +458,25 @@ def _scatter_hist_by_leaf_chunk(bins_c, vals_c, leaf_c, num_leaves: int, num_bin
     return flat.reshape(3, num_leaves + 1, F, num_bins)[:, :num_leaves]
 
 
+def _scatter_hist_by_leaf_chunk_int(bins_c, vals_c, leaf_c, num_leaves: int,
+                                    num_bins: int):
+    """Quantized twin of ``_scatter_hist_by_leaf_chunk``: int16 vals →
+    (3, L, F, B) int32 scatter-add.  headroom: |val| ≤ QMAX keeps C·QMAX
+    sums inside int32 (quantize_wire_plan)."""
+    C, F = bins_c.shape
+    leaf_c = leaf_c.astype(jnp.int32)
+    parked = (leaf_c < 0) | (leaf_c >= num_leaves)
+    leaf_c = jnp.where(parked, num_leaves, leaf_c)
+    base = leaf_c[:, None] * (F * num_bins)
+    idx = base + jnp.arange(F, dtype=jnp.int32)[None, :] * num_bins + bins_c.astype(jnp.int32)
+    flat = jax.vmap(
+        lambda v: jnp.zeros((num_leaves + 1) * F * num_bins, jnp.int32)
+        .at[idx.reshape(-1)]
+        .add(jnp.broadcast_to(v.astype(jnp.int32)[:, None], (C, F)).reshape(-1))
+    )(vals_c)
+    return flat.reshape(3, num_leaves + 1, F, num_bins)[:, :num_leaves]
+
+
 def build_histogram_by_leaf(
     bins: jnp.ndarray,
     vals: jnp.ndarray,
@@ -234,10 +490,13 @@ def build_histogram_by_leaf(
     transposed: bool = False,
     psum_dtype: str = "float32",
     merge: str = "allreduce",
+    quantize: Optional[HistQuantize] = None,
 ) -> jnp.ndarray:
     """Per-leaf histograms in ONE pass over the data: (3, L, F, B) — or
     (3, L, F/D, B), this shard's merged feature slice, under
-    ``merge="reduce_scatter"``.
+    ``merge="reduce_scatter"``.  With ``quantize`` set, ``vals`` must be
+    int16 buckets; the result is the DEQUANTIZED f32 histogram (see
+    :func:`build_histogram`).
 
     The depthwise grower's workhorse (SURVEY.md §7.4.2): one pass histograms
     every leaf slot in ``[0, num_leaves)`` together.  Rows to exclude
@@ -253,10 +512,13 @@ def build_histogram_by_leaf(
         F, n = bins.shape
     else:
         n, F = bins.shape
-    vals = vals.astype(jnp.float32)
+    quant = quantize is not None
+    if not quant:
+        vals = vals.astype(jnp.float32)
     if backend == "pallas":
         from mmlspark_tpu.ops.pallas_hist import (
             pallas_hist_by_leaf_chunk,
+            pallas_hist_by_leaf_chunk_int,
             pallas_hist_by_leaf_nibble_chunk,
         )
 
@@ -265,7 +527,16 @@ def build_histogram_by_leaf(
         # ulps — parity tested) and wins measurably up to M ≈ 128 (W≤21 at B=256:
         # 7.5 → 4.9 ms/pass at W=12, 262k×64 on v5e).
         h = (num_bins + 127) // 128
-        if num_bins > 128 and 3 * num_leaves * h <= 128:
+        if quant:
+            # quantized builds route to the plain int-accumulator kernel
+            # only: the nibble factorization's hi/lo recombination is a
+            # float trick with no int32 twin (and the int path is already
+            # exact, so there is nothing for it to tighten)
+            fn = functools.partial(
+                pallas_hist_by_leaf_chunk_int, precision=precision,
+                transposed=transposed,
+            )
+        elif num_bins > 128 and 3 * num_leaves * h <= 128:
             fn = functools.partial(
                 pallas_hist_by_leaf_nibble_chunk, precision=precision,
                 transposed=transposed,
@@ -276,13 +547,21 @@ def build_histogram_by_leaf(
                 transposed=transposed,
             )
     elif backend in ("scatter", "onehot"):
-        fn = _scatter_hist_by_leaf_chunk if not transposed else (
-            lambda b, v, l, nl, nb: _scatter_hist_by_leaf_chunk(b.T, v, l, nl, nb)
+        base = (_scatter_hist_by_leaf_chunk_int if quant
+                else _scatter_hist_by_leaf_chunk)
+        fn = base if not transposed else (
+            lambda b, v, l, nl, nb, _f=base: _f(b.T, v, l, nl, nb)
         )
     else:
         raise ValueError(
             f"unknown hist backend {backend!r}; expected scatter|onehot|pallas"
         )
+    if quant:
+        # headroom: n·QMAX bin sums fit the int32 accumulator for any
+        # n ≤ 16.9M rows/shard — guarded statically by quantize_wire_plan
+        acc0 = jnp.zeros((3, num_leaves, F, num_bins), jnp.int32)
+    else:
+        acc0 = jnp.zeros((3, num_leaves, F, num_bins), jnp.float32)
     if n <= chunk:
         hist = fn(bins, vals, leaf_ids, num_leaves, num_bins)
     else:
@@ -299,14 +578,18 @@ def build_histogram_by_leaf(
             b, v, l = xs
             return acc + fn(b, v, l, num_leaves, num_bins), None
 
-        hist, _ = lax.scan(
-            body,
-            jnp.zeros((3, num_leaves, F, num_bins), jnp.float32),
-            (bc, vc, lc),
-        )
+        hist, _ = lax.scan(body, acc0, (bc, vc, lc))
     if axis_name is not None:
-        hist = merge_shard_histograms(
-            hist, axis_name, merge=merge, psum_dtype=psum_dtype,
-            feature_axis=2,
-        )
+        if quant:
+            hist = merge_shard_histograms_quantized(
+                hist, axis_name, merge=merge, wire=quantize.wire,
+                shift=quantize.shift, feature_axis=2,
+            )
+        else:
+            hist = merge_shard_histograms(
+                hist, axis_name, merge=merge, psum_dtype=psum_dtype,
+                feature_axis=2,
+            )
+    if quant:
+        hist = hist.astype(jnp.float32) * quantize.scales[:, None, None, None]
     return hist

@@ -280,10 +280,13 @@ def _pallas_hist_by_leaf(
 def _prep_by_leaf_chunk(
     bins_c, vals_c, leaf_c, num_leaves: int, num_bins: int,
     bm: int, bf: int, rm: int, transposed: bool,
+    val_dtype=jnp.float32,
 ):
-    """Shared wrapper prep for both by-leaf kernels: backend check,
+    """Shared wrapper prep for the by-leaf kernels: backend check,
     transpose, block clamps, padding.  Returns
-    (bins_t, vals, leaf_row, bm, bf, rm, F, interpret)."""
+    (bins_t, vals, leaf_row, bm, bf, rm, F, interpret).  ``val_dtype``
+    is f32 for the float kernels, int16 for the quantized kernel (the
+    row values DMA at half width)."""
     import jax as _jax
 
     backend = _jax.default_backend()
@@ -297,7 +300,7 @@ def _prep_by_leaf_chunk(
     else:
         C, F = bins_c.shape
         bins_t = bins_c.astype(jnp.int32).T
-    vals_c = vals_c.astype(jnp.float32)
+    vals_c = vals_c.astype(val_dtype)
     leaf_row = leaf_c.astype(jnp.int32)[None, :]  # (1, C): lane-friendly
     bf = min(bf, max(8, _round_up(F, 8)))  # don't pad tiny feature counts 4x
     # Feature-block choice minimizes PADDED width: bf=32 on F=40 (the
@@ -476,6 +479,219 @@ def pallas_hist_by_leaf_nibble_chunk(
         bins_c, vals_c, leaf_c, num_leaves, num_bins, bm, bf, rm, transposed
     )
     out = _pallas_hist_by_leaf_nibble(
+        bins_t, vals_c, leaf_row, num_leaves, num_bins, bm, bf, rm,
+        interp, precision,
+    )
+    return out[:, :, :F]
+
+
+# ---------------------------------------------------------------------------
+# Integer-accumulator variants (ISSUE 9 — quantized training).
+#
+# Layout note, int accumulator tile: the row values arrive as an int16
+# (3, bm) tile (sublane-padded to 16; HALF the per-row-block DMA of the
+# f32 kernels) and the grid-resident output tile is **int32** with the
+# same (3·L on sublanes, bf·B on lanes) orientation as the float kernels.
+# The per-row-block contraction itself stays an f32 MXU matmul — there is
+# no native int32 MXU path to lower to, and none is needed for exactness:
+# both operands are small integers (one-hot ∈ {0,1}, |vals| ≤ QMAX = 127,
+# exact even as bf16 under precision="default"), so every partial sum is
+# an integer ≤ bm·QMAX ≈ 2.1M ≪ 2²⁴, exactly representable in the f32
+# accumulator; the cast to int32 after each row block is therefore exact,
+# and int32 grid accumulation across row blocks is associative — the
+# whole build is bit-reproducible regardless of precision mode, chunking,
+# or merge order.  headroom: n·QMAX ≤ 2³¹ per shard is attested
+# statically by ops.histogram.quantize_wire_plan before any kernel runs.
+# ---------------------------------------------------------------------------
+def _hist_kernel_int(bins_ref, vals_ref, out_ref, *, num_bins: int, precision):
+    """Quantized twin of ``_hist_kernel``: int16 vals in, int32 out."""
+    i = pl.program_id(1)  # row block (innermost → accumulation is safe)
+    bins = bins_ref[...]  # (bf, bm) int32
+    vals = vals_ref[...].astype(jnp.float32)  # (3, bm) int16 buckets
+    bf, bm = bins.shape
+    iota_b = jax.lax.broadcasted_iota(jnp.int32, (num_bins, bm), 0)
+    parts = []
+    for f in range(bf):
+        oh_f = (iota_b == bins[f, :][None, :]).astype(jnp.float32)
+        parts.append(
+            jax.lax.dot_general(
+                vals, oh_f,
+                dimension_numbers=(((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+                precision=precision,
+            )  # (3, B) — integer-valued, exact in f32 (see layout note)
+        )
+    part = jnp.concatenate(parts, axis=1).astype(jnp.int32)  # (3, bf·B)
+
+    @pl.when(i == 0)
+    def _init():
+        out_ref[...] = part[None, :, :]
+
+    @pl.when(i > 0)
+    def _acc():
+        out_ref[...] += part[None, :, :]
+
+
+@functools.partial(
+    jax.jit, static_argnames=("num_bins", "bm", "bf", "interpret", "precision")
+)
+def _pallas_hist_int(
+    bins_t, vals, num_bins: int, bm: int, bf: int, interpret: bool, precision: str
+):
+    F, n = bins_t.shape
+    kernel = functools.partial(
+        _hist_kernel_int, num_bins=num_bins, precision=_PRECISIONS[precision]
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid=(F // bf, n // bm),
+        in_specs=[
+            pl.BlockSpec((bf, bm), lambda j, i: (j, i)),
+            pl.BlockSpec((3, bm), lambda j, i: (0, i)),
+        ],
+        out_specs=pl.BlockSpec((1, 3, bf * num_bins), lambda j, i: (j, 0, 0)),
+        # headroom: int32 grid accumulator — n·QMAX per shard is attested
+        # statically by ops.histogram.quantize_wire_plan before kernels run
+        out_shape=jax.ShapeDtypeStruct((F // bf, 3, bf * num_bins), jnp.int32),
+        interpret=interpret,
+    )(bins_t, vals)
+    return out.transpose(1, 0, 2).reshape(3, F, num_bins)
+
+
+def pallas_hist_chunk_int(
+    bins_c, vals_c, num_bins: int, bm: int = 4096, bf: int = 32,
+    precision: str = "highest", transposed: bool = False,
+) -> jnp.ndarray:
+    """Quantized twin of :func:`pallas_hist_chunk`: (3, C) int16 bucket
+    vals → (3, F, B) int32, same padding/blocking rules."""
+    if transposed:
+        bins_t = bins_c  # (F, C) int32 already
+        F, C = bins_t.shape
+    else:
+        C, F = bins_c.shape
+        bins_t = bins_c.astype(jnp.int32).T
+    vals_c = vals_c.astype(jnp.int16)
+    bm = min(bm, _pow2_floor(max(512, bm * 256 // num_bins)))
+    bm = min(bm, _round_up(C, 128))
+    bf = min(bf, max(8, _round_up(F, 8)))
+    pad_r = (-C) % bm
+    pad_f = (-F) % bf
+    if pad_r:
+        bins_t = jnp.pad(bins_t, ((0, 0), (0, pad_r)))
+        vals_c = jnp.pad(vals_c, ((0, 0), (0, pad_r)))
+    if pad_f:
+        bins_t = jnp.pad(bins_t, ((0, pad_f), (0, 0)))
+    backend = jax.default_backend()
+    if backend not in ("cpu", "tpu"):
+        raise NotImplementedError(
+            f"hist_backend='pallas' supports tpu (compiled) and cpu "
+            f"(interpret) backends, not {backend!r}; use 'scatter'"
+        )
+    out = _pallas_hist_int(
+        bins_t, vals_c, num_bins, bm, bf, backend == "cpu", precision
+    )
+    return out[:, :F, :]  # (3, F, B) int32
+
+
+def _hist_leaf_kernel_int(
+    bins_ref, vals_ref, leaf_ref, out_ref, *,
+    num_bins: int, num_leaves: int, rm: int, precision,
+):
+    """Quantized twin of ``_hist_leaf_kernel`` (see the layout note above):
+    per-sub-block f32 contraction, exact cast, int32 accumulation."""
+    i = pl.program_id(1)  # row block, innermost → accumulation is safe
+    bf, bm = bins_ref.shape
+
+    def sub(s, acc):
+        sl = pl.ds(s * rm, rm)
+        bins = bins_ref[:, sl]  # (bf, rm) int32
+        vals = vals_ref[:, sl].astype(jnp.float32)  # (3, rm) int16 buckets
+        leaf = leaf_ref[0, sl]  # (rm,) int32
+        iota_l = jax.lax.broadcasted_iota(jnp.int32, (rm, num_leaves), 1)
+        oh_leaf = (iota_l == leaf[:, None]).astype(jnp.float32)
+        rhs = jnp.concatenate(
+            [oh_leaf * vals[c, :][:, None] for c in range(3)], axis=1
+        )  # (rm, 3·L)
+        iota_b = jax.lax.broadcasted_iota(jnp.int32, (num_bins, rm), 0)
+        parts = []
+        for f in range(bf):
+            oh_f = (iota_b == bins[f, :][None, :]).astype(jnp.float32)
+            parts.append(
+                jax.lax.dot_general(
+                    rhs, oh_f,
+                    dimension_numbers=(((0,), (1,)), ((), ())),
+                    preferred_element_type=jnp.float32,
+                    precision=precision,
+                )  # (3·L, B)
+            )
+        # integer-valued f32 partial sums ≤ rm·QMAX ≪ 2²⁴ → exact cast
+        return acc + jnp.concatenate(parts, axis=1).astype(jnp.int32)
+
+    part = jax.lax.fori_loop(
+        0, bm // rm, sub,
+        # headroom: bm·QMAX ≪ 2³¹ per block; the cross-block int32 total
+        # is bounded by quantize_wire_plan's static n·QMAX check
+        jnp.zeros((3 * num_leaves, bf * num_bins), jnp.int32),
+    )
+
+    @pl.when(i == 0)
+    def _init():
+        out_ref[...] = part[None]
+
+    @pl.when(i > 0)
+    def _acc():
+        out_ref[...] += part[None]
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=(
+        "num_leaves", "num_bins", "bm", "bf", "rm", "interpret", "precision"
+    ),
+)
+def _pallas_hist_by_leaf_int(
+    bins_t, vals, leaf_ids, num_leaves, num_bins, bm, bf, rm, interpret, precision
+):
+    F, n = bins_t.shape
+    kernel = functools.partial(
+        _hist_leaf_kernel_int, num_bins=num_bins, num_leaves=num_leaves,
+        rm=rm, precision=_PRECISIONS[precision],
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid=(F // bf, n // bm),
+        in_specs=[
+            pl.BlockSpec((bf, bm), lambda j, i: (j, i)),
+            pl.BlockSpec((3, bm), lambda j, i: (0, i)),
+            pl.BlockSpec((1, bm), lambda j, i: (0, i)),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, num_leaves * 3, bf * num_bins), lambda j, i: (j, 0, 0)
+        ),
+        # headroom: int32 grid accumulator — n·QMAX per shard is attested
+        # statically by ops.histogram.quantize_wire_plan before kernels run
+        out_shape=jax.ShapeDtypeStruct(
+            (F // bf, num_leaves * 3, bf * num_bins), jnp.int32
+        ),
+        interpret=interpret,
+    )(bins_t, vals, leaf_ids)
+    out = out.reshape(F // bf, 3, num_leaves, bf, num_bins)
+    return out.transpose(1, 2, 0, 3, 4).reshape(3, num_leaves, F, num_bins)
+
+
+def pallas_hist_by_leaf_chunk_int(
+    bins_c, vals_c, leaf_c, num_leaves: int, num_bins: int,
+    bm: int = 16384, bf: int = 32, rm: int = 1024, precision: str = "highest",
+    transposed: bool = False,
+) -> jnp.ndarray:
+    """Quantized twin of :func:`pallas_hist_by_leaf_chunk`: int16 bucket
+    vals → (3, L, F, B) int32.  The nibble factorization has no int twin
+    (ops/histogram.py routes quantized builds here unconditionally)."""
+    bins_t, vals_c, leaf_row, bm, bf, rm, F, interp = _prep_by_leaf_chunk(
+        bins_c, vals_c, leaf_c, num_leaves, num_bins, bm, bf, rm, transposed,
+        val_dtype=jnp.int16,
+    )
+    out = _pallas_hist_by_leaf_int(
         bins_t, vals_c, leaf_row, num_leaves, num_bins, bm, bf, rm,
         interp, precision,
     )

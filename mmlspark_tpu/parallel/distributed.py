@@ -194,6 +194,56 @@ def device_all_gather(x, axis_name, **kw):
     return out
 
 
+def _require_int_wire(x, op: str) -> None:
+    import jax
+    import numpy as np
+
+    for leaf in jax.tree_util.tree_leaves(x):
+        if not np.issubdtype(np.dtype(leaf.dtype), np.integer):
+            raise TypeError(
+                f"{op} carries the quantized integer histogram wire; got "
+                f"dtype {leaf.dtype} — quantize first (ops.histogram."
+                "quantize_hist_vals) or use the float wrapper"
+            )
+
+
+def device_psum_int(x, axis_name):
+    """Integer-wire ``lax.psum`` (ISSUE 9 quantized histogram merge).
+
+    Same op label / watchdog / byte accounting as :func:`device_psum`,
+    plus a ``hist.quantized_bytes`` counter so the wire savings of the
+    quantized path are directly readable from one obs counter.  Rejects
+    non-integer operands: the caller's wire plan (shift + dtype) is what
+    makes the integer sum overflow-safe, so a float sneaking in here
+    means the plan was bypassed.
+    """
+    from jax import lax
+
+    _require_int_wire(x, "device_psum_int")
+    with obs.collective_watchdog("psum", **obs.trace_attrs()) as wd:
+        out = lax.psum(x, axis_name)
+        nbytes = _leaf_nbytes(out)
+        wd.attrs["nbytes"] = nbytes
+        obs.inc("hist.quantized_bytes", nbytes)
+    return out
+
+
+def device_psum_scatter_int(x, axis_name, scatter_dimension: int = 0,
+                            tiled: bool = True):
+    """Integer-wire ``lax.psum_scatter`` (see :func:`device_psum_int`)."""
+    from jax import lax
+
+    _require_int_wire(x, "device_psum_scatter_int")
+    with obs.collective_watchdog("reduce_scatter", **obs.trace_attrs()) as wd:
+        out = lax.psum_scatter(
+            x, axis_name, scatter_dimension=scatter_dimension, tiled=tiled
+        )
+        nbytes = _leaf_nbytes(out)
+        wd.attrs["nbytes"] = nbytes
+        obs.inc("hist.quantized_bytes", nbytes)
+    return out
+
+
 def host_allgather(arr) -> "np.ndarray":
     """Allgather a SMALL host array across processes → (nproc, *shape).
 

@@ -20,6 +20,7 @@ from tools.analyze.common import Finding, apply_suppressions
 from tools.analyze.hygiene import check_hygiene_file
 from tools.analyze.obs_rules import check_obs, check_obs_file
 from tools.analyze.predict_rules import check_predict, check_predict_file
+from tools.analyze.quantize_rules import check_quantize_file
 from tools.analyze.serving_rules import check_serving, check_serving_file
 from tools.analyze.tracer import check_host_only_file, check_tracer_file
 
@@ -1413,6 +1414,101 @@ def test_dty001_index_valued_results_drop_taint(tmp_path):
         """,
     })
     assert run_all(root, rules={"DTY001"}) == []
+
+
+# ------------------------------------------------------- QNT001 fixtures
+
+
+def test_qnt001_unattested_int_accumulator(tmp_path):
+    # the seeded bug: an int32 histogram accumulator with no headroom
+    # note — n·QMAX overflow would wrap silently
+    p = _write(str(tmp_path / "hist.py"), """
+        import jax.numpy as jnp
+        def build_hist(bins, vals, F, B):
+            acc = jnp.zeros((3, F, B), jnp.int32)
+            return acc.at[..., bins].add(vals)
+    """)
+    assert rules(check_quantize_file(p)) == ["QNT001"]
+
+
+def test_qnt001_fires_by_function_name_outside_hist_file(tmp_path):
+    # file name is neutral; the enclosing function is histogram code
+    p = _write(str(tmp_path / "m.py"), """
+        import jax.numpy as jnp
+        def _scatter_hist_chunk_int(idx, vals, F, B):
+            return jnp.zeros(F * B, jnp.int16).at[idx].add(vals)
+    """)
+    assert rules(check_quantize_file(p)) == ["QNT001"]
+
+
+def test_qnt001_matmul_accumulator_and_out_shape(tmp_path):
+    # the Pallas shapes: int32 ShapeDtypeStruct grid accumulator and an
+    # integer preferred_element_type contraction
+    p = _write(str(tmp_path / "pallas_hist.py"), """
+        import jax
+        import jax.numpy as jnp
+        def _pallas_hist_int(F, B):
+            return jax.ShapeDtypeStruct((3, F, B), jnp.int32)
+        def _hist_kernel_int(oh, vals):
+            return jax.lax.dot_general(
+                oh, vals, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.int32)
+    """)
+    assert rules(check_quantize_file(p)) == ["QNT001", "QNT001"]
+
+
+def test_qnt001_silent_with_headroom_attestation(tmp_path):
+    p = _write(str(tmp_path / "hist.py"), """
+        import jax.numpy as jnp
+        def build_hist(bins, vals, F, B):
+            # headroom: n*QMAX bin sums fit int32 (quantize_wire_plan)
+            acc = jnp.zeros((3, F, B), jnp.int32)
+            return acc.at[..., bins].add(vals)
+    """)
+    assert check_quantize_file(p) == []
+
+
+def test_qnt001_silent_outside_hist_context(tmp_path):
+    # int32 index/packing arrays in non-histogram code are not
+    # accumulators — the forest node table, bin ids, argsort ranks
+    p = _write(str(tmp_path / "forest.py"), """
+        import jax.numpy as jnp
+        def pack_nodes(n):
+            return jnp.zeros((n, 4), jnp.int32)
+    """)
+    assert check_quantize_file(p) == []
+
+
+def test_qnt001_silent_on_float_accumulators(tmp_path):
+    p = _write(str(tmp_path / "hist.py"), """
+        import jax.numpy as jnp
+        def build_hist(bins, vals, F, B):
+            bin_ids = jnp.zeros(F, jnp.int8)  # not a 16/32-bit accumulator
+            return jnp.zeros((3, F, B), jnp.float32).at[..., bins].add(vals)
+    """)
+    assert check_quantize_file(p) == []
+
+
+def test_qnt001_suppression_roundtrip(tmp_path):
+    # a site whose bound lives elsewhere suppresses inline; the stale
+    # checker still sees the raw finding under the comment
+    p = _write(str(tmp_path / "hist.py"), """
+        import jax.numpy as jnp
+        def build_hist(bins, vals, F, B):
+            acc = jnp.zeros((3, F, B), jnp.int32)  # analyze: ignore[QNT001]
+            return acc.at[..., bins].add(vals)
+    """)
+    raw = check_quantize_file(p)
+    assert rules(raw) == ["QNT001"]
+    assert apply_suppressions(raw) == []
+
+
+def test_qnt001_library_int_accumulators_are_attested():
+    # every int16/int32 accumulator the quantized path ships (histogram.py
+    # chunk builders, pallas_hist.py int kernels) carries its headroom note
+    from tools.analyze.quantize_rules import check_quantize
+
+    assert apply_suppressions(check_quantize(repo_root())) == []
 
 
 # ------------------------------------------------- golden + parity gates

@@ -1,8 +1,8 @@
 """Repo-native static-analysis suite (see README.md in this directory).
 
-Ten passes over a shared project index (built once per run by
-:mod:`tools.analyze.engine`): the seven per-file-portable passes (ABI,
-collectives, tracer, hygiene, obs, serving, predict) plus the
+Eleven passes over a shared project index (built once per run by
+:mod:`tools.analyze.engine`): the eight per-file-portable passes (ABI,
+collectives, tracer, hygiene, obs, serving, predict, quantize) plus the
 index-native interprocedural passes (collective order COL005/COL006,
 serve-layer locks LCK001–003, dtype-contract flow DTY001).  Each pass
 returns :class:`tools.analyze.common.Finding` rows; :func:`run_all`
@@ -26,13 +26,14 @@ from tools.analyze.common import (
 from tools.analyze.hygiene import check_hygiene
 from tools.analyze.obs_rules import check_obs
 from tools.analyze.predict_rules import check_predict
+from tools.analyze.quantize_rules import check_quantize
 from tools.analyze.serving_rules import check_serving
 from tools.analyze.tracer import check_tracer
 
 __all__ = [
     "Finding", "run_all", "repo_root", "PASSES",
     "check_abi", "check_collectives", "check_tracer", "check_hygiene",
-    "check_obs", "check_serving", "check_predict",
+    "check_obs", "check_serving", "check_predict", "check_quantize",
 ]
 
 
@@ -77,6 +78,8 @@ PASSES = {
                 {"SRV001"}),
     "predict": (lambda root, index: check_predict(root, index=index),
                 {"PRED001"}),
+    "quantize": (lambda root, index: check_quantize(root, index=index),
+                 {"QNT001"}),
     "collective_order": (
         lambda root, index: _check_collective_order(index),
         {"COL005", "COL006"}),

@@ -58,6 +58,7 @@ COLLECTIVE_NAMES = {
     # rank-divergent guard around an in-program collective desyncs the
     # SPMD program exactly like a host collective hangs the job
     "device_psum", "device_psum_scatter", "device_all_gather",
+    "device_psum_int", "device_psum_scatter_int",
 }
 # any attribute reached through these modules is treated as a collective
 COLLECTIVE_MODULES = {"multihost_utils", "mhu"}

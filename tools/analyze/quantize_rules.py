@@ -1,0 +1,169 @@
+"""Pass — quantized-histogram accumulator overflow lint (ISSUE 9).
+
+The quantized training path (``hist_quantize``) sums int16 gradient
+buckets into int16/int32 accumulators and merges shards over an integer
+wire.  Integer overflow does not produce an inf or a nan — it WRAPS,
+silently corrupting split gains in a way no downstream numeric check
+catches.  The engine's defense is static: every integer accumulator is
+sized against a worst-case bound proven before any kernel runs
+(``ops.histogram.quantize_wire_plan`` raises at train time when
+``n · QMAX`` exceeds the wire's headroom).  This pass makes that
+defense auditable.
+
+Rules
+-----
+- QNT001: an int16/int32 accumulator allocation in histogram-building
+  code — ``jnp.zeros/full/empty`` (or ``ShapeDtypeStruct`` out-shapes,
+  the Pallas grid accumulator) with an int16/int32 dtype, or a
+  ``preferred_element_type`` of int16/int32 — whose enclosing function
+  does not ATTEST its overflow budget.  Attestation is a ``headroom``
+  token (comment or docstring) anywhere in an enclosing function's
+  span, stating why the worst-case sum fits (typically by citing
+  ``quantize_wire_plan``).  "Histogram-building code" means the file
+  or an enclosing function is named like a histogram builder
+  (``hist`` in the name) — int32 index/bin arrays elsewhere are not
+  accumulators and stay quiet.
+
+Module-level allocations (rare: constants, test scaffolding) are
+checked against the 10 lines above the call instead of a function
+span.  ``# analyze: ignore[QNT001]`` suppresses a site whose bound is
+established elsewhere.
+"""
+
+from __future__ import annotations
+
+import ast
+import glob
+import os
+import re
+
+from tools.analyze.common import Finding
+
+# allocation constructors whose result is (or shapes) an accumulator
+ALLOC_NAMES = {
+    "zeros", "full", "empty", "zeros_like", "full_like",
+    "ShapeDtypeStruct",
+}
+
+_INT_RE = re.compile(r"\bu?int(?:16|32)\b")
+_ATTEST = "headroom"
+_HIST = "hist"
+# module-level fallback: attestation may sit this many lines above
+_MODULE_REACH = 10
+
+
+def _int_alloc_kind(call: ast.Call) -> "str | None":
+    """``"alloc"`` for an int16/int32 constructor call, ``"matmul"``
+    for an integer ``preferred_element_type``, else None."""
+    fn = call.func
+    name = None
+    if isinstance(fn, ast.Name):
+        name = fn.id
+    elif isinstance(fn, ast.Attribute):
+        name = fn.attr
+    if name in ALLOC_NAMES:
+        srcs = [ast.unparse(a) for a in call.args]
+        srcs += [
+            ast.unparse(k.value) for k in call.keywords
+            if k.arg in (None, "dtype")
+        ]
+        if any(_INT_RE.search(s) for s in srcs):
+            return "alloc"
+    for k in call.keywords:
+        if k.arg == "preferred_element_type" and _INT_RE.search(
+                ast.unparse(k.value)):
+            return "matmul"
+    return None
+
+
+class _Scanner:
+    def __init__(self, path: str, lines: "list[str]"):
+        self.path = path
+        self.lines = lines
+        self.file_is_hist = _HIST in os.path.basename(path).lower()
+        self.findings: "list[Finding]" = []
+
+    def _span_attests(self, fn) -> bool:
+        lo = fn.lineno
+        hi = getattr(fn, "end_lineno", fn.lineno) or fn.lineno
+        return any(
+            _ATTEST in ln.lower() for ln in self.lines[lo - 1:hi]
+        )
+
+    def _module_attests(self, lineno: int) -> bool:
+        lo = max(0, lineno - 1 - _MODULE_REACH)
+        return any(
+            _ATTEST in ln.lower() for ln in self.lines[lo:lineno]
+        )
+
+    def visit(self, node, fn_stack: "list"):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            fn_stack = fn_stack + [node]
+        elif isinstance(node, ast.Call):
+            self._check_call(node, fn_stack)
+        for child in ast.iter_child_nodes(node):
+            self.visit(child, fn_stack)
+
+    def _check_call(self, call: ast.Call, fn_stack: "list"):
+        kind = _int_alloc_kind(call)
+        if kind is None:
+            return
+        in_hist = self.file_is_hist or any(
+            _HIST in f.name.lower() for f in fn_stack
+        )
+        if not in_hist:
+            return
+        if fn_stack:
+            if any(self._span_attests(f) for f in fn_stack):
+                return
+            where = f"function {fn_stack[-1].name}()"
+        else:
+            if self._module_attests(call.lineno):
+                return
+            where = "module scope"
+        what = (
+            "integer matmul accumulator (preferred_element_type)"
+            if kind == "matmul"
+            else f"int accumulator {ast.unparse(call.func)}(...)"
+        )
+        self.findings.append(Finding(
+            self.path, call.lineno, "QNT001",
+            f"{what} in histogram code without attested headroom — "
+            f"integer overflow wraps silently; add a 'headroom:' "
+            f"comment in {where} proving the worst-case sum fits "
+            "(cite ops.histogram.quantize_wire_plan), or suppress "
+            "with analyze: ignore[QNT001] if the bound is "
+            "established elsewhere",
+        ))
+
+
+def check_quantize(root: str, index=None) -> list:
+    findings: list = []
+    if index is not None:
+        for mi in index.package_modules():
+            findings.extend(
+                check_quantize_file(mi.path, tree=mi.tree, text=mi.text)
+            )
+        return findings
+    pkg = os.path.join(root, "mmlspark_tpu")
+    for py in sorted(glob.glob(os.path.join(pkg, "**", "*.py"),
+                               recursive=True)):
+        findings.extend(check_quantize_file(py))
+    return findings
+
+
+def check_quantize_file(path: str, tree=None, text=None) -> list:
+    if text is None:
+        try:
+            with open(path, encoding="utf-8", errors="replace") as fh:
+                text = fh.read()
+        except OSError:
+            return []
+    if tree is None:
+        try:
+            tree = ast.parse(text, filename=path)
+        except SyntaxError:
+            return []
+    s = _Scanner(path, text.splitlines())
+    s.visit(tree, [])
+    return s.findings

@@ -68,6 +68,9 @@ class CollectiveRecorder:
         import jax
 
         for leaf in jax.tree_util.tree_leaves(out):
+            if not hasattr(leaf, "shape"):
+                continue  # psum of a Python scalar constant-folds to an
+                # int (the axis-size idiom) — no bytes move
             self.calls.append((kind, tuple(leaf.shape), str(leaf.dtype),
                                int(np.prod(leaf.shape)) * leaf.dtype.itemsize))
 
@@ -186,6 +189,11 @@ def run_child(n_dev: int):
              ("data_bf16wire", dict(tree_learner="data",
                                     hist_merge="allreduce",
                                     hist_psum_dtype="bfloat16")),
+             # ISSUE 9: int16 gradient buckets + integer merge wire — the
+             # recorder shows the hist merge riding int16 (half the f32
+             # bytes) and the AUC column quality-gates the quantization
+             ("data_quantize", dict(tree_learner="data",
+                                    hist_quantize="int16")),
              ("voting", dict(tree_learner="voting"))]
     if n_dev == 1:
         modes = [("data", dict(tree_learner="serial"))]

@@ -26,6 +26,7 @@ _MODE_LABEL = {
     "data": "data (auto merge)",
     "data_allreduce": "data + allreduce",
     "data_bf16wire": "data + allreduce + bf16 wire",
+    "data_quantize": "data + int16 quantized wire",
     "voting": "voting",
 }
 
