@@ -1,0 +1,48 @@
+"""Out-of-core data plane: shard loading, quantile sketches, streaming.
+
+ROADMAP item 2 — training data larger than host RAM.  Shards on disk
+(:mod:`~mmlspark_tpu.data.loader`) stream as fixed-size chunks through a
+double-buffered host→device pipeline; global bin edges come from merged
+per-shard quantile sketches (:mod:`~mmlspark_tpu.data.sketch`, no full
+data pass); training-side binning runs on device through the
+:class:`~mmlspark_tpu.ops.binning.BinningAuthority`
+(:mod:`~mmlspark_tpu.data.streaming`).
+
+Ingest hot-path hygiene is enforced by analyzer rule ING001
+(``tools/analyze/ingest_rules.py``): nothing in this package may
+materialize a full dataset on host.
+"""
+
+from mmlspark_tpu.data.loader import (
+    Chunk,
+    ChunkPrefetcher,
+    NpySource,
+    RowGroupSource,
+    chunk_stream,
+    write_row_group_shards,
+)
+from mmlspark_tpu.data.sketch import (
+    DatasetSketch,
+    merge_sketch_states,
+)
+from mmlspark_tpu.data.streaming import (
+    StreamedDataset,
+    stream_fit_binning,
+    stream_ingest,
+    train_streaming,
+)
+
+__all__ = [
+    "Chunk",
+    "ChunkPrefetcher",
+    "NpySource",
+    "RowGroupSource",
+    "chunk_stream",
+    "write_row_group_shards",
+    "DatasetSketch",
+    "merge_sketch_states",
+    "StreamedDataset",
+    "stream_fit_binning",
+    "stream_ingest",
+    "train_streaming",
+]

@@ -1,0 +1,282 @@
+"""Out-of-core shard loading: mmap shards → fixed-size chunks → prefetch.
+
+The ingestion data plane for ROADMAP item 2 ("data larger than host
+RAM").  Datasets live on disk as SHARDS — plain ``.npy`` files opened
+with ``mmap_mode="r"`` or arrow-style row-group containers
+(:func:`write_row_group_shards` / :class:`RowGroupSource`) — and stream
+through training as fixed-size row CHUNKS:
+
+- no full-dataset host materialization, ever: each chunk is the only
+  host copy alive (peak host resident bytes = O(chunk), asserted in
+  ``tests/test_streaming.py``);
+- chunks cross shard boundaries transparently (a chunk may stitch the
+  tail of one shard to the head of the next), so shard layout never
+  constrains ``chunk_rows``;
+- :class:`ChunkPrefetcher` double-buffers chunks on a background thread
+  (read/convert the NEXT chunk — and optionally ``jax.device_put`` it —
+  while the consumer bins/accumulates the current one).
+
+obs counters (surfaced by ``python -m tools.obs report``):
+``ingest.chunks`` / ``ingest.bytes`` count produced chunk payloads;
+``ingest.buffer_stall_ns`` accumulates time the CONSUMER spent blocked
+waiting on the prefetch queue — ~0 means the pipeline hid the host I/O
+behind compute, large values mean disk/convert is the bottleneck.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import queue
+import threading
+import time
+from typing import Iterator, List, NamedTuple, Optional, Sequence
+
+import numpy as np
+
+from mmlspark_tpu import obs
+
+
+class Chunk(NamedTuple):
+    """One streamed slice of the dataset."""
+
+    X: np.ndarray            # (rows, F) float32, C-contiguous
+    y: Optional[np.ndarray]  # (rows,) float64 labels, when the source has them
+    start: int               # global row offset of this chunk
+    index: int               # chunk ordinal
+
+
+class NpySource:
+    """Shards as ``.npy`` files, memory-mapped (never fully loaded).
+
+    ``paths`` are the per-shard feature matrices; ``label_paths`` (same
+    length, same per-shard row counts) are optional per-shard label
+    vectors.
+    """
+
+    def __init__(
+        self,
+        paths: Sequence[str],
+        label_paths: Optional[Sequence[str]] = None,
+    ):
+        # a bare path would iterate character-by-character below
+        if isinstance(paths, (str, os.PathLike)):
+            paths = [paths]
+        if isinstance(label_paths, (str, os.PathLike)):
+            label_paths = [label_paths]
+        if not paths:
+            raise ValueError("NpySource needs at least one shard path")
+        if label_paths is not None and len(label_paths) != len(paths):
+            raise ValueError("label_paths must pair 1:1 with shard paths")
+        self.paths = [os.fspath(p) for p in paths]
+        self.label_paths = (
+            None if label_paths is None
+            else [os.fspath(p) for p in label_paths]
+        )
+        self._shapes: List[tuple] = []
+        F = None
+        for p in self.paths:
+            arr = np.load(p, mmap_mode="r")
+            if arr.ndim != 2:
+                raise ValueError(f"shard {p} is not 2-D: shape {arr.shape}")
+            if F is None:
+                F = arr.shape[1]
+            elif arr.shape[1] != F:
+                raise ValueError(
+                    f"shard {p} has {arr.shape[1]} features, expected {F}"
+                )
+            self._shapes.append(arr.shape)
+        self.num_features = int(F)
+        self.num_rows = int(sum(s[0] for s in self._shapes))
+
+    def iter_shards(self) -> Iterator[tuple]:
+        for i, p in enumerate(self.paths):
+            X = np.load(p, mmap_mode="r")
+            y = None
+            if self.label_paths is not None:
+                y = np.load(self.label_paths[i], mmap_mode="r")
+                if len(y) != len(X):
+                    raise ValueError(
+                        f"label shard {self.label_paths[i]} has {len(y)} "
+                        f"rows, feature shard has {len(X)}"
+                    )
+            yield X, y
+
+
+class RowGroupSource:
+    """Arrow-style row-group container written by
+    :func:`write_row_group_shards`: a manifest plus raw row-major f32
+    group files, each group memory-mapped on demand."""
+
+    def __init__(self, path: str):
+        self.path = os.fspath(path)
+        with open(os.path.join(self.path, "manifest.json")) as fh:
+            self.manifest = json.load(fh)
+        if int(self.manifest.get("version", 0)) != 1:
+            raise ValueError(
+                f"unknown row-group manifest version in {self.path}"
+            )
+        self.num_rows = int(self.manifest["num_rows"])
+        self.num_features = int(self.manifest["num_features"])
+
+    def iter_shards(self) -> Iterator[tuple]:
+        F = self.num_features
+        label_file = self.manifest.get("label_file")
+        y_all = None
+        if label_file:
+            y_all = np.memmap(
+                os.path.join(self.path, label_file), np.float32, mode="r",
+                shape=(self.num_rows,),
+            )
+        off = 0
+        for g in self.manifest["groups"]:
+            rows = int(g["rows"])
+            X = np.memmap(
+                os.path.join(self.path, g["file"]), np.float32, mode="r",
+                shape=(rows, F),
+            )
+            y = None if y_all is None else y_all[off:off + rows]
+            off += rows
+            yield X, y
+
+
+def write_row_group_shards(
+    path: str,
+    X: np.ndarray,
+    y: Optional[np.ndarray] = None,
+    rows_per_group: int = 65536,
+) -> str:
+    """Write a row-group container (test/bench fixture writer — the ONE
+    place allowed to hold the full matrix, since it is producing the
+    on-disk layout the streaming paths then read back chunked)."""
+    X = np.asarray(X, np.float32)  # analyze: ignore[ING001] fixture writer
+    os.makedirs(path, exist_ok=True)
+    groups = []
+    for gi, start in enumerate(range(0, len(X), rows_per_group)):
+        block = np.ascontiguousarray(X[start:start + rows_per_group])
+        fname = f"rg-{gi:05d}.bin"
+        block.tofile(os.path.join(path, fname))
+        groups.append({"file": fname, "rows": int(len(block))})
+    manifest = {
+        "version": 1,
+        "num_rows": int(len(X)),
+        "num_features": int(X.shape[1]),
+        "dtype": "float32",
+        "groups": groups,
+    }
+    if y is not None:
+        np.asarray(y, np.float32).tofile(  # analyze: ignore[ING001] fixture writer
+            os.path.join(path, "labels.bin")
+        )
+        manifest["label_file"] = "labels.bin"
+    with open(os.path.join(path, "manifest.json"), "w") as fh:
+        json.dump(manifest, fh)
+    return path
+
+
+def chunk_stream(source, chunk_rows: int) -> Iterator[Chunk]:
+    """Re-chunk a shard source into fixed ``chunk_rows`` slices.
+
+    Every yielded chunk except possibly the last has exactly
+    ``chunk_rows`` rows; chunks stitch across shard boundaries.  Each
+    chunk is freshly allocated (f32 features, f64 labels) — the caller
+    may donate/consume it — and the mmap'd shards are only ever sliced
+    per-chunk, so host residency stays O(chunk).
+    """
+    if chunk_rows <= 0:
+        raise ValueError(f"chunk_rows must be positive, got {chunk_rows}")
+    F = source.num_features
+    buf_X = np.empty((chunk_rows, F), np.float32)
+    buf_y: Optional[np.ndarray] = None
+    filled = 0
+    start = 0
+    index = 0
+    for X_shard, y_shard in source.iter_shards():
+        off = 0
+        n_shard = len(X_shard)
+        while off < n_shard:
+            take = min(chunk_rows - filled, n_shard - off)
+            buf_X[filled:filled + take] = X_shard[off:off + take]
+            if y_shard is not None:
+                if buf_y is None:
+                    buf_y = np.empty(chunk_rows, np.float64)
+                buf_y[filled:filled + take] = y_shard[off:off + take]
+            filled += take
+            off += take
+            if filled == chunk_rows:
+                yield Chunk(
+                    buf_X, None if buf_y is None else buf_y, start, index
+                )
+                start += filled
+                index += 1
+                filled = 0
+                # fresh buffers: the consumer owns the yielded arrays
+                buf_X = np.empty((chunk_rows, F), np.float32)
+                buf_y = None if buf_y is None else np.empty(
+                    chunk_rows, np.float64
+                )
+    if filled:
+        yield Chunk(
+            np.ascontiguousarray(buf_X[:filled]),
+            None if buf_y is None else buf_y[:filled].copy(),
+            start, index,
+        )
+
+
+class ChunkPrefetcher:
+    """Double-buffered chunk pipeline: a background thread pulls chunks
+    (optionally mapping each through ``transform`` — e.g. pad + device
+    upload) into a bounded queue while the consumer works.
+
+    ``depth=2`` is classic double buffering: one chunk in flight behind
+    the one being consumed.  Iterating yields the transformed chunks in
+    order; producer exceptions re-raise in the consumer.
+    """
+
+    _DONE = object()
+
+    def __init__(self, chunks: Iterator[Chunk], transform=None, depth: int = 2):
+        self._q: "queue.Queue" = queue.Queue(maxsize=max(1, depth))
+        self._transform = transform
+        self._err: Optional[BaseException] = None
+        self._thread = threading.Thread(
+            target=self._produce, args=(chunks,),
+            name="mmlspark-tpu-ingest-prefetch", daemon=True,
+        )
+        self._thread.start()
+
+    def _produce(self, chunks) -> None:
+        try:
+            for chunk in chunks:
+                if obs.enabled():
+                    obs.inc("ingest.chunks")
+                    obs.inc("ingest.bytes", float(chunk.X.nbytes))
+                item = chunk if self._transform is None else self._transform(chunk)
+                self._q.put(item)
+        except BaseException as e:  # surfaced on the consumer side
+            self._err = e
+        finally:
+            self._q.put(self._DONE)
+
+    def __iter__(self):
+        while True:
+            t0 = time.perf_counter_ns()
+            while True:
+                try:
+                    item = self._q.get(timeout=1.0)
+                    break
+                except queue.Empty:
+                    if not self._thread.is_alive() and self._q.empty():
+                        # producer died without posting the sentinel
+                        # (e.g. killed interpreter-side); don't park forever
+                        if self._err is not None:
+                            raise self._err
+                        return
+            stall = time.perf_counter_ns() - t0
+            if obs.enabled():
+                obs.inc("ingest.buffer_stall_ns", float(stall))
+            if item is self._DONE:
+                if self._err is not None:
+                    raise self._err
+                return
+            yield item

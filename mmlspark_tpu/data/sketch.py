@@ -1,0 +1,466 @@
+"""Mergeable per-feature quantile sketches for streaming bin finding.
+
+The out-of-core ingestion path (ROADMAP item 2) fixes global bin edges
+WITHOUT a full-dataset pass: each chunk/shard/process folds its rows into
+a :class:`DatasetSketch`, sketches merge associatively (locally chunk by
+chunk, then across processes via the sanctioned
+``parallel/distributed.py`` control-plane allgather), and the merged
+sketch derives edges through the SAME greedy equal-mass walk
+``BinMapper._fit_numeric`` uses (:func:`mmlspark_tpu.ops.binning.
+numeric_uppers_from_distinct`) — one edge formula, two feeders.
+
+Two regimes per numeric feature:
+
+- **Exact mode** — distinct ``(value, count)`` pairs are kept verbatim up
+  to ``exact_budget`` distincts.  Any feature whose cardinality fits the
+  budget reproduces the full-pass ``BinMapper`` edges BIT-FOR-BIT (the
+  walk sees the identical distinct/count arrays), which is what makes
+  stream-binned training bitwise-identical to host-binned training on
+  such data.
+- **Sketch mode** — past the budget the pairs spill into a KLL-style
+  compactor hierarchy (Karnin–Lang–Liberty 2016, simplified to equal
+  per-level capacities): level ``i`` holds items of weight ``2**i``; a
+  full level sorts, keeps every other item (deterministic alternating
+  parity — no RNG, so same chunking ⇒ same sketch), and promotes the
+  survivors.  Each compaction of level ``i`` perturbs any rank by at most
+  ``2**i``, so the worst-case rank error after ``c_i`` compactions per
+  level is ``Σ c_i·2**i ≤ H·n/cap`` with ``H`` levels — the declared
+  epsilon below (:attr:`DatasetSketch.rank_epsilon`), default
+  ``cap=2048`` ⇒ ε ≈ 1e-2·H/20 per unit rank, i.e. bin boundaries land
+  within ~ε·n sample ranks of the exact equal-mass boundaries.
+
+Categorical features and NaN never approximate: category counts are
+exact mergeable maps (mirroring ``_fit_categorical``'s
+most-frequent-first selection) and NaN is counted per feature and
+excluded from every sketch (missing-bin routing happens at transform
+time, not fit time).
+
+Everything serializes to one flat float64 vector (`to_state` /
+`from_state`) so cross-process merge rides ``host_allgather`` raw-bytes
+semantics — bit-exact f64 on the wire, no pickle.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from mmlspark_tpu.ops.binning import (
+    BinMapper,
+    numeric_uppers_from_distinct,
+)
+
+# Distinct-pair budget under which a feature stays exact (reproduces the
+# full-pass BinMapper edges bit-for-bit).  Default comfortably covers the
+# ≤max_bin-distinct "one bin per value" regime AND typical few-thousand-
+# distinct columns.
+DEFAULT_EXACT_BUDGET = 8192
+# Per-level compactor capacity in sketch mode (items, not bytes).
+DEFAULT_COMPACTOR_CAP = 2048
+
+
+def _merge_distinct(va, ca, vb, cb):
+    """Merge two sorted (values, counts) distinct sets."""
+    v = np.concatenate([va, vb])
+    c = np.concatenate([ca, cb])
+    order = np.argsort(v, kind="stable")
+    v, c = v[order], c[order]
+    uniq, inv = np.unique(v, return_inverse=True)
+    out = np.zeros(len(uniq), np.int64)
+    np.add.at(out, inv, c)
+    return uniq, out
+
+
+class _NumericSketch:
+    """One numeric feature: exact distinct pairs → KLL compactors."""
+
+    __slots__ = ("exact_budget", "cap", "vals", "cnts", "levels",
+                 "_parity", "nan_count", "compactions")
+
+    def __init__(self, exact_budget: int, cap: int):
+        self.exact_budget = int(exact_budget)
+        self.cap = int(cap)
+        self.vals = np.empty(0, np.float64)   # exact distinct values (sorted)
+        self.cnts = np.empty(0, np.int64)     # exact counts
+        self.levels: Optional[List[np.ndarray]] = None  # sketch mode when set
+        self._parity = 0        # deterministic compaction coin
+        self.nan_count = 0
+        self.compactions = np.zeros(0, np.int64)  # per-level compaction count
+
+    # -- ingest --------------------------------------------------------
+    def add(self, col: np.ndarray) -> None:
+        col = np.asarray(col, np.float64).reshape(-1)
+        nan = np.isnan(col)
+        self.nan_count += int(nan.sum())
+        col = col[~nan]
+        if not len(col):
+            return
+        v, c = np.unique(col, return_counts=True)
+        if self.levels is None:
+            self.vals, self.cnts = _merge_distinct(self.vals, self.cnts, v, c)
+            if len(self.vals) > self.exact_budget:
+                self._spill()
+        else:
+            self._push_pairs(v, c.astype(np.int64))
+
+    def _spill(self) -> None:
+        """Exact → sketch: decompose each count into powers of two, so the
+        hierarchy starts as an EXACT weighted representation."""
+        self.levels = []
+        self.compactions = np.zeros(0, np.int64)
+        self._push_pairs(self.vals, self.cnts)
+        self.vals = np.empty(0, np.float64)
+        self.cnts = np.empty(0, np.int64)
+
+    def _push_pairs(self, vals: np.ndarray, cnts: np.ndarray) -> None:
+        """Fold (value, count) pairs into the hierarchy: value enters level
+        ``b`` once for every set bit ``b`` of its count (weight 2**b)."""
+        cnts = cnts.copy()
+        level = 0
+        while np.any(cnts):
+            odd = (cnts & 1).astype(bool)
+            if np.any(odd):
+                self._append(level, vals[odd])
+            cnts >>= 1
+            level += 1
+        self._compact_all()
+
+    def _append(self, level: int, items: np.ndarray) -> None:
+        while len(self.levels) <= level:
+            self.levels.append(np.empty(0, np.float64))
+        self.levels[level] = np.concatenate([self.levels[level], items])
+        if len(self.compactions) < len(self.levels):
+            self.compactions = np.concatenate([
+                self.compactions,
+                np.zeros(len(self.levels) - len(self.compactions), np.int64),
+            ])
+
+    def _compact_all(self) -> None:
+        lvl = 0
+        while lvl < len(self.levels):
+            buf = self.levels[lvl]
+            if len(buf) > self.cap:
+                buf = np.sort(buf, kind="stable")
+                keep = len(buf) & 1  # odd leftover stays at this level
+                body = buf[keep:]
+                # alternate survivor parity deterministically
+                survivors = body[self._parity::2]
+                self._parity ^= 1
+                self.levels[lvl] = buf[:keep]
+                self._append(lvl + 1, survivors)
+                self.compactions[lvl] += 1
+            lvl += 1
+
+    # -- merge ---------------------------------------------------------
+    def merge(self, other: "_NumericSketch") -> None:
+        self.nan_count += other.nan_count
+        if self.levels is None and other.levels is None:
+            self.vals, self.cnts = _merge_distinct(
+                self.vals, self.cnts, other.vals, other.cnts
+            )
+            if len(self.vals) > self.exact_budget:
+                self._spill()
+            return
+        if self.levels is None:
+            self._spill()
+        if other.levels is None:
+            self._push_pairs(other.vals, other.cnts)
+        else:
+            for lvl, buf in enumerate(other.levels):
+                if len(buf):
+                    self._append(lvl, buf)
+            k = len(other.compactions)
+            if k:
+                if len(self.compactions) < k:
+                    self.compactions = np.concatenate([
+                        self.compactions,
+                        np.zeros(k - len(self.compactions), np.int64),
+                    ])
+                self.compactions[:k] += other.compactions
+            self._compact_all()
+
+    # -- derive --------------------------------------------------------
+    @property
+    def is_exact(self) -> bool:
+        return self.levels is None
+
+    def total_weight(self) -> int:
+        if self.levels is None:
+            return int(self.cnts.sum())
+        return int(sum(len(b) << i for i, b in enumerate(self.levels)))
+
+    def rank_error_bound(self) -> int:
+        """Worst-case absolute rank perturbation: each compaction of level
+        ``i`` moves any rank by ≤ 2**i."""
+        if self.levels is None:
+            return 0
+        return int(sum(int(c) << i for i, c in enumerate(self.compactions)))
+
+    def weighted_distinct(self):
+        """(distinct values, weights) — exact counts in exact mode, KLL
+        weight estimates in sketch mode."""
+        if self.levels is None:
+            return self.vals, self.cnts
+        if not any(len(b) for b in self.levels):
+            return np.empty(0, np.float64), np.empty(0, np.int64)
+        vals = np.concatenate([b for b in self.levels if len(b)])
+        wts = np.concatenate([
+            np.full(len(b), 1 << i, np.int64)
+            for i, b in enumerate(self.levels) if len(b)
+        ])
+        order = np.argsort(vals, kind="stable")
+        vals, wts = vals[order], wts[order]
+        uniq, inv = np.unique(vals, return_inverse=True)
+        out = np.zeros(len(uniq), np.int64)
+        np.add.at(out, inv, wts)
+        return uniq, out
+
+    # -- state ---------------------------------------------------------
+    def state_parts(self) -> List[np.ndarray]:
+        if self.levels is None:
+            return [
+                np.asarray([0.0, float(self.nan_count), float(len(self.vals))]),
+                self.vals,
+                self.cnts.astype(np.float64),
+            ]
+        parts = [np.asarray([
+            1.0, float(self.nan_count), float(len(self.levels)), float(self._parity),
+        ])]
+        for i, buf in enumerate(self.levels):
+            c = self.compactions[i] if i < len(self.compactions) else 0
+            parts.append(np.asarray([float(len(buf)), float(c)]))
+            parts.append(buf)
+        return parts
+
+    @staticmethod
+    def read_state(vec: np.ndarray, off: int, exact_budget: int, cap: int):
+        sk = _NumericSketch(exact_budget, cap)
+        mode = int(vec[off])
+        if mode == 0:
+            sk.nan_count = int(vec[off + 1])
+            k = int(vec[off + 2])
+            off += 3
+            sk.vals = vec[off:off + k].copy()
+            sk.cnts = vec[off + k:off + 2 * k].astype(np.int64)
+            return sk, off + 2 * k
+        sk.nan_count = int(vec[off + 1])
+        n_levels = int(vec[off + 2])
+        sk._parity = int(vec[off + 3])
+        off += 4
+        sk.levels = []
+        sk.compactions = np.zeros(n_levels, np.int64)
+        for i in range(n_levels):
+            k, c = int(vec[off]), int(vec[off + 1])
+            off += 2
+            sk.levels.append(vec[off:off + k].copy())
+            sk.compactions[i] = c
+            off += k
+        return sk, off
+
+
+class _CatSketch:
+    """One categorical feature: exact mergeable category counts."""
+
+    __slots__ = ("counts", "nan_count")
+
+    def __init__(self):
+        self.counts: Dict[int, int] = {}
+        self.nan_count = 0
+
+    def add(self, col: np.ndarray) -> None:
+        col = np.asarray(col, np.float64).reshape(-1)
+        nan = np.isnan(col)
+        self.nan_count += int(nan.sum())
+        col = col[~nan]
+        if not len(col):
+            return
+        cats, cnts = np.unique(col.astype(np.int64), return_counts=True)
+        for cat, c in zip(cats.tolist(), cnts.tolist()):
+            self.counts[cat] = self.counts.get(cat, 0) + c
+
+    def merge(self, other: "_CatSketch") -> None:
+        self.nan_count += other.nan_count
+        for cat, c in other.counts.items():
+            self.counts[cat] = self.counts.get(cat, 0) + c
+
+    def cat_map(self, max_bin: int) -> np.ndarray:
+        """Most-frequent-first selection, EXACTLY mirroring
+        ``BinMapper._fit_categorical`` (stable argsort over sorted cats)."""
+        if not self.counts:
+            return np.empty(0, np.int64)
+        cats = np.asarray(sorted(self.counts), np.int64)
+        cnts = np.asarray([self.counts[c] for c in cats.tolist()], np.int64)
+        order = np.argsort(-cnts, kind="stable")
+        kept = cats[order][:max_bin]
+        return np.sort(kept)
+
+    def state_parts(self) -> List[np.ndarray]:
+        cats = np.asarray(sorted(self.counts), np.float64)
+        cnts = np.asarray(
+            [self.counts[int(c)] for c in cats.tolist()], np.float64
+        )
+        return [
+            np.asarray([2.0, float(self.nan_count), float(len(cats))]),
+            cats, cnts,
+        ]
+
+    @staticmethod
+    def read_state(vec: np.ndarray, off: int):
+        sk = _CatSketch()
+        sk.nan_count = int(vec[off + 1])
+        k = int(vec[off + 2])
+        off += 3
+        cats = vec[off:off + k].astype(np.int64)
+        cnts = vec[off + k:off + 2 * k].astype(np.int64)
+        sk.counts = dict(zip(cats.tolist(), cnts.tolist()))
+        return sk, off + 2 * k
+
+
+class DatasetSketch:
+    """Mergeable all-features sketch; derives a :class:`BinMapper`."""
+
+    def __init__(
+        self,
+        num_features: int,
+        max_bin: int = 255,
+        categorical_features: Sequence[int] = (),
+        min_data_in_bin: int = 3,
+        exact_budget: int = DEFAULT_EXACT_BUDGET,
+        compactor_cap: int = DEFAULT_COMPACTOR_CAP,
+    ):
+        self.num_features = int(num_features)
+        self.max_bin = int(max_bin)
+        self.categorical_features = tuple(int(f) for f in categorical_features)
+        self.min_data_in_bin = int(min_data_in_bin)
+        self.exact_budget = int(exact_budget)
+        self.compactor_cap = int(compactor_cap)
+        cat_set = set(self.categorical_features)
+        self.features = [
+            _CatSketch() if f in cat_set
+            else _NumericSketch(exact_budget, compactor_cap)
+            for f in range(self.num_features)
+        ]
+        self.n_rows = 0
+
+    # -- ingest --------------------------------------------------------
+    def update(self, X_chunk: np.ndarray) -> "DatasetSketch":
+        X_chunk = np.asarray(X_chunk)
+        if X_chunk.ndim != 2 or X_chunk.shape[1] != self.num_features:
+            raise ValueError(
+                f"chunk shape {X_chunk.shape} != (rows, {self.num_features})"
+            )
+        self.n_rows += len(X_chunk)
+        for f in range(self.num_features):
+            self.features[f].add(X_chunk[:, f])
+        return self
+
+    # -- merge ---------------------------------------------------------
+    def merge(self, other: "DatasetSketch") -> "DatasetSketch":
+        if (other.num_features != self.num_features
+                or other.categorical_features != self.categorical_features
+                or other.max_bin != self.max_bin):
+            raise ValueError("cannot merge sketches with different configs")
+        self.n_rows += other.n_rows
+        for mine, theirs in zip(self.features, other.features):
+            mine.merge(theirs)
+        return self
+
+    # -- derived properties --------------------------------------------
+    @property
+    def rank_epsilon(self) -> float:
+        """Declared worst-case RELATIVE rank error of any derived boundary:
+        max over features of (compaction rank perturbation / rows seen).
+        0.0 ⟺ every feature is exact ⟺ edges are bit-identical to a
+        full-pass ``BinMapper.fit`` on the same rows."""
+        if not self.n_rows:
+            return 0.0
+        worst = 0
+        for sk in self.features:
+            if isinstance(sk, _NumericSketch):
+                worst = max(worst, sk.rank_error_bound())
+        return worst / float(self.n_rows)
+
+    @property
+    def is_exact(self) -> bool:
+        return all(
+            sk.is_exact for sk in self.features
+            if isinstance(sk, _NumericSketch)
+        )
+
+    # -- edge derivation ------------------------------------------------
+    def to_bin_mapper(self) -> BinMapper:
+        """Edges via the SAME greedy walk as ``BinMapper._fit_numeric``
+        (shared :func:`numeric_uppers_from_distinct`), categories via the
+        same most-frequent-first selection — exact-mode features reproduce
+        the full-pass fit bit-for-bit."""
+        bm = BinMapper(
+            max_bin=self.max_bin,
+            categorical_features=self.categorical_features,
+            min_data_in_bin=self.min_data_in_bin,
+        )
+        bm.num_features = self.num_features
+        bm.upper_bounds = []
+        cat_set = set(self.categorical_features)
+        for f, sk in enumerate(self.features):
+            if f in cat_set:
+                bm.cat_maps[f] = sk.cat_map(self.max_bin)
+                bm.upper_bounds.append(np.array([np.inf]))
+            else:
+                distinct, weights = sk.weighted_distinct()
+                bm.upper_bounds.append(numeric_uppers_from_distinct(
+                    distinct, weights, self.max_bin, self.min_data_in_bin
+                ))
+        return bm
+
+    # -- serialization (flat f64, host_allgather-friendly) -------------
+    _STATE_VERSION = 1.0
+
+    def to_state(self) -> np.ndarray:
+        parts = [np.asarray([
+            self._STATE_VERSION, float(self.num_features), float(self.max_bin),
+            float(self.min_data_in_bin), float(self.exact_budget),
+            float(self.compactor_cap), float(self.n_rows),
+            float(len(self.categorical_features)),
+        ])]
+        parts.append(np.asarray(self.categorical_features, np.float64))
+        for sk in self.features:
+            parts.extend(sk.state_parts())
+        return np.concatenate(parts) if parts else np.empty(0, np.float64)
+
+    @staticmethod
+    def from_state(vec: np.ndarray) -> "DatasetSketch":
+        vec = np.asarray(vec, np.float64).reshape(-1)
+        if int(vec[0]) != int(DatasetSketch._STATE_VERSION):
+            raise ValueError(f"unknown sketch state version {vec[0]}")
+        F, max_bin, mdib = int(vec[1]), int(vec[2]), int(vec[3])
+        budget, cap, n_rows, n_cat = (
+            int(vec[4]), int(vec[5]), int(vec[6]), int(vec[7]),
+        )
+        off = 8
+        cats = tuple(int(c) for c in vec[off:off + n_cat])
+        off += n_cat
+        sk = DatasetSketch(
+            F, max_bin=max_bin, categorical_features=cats,
+            min_data_in_bin=mdib, exact_budget=budget, compactor_cap=cap,
+        )
+        sk.n_rows = n_rows
+        cat_set = set(cats)
+        for f in range(F):
+            if f in cat_set:
+                sk.features[f], off = _CatSketch.read_state(vec, off)
+            else:
+                sk.features[f], off = _NumericSketch.read_state(
+                    vec, off, budget, cap
+                )
+        return sk
+
+
+def merge_sketch_states(states: Sequence[np.ndarray]) -> DatasetSketch:
+    """Deserialize + fold per-process sketch states in process order."""
+    if not states:
+        raise ValueError("no sketch states to merge")
+    merged = DatasetSketch.from_state(states[0])
+    for s in states[1:]:
+        merged.merge(DatasetSketch.from_state(s))
+    return merged

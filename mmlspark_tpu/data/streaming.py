@@ -1,0 +1,410 @@
+"""Streamed out-of-core training: sketch-fit edges, device-side binning.
+
+The full pipeline behind :func:`train_streaming` (ROADMAP item 2):
+
+1. **Sketch pass** (host, chunked): stream chunks off the mmap'd shards
+   (:mod:`mmlspark_tpu.data.loader`) and fold each into a mergeable
+   :class:`~mmlspark_tpu.data.sketch.DatasetSketch` — no full-dataset
+   pass, no full-dataset residency.
+2. **Merge** (control plane): serialize the per-process sketch, gather
+   bit-exact f64 blobs via the sanctioned
+   :func:`~mmlspark_tpu.parallel.distributed.host_allgather_blobs`
+   collective, fold in process order, and derive global bin edges → one
+   :class:`~mmlspark_tpu.ops.binning.BinningAuthority` shared by every
+   rank.
+3. **Ingest pass** (device, double-buffered): raw f32 chunks upload
+   while the previous chunk bins ON DEVICE through the authority's
+   double-single boundary table (``ops/device_binning.py``) — the host
+   ``searchsorted`` transform is gone from the train path entirely.  The
+   binned chunk lands in a preallocated device cache via donated
+   ``dynamic_update_slice`` (O(1) extra memory per chunk), nibble-packed
+   two-rows-per-byte when ``num_bins ≤ 16`` (``ops/binpack.py``).
+4. **Train**: the resulting :class:`StreamedDataset` drops into the
+   stock ``engine/booster.py`` trainer — ``binned()`` hands back the
+   device-resident cache, so ``_train_impl`` skips host binning and goes
+   straight to padding/sharding.
+
+Host residency: O(chunk) for features (the only O(n) host arrays are the
+label/weight vectors — 8 bytes/row — and the capped quality sample).
+Current scope: single-controller (any local mesh size); with multiple
+processes the sketch/merge phases are already collective-correct, but
+the ingest pass assembles a process-local device cache, which
+``process_local`` training consumes partition-wise.
+
+obs: the whole fit rides a ``train.binning`` span with
+``train.binning.sketch`` / ``train.binning.merge`` /
+``train.binning.device_bin`` children plus the ``ingest.*`` counters
+from the loader — ``python -m tools.obs report`` shows the breakdown.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional, Sequence, Tuple
+
+import numpy as np
+
+from mmlspark_tpu import obs
+from mmlspark_tpu.data.loader import ChunkPrefetcher, chunk_stream
+from mmlspark_tpu.data.sketch import (
+    DEFAULT_COMPACTOR_CAP,
+    DEFAULT_EXACT_BUDGET,
+    DatasetSketch,
+    merge_sketch_states,
+)
+from mmlspark_tpu.ops.binning import BinningAuthority
+
+DEFAULT_CHUNK_ROWS = 65536
+
+
+def stream_fit_binning(
+    source,
+    max_bin: int = 255,
+    categorical_features: Sequence[int] = (),
+    chunk_rows: int = DEFAULT_CHUNK_ROWS,
+    min_data_in_bin: int = 3,
+    exact_budget: int = DEFAULT_EXACT_BUDGET,
+    compactor_cap: int = DEFAULT_COMPACTOR_CAP,
+) -> Tuple[BinningAuthority, DatasetSketch]:
+    """Chunked sketch pass + cross-process merge → global bin edges.
+
+    Returns ``(authority, merged_sketch)`` — the sketch is returned so
+    callers can read ``rank_epsilon`` / ``is_exact`` (the declared
+    accuracy of the derived edges).  Every process must call this
+    collectively (it ends in an allgather); all processes return
+    identical edges.
+    """
+    import jax
+
+    sk = DatasetSketch(
+        source.num_features, max_bin=max_bin,
+        categorical_features=categorical_features,
+        min_data_in_bin=min_data_in_bin, exact_budget=exact_budget,
+        compactor_cap=compactor_cap,
+    )
+    with obs.span("train.binning.sketch", features=source.num_features):
+        # prefetch thread overlaps shard I/O with sketch folding
+        for chunk in ChunkPrefetcher(chunk_stream(source, chunk_rows)):
+            sk.update(chunk.X)
+    with obs.span("train.binning.merge", processes=jax.process_count()):
+        from mmlspark_tpu.parallel.distributed import host_allgather_blobs
+
+        if jax.process_count() > 1:
+            merged = merge_sketch_states(host_allgather_blobs(sk.to_state()))
+        else:
+            merged = sk
+        authority = BinningAuthority.from_sketch(merged)
+    return authority, merged
+
+
+class StreamedDataset:
+    """A :class:`~mmlspark_tpu.engine.booster.Dataset` stand-in whose
+    binned matrix lives ON DEVICE (assembled chunk-by-chunk by
+    :func:`stream_ingest`) and whose raw ``X`` never existed host-resident.
+
+    Duck-typed against the trainer's Dataset surface: ``binned()`` /
+    ``fitted_mapper()`` / ``label`` / ``num_rows`` / the cache dicts —
+    plus ``quality_feature_specs`` / ``quality_binned_sample``, the
+    streamed substitutes the quality-baseline capture uses instead of
+    materializing the full binned matrix on host.
+    """
+
+    def __init__(
+        self,
+        *,
+        authority: BinningAuthority,
+        binned_dev,
+        packed: bool,
+        num_rows: int,
+        num_features: int,
+        label: Optional[np.ndarray] = None,
+        weight: Optional[np.ndarray] = None,
+        occupancy: Optional[np.ndarray] = None,
+        sample: Optional[np.ndarray] = None,
+    ):
+        self.authority = authority
+        self._binned_dev = binned_dev
+        self._packed = bool(packed)
+        self.num_rows = int(num_rows)
+        self.num_features = int(num_features)
+        self.X = None  # the whole point: raw features never fully on host
+        self.label = None if label is None else np.asarray(label, np.float64)
+        self.weight = None if weight is None else np.asarray(weight, np.float64)
+        self.group = None
+        self.init_score = None
+        self._occupancy = occupancy  # (F, B) int64 exact bin occupancy
+        self._sample = sample        # (≤cap, F) uint8 host quality sample
+        # trainer-facing caches (same contract as Dataset's)
+        self._mapper_cache = {}
+        self._bins_cache = {}
+        self._dev_bins_cache = {}
+        self._cache_refs = []
+
+    @property
+    def packed(self) -> bool:
+        """True when the device cache is nibble-packed (2 rows/byte)."""
+        return self._packed
+
+    @property
+    def binned_cache_nbytes(self) -> int:
+        return int(self._binned_dev.nbytes)
+
+    def __getstate__(self):
+        raise TypeError(
+            "StreamedDataset holds a device-resident cache and cannot be "
+            "pickled; persist the shard source path + BinningAuthority "
+            "and re-ingest instead"
+        )
+
+    def fitted_mapper(self, cfg):
+        """The edges are FIXED by the stream fit; a config asking for
+        different binning cannot be honored post-ingest."""
+        bm = self.authority.mapper
+        if (int(cfg.max_bin) != int(bm.max_bin)
+                or tuple(cfg.categorical_feature)
+                != tuple(bm.categorical_features)):
+            raise ValueError(
+                "StreamedDataset was ingested with max_bin="
+                f"{bm.max_bin}, categorical={tuple(bm.categorical_features)}; "
+                f"training asked for max_bin={cfg.max_bin}, categorical="
+                f"{tuple(cfg.categorical_feature)} — re-run stream_fit_"
+                "binning/stream_ingest with the new binning config"
+            )
+        return bm
+
+    def binned(self, bin_mapper):
+        """The device-resident binned matrix (unpacked view).  Cached per
+        mapper id like ``Dataset.binned`` — the unpack of a packed cache
+        happens once per mapper, on device."""
+        if bin_mapper is not self.authority.mapper and (
+            int(bin_mapper.num_bins) != int(self.authority.num_bins)
+        ):
+            raise ValueError(
+                "StreamedDataset is bound to its ingest-time bin edges; "
+                "got a mapper with a different bin count"
+            )
+        key = id(bin_mapper)
+        bins = self._bins_cache.get(key)
+        if bins is None:
+            if self._packed:
+                import jax
+
+                from mmlspark_tpu.ops.binpack import unpack_rows
+
+                bins = jax.jit(
+                    unpack_rows, static_argnums=1
+                )(self._binned_dev, self.num_rows)
+            else:
+                bins = self._binned_dev
+            self._bins_cache = {key: bins}
+            self._dev_bins_cache = {}
+            self._cache_refs = [bin_mapper]
+        return bins
+
+    # -- quality-baseline hooks (no full host materialization) ---------
+    def quality_feature_specs(self, bin_mapper):
+        """Per-feature occupancy specs from the EXACT per-chunk device
+        tallies accumulated during ingest — the streamed substitute for
+        ``quality.feature_specs_from_binned`` over a host matrix."""
+        if self._occupancy is None:
+            return None
+        occ = np.asarray(self._occupancy)
+        missing_bin = int(bin_mapper.missing_bin)
+        specs = []
+        for f in range(self.num_features):
+            counts_full = occ[f]
+            if bin_mapper.is_categorical(f):
+                cats = np.asarray(
+                    bin_mapper.cat_maps.get(f, np.empty(0, np.int64)),
+                    np.int64,
+                )
+                nv = len(cats)
+                spec = {"kind": "cat", "cats": cats.tolist()}
+            else:
+                edges = np.asarray(bin_mapper.upper_bounds[f], np.float64)
+                nv = len(edges)
+                spec = {"kind": "num", "edges": edges.tolist()}
+            counts = np.concatenate(
+                [counts_full[:nv], [counts_full[missing_bin]]]
+            )
+            spec["counts"] = counts.astype(float).tolist()
+            specs.append(spec)
+        return specs
+
+    def quality_binned_sample(self, cap: int) -> Optional[np.ndarray]:
+        """Capped binned row sample collected during ingest (host uint8)."""
+        if self._sample is None or not len(self._sample):
+            return None
+        return self._sample[:cap]
+
+
+def stream_ingest(
+    source,
+    authority: BinningAuthority,
+    chunk_rows: int = DEFAULT_CHUNK_ROWS,
+    pack: str = "auto",
+    quality_sample_cap: int = 4096,
+    seed: int = 0,
+) -> StreamedDataset:
+    """Double-buffered raw-f32 upload + on-device binning into a
+    persistent device cache.
+
+    Per chunk: the prefetch thread reads the next chunk off the shards
+    and issues its ``jax.device_put`` while the CURRENT chunk runs the
+    device binning program and lands in the preallocated cache via a
+    donated ``dynamic_update_slice``.  Host never holds more than the
+    in-flight chunks; the host ``BinMapper.transform`` pass is gone.
+
+    ``pack="auto"`` nibble-packs the cache when ``num_bins ≤ 16``
+    (halving its bytes); ``"never"`` forces plain uint8.
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    from mmlspark_tpu.ops.binpack import can_pack, pack_rows
+    from mmlspark_tpu.ops.device_binning import bin_rows_device
+
+    if pack not in ("auto", "never"):
+        raise ValueError(f"pack must be 'auto' or 'never', got {pack!r}")
+    binner = authority.device_binner()
+    n, F = int(source.num_rows), int(source.num_features)
+    B = int(authority.num_bins)
+    do_pack = pack == "auto" and can_pack(B)
+    if do_pack and chunk_rows % 2:
+        chunk_rows += 1  # row pairs must not straddle chunks
+
+    missing_bin, n_bounds = binner.missing_bin, binner.n_bounds
+
+    def _bin(arrays, rows):
+        return bin_rows_device(
+            arrays, rows, missing_bin=missing_bin, n_bounds=n_bounds
+        )
+
+    bin_chunk = jax.jit(_bin)
+
+    def _update(buf, binned_u8, start):
+        return lax.dynamic_update_slice(buf, binned_u8, (start, 0))
+
+    # donated: the cache is rewritten in place chunk by chunk (O(1) extra
+    # device memory per update on backends with donation)
+    update = jax.jit(_update, donate_argnums=0)
+
+    def _occ(counts, binned):
+        f_idx = jnp.broadcast_to(
+            jnp.arange(F, dtype=jnp.int32)[None, :], binned.shape
+        )
+        return counts.at[f_idx, binned].add(1)
+
+    occ_update = jax.jit(_occ, donate_argnums=0)
+
+    buf_rows = (n + 1) // 2 if do_pack else n
+    buf = jnp.zeros((buf_rows, F), jnp.uint8)
+    occupancy = jnp.zeros((F, B), jnp.int32)
+    label = None
+    sample_parts = []
+    sample_per_chunk = (
+        0 if quality_sample_cap <= 0 or n == 0
+        else max(1, math.ceil(quality_sample_cap * chunk_rows / n))
+    )
+
+    with obs.span(
+        "train.binning.device_bin", rows=n, features=F, packed=do_pack
+    ):
+        feed = ChunkPrefetcher(
+            chunk_stream(source, chunk_rows),
+            # upload happens on the prefetch thread: next chunk transfers
+            # while the current one bins — the double buffer
+            transform=lambda c: (c, jax.device_put(c.X)),
+        )
+        for chunk, rows_dev in feed:
+            binned = bin_chunk(binner.arrays, rows_dev)
+            occupancy = occ_update(occupancy, binned)
+            binned_u8 = binned.astype(jnp.uint8)
+            if sample_per_chunk:
+                rng = np.random.default_rng([seed, 7, chunk.index])
+                k = min(sample_per_chunk, len(chunk.X))
+                idx = np.sort(rng.choice(len(chunk.X), k, replace=False))
+                sample_parts.append(np.asarray(binned_u8[idx]))
+            if do_pack:
+                start = chunk.start // 2
+                binned_u8 = pack_rows(binned_u8)
+            else:
+                start = chunk.start
+            buf = update(buf, binned_u8, start)
+            if chunk.y is not None:
+                if label is None:
+                    label = np.empty(n, np.float64)
+                label[chunk.start:chunk.start + len(chunk.X)] = chunk.y[
+                    : len(chunk.X)
+                ]
+        buf.block_until_ready()
+
+    sample = (
+        np.concatenate(sample_parts)[:quality_sample_cap]
+        if sample_parts else None
+    )
+    return StreamedDataset(
+        authority=authority,
+        binned_dev=buf,
+        packed=do_pack,
+        num_rows=n,
+        num_features=F,
+        label=label,
+        occupancy=np.asarray(occupancy, np.int64),
+        sample=sample,
+    )
+
+
+def train_streaming(
+    params: dict,
+    source,
+    valid_sets: Sequence = (),
+    valid_names: Optional[Sequence[str]] = None,
+    chunk_rows: int = DEFAULT_CHUNK_ROWS,
+    pack: str = "auto",
+    exact_budget: int = DEFAULT_EXACT_BUDGET,
+    compactor_cap: int = DEFAULT_COMPACTOR_CAP,
+    mesh=None,
+    init_model=None,
+    return_dataset: bool = False,
+):
+    """End-to-end streamed training: sketch-fit → device ingest → the
+    stock :func:`mmlspark_tpu.engine.booster.train` loop.
+
+    ``params`` is the usual LightGBM-style dict; ``max_bin`` /
+    ``categorical_feature`` / ``min_data_in_bin`` flow into the sketch
+    fit so the streamed edges answer the same binning config the
+    in-memory path would.  With ``return_dataset=True`` returns
+    ``(booster, streamed_dataset)`` so callers can reuse the ingested
+    cache across training calls.
+    """
+    from mmlspark_tpu.engine.booster import TrainConfig
+    from mmlspark_tpu.engine.booster import train as _train
+
+    cfg = TrainConfig.from_params(params)
+    with obs.span("train.binning", streamed=True, rows=source.num_rows):
+        authority, sketch = stream_fit_binning(
+            source,
+            max_bin=cfg.max_bin,
+            categorical_features=tuple(cfg.categorical_feature),
+            chunk_rows=chunk_rows,
+            exact_budget=exact_budget,
+            compactor_cap=compactor_cap,
+        )
+        if obs.enabled():
+            obs.gauge("ingest.sketch_rank_epsilon", float(sketch.rank_epsilon))
+        train_set = stream_ingest(
+            source, authority, chunk_rows=chunk_rows, pack=pack,
+            quality_sample_cap=4096, seed=cfg.seed,
+        )
+    if train_set.label is None:
+        raise ValueError(
+            "streamed training needs labels: the shard source yielded none "
+            "(NpySource(label_paths=...) or write_row_group_shards(y=...))"
+        )
+    booster = _train(
+        params, train_set, valid_sets=valid_sets, valid_names=valid_names,
+        bin_mapper=authority.mapper, init_model=init_model, mesh=mesh,
+    )
+    return (booster, train_set) if return_dataset else booster

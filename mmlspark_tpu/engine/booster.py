@@ -326,12 +326,18 @@ class Dataset:
         key = (cfg.max_bin, tuple(cfg.categorical_feature), cfg.seed)
         bm = self._mapper_cache.get(key)
         if bm is None:
-            bm = BinMapper(
+            # One fit path for every consumer: the full-pass branch of the
+            # binning authority (ops/binning.BinningAuthority) — streamed
+            # datasets take its from_sketch branch instead.
+            from mmlspark_tpu.ops.binning import BinningAuthority
+
+            bm = BinningAuthority.fit(
+                self.X,
                 max_bin=cfg.max_bin,
                 categorical_features=tuple(cfg.categorical_feature),
                 seed=cfg.seed,
                 threads=cfg.num_threads,
-            ).fit(self.X)
+            ).mapper
             self._mapper_cache = {key: bm}  # size-1: sweeps must not pin all
         return bm
 
@@ -352,11 +358,29 @@ class Dataset:
         return bins
 
 
-def _pad_rows(arr: np.ndarray, n_pad: int, value=0):
+def _pad_rows(arr, n_pad: int, value=0):
+    # Accepts numpy OR device arrays: a StreamedDataset's binned matrix is
+    # already on device, and pulling it to host just to pad would undo the
+    # out-of-core ingestion (ING001's whole point).
     if n_pad == 0:
         return arr
     pad_shape = (n_pad,) + arr.shape[1:]
-    return np.concatenate([arr, np.full(pad_shape, value, dtype=arr.dtype)], axis=0)
+    if isinstance(arr, np.ndarray):
+        return np.concatenate(
+            [arr, np.full(pad_shape, value, dtype=arr.dtype)], axis=0
+        )
+    return jnp.concatenate(
+        [arr, jnp.full(pad_shape, value, dtype=arr.dtype)], axis=0
+    )
+
+
+def _pad_cols(arr, f_pad: int):
+    """Right-pad feature columns with zeros (numpy or device array)."""
+    if f_pad == 0:
+        return arr
+    if isinstance(arr, np.ndarray):
+        return np.pad(arr, ((0, 0), (0, f_pad)))
+    return jnp.pad(arr, ((0, 0), (0, f_pad)))
 
 
 # Padding fill per Tree field when concatenating forests whose num_leaves
@@ -429,6 +453,7 @@ class Booster:
         self._packed_forests: Dict[int, object] = {}
         self._pallas_forests: Dict[int, object] = {}
         self._device_binner = None
+        self._bin_authority = None
         self._predict_warm: set = set()
 
     def _host_trees(self) -> Tree:
@@ -468,6 +493,7 @@ class Booster:
         state["_packed_forests"] = {}
         state["_pallas_forests"] = {}
         state["_device_binner"] = None
+        state["_bin_authority"] = None
         state["_predict_warm"] = set()
         state["trees"] = self._host_trees()
         return state
@@ -479,6 +505,7 @@ class Booster:
         self.__dict__.setdefault("_packed_forests", {})
         self.__dict__.setdefault("_pallas_forests", {})
         self.__dict__.setdefault("_device_binner", None)
+        self.__dict__.setdefault("_bin_authority", None)
         self.__dict__.setdefault("_predict_warm", set())
         self.__dict__.setdefault("quality_baseline", None)
         self.trees = Tree(*[jnp.asarray(a) for a in self.trees])
@@ -607,13 +634,22 @@ class Booster:
             self._pallas_forests[T] = pf
         return pf
 
-    def device_binner(self):
-        """Uploaded-once on-device binning state (ops/device_binning) for
-        the raw-f32-rows serving hot path."""
-        from mmlspark_tpu.ops.device_binning import DeviceBinner
+    def bin_authority(self):
+        """This model's :class:`~mmlspark_tpu.ops.binning.BinningAuthority`
+        — the ONE object owning the fitted edges and the f64/f32 decision
+        contract.  The serve wire (``predict_padded``), host predict, and
+        any re-ingestion all bin through it."""
+        from mmlspark_tpu.ops.binning import BinningAuthority
 
+        if getattr(self, "_bin_authority", None) is None:
+            self._bin_authority = BinningAuthority(self.bin_mapper)
+        return self._bin_authority
+
+    def device_binner(self):
+        """Uploaded-once on-device binning state (via the binning
+        authority) for the raw-f32-rows serving hot path."""
         if getattr(self, "_device_binner", None) is None:
-            self._device_binner = DeviceBinner.from_mapper(self.bin_mapper)
+            self._device_binner = self.bin_authority().device_binner()
         return self._device_binner
 
     def _raw_scores_dispatch(
@@ -1293,10 +1329,35 @@ def _capture_quality_baseline(
         return None
     from mmlspark_tpu.obs import quality
 
-    bins = np.asarray(train_set.binned(booster.bin_mapper))
-    features = quality.feature_specs_from_binned(bins, booster.bin_mapper)
     cap = int(float(os.environ.get(
         "MMLSPARK_TPU_QUALITY_SCORE_SAMPLE", "4096") or 4096))
+    specs_fn = getattr(train_set, "quality_feature_specs", None)
+    if specs_fn is not None:
+        # Streamed dataset: occupancy was tallied chunk-by-chunk on device
+        # during ingest and the score sample was capped at collection time
+        # — the full binned matrix NEVER materializes on host here.
+        features = specs_fn(booster.bin_mapper)
+        if features is None:
+            return None
+        sample0 = train_set.quality_binned_sample(cap)
+        score = None
+        class_mix = None
+        if cap > 0 and sample0 is not None and len(sample0):
+            preds = _host_replay_scores(booster, sample0)
+            score = quality.score_spec_from_scores(
+                quality.ScoreDriftTracker.scores_of(preds)
+            )
+            if preds.ndim == 2 and preds.shape[1] > 1:
+                class_mix = np.bincount(
+                    np.argmax(preds, axis=1), minlength=preds.shape[1]
+                ).astype(float).tolist()
+        return quality.QualityBaseline(
+            features, score=score, class_mix=class_mix,
+            n_rows=train_set.num_rows,
+        ).to_dict()
+
+    bins = np.asarray(train_set.binned(booster.bin_mapper))
+    features = quality.feature_specs_from_binned(bins, booster.bin_mapper)
     score = None
     class_mix = None
     if cap > 0 and len(bins):
@@ -1378,7 +1439,11 @@ def train(
         wall = time.perf_counter() - t0
         obs.gauge("booster.train_wall_s", wall)
         try:
-            n_rows = int(np.shape(train_set.X)[0])
+            # StreamedDataset has X=None by design; row count still exists
+            n_rows = (
+                int(train_set.num_rows) if train_set.X is None
+                else int(np.shape(train_set.X)[0])
+            )
         except Exception:
             n_rows = 0
         if n_rows and wall > 0:
@@ -1656,7 +1721,7 @@ def _train_impl(
         # never renumbers real columns, so the global indices stay valid.
         f_pad = (-F) % D
         if f_pad:
-            bins_np = np.pad(bins_np, ((0, 0), (0, f_pad)))
+            bins_np = _pad_cols(bins_np, f_pad)
             F += f_pad
 
     # ---- padding: shard count × histogram chunk ------------------------

@@ -40,6 +40,52 @@ import numpy as np
 MAX_SAMPLE = 200_000  # LightGBM bin_construct_sample_cnt default
 
 
+def numeric_uppers_from_distinct(
+    distinct: np.ndarray,
+    counts: np.ndarray,
+    max_bin: int,
+    min_data_in_bin: int,
+) -> np.ndarray:
+    """THE numeric edge formula, shared by every fit path.
+
+    Both the full-pass :meth:`BinMapper._fit_numeric` (after its
+    ``np.unique``) and the streaming quantile sketch
+    (:mod:`mmlspark_tpu.data.sketch`, after its weighted-distinct
+    assembly) derive edges through this ONE function, so an exact sketch
+    reproduces the full-pass boundaries bit-for-bit.  ``counts`` may be
+    exact tallies or KLL weight estimates — the walk only sees the
+    (distinct, count) multiset.
+
+    ≤ ``max_bin`` distincts → one bin per value (midpoint boundaries,
+    last open to +inf); otherwise LightGBM's greedy equal-mass strategy,
+    computed as a jump recursion over the count cumsum (next boundary at
+    ``searchsorted(cum, cum[last] + target)``) — identical boundaries to
+    the per-value greedy walk in O(max_bin·log n).
+    """
+    distinct = np.asarray(distinct, np.float64)
+    counts = np.asarray(counts)
+    if distinct.size == 0:
+        return np.array([np.inf])
+    if len(distinct) <= max_bin:
+        uppers = np.empty(len(distinct))
+        uppers[:-1] = (distinct[:-1] + distinct[1:]) / 2.0
+        uppers[-1] = np.inf
+        return uppers
+    total = counts.sum()
+    target = max(total / max_bin, min_data_in_bin)
+    cum = np.cumsum(counts)
+    uppers = []
+    last = 0.0  # cum value at the previous boundary
+    while len(uppers) < max_bin - 1:
+        i = int(np.searchsorted(cum, last + target, side="left"))
+        if i >= len(distinct) - 1:
+            break
+        uppers.append((distinct[i] + distinct[i + 1]) / 2.0)
+        last = cum[i]
+    uppers.append(np.inf)
+    return np.asarray(uppers)
+
+
 @dataclass
 class BinMapper:
     """Per-dataset binning state (fit once, apply to train/valid/test)."""
@@ -127,33 +173,9 @@ class BinMapper:
         if col.size == 0:
             return np.array([np.inf])
         distinct, counts = np.unique(col, return_counts=True)
-        if len(distinct) <= self.max_bin:
-            # One bin per distinct value; boundary = midpoint to the next
-            # value (upper-inclusive), last bin open to +inf.
-            uppers = np.empty(len(distinct))
-            uppers[:-1] = (distinct[:-1] + distinct[1:]) / 2.0
-            uppers[-1] = np.inf
-            return uppers
-        # Equal-mass binning over the sample distribution, splitting only at
-        # distinct-value boundaries (LightGBM's greedy equal-count strategy).
-        # The greedy "accumulate until >= target, then reset" walk is
-        # computed as a jump recursion over the count cumsum — next boundary
-        # at searchsorted(cum, cum[last] + target) — which places the exact
-        # same boundaries in O(max_bin · log n) instead of a Python loop
-        # over every distinct value (3.8s → ~10ms at 200k×64).
-        total = counts.sum()
-        target = max(total / self.max_bin, self.min_data_in_bin)
-        cum = np.cumsum(counts)
-        uppers = []
-        last = 0.0  # cum value at the previous boundary
-        while len(uppers) < self.max_bin - 1:
-            i = int(np.searchsorted(cum, last + target, side="left"))
-            if i >= len(distinct) - 1:
-                break
-            uppers.append((distinct[i] + distinct[i + 1]) / 2.0)
-            last = cum[i]
-        uppers.append(np.inf)
-        return np.asarray(uppers)
+        return numeric_uppers_from_distinct(
+            distinct, counts, self.max_bin, self.min_data_in_bin
+        )
 
     def _fit_categorical(self, f: int, col: np.ndarray) -> np.ndarray:
         cats, counts = np.unique(col.astype(np.int64), return_counts=True)
@@ -296,6 +318,107 @@ class BinMapper:
         bm.upper_bounds = [np.asarray(u) for u in d["upper_bounds"]]
         bm.cat_maps = {int(k): np.asarray(v) for k, v in d["cat_maps"].items()}
         return bm
+
+
+class BinningAuthority:
+    """THE single binning decision authority (host + device + serve).
+
+    Collapses the host :class:`BinMapper` and the device
+    :class:`~mmlspark_tpu.ops.device_binning.DeviceBinner` behind one
+    object with a declared decision contract:
+
+    **f64/f32 decision contract.**  Every bin decision is DEFINED by the
+    float64 rule ``bin = np.searchsorted(upper_bounds[f], v, side="left")``
+    (count of f64 boundaries strictly below ``v``; NaN → ``missing_bin``;
+    categoricals by exact int64 match after trunc-toward-zero).  The
+    device path stores each f64 boundary as a double-single f32 pair
+    ``(hi, lo)`` and compares ``(hi < v) | ((hi == v) & (lo < 0))``,
+    which reproduces the f64 ordering EXACTLY for every f32-representable
+    input — i.e. for the raw-f32 serve wire and the raw-f32 streamed
+    training shards, host and device binning are bitwise identical by
+    construction (proven in ``ops/device_binning.py``, tested in
+    ``tests/test_packed_forest.py`` / ``tests/test_streaming.py``).
+    Inputs that are NOT f32-representable must take :meth:`bin_host`
+    (the f64 path); feeding them through f32 loses the distinction
+    between values that only differ past f32 precision.
+
+    **Edge provenance.**  ``mapper`` may come from a full-pass
+    :meth:`BinMapper.fit` or from a merged streaming quantile sketch
+    (:mod:`mmlspark_tpu.data.sketch`); both derive numeric edges through
+    :func:`numeric_uppers_from_distinct`, so exact-mode sketches agree
+    bit-for-bit and sketch-mode edges sit within the sketch's declared
+    ``rank_epsilon`` of the exact equal-mass boundaries.
+
+    Consumers: ``engine/booster.py`` (``Dataset.fitted_mapper`` fit path
+    and ``Booster.device_binner()``), the streamed trainer
+    (``mmlspark_tpu/data/streaming.py``), and the serve wire
+    (``Booster.predict_padded`` raw-f32 entry).
+    """
+
+    def __init__(self, mapper: BinMapper):
+        self.mapper = mapper
+        self._device_binner = None
+
+    # -- construction ---------------------------------------------------
+    @staticmethod
+    def fit(
+        X: np.ndarray,
+        max_bin: int = 255,
+        categorical_features: Sequence[int] = (),
+        seed: int = 0,
+        threads: int = 0,
+    ) -> "BinningAuthority":
+        """Full-pass host fit (the classic in-memory path)."""
+        return BinningAuthority(BinMapper(
+            max_bin=max_bin,
+            categorical_features=tuple(categorical_features),
+            seed=seed,
+            threads=threads,
+        ).fit(X))
+
+    @staticmethod
+    def from_sketch(sketch) -> "BinningAuthority":
+        """Edges from a merged :class:`~mmlspark_tpu.data.sketch.
+        DatasetSketch` — the no-full-pass streaming fit."""
+        return BinningAuthority(sketch.to_bin_mapper())
+
+    # -- the two transform paths ---------------------------------------
+    def bin_host(self, X: np.ndarray) -> np.ndarray:
+        """f64 host transform (reference path; accepts any float input)."""
+        return self.mapper.transform(X)
+
+    def device_binner(self):
+        """Cached device-side binner (uploads the double-single boundary
+        table once); its ``transform`` bins raw f32 rows on device."""
+        if self._device_binner is None:
+            from mmlspark_tpu.ops.device_binning import DeviceBinner
+
+            self._device_binner = DeviceBinner.from_mapper(self.mapper)
+        return self._device_binner
+
+    def bin_device(self, rows):
+        """(n, F) raw f32 rows → (n, F) int32 bins, on device."""
+        return self.device_binner().transform(rows)
+
+    # -- passthrough metadata ------------------------------------------
+    @property
+    def num_bins(self) -> int:
+        return self.mapper.num_bins
+
+    @property
+    def missing_bin(self) -> int:
+        return self.mapper.missing_bin
+
+    @property
+    def num_features(self) -> int:
+        return self.mapper.num_features
+
+    def to_dict(self) -> dict:
+        return self.mapper.to_dict()
+
+    @staticmethod
+    def from_dict(d: dict) -> "BinningAuthority":
+        return BinningAuthority(BinMapper.from_dict(d))
 
 
 def sample_rows_for_binning(

@@ -3,7 +3,11 @@
 The serving hot path (ISSUE 5) must transfer raw ``f32`` rows and never
 touch the host :class:`~mmlspark_tpu.ops.binning.BinMapper` — so the bin
 boundaries are uploaded ONCE as device arrays and the searchsorted runs
-as a fused prologue of the packed-forest predict program.
+as a fused prologue of the packed-forest predict program.  Since ISSUE
+10 the streamed TRAINING ingest (`data/streaming.py`) runs the same
+kernel chunk-by-chunk, so train and serve bin through one authority —
+see :class:`~mmlspark_tpu.ops.binning.BinningAuthority` and
+``ops/README.md`` for the f64/f32 decision contract.
 
 Exactness.  The host transform searches **float64** boundaries
 (``np.searchsorted(upper_bounds[f], v, side="left")`` = count of bounds

@@ -345,6 +345,7 @@ def build_histogram(
     psum_dtype: str = "float32",
     merge: str = "allreduce",
     quantize: Optional[HistQuantize] = None,
+    packed: bool = False,
 ) -> jnp.ndarray:
     """Histogram of ``vals`` (3, n) over (feature, bin), rows gated by
     ``mask``; returns (3, F, B) — or (3, F/D, B), this shard's merged
@@ -365,8 +366,33 @@ def build_histogram(
     replacement for LightGBM's socket allreduce of histograms
     (``LGBM_NetworkInit`` + recursive-halving allreduce; SURVEY.md §3.1,
     §5.8 native component N2).
+
+    ``packed=True`` means ``bins`` arrives NIBBLE-PACKED — (⌈n/2⌉, F)
+    uint8 with two row indices per byte (``ops/binpack.py``; requires
+    ``num_bins ≤ 16`` and row-major layout, so it excludes
+    ``transposed``).  The scan unpacks per chunk inside the body, so the
+    full-size uint8 matrix never materializes: HBM holds the packed half
+    plus one unpacked chunk.  ``n``/``mask``/``vals`` keep LOGICAL row
+    semantics; odd ``n`` is handled by the pack's phantom zero row, whose
+    mask slot must be False (standard row padding already guarantees it).
     """
-    if transposed:
+    if packed:
+        if transposed:
+            raise ValueError("packed bins are row-major; transposed "
+                             "input is not supported")
+        from mmlspark_tpu.ops.binpack import PACK_MAX_BINS, unpack_rows
+
+        if num_bins > PACK_MAX_BINS:
+            raise ValueError(
+                f"packed bins need num_bins <= {PACK_MAX_BINS}, got {num_bins}"
+            )
+        n = vals.shape[1]
+        F = bins.shape[1]
+        if bins.shape[0] != (n + 1) // 2:
+            raise ValueError(
+                f"packed bins rows {bins.shape[0]} != ceil({n}/2)"
+            )
+    elif transposed:
         F, n = bins.shape
     else:
         n, F = bins.shape
@@ -404,11 +430,21 @@ def build_histogram(
         vals = jnp.where(mask[None, :], vals, 0.0).astype(jnp.float32)
         acc0 = jnp.zeros((3, F, num_bins), jnp.float32)
     if n <= chunk:
+        if packed:
+            bins = unpack_rows(bins, n)
         hist = fn(bins, vals, num_bins)
     else:
         if n % chunk != 0:
             raise ValueError(f"row count {n} not a multiple of chunk {chunk}")
-        if transposed:
+        if packed:
+            if chunk % 2:
+                raise ValueError(
+                    f"packed bins need an even chunk, got {chunk}"
+                )
+            # two logical rows per packed row: unpack happens per-chunk in
+            # the body, so peak unpacked residency is ONE chunk
+            bc = bins.reshape(n // chunk, chunk // 2, F)
+        elif transposed:
             bc = bins.reshape(F, n // chunk, chunk).transpose(1, 0, 2)
         else:
             bc = bins.reshape(n // chunk, chunk, F)
@@ -416,6 +452,8 @@ def build_histogram(
 
         def body(acc, xs):
             b, v = xs
+            if packed:
+                b = unpack_rows(b, chunk)
             return acc + fn(b, v, num_bins), None
 
         hist, _ = lax.scan(body, acc0, (bc, vc))

@@ -296,3 +296,32 @@ def host_allgather_ragged_rows(arr) -> "np.ndarray":
     return np.concatenate(
         [gathered[i, : counts[i]] for i in range(len(counts))], axis=0
     )
+
+
+def host_allgather_blobs(vec) -> "list":
+    """Allgather one flat per-process vector, returning the PER-PROCESS
+    blobs as a list in process order (unlike
+    :func:`host_allgather_ragged_rows`, which concatenates — callers that
+    must deserialize each process's payload separately need the
+    boundaries preserved).
+
+    The streaming quantile-sketch merge rides this: every process
+    serializes its :class:`~mmlspark_tpu.data.sketch.DatasetSketch` to a
+    flat float64 state vector (KB-scale — sketch sizes are bounded by
+    ``exact_budget``/``compactor_cap`` per feature, never O(rows)), the
+    blobs gather bit-exactly (``host_allgather`` is a raw-bytes gather,
+    immune to the x64 truncation trap), and every process folds them in
+    the SAME process order — deterministic identical merged edges on all
+    ranks.  Single-process: a one-element list, no wire traffic.
+    """
+    import numpy as np
+
+    vec = np.ascontiguousarray(vec).reshape(-1)
+    lens = host_allgather(np.asarray([len(vec)])).reshape(-1)
+    if len(lens) == 1:
+        return [vec]
+    m = int(lens.max())
+    padded = np.zeros(m, vec.dtype)
+    padded[: len(vec)] = vec
+    gathered = host_allgather(padded)  # (nproc, m)
+    return [gathered[i, : lens[i]] for i in range(len(lens))]

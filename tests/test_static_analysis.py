@@ -1511,6 +1511,91 @@ def test_qnt001_library_int_accumulators_are_attested():
     assert apply_suppressions(check_quantize(repo_root())) == []
 
 
+# ------------------------------------------------------------------- ING001
+
+
+def test_ing001_full_materialization_in_data_module(tmp_path):
+    from tools.analyze.ingest_rules import check_ingest_file
+
+    p = _write(str(tmp_path / "data" / "m.py"), """
+        import numpy as np
+        def read_shard(p):
+            X = np.load(p)                  # eager: whole shard in RAM
+            X = np.asarray(X, np.float32)   # whole-frame copy
+            X = X.astype(np.float64)        # and again
+            return X
+        def fit_edges(binner, X):
+            return binner.fit(X)            # host full-data pass
+    """)
+    found = check_ingest_file(p)
+    assert rules(found) == ["ING001"] * 4
+    assert "O(chunk)" in found[0].message
+
+
+def test_ing001_chunked_code_is_silent(tmp_path):
+    from tools.analyze.ingest_rules import check_ingest_file
+
+    p = _write(str(tmp_path / "data" / "m.py"), """
+        import numpy as np
+        def read_shard(p):
+            X = np.load(p, mmap_mode="r")          # lazy: fine
+            for start in range(0, len(X), 4096):
+                X_chunk = np.asarray(X[start:start + 4096])
+                yield X_chunk.astype(np.float32)   # chunk-shaped: fine
+    """)
+    assert check_ingest_file(p) == []
+
+
+def test_ing001_scoped_to_data_and_stream_fns(tmp_path):
+    from tools.analyze.ingest_rules import check_ingest_file
+
+    p = _write(str(tmp_path / "engine" / "m.py"), """
+        import numpy as np
+        def fit(X):
+            return np.asarray(X)        # host training prep: out of scope
+        def stream_fit(src, X):
+            return np.asarray(X)        # streaming hot path: in scope
+        def chunk_ingest(X):
+            return X.astype(np.float32)  # ingest hot path: in scope
+    """)
+    assert rules(check_ingest_file(p)) == ["ING001"] * 2
+
+
+def test_ing001_suppression_roundtrip(tmp_path):
+    from tools.analyze.ingest_rules import check_ingest_file
+
+    p = _write(str(tmp_path / "data" / "m.py"), """
+        import numpy as np
+        def _write_fixture(path, X):
+            X = np.asarray(X, np.float32)  # analyze: ignore[ING001]
+            X.tofile(path)
+    """)
+    raw = check_ingest_file(p)
+    assert rules(raw) == ["ING001"]
+    assert apply_suppressions(raw) == []
+
+
+def test_ing001_real_data_plane_is_clean():
+    # the shipped ingest pipeline (data/loader.py, data/streaming.py,
+    # data/sketch.py) holds its own O(chunk) contract; the two fixture-
+    # writer conversions in write_row_group_shards are the only
+    # sanctioned sites
+    from tools.analyze.ingest_rules import check_ingest
+
+    assert apply_suppressions(check_ingest(repo_root())) == []
+
+
+def test_ing001_glob_and_index_walks_agree():
+    from tools.analyze.engine import build_index
+    from tools.analyze.ingest_rules import check_ingest
+
+    root = repo_root()
+    key = lambda f: (f.file, f.line, f.rule, f.message)
+    legacy = sorted(map(key, check_ingest(root)))
+    indexed = sorted(map(key, check_ingest(root, index=build_index(root))))
+    assert legacy == indexed
+
+
 # ------------------------------------------------- golden + parity gates
 
 

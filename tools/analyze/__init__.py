@@ -1,8 +1,9 @@
 """Repo-native static-analysis suite (see README.md in this directory).
 
-Eleven passes over a shared project index (built once per run by
-:mod:`tools.analyze.engine`): the eight per-file-portable passes (ABI,
-collectives, tracer, hygiene, obs, serving, predict, quantize) plus the
+Twelve passes over a shared project index (built once per run by
+:mod:`tools.analyze.engine`): the nine per-file-portable passes (ABI,
+collectives, tracer, hygiene, obs, serving, predict, quantize,
+ingest) plus the
 index-native interprocedural passes (collective order COL005/COL006,
 serve-layer locks LCK001–003, dtype-contract flow DTY001).  Each pass
 returns :class:`tools.analyze.common.Finding` rows; :func:`run_all`
@@ -24,6 +25,7 @@ from tools.analyze.common import (
     stale_suppressions,
 )
 from tools.analyze.hygiene import check_hygiene
+from tools.analyze.ingest_rules import check_ingest
 from tools.analyze.obs_rules import check_obs
 from tools.analyze.predict_rules import check_predict
 from tools.analyze.quantize_rules import check_quantize
@@ -34,6 +36,7 @@ __all__ = [
     "Finding", "run_all", "repo_root", "PASSES",
     "check_abi", "check_collectives", "check_tracer", "check_hygiene",
     "check_obs", "check_serving", "check_predict", "check_quantize",
+    "check_ingest",
 ]
 
 
@@ -80,6 +83,8 @@ PASSES = {
                 {"PRED001"}),
     "quantize": (lambda root, index: check_quantize(root, index=index),
                  {"QNT001"}),
+    "ingest": (lambda root, index: check_ingest(root, index=index),
+               {"ING001"}),
     "collective_order": (
         lambda root, index: _check_collective_order(index),
         {"COL005", "COL006"}),

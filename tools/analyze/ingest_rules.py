@@ -1,0 +1,149 @@
+"""Pass 12 — ingest hot-path hygiene.
+
+Rules
+-----
+- ING001: full-dataset host materialization inside the out-of-core
+  ingest/train hot paths.  The streaming data plane (ISSUE 10) exists so
+  training data larger than host RAM flows shard → chunk → device with
+  peak host residency O(chunk); a single eager ``np.load`` (no
+  ``mmap_mode``), a whole-frame ``np.asarray(X)`` / ``X.astype(...)``
+  copy, or a host binner ``.fit(X)`` on the full matrix silently turns
+  the O(chunk) pipeline back into an O(dataset) one — exactly the full
+  data pass the sketch-merge binning removed.  Sanctioned sites (test
+  fixture writers, tiny capped samples) are marked
+  ``# analyze: ignore[ING001]``.
+
+Scope: every module under ``mmlspark_tpu/data/`` (the package docstring
+declares the no-full-materialization contract), plus — anywhere else in
+the package — functions whose name contains ``ingest`` or starts with
+``stream_``.  Chunk-shaped values (``X_chunk``, ``block``, slices) are
+out of scope by construction: the checks match whole-frame *names* only.
+"""
+
+from __future__ import annotations
+
+import ast
+import glob
+import os
+
+from tools.analyze.common import Finding
+
+_NP_NAMES = {"np", "numpy"}
+_CONVERTERS = {"asarray", "array", "ascontiguousarray"}
+#: names that conventionally bind the FULL dataset in this codebase
+_FRAME_NAMES = {"X", "y", "data", "frame", "table", "dataset"}
+_FIT_NAMES = {"fit", "fit_transform"}
+
+
+def _is_hot_path_fn(name: str) -> bool:
+    return "ingest" in name or name.startswith("stream_")
+
+
+def _findings_in(node, path: str) -> list:
+    findings = []
+    for sub in ast.walk(node):
+        if not isinstance(sub, ast.Call):
+            continue
+        func = sub.func
+        if not isinstance(func, ast.Attribute):
+            continue
+        recv = func.value
+        if (
+            func.attr == "load"
+            and isinstance(recv, ast.Name)
+            and recv.id in _NP_NAMES
+            and not any(kw.arg == "mmap_mode" for kw in sub.keywords)
+        ):
+            findings.append(Finding(
+                path, sub.lineno, "ING001",
+                "eager np.load() without mmap_mode in the ingest path "
+                "reads the whole shard into host RAM; use "
+                "np.load(..., mmap_mode='r') so chunk_stream slices "
+                "copy O(chunk), or mark a sanctioned site with "
+                "# analyze: ignore[ING001]",
+            ))
+        elif (
+            func.attr in _CONVERTERS
+            and isinstance(recv, ast.Name)
+            and recv.id in _NP_NAMES
+            and sub.args
+            and isinstance(sub.args[0], ast.Name)
+            and sub.args[0].id in _FRAME_NAMES
+        ):
+            findings.append(Finding(
+                path, sub.lineno, "ING001",
+                f"np.{func.attr}({sub.args[0].id}) materializes the full "
+                "frame on host inside the ingest path — peak residency "
+                "becomes O(dataset), not O(chunk); stream it, or mark a "
+                "sanctioned site with # analyze: ignore[ING001]",
+            ))
+        elif (
+            func.attr == "astype"
+            and isinstance(recv, ast.Name)
+            and recv.id in _FRAME_NAMES
+        ):
+            findings.append(Finding(
+                path, sub.lineno, "ING001",
+                f"{recv.id}.astype(...) copies the full frame on host "
+                "inside the ingest path; convert per chunk instead, or "
+                "mark a sanctioned site with # analyze: ignore[ING001]",
+            ))
+        elif (
+            func.attr in _FIT_NAMES
+            and any(isinstance(a, ast.Name) and a.id in _FRAME_NAMES
+                    for a in sub.args)
+        ):
+            findings.append(Finding(
+                path, sub.lineno, "ING001",
+                f".{func.attr}() over the full frame is a host full-data "
+                "pass inside the ingest path; bin edges come from merged "
+                "per-shard sketches (data/sketch.py), or mark a "
+                "sanctioned site with # analyze: ignore[ING001]",
+            ))
+    return findings
+
+
+def check_ingest_file(path: str, tree=None, pkg_rel=None) -> list:
+    if tree is None:
+        try:
+            with open(path, encoding="utf-8", errors="replace") as fh:
+                tree = ast.parse(fh.read(), filename=path)
+        except SyntaxError:
+            return []
+    if pkg_rel is None:
+        parts = os.path.abspath(path).replace("\\", "/").split("/")
+        in_data = "data" in parts[:-1]
+    else:
+        in_data = pkg_rel.replace("\\", "/").startswith("data/")
+    if in_data:
+        findings = _findings_in(tree, path)
+    else:
+        findings = []
+        for node in ast.walk(tree):
+            if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if _is_hot_path_fn(node.name):
+                findings.extend(_findings_in(node, path))
+    # a call nested in two matching defs would report twice
+    seen, out = set(), []
+    for f in findings:
+        k = (f.file, f.line, f.message)
+        if k not in seen:
+            seen.add(k)
+            out.append(f)
+    return out
+
+
+def check_ingest(root: str, index=None) -> list:
+    findings: list = []
+    if index is not None:
+        for mi in index.package_modules():
+            findings.extend(
+                check_ingest_file(mi.path, tree=mi.tree, pkg_rel=mi.pkg_rel))
+        return findings
+    pkg = os.path.join(root, "mmlspark_tpu")
+    for py in sorted(glob.glob(os.path.join(pkg, "**", "*.py"),
+                               recursive=True)):
+        rel = os.path.relpath(py, pkg)
+        findings.extend(check_ingest_file(py, pkg_rel=rel))
+    return findings
