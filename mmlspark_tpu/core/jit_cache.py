@@ -20,6 +20,36 @@ therefore every estimator facade).  Controls:
 
 A user-set ``jax_compilation_cache_dir`` (jax config or ``JAX_COMPILATION_
 CACHE_DIR``) always wins — we never override an explicit choice.
+
+AOT artifacts (ISSUE 11 / ROADMAP item 3a)
+------------------------------------------
+jax's persistent cache only skips the XLA *compile*; a fresh process
+still pays the full trace/lower before the cache is even consulted
+(~230 ms for the bench forest, on top of ~420 ms compile).  The
+``aot-*`` artifact kind stores the WHOLE compiled executable
+(``jax.experimental.serialize_executable``), so a second process goes
+straight from disk bytes to a callable in low milliseconds.  The
+``pft-*`` kind stores the packed-forest host arrays (the Python
+per-tree pack loop is ~40 ms for 200 trees — real money against a
+millisecond cold-start budget).  Both kinds live in the SAME directory
+as jax's own cache entries and ride the SAME LRU prune/mtime machinery
+— :func:`prune_cache_dir` is kind-agnostic by construction (it orders
+every file by last access, whatever its prefix).
+
+Keys are content fingerprints (:func:`aot_fingerprint`): schema
+version, jax/jaxlib versions, backend platform + device kind + device
+count, ``XLA_FLAGS``, the caller's static meta (forest slice, bin
+config), and every argument leaf's shape/dtype.  Any drift — a jax
+upgrade, a different bucket shape, a retrained forest with a new tree
+count — lands on a different key; stale artifacts simply age out of
+the LRU.  A deserialize failure (e.g. an artifact from an incompatible
+jaxlib that collided on key) deletes the artifact and reports a miss,
+so the caller falls back to the trace path.
+
+obs: ``jit_cache.aot_serialize`` / ``jit_cache.aot_deserialize`` spans
+time the (de)serialization; ``jit_cache.aot_hits`` / ``aot_misses`` /
+``aot_bytes`` counters feed :func:`cache_counters` and
+``tools.obs report``.
 """
 
 from __future__ import annotations
@@ -29,6 +59,8 @@ import os
 from mmlspark_tpu import obs
 
 _done = False
+
+AOT_SCHEMA = 1  # bump to invalidate every serialized artifact at once
 
 
 def default_cache_dir() -> str:
@@ -72,9 +104,18 @@ def enable_compile_cache() -> bool:
         prune_cache_dir(path)
         _install_hit_recorder(path)
         _done = True
-        return True
     except Exception:
         return False
+    try:
+        # jax lazily imports etils.epath inside the FIRST compile's
+        # get_compile_options once a cache dir is set — ~75 ms of pure
+        # Python import that would otherwise land in the first predict's
+        # cold window.  Front-load it here, where enabling the cache is
+        # already declared process setup.
+        import etils.epath  # noqa: F401
+    except Exception:
+        pass
+    return True
 
 
 def record_cache_hit(path: str) -> None:
@@ -135,13 +176,189 @@ def cache_counters() -> dict:
     obs registry; zeros while obs is disabled).  The serving readiness
     gate snapshots these at startup: pre-warming is proven by the miss
     AND hit counters staying flat across first real requests — a warmed
-    shape never reaches the compilation cache at all.
+    shape never reaches the compilation cache at all.  ``aot_*`` keys
+    count the serialized-executable artifacts: a replica that warmed
+    from disk shows ``aot_hits`` with ``miss`` flat.
     """
     counters = obs.snapshot().get("counters", {})
     return {
         key: float(counters.get(f"jit_cache.{key}", 0.0))
-        for key in ("hit", "miss", "pruned")
+        for key in ("hit", "miss", "pruned",
+                    "aot_hits", "aot_misses", "aot_bytes")
     }
+
+
+# ---------------------------------------------------------------------------
+# AOT artifacts: serialized executables + packed-forest blobs
+# ---------------------------------------------------------------------------
+def artifact_dir() -> str:
+    """Directory AOT artifacts share with jax's persistent cache entries
+    (the user-configured jax cache dir when set, else our default)."""
+    try:
+        import jax
+
+        configured = jax.config.jax_compilation_cache_dir
+        if configured:
+            return configured
+    except Exception:
+        pass
+    return os.environ.get("JAX_COMPILATION_CACHE_DIR") or default_cache_dir()
+
+
+def aot_fingerprint(kind: str, meta: dict, args=()) -> str:
+    """Content fingerprint for an AOT artifact.
+
+    Hashes everything that determines executable validity: schema
+    version, jax + jaxlib versions, backend platform / device kind /
+    device count, ``XLA_FLAGS``, the caller's static ``meta`` (e.g.
+    forest slice T/K/depth, bin config, raw_score), and the
+    shape+dtype of every leaf in ``args`` (the bucket shape lives
+    here).  Model WEIGHTS are deliberately excluded for executables —
+    they are runtime arguments, so one artifact serves every model
+    version with the same shapes (a hot-swap warms for free).
+    """
+    import hashlib
+    import json
+
+    import jax
+
+    try:
+        import jaxlib
+
+        jaxlib_v = getattr(jaxlib, "__version__", "")
+    except Exception:
+        jaxlib_v = ""
+    devs = jax.devices()
+    spec = [
+        (tuple(int(d) for d in getattr(leaf, "shape", ())),
+         str(getattr(leaf, "dtype", type(leaf).__name__)))
+        for leaf in jax.tree_util.tree_leaves(args)
+    ]
+    blob = json.dumps(
+        {
+            "schema": AOT_SCHEMA,
+            "kind": kind,
+            "jax": jax.__version__,
+            "jaxlib": jaxlib_v,
+            "backend": jax.default_backend(),
+            "device_kind": getattr(devs[0], "device_kind", str(devs[0])),
+            "device_count": len(devs),
+            "xla_flags": os.environ.get("XLA_FLAGS", ""),
+            "meta": meta,
+            "args": spec,
+        },
+        sort_keys=True,
+        default=str,
+    )
+    return hashlib.sha256(blob.encode()).hexdigest()[:32]
+
+
+def _artifact_path(kind: str, key: str) -> str:
+    return os.path.join(artifact_dir(), f"{kind}-{key}")
+
+
+def save_artifact(kind: str, key: str, data: bytes) -> bool:
+    """Atomically write an artifact blob into the cache dir (tmp +
+    rename), then prune the dir to its LRU budget.  Never raises;
+    respects the ``MMLSPARK_TPU_NO_COMPILE_CACHE`` opt-out."""
+    if os.environ.get("MMLSPARK_TPU_NO_COMPILE_CACHE"):
+        return False
+    try:
+        d = artifact_dir()
+        os.makedirs(d, exist_ok=True)
+        path = _artifact_path(kind, key)
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "wb") as fh:
+            fh.write(data)
+        os.replace(tmp, path)
+        prune_cache_dir(d)
+        return True
+    except OSError:
+        return False
+
+
+def load_artifact(kind: str, key: str):
+    """Artifact bytes for ``kind-key``, bumping its LRU timestamp on the
+    way out; ``None`` when absent (or caching is opted out)."""
+    if os.environ.get("MMLSPARK_TPU_NO_COMPILE_CACHE"):
+        return None
+    try:
+        path = _artifact_path(kind, key)
+        with open(path, "rb") as fh:
+            data = fh.read()
+        record_cache_hit(path)
+        return data
+    except OSError:
+        return None
+
+
+def save_aot(key: str, compiled) -> bool:
+    """Serialize a compiled executable under ``aot-<key>``.
+
+    Returns False (artifact simply not cached) on any failure — some
+    backends/executables don't support serialization.
+    """
+    try:
+        import pickle
+
+        from jax.experimental import serialize_executable as se
+
+        with obs.span("jit_cache.aot_serialize", key=key):
+            data = pickle.dumps(se.serialize(compiled))
+    except Exception:
+        return False
+    if save_artifact("aot", key, data):
+        obs.inc("jit_cache.aot_bytes", float(len(data)))
+        return True
+    return False
+
+
+def load_aot(key: str):
+    """Deserialize the ``aot-<key>`` executable; ``None`` on miss.
+
+    A present-but-undeserializable artifact (incompatible jaxlib bits
+    that collided on key) is deleted and reported as a miss, so the
+    caller's trace fallback replaces it.
+    """
+    data = load_artifact("aot", key)
+    if data is not None:
+        try:
+            import pickle
+
+            from jax.experimental import serialize_executable as se
+
+            with obs.span("jit_cache.aot_deserialize", key=key):
+                exe = se.deserialize_and_load(*pickle.loads(data))
+            obs.inc("jit_cache.aot_hits")
+            return exe
+        except Exception:
+            try:
+                os.remove(_artifact_path("aot", key))
+            except OSError:
+                pass
+    obs.inc("jit_cache.aot_misses")
+    return None
+
+
+def save_pft(key: str, arrays_state: bytes) -> bool:
+    """Store pickled packed-forest host arrays under ``pft-<key>`` (the
+    per-tree Python pack loop is the dominant from-disk cold cost)."""
+    if save_artifact("pft", key, arrays_state):
+        obs.inc("jit_cache.aot_bytes", float(len(arrays_state)))
+        return True
+    return False
+
+
+def load_pft(key: str):
+    """Pickled packed-forest bytes for ``pft-<key>`` (``None`` on miss);
+    counts into the same aot hit/miss counters — it is part of the same
+    warm-from-disk story."""
+    data = load_artifact("pft", key)
+    if data is not None:
+        obs.inc("jit_cache.aot_hits")
+        return data
+    obs.inc("jit_cache.aot_misses")
+    return None
 
 
 def prune_cache_dir(path: str, max_mb: float | None = None) -> int:

@@ -12,13 +12,17 @@ The full pipeline behind :func:`train_streaming` (ROADMAP item 2):
    collective, fold in process order, and derive global bin edges → one
    :class:`~mmlspark_tpu.ops.binning.BinningAuthority` shared by every
    rank.
-3. **Ingest pass** (device, double-buffered): raw f32 chunks upload
-   while the previous chunk bins ON DEVICE through the authority's
-   double-single boundary table (``ops/device_binning.py``) — the host
-   ``searchsorted`` transform is gone from the train path entirely.  The
-   binned chunk lands in a preallocated device cache via donated
-   ``dynamic_update_slice`` (O(1) extra memory per chunk), nibble-packed
-   two-rows-per-byte when ``num_bins ≤ 16`` (``ops/binpack.py``).
+3. **Ingest pass** (device, double-buffered, fused): raw f32 chunks
+   upload on the prefetch thread while the previous chunk runs ONE
+   fused device step — binning through the authority's double-single
+   boundary table (``ops/device_binning.py``; on TPU the fused Pallas
+   bin+occupancy kernel, ``ops/pallas_binhist.py``, so binned rows
+   never round-trip HBM before the tally), the occupancy update, the
+   quality-sample gather, and the donated ``dynamic_update_slice``
+   into the preallocated cache (O(1) extra memory per chunk).  The
+   consumer never syncs mid-loop, so upload and device work overlap.
+   The cache is nibble-packed two-rows-per-byte when ``num_bins ≤ 16``
+   and rides 1-byte indices through 256 bins (``ops/binpack.py``).
 4. **Train**: the resulting :class:`StreamedDataset` drops into the
    stock ``engine/booster.py`` trainer — ``binned()`` hands back the
    device-resident cache, so ``_train_impl`` skips host binning and goes
@@ -33,8 +37,12 @@ the ingest pass assembles a process-local device cache, which
 
 obs: the whole fit rides a ``train.binning`` span with
 ``train.binning.sketch`` / ``train.binning.merge`` /
-``train.binning.device_bin`` children plus the ``ingest.*`` counters
-from the loader — ``python -m tools.obs report`` shows the breakdown.
+``train.binning.device_bin`` children; inside the ingest pass each
+phase is spanned — ``ingest.upload`` (prefetch-thread device transfer),
+``ingest.bin`` (fused-step enqueue), ``ingest.drain`` (await) — plus
+the ``ingest.*`` counters from the loader (``ingest.buffer_stall_ns``
+= consumer waiting on the prefetcher, i.e. upload-bound time) —
+``python -m tools.obs report`` shows the breakdown.
 """
 
 from __future__ import annotations
@@ -245,18 +253,35 @@ def stream_ingest(
     pack: str = "auto",
     quality_sample_cap: int = 4096,
     seed: int = 0,
+    fuse: str = "auto",
 ) -> StreamedDataset:
     """Double-buffered raw-f32 upload + on-device binning into a
-    persistent device cache.
+    persistent device cache — ONE fused device step per chunk.
 
-    Per chunk: the prefetch thread reads the next chunk off the shards
-    and issues its ``jax.device_put`` while the CURRENT chunk runs the
-    device binning program and lands in the preallocated cache via a
-    donated ``dynamic_update_slice``.  Host never holds more than the
-    in-flight chunks; the host ``BinMapper.transform`` pass is gone.
+    Per chunk the prefetch thread reads the next chunk off the shards
+    and runs its ``jax.device_put`` (the ``ingest.upload`` span) while
+    the CURRENT chunk's single fused program — bin → occupancy tally →
+    quality-sample gather → optional nibble pack → donated
+    ``dynamic_update_slice`` — executes on device.  The consumer only
+    ENQUEUES that step (``ingest.bin`` span): there is no per-chunk host
+    sync (the quality sample stays a device array until after the loop),
+    so the device pipeline and the next upload genuinely overlap —
+    ``ingest.buffer_stall_ns`` now measures the consumer waiting on the
+    PREFETCHER, i.e. upload-bound time, instead of being inflated by
+    serial device work.  The final ``ingest.drain`` span is where the
+    enqueued work is awaited.
 
     ``pack="auto"`` nibble-packs the cache when ``num_bins ≤ 16``
-    (halving its bytes); ``"never"`` forces plain uint8.
+    (halving its bytes); ``"never"`` forces plain uint8.  At larger bin
+    counts the cache rides the byte tier (1 byte/index up to 256 bins —
+    ``ops/binpack.py``).
+
+    ``fuse="auto"`` routes the bin+occupancy body through the fused
+    Pallas kernel (:mod:`mmlspark_tpu.ops.pallas_binhist`) on TPU — the
+    binned rows feed the occupancy tally in VMEM without an HBM
+    round-trip — and through the XLA body elsewhere; ``"pallas"`` /
+    ``"xla"`` force a path (cpu pallas runs interpret mode: tests only).
+    Both produce bitwise-identical caches and occupancy.
     """
     import jax
     import jax.numpy as jnp
@@ -267,6 +292,10 @@ def stream_ingest(
 
     if pack not in ("auto", "never"):
         raise ValueError(f"pack must be 'auto' or 'never', got {pack!r}")
+    if fuse not in ("auto", "pallas", "xla"):
+        raise ValueError(
+            f"fuse must be 'auto', 'pallas' or 'xla', got {fuse!r}"
+        )
     binner = authority.device_binner()
     n, F = int(source.num_rows), int(source.num_features)
     B = int(authority.num_bins)
@@ -275,73 +304,100 @@ def stream_ingest(
         chunk_rows += 1  # row pairs must not straddle chunks
 
     missing_bin, n_bounds = binner.missing_bin, binner.n_bounds
+    use_pallas = fuse == "pallas" or (
+        fuse == "auto" and jax.default_backend() == "tpu"
+    )
 
-    def _bin(arrays, rows):
-        return bin_rows_device(
+    def _bin_occ(arrays, rows, counts):
+        """Raw chunk → (uint8 bins, updated occupancy) — the fused core."""
+        if use_pallas:
+            from mmlspark_tpu.ops.pallas_binhist import bin_occ_rows
+
+            binned_u8, occ = bin_occ_rows(
+                arrays, rows, missing_bin=missing_bin,
+                n_bounds=n_bounds, num_bins=B,
+            )
+            return binned_u8, counts + occ
+        binned = bin_rows_device(
             arrays, rows, missing_bin=missing_bin, n_bounds=n_bounds
         )
-
-    bin_chunk = jax.jit(_bin)
-
-    def _update(buf, binned_u8, start):
-        return lax.dynamic_update_slice(buf, binned_u8, (start, 0))
-
-    # donated: the cache is rewritten in place chunk by chunk (O(1) extra
-    # device memory per update on backends with donation)
-    update = jax.jit(_update, donate_argnums=0)
-
-    def _occ(counts, binned):
         f_idx = jnp.broadcast_to(
             jnp.arange(F, dtype=jnp.int32)[None, :], binned.shape
         )
-        return counts.at[f_idx, binned].add(1)
+        return binned.astype(jnp.uint8), counts.at[f_idx, binned].add(1)
 
-    occ_update = jax.jit(_occ, donate_argnums=0)
+    def _step(buf, counts, arrays, rows, start):
+        binned_u8, counts = _bin_occ(arrays, rows, counts)
+        cache = pack_rows(binned_u8) if do_pack else binned_u8
+        return lax.dynamic_update_slice(buf, cache, (start, 0)), counts
+
+    def _step_sampled(buf, counts, arrays, rows, start, sample_idx):
+        binned_u8, counts = _bin_occ(arrays, rows, counts)
+        samp = jnp.take(binned_u8, sample_idx, axis=0)
+        cache = pack_rows(binned_u8) if do_pack else binned_u8
+        return lax.dynamic_update_slice(buf, cache, (start, 0)), counts, samp
+
+    # donated cache + occupancy: rewritten in place chunk by chunk (O(1)
+    # extra device memory per step on backends with donation)
+    step = jax.jit(_step, donate_argnums=(0, 1))
+    step_sampled = jax.jit(_step_sampled, donate_argnums=(0, 1))
 
     buf_rows = (n + 1) // 2 if do_pack else n
     buf = jnp.zeros((buf_rows, F), jnp.uint8)
     occupancy = jnp.zeros((F, B), jnp.int32)
     label = None
-    sample_parts = []
+    sample_parts = []  # device arrays; materialized AFTER the loop
     sample_per_chunk = (
         0 if quality_sample_cap <= 0 or n == 0
         else max(1, math.ceil(quality_sample_cap * chunk_rows / n))
     )
 
+    def _upload(c):
+        # runs on the prefetch thread: next chunk transfers while the
+        # current one executes its fused step — the double buffer.  The
+        # block makes the span honest device-transfer time (and never
+        # blocks the consumer).
+        with obs.span("ingest.upload", rows=len(c.X), bytes=int(c.X.nbytes)):
+            dev = jax.device_put(c.X)
+            dev.block_until_ready()
+        return (c, dev)
+
     with obs.span(
-        "train.binning.device_bin", rows=n, features=F, packed=do_pack
+        "train.binning.device_bin", rows=n, features=F, packed=do_pack,
+        fused_kernel=use_pallas,
     ):
-        feed = ChunkPrefetcher(
-            chunk_stream(source, chunk_rows),
-            # upload happens on the prefetch thread: next chunk transfers
-            # while the current one bins — the double buffer
-            transform=lambda c: (c, jax.device_put(c.X)),
-        )
+        feed = ChunkPrefetcher(chunk_stream(source, chunk_rows), transform=_upload)
         for chunk, rows_dev in feed:
-            binned = bin_chunk(binner.arrays, rows_dev)
-            occupancy = occ_update(occupancy, binned)
-            binned_u8 = binned.astype(jnp.uint8)
-            if sample_per_chunk:
-                rng = np.random.default_rng([seed, 7, chunk.index])
-                k = min(sample_per_chunk, len(chunk.X))
-                idx = np.sort(rng.choice(len(chunk.X), k, replace=False))
-                sample_parts.append(np.asarray(binned_u8[idx]))
-            if do_pack:
-                start = chunk.start // 2
-                binned_u8 = pack_rows(binned_u8)
-            else:
-                start = chunk.start
-            buf = update(buf, binned_u8, start)
+            c_rows = len(chunk.X)
+            start = chunk.start // 2 if do_pack else chunk.start
+            with obs.span("ingest.bin", rows=c_rows):
+                if sample_per_chunk:
+                    rng = np.random.default_rng([seed, 7, chunk.index])
+                    k = min(sample_per_chunk, c_rows)
+                    idx = np.sort(rng.choice(c_rows, k, replace=False))
+                    buf, occupancy, samp = step_sampled(
+                        buf, occupancy, binner.arrays, rows_dev,
+                        np.int32(start), jnp.asarray(idx, jnp.int32),
+                    )
+                    sample_parts.append(samp)
+                else:
+                    buf, occupancy = step(
+                        buf, occupancy, binner.arrays, rows_dev,
+                        np.int32(start),
+                    )
             if chunk.y is not None:
                 if label is None:
                     label = np.empty(n, np.float64)
                 label[chunk.start:chunk.start + len(chunk.X)] = chunk.y[
                     : len(chunk.X)
                 ]
-        buf.block_until_ready()
+        with obs.span("ingest.drain"):
+            buf.block_until_ready()
+            occupancy.block_until_ready()
 
     sample = (
-        np.concatenate(sample_parts)[:quality_sample_cap]
+        np.concatenate([np.asarray(s) for s in sample_parts])
+        [:quality_sample_cap]
         if sample_parts else None
     )
     return StreamedDataset(
@@ -363,6 +419,7 @@ def train_streaming(
     valid_names: Optional[Sequence[str]] = None,
     chunk_rows: int = DEFAULT_CHUNK_ROWS,
     pack: str = "auto",
+    fuse: str = "auto",
     exact_budget: int = DEFAULT_EXACT_BUDGET,
     compactor_cap: int = DEFAULT_COMPACTOR_CAP,
     mesh=None,
@@ -396,7 +453,7 @@ def train_streaming(
             obs.gauge("ingest.sketch_rank_epsilon", float(sketch.rank_epsilon))
         train_set = stream_ingest(
             source, authority, chunk_rows=chunk_rows, pack=pack,
-            quality_sample_cap=4096, seed=cfg.seed,
+            fuse=fuse, quality_sample_cap=4096, seed=cfg.seed,
         )
     if train_set.label is None:
         raise ValueError(

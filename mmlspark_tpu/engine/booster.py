@@ -455,6 +455,7 @@ class Booster:
         self._device_binner = None
         self._bin_authority = None
         self._predict_warm: set = set()
+        self._aot_execs: Dict[Tuple, object] = {}
 
     def _host_trees(self) -> Tree:
         """Host (numpy) copy of the forest, materialized LAZILY via ONE
@@ -495,6 +496,7 @@ class Booster:
         state["_device_binner"] = None
         state["_bin_authority"] = None
         state["_predict_warm"] = set()
+        state["_aot_execs"] = {}
         state["trees"] = self._host_trees()
         return state
 
@@ -507,7 +509,13 @@ class Booster:
         self.__dict__.setdefault("_device_binner", None)
         self.__dict__.setdefault("_bin_authority", None)
         self.__dict__.setdefault("_predict_warm", set())
+        self.__dict__.setdefault("_aot_execs", {})
         self.__dict__.setdefault("quality_baseline", None)
+        # the pickle carries host arrays (__getstate__): keep them as the
+        # _host_trees copy so a fresh process's predict cold never pays a
+        # device fetch program for arrays it already had on host
+        if isinstance(self.trees.split_leaf, np.ndarray):
+            self._trees_np = self.trees
         self.trees = Tree(*[jnp.asarray(a) for a in self.trees])
 
     # -- introspection ---------------------------------------------------
@@ -599,31 +607,142 @@ class Booster:
         re-resolved against jax.default_backend(), with the pallas kernel
         additionally gated on its numeric-only + SMEM-budget support."""
         from mmlspark_tpu.engine.forest import resolve_predict_backend
-        from mmlspark_tpu.ops.pallas_predict import pallas_supported
 
         requested = getattr(self.config, "predict_backend", "auto") or "auto"
         resolved = resolve_predict_backend(
             requested, has_cats=self._has_cat_splits()
         )
-        if resolved in ("pallas", "pallas_interpret") and not pallas_supported(
-            T, self.num_class, int(self.trees.split_leaf.shape[-1]), False
-        ):
-            resolved = "packed"
+        if resolved in ("pallas", "pallas_interpret"):
+            # deferred: importing the pallas stack costs ~100 ms of pure
+            # Python module load — the packed cold path must not pay it
+            from mmlspark_tpu.ops.pallas_predict import pallas_supported
+
+            if not pallas_supported(
+                T, self.num_class, int(self.trees.split_leaf.shape[-1]), False
+            ):
+                resolved = "packed"
         return resolved
+
+    def _model_fingerprint(self, T: int) -> str:
+        """Content hash of the forest slice actually used at ``T``
+        iterations (tree arrays + weights + bin count) — the ``pft-*``
+        artifact key half that ties a packed-forest blob to exactly this
+        model's bytes."""
+        import hashlib
+
+        host = self._host_trees()
+        h = hashlib.sha256()
+        for field in host:
+            a = np.ascontiguousarray(np.asarray(field)[:T])
+            h.update(str((a.shape, a.dtype)).encode())
+            h.update(a.tobytes())
+        w = np.ascontiguousarray(self.tree_weights[:T])
+        h.update(w.tobytes())
+        h.update(str(int(self.bin_mapper.num_bins)).encode())
+        return h.hexdigest()[:32]
 
     def _packed_forest(self, T: int):
         """Device-resident packed SoA node table (engine/forest), built +
-        uploaded once per T and cached."""
+        uploaded once per T and cached.
+
+        Warm-from-disk: the per-tree Python pack loop is ~40 ms for a
+        200-tree forest — real money against the millisecond cold-start
+        budget — so the host arrays are stashed as a ``pft-*`` jit_cache
+        artifact keyed by the model content hash; a second process
+        reloads them in ~1 ms and goes straight to the upload.
+        """
+        from mmlspark_tpu.core import jit_cache as _jc
         from mmlspark_tpu.engine import forest as _forest
 
         pf = self._packed_forests.get(T)
         if pf is None:
-            pf = _forest.pack_forest(
-                self._host_trees(), self.tree_weights, T,
-                self.bin_mapper.num_bins,
-            )
+            key = None
+            try:
+                key = _jc.aot_fingerprint(
+                    "pft", {"model": self._model_fingerprint(T)}
+                )
+                data = _jc.load_pft(key)
+            except Exception:
+                data = None
+            if data is not None:
+                try:
+                    pf = _forest.packed_forest_from_state(data)
+                except Exception:
+                    pf = None
+            if pf is None:
+                pf = _forest.pack_forest(
+                    self._host_trees(), self.tree_weights, T,
+                    self.bin_mapper.num_bins,
+                )
+                if key is not None:
+                    _jc.save_pft(key, _forest.packed_forest_state(pf))
             self._packed_forests[T] = pf
         return pf
+
+    def _finalize_fn(self, T: int, raw_score: bool):
+        """One jitted program for the score post-processing (average
+        division + objective link).  Eager op-by-op dispatch here costs
+        ~80 ms of first-call compiles that the persistent cache never
+        sees — as ONE jitted program it compiles once ever per machine
+        and loads from the jax cache in milliseconds on every later
+        process, keeping the warm-from-disk predict cold in budget."""
+        key = ("finalize", T, bool(raw_score))
+        fn = self._predict_cache.get(key)
+        if fn is None:
+            denom = float(max(T, 1)) if self.average_output else None
+            transform = None if raw_score else self.objective.transform
+
+            def _finalize(r):
+                if denom is not None:
+                    r = r / denom
+                return r if transform is None else transform(r)
+
+            fn = jax.jit(_finalize)
+            self._predict_cache[key] = fn
+        return fn
+
+    def _packed_raw_rows_exec(self, T: int, rows):
+        """The compiled resident serving program for one bucket shape —
+        disk-first (``jit_cache.load_aot``), tracing + ``save_aot`` only
+        on a genuine miss.
+
+        Returns ``(executable, how)`` where ``how`` is ``None`` (already
+        resident in this process), ``"from_disk"`` (deserialized — the
+        millisecond path), or ``"traced"`` (paid the full lower+compile).
+        Weights are runtime arguments, so the artifact key only covers
+        shapes/statics: a hot-swapped model with the same forest shape
+        reuses the executable outright.
+        """
+        from mmlspark_tpu.core import jit_cache as _jc
+        from mmlspark_tpu.engine import forest as _forest
+
+        # predict-only processes deserve the persistent cache too (the
+        # score post-processing programs compile outside the AOT artifact)
+        _jc.enable_compile_cache()
+        pf = self._packed_forest(T)
+        db = self.device_binner()
+        ck = (T, tuple(rows.shape))
+        exe = self._aot_execs.get(ck)
+        if exe is not None:
+            return exe, None
+        key = None
+        try:
+            key = _jc.aot_fingerprint(
+                "packed_raw_rows",
+                _forest.packed_raw_rows_meta(pf, db),
+                (pf.arrays, db.arrays, rows),
+            )
+        except Exception:
+            pass
+        exe = _jc.load_aot(key) if key is not None else None
+        how = "from_disk"
+        if exe is None:
+            exe = _forest.lower_packed_raw_rows(pf, db, rows).compile()
+            if key is not None:
+                _jc.save_aot(key, exe)
+            how = "traced"
+        self._aot_execs[ck] = exe
+        return exe, how
 
     def _pallas_forest(self, T: int):
         pf = self._pallas_forests.get(T)
@@ -787,7 +906,7 @@ class Booster:
         with obs.span(
             "predict", rows=int(n_valid), bucket=int(rows.shape[0]),
             backend=backend, cold=cold, **obs.trace_attrs(),
-        ):
+        ) as sp:
             if backend in ("pallas", "pallas_interpret"):
                 from mmlspark_tpu.ops.pallas_predict import pallas_raw_scores
 
@@ -797,15 +916,20 @@ class Booster:
                     interpret=backend == "pallas_interpret",
                 )
             else:
-                from mmlspark_tpu.engine import forest as _forest
-
-                raw = _forest.packed_raw_scores_rows(
-                    self._packed_forest(T), self.device_binner(), rows
-                )
-            if self.average_output:
-                raw = raw / max(T, 1)
-            if not raw_score:
-                raw = self.objective.transform(raw)
+                # AOT-resident hot path: disk-deserialized executable when
+                # a prior process compiled this bucket shape, traced (and
+                # persisted) otherwise.  The span's ``cold`` attr upgrades
+                # from a bool to "from_disk"/"traced" so obs can tell a
+                # millisecond deserialize-warm from a full-compile warm.
+                exe, how = self._packed_raw_rows_exec(T, rows)
+                if how is not None:
+                    try:
+                        sp.attrs["cold"] = how
+                    except (AttributeError, TypeError):
+                        pass
+                pf = self._packed_forests[T]
+                raw = exe(pf.arrays, self.device_binner().arrays, rows)
+            raw = self._finalize_fn(T, raw_score)(raw)
             # API exit: host ndarray is the return contract
             out = np.asarray(raw)  # analyze: ignore[PRED001]
             out = out[0] if out.shape[0] == 1 else out.T
@@ -820,9 +944,14 @@ class Booster:
     def prewarm_predict(
         self, batch_sizes: Sequence[int], raw_score: bool = False
     ) -> None:
-        """Compile (and persistent-cache, via core/jit_cache) the predict
-        program for each serving bucket shape up front, so a serving
-        process answers its first real request without a compile stall."""
+        """Warm the predict program for each serving bucket shape up
+        front, so a serving process answers its first real request
+        without a compile stall.  On the packed backend this
+        deserializes ``aot-*`` executables from the jit_cache dir when a
+        prior process compiled the same shapes (milliseconds per bucket
+        — the replica warm-from-disk path, serve/README.md); only
+        genuinely new shapes pay a trace+compile, and those are
+        persisted for the next replica."""
         from mmlspark_tpu.core.jit_cache import enable_compile_cache
 
         enable_compile_cache()

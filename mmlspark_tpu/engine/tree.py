@@ -44,6 +44,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
+from mmlspark_tpu.ops.binpack import hist_transpose
 from mmlspark_tpu.ops.histogram import (
     COUNT_SCALE,
     HistQuantize,
@@ -779,8 +780,11 @@ def grow_tree(
     """
     n, F = bins.shape
     B, L, S = cfg.num_bins, cfg.num_leaves, cfg.max_steps
-    # One convert+transpose per tree (histogram passes want (F, n) int32).
-    bins_t = bins.astype(jnp.int32).T
+    # One transpose per tree (histogram passes want rows on the lane
+    # axis); the dtype stays uint8 through the byte tier (B ≤ 256) — the
+    # kernels widen per block — so the tree-resident working set is 1
+    # byte/index instead of 4 (ops/binpack.py::hist_transpose).
+    bins_t = hist_transpose(bins, B)
     in_bag = (bag_weight > 0).astype(jnp.float32)
     vals = jnp.stack(
         [grad * bag_weight, hess * bag_weight, in_bag], axis=0
@@ -950,10 +954,11 @@ def grow_tree_depthwise(
     B, L, S = cfg.num_bins, cfg.num_leaves, cfg.max_steps
     W = cfg.level_window
     LB = L + W  # hist buffer slots: window writes start at base ≤ S
-    # ONE convert+transpose per tree: every histogram pass wants rows on
-    # the lane axis ((F, n) int32), and re-deriving it per pass cost a
-    # ~10s-of-MB relayout each level.
-    bins_t = bins.astype(jnp.int32).T  # (F, n)
+    # ONE transpose per tree: every histogram pass wants rows on the
+    # lane axis (F, n), and re-deriving it per pass cost a ~10s-of-MB
+    # relayout each level.  uint8 through the byte tier (B ≤ 256) — see
+    # grow_tree / ops/binpack.py::hist_transpose.
+    bins_t = hist_transpose(bins, B)  # (F, n)
     in_bag = (bag_weight > 0).astype(jnp.float32)
     vals = jnp.stack(
         [grad * bag_weight, hess * bag_weight, in_bag], axis=0

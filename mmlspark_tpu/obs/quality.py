@@ -52,7 +52,12 @@ import numpy as np
 DEFAULT_PSI_GROUPS = 32
 # Smoothing mass added to every group on both sides of the PSI so empty
 # groups cannot produce infinities.
-PSI_EPS = 1e-4
+# Laplace half-count smoothing per slot.  An additive eps ≪ 1 count (the
+# original 1e-4) makes an empty live group contribute p_ref·log(p_ref·n/eps)
+# — ~0.3 PER EMPTY GROUP near the warm floor, dwarfing the chi-square
+# no-drift bias and paging on training-distribution traffic.  Half a count
+# bounds the log ratio by the evidence actually held against the group.
+PSI_EPS = 0.5
 
 _BASELINE_VERSION = 1
 
@@ -81,8 +86,12 @@ def quality_env_config() -> dict:
 def psi(ref_counts, live_counts, eps: float = PSI_EPS) -> float:
     """Population Stability Index between two count vectors.
 
-    Both sides are normalized to probabilities with ``eps`` smoothing per
-    slot; identical distributions → ~0, disjoint ones → large (>1).
+    Both sides take ``eps`` pseudo-counts per slot (Laplace smoothing)
+    before normalizing to probabilities; identical distributions → ~0,
+    disjoint ones → large (>1).  Smoothing in COUNT space means sparse
+    slots are judged by the evidence against them, so the statistic is
+    scale-invariant only to O(G/n²) — exact invariance would require the
+    unsmoothed statistic, which explodes on empty slots.
     """
     r = np.asarray(ref_counts, np.float64) + eps
     l = np.asarray(live_counts, np.float64) + eps
@@ -253,6 +262,18 @@ class _FeatureState:
     def excess_psi(self) -> float:
         return max(0.0, self.psi() - self.psi_bias())
 
+    def psi_noise_sd(self) -> float:
+        """One sigma of the no-drift PSI (same chi-square asymptotics as
+        :meth:`psi_bias`: variance ``2(G-1)·(1/n_live + 1/n_ref)²``).
+        Subtracting the bias centers the statistic but says nothing about
+        its spread — at small live counts the sd rivals the alert
+        threshold itself, so alarm gates add a z·sd guard band."""
+        n_live = max(self.live_rows, 1.0)
+        n_ref = max(self.ref_rows, 1.0)
+        return math.sqrt(2.0 * max(self.n_groups - 1, 1)) * (
+            1.0 / n_live + 1.0 / n_ref
+        )
+
     def missing_rate(self) -> float:
         return (
             float(self.live[-1] / self.live.sum()) if self.live.sum() else 0.0
@@ -295,6 +316,13 @@ class FeatureDriftTracker:
         statistic alarms compare against the threshold."""
         return np.array(
             [st.excess_psi() for st in self._states], np.float64
+        )
+
+    def psi_noise_sds(self) -> np.ndarray:
+        """Per-feature no-drift sd (see :meth:`_FeatureState.psi_noise_sd`)
+        — the alarm guard band."""
+        return np.array(
+            [st.psi_noise_sd() for st in self._states], np.float64
         )
 
     def missing_rates(self) -> np.ndarray:
@@ -410,6 +438,15 @@ class ScoreDriftTracker:
 
     def excess_psi(self) -> float:
         return max(0.0, self.psi() - self.psi_bias())
+
+    def psi_noise_sd(self) -> float:
+        """One sigma of the no-drift score PSI (see
+        :meth:`_FeatureState.psi_noise_sd`)."""
+        n_live = max(self.live_rows(), 1.0)
+        n_ref = max(float(self._ref.sum()), 1.0)
+        return math.sqrt(2.0 * max(len(self._live) - 1, 1)) * (
+            1.0 / n_live + 1.0 / n_ref
+        )
 
     def class_mix_psi(self) -> Optional[float]:
         if self._ref_mix is None or self._live_mix is None:

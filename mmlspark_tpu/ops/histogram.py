@@ -282,6 +282,7 @@ def _scatter_hist_chunk(bins_c, vals_c, num_bins: int):
 def _onehot_hist_chunk(bins_c, vals_c, num_bins: int, feat_block: int = 8):
     """Same contraction as ``_scatter_hist_chunk`` but as MXU matmuls."""
     C, F = bins_c.shape
+    bins_c = bins_c.astype(jnp.int32)  # uint8 arrivals widen per chunk
     pad_f = (-F) % feat_block
     if pad_f:
         # Padded features all hit bin 0 with zero value — harmless.
@@ -316,6 +317,7 @@ def _onehot_hist_chunk_int(bins_c, vals_c, num_bins: int, feat_block: int = 8):
     """Quantized twin of ``_onehot_hist_chunk``: int32 matmul accumulation.
     headroom: per-chunk sums ≤ C·QMAX ≪ 2³¹ (quantize_wire_plan)."""
     C, F = bins_c.shape
+    bins_c = bins_c.astype(jnp.int32)  # uint8 arrivals widen per chunk
     pad_f = (-F) % feat_block
     if pad_f:
         bins_c = jnp.pad(bins_c, ((0, 0), (0, pad_f)))
@@ -356,10 +358,12 @@ def build_histogram(
     merge rides the integer wire, and the returned histogram is
     DEQUANTIZED f32 — downstream gain math is unchanged.
 
-    ``transposed=True`` means ``bins`` arrives as (F, n) int32 — growers
-    hoist the convert+transpose out of their per-pass loop (pallas wants
-    rows on the lane axis; the scatter/onehot fallbacks transpose back,
-    they are the small-scale/test paths).
+    ``transposed=True`` means ``bins`` arrives as (F, n) integer — uint8
+    through the byte tier (``num_bins ≤ 256``, ``ops/binpack.py``), int32
+    past it — growers hoist the transpose out of their per-pass loop
+    (pallas wants rows on the lane axis and widens per VMEM block; the
+    scatter/onehot fallbacks transpose back and widen per chunk, they
+    are the small-scale/test paths).
 
     When ``axis_name`` is set (running inside ``shard_map`` over row shards),
     the result is ``psum``-med across the mesh axis — this single line is the
@@ -541,8 +545,9 @@ def build_histogram_by_leaf(
     (out of bag / padding / other leaves — e.g. the windowed new-children
     pass, which passes ``leaf_ids - base``) must arrive with ``leaf_ids``
     outside ``[0, num_leaves)`` (any parked value, including negatives) or
-    zeroed ``vals``.  ``transposed=True``: bins arrive as (F, n) int32 (see
-    :func:`build_histogram`).  With ``axis_name``, the result is psum-med
+    zeroed ``vals``.  ``transposed=True``: bins arrive as (F, n) integer —
+    uint8 through the byte tier (see :func:`build_histogram`).  With
+    ``axis_name``, the result is psum-med
     across the mesh — the same single-collective structure as
     :func:`build_histogram`.
     """

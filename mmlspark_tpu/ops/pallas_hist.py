@@ -17,7 +17,10 @@ reduction pattern.
 
 Layout choices (TPU tiling wants the last dim lane-sized):
 - bins arrive transposed as (F, rows) so a block is (bf, bm) with rows on
-  the 128-lane axis;
+  the 128-lane axis; the dtype is uint8 through the byte tier
+  (``num_bins ≤ 256``, ``ops/binpack.py``) and every kernel widens to
+  int32 immediately after the block load — 1-byte indices in HBM and on
+  the DMA, int32 only in VMEM;
 - vals arrive channel-major (3, rows) — rows on lanes;
 - bin one-hots are built PER FEATURE as clean 2-D (B, rows) iota-compares:
   a fused (bf, B, rows)→(bf·B, rows) one-hot needs a Mosaic lane relayout
@@ -62,7 +65,9 @@ def _pow2_floor(x: int) -> int:
 def _hist_kernel(bins_ref, vals_ref, out_ref, *, num_bins: int, precision):
     """One (feature-block j, row-block i) cell: out[j] += vals·onehotᵀ."""
     i = pl.program_id(1)  # row block (innermost → accumulation is safe)
-    bins = bins_ref[...]  # (bf, bm) int32
+    # bins arrive uint8 at ≤256 bins (byte tier, ops/binpack.py) — the
+    # HBM→VMEM DMA moves 1 byte/index; widen to int32 IN VMEM only.
+    bins = bins_ref[...].astype(jnp.int32)  # (bf, bm)
     vals = vals_ref[...]  # (3, bm) f32
     bf, bm = bins.shape
     # Per-feature 2-D one-hot over bins, rows on lanes — VMEM only.
@@ -129,18 +134,22 @@ def pallas_hist_chunk(
     scatter/onehot chunk builders in :mod:`mmlspark_tpu.ops.histogram`.
 
     ``transposed=True`` means ``bins_c`` arrives PRE-transposed as (F, C)
-    int32 — the grower hoists the 10s-of-MB convert+transpose out of the
-    per-pass path (it is invariant across a tree's passes).
+    integer — uint8 through the byte tier (``num_bins ≤ 256``), int32
+    past it — the grower hoists the 10s-of-MB transpose out of the
+    per-pass path (it is invariant across a tree's passes).  The kernel
+    widens per VMEM block, so uint8 input quarters the per-pass bins DMA.
 
     Pads rows/features up to block multiples (padded rows carry zero vals,
     padded features are sliced off).
     """
+    from mmlspark_tpu.ops.binpack import hist_transpose
+
     if transposed:
-        bins_t = bins_c  # (F, C) int32 already
+        bins_t = bins_c  # (F, C) integer already
         F, C = bins_t.shape
     else:
         C, F = bins_c.shape
-        bins_t = bins_c.astype(jnp.int32).T  # (F, C): rows on the lane axis
+        bins_t = hist_transpose(bins_c, num_bins)  # (F, C): rows on lanes
     vals_c = vals_c.astype(jnp.float32)
     # VMEM guard: the kernel's iota/one-hot tiles are (num_bins, bm); the
     # defaults were swept at B=256, so scale bm down for bigger bin counts.
@@ -197,7 +206,8 @@ def _hist_leaf_kernel(
 
     def sub(s, acc):
         sl = pl.ds(s * rm, rm)
-        bins = bins_ref[:, sl]  # (bf, rm) int32
+        # uint8 at ≤256 bins: 1-byte DMA, widened in VMEM (see _hist_kernel)
+        bins = bins_ref[:, sl].astype(jnp.int32)  # (bf, rm)
         vals = vals_ref[:, sl]  # (3, rm) f32
         leaf = leaf_ref[0, sl]  # (rm,) int32
         # Leaf-masked values, channel-major columns: rhs[r, c·L + l] =
@@ -294,12 +304,14 @@ def _prep_by_leaf_chunk(
         raise NotImplementedError(
             f"hist_backend='pallas' supports tpu/cpu backends, not {backend!r}"
         )
+    from mmlspark_tpu.ops.binpack import hist_transpose
+
     if transposed:
-        bins_t = bins_c
+        bins_t = bins_c  # (F, C) integer (uint8 through the byte tier)
         F, C = bins_t.shape
     else:
         C, F = bins_c.shape
-        bins_t = bins_c.astype(jnp.int32).T
+        bins_t = hist_transpose(bins_c, num_bins)
     vals_c = vals_c.astype(val_dtype)
     leaf_row = leaf_c.astype(jnp.int32)[None, :]  # (1, C): lane-friendly
     bf = min(bf, max(8, _round_up(F, 8)))  # don't pad tiny feature counts 4x
@@ -388,7 +400,9 @@ def _hist_leaf_nibble_kernel(
 
     def sub(s, acc):
         sl = pl.ds(s * rm, rm)
-        bins = bins_ref[:, sl]  # (bf, rm) int32
+        # uint8 at ≤256 bins: 1-byte DMA, widened in VMEM (the >>/& bit
+        # ops below need the widening anyway — hi spans [0, 2) at B=256)
+        bins = bins_ref[:, sl].astype(jnp.int32)  # (bf, rm)
         vals = vals_ref[:, sl]  # (3, rm) f32
         leaf = leaf_ref[0, sl]  # (rm,) int32
         # All operands keep ROWS ON LANES (rm trailing) — mixed-orientation
@@ -506,7 +520,7 @@ def pallas_hist_by_leaf_nibble_chunk(
 def _hist_kernel_int(bins_ref, vals_ref, out_ref, *, num_bins: int, precision):
     """Quantized twin of ``_hist_kernel``: int16 vals in, int32 out."""
     i = pl.program_id(1)  # row block (innermost → accumulation is safe)
-    bins = bins_ref[...]  # (bf, bm) int32
+    bins = bins_ref[...].astype(jnp.int32)  # (bf, bm); uint8 DMA at ≤256 bins
     vals = vals_ref[...].astype(jnp.float32)  # (3, bm) int16 buckets
     bf, bm = bins.shape
     iota_b = jax.lax.broadcasted_iota(jnp.int32, (num_bins, bm), 0)
@@ -564,12 +578,14 @@ def pallas_hist_chunk_int(
 ) -> jnp.ndarray:
     """Quantized twin of :func:`pallas_hist_chunk`: (3, C) int16 bucket
     vals → (3, F, B) int32, same padding/blocking rules."""
+    from mmlspark_tpu.ops.binpack import hist_transpose
+
     if transposed:
-        bins_t = bins_c  # (F, C) int32 already
+        bins_t = bins_c  # (F, C) integer (uint8 through the byte tier)
         F, C = bins_t.shape
     else:
         C, F = bins_c.shape
-        bins_t = bins_c.astype(jnp.int32).T
+        bins_t = hist_transpose(bins_c, num_bins)
     vals_c = vals_c.astype(jnp.int16)
     bm = min(bm, _pow2_floor(max(512, bm * 256 // num_bins)))
     bm = min(bm, _round_up(C, 128))
@@ -604,7 +620,7 @@ def _hist_leaf_kernel_int(
 
     def sub(s, acc):
         sl = pl.ds(s * rm, rm)
-        bins = bins_ref[:, sl]  # (bf, rm) int32
+        bins = bins_ref[:, sl].astype(jnp.int32)  # (bf, rm); uint8 DMA ≤256 bins
         vals = vals_ref[:, sl].astype(jnp.float32)  # (3, rm) int16 buckets
         leaf = leaf_ref[0, sl]  # (rm,) int32
         iota_l = jax.lax.broadcasted_iota(jnp.int32, (rm, num_leaves), 1)

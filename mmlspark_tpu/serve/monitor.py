@@ -41,6 +41,13 @@ from mmlspark_tpu import obs
 from mmlspark_tpu.obs import flight
 from mmlspark_tpu.obs import quality
 
+# Drift alarms require the excess PSI to clear the alert threshold by
+# z·sd of the no-drift statistic (quality.psi_noise_sd): at the default
+# min_rows=512 the band is ≪ the threshold, but right at the warm floor
+# the statistic's own sampling noise is threshold-sized — 3σ keeps a
+# route serving training-distribution traffic from paging.
+_ALARM_Z = 3.0
+
 
 def find_booster(model):
     """The Booster inside a model, if there is one (LightGBM facades or a
@@ -235,19 +242,30 @@ class ModelQualityMonitor:
                 if st.feature is not None:
                     # alarm on the bias-corrected (excess) PSI: raw PSI's
                     # no-drift expectation scales like groups/rows and
-                    # would page on small-sample noise
-                    psi_max = float(st.feature.excess_psis().max()) \
+                    # would page on small-sample noise.  Subtracting the
+                    # bias only centers the statistic — its no-drift sd
+                    # rivals the threshold at small live counts, so each
+                    # feature also clears a z·sd guard band before paging
+                    ex = st.feature.excess_psis()
+                    psi_max = float(ex.max()) \
                         if st.feature.num_features else 0.0
                     obs.gauge("quality.feature_psi_max", psi_max,
                               model=st.name)
                     warm = st.feature.live_rows() >= min_rows
-                    active["feature_drift"] = warm and psi_max > psi_alert
+                    fired = bool(np.any(
+                        ex > psi_alert
+                        + _ALARM_Z * st.feature.psi_noise_sds()
+                    )) if st.feature.num_features else False
+                    active["feature_drift"] = warm and fired
                     detail["feature_psi_max"] = psi_max
                 if st.score is not None:
                     s_psi = st.score.excess_psi()
                     obs.gauge("quality.score_psi", s_psi, model=st.name)
                     warm = st.score.live_rows() >= min_rows
-                    active["score_drift"] = warm and s_psi > psi_alert
+                    band = _ALARM_Z * st.score.psi_noise_sd()
+                    active["score_drift"] = (
+                        warm and s_psi > psi_alert + band
+                    )
                     detail["score_psi"] = s_psi
                 slo = st.slo.evaluate(now)
                 for kind in ("availability", "latency"):

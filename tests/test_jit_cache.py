@@ -161,3 +161,77 @@ def test_hit_recorder_wraps_jax_cache(monkeypatch, tmp_path):
     # re-install is a no-op (no double wrap)
     jc._install_hit_recorder(str(cache_dir))
     assert cc.get_executable_and_time is wrapped
+
+
+def test_prune_evicts_oldest_across_artifact_kinds(tmp_path):
+    """ISSUE 11: the cache dir now holds jax entries plus ``aot-*``
+    executables and ``pft-*`` packed-forest states; pruning stays one
+    LRU over ALL of them — eviction order is age, never kind."""
+    import time
+
+    from mmlspark_tpu.core.jit_cache import prune_cache_dir
+
+    d = tmp_path / "jit"
+    d.mkdir()
+    files = ["aot-old", "pft-mid", "jaxentry-cache", "aot-new"]
+    for i, name in enumerate(files):
+        p = d / name
+        p.write_bytes(b"x" * 1024)
+        t = time.time() - (400 - 100 * i)  # aot-old oldest ... aot-new newest
+        os.utime(p, (t, t))
+    # cap at 2 KiB -> the two oldest go: one aot, one pft — the newer
+    # jax entry and aot survive regardless of prefix
+    assert prune_cache_dir(str(d), max_mb=2 / 1024) == 2
+    assert sorted(f.name for f in d.iterdir()) == ["aot-new", "jaxentry-cache"]
+
+
+def test_aot_roundtrip_across_process_boundary(tmp_path):
+    """The ISSUE 11 cold-start contract end to end: process A compiles a
+    padded predict and persists the ``aot-*`` executable; process B —
+    sharing only the cache DIR, not the process — deserializes it (AOT
+    hits, zero misses) and reproduces the scores bitwise."""
+    import json
+    import pickle
+    import subprocess
+    import sys
+
+    import numpy as np
+
+    from mmlspark_tpu.engine.booster import Dataset, train
+
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(256, 4))
+    y = (X[:, 0] + 0.3 * X[:, 1] > 0).astype(np.float64)
+    booster = train(
+        dict(objective="binary", num_iterations=3, num_leaves=7,
+             min_data_in_leaf=4, max_bin=31),
+        Dataset(X, y),
+    )
+    pkl = tmp_path / "booster.pkl"
+    pkl.write_bytes(pickle.dumps(booster))
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ)
+    env["MMLSPARK_TPU_COMPILE_CACHE_DIR"] = str(tmp_path / "jit")
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("XLA_FLAGS", None)  # children: plain single-device cpu
+
+    def leg(name):
+        out_npy = tmp_path / f"{name}.npy"
+        r = subprocess.run(
+            [sys.executable, "-m", "tools.bench_predict",
+             "--cold-child", str(pkl), "--bucket", "8",
+             "--out-npy", str(out_npy)],
+            cwd=repo, env=env, capture_output=True, text=True, timeout=300,
+        )
+        assert r.returncode == 0, r.stderr[-2000:]
+        return json.loads(r.stdout.strip().splitlines()[-1]), np.load(out_npy)
+
+    a, out_a = leg("cleared")
+    assert a["aot_hits"] == 0 and a["aot_misses"] > 0
+    assert any(
+        f.name.startswith("aot-") for f in (tmp_path / "jit").iterdir()
+    ), "process A persisted no aot-* artifact"
+    b, out_b = leg("from_disk")
+    assert b["aot_misses"] == 0 and b["aot_hits"] >= a["aot_misses"]
+    np.testing.assert_array_equal(out_a, out_b)

@@ -418,13 +418,15 @@ def _post(host, port, payload):
         return r.status, r.read()
 
 
-def _wait_counter(key, timeout=5.0):
+def _wait_counter(key, value=1, timeout=5.0):
     """Counters increment on the handler thread after the reply bytes are
-    already on the wire — poll briefly instead of asserting immediately."""
+    already on the wire — poll until the EXPECTED count lands (existence
+    alone races: the first request creates the key while later ones are
+    still mid-increment)."""
     deadline = time.time() + timeout
     while time.time() < deadline:
         snap = obs.snapshot()
-        if key in snap["counters"]:
+        if snap["counters"].get(key, 0) >= value:
             return snap
         time.sleep(0.01)
     return obs.snapshot()
@@ -458,7 +460,7 @@ class TestServingInstrumentation:
             for i in range(3):
                 status, body = _post(server.host, server.port, {"v": i})
                 assert status == 200
-            snap = _wait_counter("http.requests{status=200}")
+            snap = _wait_counter("http.requests{status=200}", value=3)
             assert snap["counters"]["http.requests{status=200}"] == 3
             h = snap["histograms"]["http.request_latency_s"]
             assert h["count"] == 3 and h["max"] > 0

@@ -86,7 +86,9 @@ def saved_models(tmp_path_factory):
 class TestPSI:
     def test_identical_distributions_are_near_zero(self):
         c = [100.0, 200.0, 300.0, 50.0]
-        assert psi(c, [v * 3 for v in c]) < 1e-6  # scale-invariant
+        # scale-invariant to O(G/n²): half-count Laplace smoothing keeps
+        # empty slots bounded at the cost of exact invariance
+        assert psi(c, [v * 3 for v in c]) < 1e-5
 
     def test_disjoint_distributions_are_large(self):
         assert psi([100.0, 0.0, 0.0], [0.0, 0.0, 100.0]) > 1.0
@@ -436,8 +438,12 @@ class TestSwapWithMonitor:
         stop = threading.Event()
         driftz_statuses = []
 
-        def hammer():
-            rng = np.random.default_rng(13)
+        def hammer(seed):
+            # distinct seeds: four copies of ONE sampled stream would
+            # quarter the effective sample size and the PSI's no-drift
+            # spread is ~4x the n_live the bias/band formulas see —
+            # that duplication reads as drift, not as more traffic
+            rng = np.random.default_rng(seed)
             while not stop.is_set():
                 n = rng.integers(1, 12)
                 idx = rng.integers(0, len(X), size=n)
@@ -449,8 +455,9 @@ class TestSwapWithMonitor:
                 driftz_statuses.append(_get(f"{app.url}/driftz")[0])
                 time.sleep(0.01)
 
-        threads = [threading.Thread(target=hammer, daemon=True)
-                   for _ in range(4)]
+        threads = [threading.Thread(target=hammer, args=(13 + i,),
+                                    daemon=True)
+                   for i in range(4)]
         threads.append(threading.Thread(target=poll_driftz, daemon=True))
         for t in threads:
             t.start()
