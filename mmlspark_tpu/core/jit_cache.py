@@ -340,6 +340,32 @@ def load_aot(key: str):
     return None
 
 
+def load_or_compile_aot(kind: str, meta: dict, args, lower):
+    """Disk-first compiled-executable resolution shared by the
+    single-model serving program (``kind="packed_raw_rows"``, booster)
+    and the co-resident super-table program
+    (``kind="multi_packed_raw_rows"``, serve.coresident): fingerprint the
+    statics + arg shapes, try ``load_aot``, and only on a genuine miss
+    call ``lower()`` (returning a jax lowering), compile, and persist.
+
+    Returns ``(executable, how)`` with ``how`` in ``{"from_disk",
+    "traced"}``.  Fingerprinting failures degrade to the trace path —
+    never raise over a cache.
+    """
+    key = None
+    try:
+        key = aot_fingerprint(kind, meta, args)
+    except Exception:
+        pass
+    exe = load_aot(key) if key is not None else None
+    if exe is not None:
+        return exe, "from_disk"
+    exe = lower().compile()
+    if key is not None:
+        save_aot(key, exe)
+    return exe, "traced"
+
+
 def save_pft(key: str, arrays_state: bytes) -> bool:
     """Store pickled packed-forest host arrays under ``pft-<key>`` (the
     per-tree Python pack loop is the dominant from-disk cold cost)."""

@@ -725,22 +725,12 @@ class Booster:
         exe = self._aot_execs.get(ck)
         if exe is not None:
             return exe, None
-        key = None
-        try:
-            key = _jc.aot_fingerprint(
-                "packed_raw_rows",
-                _forest.packed_raw_rows_meta(pf, db),
-                (pf.arrays, db.arrays, rows),
-            )
-        except Exception:
-            pass
-        exe = _jc.load_aot(key) if key is not None else None
-        how = "from_disk"
-        if exe is None:
-            exe = _forest.lower_packed_raw_rows(pf, db, rows).compile()
-            if key is not None:
-                _jc.save_aot(key, exe)
-            how = "traced"
+        exe, how = _jc.load_or_compile_aot(
+            "packed_raw_rows",
+            _forest.packed_raw_rows_meta(pf, db),
+            (pf.arrays, db.arrays, rows),
+            lambda: _forest.lower_packed_raw_rows(pf, db, rows),
+        )
         self._aot_execs[ck] = exe
         return exe, how
 

@@ -443,6 +443,320 @@ def packed_raw_scores_rows(pf: PackedForest, device_binner, rows) -> jnp.ndarray
 
 
 # ---------------------------------------------------------------------------
+# Multi-model co-resident super-table (ISSUE 13 tentpole)
+# ---------------------------------------------------------------------------
+class PackedSegment(NamedTuple):
+    """One model's HOST-side packed slice: the numpy node table plus the
+    static meta needed to place it in a super-table.  Segments are what a
+    tenant hot-swap rebuilds — concatenating cached segments into a new
+    super-table is a cheap ``np.concatenate``, so swapping one tenant
+    never re-packs the others."""
+
+    arrays: dict        # numpy PackedArrays columns (nav/ft/catrow/...)
+    num_trees: int
+    num_class: int
+    max_depth: int
+    num_bins: int
+    has_cats: bool
+
+
+def segment_from_packed(pf: PackedForest) -> PackedSegment:
+    """Snapshot a :class:`PackedForest` as a host segment (one download of
+    the node table; free on CPU backends)."""
+    np_arrays = {
+        k: np.asarray(getattr(pf.arrays, k)) for k in PackedArrays._fields
+    }
+    return PackedSegment(
+        arrays=np_arrays, num_trees=pf.num_trees, num_class=pf.num_class,
+        max_depth=pf.max_depth, num_bins=pf.num_bins, has_cats=pf.has_cats,
+    )
+
+
+class MultiPackedArrays(NamedTuple):
+    """The fleet-wide device SoA: N node tables concatenated, with
+    per-model offsets folded into the packed words at build time so the
+    traversal needs NO per-step offset arithmetic."""
+
+    nav: jnp.ndarray           # (Ntot,) int32; node_base pre-added to child_base
+    ft: jnp.ndarray            # (Ntot,) int32
+    catrow: jnp.ndarray        # (Ntot,) int32; cat_base pre-added
+    leafv: jnp.ndarray         # (Ntot,) f32 | f16 | int8 (leaf_dtype)
+    cat_table: jnp.ndarray     # (Ctot, Bmax) bool
+    root_table: jnp.ndarray    # (M, TTmax) int32; pad slots repeat a real root
+    weight_table: jnp.ndarray  # (M, TTmax) f32; int8 dequant scale folded in
+    class_table: jnp.ndarray   # (M, TTmax) int32 — slot j's class (j % K_m)
+    tt: jnp.ndarray            # (M,) int32 — live slots per model (T_m * K_m)
+    missing_bin: jnp.ndarray   # (M,) int32 — num_bins_m - 1
+
+
+@dataclasses.dataclass(frozen=True)
+class MultiPackedForest:
+    """N packed forests resident as ONE device super-table, serving a
+    mixed batch (rows + model-id column) in one dispatch.
+
+    Per-model raw scores are bitwise-identical to the standalone
+    :class:`PackedForest` path (``leaf_dtype="f32"``): traversal gathers
+    the same words (offsets are pre-folded), and the accumulation below
+    replays the standalone serial tree fold per class.  ``"f16"`` /
+    ``"int8"`` leaf tables trade that guarantee for memory (values are
+    upcast/dequantized to f32 before the accumulate; gate swaps on a
+    measured AUC drift — see serve/README.md)."""
+
+    arrays: MultiPackedArrays
+    names: Tuple[str, ...]
+    segments: Tuple[PackedSegment, ...]   # host copies, kept for slice swaps
+    num_models: int
+    max_tt: int        # TTmax: max T_m * K_m
+    max_class: int     # Kmax
+    max_depth: int
+    has_cats: bool
+    leaf_dtype: str    # "f32" | "f16" | "int8"
+    nbytes: int
+    offsets: Tuple[dict, ...]  # per-model node_base/tree_base/cat_base/...
+
+    def model_id(self, name: str) -> int:
+        return self.names.index(name)
+
+
+_LEAF_DTYPES = {"f32": np.float32, "f16": np.float16, "int8": np.int8}
+
+
+def _quantize_leaves(leafv: np.ndarray, leaf_dtype: str):
+    """Per-model leaf-table quantization → (stored values, dequant scale).
+
+    The scale is folded into the model's weight_table slots so the device
+    accumulate stays the plain f32 ``acc + w·v`` fold."""
+    if leaf_dtype == "f32":
+        return leafv.astype(np.float32), 1.0
+    if leaf_dtype == "f16":
+        return leafv.astype(np.float16), 1.0
+    amax = float(np.max(np.abs(leafv))) if leafv.size else 0.0
+    scale = (amax / 127.0) if amax > 0 else 1.0
+    q = np.clip(np.rint(leafv / scale), -127, 127).astype(np.int8)
+    return q, scale
+
+
+def build_multi_forest(named_segments, leaf_dtype: str = "f32",
+                       ) -> MultiPackedForest:
+    """Concatenate ``[(name, PackedSegment), ...]`` into one resident
+    super-table (single upload).  Offsets: ``node_base`` is pre-added to
+    every ``child_base`` and root, ``cat_base`` to every ``catrow`` (each
+    model keeps its own all-False row 0), ``tree_base`` positions the
+    model's slots in the padded ``(M, TTmax)`` per-tree tables."""
+    if leaf_dtype not in _LEAF_DTYPES:
+        raise ValueError(f"leaf_dtype must be f32|f16|int8, got {leaf_dtype!r}")
+    names = tuple(n for n, _ in named_segments)
+    segments = tuple(s for _, s in named_segments)
+    if len(set(names)) != len(names):
+        raise ValueError(f"duplicate model names: {names}")
+    M = len(segments)
+    if M == 0:
+        raise ValueError("build_multi_forest needs at least one segment")
+    TTmax = max(s.num_trees * s.num_class for s in segments)
+    Kmax = max(s.num_class for s in segments)
+    Bmax = max(
+        max(int(s.arrays["cat_table"].shape[1]), s.num_bins) for s in segments
+    )
+
+    nav_col, ft_col, catrow_col, leafv_col = [], [], [], []
+    cat_blocks = []
+    root_table = np.zeros((M, TTmax), np.int32)
+    weight_table = np.zeros((M, TTmax), np.float32)
+    class_table = np.zeros((M, TTmax), np.int32)
+    tt = np.zeros(M, np.int32)
+    missing_bin = np.zeros(M, np.int32)
+    offsets = []
+    node_base = cat_base = tree_base = 0
+    for m, seg in enumerate(segments):
+        a = seg.arrays
+        n_nodes = int(a["nav"].shape[0])
+        K = seg.num_class
+        tt_m = seg.num_trees * K
+        nav_col.append(a["nav"].astype(np.int64) + (node_base << 2))
+        ft_col.append(a["ft"])
+        catrow_col.append(a["catrow"].astype(np.int64) + cat_base)
+        q, scale = _quantize_leaves(np.asarray(a["leafv"]), leaf_dtype)
+        leafv_col.append(q)
+        ct = np.asarray(a["cat_table"], bool)
+        block = np.zeros((ct.shape[0], Bmax), bool)
+        block[:, : ct.shape[1]] = ct
+        cat_blocks.append(block)
+        roots = a["root"].astype(np.int64) + node_base
+        root_table[m, :tt_m] = roots
+        root_table[m, tt_m:] = roots[0]   # in-bounds no-op walks, masked out
+        w = np.asarray(a["weight"], np.float64)
+        slot_w = (w[np.arange(tt_m) // K] * scale).astype(np.float32)
+        weight_table[m, :tt_m] = slot_w
+        class_table[m, :tt_m] = np.arange(tt_m, dtype=np.int32) % K
+        tt[m] = tt_m
+        missing_bin[m] = seg.num_bins - 1
+        offsets.append(dict(
+            node_base=node_base, n_nodes=n_nodes, tree_base=tree_base,
+            cat_base=cat_base, T=seg.num_trees, K=K,
+            num_bins=seg.num_bins, max_depth=seg.max_depth,
+            leaf_scale=scale,
+        ))
+        node_base += n_nodes
+        cat_base += ct.shape[0]
+        tree_base += tt_m
+
+    assert node_base < (1 << 29), "super-table too large for nav packing"
+    np_arrays = dict(
+        nav=np.concatenate(nav_col).astype(np.int32),
+        ft=np.concatenate(ft_col).astype(np.int32),
+        catrow=np.concatenate(catrow_col).astype(np.int32),
+        leafv=np.concatenate(leafv_col).astype(_LEAF_DTYPES[leaf_dtype]),
+        cat_table=np.concatenate(cat_blocks, axis=0),
+        root_table=root_table, weight_table=weight_table,
+        class_table=class_table, tt=tt, missing_bin=missing_bin,
+    )
+    nbytes = sum(v.nbytes for v in np_arrays.values())
+    has_cats = any(s.has_cats for s in segments)
+    with obs.span("predict.pack_multi_forest", models=M,
+                  nodes=int(node_base), leaf_dtype=leaf_dtype):
+        arrays = MultiPackedArrays(
+            **{k: jnp.asarray(v) for k, v in np_arrays.items()}
+        )
+    if obs.enabled():
+        obs.inc("predict.multi_packed_builds")
+        obs.inc("predict.packed_upload_bytes", float(nbytes))
+    return MultiPackedForest(
+        arrays=arrays, names=names, segments=segments, num_models=M,
+        max_tt=TTmax, max_class=Kmax,
+        max_depth=max(s.max_depth for s in segments),
+        has_cats=has_cats, leaf_dtype=leaf_dtype, nbytes=nbytes,
+        offsets=tuple(offsets),
+    )
+
+
+def swap_multi_segment(mpf: MultiPackedForest, name: str,
+                       seg: PackedSegment) -> MultiPackedForest:
+    """Rebuild the super-table with ONE tenant's slice replaced.  Every
+    other tenant's cached host segment is reused verbatim (no re-pack) —
+    only the concatenation and the single upload re-run."""
+    i = mpf.model_id(name)
+    segs = list(mpf.segments)
+    segs[i] = seg
+    return build_multi_forest(
+        list(zip(mpf.names, segs)), leaf_dtype=mpf.leaf_dtype
+    )
+
+
+def _multi_leaf_cursors(a: MultiPackedArrays, bins, mid, *, depth: int,
+                        has_cats: bool):
+    """(n, TTmax) cursors after ``depth`` level steps, each row walking
+    ITS model's trees (roots and child targets carry pre-folded
+    node_base offsets, so the step body is the standalone one)."""
+    bins_i = bins.astype(jnp.int32)
+    mid_i = mid.astype(jnp.int32)
+    cur0 = a.root_table[mid_i]                           # (n, TTmax)
+    mb = a.missing_bin[mid_i][:, None]                   # (n, 1)
+
+    def level(_, cur):
+        ft = a.ft[cur]
+        nav = a.nav[cur]
+        b = jnp.take_along_axis(bins_i, ft >> 16, axis=1)
+        miss = b == mb
+        go_left = jnp.where(miss, (nav & 1) == 1, b <= (ft & 0xFFFF))
+        if has_cats:
+            go_left = jnp.where(
+                (nav & 2) == 2, a.cat_table[a.catrow[cur], b], go_left
+            )
+        return (nav >> 2) + jnp.where(go_left, 0, 1)
+
+    return lax.fori_loop(0, depth, level, cur0)
+
+
+def _multi_raw_impl(a: MultiPackedArrays, bins, mid, *, TT: int, K: int,
+                    depth: int, has_cats: bool):
+    """(Kmax, n) raw scores for a mixed batch, bitwise-equal per model to
+    the standalone fold (f32 leaves): for a row of model m and class k
+    the masked updates fire exactly at slots ``j = t·K_m + k`` ascending
+    in t — the same ``acc + w_t·v_{t,k}`` f32 sequence ``_packed_raw``
+    scans.  Masking selects via ``jnp.where`` (never additive zero), so
+    ``-0.0`` leaves survive untouched."""
+    n = bins.shape[0]
+    cur = _multi_leaf_cursors(a, bins, mid, depth=depth, has_cats=has_cats)
+    vals = a.leafv[cur].astype(jnp.float32)               # (n, TTmax)
+    mid_i = mid.astype(jnp.int32)
+    w = a.weight_table[mid_i]                             # (n, TTmax)
+    cls = a.class_table[mid_i]                            # (n, TTmax)
+    tt = a.tt[mid_i]                                      # (n,)
+    iota_k = jnp.arange(K, dtype=jnp.int32)[:, None]      # (K, 1)
+
+    def body(j, acc):
+        sel = (iota_k == cls[:, j][None, :]) & (j < tt)[None, :]
+        return jnp.where(sel, acc + w[:, j][None, :] * vals[:, j][None, :],
+                         acc)
+
+    return lax.fori_loop(0, TT, body, jnp.zeros((K, n), jnp.float32))
+
+
+_multi_raw = partial(jax.jit, static_argnames=("TT", "K", "depth",
+                                               "has_cats"))(_multi_raw_impl)
+
+
+def multi_packed_raw_scores(mpf: MultiPackedForest, bins, mid) -> jnp.ndarray:
+    """(Kmax, n) raw scores from pre-binned (n, Fmax) bins + (n,) model
+    ids (rows of model m with K_m < Kmax leave rows K_m.. at zero)."""
+    return _multi_raw(
+        mpf.arrays, bins, mid, TT=mpf.max_tt, K=mpf.max_class,
+        depth=mpf.max_depth, has_cats=mpf.has_cats,
+    )
+
+
+@partial(jax.jit, static_argnames=("TT", "K", "depth", "has_cats", "n_bounds"))
+def _multi_raw_rows(a: MultiPackedArrays, binner_arrays, rows, mid, *,
+                    TT: int, K: int, depth: int, has_cats: bool,
+                    n_bounds: int):
+    from mmlspark_tpu.ops.device_binning import bin_rows_device_multi
+
+    bins = bin_rows_device_multi(binner_arrays, rows, mid, n_bounds=n_bounds)
+    return _multi_raw_impl(
+        a, bins, mid, TT=TT, K=K, depth=depth, has_cats=has_cats
+    )
+
+
+def multi_packed_raw_scores_rows(mpf: MultiPackedForest, multi_binner,
+                                 rows, mid) -> jnp.ndarray:
+    """The co-resident serving entry: raw f32 rows + model ids →
+    (Kmax, n) raw scores, binning and traversal fused in ONE dispatch."""
+    return _multi_raw_rows(
+        mpf.arrays, multi_binner.arrays, rows, mid, TT=mpf.max_tt,
+        K=mpf.max_class, depth=mpf.max_depth, has_cats=mpf.has_cats,
+        n_bounds=multi_binner.n_bounds,
+    )
+
+
+def lower_multi_packed_raw_rows(mpf: MultiPackedForest, multi_binner,
+                                rows, mid):
+    """AOT lowering of the super-table serving program for one bucket
+    shape — the multi-model analogue of :func:`lower_packed_raw_rows`."""
+    return _multi_raw_rows.lower(
+        mpf.arrays, multi_binner.arrays, rows, mid, TT=mpf.max_tt,
+        K=mpf.max_class, depth=mpf.max_depth, has_cats=mpf.has_cats,
+        n_bounds=multi_binner.n_bounds,
+    )
+
+
+def multi_packed_raw_rows_meta(mpf: MultiPackedForest, multi_binner) -> dict:
+    """Static half of the super-table AOT fingerprint.  Weights/leaves
+    are runtime args — a same-shape tenant swap reuses the executable —
+    but anything the trace bakes in (fleet maxima, per-model layout) is
+    here so a shape-changing swap re-fingerprints."""
+    return dict(
+        M=int(mpf.num_models), TT=int(mpf.max_tt), K=int(mpf.max_class),
+        depth=int(mpf.max_depth), has_cats=bool(mpf.has_cats),
+        leaf_dtype=mpf.leaf_dtype, n_bounds=int(multi_binner.n_bounds),
+        F=int(multi_binner.num_features),
+        models=[
+            dict(T=o["T"], K=o["K"], num_bins=o["num_bins"],
+                 n_nodes=o["n_nodes"]) for o in mpf.offsets
+        ],
+    )
+
+
+# ---------------------------------------------------------------------------
 # Predict-backend resolution (the hist_backend="auto" pattern)
 # ---------------------------------------------------------------------------
 def resolve_predict_backend(

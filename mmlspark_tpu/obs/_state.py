@@ -69,6 +69,37 @@ def process_count_hint() -> int:
     return 1
 
 
+def replica_id() -> Optional[str]:
+    """Per-replica file namespace for same-host serving fleets.
+
+    N replica processes on one host are each rank 0 of their own
+    single-process world, so rank alone cannot keep their export and
+    blackbox files apart — ``MMLSPARK_TPU_REPLICA_ID`` (set by
+    serve/router.py when it spawns replicas, or by hand) adds the
+    disambiguating tag.  None outside fleet mode: filenames stay exactly
+    as before."""
+    v = os.environ.get("MMLSPARK_TPU_REPLICA_ID")
+    if v is None:
+        return None
+    v = v.strip()
+    return v or None
+
+
+def file_suffix() -> str:
+    """Filename tag for per-process export files: empty for a plain
+    single process, ``.rank<R>`` under multi-process, and
+    ``.rank<R>.rep<ID>`` for fleet replicas.  The ``.rep`` tag rides
+    AFTER the rank so existing ``<path>.rank*`` discovery globs in
+    tools/obs keep matching fleet files."""
+    suffix = ""
+    rid = replica_id()
+    if process_count_hint() > 1 or rid is not None:
+        suffix = f".rank{process_index()}"
+    if rid is not None:
+        suffix += f".rep{rid}"
+    return suffix
+
+
 def reset_rank_cache() -> None:
     global _rank
     _rank = None

@@ -181,7 +181,13 @@ def blackbox_path(directory: Optional[str] = None) -> Optional[str]:
     d = directory or flight_dir()
     if not d:
         return None
-    return os.path.join(d, f"blackbox.rank{_state.process_index()}.jsonl")
+    # fleet replicas (all rank 0 on one host) get a .rep<ID> tag so their
+    # dumps never clobber each other; blackbox.rank*.jsonl globs still match
+    rid = _state.replica_id()
+    rep = f".rep{rid}" if rid is not None else ""
+    return os.path.join(
+        d, f"blackbox.rank{_state.process_index()}{rep}.jsonl"
+    )
 
 
 def _snapshot_rings() -> "list[tuple[str, list]]":
@@ -215,9 +221,11 @@ def dump(reason: str, directory: Optional[str] = None) -> Optional[str]:
                           for (t, kind, name, detail) in ring)
         events.sort(key=lambda e: e[0])
         rank = _state.process_index()
+        rid = _state.replica_id()
         header = {
             "kind": "flight_header",
             "rank": rank,
+            **({"replica": rid} if rid is not None else {}),
             "reason": reason,
             # Paired wall/monotonic anchor: wall(ev) = ts - (mono_ns - t_ns)/1e9
             "ts": time.time(),

@@ -179,9 +179,9 @@ class _Exporter:
 
     def _open(self):
         if self._f is None:
-            path = self._requested
-            if _state.process_count_hint() > 1:
-                path = f"{path}.rank{_state.process_index()}"
+            # rank suffix under multi-process; .rep<ID> tag for fleet
+            # replicas (same-host, all rank 0) — see _state.file_suffix
+            path = self._requested + _state.file_suffix()
             d = os.path.dirname(os.path.abspath(path))
             os.makedirs(d, exist_ok=True)
             self._f = open(path, "a", buffering=1)
@@ -190,6 +190,9 @@ class _Exporter:
 
     def write(self, rec: dict) -> None:
         try:
+            rid = _state.replica_id()
+            if rid is not None and "replica" not in rec:
+                rec["replica"] = rid  # fleet merge key (tools/obs)
             line = json.dumps(rec, separators=(",", ":"), default=str)
             with self._lock:
                 self._open().write(line + "\n")

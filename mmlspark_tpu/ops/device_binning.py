@@ -62,6 +62,36 @@ class DeviceBinnerArrays(NamedTuple):
     iscat: jnp.ndarray  # (F,) bool
 
 
+def _host_tables(bm: BinMapper):
+    """Host-side (hi, lo, iscat, P) double-single boundary tables for one
+    mapper — shared by the single-model upload and the stacked multi-model
+    table so both bin through IDENTICAL encodings."""
+    F = bm.num_features
+    cat_set = set(bm.categorical_features)
+    rows = []
+    for f in range(F):
+        if f in cat_set:
+            rows.append(np.asarray(
+                bm.cat_maps.get(f, np.empty(0, np.int64)), np.float64))
+        else:
+            rows.append(np.asarray(bm.upper_bounds[f], np.float64))
+    max_len = max((len(r) for r in rows), default=0)
+    P = 1 << int(np.ceil(np.log2(max_len + 1))) if max_len else 1
+    table = np.full((F, P), np.inf, np.float64)
+    for f, r in enumerate(rows):
+        table[f, : len(r)] = r
+    hi = table.astype(np.float32)
+    finite = np.isfinite(hi)
+    lo = np.zeros_like(table)
+    np.subtract(table, hi.astype(np.float64), out=lo, where=finite)
+    lo = lo.astype(np.float32)
+    iscat = np.zeros(F, bool)
+    for f in cat_set:
+        if 0 <= f < F:
+            iscat[f] = True
+    return hi, lo, iscat, P
+
+
 @dataclasses.dataclass(frozen=True)
 class DeviceBinner:
     """Uploaded-once binning state + static search metadata."""
@@ -74,29 +104,8 @@ class DeviceBinner:
 
     @staticmethod
     def from_mapper(bm: BinMapper) -> "DeviceBinner":
+        hi, lo, iscat, P = _host_tables(bm)
         F = bm.num_features
-        cat_set = set(bm.categorical_features)
-        rows = []
-        for f in range(F):
-            if f in cat_set:
-                rows.append(np.asarray(
-                    bm.cat_maps.get(f, np.empty(0, np.int64)), np.float64))
-            else:
-                rows.append(np.asarray(bm.upper_bounds[f], np.float64))
-        max_len = max((len(r) for r in rows), default=0)
-        P = 1 << int(np.ceil(np.log2(max_len + 1))) if max_len else 1
-        table = np.full((F, P), np.inf, np.float64)
-        for f, r in enumerate(rows):
-            table[f, : len(r)] = r
-        hi = table.astype(np.float32)
-        finite = np.isfinite(hi)
-        lo = np.zeros_like(table)
-        np.subtract(table, hi.astype(np.float64), out=lo, where=finite)
-        lo = lo.astype(np.float32)
-        iscat = np.zeros(F, bool)
-        for f in cat_set:
-            if 0 <= f < F:
-                iscat[f] = True
         nbytes = hi.nbytes + lo.nbytes + iscat.nbytes
         with obs.span("predict.upload_bin_edges", features=F, padded=P):
             arrays = DeviceBinnerArrays(
@@ -154,3 +163,91 @@ def bin_rows_device(a: DeviceBinnerArrays, rows, *, missing_bin: int,
 @partial(jax.jit, static_argnames=("missing_bin", "n_bounds"))
 def _transform(a: DeviceBinnerArrays, rows, *, missing_bin: int, n_bounds: int):
     return bin_rows_device(a, rows, missing_bin=missing_bin, n_bounds=n_bounds)
+
+
+# ---------------------------------------------------------------------------
+# Multi-model stacked binner (co-resident serving, ISSUE 13)
+# ---------------------------------------------------------------------------
+class MultiDeviceBinnerArrays(NamedTuple):
+    """Per-model boundary tables stacked on a leading model axis."""
+
+    hi: jnp.ndarray       # (M, F, P) float32; +inf pad rows/cols
+    lo: jnp.ndarray       # (M, F, P) float32
+    iscat: jnp.ndarray    # (M, F) bool
+    missing: jnp.ndarray  # (M,) int32 — per-model missing bin id
+
+
+@dataclasses.dataclass(frozen=True)
+class MultiDeviceBinner:
+    """N models' binning state in ONE table so a mixed batch bins in one
+    fused prologue.  Each model's rows are its exact
+    :func:`_host_tables` encoding padded to the fleet-wide (F, P) with
+    +inf — padding never sorts below any value, so the power-of-two
+    lower bound returns the model's standalone bin ids bit-for-bit."""
+
+    arrays: MultiDeviceBinnerArrays
+    num_models: int
+    num_features: int  # F: fleet-wide max feature count
+    n_bounds: int      # P: fleet-wide max padded row length (power of two)
+    nbytes: int
+
+    @staticmethod
+    def from_mappers(mappers) -> "MultiDeviceBinner":
+        parts = [_host_tables(bm) for bm in mappers]
+        M = len(parts)
+        F = max(p[0].shape[0] for p in parts)
+        P = max(p[3] for p in parts)
+        hi = np.full((M, F, P), np.inf, np.float32)
+        lo = np.zeros((M, F, P), np.float32)
+        iscat = np.zeros((M, F), bool)
+        missing = np.zeros(M, np.int32)
+        for m, ((h, l, c, _), bm) in enumerate(zip(parts, mappers)):
+            f_m, p_m = h.shape
+            hi[m, :f_m, :p_m] = h
+            lo[m, :f_m, :p_m] = l
+            iscat[m, : c.shape[0]] = c
+            missing[m] = bm.missing_bin
+        nbytes = hi.nbytes + lo.nbytes + iscat.nbytes + missing.nbytes
+        with obs.span("predict.upload_bin_edges", features=F, padded=P,
+                      models=M):
+            arrays = MultiDeviceBinnerArrays(
+                hi=jnp.asarray(hi), lo=jnp.asarray(lo),
+                iscat=jnp.asarray(iscat), missing=jnp.asarray(missing),
+            )
+        if obs.enabled():
+            obs.inc("predict.binner_uploads")
+            obs.inc("predict.binner_upload_bytes", float(nbytes))
+        return MultiDeviceBinner(
+            arrays=arrays, num_models=M, num_features=F, n_bounds=P,
+            nbytes=nbytes,
+        )
+
+
+def bin_rows_device_multi(a: MultiDeviceBinnerArrays, rows, mid, *,
+                          n_bounds: int) -> jnp.ndarray:
+    """Trace-time body: (n, F) f32 rows + (n,) int32 model ids → (n, F)
+    int32 bins, each row binned against ITS model's boundary rows."""
+    v_raw = rows.astype(jnp.float32)
+    m = mid.astype(jnp.int32)[:, None]                       # (n, 1)
+    iscat = a.iscat[m[:, 0]]                                 # (n, F)
+    v = jnp.where(iscat, jnp.trunc(v_raw), v_raw)
+
+    farange = jnp.arange(a.hi.shape[1])[None, :]             # (1, F)
+    pos = jnp.zeros(v.shape, jnp.int32)
+    step = n_bounds // 2
+    while step >= 1:
+        nxt = pos + step
+        h = a.hi[m, farange, nxt - 1]
+        l = a.lo[m, farange, nxt - 1]
+        below = (h < v) | ((h == v) & (l < 0))
+        pos = jnp.where(below, nxt, pos)
+        step //= 2
+
+    mb = a.missing[m[:, 0]][:, None]                         # (n, 1)
+    h_at = a.hi[m, farange, pos]
+    l_at = a.lo[m, farange, pos]
+    hit = (h_at == v) & (l_at == 0) & jnp.isfinite(v)
+    cat_bins = jnp.where(hit, pos, mb)
+
+    bins = jnp.where(iscat, cat_bins, pos)
+    return jnp.where(jnp.isnan(v_raw), mb, bins).astype(jnp.int32)

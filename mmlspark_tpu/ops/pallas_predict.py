@@ -200,3 +200,196 @@ def pallas_raw_scores(pf: PallasForest, bins, num_bins: int,
         interpret=interpret or backend == "cpu",
     )
     return out[:, :n]
+
+
+# ---------------------------------------------------------------------------
+# Multi-model co-resident kernel (ISSUE 13): one launch, mixed batch
+# ---------------------------------------------------------------------------
+class MultiPallasForest(NamedTuple):
+    """N models' replay tables concatenated tree-major plus the SMEM
+    model-offset table: per tree row its model id, class slot, and the
+    model's missing-bin sentinel.  One launch replays the whole fleet
+    over a mixed tile; per-row masking keeps foreign trees inert."""
+
+    feat: jnp.ndarray    # (TTtot, S) int32
+    thr: jnp.ndarray     # (TTtot, S) int32
+    sleaf: jnp.ndarray   # (TTtot, S) int32 (-1 = inactive step)
+    dleft: jnp.ndarray   # (TTtot, S) int32
+    weight: jnp.ndarray  # (TTtot, 1) float32
+    tmid: jnp.ndarray    # (TTtot, 1) int32 — tree row -> model id
+    tcls: jnp.ndarray    # (TTtot, 1) int32 — tree row -> class slot
+    tnb: jnp.ndarray     # (TTtot, 1) int32 — tree row -> model num_bins
+    leafv: jnp.ndarray   # (TTtot, Lp) float32
+    num_models: int
+    total_trees: int     # TTtot = sum of T_m * K_m
+    num_class: int       # Kmax
+    num_steps: int       # Smax
+    num_leaves: int      # Lp
+    nbytes: int
+
+
+def multi_pallas_supported(parts) -> bool:
+    """``parts`` = per-model (T, K, S, has_cats) tuples; the concatenated
+    tables must fit the same SMEM budget as the standalone kernel."""
+    if any(p[3] for p in parts):
+        return False
+    s_max = max((p[2] for p in parts), default=0)
+    tt_tot = sum(p[0] * p[1] for p in parts)
+    return tt_tot * s_max <= SMEM_ENTRY_BUDGET
+
+
+def build_multi_pallas_forest(models) -> MultiPallasForest:
+    """``models`` = list of (host_trees, tree_weights, T, num_bins) per
+    model, concatenated model-major / tree-major / class-minor so each
+    model's per-class add order matches its standalone scan exactly."""
+    per = []
+    for host_trees, tree_weights, T, num_bins in models:
+        sl = np.asarray(host_trees.split_leaf)[:T]      # (T, K, S)
+        _, K, S = sl.shape
+        lv = np.asarray(host_trees.leaf_value)[:T]      # (T, K, L)
+        w = np.repeat(np.asarray(tree_weights[:T], np.float32), K)[:, None]
+        per.append(dict(
+            feat=np.asarray(host_trees.split_feat)[:T].reshape(T * K, S),
+            thr=np.asarray(host_trees.split_bin)[:T].reshape(T * K, S),
+            sleaf=sl.reshape(T * K, S),
+            dleft=np.asarray(host_trees.default_left)[:T].reshape(T * K, S),
+            weight=w, leafv=lv.reshape(T * K, lv.shape[-1]),
+            K=K, S=S, num_bins=num_bins,
+        ))
+    S = max(p["S"] for p in per)
+    L = max(p["leafv"].shape[1] for p in per)
+    Lp = _round_up(max(L, 1), 128)
+    Kmax = max(p["K"] for p in per)
+    tt_tot = sum(p["feat"].shape[0] for p in per)
+
+    def pad_steps(a, fill):
+        out = np.full((a.shape[0], S), fill, np.int32)
+        out[:, : a.shape[1]] = a
+        return out
+
+    feat = np.concatenate([pad_steps(p["feat"], 0) for p in per])
+    thr = np.concatenate([pad_steps(p["thr"], 0) for p in per])
+    sleaf = np.concatenate([pad_steps(p["sleaf"], -1) for p in per])
+    dleft = np.concatenate([pad_steps(p["dleft"], 0) for p in per])
+    weight = np.concatenate([p["weight"] for p in per]).astype(np.float32)
+    leafv = np.zeros((tt_tot, Lp), np.float32)
+    row = 0
+    tmid = np.zeros((tt_tot, 1), np.int32)
+    tcls = np.zeros((tt_tot, 1), np.int32)
+    tnb = np.zeros((tt_tot, 1), np.int32)
+    for m, p in enumerate(per):
+        tt_m = p["feat"].shape[0]
+        leafv[row: row + tt_m, : p["leafv"].shape[1]] = p["leafv"]
+        tmid[row: row + tt_m, 0] = m
+        tcls[row: row + tt_m, 0] = np.arange(tt_m, dtype=np.int32) % p["K"]
+        tnb[row: row + tt_m, 0] = p["num_bins"]
+        row += tt_m
+    arrays = dict(feat=feat, thr=thr, sleaf=sleaf, dleft=dleft,
+                  weight=weight, tmid=tmid, tcls=tcls, tnb=tnb, leafv=leafv)
+    nbytes = sum(a.nbytes for a in arrays.values())
+    return MultiPallasForest(
+        **{k: jnp.asarray(v) for k, v in arrays.items()},
+        num_models=len(per), total_trees=tt_tot, num_class=Kmax,
+        num_steps=S, num_leaves=Lp, nbytes=nbytes,
+    )
+
+
+def _multi_predict_kernel(bins_ref, mid_ref, leafv_ref, feat_ref, thr_ref,
+                          sleaf_ref, dleft_ref, w_ref, tmid_ref, tcls_ref,
+                          tnb_ref, out_ref, *, TT: int, K: int, S: int,
+                          L: int):
+    """One mixed row tile: replay ALL models' trees; a tree's contribution
+    lands only on rows whose model-id matches its SMEM offset entry."""
+    bm = bins_ref.shape[1]
+    iota_k = lax.broadcasted_iota(jnp.int32, (K, bm), 0)
+    iota_l = lax.broadcasted_iota(jnp.int32, (L, bm), 0)
+    mids = mid_ref[pl.ds(0, 1), :]                   # (1, bm) int32
+
+    def tree_body(idx, acc):
+        nb = tnb_ref[idx, 0]
+
+        def step_body(s, leaf):
+            f = feat_ref[idx, s]
+            sleaf = sleaf_ref[idx, s]
+            thr = thr_ref[idx, s]
+            dl = dleft_ref[idx, s]
+            fcol = bins_ref[pl.ds(f, 1), :]          # (1, bm) int32
+            miss = fcol == nb - 1
+            go_left = jnp.where(miss, dl == 1, fcol <= thr)
+            move = (leaf == sleaf) & (~go_left)
+            return jnp.where(move, s + 1, leaf)
+
+        leaf = lax.fori_loop(0, S, step_body, jnp.zeros((1, bm), jnp.int32))
+        one_hot = (iota_l == leaf).astype(jnp.float32)
+        lv = leafv_ref[pl.ds(idx, 1), :]
+        val = lax.dot_general(
+            lv, one_hot,
+            dimension_numbers=(((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+            precision=lax.Precision.HIGHEST,
+        )
+        contrib = w_ref[idx, 0] * val
+        sel = (iota_k == tcls_ref[idx, 0]) & (mids == tmid_ref[idx, 0])
+        return jnp.where(sel, acc + contrib, acc)
+
+    out_ref[...] = lax.fori_loop(
+        0, TT, tree_body, jnp.zeros((K, bm), jnp.float32)
+    )
+
+
+@functools.partial(jax.jit, static_argnames=(
+    "TT", "K", "S", "L", "bm", "interpret"))
+def _multi_pallas_predict(bins_t, mid_row, leafv, feat, thr, sleaf, dleft,
+                          weight, tmid, tcls, tnb, *, TT: int, K: int,
+                          S: int, L: int, bm: int, interpret: bool):
+    F, n = bins_t.shape
+    kernel = functools.partial(
+        _multi_predict_kernel, TT=TT, K=K, S=S, L=L
+    )
+    smem = pl.BlockSpec(memory_space=pltpu.SMEM)
+    return pl.pallas_call(
+        kernel,
+        grid=(n // bm,),
+        in_specs=[
+            pl.BlockSpec((F, bm), lambda i: (0, i)),   # bins tile (VMEM)
+            pl.BlockSpec((1, bm), lambda i: (0, i)),   # row model ids
+            pl.BlockSpec(memory_space=pltpu.VMEM),     # leaf values
+            smem, smem, smem, smem, smem, smem, smem, smem,
+        ],
+        out_specs=pl.BlockSpec((K, bm), lambda i: (0, i)),
+        out_shape=jax.ShapeDtypeStruct((K, n), jnp.float32),
+        interpret=interpret,
+    )(bins_t, mid_row, leafv, feat, thr, sleaf, dleft, weight, tmid, tcls,
+      tnb)
+
+
+def multi_pallas_raw_scores(mpf: MultiPallasForest, bins, mid,
+                            bm: int = 2048,
+                            interpret: bool = False) -> jnp.ndarray:
+    """(n, F) mixed binned matrix + (n,) model ids → (Kmax, n) raw
+    scores; per model bitwise-equal to its standalone kernel output."""
+    backend = jax.default_backend()
+    if backend not in ("cpu", "tpu"):
+        raise NotImplementedError(
+            f"multi-model pallas predict supports tpu (compiled) and cpu "
+            f"(interpret) backends, not {backend!r}; use 'packed'"
+        )
+    n, F = bins.shape
+    bins_t = bins.astype(jnp.int32).T
+    mid_row = mid.astype(jnp.int32)[None, :]         # (1, n)
+    bm = min(bm, _round_up(max(n, 1), 128))
+    pad_r = (-n) % bm
+    pad_f = (-F) % 8
+    if pad_r or pad_f:
+        bins_t = jnp.pad(bins_t, ((0, pad_f), (0, pad_r)))
+    if pad_r:
+        # pad rows carry model id -1: no tree matches, they stay zero
+        mid_row = jnp.pad(mid_row, ((0, 0), (0, pad_r)),
+                          constant_values=-1)
+    out = _multi_pallas_predict(
+        bins_t, mid_row, mpf.leafv, mpf.feat, mpf.thr, mpf.sleaf,
+        mpf.dleft, mpf.weight, mpf.tmid, mpf.tcls, mpf.tnb,
+        TT=mpf.total_trees, K=mpf.num_class, S=mpf.num_steps,
+        L=mpf.num_leaves, bm=bm, interpret=interpret or backend == "cpu",
+    )
+    return out[:, :n]

@@ -6,7 +6,11 @@ transport: deadline-aware dynamic micro-batching with bucket padding
 atomic hot-swap and rollback (:mod:`~mmlspark_tpu.serve.registry`),
 admission control with load shedding and graceful drain
 (:mod:`~mmlspark_tpu.serve.admission`), all composed by
-:class:`~mmlspark_tpu.serve.app.ServingApp`.
+:class:`~mmlspark_tpu.serve.app.ServingApp`.  Fleet mode adds
+multi-tenant co-residency — N models as one device super-table served
+by one dispatch (:mod:`~mmlspark_tpu.serve.coresident`) — and a
+replica-routing front process (:mod:`~mmlspark_tpu.serve.router`) over
+``serve/replica.py`` worker processes.
 
 See ``mmlspark_tpu/serve/README.md`` for architecture, env knobs, and the
 hot-swap protocol; ``tools/bench_serving.py`` for the load generator.
@@ -19,15 +23,24 @@ from mmlspark_tpu.serve.batcher import (
     BatchItem,
     DynamicBatcher,
 )
+from mmlspark_tpu.serve.coresident import (
+    CoResidentGroup,
+    quantization_auc_drift,
+)
 from mmlspark_tpu.serve.registry import ModelRegistry, ModelVersion
+from mmlspark_tpu.serve.router import FleetRouter, ReplicaHandle
 
 __all__ = [
     "AdmissionController",
     "BatchItem",
+    "CoResidentGroup",
     "DEFAULT_BUCKETS",
     "DynamicBatcher",
+    "FleetRouter",
     "ModelRegistry",
     "ModelVersion",
+    "ReplicaHandle",
     "ServingApp",
     "default_predictor",
+    "quantization_auc_drift",
 ]

@@ -100,15 +100,25 @@ class AdmissionController:
 
     # -- routes ----------------------------------------------------------
     def register_route(
-        self, route: str, max_inflight: Optional[int] = None
+        self,
+        route: str,
+        max_inflight: Optional[int] = None,
+        queue_: Optional["queue.Queue"] = None,
     ) -> "queue.Queue":
-        """Create (or return) the route's bounded queue."""
+        """Create (or return) the route's bounded queue.
+
+        ``queue_`` lets co-resident routes SHARE one bounded queue (the
+        grouped super-table worker drains a single queue for all its
+        tenants) while keeping per-route inflight caps and verdicts.
+        """
         with self._lock:
             st = self._routes.get(route)
             if st is None:
                 st = self._routes[route] = _RouteState(
                     self._depth, int(max_inflight or self._max_inflight)
                 )
+                if queue_ is not None:
+                    st.queue = queue_
             return st.queue
 
     def queue_for(self, route: str) -> Optional["queue.Queue"]:
@@ -151,6 +161,35 @@ class AdmissionController:
         if verdict == "accept":
             return None
         if verdict in ("shed_inflight", "shed_queue"):
+            return _verdict_response(
+                429, "overloaded, retry later", self._retry_after_s
+            )
+        if verdict == "draining":
+            return _verdict_response(503, "draining", self._retry_after_s)
+        return _verdict_response(503, "not ready", self._retry_after_s)
+
+    def admit_inline(self, route: str) -> Optional[HTTPResponseData]:
+        """Queueless verdict for proxying frontends (the fleet router):
+        same lifecycle/concurrency gates as :meth:`admit`, but the caller
+        holds the request on its own thread instead of a queue.  None =
+        admitted (inflight incremented — caller MUST :meth:`complete`)."""
+        with self._lock:
+            st = self._routes.get(route)
+            if st is None or not self._ready:
+                verdict = "not_ready"
+            elif self._draining:
+                verdict = "draining"
+            elif st.inflight >= st.max_inflight:
+                verdict = "shed_inflight"
+            else:
+                verdict = "accept"
+                st.inflight += 1
+                self._idle.clear()
+        flight.record("admit", verdict, {"route": route, "inline": True})
+        obs.inc("serve.admission", verdict=verdict, route=route)
+        if verdict == "accept":
+            return None
+        if verdict == "shed_inflight":
             return _verdict_response(
                 429, "overloaded, retry later", self._retry_after_s
             )

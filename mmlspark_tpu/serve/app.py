@@ -30,6 +30,7 @@ import re
 import threading
 import time
 import uuid
+from contextlib import ExitStack
 from typing import Callable, Dict, Optional, Sequence
 
 import numpy as np
@@ -43,6 +44,7 @@ from mmlspark_tpu.io.http.serving import HTTPServer
 from mmlspark_tpu.obs.quality import SLOConfig
 from mmlspark_tpu.serve.admission import AdmissionController
 from mmlspark_tpu.serve.batcher import DEFAULT_BUCKETS, BatchItem, DynamicBatcher
+from mmlspark_tpu.serve.coresident import CoResidentGroup
 from mmlspark_tpu.serve.monitor import ModelQualityMonitor, find_booster
 from mmlspark_tpu.serve.registry import ModelRegistry, ModelVersion
 
@@ -100,12 +102,28 @@ def default_predictor(model):
 
 class _Route:
     def __init__(self, name: str, batcher: DynamicBatcher, q,
-                 predict: Callable, feature_dim: Optional[int]):
+                 predict: Optional[Callable], feature_dim: Optional[int]):
         self.name = name
         self.batcher = batcher
         self.queue = q
         self.predict = predict
         self.feature_dim = feature_dim
+        self.prewarmed = False
+        self.thread: Optional[threading.Thread] = None
+        self.group: Optional["_Group"] = None  # set for co-resident tenants
+
+
+class _Group:
+    """One co-resident tenant set: a shared bounded queue + shared batcher
+    drained by ONE worker thread into ONE super-table dispatch."""
+
+    def __init__(self, name: str, group: CoResidentGroup,
+                 batcher: DynamicBatcher, q, route_names):
+        self.name = name
+        self.group = group
+        self.batcher = batcher
+        self.queue = q
+        self.route_names = tuple(route_names)
         self.prewarmed = False
         self.thread: Optional[threading.Thread] = None
 
@@ -158,6 +176,7 @@ class ServingApp:
         )
         self._prewarm = prewarm
         self._routes: Dict[str, _Route] = {}
+        self._groups: Dict[str, _Group] = {}
         self._stop = threading.Event()
         self._started = False
         self._jit_counters_at_ready: Dict[str, float] = {}
@@ -231,11 +250,106 @@ class ServingApp:
             self._jit_counters_at_ready = cache_counters()
         return mv
 
+    def add_model_group(
+        self,
+        models: Sequence,
+        group: str = "group",
+        leaf_dtype: str = "f32",
+    ) -> Dict[str, ModelVersion]:
+        """Register N tenants as ONE co-resident route set.
+
+        ``models`` is ``[(name, path_or_model), ...]``.  Every tenant must
+        carry a booster (the super-table is a packed-forest concatenation).
+        All tenants share one bounded queue, one batcher, and one worker —
+        a mixed batch spanning several tenants costs a single super-table
+        dispatch (see serve/coresident.py).  Each tenant keeps its OWN
+        registry entry, admission inflight cap, quality-monitor route, and
+        ``/models/<name>/predict`` path, so clients cannot tell a grouped
+        tenant from a standalone one.
+        """
+        if group in self._groups:
+            raise ValueError(f"group {group!r} already exists")
+        pairs = []
+        mvs: Dict[str, ModelVersion] = {}
+        for name, spec in models:
+            if name in self._routes:
+                raise ValueError(
+                    f"route {name!r} already exists; use swap_model"
+                )
+            mv = (
+                self.registry.register(name, path=spec)
+                if isinstance(spec, str)
+                else self.registry.register(name, model=spec)
+            )
+            booster = _find_booster(mv.model)
+            if booster is None:
+                raise ValueError(
+                    f"co-resident tenant {name!r} carries no booster"
+                )
+            mvs[name] = mv
+            pairs.append((name, booster))
+        cg = CoResidentGroup(pairs, leaf_dtype=leaf_dtype)
+        batcher = DynamicBatcher(**self._batcher_cfg)
+        shared_q = self.admission.register_route(pairs[0][0])
+        g = _Group(group, cg, batcher, shared_q, [n for n, _ in pairs])
+        for name, booster in pairs:
+            self.admission.register_route(name, queue_=shared_q)
+            route = _Route(
+                name, batcher, shared_q, None, int(booster.num_features)
+            )
+            route.group = g
+            self._routes[name] = route
+            if self.monitor is not None:
+                mv = mvs[name]
+                self.monitor.register_route(
+                    name, mv.version, mv.quality_baseline
+                )
+        self._groups[group] = g
+        g.thread = threading.Thread(
+            target=self._group_worker, args=(g,), daemon=True,
+            name=f"serve-group-{group}",
+        )
+        g.thread.start()
+        if self._started:
+            self._prewarm_group(g)
+            self._jit_counters_at_ready = cache_counters()
+        return mvs
+
     def swap_model(self, name: str, path: Optional[str] = None, model=None,
                    block: bool = True):
         """Zero-downtime replacement of a route's model (load → warm →
-        flip → drain old); see :meth:`ModelRegistry.swap`."""
+        flip → drain old); see :meth:`ModelRegistry.swap`.
+
+        Grouped tenants compose with the same flow: ``warm`` stages the
+        rebuilt super-table slice + pre-warmed executables off-path, and
+        ``on_flip`` commits the staged snapshot atomically with the
+        registry flip — only the swapped tenant's segment is re-packed.
+        """
         route = self._routes[name]
+        g = route.group
+
+        if g is not None:
+            def warm(mv: ModelVersion) -> None:
+                booster = _find_booster(mv.model)
+                if booster is None:
+                    raise ValueError(
+                        f"swap for grouped tenant {name!r} has no booster"
+                    )
+                g.group.prepare_swap(
+                    name, booster,
+                    buckets=self.buckets if self._prewarm else (),
+                )
+
+            def on_flip(mv: ModelVersion) -> None:
+                g.group.commit_swap(name)
+                route.feature_dim = g.group.tenant_feature_dim(name)
+                if self.monitor is not None:
+                    self.monitor.register_route(
+                        name, mv.version, mv.quality_baseline
+                    )
+
+            return self.registry.swap(name, path=path, model=model,
+                                      warm=warm, block=block, on_flip=on_flip)
 
         def warm(mv: ModelVersion) -> None:
             if self._prewarm and route.feature_dim is not None:
@@ -256,6 +370,14 @@ class ServingApp:
 
     def rollback(self, name: str) -> ModelVersion:
         mv = self.registry.rollback(name)
+        route = self._routes.get(name)
+        if route is not None and route.group is not None:
+            booster = _find_booster(mv.model)
+            g = route.group
+            g.group.prepare_swap(
+                name, booster, buckets=self.buckets if self._prewarm else ()
+            )
+            g.group.commit_swap(name)
         if self.monitor is not None:
             # the restored version brings its own baseline back
             self.monitor.register_route(name, mv.version, mv.quality_baseline)
@@ -273,9 +395,13 @@ class ServingApp:
         self._server.start()
         self._started = True
         for name, route in self._routes.items():
+            if route.group is not None:
+                continue  # grouped tenants warm through their group below
             mv = self.registry.get(name)
             if mv is not None:
                 self._prewarm_route(route, mv)
+        for g in self._groups.values():
+            self._prewarm_group(g)
         self._jit_counters_at_ready = cache_counters()
         self.admission.set_ready(True)
         obs.inc("serve.starts")
@@ -289,6 +415,9 @@ class ServingApp:
         for route in self._routes.values():
             if route.thread is not None:
                 route.thread.join(timeout=5.0)
+        for g in self._groups.values():
+            if g.thread is not None:
+                g.thread.join(timeout=5.0)
         self._server.stop()
         self.admission.set_ready(False)
         if self.monitor is not None:
@@ -309,6 +438,13 @@ class ServingApp:
                 lambda X, n: route.predict(mv.model, X, n), route.feature_dim
             )
         route.prewarmed = True
+
+    def _prewarm_group(self, g: _Group) -> None:
+        if not self._prewarm or g.prewarmed:
+            return
+        with obs.span("serve.prewarm_route", model=g.name, group=True):
+            g.group.prewarm(self.buckets)
+        g.prewarmed = True
 
     # -- transport intake -------------------------------------------------
     def _intake(self, rid: str, req: HTTPRequestData, wait_s: float
@@ -332,6 +468,8 @@ class ServingApp:
             return _json_response(404, {"error": f"no such path: {path}"})
         if req.method != "POST":
             return _json_response(405, {"error": f"method {req.method}"})
+        if path == "/admin/swap":
+            return self._admin_swap(req)
         m = _PREDICT_RE.match(path)
         if not m:
             return _json_response(404, {"error": f"no such path: {path}"})
@@ -353,6 +491,7 @@ class ServingApp:
                 return err
             item.trace_id = req_id
             item.request_id = req_id
+            item.model = name  # shared (grouped) queues demux on this
             verdict = self.admission.admit(name, item)
         if verdict is not None:
             verdict.headers["X-Request-Id"] = req_id
@@ -391,6 +530,32 @@ class ServingApp:
             return _json_response(
                 200, {"status": "degraded", "error": repr(e), "routes": {}}
             )
+
+    def _admin_swap(self, req: HTTPRequestData) -> HTTPResponseData:
+        """``POST /admin/swap {"model": name, "path": dir}`` — the fleet
+        router's rolling-swap hook.  Synchronous (the response means the
+        flip + old-version drain completed), so a router swapping replicas
+        one at a time knows when it is safe to move on."""
+        try:
+            payload = json.loads((req.entity or b"").decode() or "{}")
+        except (ValueError, UnicodeDecodeError) as e:
+            return _json_response(400, {"error": f"bad JSON: {e}"})
+        name = payload.get("model")
+        path = payload.get("path")
+        if not name or not path:
+            return _json_response(
+                400, {"error": 'body needs "model" and "path"'}
+            )
+        if name not in self._routes:
+            return _json_response(404, {"error": f"no such model: {name}"})
+        try:
+            mv = self.swap_model(name, path=path, block=True)
+        except Exception as e:
+            obs.inc("serve.errors", model=name)
+            return _json_response(500, {"error": repr(e)})
+        return _json_response(
+            200, {"model": name, "version": getattr(mv, "version", None)}
+        )
 
     def _parse_predict(self, rid: str, req: HTTPRequestData, route: _Route,
                        wait_s: float):
@@ -536,3 +701,140 @@ class ServingApp:
                 )
         finally:
             self.admission.complete(route.name, len(items))
+
+    # -- the co-resident group batch loop ---------------------------------
+    def _group_worker(self, g: _Group) -> None:
+        while not self._stop.is_set():
+            items = g.batcher.collect(g.queue)
+            if not items:
+                continue
+            self._process_group(g, items)
+
+    def _process_group(self, g: _Group, items) -> None:
+        """One mixed batch across the group's tenants → ONE super-table
+        dispatch.  Mirrors :meth:`_process` (stage spans, per-item replies,
+        monitor submits) but demuxes on ``BatchItem.model``: rows are
+        right-padded to the fleet feature width, tagged with model ids,
+        and each tenant's finalized slice replies under ITS leased
+        version."""
+        t_closed = time.monotonic()
+        batch_id = "b-" + uuid.uuid4().hex[:12]
+        members = [it.request_id or it.rid for it in items]
+        for it in items:
+            dq = it.dequeued or t_closed
+            tid = it.trace_id or it.rid
+            obs.record_span(
+                "serve.queue_wait", max(0.0, dq - it.enqueued),
+                rid=it.request_id or it.rid, trace_id=tid,
+            )
+            obs.record_span(
+                "serve.batch_close_wait", max(0.0, t_closed - dq),
+                rid=it.request_id or it.rid, trace_id=tid, batch=batch_id,
+            )
+        F = g.group.feature_dim
+        n = sum(it.n_rows for it in items)
+        X = np.zeros((n, F), np.float64)
+        mids = np.zeros(n, np.int32)
+        off = 0
+        for it in items:
+            k = it.n_rows
+            X[off:off + k, : it.rows.shape[1]] = it.rows
+            mids[off:off + k] = g.group.model_id(it.model)
+            off += k
+        padded, n = g.batcher.pad(X)
+        bucket = int(padded.shape[0])
+        mids_padded = np.zeros(bucket, np.int32)
+        mids_padded[:n] = mids
+        names = sorted({it.model for it in items})
+        try:
+            versions: Dict[str, int] = {}
+            with ExitStack() as stack:
+                leases = {
+                    nm: stack.enter_context(self.registry.lease(nm))
+                    for nm in names
+                }
+                versions = {nm: mv.version for nm, mv in leases.items()}
+                with obs.bind_trace(trace_id=batch_id):
+                    with obs.span(
+                        "serve.batch", model=g.name, bucket=bucket,
+                        rows=n, batch=batch_id, members=members,
+                        models=names,
+                    ):
+                        # predict_mixed returns host f32 rows already —
+                        # responses serialize per-item chunks from it
+                        preds = g.group.predict_mixed(padded, mids_padded)
+            off = 0
+            per_tenant: Dict[str, list] = {nm: [] for nm in names}
+            for it in items:
+                k = it.n_rows
+                K = g.group.tenant_num_class(it.model)
+                chunk = preds[off:off + k, :K]
+                if K == 1:
+                    chunk = chunk[:, 0]
+                body = (
+                    {"prediction": chunk[0].tolist()
+                     if chunk.ndim > 1 else float(chunk[0])}
+                    if it.single
+                    else {"predictions": chunk.tolist()}
+                )
+                headers = {
+                    "X-Model-Version": str(versions[it.model]),
+                    "X-Request-Id": it.request_id or it.rid,
+                }
+                tid = it.trace_id or it.rid
+                t_reply = time.monotonic()
+                self._server.reply(it.rid, _json_response(200, body, headers))
+                now = time.monotonic()
+                per_tenant[it.model].append(
+                    (off, k, now - it.enqueued)
+                )
+                off += k
+                obs.record_span(
+                    "serve.reply", now - t_reply,
+                    rid=it.request_id or it.rid, trace_id=tid,
+                )
+                obs.record_span(
+                    "serve.request", now - it.enqueued,
+                    rid=it.request_id or it.rid, trace_id=tid,
+                    batch=batch_id, bucket=bucket,
+                )
+            if self.monitor is not None:
+                for nm, chunks in per_tenant.items():
+                    rows_idx = np.concatenate(
+                        [np.arange(o, o + k) for o, k, _ in chunks]
+                    )
+                    self.monitor.submit(
+                        nm, versions[nm],
+                        rows=X[rows_idx], preds=preds[rows_idx],
+                        statuses=[200] * len(chunks),
+                        latencies=[lat for _, _, lat in chunks],
+                    )
+        except Exception as e:
+            obs.inc("serve.errors", model=g.name)
+            obs.get_logger("mmlspark_tpu.serve").exception(
+                "batch failed on group %s", g.name
+            )
+            now = time.monotonic()
+            for it in items:
+                err = _json_response(
+                    500, {"error": repr(e)},
+                    {"X-Request-Id": it.request_id or it.rid},
+                )
+                self._server.reply(it.rid, err)
+            if self.monitor is not None:
+                for nm in names:
+                    mv_now = self.registry.get(nm)
+                    lats = [now - it.enqueued for it in items
+                            if it.model == nm]
+                    self.monitor.submit(
+                        nm,
+                        mv_now.version if mv_now is not None else -1,
+                        statuses=[500] * len(lats),
+                        latencies=lats,
+                    )
+        finally:
+            counts: Dict[str, int] = {}
+            for it in items:
+                counts[it.model] = counts.get(it.model, 0) + 1
+            for nm, c in counts.items():
+                self.admission.complete(nm, c)

@@ -53,6 +53,7 @@ class BatchItem:
     rows: np.ndarray  # (k, F) float64
     deadline: float  # time.monotonic() based
     single: bool = False  # request carried one row (reply shape differs)
+    model: Optional[str] = None  # route name, set on shared (grouped) queues
     enqueued: float = field(default_factory=time.monotonic)
     trace_id: Optional[str] = None
     request_id: Optional[str] = None

@@ -1,0 +1,334 @@
+"""serve.coresident — N tenants resident as ONE device super-table.
+
+A :class:`CoResidentGroup` owns the fleet-side state for a set of
+co-resident tenant models: their host :class:`PackedSegment` snapshots,
+the concatenated :class:`MultiPackedForest` super-table, the stacked
+:class:`MultiDeviceBinner`, and the per-bucket AOT executables of the
+fused bin+traverse program.  A mixed batch (rows + model-id column)
+costs ONE dispatch regardless of how many tenants it spans — that is
+the whole point: M small per-tenant batches at bucket size B pay M
+dispatches and M paddings, the group pays one.
+
+Parity contract: with ``leaf_dtype="f32"`` every tenant's finalized
+scores are **bitwise-identical** to its standalone
+``booster.predict_padded`` output.  Raw scores replay the standalone
+serial f32 tree fold (engine/forest.py), and the per-tenant finalize
+(average division + objective link) is applied to the tenant's raw
+slice zero-padded to the FIXED bucket width, so the jitted finalize
+programs are the very same cached programs the standalone path runs —
+elementwise / per-column ops make the pad columns inert.
+
+Hot swap: :meth:`prepare_swap` rebuilds only the swapped tenant's
+segment (the others are concatenated from cached host copies), stages
+the new super-table + binner + pre-warmed executables OFF the serving
+path, and :meth:`commit_swap` flips the whole snapshot atomically.
+In-flight batches hold references to the old arrays and finish on them.
+
+``leaf_dtype="f16"|"int8"`` shrinks the leaf table (accumulation stays
+f32 — the int8 dequant scale is folded into the weight table).  That
+trades the bitwise guarantee for memory, so it is gated on a MEASURED
+ranking drift: :func:`quantization_auc_drift` scores a holdout through
+both leaf tables and returns the AUC delta for the caller to compare
+against its budget before enabling the narrow dtype.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from mmlspark_tpu import obs
+from mmlspark_tpu.core import jit_cache
+from mmlspark_tpu.engine import forest as _forest
+from mmlspark_tpu.ops.device_binning import MultiDeviceBinner
+
+
+class _GroupSnapshot:
+    """One immutable generation of the group's device state.  predict
+    threads grab the current snapshot under the lock and then run
+    lock-free; a swap publishes a NEW snapshot and never mutates an old
+    one, so in-flight batches finish on the arrays they started with."""
+
+    __slots__ = ("mpf", "binner", "execs", "finalizers", "boosters")
+
+    def __init__(self, mpf, binner, boosters):
+        self.mpf = mpf
+        self.binner = binner
+        self.boosters = dict(boosters)  # name -> booster
+        self.execs: Dict[int, object] = {}  # bucket rows -> AOT executable
+        self.finalizers: Dict[Tuple[str, bool], object] = {}
+
+
+def _segment_of(booster):
+    T = int(booster.num_iterations)
+    return _forest.segment_from_packed(booster._packed_forest(T))
+
+
+class CoResidentGroup:
+    """Co-resident multi-tenant predictor over one super-table."""
+
+    def __init__(
+        self,
+        models: Sequence[Tuple[str, object]],  # [(name, booster), ...]
+        leaf_dtype: str = "f32",
+    ):
+        if not models:
+            raise ValueError("CoResidentGroup needs at least one model")
+        self.leaf_dtype = leaf_dtype
+        self._lock = threading.RLock()
+        self._staged: Optional[Tuple[str, _GroupSnapshot]] = None
+        boosters = {name: b for name, b in models}
+        self._snap = self._build_snapshot(boosters, order=[n for n, _ in models])
+
+    # -- construction ----------------------------------------------------
+    def _build_snapshot(self, boosters, order) -> _GroupSnapshot:
+        with obs.span("serve.group_build", models=len(order),
+                      leaf_dtype=self.leaf_dtype):
+            segs = [(name, _segment_of(boosters[name])) for name in order]
+            mpf = _forest.build_multi_forest(segs, leaf_dtype=self.leaf_dtype)
+            binner = MultiDeviceBinner.from_mappers(
+                [boosters[name].bin_mapper for name in order]
+            )
+        return _GroupSnapshot(mpf, binner, boosters)
+
+    # -- introspection ---------------------------------------------------
+    @property
+    def names(self) -> Tuple[str, ...]:
+        with self._lock:
+            return self._snap.mpf.names
+
+    @property
+    def feature_dim(self) -> int:
+        """Fleet-wide max feature count; narrower tenants' rows are
+        zero-padded on the right (pad features never reach a pad model's
+        nodes — binning tables are +inf there)."""
+        with self._lock:
+            return int(self._snap.binner.num_features)
+
+    def tenant_feature_dim(self, name: str) -> int:
+        with self._lock:
+            return int(self._snap.boosters[name].num_features)
+
+    def tenant_num_class(self, name: str) -> int:
+        with self._lock:
+            return int(self._snap.boosters[name].num_class)
+
+    def model_id(self, name: str) -> int:
+        with self._lock:
+            return self._snap.mpf.model_id(name)
+
+    def describe(self) -> dict:
+        with self._lock:
+            mpf, binner = self._snap.mpf, self._snap.binner
+            return {
+                "models": list(mpf.names),
+                "leaf_dtype": mpf.leaf_dtype,
+                "supertable_bytes": int(mpf.nbytes),
+                "binner_bytes": int(binner.nbytes),
+                "max_tt": int(mpf.max_tt),
+                "max_class": int(mpf.max_class),
+                "max_depth": int(mpf.max_depth),
+                "feature_dim": int(binner.num_features),
+            }
+
+    # -- the one dispatch ------------------------------------------------
+    def _exec_for(self, snap: _GroupSnapshot, rows_j, mid_j):
+        B = int(rows_j.shape[0])
+        exe = snap.execs.get(B)
+        if exe is None:
+            exe, how = jit_cache.load_or_compile_aot(
+                "multi_packed_raw_rows",
+                _forest.multi_packed_raw_rows_meta(snap.mpf, snap.binner),
+                (snap.mpf.arrays, snap.binner.arrays, rows_j, mid_j),
+                lambda: _forest.lower_multi_packed_raw_rows(
+                    snap.mpf, snap.binner, rows_j, mid_j
+                ),
+            )
+            snap.execs[B] = exe
+            if obs.enabled():
+                obs.inc("serve.group_exec_builds", how=how or "process")
+        return exe
+
+    def _finalize_for(self, snap: _GroupSnapshot, name: str, raw_score: bool):
+        key = (name, bool(raw_score))
+        fn = snap.finalizers.get(key)
+        if fn is None:
+            b = snap.boosters[name]
+            fn = b._finalize_fn(int(b.num_iterations), raw_score)
+            snap.finalizers[key] = fn
+        return fn
+
+    def predict_mixed(
+        self,
+        X: np.ndarray,
+        mids: np.ndarray,
+        raw_score: bool = False,
+    ) -> np.ndarray:
+        """Mixed padded batch → finalized scores, one device dispatch.
+
+        ``X`` is (B, Fmax) with B a pre-warmed bucket shape; ``mids`` is
+        (B,) int model ids (pad rows may carry any valid id — their
+        outputs are discarded by the caller).  Returns (B, Kmax) f32
+        where row r holds tenant ``mids[r]``'s scores in columns
+        ``:K_m`` (single-output tenants use column 0).
+        """
+        import jax.numpy as jnp
+
+        with self._lock:
+            snap = self._snap
+        rows_j = jnp.asarray(  # API entry: rows arrive host-side (f32 wire)
+            np.ascontiguousarray(X, dtype=np.float32)  # analyze: ignore[PRED001]
+        )
+        mid_np = np.ascontiguousarray(mids, dtype=np.int32)  # analyze: ignore[PRED001]
+        mid_j = jnp.asarray(mid_np)
+        B = int(rows_j.shape[0])
+        with obs.span("predict.multi", rows=B,
+                      models=int(snap.mpf.num_models), **obs.trace_attrs()):
+            exe = self._exec_for(snap, rows_j, mid_j)
+            raw = exe(snap.mpf.arrays, snap.binner.arrays, rows_j, mid_j)
+            raw_np = np.asarray(raw)  # analyze: ignore[PRED001] - API exit (Kmax, B)
+            out = np.zeros((B, int(snap.mpf.max_class)), np.float32)
+            for m in np.unique(mid_np):
+                name = snap.mpf.names[int(m)]
+                K = int(snap.boosters[name].num_class)
+                cols = np.nonzero(mid_np == m)[0]
+                # Zero-pad the tenant slice back to the FIXED bucket
+                # width so the finalize program is the standalone
+                # booster's cached (K, B) compile — elementwise /
+                # per-column ops keep the real columns bitwise-equal.
+                buf = np.zeros((K, B), np.float32)
+                buf[:, : cols.size] = raw_np[:K, cols]
+                fin = np.asarray(  # analyze: ignore[PRED001] - API exit
+                    self._finalize_for(snap, name, raw_score)(buf))
+                out[cols, :K] = fin[:, : cols.size].T
+        return out
+
+    def prewarm(self, buckets: Sequence[int]) -> None:
+        """Compile (or disk-load) every bucket shape + every tenant's
+        finalize program before traffic arrives."""
+        with self._lock:
+            snap = self._snap
+        self._prewarm_snapshot(snap, buckets)
+
+    def _prewarm_snapshot(self, snap: _GroupSnapshot, buckets) -> None:
+        F = int(snap.binner.num_features)
+        for b in buckets:
+            with obs.span("serve.prewarm", bucket=int(b), group=True):
+                X = np.zeros((int(b), F), np.float32)
+                mids = np.zeros(int(b), np.int32)
+                self._predict_on(snap, X, mids)
+            obs.inc("serve.prewarm.buckets")
+
+    def _predict_on(self, snap: _GroupSnapshot, X, mids) -> None:
+        import jax.numpy as jnp
+
+        rows_j = jnp.asarray(
+            np.ascontiguousarray(X, np.float32))  # analyze: ignore[PRED001]
+        mid_j = jnp.asarray(
+            np.ascontiguousarray(mids, np.int32))  # analyze: ignore[PRED001]
+        exe = self._exec_for(snap, rows_j, mid_j)
+        raw = np.asarray(  # analyze: ignore[PRED001] - prewarm-only path
+            exe(snap.mpf.arrays, snap.binner.arrays, rows_j, mid_j))
+        B = int(rows_j.shape[0])
+        for name in snap.mpf.names:
+            K = int(snap.boosters[name].num_class)
+            buf = np.zeros((K, B), np.float32)
+            buf[:K, :] = raw[:K, :]
+            self._finalize_for(snap, name, False)(buf)
+
+    # -- tenant hot swap -------------------------------------------------
+    def prepare_swap(
+        self, name: str, booster, buckets: Sequence[int] = ()
+    ) -> None:
+        """Stage a replacement for ONE tenant: rebuild its segment, splice
+        it into a new super-table (other tenants' cached host segments are
+        reused — no re-pack), restack the binner, and pre-warm the staged
+        executables.  All of it happens OFF the serving path; the live
+        snapshot keeps serving until :meth:`commit_swap`."""
+        with self._lock:
+            cur = self._snap
+            if name not in cur.mpf.names:
+                raise KeyError(f"unknown tenant {name!r}")
+            order = list(cur.mpf.names)
+            boosters = dict(cur.boosters)
+        boosters[name] = booster
+        with obs.span("serve.group_swap_stage", model=name):
+            seg = _segment_of(booster)
+            mpf = _forest.swap_multi_segment(cur.mpf, name, seg)
+            binner = MultiDeviceBinner.from_mappers(
+                [boosters[n].bin_mapper for n in order]
+            )
+            staged = _GroupSnapshot(mpf, binner, boosters)
+            if buckets:
+                self._prewarm_snapshot(staged, buckets)
+        with self._lock:
+            self._staged = (name, staged)
+
+    def commit_swap(self, name: str) -> None:
+        """Atomically flip the staged snapshot in.  In-flight batches
+        keep their old snapshot references and drain on them."""
+        with self._lock:
+            if self._staged is None or self._staged[0] != name:
+                raise RuntimeError(f"no staged swap for tenant {name!r}")
+            self._snap = self._staged[1]
+            self._staged = None
+        obs.inc("serve.group_swaps", model=name)
+
+    def abort_swap(self, name: str) -> None:
+        with self._lock:
+            if self._staged is not None and self._staged[0] == name:
+                self._staged = None
+
+
+# ---------------------------------------------------------------------------
+# Quantized-leaf gating: measured ranking drift, not vibes
+# ---------------------------------------------------------------------------
+def _auc(scores: np.ndarray, labels: np.ndarray) -> float:
+    """Rank-based AUC (Mann-Whitney), ties averaged — dependency-free."""
+    scores = np.asarray(scores, np.float64).ravel()
+    labels = np.asarray(labels).ravel().astype(bool)
+    n_pos = int(labels.sum())
+    n_neg = labels.size - n_pos
+    if n_pos == 0 or n_neg == 0:
+        return 0.5
+    s = np.sort(scores)
+    first = np.searchsorted(s, scores, side="left") + 1
+    last = np.searchsorted(s, scores, side="right")
+    ranks = (first + last) / 2.0  # average rank over ties
+    return float(
+        (ranks[labels].sum() - n_pos * (n_pos + 1) / 2.0) / (n_pos * n_neg)
+    )
+
+
+def quantization_auc_drift(
+    booster, X: np.ndarray, y: np.ndarray, leaf_dtype: str
+) -> dict:
+    """Score a holdout through f32 and ``leaf_dtype`` leaf tables of the
+    SAME forest and report the AUC delta.  Callers gate narrow-leaf
+    deployment on ``drift <= budget`` — the gate is a measurement, not an
+    assumption about quantization being harmless."""
+    import jax.numpy as jnp
+
+    name = "m"
+    seg = _segment_of(booster)
+    binner = MultiDeviceBinner.from_mappers([booster.bin_mapper])
+    rows = jnp.asarray(np.ascontiguousarray(X, np.float32))
+    mids = jnp.zeros(int(rows.shape[0]), jnp.int32)
+    aucs = {}
+    for dt in ("f32", leaf_dtype):
+        mpf = _forest.build_multi_forest([(name, seg)], leaf_dtype=dt)
+        raw = np.asarray(
+            _forest.multi_packed_raw_scores_rows(mpf, binner, rows, mids)
+        )
+        aucs[dt] = _auc(raw[0], y)
+    drift = abs(aucs["f32"] - aucs[leaf_dtype])
+    if obs.enabled():
+        obs.gauge("serve.quant_auc_drift", drift, leaf_dtype=leaf_dtype)
+    return {
+        "leaf_dtype": leaf_dtype,
+        "auc_f32": aucs["f32"],
+        "auc_quant": aucs[leaf_dtype],
+        "auc_drift": drift,
+    }

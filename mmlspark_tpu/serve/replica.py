@@ -1,0 +1,108 @@
+"""serve.replica — one fleet replica as a process entrypoint.
+
+``python -m mmlspark_tpu.serve.replica --port 0 --model churn=/models/v1
+--model fraud=/models/f2 --group`` builds a :class:`ServingApp`, loads
+every ``--model name=path`` pair (co-resident behind one super-table
+with ``--group``, independent routes without), starts it, and prints ONE
+JSON line to stdout::
+
+    {"port": 8931, "url": "http://127.0.0.1:8931", "ready_s": 0.41,
+     "replica_id": "r0", "models": ["churn", "fraud"], "pid": 1234}
+
+so a parent (serve/router.py, tools/bench_serving.py --fleet, the CI
+fleet-smoke job) can read the bound port without racing the OS.  The
+process then serves until SIGTERM/SIGINT, which triggers the graceful
+path — admission drain, worker join, transport stop — before exit; the
+router's ``stop()`` escalates to SIGKILL only if this times out.
+
+``MMLSPARK_TPU_REPLICA_ID`` (set by the router, or ``--replica-id``)
+namespaces the obs export/blackbox files so N same-host replicas (all
+rank 0 in their own process) never clobber one another's telemetry —
+see obs/_state.py.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import sys
+import threading
+import time
+
+
+def _parse_models(specs):
+    pairs = []
+    for spec in specs:
+        name, sep, path = spec.partition("=")
+        if not sep or not name or not path:
+            raise SystemExit(f"--model needs name=path, got {spec!r}")
+        pairs.append((name, path))
+    return pairs
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="mmlspark_tpu.serve.replica",
+        description="Run one serving replica (fleet member).",
+    )
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--port", type=int, default=0,
+                    help="0 binds an ephemeral port (printed on stdout)")
+    ap.add_argument("--model", action="append", default=[],
+                    metavar="NAME=PATH", help="tenant model (repeatable)")
+    ap.add_argument("--group", action="store_true",
+                    help="co-resident tenants: one super-table dispatch")
+    ap.add_argument("--leaf-dtype", default="f32",
+                    choices=("f32", "f16", "int8"),
+                    help="grouped leaf table dtype (see serve/README.md)")
+    ap.add_argument("--replica-id", default=None,
+                    help="obs file namespace (default: env or pid)")
+    ap.add_argument("--drain-s", type=float, default=10.0)
+    args = ap.parse_args(argv)
+    models = _parse_models(args.model)
+    if not models:
+        raise SystemExit("at least one --model name=path is required")
+
+    if args.replica_id:
+        os.environ["MMLSPARK_TPU_REPLICA_ID"] = args.replica_id
+    replica_id = os.environ.get("MMLSPARK_TPU_REPLICA_ID") or f"pid{os.getpid()}"
+
+    # import after the env is set so obs picks up the replica namespace
+    from mmlspark_tpu.serve.app import ServingApp
+
+    t0 = time.perf_counter()
+    app = ServingApp(host=args.host, port=args.port)
+    if args.group and len(models) > 1:
+        app.add_model_group(models, leaf_dtype=args.leaf_dtype)
+    else:
+        for name, path in models:
+            app.add_model(name, path=path)
+    app.start()
+    # the ready line IS the parent-facing contract: one JSON object on
+    # stdout that the router blocks on to learn the bound port
+    print(json.dumps({  # analyze: ignore[OBS001]
+        "port": app.port,
+        "url": app.url,
+        "ready_s": round(time.perf_counter() - t0, 3),
+        "replica_id": replica_id,
+        "models": [name for name, _ in models],
+        "pid": os.getpid(),
+    }), flush=True)
+
+    done = threading.Event()
+
+    def _graceful(signum, frame):  # noqa: ARG001 - signal signature
+        done.set()
+
+    signal.signal(signal.SIGTERM, _graceful)
+    signal.signal(signal.SIGINT, _graceful)
+    while not done.wait(timeout=1.0):
+        pass  # bounded waits keep the thread signalable/debuggable
+    clean = app.stop(drain_s=args.drain_s)
+    return 0 if clean else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,358 @@
+"""MultiPackedForest / co-resident super-table parity suite (ISSUE 13).
+
+The fleet contract mirrors the standalone one (test_packed_forest.py):
+serving N models from ONE concatenated device table must leave every
+model's raw scores **bitwise-identical** to its standalone PackedForest
+output — same gathers (offsets pre-folded), same serial f32 accumulation
+per class — across categorical splits, multiclass heads, and models of
+different depth/width sharing a batch.  ``np.array_equal`` throughout.
+
+Also covered: a single-tenant swap reuses every other tenant's host
+segment verbatim (slice-only rebuild), the quantized fp16/int8 leaf
+tables hold their measured AUC-drift bound, and the multi-model Pallas
+replay kernel (interpret mode on CPU) matches the gather loop.
+"""
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu.engine import forest as _forest
+from mmlspark_tpu.engine.booster import Dataset, train
+from mmlspark_tpu.ops.device_binning import (
+    MultiDeviceBinner, bin_rows_device_multi,
+)
+
+
+def _segment(booster):
+    T = int(booster.num_iterations)
+    return _forest.segment_from_packed(booster._packed_forest(T))
+
+
+@pytest.fixture(scope="module")
+def fleet():
+    """Four deliberately heterogeneous tenants: feature widths 4/5/5/6,
+    depths from num_leaves 4 vs 15, a 3-class head, and real categorical
+    splits — every padding dimension of the super-table is exercised."""
+    rng = np.random.default_rng(11)
+
+    X_a = rng.normal(size=(300, 6))
+    y_a = X_a[:, 0] * 2.0 - np.sin(X_a[:, 1]) + 0.2 * rng.normal(size=300)
+    deep = train(
+        {"objective": "regression", "num_iterations": 12, "num_leaves": 15,
+         "min_data_in_leaf": 4, "learning_rate": 0.2},
+        Dataset(X_a, y_a),
+    )
+
+    X_b = rng.normal(size=(250, 4))
+    y_b = X_b[:, 1] - X_b[:, 2] + 0.1 * rng.normal(size=250)
+    shallow = train(
+        {"objective": "regression", "num_iterations": 6, "num_leaves": 4,
+         "min_data_in_leaf": 4},
+        Dataset(X_b, y_b),
+    )
+
+    X_c = rng.normal(size=(350, 5))
+    y_c = (X_c[:, 0] + 0.7 * X_c[:, 1] > 0.4).astype(int) + (X_c[:, 2] > 0.6)
+    multi = train(
+        {"objective": "multiclass", "num_class": 3, "num_iterations": 8,
+         "num_leaves": 7, "min_data_in_leaf": 3, "learning_rate": 0.3},
+        Dataset(X_c, y_c.astype(np.float64)),
+    )
+
+    Xc_cat = rng.integers(0, 12, size=(300, 2)).astype(np.float64)
+    Xc_num = rng.normal(size=(300, 3))
+    X_d = np.concatenate([Xc_cat, Xc_num], axis=1)
+    y_d = (np.isin(Xc_cat[:, 0], [1, 4, 9]).astype(float) * 2.0
+           + Xc_num[:, 0] + 0.2 * rng.normal(size=300))
+    cats = train(
+        {"objective": "regression", "num_iterations": 10, "num_leaves": 15,
+         "min_data_in_leaf": 4, "categorical_feature": [0, 1]},
+        Dataset(X_d, y_d),
+    )
+    assert bool(np.any(np.asarray(cats.trees.split_cat) >= 0)), \
+        "fixture must actually take categorical splits"
+
+    return {
+        "deep": (deep, X_a, y_a),
+        "shallow": (shallow, X_b, y_b),
+        "multi": (multi, X_c, y_c),
+        "cats": (cats, X_d, y_d),
+    }
+
+
+def _mixed_batch(fleet_dict, names, rows_per_model, f_max, seed=0):
+    """(n, Fmax) zero-padded mixed rows + (n,) mids in fixture order."""
+    rng = np.random.default_rng(seed)
+    X = np.zeros((rows_per_model * len(names), f_max), np.float64)
+    mids = np.zeros(rows_per_model * len(names), np.int32)
+    blocks = {}
+    for i, name in enumerate(names):
+        _, Xm, _ = fleet_dict[name]
+        rows = Xm[rng.integers(0, len(Xm), size=rows_per_model)]
+        sl = slice(i * rows_per_model, (i + 1) * rows_per_model)
+        X[sl, : rows.shape[1]] = rows
+        mids[sl] = i
+        blocks[name] = (sl, rows)
+    return X, mids, blocks
+
+
+def _build(fleet_dict, names, leaf_dtype="f32"):
+    segs = [(n, _segment(fleet_dict[n][0])) for n in names]
+    mpf = _forest.build_multi_forest(segs, leaf_dtype=leaf_dtype)
+    binner = MultiDeviceBinner.from_mappers(
+        [fleet_dict[n][0].bin_mapper for n in names]
+    )
+    return mpf, binner
+
+
+class TestMixedBatchBitwiseParity:
+    NAMES = ("deep", "shallow", "multi", "cats")
+
+    def test_every_tenant_bitwise_equal_to_standalone(self, fleet):
+        mpf, binner = _build(fleet, self.NAMES)
+        X, mids, blocks = _mixed_batch(fleet, self.NAMES, 48,
+                                       binner.num_features)
+        import jax.numpy as jnp
+
+        raw = np.asarray(_forest.multi_packed_raw_scores_rows(
+            mpf, binner, jnp.asarray(X, jnp.float32), jnp.asarray(mids)
+        ))
+        assert raw.shape == (mpf.max_class, len(mids))
+        for name in self.NAMES:
+            booster, _, _ = fleet[name]
+            sl, rows = blocks[name]
+            pf = booster._packed_forest(int(booster.num_iterations))
+            want = np.asarray(_forest.packed_raw_scores_rows(
+                pf, booster.device_binner(),
+                jnp.asarray(rows, jnp.float32),
+            ))
+            K = int(booster.num_class)
+            assert np.array_equal(raw[:K, sl], want), name
+            # foreign class rows of narrower heads stay exactly zero
+            assert not raw[K:, sl].any(), name
+
+    def test_row_order_invariance(self, fleet):
+        """Interleaved tenants score identically to blocked tenants —
+        routing is purely per-row, no cross-row state."""
+        mpf, binner = _build(fleet, self.NAMES)
+        X, mids, _ = _mixed_batch(fleet, self.NAMES, 16,
+                                  binner.num_features, seed=5)
+        import jax.numpy as jnp
+
+        perm = np.random.default_rng(9).permutation(len(mids))
+        base = np.asarray(_forest.multi_packed_raw_scores_rows(
+            mpf, binner, jnp.asarray(X, jnp.float32), jnp.asarray(mids)))
+        shuf = np.asarray(_forest.multi_packed_raw_scores_rows(
+            mpf, binner, jnp.asarray(X[perm], jnp.float32),
+            jnp.asarray(mids[perm])))
+        assert np.array_equal(base[:, perm], shuf)
+
+    def test_prebinned_entry_matches_fused(self, fleet):
+        mpf, binner = _build(fleet, self.NAMES)
+        X, mids, _ = _mixed_batch(fleet, self.NAMES, 8, binner.num_features)
+        import jax.numpy as jnp
+
+        rows = jnp.asarray(X, jnp.float32)
+        mid_j = jnp.asarray(mids)
+        bins = bin_rows_device_multi(binner.arrays, rows, mid_j,
+                                     n_bounds=binner.n_bounds)
+        assert np.array_equal(
+            np.asarray(_forest.multi_packed_raw_scores(mpf, bins, mid_j)),
+            np.asarray(_forest.multi_packed_raw_scores_rows(
+                mpf, binner, rows, mid_j)),
+        )
+
+
+class TestSliceOnlySwap:
+    NAMES = ("deep", "shallow", "multi")
+
+    def test_swap_reuses_other_segments_verbatim(self, fleet):
+        mpf, _ = _build(fleet, self.NAMES)
+        _, Xm, ym = fleet["shallow"]
+        v2 = train(
+            {"objective": "regression", "num_iterations": 6, "num_leaves": 4,
+             "min_data_in_leaf": 4},
+            Dataset(Xm, -ym),
+        )
+        swapped = _forest.swap_multi_segment(mpf, "shallow", _segment(v2))
+        assert swapped.names == mpf.names
+        for i, name in enumerate(self.NAMES):
+            if name == "shallow":
+                assert swapped.segments[i] is not mpf.segments[i]
+            else:
+                # the OTHER tenants' host segments are reused by identity:
+                # a one-tenant swap never re-packs its neighbours
+                assert swapped.segments[i] is mpf.segments[i], name
+
+    def test_swap_parity_swapped_and_untouched(self, fleet):
+        import jax.numpy as jnp
+
+        mpf, binner = _build(fleet, self.NAMES)
+        _, Xm, ym = fleet["shallow"]
+        v2 = train(
+            {"objective": "regression", "num_iterations": 6, "num_leaves": 4,
+             "min_data_in_leaf": 4},
+            Dataset(Xm, -2.0 * ym),
+        )
+        swapped = _forest.swap_multi_segment(mpf, "shallow", _segment(v2))
+        X, mids, blocks = _mixed_batch(fleet, self.NAMES, 24,
+                                       binner.num_features, seed=3)
+        raw = np.asarray(_forest.multi_packed_raw_scores_rows(
+            swapped, binner, jnp.asarray(X, jnp.float32), jnp.asarray(mids)))
+        # swapped tenant serves the NEW model ...
+        sl, rows = blocks["shallow"]
+        pf2 = v2._packed_forest(int(v2.num_iterations))
+        want = np.asarray(_forest.packed_raw_scores_rows(
+            pf2, v2.device_binner(), jnp.asarray(rows, jnp.float32)))
+        assert np.array_equal(raw[:1, sl], want)
+        # ... and the untouched tenants stay bitwise on the OLD ones
+        for name in ("deep", "multi"):
+            booster, _, _ = fleet[name]
+            sl, rows = blocks[name]
+            pf = booster._packed_forest(int(booster.num_iterations))
+            want = np.asarray(_forest.packed_raw_scores_rows(
+                pf, booster.device_binner(), jnp.asarray(rows, jnp.float32)))
+            assert np.array_equal(raw[: int(booster.num_class), sl], want), name
+
+    def test_swap_unknown_tenant_raises(self, fleet):
+        mpf, _ = _build(fleet, self.NAMES)
+        with pytest.raises(ValueError):
+            mpf.model_id("nope")
+
+
+class TestQuantizedLeaves:
+    def test_leaf_tables_actually_narrow(self, fleet):
+        names = ("deep", "shallow")
+        f32, _ = _build(fleet, names, "f32")
+        f16, _ = _build(fleet, names, "f16")
+        i8, _ = _build(fleet, names, "int8")
+        assert np.asarray(f32.arrays.leafv).dtype == np.float32
+        assert np.asarray(f16.arrays.leafv).dtype == np.float16
+        assert np.asarray(i8.arrays.leafv).dtype == np.int8
+        assert f16.nbytes < f32.nbytes and i8.nbytes < f16.nbytes
+
+    def test_bad_leaf_dtype_rejected(self, fleet):
+        segs = [("deep", _segment(fleet["deep"][0]))]
+        with pytest.raises(ValueError):
+            _forest.build_multi_forest(segs, leaf_dtype="f8")
+
+    @pytest.mark.parametrize("leaf_dtype", ["f16", "int8"])
+    def test_auc_drift_within_budget(self, fleet, leaf_dtype):
+        """The narrow-dtype gate is a MEASUREMENT: score a holdout
+        through both leaf tables and bound the ranking drift."""
+        from mmlspark_tpu.serve.coresident import quantization_auc_drift
+
+        booster, X, y = fleet["deep"]
+        labels = (y > np.median(y)).astype(int)
+        rep = quantization_auc_drift(booster, X, labels, leaf_dtype)
+        assert rep["leaf_dtype"] == leaf_dtype
+        assert rep["auc_f32"] > 0.8  # the measurement must be meaningful
+        assert rep["auc_drift"] <= 0.02, rep
+
+
+class TestMultiPallasParity:
+    NAMES = ("deep", "shallow", "multi")  # numeric-only (kernel has no cats)
+
+    def test_replay_kernel_matches_gather_loop(self, fleet):
+        from mmlspark_tpu.ops import pallas_predict as pp
+
+        import jax.numpy as jnp
+
+        models, parts = [], []
+        for name in self.NAMES:
+            booster, _, _ = fleet[name]
+            T = int(booster.num_iterations)
+            seg = _segment(booster)
+            ht = booster._host_trees()
+            S = int(np.asarray(ht.split_leaf).shape[-1])
+            models.append((ht, booster.tree_weights, T, seg.num_bins))
+            parts.append((T, int(booster.num_class), S, seg.has_cats))
+        if not pp.multi_pallas_supported(parts):
+            pytest.skip("fleet exceeds the SMEM replay budget")
+        mpal = pp.build_multi_pallas_forest(models)
+        mpf, binner = _build(fleet, self.NAMES)
+        X, mids, _ = _mixed_batch(fleet, self.NAMES, 40, binner.num_features)
+        rows = jnp.asarray(X, jnp.float32)
+        mid_j = jnp.asarray(mids)
+        bins = bin_rows_device_multi(binner.arrays, rows, mid_j,
+                                     n_bounds=binner.n_bounds)
+        got = np.asarray(pp.multi_pallas_raw_scores(
+            mpal, bins, mid_j, interpret=True))
+        want = np.asarray(_forest.multi_packed_raw_scores(mpf, bins, mid_j))
+        assert np.array_equal(got, want)
+
+    def test_cats_fleet_not_supported(self, fleet):
+        from mmlspark_tpu.ops import pallas_predict as pp
+
+        booster = fleet["cats"][0]
+        seg = _segment(booster)
+        assert seg.has_cats
+        assert not pp.multi_pallas_supported(
+            [(int(booster.num_iterations), 1, 4, True)]
+        )
+
+
+class TestCoResidentGroup:
+    """serve-layer wrapper: finalized (not just raw) parity + hot swap."""
+
+    NAMES = ("deep", "shallow", "multi")
+
+    def test_predict_mixed_finalized_parity(self, fleet):
+        from mmlspark_tpu.serve.coresident import CoResidentGroup
+
+        group = CoResidentGroup([(n, fleet[n][0]) for n in self.NAMES])
+        B = 64
+        X, mids, blocks = _mixed_batch(fleet, self.NAMES, B // 4,
+                                       group.feature_dim, seed=21)
+        pad = B - len(mids)
+        Xp = np.concatenate([X, np.zeros((pad, X.shape[1]))])
+        mp = np.concatenate([mids, np.zeros(pad, np.int32)])
+        out = group.predict_mixed(Xp, mp)
+        assert out.shape == (B, 3)  # Kmax = multi's 3 classes
+        for name in self.NAMES:
+            booster, _, _ = fleet[name]
+            sl, rows = blocks[name]
+            K = int(booster.num_class)
+            padded = np.zeros((B, rows.shape[1]))
+            padded[: rows.shape[0]] = rows
+            want = np.asarray(
+                booster.predict_padded(padded, rows.shape[0]), np.float32
+            )
+            got = out[sl, :K]
+            if K == 1:
+                got = got[:, 0]
+            assert np.array_equal(got, want), name
+
+    def test_prepare_commit_swap(self, fleet):
+        from mmlspark_tpu.serve.coresident import CoResidentGroup
+
+        group = CoResidentGroup([(n, fleet[n][0]) for n in self.NAMES])
+        _, Xm, ym = fleet["shallow"]
+        v2 = train(
+            {"objective": "regression", "num_iterations": 6, "num_leaves": 4,
+             "min_data_in_leaf": 4},
+            Dataset(Xm, -ym),
+        )
+        with pytest.raises(RuntimeError):
+            group.commit_swap("shallow")  # nothing staged yet
+        group.prepare_swap("shallow", v2)
+        group.commit_swap("shallow")
+        rows = Xm[:5]
+        B = 8
+        X = np.zeros((B, group.feature_dim))
+        X[:5, : rows.shape[1]] = rows
+        mids = np.full(B, group.model_id("shallow"), np.int32)
+        out = group.predict_mixed(X, mids)
+        padded = np.zeros((B, rows.shape[1]))
+        padded[:5] = rows
+        want = np.asarray(v2.predict_padded(padded, 5), np.float32)
+        assert np.array_equal(out[:5, 0], want)
+
+    def test_abort_swap_keeps_live_snapshot(self, fleet):
+        from mmlspark_tpu.serve.coresident import CoResidentGroup
+
+        group = CoResidentGroup([(n, fleet[n][0]) for n in self.NAMES])
+        group.prepare_swap("shallow", fleet["shallow"][0])
+        group.abort_swap("shallow")
+        with pytest.raises(RuntimeError):
+            group.commit_swap("shallow")

@@ -1,0 +1,436 @@
+"""Fleet serving: co-resident group routes + the replica router (ISSUE 13).
+
+Layers:
+1. batcher/admission units for the shared-queue group path: mixed-model
+   items close into ONE batch with their model tags intact; grouped
+   tenants share one bounded queue while keeping per-route caps;
+   ``admit_inline`` replays the full verdict ladder without a queue;
+2. ServingApp group routes end-to-end over HTTP: per-tenant predictions
+   bitwise-equal to the standalone padded path, the single-row contract,
+   and ``POST /admin/swap`` rebuilding only the swapped tenant's slice;
+3. FleetRouter: least-loaded placement with SLO/drift penalties (units
+   on fabricated handles), and the HTTP front proxying to an attached
+   in-process replica — health, /fleetz, retry-on-transport-error,
+   rolling swap, drain;
+4. (slow) a real spawned replica process, drain-or-kill on stop.
+"""
+
+import json
+import queue
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu.serve.admission import AdmissionController
+from mmlspark_tpu.serve.batcher import BatchItem, DynamicBatcher
+from mmlspark_tpu.serve.router import FleetRouter, ReplicaHandle
+
+from tests.test_serve import _get, _post
+
+
+# --------------------------------------------------------------- fixtures
+@pytest.fixture(scope="module")
+def tenants(tmp_path_factory):
+    """Two regressors with DIFFERENT feature widths (4 and 6) plus a v2
+    of the first — saved to disk like a real fleet deployment."""
+    from mmlspark_tpu.core.frame import DataFrame
+    from mmlspark_tpu.models.lightgbm import LightGBMRegressor
+
+    rng = np.random.default_rng(23)
+    tmp = tmp_path_factory.mktemp("fleet_models")
+    out = {}
+    for name, f, scale in (("alpha", 4, 1.0), ("beta", 6, -2.0)):
+        X = rng.normal(size=(200, f))
+        y = X[:, 0] * scale + 0.1 * rng.normal(size=200)
+        model = LightGBMRegressor(
+            numIterations=4, numLeaves=4, minDataInLeaf=2
+        ).fit(DataFrame({"features": list(X), "label": y}))
+        p = str(tmp / f"{name}_v1")
+        model.save(p)
+        out[name] = {"path": p, "X": X, "model": model}
+    # alpha v2: same shape, different fit
+    X = out["alpha"]["X"]
+    m2 = LightGBMRegressor(
+        numIterations=4, numLeaves=4, minDataInLeaf=2
+    ).fit(DataFrame({"features": list(X), "label": -3.0 * X[:, 0]}))
+    p2 = str(tmp / "alpha_v2")
+    m2.save(p2)
+    out["alpha_v2"] = {"path": p2, "model": m2}
+    return out
+
+
+@pytest.fixture()
+def group_app(tenants):
+    from mmlspark_tpu.serve import ServingApp
+
+    app = ServingApp(max_wait_ms=10.0)
+    app.add_model_group([
+        ("alpha", tenants["alpha"]["path"]),
+        ("beta", tenants["beta"]["path"]),
+    ])
+    app.start()
+    yield app
+    app.stop(drain_s=5.0)
+
+
+def _padded_want(model, rows, bucket):
+    from mmlspark_tpu.serve.monitor import find_booster
+
+    b = find_booster(model)
+    padded = np.zeros((bucket, rows.shape[1]))
+    padded[: rows.shape[0]] = rows
+    return np.asarray(
+        b.predict_padded(padded, rows.shape[0]), np.float32
+    )
+
+
+# ------------------------------------------------- shared-queue batching
+class TestMixedBatchUnits:
+    def test_mixed_models_close_into_one_batch(self):
+        """The grouped batcher is model-agnostic: items for different
+        tenants drain into ONE batch, each keeping its model tag — the
+        worker routes rows by ``item.model``, not by queue identity."""
+        b = DynamicBatcher(buckets=(8,), max_rows=8, max_wait_ms=5000)
+        q = queue.Queue()
+        for i, model in enumerate(["alpha", "beta", "alpha", "beta"]):
+            q.put(BatchItem(
+                rid=f"r{i}", rows=np.zeros((2, 3)),
+                deadline=time.monotonic() + 60, model=model,
+            ))
+        items = b.collect(q)
+        assert [it.model for it in items] == ["alpha", "beta", "alpha",
+                                              "beta"]
+        assert sum(it.rows.shape[0] for it in items) == 8
+
+    def test_model_tag_defaults_to_none(self):
+        it = BatchItem(rid="r", rows=np.zeros((1, 3)),
+                       deadline=time.monotonic() + 60)
+        assert it.model is None
+
+    def test_grouped_routes_share_one_queue(self):
+        adm = AdmissionController()
+        q1 = adm.register_route("alpha")
+        q2 = adm.register_route("beta", queue_=q1)
+        assert q2 is q1
+        assert adm.queue_for("beta") is q1
+        # per-route inflight accounting stays separate on the shared queue
+        adm.set_ready(True)
+        assert adm.admit("alpha", BatchItem(
+            rid="a", rows=np.zeros((1, 3)),
+            deadline=time.monotonic() + 60)) is None
+        assert adm.inflight("alpha") == 1 and adm.inflight("beta") == 0
+        assert q1.qsize() == 1
+
+    def test_admit_inline_verdict_ladder(self):
+        adm = AdmissionController(max_inflight=1)
+        adm.register_route("m")
+        resp = adm.admit_inline("m")  # not ready yet
+        assert resp is not None and resp.statusCode == 503
+        adm.set_ready(True)
+        assert adm.admit_inline("m") is None
+        assert adm.inflight("m") == 1
+        shed = adm.admit_inline("m")  # at the per-route cap
+        assert shed is not None and shed.statusCode == 429
+        adm.complete("m")
+        assert adm.inflight("m") == 0
+        # draining refuses new inline admits and reports drained
+        assert adm.begin_drain(timeout_s=1.0)
+        resp = adm.admit_inline("m")
+        assert resp is not None and resp.statusCode == 503
+
+    def test_inline_admits_block_drain_until_complete(self):
+        adm = AdmissionController()
+        adm.register_route("m")
+        adm.set_ready(True)
+        assert adm.admit_inline("m") is None
+        done = []
+
+        def drainer():
+            done.append(adm.begin_drain(timeout_s=10.0))
+
+        t = threading.Thread(target=drainer)
+        t.start()
+        time.sleep(0.1)
+        adm.complete("m")
+        t.join(timeout=10)
+        assert done == [True]
+
+
+# ------------------------------------------------- group app over HTTP
+class TestGroupServing:
+    def test_per_tenant_parity_and_headers(self, group_app, tenants):
+        for name in ("alpha", "beta"):
+            rows = tenants[name]["X"][:5]
+            st, body, hdr = _post(
+                f"{group_app.url}/models/{name}/predict",
+                {"instances": rows.tolist()},
+            )
+            assert st == 200, body
+            want = _padded_want(tenants[name]["model"], rows, 8)
+            got = np.asarray(body["predictions"], np.float32)
+            assert np.array_equal(got, want), name
+            assert hdr.get("X-Model-Version") == "1"
+
+    def test_single_row_contract(self, group_app, tenants):
+        st, body, _ = _post(
+            f"{group_app.url}/models/alpha/predict",
+            {"features": tenants["alpha"]["X"][0].tolist()},
+        )
+        assert st == 200 and isinstance(body["prediction"], float)
+
+    def test_concurrent_mixed_tenants_round_trip(self, group_app, tenants):
+        """Concurrent traffic to BOTH tenants through the shared queue:
+        every reply must carry its own tenant's scores (no cross-tenant
+        leakage through the mixed batch)."""
+        errors = []
+
+        def fire(name, reps):
+            rows = tenants[name]["X"][:3]
+            want = _padded_want(tenants[name]["model"], rows, 8)
+            for _ in range(reps):
+                st, body, _ = _post(
+                    f"{group_app.url}/models/{name}/predict",
+                    {"instances": rows.tolist()},
+                )
+                if st != 200:
+                    errors.append((name, st, body))
+                    return
+                got = np.asarray(body["predictions"], np.float32)
+                if not np.array_equal(got, want):
+                    errors.append((name, "parity"))
+                    return
+
+        threads = [
+            threading.Thread(target=fire, args=(name, 10))
+            for name in ("alpha", "beta") for _ in range(3)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60)
+        assert not errors, errors[:3]
+
+    def test_admin_swap_rebuilds_only_swapped_tenant(self, group_app,
+                                                     tenants):
+        st, body, _ = _post(
+            f"{group_app.url}/admin/swap",
+            {"model": "alpha", "path": tenants["alpha_v2"]["path"]},
+        )
+        assert st == 200, body
+        assert body["model"] == "alpha" and body["version"] == 2
+        # swapped tenant serves v2 ...
+        rows = tenants["alpha"]["X"][:4]
+        st, body, hdr = _post(
+            f"{group_app.url}/models/alpha/predict",
+            {"instances": rows.tolist()},
+        )
+        want = _padded_want(tenants["alpha_v2"]["model"], rows, 8)
+        assert np.array_equal(
+            np.asarray(body["predictions"], np.float32), want
+        )
+        assert hdr.get("X-Model-Version") == "2"
+        # ... and beta still serves its untouched v1, bitwise
+        rows = tenants["beta"]["X"][:4]
+        st, body, _ = _post(
+            f"{group_app.url}/models/beta/predict",
+            {"instances": rows.tolist()},
+        )
+        assert np.array_equal(
+            np.asarray(body["predictions"], np.float32),
+            _padded_want(tenants["beta"]["model"], rows, 8),
+        )
+
+    def test_admin_swap_rejects_bad_requests(self, group_app):
+        st, body, _ = _post(f"{group_app.url}/admin/swap", {"model": "alpha"})
+        assert st == 400
+        st, body, _ = _post(
+            f"{group_app.url}/admin/swap",
+            {"model": "ghost", "path": "/nowhere"},
+        )
+        assert st == 404
+
+    def test_readyz_lists_group_tenants(self, group_app):
+        st, body = _get(group_app.url + "/readyz")
+        assert st == 200
+        assert {"alpha", "beta"} <= set(body["models"])
+
+
+# --------------------------------------------------------- router units
+def _handle(url="http://x", models=("m",), inflight=0, healthy=True,
+            draining=False, burning=False, drifting=False):
+    h = ReplicaHandle(url, models)
+    h.inflight = inflight
+    h.healthy = healthy
+    h.draining = draining
+    h.route_health = {m: {"burning": burning, "drifting": drifting}
+                      for m in models}
+    return h
+
+
+class TestRouterPlacement:
+    def _router(self, handles):
+        r = FleetRouter()
+        r.replicas.extend(handles)
+        return r
+
+    def test_least_loaded_wins(self):
+        busy = _handle("http://a", inflight=5)
+        idle = _handle("http://b", inflight=1)
+        assert self._router([busy, idle])._pick("m") is idle
+
+    def test_unhealthy_and_draining_excluded(self):
+        down = _handle("http://a", healthy=False)
+        draining = _handle("http://b", draining=True)
+        ok = _handle("http://c", inflight=99)
+        r = self._router([down, draining, ok])
+        assert r._pick("m") is ok
+        assert r._candidates("m") == [ok]
+
+    def test_burning_tenant_penalized_not_excluded(self):
+        hot = _handle("http://a", inflight=0, burning=True)
+        cool = _handle("http://b", inflight=50)
+        r = self._router([hot, cool])
+        # the clean replica wins despite higher inflight ...
+        assert r._pick("m") is cool
+        # ... but a fully-degraded fleet still routes somewhere
+        assert self._router([hot])._pick("m") is hot
+
+    def test_drifting_tenant_penalized_per_tenant_only(self):
+        h = _handle("http://a", models=("m", "other"))
+        h.route_health["m"]["drifting"] = True
+        clean = _handle("http://b", models=("m", "other"), inflight=10)
+        r = self._router([h, clean])
+        assert r._pick("m") is clean      # drifting tenant steered away
+        assert r._pick("other") is h      # other tenant unaffected
+
+    def test_all_burning_detection(self):
+        r = self._router([_handle("http://a", burning=True),
+                          _handle("http://b", burning=True)])
+        assert r._all_burning("m")
+        r2 = self._router([_handle("http://a", burning=True),
+                           _handle("http://b")])
+        assert not r2._all_burning("m")
+
+    def test_pick_honours_exclusions(self):
+        a, b = _handle("http://a"), _handle("http://b")
+        r = self._router([a, b])
+        first = r._pick("m")
+        second = r._pick("m", exclude=[first])
+        assert second is not first and second is not None
+        assert r._pick("m", exclude=[a, b]) is None
+
+
+# ---------------------------------------------------- router over HTTP
+class TestRouterHTTP:
+    @pytest.fixture()
+    def fleet(self, group_app):
+        router = FleetRouter(health_interval_s=0.2)
+        router.attach_replica(group_app.url)
+        router.start()
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            st, _ = _get(router.url + "/readyz")
+            if st == 200:
+                break
+            time.sleep(0.05)
+        yield router
+        router.stop(drain_s=5.0)
+
+    def test_attach_discovers_models(self, fleet):
+        assert fleet.replicas[0].models == {"alpha", "beta"}
+
+    def test_proxy_parity_and_version_header(self, fleet, tenants):
+        rows = tenants["beta"]["X"][:3]
+        st, body, hdr = _post(
+            f"{fleet.url}/models/beta/predict",
+            {"instances": rows.tolist()},
+        )
+        assert st == 200, body
+        assert np.array_equal(
+            np.asarray(body["predictions"], np.float32),
+            _padded_want(tenants["beta"]["model"], rows, 8),
+        )
+        assert hdr.get("X-Model-Version")
+
+    def test_unknown_model_404(self, fleet):
+        st, body, _ = _post(
+            f"{fleet.url}/models/ghost/predict", {"instances": [[0.0]]}
+        )
+        assert st == 404
+
+    def test_fleetz_state(self, fleet):
+        st, body = _get(fleet.url + "/fleetz")
+        assert st == 200
+        assert body["models"] == ["alpha", "beta"]
+        assert body["replicas"][0]["healthy"]
+
+    def test_transport_error_retries_on_other_replica(self, fleet, tenants):
+        """A dead attached replica must not surface 5xx while a live one
+        can serve: the router retries transport failures on a DIFFERENT
+        replica."""
+        fleet.attach_replica("http://127.0.0.1:9", models=["alpha", "beta"])
+        rows = tenants["alpha"]["X"][:2]
+        ok = 0
+        for _ in range(6):
+            st, _, _ = _post(
+                f"{fleet.url}/models/alpha/predict",
+                {"instances": rows.tolist()},
+            )
+            ok += st == 200
+        assert ok == 6
+
+    def test_rolling_swap_via_router(self, fleet, tenants):
+        st, body, _ = _post(
+            f"{fleet.url}/admin/swap",
+            {"model": "beta", "path": tenants["beta"]["path"]},
+        )
+        assert st == 200, body
+        assert body["model"] == "beta"
+        assert all(leg["status"] == 200 for leg in body["replicas"])
+        # the draining mark is transient: replica back in rotation
+        assert not fleet.replicas[0].draining
+
+    def test_rolling_swap_unknown_model_404(self, fleet):
+        st, body, _ = _post(
+            f"{fleet.url}/admin/swap", {"model": "ghost", "path": "/x"}
+        )
+        assert st == 404
+
+
+# ------------------------------------------- spawned replica (slow path)
+@pytest.mark.slow
+class TestSpawnedReplica:
+    def test_spawn_predict_swap_and_drain_or_kill(self, tenants):
+        router = FleetRouter(health_interval_s=0.5)
+        try:
+            h = router.spawn_replica(
+                [("alpha", tenants["alpha"]["path"]),
+                 ("beta", tenants["beta"]["path"])],
+                group=True,
+            )
+            router.start()
+            assert h.proc is not None and h.proc.poll() is None
+            assert h.replica_id == "r0"
+            rows = tenants["alpha"]["X"][:3]
+            st, body, _ = _post(
+                f"{router.url}/models/alpha/predict",
+                {"instances": rows.tolist()}, timeout=120.0,
+            )
+            assert st == 200, body
+            assert np.array_equal(
+                np.asarray(body["predictions"], np.float32),
+                _padded_want(tenants["alpha"]["model"], rows, 8),
+            )
+            st, body, _ = _post(
+                f"{router.url}/admin/swap",
+                {"model": "alpha", "path": tenants["alpha_v2"]["path"]},
+                timeout=300.0,
+            )
+            assert st == 200, body
+        finally:
+            clean = router.stop(drain_s=10.0, kill_timeout_s=30.0)
+        assert clean
+        assert h.proc.poll() is not None  # no orphaned serving process

@@ -782,6 +782,71 @@ def test_srv001_would_have_caught_the_seed_transport(tmp_path):
     assert got == ["SRV001"] * 2
 
 
+def test_srv002_popen_without_reap_path(tmp_path):
+    p = _write(str(tmp_path / "m.py"), """
+        import subprocess, sys
+        class Fleet:
+            def spawn(self):
+                self._procs = [subprocess.Popen([sys.executable, "-m", "x"])]
+            def stop(self):
+                self._procs.clear()   # forgets the children entirely
+    """)
+    found = check_serving_file(p)
+    assert rules(found) == ["SRV002"]
+    assert "orphan" in found[0].message
+
+
+def test_srv002_silent_with_reap_path_and_on_bounded_run(tmp_path):
+    p = _write(str(tmp_path / "m.py"), """
+        import subprocess, sys
+        class Fleet:
+            def spawn(self):
+                self._proc = subprocess.Popen([sys.executable, "-m", "x"])
+            def stop(self):
+                self._proc.terminate()
+                try:
+                    self._proc.wait(timeout=30)
+                except subprocess.TimeoutExpired:
+                    self._proc.kill()
+        def build():
+            # run()/check_output block until the child exits: never fires
+            subprocess.run(["make"], check=True)
+            return subprocess.check_output(["git", "rev-parse", "HEAD"])
+    """)
+    assert check_serving_file(p) == []
+
+
+def test_srv002_tree_walker_only_visits_library_code(tmp_path):
+    bad = ("import subprocess\n"
+           "p = subprocess.Popen(['sleep', '9'])\n")
+    _write(str(tmp_path / "mmlspark_tpu" / "serve" / "m.py"), bad)
+    _write(str(tmp_path / "tests" / "t.py"), bad)    # exempt by contract
+    _write(str(tmp_path / "tools" / "u.py"), bad)    # exempt by contract
+    assert rules(check_serving(str(tmp_path))) == ["SRV002"]
+
+
+def test_srv002_suppression_round_trip(tmp_path):
+    src = """
+        import subprocess
+        p = subprocess.Popen(["sleep", "9"]){supp}
+    """
+    fires = _write(str(tmp_path / "a.py"), src.format(supp=""))
+    assert rules(apply_suppressions(check_serving_file(fires))) == ["SRV002"]
+    silenced = _write(str(tmp_path / "b.py"),
+                      src.format(supp="  # analyze: ignore[SRV002]"))
+    assert apply_suppressions(check_serving_file(silenced)) == []
+
+
+def test_srv002_real_router_is_clean():
+    """The shipped FleetRouter spawns replicas AND carries the
+    drain-or-kill path (stop(): SIGTERM -> bounded wait -> SIGKILL), so
+    the real serve tree stays silent."""
+    import mmlspark_tpu.serve.router as router_mod
+    found = [f for f in check_serving_file(router_mod.__file__)
+             if f.rule == "SRV002"]
+    assert found == []
+
+
 # ------------------------------------------------------------ suppressions
 
 

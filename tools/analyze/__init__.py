@@ -78,7 +78,7 @@ PASSES = {
     "obs": (lambda root, index: check_obs(root, index=index),
             {"OBS001", "OBS002", "OBS003"}),
     "serving": (lambda root, index: check_serving(root, index=index),
-                {"SRV001"}),
+                {"SRV001", "SRV002"}),
     "predict": (lambda root, index: check_predict(root, index=index),
                 {"PRED001"}),
     "quantize": (lambda root, index: check_quantize(root, index=index),

@@ -17,10 +17,23 @@ Rules
   Tests and ``tools/`` are exempt (bounded lifetimes by contract);
   deliberate cases carry ``# analyze: ignore[SRV001]``.
 
+- SRV002: serve-layer code that spawns a long-lived subprocess
+  (``subprocess.Popen``) in a module with NO reap path — no
+  ``.terminate()`` / ``.kill()`` / ``.send_signal()`` call anywhere in
+  the file.  A replica child that nobody can signal outlives its parent
+  as an orphan: it keeps the port, the device memory, and the jit-cache
+  lock, so the NEXT deploy fails in a way that looks like a routing bug.
+  Spawning is fine — ``FleetRouter.stop()`` is the shipped shape
+  (drain → SIGTERM → bounded wait → SIGKILL) — but the kill switch must
+  live in the same module as the spawn.  ``subprocess.run``/
+  ``check_output`` are exempt (they block until the child exits).
+
 Detection is intentionally modest: only ``.get``/``.wait`` receivers that
 this module ASSIGNED from a ``Queue``/``Event`` constructor are checked
 (by variable or attribute name), so ``dict.get``/``os.environ.get`` and
-friends never false-positive.
+friends never false-positive; SRV002 keys on the ``Popen`` callee name
+and a whole-module scan for the three signal methods, so helper modules
+that merely type-annotate ``subprocess.Popen`` never fire.
 """
 
 from __future__ import annotations
@@ -99,6 +112,40 @@ def _blocks_forever(call: ast.Call, method: str) -> bool:
     return True
 
 
+_REAP_METHODS = {"terminate", "kill", "send_signal"}
+
+
+def _popen_findings(path: str, tree) -> list:
+    """SRV002: ``Popen(...)`` calls in a module with no reap path."""
+    spawns = []
+    has_reap = False
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        fn = node.func
+        name = fn.id if isinstance(fn, ast.Name) else (
+            fn.attr if isinstance(fn, ast.Attribute) else None
+        )
+        if name == "Popen":
+            spawns.append(node)
+        elif isinstance(fn, ast.Attribute) and fn.attr in _REAP_METHODS:
+            has_reap = True
+    if not spawns or has_reap:
+        return []
+    return [
+        Finding(
+            path, node.lineno, "SRV002",
+            "subprocess.Popen() in serve-layer code with no "
+            "terminate()/kill()/send_signal() anywhere in this module — "
+            "a replica child nobody can signal outlives its parent as an "
+            "orphan (holding the port, device memory, and jit-cache "
+            "locks); keep the drain-or-kill path next to the spawn (see "
+            "FleetRouter.stop in mmlspark_tpu/serve/router.py)",
+        )
+        for node in spawns
+    ]
+
+
 def check_serving_file(path: str, tree=None) -> list:
     if tree is None:
         try:
@@ -106,7 +153,7 @@ def check_serving_file(path: str, tree=None) -> list:
                 tree = ast.parse(fh.read(), filename=path)
         except SyntaxError:
             return []
-    findings: list = []
+    findings: list = list(_popen_findings(path, tree))
     queue_names: set = set()
     event_names: set = set()
     # pass 1: ctor sites — flag unbounded queues, learn receiver names

@@ -46,6 +46,19 @@ scales).  With ``--smoke`` the mechanism is hard-asserted (both legs
 ready, warm leg hit the AOT artifacts); the sub-second warm
 ``app_ready_s`` target is recorded and enforced like the ingest gate —
 hard on accelerators, advisory on ``backend: cpu``.
+
+``--fleet`` runs the multi-model fleet scenario (ISSUE 13) instead:
+phase 1 builds a :class:`~mmlspark_tpu.serve.CoResidentGroup` of 4
+tenants and measures ONE mixed-batch super-table dispatch against 4
+sequential per-model dispatches at an equal row budget (per-model
+outputs must stay bitwise-identical; the >=2x aggregate-throughput gate
+is hard on accelerators, advisory on cpu), and records the measured
+fp16/int8 leaf-table AUC drift.  Phase 2 spawns a
+:class:`~mmlspark_tpu.serve.FleetRouter` with two replica PROCESSES
+each co-hosting 3 tenants, runs per-tenant closed-loop traffic through
+the router, fires a rolling hot-swap of one tenant mid-window, and
+gates on zero 5xx plus the unswapped tenants' p99 staying within 20%
+of steady state.  The report is emitted as a ``SERVE_FLEET`` JSON line.
 """
 
 from __future__ import annotations
@@ -549,6 +562,303 @@ def _run_cold(args, tmp, report) -> int:
 
 
 # --------------------------------------------------------------------------
+# fleet scenario (--fleet): co-resident super-table + replica router
+# --------------------------------------------------------------------------
+def _train_fleet_models(tmp, seed, n_models):
+    """``n_models`` small regressors with DIFFERENT feature widths (the
+    co-resident group must pad narrower tenants) sharing one rng stream.
+    Returns [(name, path, facade_model, X, y), ...]."""
+    from mmlspark_tpu.core.frame import DataFrame
+    from mmlspark_tpu.models.lightgbm import LightGBMRegressor
+
+    rng = np.random.default_rng(seed)
+    out = []
+    for i in range(n_models):
+        f = N_FEATURES + i  # 4, 5, 6, 7, ...
+        X = rng.normal(size=(300, f))
+        y = X[:, 0] * (1.5 + i) + np.sin(X[:, 1]) + 0.1 * rng.normal(size=300)
+        model = LightGBMRegressor(
+            numIterations=8, numLeaves=8, minDataInLeaf=4
+        ).fit(DataFrame({"features": list(X), "label": y}))
+        path = os.path.join(tmp, f"tenant{i}_v1")
+        model.save(path)
+        out.append((f"t{i}", path, model, X, y))
+    return out
+
+
+def _coresident_phase(models, bucket, rounds, report):
+    """In-process micro-bench: ONE mixed-batch dispatch through the
+    super-table vs M sequential per-model dispatches, equal row budget,
+    plus the bitwise per-model parity check and the quantized-leaf AUC
+    drift measurements."""
+    from mmlspark_tpu.serve.coresident import (
+        CoResidentGroup, quantization_auc_drift,
+    )
+    from mmlspark_tpu.serve.monitor import find_booster
+
+    boosters = [(name, find_booster(m)) for name, _, m, _, _ in models]
+    group = CoResidentGroup(boosters)
+    M = len(models)
+    k = bucket // M  # rows per tenant; equal total budget both paths
+    f_max = group.feature_dim
+    rng = np.random.default_rng(1234)
+
+    # mixed batch: tenant i owns rows [i*k, (i+1)*k), zero-padded right
+    X_mixed = np.zeros((bucket, f_max), np.float64)
+    mids = np.zeros(bucket, np.int32)
+    per_model = []
+    for i, (name, _, m, X, _) in enumerate(models):
+        f = X.shape[1]
+        rows = rng.normal(size=(k, f))
+        X_mixed[i * k:(i + 1) * k, :f] = rows
+        mids[i * k:(i + 1) * k] = group.model_id(name)
+        per_model.append((name, find_booster(m), rows))
+
+    # parity: each tenant's finalized slice must be bitwise-identical to
+    # its STANDALONE predict_padded at the same bucket width
+    out = group.predict_mixed(X_mixed, mids)
+    parity = True
+    for i, (name, booster, rows) in enumerate(per_model):
+        K = int(booster.num_class)
+        padded = np.zeros((bucket, rows.shape[1]))
+        padded[:k] = rows
+        want = np.asarray(booster.predict_padded(padded, k), np.float32)
+        got = out[i * k:(i + 1) * k, :K]
+        if K == 1:
+            got = got[:, 0]
+        if not np.array_equal(got, want):
+            parity = False
+            print(f"[serving] fleet parity BROKEN for {name}: "
+                  f"max|d|={np.abs(got - want).max()}", file=sys.stderr)
+
+    # timed rounds (both paths warmed by the calls above / below)
+    seq_inputs = [
+        (booster, np.ascontiguousarray(rows)) for _, booster, rows in per_model
+    ]
+    for booster, rows in seq_inputs:  # warm the (k, F) standalone programs
+        booster.predict_padded(rows, k)
+    t0 = time.perf_counter()
+    for _ in range(rounds):
+        group.predict_mixed(X_mixed, mids)
+    co_s = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    for _ in range(rounds):
+        for booster, rows in seq_inputs:
+            booster.predict_padded(rows, k)
+    seq_s = time.perf_counter() - t0
+
+    total_rows = bucket * rounds
+    speedup = seq_s / co_s if co_s else 0.0
+    co = {
+        "models": M,
+        "bucket_rows": bucket,
+        "rows_per_tenant": k,
+        "rounds": rounds,
+        "parity_bitwise": parity,
+        "co_resident_rows_per_s": round(total_rows / co_s, 1),
+        "sequential_rows_per_s": round(total_rows / seq_s, 1),
+        "dispatches_co": rounds,
+        "dispatches_seq": rounds * M,
+        "speedup_vs_sequential": round(speedup, 2),
+        "gate_speedup_ge_2x": speedup >= 2.0,
+        "supertable": group.describe(),
+    }
+
+    # quantized-leaf gate: measured AUC drift, recorded alongside
+    _, _, m0, X0, y0 = models[0]
+    labels = (y0 > np.median(y0)).astype(int)
+    co["quantization"] = {
+        dt: quantization_auc_drift(find_booster(m0), X0, labels, dt)
+        for dt in ("f16", "int8")
+    }
+    report["coresident"] = co
+    print(f"[serving] co-resident {M} models @ {bucket} rows: "
+          f"{co['co_resident_rows_per_s']} rows/s (1 dispatch) vs "
+          f"{co['sequential_rows_per_s']} rows/s ({M} dispatches) = "
+          f"{co['speedup_vs_sequential']}x  parity={parity}")
+    return co
+
+
+def _fleet_traffic(router_url, tenants, duration_s, clients_per_tenant,
+                   seed):
+    """Closed-loop per-tenant traffic through the router; one
+    _LoadResult per tenant so p50/p99 stay attributable."""
+    results = {name: _LoadResult() for name, _ in tenants}
+    stop_at = time.monotonic() + duration_s
+    threads = []
+
+    def worker(name, f, wid):
+        rng = random.Random(seed * 131 + hash(name) % 1000 + wid)
+        frng = np.random.default_rng(seed * 17 + wid)
+        url = f"{router_url}/models/{name}/predict"
+        while time.monotonic() < stop_at:
+            k = rng.randint(1, 8)
+            rows = frng.normal(size=(k, f)).tolist()
+            results[name].record(*_post(url, {"instances": rows},
+                                        timeout=30.0))
+
+    t0 = time.monotonic()
+    for name, f in tenants:
+        for wid in range(clients_per_tenant):
+            t = threading.Thread(target=worker, args=(name, f, wid),
+                                 daemon=True)
+            t.start()
+            threads.append(t)
+    for t in threads:
+        t.join(timeout=duration_s + 120)
+    wall = time.monotonic() - t0
+    return {name: res.summary(wall) for name, res in results.items()}
+
+
+def _run_fleet(args, tmp, report) -> int:
+    import jax
+
+    from mmlspark_tpu.serve.router import FleetRouter
+
+    backend = jax.default_backend()
+    report["backend"] = backend
+    gate_enforced = backend != "cpu"  # perf gates advisory on cpu CI
+    report["gate_enforced"] = gate_enforced
+
+    # ---- phase 1: co-resident super-table vs sequential dispatch -------
+    n_models = 4
+    models = _train_fleet_models(tmp, args.seed, n_models)
+    bucket = 256 if args.smoke else 512
+    rounds = 10 if args.smoke else 40
+    co = _coresident_phase(models, bucket, rounds, report)
+
+    # ---- phase 2: router + 2 replica processes, rolling swap ----------
+    tenant_specs = [(name, path) for name, path, _, _, _ in models[:3]]
+    tenants = [(name, X.shape[1]) for name, _, _, X, _ in models[:3]]
+    swap_tenant = tenant_specs[0][0]
+    v2_path = os.path.join(tmp, "tenant0_v2")
+    models[0][2].save(v2_path)  # same model re-saved = a new version dir
+
+    router = FleetRouter(port=0, health_interval_s=0.5)
+    fleet = {"replicas": [], "swap_tenant": swap_tenant}
+    try:
+        for _ in range(2):
+            t0 = time.perf_counter()
+            h = router.spawn_replica(tenant_specs, group=True)
+            fleet["replicas"].append({
+                "replica_id": h.replica_id,
+                "url": h.url,
+                "spawn_to_ready_s": round(time.perf_counter() - t0, 2),
+            })
+            print(f"[serving] fleet replica {h.replica_id} ready at {h.url} "
+                  f"({fleet['replicas'][-1]['spawn_to_ready_s']}s)")
+        router.start()
+        clients = max(1, min(2, args.clients))
+
+        # steady window: per-tenant baseline latencies
+        steady = _fleet_traffic(router.url, tenants, args.duration,
+                                clients, args.seed)
+        fleet["steady"] = steady
+
+        # swap window: same traffic, rolling hot-swap of ONE tenant fired
+        # mid-window through the router (drain-aware, one replica at a time)
+        swap_result = {}
+
+        def swapper():
+            time.sleep(args.duration * 0.25)
+            t0 = time.perf_counter()
+            status, lat = _post(
+                f"{router.url}/admin/swap",
+                {"model": swap_tenant, "path": v2_path}, timeout=600.0,
+            )
+            swap_result["status"] = status
+            swap_result["wall_s"] = round(time.perf_counter() - t0, 3)
+
+        swap_thread = threading.Thread(target=swapper, daemon=True)
+        swap_thread.start()
+        during = _fleet_traffic(router.url, tenants, args.duration,
+                                clients, args.seed + 5)
+        swap_thread.join(timeout=600)
+        fleet["during_swap"] = during
+        fleet["swap"] = swap_result
+
+        with urllib.request.urlopen(router.url + "/fleetz", timeout=10) as r:
+            fleet["fleetz"] = json.loads(r.read().decode())
+    finally:
+        fleet["router_stop_clean"] = router.stop(drain_s=10.0)
+
+    # gates: zero 5xx anywhere; unswapped tenants' p99 within 20% of
+    # their steady-state p99 while the swap rolled through the fleet
+    fivexx = sum(s["fivexx"] for s in fleet["steady"].values()) + sum(
+        s["fivexx"] for s in fleet["during_swap"].values()
+    )
+    fleet["fivexx_total"] = fivexx
+    p99_ok = True
+    p99_detail = {}
+    for name, _ in tenants:
+        if name == swap_tenant:
+            continue
+        base = fleet["steady"][name]["p99_ms"]
+        swapped = fleet["during_swap"][name]["p99_ms"]
+        # sub-ms floor: at cpu-CI latencies a 20% band is noise
+        within = swapped <= max(1.2 * base, base + 1.0)
+        p99_detail[name] = {"steady_p99_ms": base, "swap_p99_ms": swapped,
+                            "within_20pct": within}
+        p99_ok = p99_ok and within
+    fleet["gate_zero_5xx"] = fivexx == 0
+    fleet["gate_p99_within_20pct"] = p99_ok
+    fleet["p99_by_tenant"] = p99_detail
+    report["fleet"] = fleet
+    for name, _ in tenants:
+        s, d = fleet["steady"][name], fleet["during_swap"][name]
+        print(f"[serving] fleet tenant {name}: steady "
+              f"{s['throughput_rps']} rps p99={s['p99_ms']}ms | swap-window "
+              f"p99={d['p99_ms']}ms 5xx={s['fivexx'] + d['fivexx']}")
+    print(f"[serving] rolling swap of {swap_tenant}: "
+          f"status={fleet['swap'].get('status')} "
+          f"wall={fleet['swap'].get('wall_s')}s  fleet 5xx={fivexx}")
+
+    out = json.dumps(report, indent=2, default=str)
+    print(out)
+    print("SERVE_FLEET " + json.dumps(report, default=str))
+    if args.json_path:
+        with open(args.json_path, "w") as f:
+            f.write(out)
+
+    failures = []
+    advisories = []
+    if not co["parity_bitwise"]:
+        failures.append("co-resident per-model outputs not bitwise-identical")
+    if not co["gate_speedup_ge_2x"]:
+        msg = (f"co-resident speedup {co['speedup_vs_sequential']}x < 2x "
+               "vs sequential dispatch")
+        (failures if gate_enforced else advisories).append(msg)
+    if fleet["swap"].get("status") != 200:
+        failures.append(
+            f"rolling swap failed: status={fleet['swap'].get('status')}"
+        )
+    if fivexx:
+        failures.append(f"fleet traffic saw {fivexx} 5xx responses")
+    if not all(s["ok"] for s in fleet["steady"].values()):
+        failures.append("a tenant served zero steady-state requests")
+    if not all(s["ok"] for s in fleet["during_swap"].values()):
+        failures.append("a tenant served zero requests during the swap")
+    if not p99_ok:
+        msg = f"unswapped-tenant p99 left the 20% band: {p99_detail}"
+        (failures if gate_enforced else advisories).append(msg)
+    if not fleet["router_stop_clean"]:
+        failures.append("router drain did not complete cleanly")
+    for msg in advisories:
+        print(f"[serving] fleet gate advisory on backend={backend}: {msg} "
+              "(recorded, not enforced)")
+    if failures and args.smoke:
+        print("[serving] FLEET SMOKE FAILED: " + "; ".join(failures),
+              file=sys.stderr)
+        return 1
+    if failures:
+        print("[serving] fleet gates failed: " + "; ".join(failures),
+              file=sys.stderr)
+        return 1
+    print("[serving] fleet OK" + (" (smoke)" if args.smoke else ""))
+    return 0
+
+
+# --------------------------------------------------------------------------
 # main
 # --------------------------------------------------------------------------
 def main(argv=None) -> int:
@@ -571,6 +881,11 @@ def main(argv=None) -> int:
                     help="run the replica cold-to-ready scenario (two "
                          "fresh processes over one jit-cache dir) instead "
                          "of the baseline/overload phases")
+    ap.add_argument("--fleet", action="store_true",
+                    help="run the fleet scenario (ISSUE 13): co-resident "
+                         "super-table vs sequential dispatch, then a "
+                         "router + 2 replica processes sustaining a "
+                         "rolling hot-swap under multi-tenant traffic")
     ap.add_argument("--replica", metavar="MODEL_PATH", default=None,
                     help=argparse.SUPPRESS)  # internal: one replica child
     ap.add_argument("--port", type=int, default=0, help=argparse.SUPPRESS)
@@ -592,7 +907,8 @@ def main(argv=None) -> int:
     obs.enable()
     report = {
         "bench": ("serving-drift" if args.shift
-                  else "serving-cold" if args.cold else "serving"),
+                  else "serving-cold" if args.cold
+                  else "serving-fleet" if args.fleet else "serving"),
         "config": {
             "duration_s": args.duration,
             "clients": args.clients,
@@ -605,6 +921,8 @@ def main(argv=None) -> int:
         return _run_shift(args, tmp, report)
     if args.cold:
         return _run_cold(args, tmp, report)
+    if args.fleet:
+        return _run_fleet(args, tmp, report)
     feature_rng = np.random.default_rng(args.seed + 1)
     v1 = _train_and_save(tmp, args.seed)
     v2 = _train_and_save(tmp, args.seed + 1)

@@ -61,9 +61,22 @@ def load_records(path: str) -> List[dict]:
     return records
 
 
+def _rank_label(rec: dict, fallback: Optional[dict] = None):
+    """Merge key for one record's writing process.  Plain runs keep the
+    integer rank (exact pre-fleet behavior); fleet replicas — which are
+    all rank 0 of their own process — append the ``replica`` tag their
+    records carry, so N same-host replicas aggregate side by side
+    instead of silently folding into one \"rank 0\"."""
+    fb = fallback or {}
+    rank = rec.get("rank", fb.get("rank", 0))
+    rep = rec.get("replica") or fb.get("replica")
+    return f"{rank}.{rep}" if rep else rank
+
+
 def aggregate(records: List[dict]) -> dict:
     """Fold span records into per-name stats; keep the LAST snapshot per
-    rank (the exit-time one supersedes any mid-run export_snapshot)."""
+    rank/replica (the exit-time one supersedes any mid-run
+    export_snapshot)."""
     spans: Dict[str, dict] = {}
     snapshots: Dict[str, dict] = {}
     ranks = set()
@@ -72,7 +85,8 @@ def aggregate(records: List[dict]) -> dict:
         if kind == "span":
             name = rec.get("name", "?")
             dur = float(rec.get("dur_s", 0.0))
-            ranks.add(rec.get("rank", 0))
+            rk = _rank_label(rec)
+            ranks.add(rk)
             agg = spans.get(name)
             if agg is None:
                 agg = spans[name] = {
@@ -84,17 +98,17 @@ def aggregate(records: List[dict]) -> dict:
             agg["count"] += 1
             agg["total_s"] += dur
             agg["max_s"] = max(agg["max_s"], dur)
-            agg["ranks"].add(rec.get("rank", 0))
+            agg["ranks"].add(rk)
         elif kind == "snapshot":
-            rank = rec.get("rank", 0)
-            ranks.add(rank)
-            snapshots[str(rank)] = rec.get("snapshot", {})
+            rk = _rank_label(rec)
+            ranks.add(rk)
+            snapshots[str(rk)] = rec.get("snapshot", {})
     for agg in spans.values():
         agg["mean_s"] = agg["total_s"] / agg["count"]
-        agg["ranks"] = sorted(agg.pop("ranks"))
+        agg["ranks"] = sorted(agg.pop("ranks"), key=str)
     return {
         "span_records": sum(a["count"] for a in spans.values()),
-        "ranks": sorted(ranks),
+        "ranks": sorted(ranks, key=str),
         "spans": spans,
         "snapshots": snapshots,
     }
@@ -213,7 +227,7 @@ def load_blackbox(path: str) -> List[dict]:
                 except (KeyError, TypeError, ValueError):
                     continue
                 events.append({
-                    "rank": rec.get("rank", header.get("rank", 0)),
+                    "rank": _rank_label(rec, header),
                     "wall": wall,
                     "ev": rec.get("ev", "?"),
                     "name": rec.get("name", "?"),
@@ -253,7 +267,7 @@ def _export_events(path: str) -> List[dict]:
         except (KeyError, TypeError, ValueError):
             continue
         events.append({
-            "rank": rec.get("rank", 0),
+            "rank": _rank_label(rec),
             "wall": ts - dur,
             "ev": "span",
             "name": rec.get("name", "?"),
@@ -340,7 +354,7 @@ def build_timeline(paths: List[str], step_span: str = "booster.iteration"
         if not os.path.basename(fn).startswith("blackbox."):
             continue
         for h in _blackbox_anchors(fn):
-            rank = str(h.get("rank", 0))
+            rank = str(_rank_label(h))
             a = anchors.setdefault(
                 rank, {"offset_s": None, "reasons": [], "segments": 0}
             )
@@ -381,7 +395,7 @@ def build_timeline(paths: List[str], step_span: str = "booster.iteration"
 
     return {
         "files": files,
-        "ranks": sorted({e["rank"] for e in events}),
+        "ranks": sorted({e["rank"] for e in events}, key=str),
         "anchors": anchors,
         "events": events,
         "spans": spans,
