@@ -37,6 +37,17 @@ only when the caller attests the program IS multi-controller
 never merely because the job has multiple processes, which would let a
 meshless rank-local train deadlock in a collective no other rank enters.
 
+Elastic resume (r11, ISSUE 14) leans on the topology key: a surviving
+process re-forms a SMALLER mesh over its own devices — e.g. ``(2, 4)``
+across two hosts collapsing to ``(1, 4)`` after a peer dies — while the
+SAME cache directory (often a shared filesystem) still holds the pod-era
+blobs.  ``mesh_trace_key``'s mesh shape + ``pc{process_count}``
+components make those keys disjoint, so the survivor re-exports for its
+new topology instead of replaying a program whose collectives expect
+dead participants; when the pod re-forms at full strength, the original
+blobs hit again unchanged.  Writes are tmp+rename atomic per process,
+so concurrent ranks racing the same digest never tear a reader.
+
 Opt out with ``MMLSPARK_TPU_NO_TRACE_CACHE=1``.  Any failure (old jax,
 unserializable graph, corrupt blob) silently falls back to the jitted
 callable.

@@ -65,6 +65,58 @@ from mmlspark_tpu.ops.binning import BinningAuthority
 DEFAULT_CHUNK_ROWS = 65536
 
 
+def process_shard_source(
+    paths: Sequence[str],
+    label_paths: Optional[Sequence[str]] = None,
+    *,
+    process_count: Optional[int] = None,
+    process_index: Optional[int] = None,
+):
+    """This process's deterministic partition of a global ``data/`` shard
+    list, as an :class:`~mmlspark_tpu.data.loader.NpySource` (ISSUE 14).
+
+    Every process passes the SAME global path list; ownership is a pure
+    function of the sorted list and the current process count
+    (``parallel.elastic.assign_shards`` round-robin), so a run resumed
+    over fewer survivors re-partitions the dead host's shards with no
+    coordination — re-form the mesh (``parallel.mesh.mesh2d``) over the
+    survivors, call this again, and train with the checkpoint as
+    ``init_model``.  The sketch/merge phases then see every row exactly
+    once regardless of the process count.
+
+    The returned source carries ``shard_paths`` — the full per-process
+    assignment (list per process) — which the trainer's checkpoint
+    writer records in the rank-0 shard manifest.
+    """
+    import jax
+
+    from mmlspark_tpu.data.loader import NpySource
+    from mmlspark_tpu.parallel.elastic import assign_shards
+
+    nproc = process_count if process_count is not None else jax.process_count()
+    pidx = process_index if process_index is not None else jax.process_index()
+    order = np.argsort(np.asarray([str(p) for p in paths]))
+    paths = [paths[i] for i in order]
+    if label_paths is not None:
+        if len(label_paths) != len(paths):
+            raise ValueError("label_paths must pair 1:1 with shard paths")
+        label_paths = [label_paths[i] for i in order]
+    groups = assign_shards(paths, nproc)
+    mine = groups[pidx]
+    if not mine:
+        raise ValueError(
+            f"process {pidx} of {nproc} owns no shards ({len(paths)} total); "
+            "write at least one shard per process"
+        )
+    own_labels = (
+        None if label_paths is None
+        else assign_shards(label_paths, nproc)[pidx]
+    )
+    src = NpySource(mine, own_labels)
+    src.shard_paths = groups
+    return src
+
+
 def stream_fit_binning(
     source,
     max_bin: int = 255,
@@ -460,6 +512,9 @@ def train_streaming(
             "streamed training needs labels: the shard source yielded none "
             "(NpySource(label_paths=...) or write_row_group_shards(y=...))"
         )
+    # Propagate the global shard assignment (process_shard_source) so the
+    # trainer's rank-0 checkpoint manifest records who held what.
+    train_set.shard_paths = getattr(source, "shard_paths", None)
     booster = _train(
         params, train_set, valid_sets=valid_sets, valid_names=valid_names,
         bin_mapper=authority.mapper, init_model=init_model, mesh=mesh,

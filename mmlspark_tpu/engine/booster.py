@@ -1229,11 +1229,32 @@ def resolve_auto_config(
                 "default" if cfg.hist_backend == "pallas" else "highest"
             ),
         )
-    if cfg.hist_merge not in ("auto", "allreduce", "reduce_scatter"):
+    if cfg.hist_merge not in (
+        "auto", "allreduce", "reduce_scatter", "hierarchical"
+    ):
         raise ValueError(
-            f"hist_merge must be 'auto', 'allreduce' or 'reduce_scatter', "
-            f"got {cfg.hist_merge!r}"
+            f"hist_merge must be 'auto', 'allreduce', 'reduce_scatter' or "
+            f"'hierarchical', got {cfg.hist_merge!r}"
         )
+    if cfg.hist_merge == "hierarchical":
+        # The 2D-mesh merge only steers the plain data-parallel learner:
+        # voting and feature-parallel own their comm patterns, and the
+        # quantized integer wire under a host-biased election would stack
+        # two approximations (the hierarchical refinement is already the
+        # exact-f32 correction) — reject rather than silently degrade.
+        if cfg.tree_learner in (
+            "voting", "voting_parallel", "feature", "feature_parallel"
+        ):
+            raise ValueError(
+                "hist_merge='hierarchical' requires the data-parallel "
+                f"learner; got tree_learner={cfg.tree_learner!r}"
+            )
+        if cfg.hist_quantize != "off":
+            raise ValueError(
+                "hist_merge='hierarchical' and hist_quantize are mutually "
+                "exclusive: the hierarchical merge already refines winners "
+                "in exact f32, so pick ONE wire-reduction strategy"
+            )
     if cfg.hist_merge == "auto":
         # Reduce-scatter wins whenever there is a mesh to scatter over and
         # enough features that every device owns a non-degenerate slice
@@ -1633,20 +1654,36 @@ def _train_impl(
     # BinMapper.to_dict(), which are data-only.
     ckpt_path = ckpt_txt = None
     requested_total = cfg.num_iterations
+    from_ckpt = False
     if (
         cfg.checkpoint_dir
         and cfg.checkpoint_every > 0
         and cfg.boosting not in ("dart", "rf")
     ):
         import os
-        import pickle
 
         os.makedirs(cfg.checkpoint_dir, exist_ok=True)
         ckpt_path = os.path.join(cfg.checkpoint_dir, "checkpoint.pkl")
         ckpt_txt = os.path.join(cfg.checkpoint_dir, "model.txt")
         if init_model is None and os.path.exists(ckpt_path):
-            with open(ckpt_path, "rb") as f:
-                init_model = pickle.load(f)
+            # Digest-verified load (ISSUE 14 elasticity): a torn, partial,
+            # or bit-rotted snapshot answers None and the run self-heals
+            # by training from scratch — a surviving-host resume must
+            # never die on the artifact the dead host half-wrote.
+            from mmlspark_tpu.parallel.elastic import load_checkpoint
+
+            init_model = load_checkpoint(ckpt_path)
+            if init_model is not None and not hasattr(init_model, "_used_iters"):
+                # digest-valid but wrong payload (operator copied some
+                # other pickle in): same self-healing as corruption
+                warnings.warn(
+                    f"checkpoint {ckpt_path!r} does not hold a Booster "
+                    f"(got {type(init_model).__name__}); training from "
+                    "scratch"
+                )
+                init_model = None
+            from_ckpt = init_model is not None
+        if from_ckpt:
             # Count the trees continuation will actually replay/keep
             # (_used_iters: an early-stopped snapshot contributes only
             # best_iteration+1 trees).
@@ -1689,22 +1726,56 @@ def _train_impl(
                 f"continued training with boosting={cfg.boosting!r} is not supported"
             )
         if bin_mapper is not None and bin_mapper is not init_model.bin_mapper:
-            raise ValueError(
-                "bin_mapper cannot be overridden when init_model is set; "
-                "continuation replays old trees, which pins their thresholds"
-            )
+            # Elastic resume (ISSUE 14): the survivor re-supplies the
+            # shared binning authority while the recovered checkpoint
+            # carries its own unpickled copy — same thresholds, different
+            # object.  Structural equality keeps the continuation safe;
+            # a genuinely different mapper still hard-fails.
+            if not (
+                from_ckpt
+                and bin_mapper.to_dict() == init_model.bin_mapper.to_dict()
+            ):
+                raise ValueError(
+                    "bin_mapper cannot be overridden when init_model is "
+                    "set; continuation replays old trees, which pins "
+                    "their thresholds"
+                )
         # New trees must be replayed over the same thresholds as the old
         # ones (one BinMapper per booster), so continuation pins the mapper.
         bin_mapper = init_model.bin_mapper
 
     # ---- mesh (data-parallel tree learner) -----------------------------
-    if mesh is None and (process_local or cfg.tree_learner in _PARALLEL_LEARNERS):
+    hierarchical_req = cfg.hist_merge == "hierarchical"
+    if mesh is None and hierarchical_req:
+        # 2D (data × feature) pod mesh: hosts on the slow axis, each
+        # host's devices on the fast axis (ISSUE 14).
+        from mmlspark_tpu.parallel.mesh import mesh2d
+
+        mesh = mesh2d()
+    elif mesh is None and (
+        process_local or cfg.tree_learner in _PARALLEL_LEARNERS
+    ):
         from mmlspark_tpu.parallel.mesh import default_mesh
 
         mesh = default_mesh()
-    from mmlspark_tpu.parallel.mesh import DATA_AXIS, mesh_num_devices
+    from mmlspark_tpu.parallel.mesh import (
+        DATA_AXIS,
+        FEATURE_AXIS,
+        ROW_AXES,
+        is_mesh_2d,
+        mesh_axis_size,
+        mesh_num_devices,
+    )
+
+    if hierarchical_req and not is_mesh_2d(mesh):
+        raise ValueError(
+            "hist_merge='hierarchical' needs the 2D (data × feature) mesh "
+            "— build one with parallel.mesh.mesh2d(); got axes "
+            f"{tuple(mesh.axis_names) if mesh is not None else None}"
+        )
 
     D = mesh_num_devices(mesh)
+    d_feat = mesh_axis_size(mesh, FEATURE_AXIS)
 
     if cfg.tree_learner in ("feature", "feature_parallel") and process_local:
         # LightGBM's tree_learner=feature contract (SURVEY.md §2 parallelism
@@ -1831,6 +1902,15 @@ def _train_impl(
         and not feature_par
         and cfg.tree_learner not in ("voting", "voting_parallel")
     )
+    # ---- hierarchical 2D-mesh merge (ISSUE 14) -------------------------
+    # Rows shard over BOTH axes (each device owns n/(H·d) rows); the
+    # windowed merge psum_scatters host-locally over the fast axis, so
+    # the feature axis pads to a multiple of d (the fast-axis size), not
+    # of the full device count.
+    hierarchical = hierarchical_req and mesh is not None
+    # Row sharding spans BOTH mesh axes under hierarchical (each device owns
+    # n/(H·d) rows); everything else shards rows over the 1-D data axis.
+    row_axes = ROW_AXES if hierarchical else DATA_AXIS
     F_real = F
     if feature_par or reduce_scatter:
         # Pad columns to a multiple of the shard count; padded columns are
@@ -1839,6 +1919,11 @@ def _train_impl(
         # time from axis_index (tree.py _local_cat_mask) — right-padding
         # never renumbers real columns, so the global indices stay valid.
         f_pad = (-F) % D
+        if f_pad:
+            bins_np = _pad_cols(bins_np, f_pad)
+            F += f_pad
+    elif hierarchical:
+        f_pad = (-F) % d_feat
         if f_pad:
             bins_np = _pad_cols(bins_np, f_pad)
             F += f_pad
@@ -1962,6 +2047,7 @@ def _train_impl(
     # iteration programs never reshuffle it.
     dev_key = (
         id(bin_mapper), n_pad, _mesh_cache_key(mesh), process_local, feature_par,
+        hierarchical,
     )
     bins_dev = train_set._dev_bins_cache.get(dev_key)
     if feature_par:
@@ -1985,20 +2071,20 @@ def _train_impl(
         from mmlspark_tpu.parallel.distributed import make_global_array
 
         if bins_dev is None:
-            bins_dev = make_global_array(mesh, P(DATA_AXIS, None), bins_np)
-        y_dev = make_global_array(mesh, P(DATA_AXIS), y.astype(np.float32))
+            bins_dev = make_global_array(mesh, P(row_axes, None), bins_np)
+        y_dev = make_global_array(mesh, P(row_axes), y.astype(np.float32))
         w_dev = None if w_np is None else make_global_array(
-            mesh, P(DATA_AXIS), w_np.astype(np.float32)
+            mesh, P(row_axes), w_np.astype(np.float32)
         )
-        valid_mask = make_global_array(mesh, P(DATA_AXIS), valid_mask_np)
-        init_scores_dev = make_global_array(mesh, P(None, DATA_AXIS), init_arr)
+        valid_mask = make_global_array(mesh, P(row_axes), valid_mask_np)
+        init_scores_dev = make_global_array(mesh, P(None, row_axes), init_arr)
     elif mesh is not None:
         from jax.sharding import NamedSharding
         from jax.sharding import PartitionSpec as P
 
-        row_sh = NamedSharding(mesh, P(DATA_AXIS))
-        rowF_sh = NamedSharding(mesh, P(DATA_AXIS, None))
-        krow_sh = NamedSharding(mesh, P(None, DATA_AXIS))
+        row_sh = NamedSharding(mesh, P(row_axes))
+        rowF_sh = NamedSharding(mesh, P(row_axes, None))
+        krow_sh = NamedSharding(mesh, P(None, row_axes))
         if bins_dev is None:
             bins_dev = jax.device_put(bins_np, rowF_sh)
         y_dev = jax.device_put(y.astype(np.float32), row_sh)
@@ -2041,7 +2127,7 @@ def _train_impl(
         grow_policy = "depthwise"
     split_batch = cfg.split_batch
     if (
-        (feature_par or reduce_scatter)
+        (feature_par or reduce_scatter or hierarchical)
         and grow_policy == "lossguide"
         and split_batch == 0
     ):
@@ -2075,7 +2161,11 @@ def _train_impl(
         hist_chunk=chunk,
         hist_precision=cfg.hist_precision,
         hist_psum_dtype=cfg.hist_psum_dtype,
-        hist_merge="reduce_scatter" if reduce_scatter else "allreduce",
+        hist_merge=(
+            "hierarchical" if hierarchical
+            else "reduce_scatter" if reduce_scatter
+            else "allreduce"
+        ),
         hist_quantize=cfg.hist_quantize,
         quantize_shift=quantize_shift,
         grow_policy=grow_policy,
@@ -2175,10 +2265,14 @@ def _train_impl(
         # (global max-abs, computed once pre-shard — no pmax needed).
         q_specs = (P(None, None), P(None, None)) if quantize_on else ()
         grow = shard_map_compat(
-            _grow_classes(dataclasses.replace(gcfg, axis_name=DATA_AXIS)),
+            _grow_classes(dataclasses.replace(
+                gcfg,
+                axis_name=(ROW_AXES if hierarchical else DATA_AXIS),
+                feature_axis_name=(FEATURE_AXIS if hierarchical else None),
+            )),
             mesh=mesh,
-            in_specs=(P(DATA_AXIS, None), P(None, DATA_AXIS), P(None, DATA_AXIS), P(DATA_AXIS), P(None, None)) + q_specs,
-            out_specs=(tree_spec, P(None, DATA_AXIS)),
+            in_specs=(P(row_axes, None), P(None, row_axes), P(None, row_axes), P(row_axes), P(None, None)) + q_specs,
+            out_specs=(tree_spec, P(None, row_axes)),
             check_vma=False,
         )
 
@@ -2299,18 +2393,18 @@ def _train_impl(
             nv_local = (int(vcounts.max()) + d_local - 1) // d_local
             v_pad = nv_local * d_local - vs.num_rows
             vb = make_global_array(
-                mesh, P(DATA_AXIS, None), _pad_rows(vbins_np, v_pad)
+                mesh, P(row_axes, None), _pad_rows(vbins_np, v_pad)
             )
             vy = make_global_array(
-                mesh, P(DATA_AXIS),
+                mesh, P(row_axes),
                 _pad_rows(vs.label, v_pad).astype(np.float32),
             )
             vw = None if vs.weight is None else make_global_array(
-                mesh, P(DATA_AXIS),
+                mesh, P(row_axes),
                 _pad_rows(vs.weight, v_pad).astype(np.float32),
             )
             vvm = make_global_array(
-                mesh, P(DATA_AXIS),
+                mesh, P(row_axes),
                 np.concatenate([np.ones(vs.num_rows, bool), np.zeros(v_pad, bool)]),
             )
             vscore_np = np.broadcast_to(
@@ -2321,7 +2415,7 @@ def _train_impl(
                 vscore_np = vscore_np + _pad_rows(
                     vs.init_score.astype(np.float32), v_pad
                 ).reshape(1, -1)
-            vscore = make_global_array(mesh, P(None, DATA_AXIS), vscore_np)
+            vscore = make_global_array(mesh, P(None, row_axes), vscore_np)
             if init_model is not None:
                 vscore = vscore + init_model._raw_scores_binned(vb)
             vsets.append({
@@ -2508,7 +2602,26 @@ def _train_impl(
     key_start = init_model._used_iters(None) if init_model is not None else 0
     total_keyed = key_start + cfg.num_iterations
     root_key = jax.random.PRNGKey(cfg.bagging_seed + 7919 * cfg.seed)
-    all_keys = np.asarray(jax.random.split(root_key, 2 * total_keyed))
+    # Keys are derived from the ABSOLUTE iteration index via fold_in, NOT
+    # by position in a split(root_key, 2*total) table: jax.random.split
+    # has no prefix property, so every entry of such a table changes with
+    # the REQUESTED total — a 4-iteration run then a resume-to-8 drew
+    # different bags/feature masks than one straight 8-iteration run,
+    # breaking the checkpoint-resume bitwise contract (ISSUE 14).
+    # fold_in(root_key, i) depends only on (seed, i); the bag stream rides
+    # a fold_in-tagged sibling root so it stays decoupled from the
+    # grower/feature-sampling stream exactly as before.
+    _abs_idx = jnp.arange(total_keyed, dtype=jnp.uint32)
+    iter_keys_all = np.asarray(
+        jax.vmap(lambda i: jax.random.fold_in(root_key, i))(_abs_idx)
+    )
+    bag_keys_all = np.asarray(
+        jax.vmap(
+            lambda i: jax.random.fold_in(
+                jax.random.fold_in(root_key, 0x00BA66ED), i
+            )
+        )(_abs_idx)
+    )
 
     # DART in the scan: the drop decisions consume only HOST RNG (never
     # data), so the whole schedule is precomputed as a (T, T) mask with the
@@ -2565,10 +2678,10 @@ def _train_impl(
             # forest's.
             global_it = np.arange(key_start, total_keyed)
             draw_at = (global_it // cfg.bagging_freq) * cfg.bagging_freq
-            bag_keys = all_keys[total_keyed + draw_at]
+            bag_keys = bag_keys_all[draw_at]
         else:
-            bag_keys = np.zeros((n_iter, 2), dtype=all_keys.dtype)
-        iter_keys = all_keys[key_start:total_keyed]
+            bag_keys = np.zeros((n_iter, 2), dtype=iter_keys_all.dtype)
+        iter_keys = iter_keys_all[key_start:total_keyed]
 
         vbins_t = tuple(vs["bins"] for vs in vsets)
         vaux_t = (
@@ -2843,15 +2956,15 @@ def _train_impl(
 
         def _write_snapshot(booster_snap):
             import os
-            import pickle
+
+            from mmlspark_tpu.parallel import elastic
 
             if process_local and jax.process_index() != 0:
                 return  # every process holds the same replicated model
 
-            tmp = ckpt_path + ".tmp"
-            with open(tmp, "wb") as f:
-                pickle.dump(booster_snap, f)
-            os.replace(tmp, ckpt_path)
+            # Atomic pickle + sha256 sidecar: resume verifies the digest
+            # and self-heals (fresh start) on a torn/corrupt snapshot.
+            elastic.write_checkpoint(ckpt_path, booster_snap)
             tmp = ckpt_txt + ".tmp"
             with open(tmp, "w") as f:
                 f.write(
@@ -2860,6 +2973,22 @@ def _train_impl(
                     )
                 )
             os.replace(tmp, ckpt_txt)
+            # Rank-0 shard manifest: which process held which data shards
+            # at snapshot time (advisory — resume re-derives ownership
+            # from the CURRENT process count, see parallel/elastic.py).
+            shard_paths = getattr(train_set, "shard_paths", None)
+            elastic.write_manifest(
+                cfg.checkpoint_dir,
+                elastic.ShardManifest(
+                    process_count=jax.process_count(),
+                    iterations_done=int(booster_snap.num_iterations),
+                    shards=(
+                        [list(map(str, g)) for g in shard_paths]
+                        if shard_paths else
+                        [[] for _ in range(jax.process_count())]
+                    ),
+                ),
+            )
 
         def _write_checkpoint(new_chunk):
             # Each chunk is fetched from device ONCE and kept host-side;
@@ -3085,9 +3214,9 @@ def _train_impl(
         _legacy_stats = [_make_stats_fn(vs["evaluators"]) for vs in vsets]
     for it in range(cfg.num_iterations):
         t_it = time.perf_counter()
-        sub = all_keys[it]
+        sub = iter_keys_all[it]
         if do_bagging and it % cfg.bagging_freq == 0:
-            current_bag = resample_bag(all_keys[cfg.num_iterations + it], valid_mask)
+            current_bag = resample_bag(bag_keys_all[it], valid_mask)
         # drop set from the shared precomputed schedule (same RNG stream
         # as the scan path — see _dart_drop_schedule)
         dropped_idx: List[int] = (

@@ -100,7 +100,19 @@ class GrowConfig:
     # right-pads columns and masks the pads out of every candidate
     # search).  Ignored under voting/feature-parallel, which never
     # allreduce full histograms in the first place.
+    # "hierarchical" (ISSUE 14): 2D pod-mesh merge — ``axis_name`` is the
+    # (slow, fast) tuple, the windowed merge psum_scatters over the FAST
+    # intra-host axis only (host-local feature slices), candidates are
+    # elected from the host-local statistics, and every pass's winners get
+    # the exact f32 refinement re-accumulation over the FULL mesh — so
+    # only the (D, 5, L) winner exchange and the winning columns'
+    # (3, W, 1, B) refinement cross the slow inter-host axis.  Split
+    # SELECTION is host-biased (like voting's local vote) but recorded
+    # thresholds/gains/memberships are globally exact.
     hist_merge: str = "allreduce"
+    # The fast intra-host axis of the 2D mesh; set (with the tuple
+    # ``axis_name``) only under hist_merge="hierarchical".
+    feature_axis_name: Optional[str] = None
     grow_policy: str = "lossguide"  # lossguide (LightGBM-exact) | depthwise
     # Categorical membership splits (LightGBM's sorted-category algorithm —
     # SURVEY.md §7.4.5; defaults are LightGBM's cat_smooth/cat_l2/
@@ -206,6 +218,34 @@ class GrowConfig:
             and self.axis_name is not None
             and not self.voting
             and not self.feature_parallel
+        )
+
+    @property
+    def hierarchical_active(self) -> bool:
+        """2D-mesh hierarchical merge (ISSUE 14): ``axis_name`` carries the
+        (slow, fast) tuple and ``feature_axis_name`` the fast axis."""
+        return (
+            self.hist_merge == "hierarchical"
+            and self.axis_name is not None
+            and self.feature_axis_name is not None
+            and not self.voting
+            and not self.feature_parallel
+        )
+
+    @property
+    def refine_active(self) -> bool:
+        """The f32 winner-refinement pass: always on for quantized training
+        (re-scores quantized winners exactly) and under the hierarchical
+        merge (host-local election needs exact global thresholds/gains)."""
+        return self.quantize_active or self.hierarchical_active
+
+    @property
+    def feature_shard_axis(self):
+        """The axis features are sliced over: the fast axis under the
+        hierarchical merge, the whole (1-D) mesh axis otherwise."""
+        return (
+            self.feature_axis_name if self.hierarchical_active
+            else self.axis_name
         )
 
     @property
@@ -649,9 +689,11 @@ def _local_cat_mask(cfg: GrowConfig, F_local: int):
     program cannot specialize statically per shard — so the mask is
     computed from ``lax.axis_index`` at run time: local column j is global
     ``shard·F_local + j``, compared against the static set (a handful of
-    traced equality ops, no extra operand threading).
+    traced equality ops, no extra operand threading).  Under the
+    hierarchical merge the slicing axis is the FAST one (feature blocks
+    repeat identically on every host).
     """
-    shard = lax.axis_index(cfg.axis_name)
+    shard = lax.axis_index(cfg.feature_shard_axis)
     gids = shard * F_local + jnp.arange(F_local, dtype=jnp.int32)
     m = jnp.zeros(F_local, bool)
     for c in cfg.categorical_features:
@@ -707,14 +749,28 @@ def _exchange_best(cfg: GrowConfig, gain_l, f_l, t_l, d_l, ic_l, F_block):
     Returns (gain, f_global, t, dleft, is_cat, own, f_local): ``own``
     marks the leaves whose winning feature lives on THIS shard and
     ``f_local`` is its local column there (clipped garbage elsewhere).
+
+    Under the hierarchical merge (2D mesh) the gather spans the FULL
+    flattened mesh — every (host, feature-slice) cell proposes its best
+    from host-local statistics and the highest gain anywhere wins (the
+    ISSUE 14 hierarchical election: this (D, 5, L) exchange is the only
+    per-pass collective crossing the slow axis besides the winners'
+    refinement columns).  Global feature ids come from the FEATURE-axis
+    index (feature slices repeat across hosts), while ``own`` keys on the
+    flattened cell index so exactly one device owns each winner.
     """
     from mmlspark_tpu.parallel.distributed import device_all_gather
 
     ax = cfg.axis_name
-    shard = lax.axis_index(ax)
+    if cfg.hierarchical_active:
+        f_shard = lax.axis_index(cfg.feature_axis_name)
+        # flattened cell index: gather order is axis-tuple major-to-minor
+        shard = lax.axis_index(ax[0]) * lax.psum(1, ax[1]) + f_shard
+    else:
+        f_shard = shard = lax.axis_index(ax)
     cand = jnp.stack([
         gain_l,
-        (f_l + shard * F_block).astype(jnp.float32),  # global feature id
+        (f_l + f_shard * F_block).astype(jnp.float32),  # global feature id
         t_l.astype(jnp.float32),
         d_l.astype(jnp.float32),
         ic_l.astype(jnp.float32),
@@ -731,7 +787,7 @@ def _exchange_best(cfg: GrowConfig, gain_l, f_l, t_l, d_l, ic_l, F_block):
     dleft = take_s(3) > 0.5
     is_cat = take_s(4) > 0.5
     own = win_shard == shard  # (L,) leaf's winner lives here
-    f_local = jnp.clip(f - shard * F_block, 0, F_block - 1)
+    f_local = jnp.clip(f - f_shard * F_block, 0, F_block - 1)
     return gain, f, t, dleft, is_cat, own, f_local
 
 
@@ -846,6 +902,7 @@ def grow_tree(
                 backend=cfg.hist_backend, chunk=cfg.hist_chunk,
                 axis_name=cfg.axis_name, psum_dtype="float32",
                 precision=cfg.hist_precision, transposed=True,
+                merge="allreduce_exact",  # recorded gains: layout-invariant
             )[:, None]  # (3, 1, 1, B)
             ref_col = ref[:, 0, 0]  # (3, B) exact winner column
             ref_stats = ref_col.sum(axis=-1)[:, None]  # (3, 1)
@@ -907,7 +964,9 @@ def grow_tree(
             )
         )(vals)  # (3, L)
         if cfg.axis_name is not None:
-            leaf_stats = lax.psum(leaf_stats, cfg.axis_name)
+            from mmlspark_tpu.parallel.distributed import psum_axes
+
+            leaf_stats = psum_axes(leaf_stats, cfg.axis_name)
     leaf_value = _leaf_output(
         leaf_stats[0], leaf_stats[1], cfg.lambda_l1, cfg.lambda_l2, cfg.learning_rate
     )
@@ -976,6 +1035,17 @@ def grow_tree_depthwise(
         else cfg.axis_name
     )
     rs = cfg.reduce_scatter_active
+    # Hierarchical (ISSUE 14): the windowed merge scatters over the FAST
+    # intra-host axis only (hist_axis is the (slow, fast) tuple; the merge
+    # routes the scatter to its last element), so the carried buffer holds
+    # HOST-LOCAL feature slices.  Election below is host-biased; the
+    # always-on refinement pass restores global exactness for the winners.
+    hier = cfg.hierarchical_active
+    featsliced = rs or hier
+    merge_mode = (
+        "hierarchical" if hier
+        else ("reduce_scatter" if rs else "allreduce")
+    )
     if cfg.quantize_active:
         # ISSUE 9 quantized path (see grow_tree): one SR quantization per
         # tree; the windowed builder accumulates int32, merges over the
@@ -998,7 +1068,7 @@ def grow_tree_depthwise(
             backend=cfg.hist_backend, chunk=cfg.hist_chunk, axis_name=hist_axis,
             psum_dtype=cfg.hist_psum_dtype,
             precision=cfg.hist_precision, transposed=True,
-            merge="reduce_scatter" if rs else "allreduce",
+            merge=merge_mode,
             quantize=hq,
         )
 
@@ -1014,10 +1084,14 @@ def grow_tree_depthwise(
     F_loc = root_hist.shape[1]
     hists0 = jnp.zeros((3, LB, F_loc, B), jnp.float32).at[:, 0].set(root_hist)
 
-    if rs:
+    if featsliced:
         from mmlspark_tpu.parallel.distributed import device_psum
 
-        rs_shard = lax.axis_index(cfg.axis_name)
+        # Feature slices live along the fast axis under hierarchical (the
+        # same block layout on every host), the whole mesh axis under
+        # reduce_scatter.
+        stats_axis = cfg.feature_shard_axis
+        rs_shard = lax.axis_index(stats_axis)
         # This shard's slice of the global feature mask + the runtime
         # categorical mask of its column block (global indices cannot be
         # specialized statically per shard in one SPMD program).
@@ -1036,9 +1110,13 @@ def grow_tree_depthwise(
             # gains round the same way (a per-shard local feature's bin-sum
             # or a rows segment-sum would each round DIFFERENTLY, visibly
             # reordering lossguide's gain-ranked split sequence).
+            # Hierarchical: the psum stays on the FAST axis, so these are
+            # HOST-LOCAL totals — identical across a host's devices, which
+            # is all the host-biased election needs; the refinement pass
+            # re-derives exact global stats for every winner.
             s = h[:, :, 0, :].sum(axis=-1)  # (3, nL) on shard 0
             return device_psum(
-                jnp.where(rs_shard == 0, s, 0.0), cfg.axis_name
+                jnp.where(rs_shard == 0, s, 0.0), stats_axis
             )
 
     # Incremental candidate cache (serial + data-parallel paths): only the
@@ -1052,7 +1130,7 @@ def grow_tree_depthwise(
     # keeps the cache — its matrices are (L, F_loc) local slices reduced
     # per shard and exchanged per pass.
     use_cand_cache = not (cfg.voting_active or cfg.feature_parallel_active)
-    if use_cand_cache and rs:
+    if use_cand_cache and featsliced:
         stats0 = _global_leaf_stats(hists0[:, :L])
         cand0 = _local_candidate_matrix(
             cfg, hists0[:, :L], stats0, fm_loc, cmask_loc
@@ -1094,12 +1172,15 @@ def grow_tree_depthwise(
             # feature 0's bins tile all rows → per-leaf totals
             leaf_stats = hists[:, :L, 0, :].sum(axis=-1)  # (3, L)
         if use_cand_cache:
-            if rs:
+            if featsliced:
                 # Local reduce over this shard's feature slice, then the
                 # winner exchange: the only per-pass collectives are the
                 # windowed reduce-scatter merge, the (D, 5, L) candidate
                 # all-gather, and the tiny leaf-stat psum — vs the full
                 # (3, W, F, B) allreduce of hist_merge="allreduce".
+                # Hierarchical: the scatter + leaf-stat psum ride the fast
+                # intra-host axis; ONLY the (D, 5, L) all-gather (and the
+                # refinement below) cross the slow axis.
                 gain_l, f_l, t_l, d_l, ic_l = _reduce_local_candidates(
                     gain_m, t_m, d_m, cmask_loc
                 )
@@ -1159,19 +1240,24 @@ def grow_tree_depthwise(
         base = step + 1  # first new id this level
         slot_leaves = order[:W].astype(jnp.int32)  # gain-ranked slots
 
-        # -- f32 winner refinement (ISSUE 9, quantized path) --------------
-        if cfg.quantize_active:
-            # Quantized histograms picked the level's ≤W winners; ONE
-            # windowed f32 pass re-accumulates just their winning COLUMNS
-            # (composed into a single per-row column: each row reads its
-            # own leaf's winning feature) and re-scores them exactly, so
-            # recorded thresholds/gains and the membership sets below
-            # carry no quantization error.  Rides the same small-allreduce
-            # structure as the membership owner-broadcast: (3, W, 1, B) ≪
-            # the full (3, W, F, B) quantized pass — and replicates the
-            # whole winner column even when the quantized merge itself
-            # runs reduce_scatter (rows are sharded, features are not, so
-            # every shard holds every column locally).
+        # -- f32 winner refinement (ISSUE 9 quantized path; ISSUE 14
+        # hierarchical merge) ---------------------------------------------
+        if cfg.refine_active:
+            # Approximate statistics picked the level's ≤W winners
+            # (quantized histograms, or the hierarchical merge's
+            # host-local slices); ONE windowed f32 pass re-accumulates
+            # just their winning COLUMNS (composed into a single per-row
+            # column: each row reads its own leaf's winning feature) and
+            # re-scores them exactly, so recorded thresholds/gains and
+            # the membership sets below carry no quantization or
+            # host-bias error.  Rides the same small-allreduce structure
+            # as the membership owner-broadcast: (3, W, 1, B) ≪ the full
+            # (3, W, F, B) pass — and replicates the whole winner column
+            # even when the merge itself scatters (rows are sharded,
+            # features are not, so every shard holds every column
+            # locally).  Under hierarchical this allreduce spans the FULL
+            # (slow × fast) mesh: it is, with the winner exchange, the
+            # only inter-host traffic of the pass.
             win_col = jnp.zeros(n, jnp.int32)
             for w in range(W):
                 l_w = slot_leaves[w]
@@ -1189,7 +1275,11 @@ def grow_tree_depthwise(
                 backend=cfg.hist_backend, chunk=cfg.hist_chunk,
                 axis_name=hist_axis, psum_dtype="float32",
                 precision=cfg.hist_precision, transposed=True,
-                merge="allreduce",
+                # exact AND process-layout-invariant: the refined
+                # gains/thresholds are recorded in the model, so their
+                # f32 sum order must not depend on how many processes
+                # the mesh spans (multihost bitwise-parity gate)
+                merge="allreduce_exact",
             )  # (3, W, 1, B) exact winner columns
             stats_w = ref_hist[:, :, 0, :].sum(axis=-1)  # (3, W)
             rg, rt, rd = _refine_candidates(
@@ -1206,7 +1296,7 @@ def grow_tree_depthwise(
 
         # -- categorical membership sets for the level's winners ----------
         if cfg.has_categoricals:
-            if cfg.quantize_active:
+            if cfg.refine_active:
                 # The refined f32 columns already hold GLOBAL statistics
                 # for every selected leaf (allreduce merge above): no
                 # owner psum, and the membership scan runs on exact
@@ -1327,7 +1417,7 @@ def grow_tree_depthwise(
             child_ids = jnp.where(warange < k, base + warange, LB)
             changed = jnp.concatenate([parent_ids, child_ids])  # (2W,)
             h_ch = jnp.take(hists, jnp.minimum(changed, LB - 1), axis=1)
-            if rs:
+            if featsliced:
                 # Shard-identical per-leaf totals from the merged slices
                 # (see _global_leaf_stats); parked slots clip to garbage
                 # the mode="drop" scatter below discards.
@@ -1397,8 +1487,13 @@ def grow_tree_depthwise(
         )(vals)  # (3, L)
     if cfg.axis_name is not None and not cfg.feature_parallel_active:
         # Row-sharded modes sum partial stats; feature-parallel replicates
-        # rows, so the local sum is already the global sum.
-        leaf_stats = lax.psum(leaf_stats, cfg.axis_name)
+        # rows, so the local sum is already the global sum.  psum_axes
+        # gathers the partials and sums them in fixed program order so
+        # the f32 result is process-layout-invariant on the 2D mesh
+        # (multihost bitwise parity gate).
+        from mmlspark_tpu.parallel.distributed import psum_axes
+
+        leaf_stats = psum_axes(leaf_stats, cfg.axis_name)
     leaf_value = _leaf_output(
         leaf_stats[0], leaf_stats[1], cfg.lambda_l1, cfg.lambda_l2,
         cfg.learning_rate,
@@ -1428,6 +1523,7 @@ def grow_tree_auto(cfg: GrowConfig, *args):
         or cfg.split_batch > 0
         or cfg.feature_parallel_active
         or cfg.reduce_scatter_active
+        or cfg.hierarchical_active
     ):
         return grow_tree_depthwise(cfg, *args)
     return grow_tree(cfg, *args)

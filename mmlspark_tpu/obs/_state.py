@@ -17,6 +17,7 @@ enabled: bool = False
 
 # Resolved lazily (jax may not be importable/initialized at obs import).
 _rank: Optional[int] = None
+_jax_rank: Optional[int] = None
 
 
 def process_index() -> int:
@@ -49,6 +50,25 @@ def _resolve_rank() -> int:
         except Exception:
             return 0
     return 0
+
+
+def jax_process_index() -> Optional[int]:
+    """jax's OWN view of this process's index, for stamping alongside the
+    launcher rank on export/blackbox records (ISSUE 14 satellite): the
+    coordinator may renumber processes, so a real multi-process run can
+    have ``rank`` (launcher env) and ``process_index`` (jax) disagree —
+    tools/obs disambiguates records on the pair.  None before jax is
+    imported/brought up; cached once resolved (``reset_rank_cache``
+    re-resolves after ``initialize_distributed``)."""
+    global _jax_rank
+    if _jax_rank is None and "jax" in sys.modules:
+        try:
+            import jax
+
+            _jax_rank = int(jax.process_index())
+        except Exception:
+            return None
+    return _jax_rank
 
 
 def process_count_hint() -> int:
@@ -101,5 +121,6 @@ def file_suffix() -> str:
 
 
 def reset_rank_cache() -> None:
-    global _rank
+    global _rank, _jax_rank
     _rank = None
+    _jax_rank = None

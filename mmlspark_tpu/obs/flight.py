@@ -222,9 +222,13 @@ def dump(reason: str, directory: Optional[str] = None) -> Optional[str]:
         events.sort(key=lambda e: e[0])
         rank = _state.process_index()
         rid = _state.replica_id()
+        pi = _state.jax_process_index()
         header = {
             "kind": "flight_header",
             "rank": rank,
+            # jax's own index rides alongside the launcher rank so the
+            # timeline merge can split records when the two disagree
+            **({"process_index": pi} if pi is not None else {}),
             **({"replica": rid} if rid is not None else {}),
             "reason": reason,
             # Paired wall/monotonic anchor: wall(ev) = ts - (mono_ns - t_ns)/1e9

@@ -193,6 +193,12 @@ class _Exporter:
             rid = _state.replica_id()
             if rid is not None and "replica" not in rec:
                 rec["replica"] = rid  # fleet merge key (tools/obs)
+            # jax's process index alongside the launcher rank: tools/obs
+            # disambiguates real multi-process records on the pair when
+            # the coordinator renumbered (ISSUE 14 satellite).
+            pi = _state.jax_process_index()
+            if pi is not None and "process_index" not in rec:
+                rec["process_index"] = pi
             line = json.dumps(rec, separators=(",", ":"), default=str)
             with self._lock:
                 self._open().write(line + "\n")

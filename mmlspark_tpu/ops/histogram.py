@@ -162,19 +162,50 @@ def merge_shard_histograms(
       global best, cutting received bytes per device per pass from
       ``3·F·B`` floats to ``3·F·B/D``.  The ``feature_axis`` size must be
       a multiple of the mesh axis size (the booster right-pads columns).
+    - ``"hierarchical"`` (ISSUE 14, 2D pod mesh): ``axis_name`` is the
+      ``(slow, fast)`` axis tuple and the merge psum_scatters over the
+      FAST intra-host axis ONLY — each device receives its host's merged
+      ``F/d`` feature slice without a single byte crossing the slow
+      inter-host axis.  The grower then elects candidates from the
+      host-local slices and sends only the tiny winner exchange plus the
+      winning columns' exact refinement over the full mesh (engine/tree
+      ``_exchange_best`` + the f32 refinement pass), shrinking inter-host
+      bytes by ~the feature-shard factor versus a flat merge.
 
-    ``psum_dtype="bfloat16"`` halves the wire for either strategy: local
+    - ``"allreduce_exact"``: allreduce semantics with a BITWISE
+      process-layout-invariant f32 sum (per-axis all_gather + fixed-order
+      local reduce, :func:`~mmlspark_tpu.parallel.distributed.device_psum_exact`).
+      Costs a host-count wire amplification on the slow axis, so it is
+      reserved for the tiny winner-refinement columns whose values are
+      recorded in the model (the multihost bitwise-parity gate,
+      tools/multihost_smoke.py).
+
+    ``psum_dtype="bfloat16"`` halves the wire for any strategy: local
     f32 partial sums are cast down for the cross-shard reduction only.
-    Both delegate to the watchdog-wrapped device collectives in
+    All delegate to the watchdog-wrapped device collectives in
     :mod:`mmlspark_tpu.parallel.distributed`, so call counts and received
-    bytes land in the obs ``collective.*`` ledger.
+    bytes land in the obs ``collective.*`` ledger (split per axis tier
+    under ``collective.axis_bytes``).
     """
     from mmlspark_tpu.parallel.distributed import (
         device_psum,
+        device_psum_exact,
         device_psum_scatter,
     )
 
-    if merge == "reduce_scatter":
+    if merge == "hierarchical":
+        if not isinstance(axis_name, (tuple, list)) or len(axis_name) < 2:
+            raise ValueError(
+                "hierarchical merge needs the (slow, fast) axis tuple of "
+                f"the 2D mesh, got axis_name={axis_name!r}"
+            )
+        op = functools.partial(
+            device_psum_scatter,
+            axis_name=axis_name[-1],  # fast intra-host axis only
+            scatter_dimension=feature_axis,
+            tiled=True,
+        )
+    elif merge == "reduce_scatter":
         op = functools.partial(
             device_psum_scatter,
             axis_name=axis_name,
@@ -183,9 +214,12 @@ def merge_shard_histograms(
         )
     elif merge == "allreduce":
         op = functools.partial(device_psum, axis_name=axis_name)
+    elif merge == "allreduce_exact":
+        op = functools.partial(device_psum_exact, axis_name=axis_name)
     else:
         raise ValueError(
-            f"unknown hist_merge {merge!r}; expected allreduce|reduce_scatter"
+            f"unknown hist_merge {merge!r}; expected "
+            "allreduce|allreduce_exact|reduce_scatter|hierarchical"
         )
     if psum_dtype == "bfloat16":
         # halve the wire: per-shard sums stay f32; only the cross-shard
@@ -236,9 +270,16 @@ def merge_shard_histograms_quantized(
             scatter_dimension=feature_axis,
             tiled=True,
         )
-    elif merge == "allreduce":
+    elif merge in ("allreduce", "allreduce_exact"):
+        # integer sums are associative-exact, so the "exact" variant is
+        # the plain integer allreduce — no gather amplification needed
         op = functools.partial(device_psum_int, axis_name=axis_name)
     else:
+        # hierarchical quantized merges are rejected up front: the
+        # hierarchical grower's election runs on HOST-LOCAL statistics and
+        # its refinement pass is already exact f32, so an integer wire
+        # underneath would compound two approximations (resolve_auto_config
+        # forbids the config combination before training starts).
         raise ValueError(
             f"unknown hist_merge {merge!r}; expected allreduce|reduce_scatter"
         )

@@ -36,6 +36,55 @@ class BarrierContext:
 _ENV_COORD = "MMLSPARK_TPU_COORDINATOR"
 _ENV_NPROC = "MMLSPARK_TPU_NUM_PROCESSES"
 _ENV_PID = "MMLSPARK_TPU_PROCESS_ID"
+_ENV_LOCAL_DEVICES = "MMLSPARK_TPU_LOCAL_DEVICES"
+
+
+def ensure_local_device_count(n: int) -> None:
+    """Pin THIS process's device visibility to ``n`` virtual CPU devices.
+
+    The multi-host smoke topology (2 real processes × N virtual CPU
+    devices each) needs every process to expose the same local device
+    count BEFORE jax initializes its backends — afterwards the flag is
+    inert.  Idempotent; appends to ``XLA_FLAGS`` rather than clobbering
+    whatever collective-timeout flags the harness already set.
+    """
+    flag = f"--xla_force_host_platform_device_count={n}"
+    cur = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in cur:
+        os.environ["XLA_FLAGS"] = (cur + " " + flag).strip()
+
+
+def barrier_context_from_cli(argv=None) -> Optional[BarrierContext]:
+    """CLI twin of :func:`barrier_context_from_env` for launcher scripts
+    (``--coordinator host:port --num-processes N --process-id I
+    [--local-devices D]``).  Unrecognized arguments are ignored so runners
+    can mix their own flags in; returns None when no coordinator was given
+    (single-process).  ``--local-devices`` additionally pins per-process
+    device visibility (see :func:`ensure_local_device_count`).
+    """
+    import argparse
+    import sys
+
+    p = argparse.ArgumentParser(add_help=False)
+    p.add_argument("--coordinator", default=None)
+    p.add_argument("--num-processes", type=int, default=1)
+    p.add_argument("--process-id", type=int, default=0)
+    p.add_argument("--local-devices", type=int, default=0)
+    ns, _ = p.parse_known_args(
+        list(sys.argv[1:] if argv is None else argv)
+    )
+    n_local = ns.local_devices or int(
+        os.environ.get(_ENV_LOCAL_DEVICES, "0")
+    )
+    if n_local:
+        ensure_local_device_count(n_local)
+    if not ns.coordinator:
+        return barrier_context_from_env()
+    return BarrierContext(
+        coordinator_address=ns.coordinator,
+        num_processes=ns.num_processes,
+        process_id=ns.process_id,
+    )
 
 
 def barrier_context_from_env() -> Optional[BarrierContext]:
@@ -84,6 +133,15 @@ def initialize_distributed(
         # Single process (or TPU-pod auto-detection handled by jax itself on
         # Cloud TPU VMs). Nothing to rendezvous.
         return False
+    # CPU pods (the 2-real-process smoke topology): the CPU backend only
+    # runs cross-process computations over its gloo collectives layer,
+    # which must be selected BEFORE the backend initializes.  Harmless on
+    # TPU (the knob only affects the CPU client); a no-op when this jax
+    # build predates the option or a backend is already up.
+    try:
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    except Exception:
+        pass
     jax.distributed.initialize(
         coordinator_address=ctx.coordinator_address,
         num_processes=ctx.num_processes,
@@ -91,6 +149,19 @@ def initialize_distributed(
         initialization_timeout=timeout_s,
     )
     _initialized = True
+    # Re-anchor obs rank stamping (ISSUE 14 satellite): anything recorded
+    # BEFORE bring-up resolved (and cached) rank 0 on every process; stamp
+    # the launcher env and drop the cache so per-process export/blackbox
+    # files split correctly from here on.
+    import os as _os
+
+    _os.environ.setdefault("MMLSPARK_TPU_PROCESS_ID", str(ctx.process_id))
+    _os.environ.setdefault(
+        "MMLSPARK_TPU_NUM_PROCESSES", str(ctx.num_processes)
+    )
+    from mmlspark_tpu.obs import _state as _obs_state
+
+    _obs_state.reset_rank_cache()
     return True
 
 
@@ -152,19 +223,124 @@ def _leaf_nbytes(x) -> int:
 # each device RECEIVES per execution of that site (psum: the full reduced
 # array; reduce_scatter: the 1/D slice; all_gather: the D-fold result) —
 # i.e. per-pass wire volume, the quantity the MULTICHIP comms ledger and
-# ``python -m tools.obs report`` track.  The analyzer's COL004 rule points
-# full-histogram ``lax.psum`` call sites at these helpers.
+# ``python -m tools.obs report`` track.  Each wrapper additionally emits a
+# ``collective.axis_bytes`` counter labeled by op AND axis scope
+# (:func:`axis_scope`), the per-axis split of the ledger: "intra" bytes
+# never leave a host on the 2D mesh, "inter" bytes cross the slow axis.
+# The analyzer's COL004 rule points full-histogram ``lax.psum`` call sites
+# at these helpers; COL007 flags full-histogram operands whose axis
+# argument crosses the inter-host axis.
 # ---------------------------------------------------------------------------
 
 
+def axis_scope(axis_name) -> str:
+    """Classify a collective's mesh-axis argument by link tier.
+
+    Modeled topology of :func:`mmlspark_tpu.parallel.mesh.mesh2d` (so the
+    ledger's split is meaningful on virtual CPU meshes too): the
+    ``"feature"`` axis connects devices WITHIN one host ("intra" — fast
+    ICI), while any axis set naming ``"data"`` spans hosts ("inter" —
+    slow DCN on a real pod; a flat 1-D "data" mesh's collectives are all
+    inter-host under this model, which is exactly the flat-vs-hierarchical
+    comparison the MULTICHIP ledger records).
+    """
+    from mmlspark_tpu.parallel.mesh import DATA_AXIS
+
+    axes = (
+        tuple(axis_name) if isinstance(axis_name, (tuple, list))
+        else (axis_name,)
+    )
+    return "inter" if DATA_AXIS in axes else "intra"
+
+
+def psum_axes(x, axis_name):
+    """Cross-layout bitwise-deterministic ``psum`` over tuple mesh axes.
+
+    ``lax.psum(x, ("data", "feature"))`` on a float operand leaves the
+    summation order to the runtime, and the order differs between a
+    single-process mesh and a real multi-process pod (measured: a
+    (3, L) f32 all-reduce over a (2, 4) mesh lands on different
+    last-ulp sums under in-process XLA vs the distributed runtime —
+    and decomposing per-axis does NOT fix it, the intra-host grouping
+    itself shifts with the process layout).  The same logical program
+    would then produce different models, sinking the bitwise parity
+    the multi-controller contract promises (tools/multihost_smoke.py).
+
+    The only layout-invariant pieces are (a) data movement — a gather
+    is bit-exact however the wire chunks it — and (b) local arithmetic,
+    which compiles identically on every process.  So: per axis, FAST
+    (intra-host) axis first, ``all_gather`` the partials (device order
+    is the mesh order on every layout) and reduce them locally in the
+    program's fixed order.  The fast step is intra-host wire; the slow
+    step then gathers ONE already-reduced partial per host, so the
+    inter-host amplification over a true all-reduce is only the host
+    count.  Still costlier than a real reduce, so reserve this for
+    SMALL operands on correctness-critical paths (per-leaf stat
+    totals, winner refinement columns — a few KB); bulk histograms
+    keep the real reduce collectives.  Integer operands and single
+    axes stay on ``lax.psum`` (exact / already order-free).  No
+    watchdog or byte accounting: this is the pure in-kernel primitive
+    (see :func:`device_psum_exact` for the ledgered twin).
+    """
+    import jax.numpy as jnp
+    from jax import lax
+
+    if (
+        isinstance(axis_name, (tuple, list))
+        and len(axis_name) > 1
+        and jnp.issubdtype(jnp.result_type(x), jnp.floating)
+    ):
+        for ax in reversed(tuple(axis_name)):  # ROW_AXES = (slow, fast)
+            x = jnp.sum(lax.all_gather(x, ax), axis=0)
+        return x
+    return lax.psum(x, axis_name)
+
+
 def device_psum(x, axis_name):
-    """``lax.psum`` under the collective watchdog + byte accounting."""
+    """``lax.psum`` under the collective watchdog + byte accounting.
+
+    Tuple axes ride one fused ``lax.psum`` (callers on order-sensitive
+    float paths use :func:`psum_axes` instead); the bytes land on the
+    slowest tier any named axis touches.
+    """
     from jax import lax
 
     with obs.collective_watchdog("psum", **obs.trace_attrs()) as wd:
-        out = lax.psum(x, axis_name)
-        wd.attrs["nbytes"] = _leaf_nbytes(out)
-    return out
+        x = lax.psum(x, axis_name)
+        wd.attrs["nbytes"] = _leaf_nbytes(x)
+        obs.inc("collective.axis_bytes", wd.attrs["nbytes"],
+                name="psum", axis=axis_scope(axis_name))
+    return x
+
+
+def device_psum_exact(x, axis_name):
+    """Bitwise layout-invariant ``psum`` (see :func:`psum_axes`) under
+    the collective watchdog, with each gather step's bytes ledgered
+    against ITS link tier as ``all_gather`` — because that IS the wire
+    op.  Non-float or single-axis operands fall through to the ordinary
+    ledgered :func:`device_psum` (already order-exact)."""
+    import jax.numpy as jnp
+    from jax import lax
+
+    axes = (
+        tuple(axis_name) if isinstance(axis_name, (tuple, list))
+        else (axis_name,)
+    )
+    if len(axes) < 2 or not jnp.issubdtype(
+        jnp.result_type(x), jnp.floating
+    ):
+        return device_psum(x, axis_name)
+    with obs.collective_watchdog("all_gather", **obs.trace_attrs()) as wd:
+        total = 0
+        for ax in reversed(axes):  # fast (intra-host) axis first
+            g = lax.all_gather(x, ax)
+            nb = _leaf_nbytes(g)
+            total += nb
+            obs.inc("collective.axis_bytes", nb,
+                    name="all_gather", axis=axis_scope(ax))
+            x = jnp.sum(g, axis=0)
+        wd.attrs["nbytes"] = total
+    return x
 
 
 def device_psum_scatter(x, axis_name, scatter_dimension: int = 0,
@@ -181,6 +357,8 @@ def device_psum_scatter(x, axis_name, scatter_dimension: int = 0,
             x, axis_name, scatter_dimension=scatter_dimension, tiled=tiled
         )
         wd.attrs["nbytes"] = _leaf_nbytes(out)
+        obs.inc("collective.axis_bytes", wd.attrs["nbytes"],
+                name="reduce_scatter", axis=axis_scope(axis_name))
     return out
 
 
@@ -191,6 +369,8 @@ def device_all_gather(x, axis_name, **kw):
     with obs.collective_watchdog("all_gather", **obs.trace_attrs()) as wd:
         out = lax.all_gather(x, axis_name, **kw)
         wd.attrs["nbytes"] = _leaf_nbytes(out)
+        obs.inc("collective.axis_bytes", wd.attrs["nbytes"],
+                name="all_gather", axis=axis_scope(axis_name))
     return out
 
 
@@ -221,11 +401,12 @@ def device_psum_int(x, axis_name):
 
     _require_int_wire(x, "device_psum_int")
     with obs.collective_watchdog("psum", **obs.trace_attrs()) as wd:
-        out = lax.psum(x, axis_name)
-        nbytes = _leaf_nbytes(out)
-        wd.attrs["nbytes"] = nbytes
-        obs.inc("hist.quantized_bytes", nbytes)
-    return out
+        x = lax.psum(x, axis_name)  # integer sum: order-exact
+        wd.attrs["nbytes"] = _leaf_nbytes(x)
+        obs.inc("collective.axis_bytes", wd.attrs["nbytes"],
+                name="psum", axis=axis_scope(axis_name))
+        obs.inc("hist.quantized_bytes", wd.attrs["nbytes"])
+    return x
 
 
 def device_psum_scatter_int(x, axis_name, scatter_dimension: int = 0,
@@ -241,6 +422,8 @@ def device_psum_scatter_int(x, axis_name, scatter_dimension: int = 0,
         nbytes = _leaf_nbytes(out)
         wd.attrs["nbytes"] = nbytes
         obs.inc("hist.quantized_bytes", nbytes)
+        obs.inc("collective.axis_bytes", nbytes,
+                name="reduce_scatter", axis=axis_scope(axis_name))
     return out
 
 

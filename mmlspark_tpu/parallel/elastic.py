@@ -1,0 +1,170 @@
+"""Elastic checkpoint/resume plumbing (ISSUE 14).
+
+Pod-scale runs lose hosts.  The recovery contract here is deliberately
+small and deterministic:
+
+- **Integrity-checked snapshots.**  :func:`write_checkpoint` writes the
+  pickled booster atomically (tmp + ``os.replace``) and drops a sha256
+  sidecar next to it; :func:`load_checkpoint` verifies the digest and
+  answers ``None`` for anything torn, truncated, or bit-rotted — the
+  trainer then self-heals by starting fresh instead of crashing on a
+  half-written pickle.  A corrupt payload is quarantined (renamed
+  ``*.corrupt``) so the next snapshot never fights a poisoned file and
+  the evidence survives for post-mortems.
+- **Per-process shard manifest.**  Rank 0 records which process owned
+  which ``data/`` shard files at snapshot time.  Resume does NOT need
+  the manifest to be correct — shard ownership is a pure function of
+  the (sorted) shard list and the CURRENT process count
+  (:func:`assign_shards`), so a run resumed over fewer survivors
+  re-partitions deterministically.  The manifest exists so operators
+  (and the elasticity tests) can see what the dead run held.
+
+TRUST MODEL: the digest guards against torn writes and bit rot, not
+against an adversary with write access to ``checkpoint_dir`` (they can
+rewrite the sidecar too).  Same stance as the booster's pickle
+checkpoints — point the directory somewhere as trusted as the code.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+import os
+import pickle
+import warnings
+from typing import List, Optional, Sequence
+
+DIGEST_SUFFIX = ".sha256"
+MANIFEST_NAME = "shards.json"
+_MANIFEST_VERSION = 1
+
+
+def _sha256_file(path: str) -> str:
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        for block in iter(lambda: f.read(1 << 20), b""):
+            h.update(block)
+    return h.hexdigest()
+
+
+def write_checkpoint(path: str, obj) -> None:
+    """Atomic pickle + digest sidecar.
+
+    The payload replaces first, the sidecar second: a crash between the
+    two leaves a digest that mismatches the (new, valid) payload, which
+    :func:`load_checkpoint` conservatively treats as corrupt — resume
+    falls back to a fresh run rather than trusting an unverifiable file.
+    """
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        pickle.dump(obj, f)
+    digest = _sha256_file(tmp)
+    os.replace(tmp, path)
+    dtmp = path + DIGEST_SUFFIX + ".tmp"
+    with open(dtmp, "w") as f:
+        f.write(digest + "\n")
+    os.replace(dtmp, path + DIGEST_SUFFIX)
+
+
+def load_checkpoint(path: str, quarantine: bool = True):
+    """Digest-verified unpickle; ``None`` on missing/partial/corrupt.
+
+    Any failure mode — missing file, digest mismatch, truncated pickle,
+    unreadable sidecar — degrades to ``None`` (with a warning) so the
+    caller trains from scratch instead of dying mid-recovery.  A legacy
+    checkpoint with no sidecar still loads (pickle's own framing catches
+    truncation); ``quarantine`` renames an unusable payload to
+    ``*.corrupt`` so it is never retried.
+    """
+    if not os.path.exists(path):
+        return None
+    side = path + DIGEST_SUFFIX
+    try:
+        if os.path.exists(side):
+            with open(side) as f:
+                want = f.read().strip()
+            if want and _sha256_file(path) != want:
+                raise ValueError("sha256 digest mismatch (torn or corrupt write)")
+        with open(path, "rb") as f:
+            return pickle.load(f)
+    except Exception as e:  # noqa: BLE001 — every failure self-heals
+        warnings.warn(
+            f"discarding unusable checkpoint {path!r}: {e}; training resumes "
+            "from scratch"
+        )
+        if quarantine:
+            for p in (path, side):
+                try:
+                    if os.path.exists(p):
+                        os.replace(p, p + ".corrupt")
+                except OSError:
+                    pass
+        return None
+
+
+# ---- shard ownership ---------------------------------------------------
+
+
+def assign_shards(
+    paths: Sequence[str],
+    num_processes: int,
+    process_index: Optional[int] = None,
+) -> List:
+    """Deterministic round-robin shard → process assignment.
+
+    Strided (``paths[i::num_processes]``) rather than blocked so that a
+    resume over fewer survivors rebalances every process's load instead
+    of dumping the dead host's whole block on one survivor.  Ownership is
+    a pure function of the (caller-sorted) path list and the CURRENT
+    process count — no coordination, no state carried across failures.
+    """
+    if num_processes < 1:
+        raise ValueError(f"num_processes must be >= 1, got {num_processes}")
+    groups = [list(paths[i::num_processes]) for i in range(num_processes)]
+    if process_index is None:
+        return groups
+    if not 0 <= process_index < num_processes:
+        raise ValueError(
+            f"process_index {process_index} out of range [0, {num_processes})"
+        )
+    return groups[process_index]
+
+
+@dataclasses.dataclass
+class ShardManifest:
+    """What each process held when the snapshot was cut (observability +
+    elasticity tests; resume derives ownership itself — see module doc)."""
+
+    process_count: int
+    iterations_done: int
+    shards: List[List[str]]  # shards[p] = shard files process p owned
+    version: int = _MANIFEST_VERSION
+
+
+def write_manifest(checkpoint_dir: str, manifest: ShardManifest) -> str:
+    path = os.path.join(checkpoint_dir, MANIFEST_NAME)
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(dataclasses.asdict(manifest), f, indent=1, sort_keys=True)
+    os.replace(tmp, path)
+    return path
+
+
+def read_manifest(checkpoint_dir: str) -> Optional[ShardManifest]:
+    path = os.path.join(checkpoint_dir, MANIFEST_NAME)
+    if not os.path.exists(path):
+        return None
+    try:
+        with open(path) as f:
+            d = json.load(f)
+        if int(d.get("version", 0)) != _MANIFEST_VERSION:
+            raise ValueError(f"unknown manifest version {d.get('version')}")
+        return ShardManifest(
+            process_count=int(d["process_count"]),
+            iterations_done=int(d["iterations_done"]),
+            shards=[list(map(str, g)) for g in d["shards"]],
+        )
+    except Exception as e:  # noqa: BLE001 — manifest is advisory
+        warnings.warn(f"ignoring unreadable shard manifest {path!r}: {e}")
+        return None

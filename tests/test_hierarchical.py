@@ -1,0 +1,231 @@
+"""Hierarchical 2D-mesh histogram merge (ISSUE 14 tentpole).
+
+The 8 virtual CPU devices (conftest) model a (2 hosts × 4 devices/host)
+pod: ``mesh2d(2, 4)`` puts hosts on the slow ``data`` axis and the
+devices within a host on the fast ``feature`` axis.  The windowed merge
+psum_scatters host-locally over the feature axis, candidates are elected
+from host-local feature-scattered stats, and only the (D,5,L) winner
+exchange plus the elected column's exact refinement histogram cross the
+slow axis — checked here end-to-end via the per-axis byte ledger
+(``collective.axis_bytes{axis=inter|intra}``).
+"""
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu import obs
+from mmlspark_tpu.engine.booster import Dataset, train
+from mmlspark_tpu.parallel.mesh import (
+    DATA_AXIS,
+    FEATURE_AXIS,
+    default_mesh,
+    is_mesh_2d,
+    mesh2d,
+    mesh_axis_size,
+)
+
+
+def _data(n=2000, F=16, seed=0):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, F)).astype(np.float32)
+    y = (X[:, 0] + 0.5 * X[:, 1] ** 2 + 0.1 * rng.normal(size=n) > 0.3)
+    return X, y.astype(np.float64)
+
+
+def _auc(y, p):
+    order = np.argsort(p)
+    ranks = np.empty(len(p))
+    ranks[order] = np.arange(1, len(p) + 1)
+    pos = y > 0
+    n1, n0 = pos.sum(), (~pos).sum()
+    return (ranks[pos].sum() - n1 * (n1 + 1) / 2) / (n1 * n0)
+
+
+PARAMS = dict(
+    objective="binary", num_iterations=8, num_leaves=15,
+    learning_rate=0.2, min_data_in_leaf=5, seed=7,
+)
+
+
+# ------------------------------------------------------------- mesh2d
+
+
+class TestMesh2D:
+    def test_explicit_grid_shape_and_axes(self):
+        m = mesh2d(2, 4)
+        assert m.devices.shape == (2, 4)
+        assert tuple(m.axis_names) == (DATA_AXIS, FEATURE_AXIS)
+        assert is_mesh_2d(m)
+        assert mesh_axis_size(m, DATA_AXIS) == 2
+        assert mesh_axis_size(m, FEATURE_AXIS) == 4
+
+    def test_process_topology_derivation_single_process(self):
+        # one process → one mesh row holding every visible device
+        m = mesh2d()
+        assert m.devices.shape[0] == 1
+        assert m.devices.shape[1] == 8
+
+    def test_oversubscription_raises(self):
+        with pytest.raises(ValueError, match="only 8 devices"):
+            mesh2d(4, 4)
+
+    def test_1d_mesh_is_not_2d(self):
+        assert not is_mesh_2d(default_mesh())
+        assert not is_mesh_2d(None)
+        assert mesh_axis_size(default_mesh(), FEATURE_AXIS) == 1
+
+
+class TestAxisScope:
+    def test_scopes(self):
+        from mmlspark_tpu.parallel.distributed import axis_scope
+
+        assert axis_scope(DATA_AXIS) == "inter"
+        assert axis_scope(FEATURE_AXIS) == "intra"
+        assert axis_scope((DATA_AXIS, FEATURE_AXIS)) == "inter"
+        assert axis_scope((FEATURE_AXIS,)) == "intra"
+
+
+# ------------------------------------------------------- config gating
+
+
+class TestHierarchicalConfigGuards:
+    def test_requires_2d_mesh(self):
+        X, y = _data(400, 8)
+        with pytest.raises(ValueError, match="2D .*mesh|mesh2d"):
+            train(dict(PARAMS, hist_merge="hierarchical"),
+                  Dataset(X, y), mesh=default_mesh())
+
+    def test_rejects_quantize(self):
+        X, y = _data(400, 8)
+        with pytest.raises(ValueError, match="mutually exclusive"):
+            train(dict(PARAMS, hist_merge="hierarchical",
+                       hist_quantize="int16"),
+                  Dataset(X, y), mesh=mesh2d(2, 4))
+
+    def test_rejects_non_data_learners(self):
+        X, y = _data(400, 8)
+        for learner in ("voting", "feature"):
+            with pytest.raises(ValueError, match="data-parallel learner"):
+                train(dict(PARAMS, hist_merge="hierarchical",
+                           tree_learner=learner),
+                      Dataset(X, y), mesh=mesh2d(2, 4))
+
+    def test_merge_helper_needs_axis_tuple(self):
+        from mmlspark_tpu.ops.histogram import merge_shard_histograms
+
+        with pytest.raises(ValueError, match="axis tuple"):
+            merge_shard_histograms(
+                np.zeros((3, 4, 5)), axis_name="data", merge="hierarchical"
+            )
+
+
+# ------------------------------------------------------------ training
+
+
+class TestHierarchicalTraining:
+    def test_quality_matches_single_device(self):
+        X, y = _data()
+        ref = train(dict(PARAMS), Dataset(X, y))
+        hier = train(dict(PARAMS, hist_merge="hierarchical"),
+                     Dataset(X, y), mesh=mesh2d(2, 4))
+        a_ref, a_h = _auc(y, ref.predict(X)), _auc(y, hier.predict(X))
+        # host-biased election + exact winner refinement: split CHOICES
+        # may differ from the global argmax, recorded split stats are
+        # exact — fit quality must match closely
+        assert a_h > 0.95
+        assert abs(a_ref - a_h) < 0.02
+
+    def test_same_seed_is_bitwise_deterministic(self):
+        X, y = _data(1200, 12)
+        p = dict(PARAMS, hist_merge="hierarchical", num_iterations=5,
+                 bagging_fraction=0.8, bagging_freq=1, feature_fraction=0.9)
+        a = train(p, Dataset(X, y), mesh=mesh2d(2, 4))
+        b = train(p, Dataset(X, y), mesh=mesh2d(2, 4))
+        np.testing.assert_array_equal(a.predict(X), b.predict(X))
+        assert a.save_model_string() == b.save_model_string()
+
+    def test_auto_mesh_construction(self):
+        # hist_merge="hierarchical" with no mesh builds mesh2d() itself
+        X, y = _data(600, 8)
+        b = train(dict(PARAMS, hist_merge="hierarchical", num_iterations=3),
+                  Dataset(X, y))
+        assert np.isfinite(b.predict(X)).all()
+
+    def test_lossguide_grower(self):
+        X, y = _data(800, 8)
+        b = train(dict(PARAMS, hist_merge="hierarchical",
+                       grow_policy="lossguide", num_iterations=4),
+                  Dataset(X, y), mesh=mesh2d(2, 4))
+        assert _auc(y, b.predict(X)) > 0.9
+
+    def test_multiclass(self):
+        rng = np.random.default_rng(3)
+        X = rng.normal(size=(900, 8)).astype(np.float32)
+        y = (X[:, 0] > 0.4).astype(np.float64) + (X[:, 1] > 0.2)
+        b = train(dict(objective="multiclass", num_class=3,
+                       num_iterations=4, num_leaves=7, min_data_in_leaf=5,
+                       seed=5, hist_merge="hierarchical"),
+                  Dataset(X, y), mesh=mesh2d(2, 4))
+        p = b.predict(X)
+        assert p.shape == (900, 3)
+        assert np.allclose(p.sum(axis=1), 1.0, atol=1e-5)
+        assert (p.argmax(axis=1) == y).mean() > 0.7
+
+
+# ------------------------------------------------- per-axis byte ledger
+
+
+class TestPerAxisBytes:
+    def _train_with_ledger(self, merge, mesh, X, y):
+        obs.reset()
+        obs.enable()
+        try:
+            p = dict(PARAMS, num_iterations=4, num_leaves=31)
+            if merge:
+                p["hist_merge"] = merge
+            train(p, Dataset(X, y), mesh=mesh)
+            snap = obs.snapshot()
+        finally:
+            obs.disable()
+            obs.reset()
+        inter = intra = 0.0
+        for k, v in snap.get("counters", {}).items():
+            if k.startswith("collective.axis_bytes"):
+                if "axis=inter" in k:
+                    inter += v
+                elif "axis=intra" in k:
+                    intra += v
+        return inter, intra
+
+    def test_hierarchical_inter_bytes_4x_below_flat(self):
+        # the ISSUE 14 acceptance gate on the modeled (2 hosts × 4
+        # devices) pod: the flat merge ships every histogram byte across
+        # the slow axis; hierarchical ships only the (D,5,L) winner
+        # exchange + the elected column's refinement histogram
+        X, y = _data(4000, 32)
+        flat_inter, flat_intra = self._train_with_ledger(
+            None, default_mesh(), X, y
+        )
+        hier_inter, hier_intra = self._train_with_ledger(
+            "hierarchical", mesh2d(2, 4), X, y
+        )
+        assert flat_inter > 0 and hier_inter > 0
+        assert flat_intra == 0  # 1-D data mesh: every byte crosses hosts
+        assert hier_intra > hier_inter  # the bulk stays on the fast axis
+        assert flat_inter >= 4.0 * hier_inter
+
+    def test_ledger_disabled_is_free(self):
+        # obs disabled: the wrappers must not record axis bytes
+        X, y = _data(400, 8)
+        assert not obs.enabled()
+        train(dict(PARAMS, num_iterations=2, hist_merge="hierarchical"),
+              Dataset(X, y), mesh=mesh2d(2, 4))
+        obs.enable()
+        try:
+            snap = obs.snapshot()
+        finally:
+            obs.disable()
+        assert not any(
+            k.startswith("collective.axis_bytes")
+            for k in snap.get("counters", {})
+        )

@@ -373,6 +373,56 @@ def test_col004_library_voting_site_is_suppressed():
     assert [f for f in found if f.rule == "COL004"] == []
 
 
+def test_col007_full_hist_over_inter_axis(tmp_path):
+    # the ISSUE 14 shape: the full (F,...) histogram crossing the slow
+    # inter-host axis, spelled via the DATA_AXIS constant or the literal
+    p = _write(str(tmp_path / "m.py"), """
+        from mmlspark_tpu.parallel.mesh import DATA_AXIS
+        def merge(hist):
+            a = device_psum(hist, axis_name=DATA_AXIS)
+            b = device_all_gather(hist, "data")
+            return a, b
+    """)
+    assert rules(check_collectives_file(p)) == ["COL007", "COL007"]
+
+
+def test_col007_silent_on_reduced_or_parameterized(tmp_path):
+    # scattered/sliced/winner operands and parameterized axes stay quiet:
+    # the rule targets hardcoded slow-axis call sites with full-F payloads
+    p = _write(str(tmp_path / "m.py"), """
+        from mmlspark_tpu.parallel.mesh import DATA_AXIS
+        def merge(hist, hist_win_col, hist_scattered, axis_name):
+            a = device_psum(hist_win_col, axis_name=DATA_AXIS)
+            b = device_psum(hist_scattered, axis_name=DATA_AXIS)
+            c = device_psum(hist, axis_name)
+            d = device_psum_scatter(hist, DATA_AXIS, scatter_dimension=1)
+            e = device_psum(grad_tot, axis_name=DATA_AXIS)
+            return a, b, c, d, e
+    """)
+    assert [f for f in check_collectives_file(p) if f.rule == "COL007"] == []
+
+
+def test_col007_suppression_round_trip(tmp_path):
+    p = _write(str(tmp_path / "m.py"), """
+        def merge(hist):
+            return device_psum(hist, axis_name="data")  # analyze: ignore[COL007]
+    """)
+    found = check_collectives_file(p)
+    assert rules(found) == ["COL007"]
+    assert apply_suppressions(found) == []
+
+
+def test_col007_real_tree_clean():
+    # the hierarchical merge keeps every full-F payload off the slow axis;
+    # the package must carry zero (unsuppressed) COL007 findings
+    import tools.analyze.collectives as col
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(col.__file__)))
+    repo = os.path.dirname(root)
+    found = apply_suppressions(col.check_collectives(repo))
+    assert [f for f in found if f.rule == "COL007"] == []
+
+
 # --------------------------------------------------------- tracer fixtures
 
 

@@ -70,7 +70,7 @@ PASSES = {
             {"ABI001", "ABI002", "ABI003", "ABI004", "ABI005", "NAT001"}),
     "collectives": (
         lambda root, index: check_collectives(root, index=index),
-        {"COL001", "COL002", "COL003", "COL004"}),
+        {"COL001", "COL002", "COL003", "COL004", "COL007"}),
     "tracer": (lambda root, index: check_tracer(root, index=index),
                {"TRC001", "TRC002", "TRC003"}),
     "hygiene": (lambda root, index: check_hygiene(root, index=index),

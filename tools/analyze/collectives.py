@@ -32,6 +32,16 @@ Rules
   bug, not a style choice (ISSUE 4; Ke et al. 2017).  Sites that psum an
   already-reduced slice (e.g. voting's elected features) carry
   ``# analyze: ignore[COL004]``.
+- COL007: a collective whose AXIS lexically names the inter-host mesh
+  axis (``DATA_AXIS``, the ``"data"`` literal, or an ``*inter*`` var)
+  while its operand is a full feature-dimensioned histogram (source
+  mentions ``hist`` with no scatter/slice/winner evidence).  On the 2D
+  pod mesh (ISSUE 14) the F-dimensioned bulk must be reduced over the
+  fast intra-host ``FEATURE_AXIS`` first
+  (``merge_shard_histograms(merge='hierarchical')``); only the reduced
+  winner exchange and the elected column may cross the slow axis.
+  Generic library code that takes the axis as a parameter stays quiet —
+  the rule fires on call sites that hardcode the slow axis.
 
 Guards counted for a statement: every enclosing ``if``/ternary test plus
 any earlier same-block ``if`` whose body unconditionally leaves the
@@ -68,6 +78,18 @@ EVIDENCE_TOKENS = (
     "process_local", "multi_controller", "mesh_spans_processes",
     "spans_processes", "all_ranks",
 )
+
+# COL007: axis expressions that lexically pin the slow inter-host axis
+_INTER_AXIS = re.compile(r"\bDATA_AXIS\b|['\"]data['\"]|inter")
+# ...and operand spellings that attest the payload is already reduced
+# below full-F (scattered shard, sliced column, elected winner)
+_REDUCED_TOKENS = ("scatter", "slice", "loc", "win", "col", "elected")
+# collectives COL007 inspects: the all-to-all-bytes primitives (the
+# psum_scatter variants ARE the fix, so they are exempt by construction)
+_FULL_BYTES_COLLECTIVES = {
+    "device_psum", "device_psum_int", "device_all_gather",
+    "psum", "all_gather",
+}
 
 _RANK_QUERY = re.compile(r"process_(?:count|index)\s*\(")
 _RANK_PINNED = re.compile(
@@ -141,8 +163,47 @@ class _Scanner:
             "already a reduced slice",
         ))
 
+    def _check_inter_axis_hist(self, call: ast.Call):
+        """COL007: full-(F,...) histogram payload over the slow inter-host
+        axis.  Lexical on both sides — the axis argument must NAME the
+        slow axis (``DATA_AXIS`` / ``"data"`` / ``*inter*``) and the
+        operand must read as a full histogram (``hist`` with no
+        scatter/slice/winner token) — so generic merge helpers taking the
+        axis as a parameter never fire, only hardcoded call sites."""
+        fn = call.func
+        name = (fn.id if isinstance(fn, ast.Name)
+                else fn.attr if isinstance(fn, ast.Attribute) else None)
+        if name not in _FULL_BYTES_COLLECTIVES or not call.args:
+            return
+        axis_src = None
+        for kw in call.keywords:
+            if kw.arg == "axis_name":
+                axis_src = ast.unparse(kw.value)
+                break
+        if axis_src is None and len(call.args) >= 2:
+            axis_src = ast.unparse(call.args[1])
+        if axis_src is None or not _INTER_AXIS.search(axis_src):
+            return
+        arg_src = ast.unparse(call.args[0]).lower()
+        if "hist" not in arg_src:
+            return
+        if any(tok in arg_src for tok in _REDUCED_TOKENS):
+            return
+        self.findings.append(Finding(
+            self.path, call.lineno, "COL007",
+            f"collective {name}() carries a full feature-dimensioned "
+            f"histogram ({ast.unparse(call.args[0])!r}) over the "
+            f"inter-host axis ({axis_src!r}) — reduce over the fast "
+            "intra-host FEATURE_AXIS first "
+            "(ops.histogram.merge_shard_histograms(merge='hierarchical')); "
+            "only the reduced winner exchange and the elected column "
+            "should cross the slow axis, or suppress if the operand is "
+            "already sub-F",
+        ))
+
     def _check_call(self, call: ast.Call, guards: list):
         self._check_psum_hist(call)
+        self._check_inter_axis_hist(call)
         name = _collective_name(call)
         if name is None:
             return

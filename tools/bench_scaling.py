@@ -23,7 +23,13 @@ scale.  This tool produces the table BASELINE.md commits:
    The ``data`` mode runs the AUTO-resolved default (asserted to be
    reduce_scatter on a real mesh — the benchmarked configuration IS the
    default configuration); ``data_allreduce`` pins the old merge so the
-   comms ledger records the measured ratio.
+   comms ledger records the measured ratio.  Every traced call is also
+   split per link tier (``axis_bytes``: intra-host vs inter-host, via
+   ``parallel.distributed.axis_scope`` — ISSUE 14): on the flat 1-D mesh
+   every byte is "inter"; the ``data_hier`` mode (D>=4) re-runs training
+   on a (2 hosts × D/2) ``mesh2d`` pod with the hierarchical merge, whose
+   inter column carries only the (D,5,L) winner exchange + the elected
+   column's refinement histogram.
 3. **psum vs psum_scatter microbench** on a histogram-shaped array — the
    transport-level bound for the reduce-scatter merge.
 
@@ -64,15 +70,19 @@ class CollectiveRecorder:
     def __init__(self):
         self.calls = []
 
-    def _record(self, kind, out):
+    def _record(self, kind, out, axis_name):
         import jax
 
+        from mmlspark_tpu.parallel.distributed import axis_scope
+
+        scope = axis_scope(axis_name)
         for leaf in jax.tree_util.tree_leaves(out):
             if not hasattr(leaf, "shape"):
                 continue  # psum of a Python scalar constant-folds to an
                 # int (the axis-size idiom) — no bytes move
             self.calls.append((kind, tuple(leaf.shape), str(leaf.dtype),
-                               int(np.prod(leaf.shape)) * leaf.dtype.itemsize))
+                               int(np.prod(leaf.shape)) * leaf.dtype.itemsize,
+                               scope))
 
     def __enter__(self):
         from jax import lax
@@ -83,17 +93,17 @@ class CollectiveRecorder:
 
         def psum(x, axis_name, **kw):
             out = self._psum(x, axis_name, **kw)
-            self._record("psum", out)
+            self._record("psum", out, axis_name)
             return out
 
         def all_gather(x, axis_name, **kw):
             out = self._ag(x, axis_name, **kw)
-            self._record("all_gather", out)
+            self._record("all_gather", out, axis_name)
             return out
 
         def psum_scatter(x, axis_name, **kw):
             out = self._pscat(x, axis_name, **kw)
-            self._record("reduce_scatter", out)
+            self._record("reduce_scatter", out, axis_name)
             return out
 
         self._lax.psum, self._lax.all_gather = psum, all_gather
@@ -106,7 +116,7 @@ class CollectiveRecorder:
 
     def summary(self):
         out = {}
-        for kind, shape, dtype, nbytes in self.calls:
+        for kind, shape, dtype, nbytes, _scope in self.calls:
             key = f"{kind}{list(shape)}:{dtype}"
             ent = out.setdefault(key, {"bytes": nbytes, "traced_calls": 0})
             ent["traced_calls"] += 1
@@ -115,7 +125,19 @@ class CollectiveRecorder:
     def total_bytes(self):
         """Σ received-bytes over every traced call — the per-pass wire
         volume of the in-loop sites plus one-off setup collectives."""
-        return int(sum(nbytes for _, _, _, nbytes in self.calls))
+        return int(sum(c[3] for c in self.calls))
+
+    def axis_bytes(self):
+        """Per-link-tier split of :meth:`total_bytes` (ISSUE 14): every
+        call's axis argument classified by
+        :func:`mmlspark_tpu.parallel.distributed.axis_scope` — "intra"
+        bytes ride a host's fast links on the 2D ``mesh2d`` pod, "inter"
+        bytes cross the slow data axis.  On a flat 1-D mesh every
+        collective runs over the data axis, so everything is "inter"."""
+        out = {"inter": 0, "intra": 0}
+        for _, _, _, nbytes, scope in self.calls:
+            out[scope] = out.get(scope, 0) + nbytes
+        return out
 
 
 def make_data(n, seed=0):
@@ -183,24 +205,36 @@ def run_child(n_dev: int):
     # real mesh — asserted below the same way bench.py pins the other
     # auto knobs); "data_allreduce" pins the pre-ISSUE-4 merge so the
     # comms ledger records the measured bytes ratio on identical trees.
-    modes = [("data", dict(tree_learner="data")),
+    modes = [("data", dict(tree_learner="data"), None),
              ("data_allreduce", dict(tree_learner="data",
-                                     hist_merge="allreduce")),
+                                     hist_merge="allreduce"), None),
              ("data_bf16wire", dict(tree_learner="data",
                                     hist_merge="allreduce",
-                                    hist_psum_dtype="bfloat16")),
+                                    hist_psum_dtype="bfloat16"), None),
              # ISSUE 9: int16 gradient buckets + integer merge wire — the
              # recorder shows the hist merge riding int16 (half the f32
              # bytes) and the AUC column quality-gates the quantization
              ("data_quantize", dict(tree_learner="data",
-                                    hist_quantize="int16")),
-             ("voting", dict(tree_learner="voting"))]
+                                    hist_quantize="int16"), None),
+             ("voting", dict(tree_learner="voting"), None)]
+    if n_dev >= 4 and n_dev % 2 == 0:
+        # ISSUE 14: the same devices as a (2 hosts × n/2) mesh2d pod —
+        # the intra/inter columns show the hierarchical merge keeping the
+        # histogram bulk on the fast feature axis and shipping only the
+        # winner exchange + elected-column refinement across hosts.
+        from mmlspark_tpu.parallel.mesh import mesh2d
+
+        modes.insert(1, ("data_hier",
+                         dict(tree_learner="data",
+                              hist_merge="hierarchical"),
+                         mesh2d(2, n_dev // 2)))
     if n_dev == 1:
-        modes = [("data", dict(tree_learner="serial"))]
-    for name, extra in modes:
+        modes = [("data", dict(tree_learner="serial"), None)]
+    for name, extra, mesh_over in modes:
         params = dict(base, **extra)
+        m_use = mesh_over if mesh_over is not None else mesh
         with CollectiveRecorder() as rec:
-            booster = train(params, ds, bin_mapper=bm, mesh=mesh)  # trace
+            booster = train(params, ds, bin_mapper=bm, mesh=m_use)  # trace
         if name == "data" and n_dev > 1:
             # The benchmarked default IS the default configuration: a bare
             # tree_learner="data" run must land on the reduce-scatter
@@ -208,13 +242,14 @@ def run_child(n_dev: int):
             assert booster.config.hist_merge == "reduce_scatter", \
                 booster.config.hist_merge
         t0 = time.perf_counter()
-        booster = train(params, ds, bin_mapper=bm, mesh=mesh)
+        booster = train(params, ds, bin_mapper=bm, mesh=m_use)
         wall = time.perf_counter() - t0
         results["modes"][name] = {
             "steady_wall_s": round(wall, 3),
             "auc": round(_auc(y, booster.predict(X)), 5),
             "hist_merge": booster.config.hist_merge,
             "comm_traced_bytes": rec.total_bytes(),
+            "axis_bytes": rec.axis_bytes(),
             "collectives": rec.summary(),
         }
 
@@ -276,7 +311,7 @@ def main():
     print(json.dumps(rows, indent=1))
     # Human summary table
     _log("\nD  rows    mode            wall(s)  AUC     merge           "
-         "comm/pass  dominant collective")
+         "comm/pass  inter/intra      dominant collective")
     for r in rows:
         for mode, m in r["modes"].items():
             # Dominant term = the largest single traced collective (the
@@ -288,10 +323,12 @@ def main():
                 default="-",
             )
             hb = m["collectives"].get(hist_key, {}).get("bytes", 0)
+            ab = m.get("axis_bytes", {})
             _log(f"{r['n_devices']}  {r['rows']:>7} {mode:<15} "
                  f"{m['steady_wall_s']:>7} {m['auc']:.4f} "
                  f"{m['hist_merge']:<15} "
                  f"{m['comm_traced_bytes']/1e6:>7.2f}MB  "
+                 f"{ab.get('inter', 0)/1e6:.2f}/{ab.get('intra', 0)/1e6:.2f}MB  "
                  f"{hb/1e6:.2f} MB ({hist_key})")
         if "microbench" in r:
             mb = r["microbench"]

@@ -1,0 +1,381 @@
+"""Two-real-process multi-host smoke: parity, kill, resume (ISSUE 14).
+
+The smallest honest model of a (2 hosts × N chips) pod that still runs
+on a laptop/CI box: TWO real OS processes rendezvous through a real
+``jax.distributed`` coordinator on localhost, each pinned to N virtual
+CPU devices (``--local-devices``), so ``mesh2d()`` derives a (2, N)
+mesh whose slow axis IS the process boundary — the inter-host
+collectives genuinely cross process memory via the distributed runtime,
+not a simulated axis.
+
+Three legs, driven by the parent:
+
+1. **parity** — both processes train the hierarchical 2D-mesh model
+   over their deterministic shard partition (multi-controller
+   ingestion: ``data.streaming.process_shard_source`` +
+   ``process_local=True``).  The rank-0 model must be BYTE-IDENTICAL
+   to a single-process run over the same global rows on the same
+   (2, N) mesh — same global arrays, same mesh, same SPMD program, so
+   the process boundary must be invisible to the math.
+2. **kill** — a second 2-process run checkpoints every iteration
+   (digest-verified rank-0 snapshots + shard manifest).  Once the
+   manifest shows ``KILL_AFTER`` iterations the parent SIGKILLs
+   process 1 mid-flight; process 0, wedged in a collective against a
+   dead peer, is reaped after a grace period.  The checkpoint on disk
+   must still load (atomic replace + sha256 sidecar).
+3. **resume** — the survivor re-forms a (1, N) mesh over its own
+   devices, re-partitions ALL shards with the same round-robin
+   (ownership is a pure function of the sorted shard list and the
+   process count — no coordination with the dead host), loads the
+   checkpoint and finishes the run.  Final AUC must sit within
+   ``AUC_GAP`` (1e-3) of the uninterrupted single-process reference.
+
+Usage:
+    python tools/multihost_smoke.py                  # parent: all legs
+    python tools/multihost_smoke.py --json OUT.json  # + machine-readable
+    python tools/multihost_smoke.py --child ...      # internal
+"""
+
+import glob
+import json
+import os
+import pickle
+import signal
+import socket
+import subprocess
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+N_ROWS = 4096          # divisible by every device layout used below
+N_FEATURES = 16
+N_SHARDS = 8
+LOCAL_DEVICES = 4      # per process → (2, 4) global mesh
+ITERS = 10
+KILL_AFTER = 3         # SIGKILL once the manifest shows this many iters
+AUC_GAP = 1e-3
+
+
+def _log(*a):
+    print("[multihost_smoke]", *a, file=sys.stderr, flush=True)
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _label_path(x_path: str) -> str:
+    d, b = os.path.split(x_path)
+    return os.path.join(d, "y" + b[1:])
+
+
+def _params(iters, workdir=None, checkpoint_every=0):
+    p = dict(
+        objective="binary", num_iterations=iters, num_leaves=15,
+        learning_rate=0.2, min_data_in_leaf=5, max_bin=63, seed=11,
+    )
+    if checkpoint_every:
+        p.update(checkpoint_dir=os.path.join(workdir, "ckpt"),
+                 checkpoint_every=checkpoint_every)
+    return p
+
+
+def _auc(y, p):
+    # midranks: tie groups get their average rank, so the score is
+    # invariant to row order (early iterations have few distinct leaf
+    # values → huge cross-class tie groups)
+    order = np.argsort(p, kind="mergesort")
+    sp = p[order]
+    uniq, inv = np.unique(sp, return_inverse=True)
+    pos_rank = np.arange(1, len(p) + 1, dtype=np.float64)
+    ranks_sorted = (np.bincount(inv, pos_rank) / np.bincount(inv))[inv]
+    ranks = np.empty(len(p))
+    ranks[order] = ranks_sorted
+    pos = y > 0
+    n1, n0 = pos.sum(), (~pos).sum()
+    return float((ranks[pos].sum() - n1 * (n1 + 1) / 2) / (n1 * n0))
+
+
+# ------------------------------------------------------------------ child
+
+
+def run_child() -> None:
+    """One training process.  ``barrier_context_from_cli`` consumes the
+    rendezvous flags (and pins device visibility BEFORE jax initializes
+    a backend); without ``--coordinator`` this is the single-process
+    reference/survivor path through the very same code."""
+    import argparse
+
+    ap = argparse.ArgumentParser(add_help=False)
+    ap.add_argument("--child", action="store_true")
+    ap.add_argument("--workdir", required=True)
+    ap.add_argument("--iters", type=int, default=ITERS)
+    ap.add_argument("--checkpoint-every", type=int, default=0)
+    ap.add_argument("--mesh", default=None,
+                    help="explicit H,d grid (default: process topology)")
+    ap.add_argument("--global-order", type=int, default=0,
+                    help="single-process only: load ALL rows in the "
+                         "global order an N-process run assembles")
+    ap.add_argument("--out", default=None)
+    ns, _ = ap.parse_known_args()
+
+    from mmlspark_tpu.parallel.distributed import (
+        barrier_context_from_cli,
+        initialize_distributed,
+    )
+
+    ctx = barrier_context_from_cli()
+    initialize_distributed(ctx)
+
+    import jax
+
+    from mmlspark_tpu.data.streaming import process_shard_source
+    from mmlspark_tpu.engine.booster import Dataset, train
+    from mmlspark_tpu.parallel.mesh import mesh2d
+
+    with open(os.path.join(ns.workdir, "binmapper.pkl"), "rb") as f:
+        bm = pickle.load(f)
+    xp = sorted(glob.glob(os.path.join(ns.workdir, "shards", "x*.npy")))
+    yp = [_label_path(p) for p in xp]
+
+    src = process_shard_source(xp, yp)  # partition = f(sorted list, nproc)
+    if ns.global_order > 1 and jax.process_count() == 1:
+        # Parity reference: the N-process run's global array is the
+        # concatenation of the per-process partitions in process order —
+        # reproduce exactly that row order so the device placement (and
+        # therefore every histogram summand) matches bit for bit.
+        parts = [
+            process_shard_source(xp, yp, process_count=ns.global_order,
+                                 process_index=i)
+            for i in range(ns.global_order)
+        ]
+    else:
+        parts = [src]
+    X = np.concatenate(
+        [np.asarray(x) for s in parts for x, _ in s.iter_shards()])
+    y = np.concatenate(
+        [np.asarray(l) for s in parts for _, l in s.iter_shards()])
+    ds = Dataset(X, y)
+    ds.shard_paths = src.shard_paths  # → rank-0 checkpoint shard manifest
+
+    mesh = (mesh2d(*map(int, ns.mesh.split(","))) if ns.mesh else mesh2d())
+    params = _params(ns.iters, ns.workdir, ns.checkpoint_every)
+    booster = train(dict(params, hist_merge="hierarchical"),
+                    ds, bin_mapper=bm, mesh=mesh, process_local=True)
+
+    if jax.process_index() == 0 and ns.out:
+        # Global AUC needs global rows; in 2-process mode each process
+        # holds only its partition, so score every shard through the
+        # finished model (prediction is host-local — no collectives).
+        gx = np.concatenate(
+            [np.load(p) for g in src.shard_paths for p in g])
+        gy = np.concatenate(
+            [np.load(_label_path(p)) for g in src.shard_paths for p in g])
+        with open(ns.out + ".tmp", "w") as f:
+            json.dump({
+                "mesh_shape": list(mesh.devices.shape),
+                "process_count": jax.process_count(),
+                "num_iterations": int(booster.num_iterations),
+                "auc": _auc(gy, booster.predict(gx)),
+                "model": booster.save_model_string(),
+            }, f)
+        os.replace(ns.out + ".tmp", ns.out)
+    _log(f"child p{jax.process_index()} done "
+         f"({jax.process_count()} processes, mesh {mesh.devices.shape})")
+
+
+# ----------------------------------------------------------------- parent
+
+
+def _child_argv(workdir, iters, checkpoint_every, out, extra):
+    argv = [
+        sys.executable, os.path.abspath(__file__), "--child",
+        "--workdir", workdir, "--iters", str(iters),
+        "--checkpoint-every", str(checkpoint_every),
+    ] + extra
+    if out:
+        argv += ["--out", out]
+    return argv
+
+
+def _child_env():
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    # children pin their own virtual device count via --local-devices;
+    # an inherited count would win (the flag is first-one-sticks)
+    env.pop("XLA_FLAGS", None)
+    env.pop("JAX_NUM_CPU_DEVICES", None)
+    return env
+
+
+def _spawn(workdir, port, pid, iters, checkpoint_every=0, out=None):
+    return subprocess.Popen(
+        _child_argv(workdir, iters, checkpoint_every, out, [
+            "--coordinator", f"127.0.0.1:{port}",
+            "--num-processes", "2", "--process-id", str(pid),
+            "--local-devices", str(LOCAL_DEVICES),
+        ]),
+        env=_child_env(),
+    )
+
+
+def _run_single(workdir, iters, checkpoint_every=0, out=None,
+                local_devices=LOCAL_DEVICES, mesh=None, global_order=0):
+    extra = ["--local-devices", str(local_devices)]
+    if mesh:
+        extra += ["--mesh", mesh]
+    if global_order:
+        extra += ["--global-order", str(global_order)]
+    subprocess.run(
+        _child_argv(workdir, iters, checkpoint_every, out, extra),
+        env=_child_env(), check=True, timeout=900,
+    )
+
+
+def _manifest_iters(ckpt_dir) -> int:
+    try:
+        with open(os.path.join(ckpt_dir, "shards.json")) as f:
+            return int(json.load(f).get("iterations_done", 0))
+    except (OSError, ValueError):
+        return 0
+
+
+def main() -> None:
+    out_json = None
+    if "--json" in sys.argv:
+        out_json = sys.argv[sys.argv.index("--json") + 1]
+    workdir = tempfile.mkdtemp(prefix="multihost_smoke_")
+    _log("workdir", workdir)
+
+    # ---- fixture: 8 shard files + one shared bin mapper ----------------
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(N_ROWS, N_FEATURES)).astype(np.float64)
+    logits = X[:, 0] * 1.5 - X[:, 1] + 0.5 * X[:, 2] ** 2
+    y = (logits + rng.normal(scale=0.5, size=N_ROWS) > 0.3).astype(
+        np.float64)
+    sh_dir = os.path.join(workdir, "shards")
+    os.makedirs(sh_dir)
+    per = N_ROWS // N_SHARDS
+    for i in range(N_SHARDS):
+        np.save(os.path.join(sh_dir, f"x{i:02d}.npy"),
+                X[i * per:(i + 1) * per])
+        np.save(os.path.join(sh_dir, f"y{i:02d}.npy"),
+                y[i * per:(i + 1) * per])
+    # One binning authority for every leg: per-process local fits would
+    # disagree on thresholds and sink the bitwise gate for a boring
+    # reason (the distributed sketch path has its own coverage in
+    # tests/test_streaming.py; the subject here is the mesh+elastic leg).
+    from mmlspark_tpu.ops.binning import BinMapper
+
+    with open(os.path.join(workdir, "binmapper.pkl"), "wb") as f:
+        pickle.dump(BinMapper(max_bin=63).fit(X), f)
+
+    report = {"workdir": workdir}
+
+    # ---- leg 1: 2-process training, parity vs single process ----------
+    port = _free_port()
+    two_out = os.path.join(workdir, "two_proc.json")
+    t0 = time.monotonic()
+    procs = [
+        _spawn(workdir, port, pid, ITERS,
+               out=two_out if pid == 0 else None)
+        for pid in (0, 1)
+    ]
+    rcs = [p.wait(timeout=900) for p in procs]
+    assert rcs == [0, 0], f"2-process training failed: rcs={rcs}"
+    with open(two_out) as f:
+        two = json.load(f)
+    assert two["process_count"] == 2 and two["mesh_shape"] == [2, 4], two
+    _log(f"2-process leg done in {time.monotonic() - t0:.1f}s "
+         f"AUC={two['auc']:.5f}")
+
+    ref_out = os.path.join(workdir, "single_proc.json")
+    _run_single(workdir, ITERS, out=ref_out, local_devices=8,
+                mesh=f"2,{LOCAL_DEVICES}", global_order=2)
+    with open(ref_out) as f:
+        ref = json.load(f)
+    assert ref["mesh_shape"] == [2, 4], ref
+    parity_bitwise = ref["model"] == two["model"]
+    report["parity"] = {
+        "bitwise": parity_bitwise,
+        "auc_two_proc": two["auc"],
+        "auc_single_proc": ref["auc"],
+    }
+    _log("parity:", "BITWISE" if parity_bitwise else
+         f"auc gap {abs(ref['auc'] - two['auc']):.2e}")
+    assert parity_bitwise, (
+        "2-process model differs from single-process model "
+        f"(AUC {two['auc']:.6f} vs {ref['auc']:.6f})")
+
+    # ---- leg 2: kill one process mid-run -------------------------------
+    kill_dir = os.path.join(workdir, "ckpt")
+    port = _free_port()
+    procs = [_spawn(workdir, port, pid, ITERS, checkpoint_every=1)
+             for pid in (0, 1)]
+    deadline = time.monotonic() + 600
+    while _manifest_iters(kill_dir) < KILL_AFTER:
+        if time.monotonic() > deadline:
+            for p in procs:
+                p.kill()
+            raise AssertionError(
+                f"checkpoint never reached {KILL_AFTER} iterations")
+        if any(p.poll() is not None for p in procs):
+            raise AssertionError(
+                "a training process exited before the kill point: "
+                f"{[p.poll() for p in procs]}")
+        time.sleep(0.2)
+    os.kill(procs[1].pid, signal.SIGKILL)  # "host 1 dies"
+    _log(f"killed process 1 at >= {KILL_AFTER} checkpointed iterations")
+    try:  # the survivor wedges in a collective against a dead peer
+        procs[0].wait(timeout=30)
+    except subprocess.TimeoutExpired:
+        procs[0].kill()
+        procs[0].wait()
+    procs[1].wait()
+
+    from mmlspark_tpu.parallel import elastic
+
+    ck = elastic.load_checkpoint(os.path.join(kill_dir, "checkpoint.pkl"))
+    assert ck is not None, "checkpoint unreadable after the kill"
+    done_at_kill = int(ck.num_iterations)
+    assert done_at_kill >= KILL_AFTER, done_at_kill
+    report["kill"] = {"iterations_at_kill": done_at_kill}
+    _log(f"checkpoint survived the kill with {done_at_kill} iterations")
+
+    # ---- leg 3: resume over the survivor -------------------------------
+    res_out = os.path.join(workdir, "resumed.json")
+    _run_single(workdir, ITERS, checkpoint_every=1, out=res_out,
+                local_devices=LOCAL_DEVICES)
+    with open(res_out) as f:
+        res = json.load(f)
+    assert res["num_iterations"] == ITERS, res["num_iterations"]
+    assert res["mesh_shape"] == [1, LOCAL_DEVICES], res
+    gap = abs(res["auc"] - ref["auc"])
+    report["resume"] = {
+        "mesh_shape": res["mesh_shape"],
+        "auc": res["auc"],
+        "iterations_resumed_from": done_at_kill,
+        "auc_gap_vs_uninterrupted": gap,
+    }
+    _log(f"resumed on (1, {LOCAL_DEVICES}) mesh: AUC={res['auc']:.5f} "
+         f"gap={gap:.2e}")
+    assert gap <= AUC_GAP, (
+        f"resumed AUC {res['auc']:.6f} drifts {gap:.2e} from the "
+        f"uninterrupted run {ref['auc']:.6f} (> {AUC_GAP})")
+
+    if out_json:
+        with open(out_json, "w") as f:
+            json.dump(report, f, indent=1)
+    _log("ALL LEGS PASSED")
+
+
+if __name__ == "__main__":
+    if "--child" in sys.argv:
+        run_child()
+    else:
+        main()

@@ -66,9 +66,16 @@ def _rank_label(rec: dict, fallback: Optional[dict] = None):
     integer rank (exact pre-fleet behavior); fleet replicas — which are
     all rank 0 of their own process — append the ``replica`` tag their
     records carry, so N same-host replicas aggregate side by side
-    instead of silently folding into one \"rank 0\"."""
+    instead of silently folding into one \"rank 0\".  Real multi-process
+    records additionally carry jax's ``process_index``; when it disagrees
+    with the launcher rank (coordinator renumbering, or records written
+    before bring-up resolved the rank) the label keeps both so distinct
+    processes never fold together."""
     fb = fallback or {}
     rank = rec.get("rank", fb.get("rank", 0))
+    pi = rec.get("process_index", fb.get("process_index"))
+    if pi is not None and pi != rank:
+        rank = f"{rank}/p{pi}"
     rep = rec.get("replica") or fb.get("replica")
     return f"{rank}.{rep}" if rep else rank
 

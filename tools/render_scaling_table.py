@@ -24,6 +24,7 @@ BEGIN, END = "<!-- scaling-table:begin -->", "<!-- scaling-table:end -->"
 
 _MODE_LABEL = {
     "data": "data (auto merge)",
+    "data_hier": "data + hierarchical 2D (2 hosts)",
     "data_allreduce": "data + allreduce",
     "data_bf16wire": "data + allreduce + bf16 wire",
     "data_quantize": "data + int16 quantized wire",
@@ -45,8 +46,9 @@ def render() -> str:
         data = json.load(f)
     lines = [
         "| D | mode | hist merge | steady wall | AUC | comm bytes/pass "
-        "| dominant collective (traced from the real program) |",
-        "|---|---|---|---|---|---|---|",
+        "| inter / intra | dominant collective (traced from the real "
+        "program) |",
+        "|---|---|---|---|---|---|---|---|",
     ]
     for entry in data:
         d = entry["n_devices"]
@@ -57,9 +59,12 @@ def render() -> str:
             merge = r.get("hist_merge", "allreduce")
             total = r.get("comm_traced_bytes")
             total_s = f"{total / 1e6:.2f} MB" if total else "—"
+            ab = r.get("axis_bytes")
+            ab_s = (f"{ab.get('inter', 0) / 1e6:.2f} / "
+                    f"{ab.get('intra', 0) / 1e6:.2f} MB" if ab else "—")
             lines.append(
                 f"| {d} | {label} | {merge} | {r['steady_wall_s']:.1f} s "
-                f"| {r['auc']:.4f} | {total_s} "
+                f"| {r['auc']:.4f} | {total_s} | {ab_s} "
                 f"| {_bytes_label(r['collectives'])} |"
             )
     return "\n".join(lines)
