@@ -1104,6 +1104,9 @@ def test_pred001_suppression_marks_sanctioned_conversions(tmp_path):
 
 
 def test_cli_exit_codes_and_json(tmp_path, capsys):
+    import json as _json
+
+    from tools.analyze import PASSES
     from tools.analyze.__main__ import main
 
     assert main([]) == 0  # the real tree is clean
@@ -1111,7 +1114,12 @@ def test_cli_exit_codes_and_json(tmp_path, capsys):
     assert "0 finding(s)" in out
 
     assert main(["--json"]) == 0
-    assert capsys.readouterr().out.strip() == "[]"
+    rep = _json.loads(capsys.readouterr().out)
+    assert rep["findings"] == []
+    # every pass (and the index build) reports its wall time
+    assert set(PASSES) <= set(rep["timings"])
+    assert "index_build" in rep["timings"]
+    assert rep["total_s"] > 0
 
     # a dirty root exits 1 and reports file:line
     _write(str(tmp_path / "mmlspark_tpu" / "native" / "k.cpp"), """
@@ -1853,4 +1861,490 @@ def test_cli_internal_error_exits_2(tmp_path, capsys, monkeypatch):
 
     monkeypatch.setattr(pkg, "run_all", boom)
     assert main([]) == 2
+    assert "internal error" in capsys.readouterr().err
+
+
+# ----------------------------------------- DET001..DET004 (determinism)
+# Taint flow from nondeterministic-order sources (unsorted directory
+# scans, set iteration, wall clock) into order/key-sensitive sinks
+# (collective wrappers, digests, manifests, fingerprints), plus the
+# syntactic global-RNG sweep.
+
+
+def test_det001_unsorted_scan_reaches_digest(tmp_path):
+    root = _pkg_tree(tmp_path, {
+        "parallel/manifest.py": """
+            import hashlib
+            import os
+
+            def shard_digest(d):
+                h = hashlib.sha256()
+                for fn in os.listdir(d):
+                    h.update(fn.encode())
+                return h.hexdigest()
+        """,
+    })
+    found = run_all(root, rules={"DET001"})
+    assert rules(found) == ["DET001"]
+    assert "filesystem-scan" in found[0].message
+
+
+def test_det001_interprocedural_hop_through_helper(tmp_path):
+    root = _pkg_tree(tmp_path, {
+        "parallel/manifest.py": """
+            import glob
+            import hashlib
+            import os
+
+            def _collect(paths):
+                return list(paths)
+
+            def digest_dir(d):
+                names = glob.glob(os.path.join(d, "*.bin"))
+                rows = _collect(names)
+                h = hashlib.sha256()
+                for r in rows:
+                    h.update(r.encode())
+                return h.hexdigest()
+        """,
+    })
+    found = run_all(root, rules={"DET001"})
+    assert rules(found) == ["DET001"]
+
+
+def test_det001_sorted_scan_is_silent(tmp_path):
+    root = _pkg_tree(tmp_path, {
+        "parallel/manifest.py": """
+            import hashlib
+            import os
+
+            def shard_digest(d):
+                h = hashlib.sha256()
+                for fn in sorted(os.listdir(d)):
+                    h.update(fn.encode())
+                return h.hexdigest()
+        """,
+    })
+    assert run_all(root, rules={"DET001"}) == []
+
+
+def test_det001_suppression_round_trip(tmp_path):
+    root = _pkg_tree(tmp_path, {
+        "parallel/manifest.py": """
+            import hashlib
+            import os
+
+            def shard_digest(d):
+                h = hashlib.sha256()
+                for fn in os.listdir(d):
+                    h.update(fn.encode())  # analyze: ignore[DET001]
+                return h.hexdigest()
+        """,
+    })
+    assert run_all(root, rules={"DET001"}) == []
+    raw = run_all(root, rules={"DET001"}, suppress=False)
+    assert rules(raw) == ["DET001"]
+
+
+def test_det002_set_order_reaches_collective(tmp_path):
+    root = _pkg_tree(tmp_path, {
+        "parallel/gather.py": """
+            def gather_feats(feats, x, host_allgather):
+                chosen = {f for f in feats if f > 0}
+                payload = [x[i] for i in chosen]
+                return host_allgather(payload)
+        """,
+    })
+    found = run_all(root, rules={"DET002"})
+    assert rules(found) == ["DET002"]
+    assert "set-iteration" in found[0].message
+
+
+def test_det002_sorted_set_is_silent(tmp_path):
+    root = _pkg_tree(tmp_path, {
+        "parallel/gather.py": """
+            def gather_feats(feats, x, host_allgather):
+                chosen = {f for f in feats if f > 0}
+                payload = [x[i] for i in sorted(chosen)]
+                return host_allgather(payload)
+        """,
+    })
+    assert run_all(root, rules={"DET002"}) == []
+
+
+def test_det002_jax_functional_set_update_is_silent(tmp_path):
+    # jax's `votes.at[idx].set(1.0)` has call leaf "set" — it must NOT
+    # count as a set-iteration source (the pre-fix false positive that
+    # flagged every voting psum in engine/tree.py)
+    root = _pkg_tree(tmp_path, {
+        "engine/vote.py": """
+            from jax import lax
+
+            def tally(votes, idx, axis_name):
+                votes = votes.at[idx].set(1.0)
+                return lax.psum(votes, axis_name)
+        """,
+    })
+    assert run_all(root, rules={"DET002"}) == []
+
+
+def test_det002_suppression_round_trip(tmp_path):
+    root = _pkg_tree(tmp_path, {
+        "parallel/gather.py": """
+            def gather_feats(feats, x, host_allgather):
+                chosen = {f for f in feats if f > 0}
+                # analyze: ignore[DET002]
+                return host_allgather(list(chosen))
+        """,
+    })
+    assert run_all(root, rules={"DET002"}) == []
+    assert rules(run_all(root, rules={"DET002"},
+                         suppress=False)) == ["DET002"]
+
+
+def test_det003_global_rng_calls_fire(tmp_path):
+    root = _pkg_tree(tmp_path, {
+        "data/sample.py": """
+            import random
+
+            import numpy as np
+
+            def shuffle_rows(x):
+                idx = np.random.permutation(len(x))
+                random.shuffle(idx)
+                rng = np.random.default_rng()
+                return x[idx], rng
+        """,
+    })
+    found = run_all(root, rules={"DET003"})
+    assert rules(found) == ["DET003", "DET003", "DET003"]
+
+
+def test_det003_seeded_and_local_generators_silent(tmp_path):
+    root = _pkg_tree(tmp_path, {
+        "data/sample.py": """
+            import numpy as np
+
+            def shuffle_rows(x, seed):
+                rng = np.random.default_rng(seed)
+                other = np.random.default_rng(0)
+                rng.shuffle(x)
+                return x, other
+        """,
+    })
+    assert run_all(root, rules={"DET003"}) == []
+
+
+def test_det003_suppression_round_trip(tmp_path):
+    root = _pkg_tree(tmp_path, {
+        "data/sample.py": """
+            import numpy as np
+
+            def jitter(x):
+                return x + np.random.normal()  # analyze: ignore[DET003]
+        """,
+    })
+    assert run_all(root, rules={"DET003"}) == []
+    assert rules(run_all(root, rules={"DET003"},
+                         suppress=False)) == ["DET003"]
+
+
+def test_det004_wall_clock_reaches_fingerprint(tmp_path):
+    root = _pkg_tree(tmp_path, {
+        "core/keys.py": """
+            import hashlib
+            import time
+
+            def cache_key(name):
+                stamp = time.time()
+                return hashlib.md5(f"{name}:{stamp}".encode()).hexdigest()
+        """,
+    })
+    found = run_all(root, rules={"DET004"})
+    assert rules(found) == ["DET004"]
+    assert "wall-clock" in found[0].message
+
+
+def test_det004_datetime_now_into_cache_subscript(tmp_path):
+    root = _pkg_tree(tmp_path, {
+        "core/keys.py": """
+            import datetime
+
+            _CACHE = {}
+
+            def remember(name, value):
+                stamp = datetime.datetime.now().isoformat()
+                _CACHE[f"{name}:{stamp}"] = value
+        """,
+    })
+    found = run_all(root, rules={"DET004"})
+    assert rules(found) == ["DET004"]
+
+
+def test_det004_duration_logging_is_silent(tmp_path):
+    root = _pkg_tree(tmp_path, {
+        "core/keys.py": """
+            import time
+
+            def timed(fn):
+                t0 = time.monotonic()
+                out = fn()
+                print(time.monotonic() - t0)
+                return out
+        """,
+    })
+    assert run_all(root, rules={"DET004"}) == []
+
+
+def test_det004_suppression_round_trip(tmp_path):
+    root = _pkg_tree(tmp_path, {
+        "core/keys.py": """
+            import hashlib
+            import time
+
+            def cache_key(name):
+                stamp = time.time()
+                # analyze: ignore[DET004]
+                return hashlib.md5(f"{name}:{stamp}".encode()).hexdigest()
+        """,
+    })
+    assert run_all(root, rules={"DET004"}) == []
+    assert rules(run_all(root, rules={"DET004"},
+                         suppress=False)) == ["DET004"]
+
+
+def test_det_real_tree_is_clean():
+    """Regression pin for the live fixes: every manifest/digest path in
+    the real tree scans sorted and no wall clock reaches a cache key."""
+    assert run_all(repo_root(),
+                   rules={"DET001", "DET002", "DET003", "DET004"}) == []
+
+
+# ------------------------------------------ DON001/DON002 (donation)
+# Use-after-donation returns garbage on TPU but works on CPU (the
+# buffer is only really invalidated on accelerators), so tests never
+# catch it — the analyzer has to.
+
+
+def test_don001_read_after_donation_module_binding(tmp_path):
+    root = _pkg_tree(tmp_path, {
+        "data/cache.py": """
+            import jax
+
+            def _step(buf, occ, rows):
+                return buf + rows, occ + 1
+
+            step = jax.jit(_step, donate_argnums=(0, 1))
+
+            def bad_loop(buf, occ, rows):
+                out, occ2 = step(buf, occ, rows)
+                total = buf.sum()
+                return out, occ2, total
+        """,
+    })
+    found = run_all(root, rules={"DON001"})
+    assert rules(found) == ["DON001"]
+    assert "donated" in found[0].message
+    assert "'buf'" in found[0].message
+
+
+def test_don001_local_binding_and_any_path_read(tmp_path):
+    # the read only happens on ONE CFG path — must still fire
+    root = _pkg_tree(tmp_path, {
+        "data/cache.py": """
+            import jax
+
+            def _step(buf, occ):
+                return buf * 2, occ + 1
+
+            def run(buf, occ, check):
+                step = jax.jit(_step, donate_argnums=(0,))
+                out, occ = step(buf, occ)
+                if check:
+                    return buf.sum()
+                return out
+        """,
+    })
+    found = run_all(root, rules={"DON001"})
+    assert rules(found) == ["DON001"]
+
+
+def test_don001_rebinding_idiom_is_silent(tmp_path):
+    # the data/streaming.py shape: the donated operand is REBOUND by the
+    # call's own result, so no stale name survives the call
+    root = _pkg_tree(tmp_path, {
+        "data/cache.py": """
+            import jax
+
+            def _step(buf, occ, rows):
+                return buf + rows, occ + 1
+
+            step = jax.jit(_step, donate_argnums=(0, 1))
+
+            def good_loop(buf, occ, chunks):
+                for rows in chunks:
+                    buf, occ = step(buf, occ, rows)
+                buf.block_until_ready()
+                return buf, occ
+        """,
+    })
+    assert run_all(root, rules={"DON001"}) == []
+
+
+def test_don001_suppression_round_trip(tmp_path):
+    root = _pkg_tree(tmp_path, {
+        "data/cache.py": """
+            import jax
+
+            def _step(buf):
+                return buf * 2
+
+            step = jax.jit(_step, donate_argnums=(0,))
+
+            def peek(buf):
+                out = step(buf)
+                return out, buf.shape  # analyze: ignore[DON001]
+        """,
+    })
+    assert run_all(root, rules={"DON001"}) == []
+    assert rules(run_all(root, rules={"DON001"},
+                         suppress=False)) == ["DON001"]
+
+
+def test_don002_aliased_donated_arguments(tmp_path):
+    root = _pkg_tree(tmp_path, {
+        "data/cache.py": """
+            import jax
+
+            def _step(a, b):
+                return a + b
+
+            step = jax.jit(_step, donate_argnums=(0, 1))
+
+            def aliased(buf):
+                other = buf
+                return step(buf, other)
+        """,
+    })
+    found = run_all(root, rules={"DON002"})
+    assert rules(found) == ["DON002"]
+    assert "alias" in found[0].message
+
+
+def test_don002_distinct_buffers_silent(tmp_path):
+    root = _pkg_tree(tmp_path, {
+        "data/cache.py": """
+            import jax
+
+            def _step(a, b):
+                return a + b
+
+            step = jax.jit(_step, donate_argnums=(0, 1))
+
+            def fine(buf, occ):
+                return step(buf, occ)
+        """,
+    })
+    assert run_all(root, rules={"DON002"}) == []
+
+
+def test_don002_suppression_round_trip(tmp_path):
+    root = _pkg_tree(tmp_path, {
+        "data/cache.py": """
+            import jax
+
+            def _step(a, b):
+                return a + b
+
+            step = jax.jit(_step, donate_argnums=(0, 1))
+
+            def aliased(buf):
+                other = buf
+                return step(buf, other)  # analyze: ignore[DON002]
+        """,
+    })
+    assert run_all(root, rules={"DON002"}) == []
+    assert rules(run_all(root, rules={"DON002"},
+                         suppress=False)) == ["DON002"]
+
+
+def test_don_real_tree_is_clean():
+    """Regression pin: the live donation sites (data/streaming.py's
+    donated chunk loop above all) use the rebinding idiom and never
+    touch a stale donated name."""
+    assert run_all(repo_root(), rules={"DON001", "DON002"}) == []
+
+
+# -------------------------------------------------- runtime budget
+
+
+def test_full_run_wall_time_budget():
+    """All fourteen passes (index built once) stay under the 15s CI
+    budget, and the timings out-param attributes the wall per pass."""
+    import time as _time
+
+    from tools.analyze import PASSES
+
+    assert len(PASSES) == 14
+    timings = {}
+    t0 = _time.monotonic()
+    run_all(repo_root(), timings=timings)
+    dt = _time.monotonic() - t0
+    assert dt < 15.0, f"analyze runtime budget blown: {dt:.2f}s"
+    assert set(PASSES) <= set(timings)
+    assert "index_build" in timings
+    assert all(v >= 0 for v in timings.values())
+
+
+# ------------------------------------------------- --changed-only
+
+
+def _git(root, *args):
+    import subprocess
+
+    return subprocess.run(
+        ["git", "-C", root, "-c", "user.email=ci@example.invalid",
+         "-c", "user.name=ci", *args],
+        check=True, capture_output=True, text=True).stdout
+
+
+def test_cli_changed_only_filters_to_diff(tmp_path, capsys):
+    from tools.analyze.__main__ import main
+
+    root = _pkg_tree(tmp_path, {
+        "core/x.py": 'print("noisy committed")\n',
+    })
+    _git(root, "init", "-q")
+    _git(root, "add", "-A")
+    _git(root, "commit", "-qm", "base")
+
+    # full run sees the committed finding
+    assert main(["--root", root]) == 1
+    assert "core/x.py" in capsys.readouterr().out
+
+    # changed-only vs HEAD: nothing changed -> clean exit
+    assert main(["--root", root, "--changed-only"]) == 0
+    capsys.readouterr()
+
+    # an UNTRACKED noisy file is "changed" — only it is reported
+    _write(os.path.join(root, "mmlspark_tpu", "core", "y.py"),
+           'print("noisy new")\n')
+    assert main(["--root", root, "--changed-only"]) == 1
+    out = capsys.readouterr().out
+    assert "core/y.py" in out and "core/x.py" not in out
+
+    # a MODIFIED tracked file shows up vs the explicit base too
+    _write(os.path.join(root, "mmlspark_tpu", "core", "x.py"),
+           'print("noisy edited")\n')
+    assert main(["--root", root, "--changed-only", "HEAD"]) == 1
+    out = capsys.readouterr().out
+    assert "core/x.py" in out and "core/y.py" in out
+
+
+def test_cli_changed_only_git_failure_exits_2(tmp_path, capsys):
+    from tools.analyze.__main__ import main
+
+    root = _pkg_tree(tmp_path, {"core/x.py": "x = 1\n"})
+    # not a git repo -> git fails -> internal-error exit code
+    assert main(["--root", root, "--changed-only"]) == 2
     assert "internal error" in capsys.readouterr().err

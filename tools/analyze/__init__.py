@@ -1,21 +1,23 @@
 """Repo-native static-analysis suite (see README.md in this directory).
 
-Twelve passes over a shared project index (built once per run by
+Fourteen passes over a shared project index (built once per run by
 :mod:`tools.analyze.engine`): the nine per-file-portable passes (ABI,
 collectives, tracer, hygiene, obs, serving, predict, quantize,
 ingest) plus the
 index-native interprocedural passes (collective order COL005/COL006,
-serve-layer locks LCK001–003, dtype-contract flow DTY001).  Each pass
+serve-layer locks LCK001–003, dtype-contract flow DTY001, determinism
+flow DET001–DET004, donation safety DON001/DON002).  Each pass
 returns :class:`tools.analyze.common.Finding` rows; :func:`run_all`
 builds the index, runs the passes, and applies inline
 ``# analyze: ignore[RULE]`` suppressions.  CLI:
 ``python -m tools.analyze [--json|--sarif] [--rule R,..] [--path P]
-[--stale-ignores]``.
+[--changed-only [BASE]] [--stale-ignores]``.
 """
 
 from __future__ import annotations
 
 import os
+import time
 
 from tools.analyze.abi import check_abi
 from tools.analyze.collectives import check_collectives
@@ -63,6 +65,18 @@ def _check_dtype_flow(index):
     return check_dtype_flow(index)
 
 
+def _check_determinism(index):
+    from tools.analyze.engine import check_determinism
+
+    return check_determinism(index)
+
+
+def _check_donation(index):
+    from tools.analyze.engine import check_donation
+
+    return check_donation(index)
+
+
 #: pass name -> (runner(root, index), rule ids it can emit).  ``--rule``
 #: uses the rule sets to select which passes actually run.
 PASSES = {
@@ -92,6 +106,10 @@ PASSES = {
               {"LCK001", "LCK002", "LCK003"}),
     "dtype_flow": (lambda root, index: _check_dtype_flow(index),
                    {"DTY001"}),
+    "determinism": (lambda root, index: _check_determinism(index),
+                    {"DET001", "DET002", "DET003", "DET004"}),
+    "donation": (lambda root, index: _check_donation(index),
+                 {"DON001", "DON002"}),
 }
 
 
@@ -104,24 +122,33 @@ def all_rules() -> set:
 
 def run_all(root: "str | None" = None, rules: "set | None" = None,
             path_prefix: "str | None" = None,
-            suppress: bool = True) -> list:
+            suppress: bool = True,
+            timings: "dict | None" = None) -> list:
     """Run the analysis passes over ``root``.
 
     ``rules`` restricts execution to the passes owning those rule ids
     (and the returned findings to exactly those rules);
     ``path_prefix`` keeps findings whose repo-relative path starts with
     the prefix; ``suppress=False`` skips inline-comment filtering (the
-    ``--stale-ignores`` driver needs the raw set).
+    ``--stale-ignores`` driver needs the raw set).  A ``timings`` dict,
+    when passed, is filled with per-pass wall seconds (plus
+    ``index_build``) so CI latency growth is attributable per pass.
     """
     from tools.analyze.engine import build_index
 
     root = root or repo_root()
+    t0 = time.perf_counter()
     index = build_index(root)
+    if timings is not None:
+        timings["index_build"] = time.perf_counter() - t0
     findings: list = []
-    for _name, (runner, owned) in PASSES.items():
+    for name, (runner, owned) in PASSES.items():
         if rules is not None and not (owned & rules):
             continue
+        t0 = time.perf_counter()
         findings.extend(runner(root, index))
+        if timings is not None:
+            timings[name] = time.perf_counter() - t0
     if rules is not None:
         findings = [f for f in findings if f.rule in rules]
     if path_prefix is not None:

@@ -2,12 +2,18 @@
 
 Options: ``--json`` / ``--sarif`` (machine-readable output), ``--root
 PATH``, ``--rule RULE[,RULE]`` (run only the owning passes), ``--path
-PREFIX`` (keep findings under a repo-relative prefix), and
-``--stale-ignores`` (report suppression comments that no longer silence
-anything).
+PREFIX`` (keep findings under a repo-relative prefix),
+``--changed-only [BASE]`` (keep findings only in files changed vs BASE
+per ``git diff --name-only`` plus untracked files — the fast PR leg),
+and ``--stale-ignores`` (report suppression comments that no longer
+silence anything).
+
+``--json`` emits ``{"findings": [...], "timings": {pass: seconds},
+"total_s": float}`` so CI latency growth is attributable per pass.
 
 Exit codes are explicit and CI-stable: 0 clean, 1 findings (or stale
-ignores in ``--stale-ignores`` mode), 2 internal analyzer error.
+ignores in ``--stale-ignores`` mode), 2 internal analyzer error
+(including git failures under ``--changed-only``).
 """
 
 from __future__ import annotations
@@ -16,7 +22,9 @@ import argparse
 import dataclasses
 import json
 import os
+import subprocess
 import sys
+import time
 
 
 def _sarif(findings, root: str) -> dict:
@@ -49,6 +57,26 @@ def _sarif(findings, root: str) -> dict:
     }
 
 
+def _changed_files(root: str, base: str) -> set:
+    """Absolute paths of files changed vs ``base`` plus untracked files.
+
+    Raises on any git failure (no repo, unknown base) — the caller's
+    generic handler turns that into exit code 2 rather than silently
+    analyzing nothing.
+    """
+    def _git(*args):
+        out = subprocess.run(
+            ["git", "-C", root, *args],
+            check=True, capture_output=True, text=True,
+        ).stdout
+        return [ln.strip() for ln in out.splitlines() if ln.strip()]
+
+    top = _git("rev-parse", "--show-toplevel")[0]
+    names = _git("diff", "--name-only", base)
+    names += _git("ls-files", "--others", "--exclude-standard")
+    return {os.path.normpath(os.path.join(top, n)) for n in names}
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(prog="python -m tools.analyze")
     ap.add_argument("--json", action="store_true",
@@ -61,6 +89,11 @@ def main(argv=None) -> int:
                     help="run only the passes owning these rule ids")
     ap.add_argument("--path", default=None, metavar="PREFIX",
                     help="keep findings under this repo-relative prefix")
+    ap.add_argument("--changed-only", nargs="?", const="HEAD",
+                    default=None, metavar="BASE",
+                    help="keep only findings in files changed vs BASE "
+                         "(git diff --name-only; default HEAD) plus "
+                         "untracked files")
     ap.add_argument("--stale-ignores", action="store_true",
                     help="report analyze:ignore comments that no longer "
                          "match any finding")
@@ -74,6 +107,8 @@ def main(argv=None) -> int:
         )
 
         root = opts.root or repo_root()
+        t_start = time.perf_counter()
+        timings: dict = {}
         if opts.stale_ignores:
             findings = run_stale_ignores(root)
             label = "stale ignore(s)"
@@ -86,13 +121,25 @@ def main(argv=None) -> int:
                 if unknown:
                     ap.error(f"unknown rule id(s): "
                              f"{', '.join(sorted(unknown))}")
-            findings = run_all(root, rules=rules, path_prefix=opts.path)
+            findings = run_all(root, rules=rules, path_prefix=opts.path,
+                               timings=timings)
             label = "finding(s)"
+        if opts.changed_only is not None:
+            changed = _changed_files(root, opts.changed_only)
+            findings = [
+                f for f in findings
+                if os.path.normpath(os.path.abspath(f.file)) in changed
+            ]
+        total_s = time.perf_counter() - t_start
         if opts.sarif:
             print(json.dumps(_sarif(findings, root), indent=2))
         elif opts.json:
-            print(json.dumps([dataclasses.asdict(f) for f in findings],
-                             indent=2))
+            print(json.dumps({
+                "findings": [dataclasses.asdict(f) for f in findings],
+                "timings": {k: round(v, 4)
+                            for k, v in sorted(timings.items())},
+                "total_s": round(total_s, 4),
+            }, indent=2))
         else:
             for f in findings:
                 print(f)

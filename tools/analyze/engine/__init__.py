@@ -3,16 +3,24 @@ only make sense interprocedurally.
 
 ``build_index`` parses the repo once (module graph, symbol table, call
 graph with guard/loop context, CFG cache); the seven original per-file
-passes consume it through their ``index=`` parameter, and the three
+passes consume it through their ``index=`` parameter, and the
 index-native passes live here:
 
 - :mod:`tools.analyze.engine.collective_order` — COL005/COL006
 - :mod:`tools.analyze.engine.locks` — LCK001..LCK003
 - :mod:`tools.analyze.engine.dtype_flow` — DTY001
+- :mod:`tools.analyze.engine.determinism` — DET001..DET004
+- :mod:`tools.analyze.engine.donation` — DON001/DON002
+
+:mod:`tools.analyze.engine.taint` holds the shared interprocedural
+assignment-taint machinery (generalized from the DTY001 flow) that the
+determinism and donation passes build on.
 """
 
 from tools.analyze.engine.cfg import CFG, ForwardDataflow, build_cfg
 from tools.analyze.engine.collective_order import check_collective_order
+from tools.analyze.engine.determinism import check_determinism
+from tools.analyze.engine.donation import check_donation
 from tools.analyze.engine.dtype_flow import check_dtype_flow
 from tools.analyze.engine.index import (
     CallSite,
@@ -23,6 +31,11 @@ from tools.analyze.engine.index import (
     build_index,
 )
 from tools.analyze.engine.locks import check_locks
+from tools.analyze.engine.taint import (
+    InterproceduralPass,
+    Summaries,
+    TaintFlow,
+)
 
 __all__ = [
     "CFG",
@@ -30,11 +43,16 @@ __all__ = [
     "ClassInfo",
     "ForwardDataflow",
     "FunctionInfo",
+    "InterproceduralPass",
     "ModuleInfo",
     "ProjectIndex",
+    "Summaries",
+    "TaintFlow",
     "build_cfg",
     "build_index",
     "check_collective_order",
+    "check_determinism",
+    "check_donation",
     "check_dtype_flow",
     "check_locks",
 ]

@@ -1,0 +1,323 @@
+"""DET001–DET004 — determinism flow.
+
+The repo's headline guarantees are *bitwise*: recorded fixed-order
+reductions (``parallel/distributed.py``), digest-verified elastic
+checkpoints and shard manifests (``parallel/elastic.py``), AOT
+fingerprints (``core/jit_cache.py`` / ``core/trace_cache.py``), and
+per-tenant serving parity.  All of them assume every process computes
+the same bytes from the same inputs.  Two things silently break that
+assumption and work fine on one host:
+
+- **ordering nondeterminism** — ``os.listdir``/``glob`` return
+  filesystem order (differs across hosts, filesystems, and runs) and
+  set iteration order depends on hash seeding and insertion history.
+  Feed either into a collective, digest, manifest, or fingerprint and
+  two processes disagree bitwise while each is locally self-consistent;
+- **wall-clock keys** — ``time.time()`` / ``datetime.now()`` folded
+  into a cache key or fingerprint means the key never matches across
+  runs (every run re-compiles / re-computes) or, worse, *collides*
+  differently per process.
+
+This pass runs a tagged taint dataflow (:mod:`.taint`, generalized from
+the DTY001 flow) over every package module:
+
+- ``scan`` taint: unsorted ``os.listdir``/``os.scandir``/``glob.glob``/
+  ``iglob``/``iterdir``/``rglob``/``os.walk`` results (``sorted(...)``
+  and ``.sort()`` drop it) — DET001 when it reaches an order-sensitive
+  sink;
+- ``set`` taint: ``set()``/``frozenset()`` calls, set literals and set
+  comprehensions — DET002 at the same sinks;
+- ``clock`` taint: ``time.time``/``time_ns``/``monotonic``/
+  ``perf_counter``, ``datetime.now``/``utcnow``/``today`` — DET004 when
+  it reaches a digest, fingerprint-shaped callee, or a
+  cache/memo-subscript store;
+- DET003 is syntactic: calls into the process-global ``random`` /
+  ``np.random`` module-level RNG (or constructing an **unseeded**
+  ``default_rng()``/``Random()``/``RandomState()``) anywhere in library
+  code — shared unseeded state is unreproducible by construction.
+
+Order-sensitive sinks: the repo's collective wrappers and raw lax
+collectives, the manifest/checkpoint writers, hash constructors and
+``.update`` on a hash object, and callees whose names say they build a
+fingerprint/digest/cache key.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import FrozenSet, List, Optional, Set
+
+from tools.analyze.common import Finding
+from tools.analyze.engine.index import ProjectIndex
+from tools.analyze.engine.taint import (
+    InterproceduralPass,
+    Taint,
+    TaintFlow,
+    head_exprs,
+    leaf_name,
+    walk_expr,
+)
+
+#: filesystem-scan calls whose result order is filesystem-dependent
+_SCAN_CALLS = {"listdir", "scandir", "iglob", "iterdir", "rglob", "walk",
+               "glob"}
+#: collective entry points — the repo's wrappers + the raw lax names
+_COLLECTIVE_SINKS = {
+    "psum_axes", "device_psum", "device_psum_exact", "device_psum_scatter",
+    "device_all_gather", "device_psum_int", "device_psum_scatter_int",
+    "host_allgather", "host_allgather_ragged_rows", "host_allgather_blobs",
+    "psum", "pmean", "all_gather", "psum_scatter", "all_to_all", "pmax",
+    "pmin",
+}
+#: manifest / checkpoint writers (cross-process digest surface)
+_MANIFEST_SINKS = {"write_manifest", "assign_shards", "ShardManifest",
+                   "write_checkpoint"}
+#: hash constructors
+_HASH_SINKS = {"sha256", "sha1", "sha512", "md5", "blake2b", "blake2s"}
+#: substrings marking a callee as fingerprint/cache-key-shaped
+_KEYISH_PARTS = ("fingerprint", "cache_key", "digest", "make_key",
+                 "checksum")
+#: receiver-name substrings that make a bare ``.update(x)`` a hash update
+_HASHY_RECV = ("hash", "sha", "md5", "blake", "digest", "hasher")
+
+_PY_RANDOM_FNS = {
+    "random", "randint", "randrange", "choice", "choices", "shuffle",
+    "sample", "uniform", "gauss", "normalvariate", "betavariate",
+    "expovariate", "triangular", "getrandbits", "randbytes",
+}
+_NP_RANDOM_FNS = {
+    "rand", "randn", "randint", "random", "random_sample", "choice",
+    "permutation", "shuffle", "uniform", "normal", "standard_normal",
+    "binomial", "poisson", "beta", "gamma", "exponential", "integers",
+}
+
+
+def _keyish(name: Optional[str]) -> bool:
+    if not name:
+        return False
+    low = name.lower()
+    return (any(p in low for p in _KEYISH_PARTS)
+            or low.endswith("_hash") or low.startswith("hash_"))
+
+
+def _hashy_update(call: ast.Call) -> bool:
+    """``h.update(x)`` where the receiver looks like a hash object."""
+    func = call.func
+    if not (isinstance(func, ast.Attribute) and func.attr == "update"):
+        return False
+    try:
+        recv = ast.unparse(func.value).lower()
+    except Exception:  # pragma: no cover
+        return False
+    return recv == "h" or any(p in recv for p in _HASHY_RECV)
+
+
+class _DetFlow(TaintFlow):
+    """scan/set/clock tagged taint with the determinism sinks.
+
+    ``set`` taint is deliberately intraprocedural: set-typed values are
+    everywhere (pickle state, categorical index sets), and any numeric
+    value *derived* from one would keep the tag through the whole call
+    graph, drowning the signal.  ``scan``/``clock`` sources are rare, so
+    they cross call boundaries.
+    """
+
+    INTERPROC_TAGS = frozenset({"scan", "clock"})
+
+    def call_source_tag(self, call: ast.Call) -> Optional[str]:
+        leaf = leaf_name(call.func)
+        if leaf in _SCAN_CALLS:
+            return "scan"
+        # constructor form only: jax's functional-update ``x.at[i].set(v)``
+        # also has leaf "set" and must not taint
+        if isinstance(call.func, ast.Name) and \
+                call.func.id in ("set", "frozenset"):
+            return "set"
+        if leaf in ("time", "time_ns", "monotonic", "monotonic_ns",
+                    "perf_counter", "perf_counter_ns"):
+            recv = call.func
+            if isinstance(recv, ast.Attribute):
+                if isinstance(recv.value, ast.Name) and \
+                        recv.value.id == "time":
+                    return "clock"
+                return None
+            return "clock"  # bare name (from time import ...)
+        if leaf in ("now", "utcnow", "today") and \
+                isinstance(call.func, ast.Attribute):
+            return "clock"
+        return None
+
+    def expr_source_tag(self, expr) -> Optional[str]:
+        if isinstance(expr, (ast.Set, ast.SetComp)):
+            return "set"
+        return None
+
+    # -- sinks -----------------------------------------------------------
+    def _arg_tags(self, call: ast.Call, state) -> Set[Taint]:
+        tags: Set[Taint] = set()
+        for a in call.args:
+            tags |= self.tags_of(a, state)
+        for kw in call.keywords:
+            tags |= self.tags_of(kw.value, state)
+        return tags
+
+    def _sink_call(self, call: ast.Call, state) -> None:
+        # taint the call as an expression first — this is what grows the
+        # interprocedural arg->param facts for bare-statement calls
+        self.tags_of(call, state)
+        leaf = leaf_name(call.func)
+        order_sink = (leaf in _COLLECTIVE_SINKS
+                      or leaf in _MANIFEST_SINKS
+                      or leaf in _HASH_SINKS
+                      or _keyish(leaf) or _hashy_update(call))
+        key_sink = (leaf in _HASH_SINKS or _keyish(leaf)
+                    or _hashy_update(call))
+        if not (order_sink or key_sink) or self.emit is None:
+            return
+        tags = self._arg_tags(call, state)
+        if not tags:
+            return
+        try:
+            sink = ast.unparse(call.func)
+        except Exception:  # pragma: no cover
+            sink = leaf or "<call>"
+        roots = sorted({n for n, _ in tags})
+        if order_sink:
+            if any(t == "scan" for _, t in tags):
+                self.emit(
+                    self.fi, call.lineno, "DET001",
+                    f"unsorted filesystem-scan order ({roots[0]!r}) "
+                    f"reaches order-sensitive sink {sink}(...) — "
+                    "os.listdir/glob order varies across hosts and "
+                    "filesystems, so collectives/digests/manifests "
+                    "built from it diverge bitwise across processes; "
+                    "wrap the scan in sorted(...)",
+                )
+            if any(t == "set" for _, t in tags):
+                self.emit(
+                    self.fi, call.lineno, "DET002",
+                    f"set-iteration order ({roots[0]!r}) reaches "
+                    f"order-sensitive sink {sink}(...) — set order "
+                    "depends on hash seeding and insertion history, so "
+                    "two processes disagree bitwise; sort the elements "
+                    "(sorted(s)) before they feed a collective, digest "
+                    "or manifest",
+                )
+        if key_sink and any(t == "clock" for _, t in tags):
+            self.emit(
+                self.fi, call.lineno, "DET004",
+                f"wall-clock value ({roots[0]!r}) reaches cache-key/"
+                f"fingerprint sink {sink}(...) — time.time()/"
+                "datetime.now() differ per process and per run, so the "
+                "key never matches across runs (or collides "
+                "differently per host); key on content — versions, "
+                "shapes, source digests — instead",
+            )
+
+    def check_stmt(self, stmt, state: FrozenSet[Taint]) -> None:
+        for e in head_exprs(stmt):
+            for node in walk_expr(e):
+                if isinstance(node, ast.Call):
+                    self._sink_call(node, state)
+        # DET004's cache-store sink: cache[key] = ... with a clock key
+        if self.emit is not None and isinstance(stmt, ast.Assign):
+            for tgt in stmt.targets:
+                if not isinstance(tgt, ast.Subscript):
+                    continue
+                try:
+                    base = ast.unparse(tgt.value).lower()
+                except Exception:  # pragma: no cover
+                    continue
+                if "cache" not in base and "memo" not in base:
+                    continue
+                tags = self.tags_of(tgt.slice, state)
+                if any(t == "clock" for _, t in tags):
+                    self.emit(
+                        self.fi, stmt.lineno, "DET004",
+                        "wall-clock value used as a cache key "
+                        f"(store into {ast.unparse(tgt.value)}[...]) — "
+                        "a time-derived key never repeats, so the "
+                        "cache can only miss; key on content instead",
+                    )
+
+
+class DeterminismPass(InterproceduralPass):
+    flow_cls = _DetFlow
+
+    def __init__(self, index: ProjectIndex):
+        super().__init__(index, (
+            fi for mi in index.package_modules() for fi in mi.functions
+        ))
+
+
+def _rng_findings(index: ProjectIndex) -> List[Finding]:
+    """DET003 — process-global / unseeded RNG use in library code."""
+    out: List[Finding] = []
+    for mi in index.package_modules():
+        for node in ast.walk(mi.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            what = None
+            if isinstance(f, ast.Attribute) and \
+                    isinstance(f.value, ast.Name):
+                tgt = mi.imports.get(f.value.id)
+                if tgt == "random":
+                    if f.attr in _PY_RANDOM_FNS:
+                        what = f"random.{f.attr}"
+                    elif f.attr == "Random" and not node.args:
+                        what = "random.Random()"
+                elif tgt in ("numpy:random", "numpy.random"):
+                    if f.attr in _NP_RANDOM_FNS:
+                        what = f"np.random.{f.attr}"
+                    elif f.attr in ("default_rng", "RandomState") and \
+                            not node.args:
+                        what = f"np.random.{f.attr}()"
+            elif isinstance(f, ast.Attribute) and \
+                    isinstance(f.value, ast.Attribute) and \
+                    f.value.attr == "random" and \
+                    isinstance(f.value.value, ast.Name) and \
+                    mi.imports.get(f.value.value.id) == "numpy":
+                if f.attr in _NP_RANDOM_FNS:
+                    what = f"np.random.{f.attr}"
+                elif f.attr in ("default_rng", "RandomState") and \
+                        not node.args:
+                    what = f"np.random.{f.attr}()"
+            elif isinstance(f, ast.Name):
+                tgt = mi.imports.get(f.id)
+                if tgt and ":" in tgt:
+                    mod, attr = tgt.split(":", 1)
+                    if mod == "random" and attr in _PY_RANDOM_FNS:
+                        what = f"random.{attr}"
+                    elif mod in ("numpy.random", "numpy") and \
+                            attr in _NP_RANDOM_FNS:
+                        what = f"np.random.{attr}"
+                    elif attr in ("default_rng", "RandomState",
+                                  "Random") and not node.args and \
+                            mod in ("numpy.random", "numpy", "random"):
+                        what = f"{attr}()"
+            if what is None:
+                continue
+            if what.endswith("()"):
+                msg = (f"unseeded generator construction {what} in "
+                       "library code — every process draws a different "
+                       "stream, so sampling-dependent results (GOSS "
+                       "drops, feature subsets) are unreproducible; "
+                       "seed it explicitly (default_rng(seed) / "
+                       "Random(seed)), deriving per-process seeds from "
+                       "a recorded base seed")
+            else:
+                msg = (f"module-level RNG call {what}(...) uses the "
+                       "process-global unseeded generator — library "
+                       "code must draw from an explicit seeded "
+                       "generator (np.random.default_rng(seed) / "
+                       "random.Random(seed)) so training and sampling "
+                       "are reproducible across runs and processes")
+            out.append(Finding(mi.path, node.lineno, "DET003", msg))
+    return out
+
+
+def check_determinism(index: ProjectIndex) -> List[Finding]:
+    findings = DeterminismPass(index).run_rules()
+    findings.extend(_rng_findings(index))
+    return findings

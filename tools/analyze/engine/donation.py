@@ -1,0 +1,324 @@
+"""DON001/DON002 — buffer-donation safety.
+
+``jax.jit(f, donate_argnums=...)`` lets XLA reuse an input buffer for an
+output — the double-buffered streamed-ingest step (``data/streaming.py``)
+leans on it to assemble TB-scale bin caches without a second copy.  The
+contract is brutal on TPU: after the call, the donated buffer is
+*invalid*.  Reading it returns garbage (or raises, backend-dependent).
+On CPU jax often ignores donation entirely, so the canonical bug —
+touch a donated array after the jitted step — passes every CPU test and
+corrupts silently on the accelerator.  The only safe idiom is the one
+streaming uses: rebind the donated names from the call's results
+(``buf, occ = step(buf, occ, ...)``).
+
+Two rules over the engine index:
+
+- **DON001** — a value passed in a ``donate_argnums`` position of a
+  jitted callable is read on *any* CFG path after the call without
+  being rebound first.  The query is a forward may-analysis over the
+  function CFG: state = the set of names (and simple ``self.attr``
+  targets) currently holding a donated-dead buffer, joined by union
+  over paths; a read of a dead name reports, an assignment to it kills
+  the deadness (so the rebinding idiom is clean — call arguments are
+  read *before* the targets bind).
+- **DON002** — two donated positions of one call resolve to the same
+  object (textually identical arguments, or names linked by a simple
+  single-assignment alias): XLA would alias one buffer for two
+  outputs.
+
+Donated callables are found by scanning each frame (module body, each
+function, class ``__init__`` attrs) for ``name = jax.jit(fn,
+donate_argnums=...)`` / ``self.step = jax.jit(...)`` bindings (also
+``pjit`` / ``pmap``), plus the inline ``jax.jit(fn, donate_argnums=...)
+(args)`` form.  ``donate_argnames`` and non-constant argnums are out of
+scope (no positional map); donated *expressions* (``bufs[i]``) are
+tracked for DON002's textual aliasing but not for DON001 liveness.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, FrozenSet, List, Optional, Set, Tuple
+
+from tools.analyze.common import Finding
+from tools.analyze.engine.cfg import ForwardDataflow
+from tools.analyze.engine.index import FunctionInfo, ModuleInfo, ProjectIndex
+from tools.analyze.engine.taint import (
+    head_exprs,
+    leaf_name,
+    store_target_keys,
+    walk_expr,
+)
+
+_JIT_NAMES = {"jit", "pjit", "pmap"}
+
+#: one dead fact: (key, callee text, donation line)
+Dead = Tuple[str, str, int]
+
+
+def _donate_positions(expr) -> Optional[Tuple[int, ...]]:
+    """Constant ``donate_argnums`` of a jit/pjit/pmap call, else None."""
+    if not isinstance(expr, ast.Call):
+        return None
+    if leaf_name(expr.func) not in _JIT_NAMES:
+        return None
+    for kw in expr.keywords:
+        if kw.arg != "donate_argnums":
+            continue
+        v = kw.value
+        if isinstance(v, ast.Constant) and isinstance(v.value, int):
+            return (v.value,)
+        if isinstance(v, (ast.Tuple, ast.List)):
+            out = []
+            for el in v.elts:
+                if isinstance(el, ast.Constant) and \
+                        isinstance(el.value, int):
+                    out.append(el.value)
+                else:
+                    return None
+            return tuple(out)
+        return None
+    return None
+
+
+def _frame_stmts(node) -> List[ast.stmt]:
+    """All statements of one frame, not descending into nested defs."""
+    out: List[ast.stmt] = []
+    stack = list(getattr(node, "body", []))
+    while stack:
+        stmt = stack.pop()
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            continue
+        out.append(stmt)
+        for blk in ("body", "orelse", "finalbody"):
+            stack.extend(getattr(stmt, blk, []))
+        for h in getattr(stmt, "handlers", []):
+            stack.extend(h.body)
+    return out
+
+
+def _frame_bindings(node) -> Dict[str, Tuple[int, ...]]:
+    """``target text -> donated positions`` for one frame's assigns."""
+    table: Dict[str, Tuple[int, ...]] = {}
+    for stmt in _frame_stmts(node):
+        if not isinstance(stmt, ast.Assign):
+            continue
+        pos = _donate_positions(stmt.value)
+        if pos is None:
+            continue
+        for tgt in stmt.targets:
+            if isinstance(tgt, ast.Name):
+                table[tgt.id] = pos
+            elif isinstance(tgt, ast.Attribute):
+                try:
+                    table[ast.unparse(tgt)] = pos
+                except Exception:  # pragma: no cover
+                    pass
+    return table
+
+
+def _simple_aliases(fn_node) -> Dict[str, str]:
+    """``name -> canonical name`` for names bound exactly once, by a
+    bare ``a = b`` copy — the conservative object-identity map DON002
+    uses beyond textual equality."""
+    counts: Dict[str, int] = {}
+    copies: Dict[str, str] = {}
+    for stmt in _frame_stmts(fn_node):
+        for key in store_target_keys(stmt):
+            counts[key] = counts.get(key, 0) + 1
+        if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1 and \
+                isinstance(stmt.targets[0], ast.Name) and \
+                isinstance(stmt.value, ast.Name):
+            copies[stmt.targets[0].id] = stmt.value.id
+    out: Dict[str, str] = {}
+    for name, src in copies.items():
+        if counts.get(name, 0) != 1:
+            continue
+        seen = {name}
+        cur = src
+        while cur in copies and counts.get(cur, 0) == 1 and \
+                cur not in seen:
+            seen.add(cur)
+            cur = copies[cur]
+        out[name] = cur
+    return out
+
+
+class _DeadFlow(ForwardDataflow):
+    def __init__(self, pass_: "DonationPass", fi: FunctionInfo,
+                 emit) -> None:
+        self.p = pass_
+        self.fi = fi
+        self.emit = emit
+        self.aliases = _simple_aliases(fi.node)
+
+    def initial(self) -> FrozenSet[Dead]:
+        return frozenset()
+
+    bottom = initial
+
+    def join(self, a, b):
+        return a | b
+
+    # -- donated-call discovery -----------------------------------------
+    def _positions_at(self, call: ast.Call
+                      ) -> Optional[Tuple[Tuple[int, ...], str]]:
+        func = call.func
+        if isinstance(func, ast.Call):  # jax.jit(f, donate...)(args)
+            pos = _donate_positions(func)
+            if pos is not None:
+                return pos, leaf_name(func.func) or "jit"
+        if isinstance(func, ast.Name):
+            pos = self.p.lookup(self.fi, func.id)
+            if pos is not None:
+                return pos, func.id
+        elif isinstance(func, ast.Attribute):
+            try:
+                text = ast.unparse(func)
+            except Exception:  # pragma: no cover
+                return None
+            pos = self.p.lookup(self.fi, text)
+            if pos is not None:
+                return pos, text
+        return None
+
+    # -- transfer --------------------------------------------------------
+    def _report_read(self, node, key: str, dead: Dict[str, Tuple[str, int]]
+                     ) -> None:
+        if self.emit is None:
+            return
+        callee, line = dead[key]
+        self.emit(
+            self.fi, node.lineno, "DON001",
+            f"{key!r} is read after being donated to {callee}(...) on "
+            f"line {line} — donate_argnums invalidates the buffer on "
+            "TPU, so this read returns garbage on accelerator while "
+            "passing on CPU (tests never catch it); rebind the call's "
+            f"results ({key}, ... = {callee}(...)) before any further "
+            "use, or drop the donation",
+        )
+
+    def transfer(self, stmt, state: FrozenSet[Dead]) -> FrozenSet[Dead]:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            return state
+        exprs = head_exprs(stmt)
+        dead = {k: (callee, line) for (k, callee, line) in state}
+        if dead:
+            for e in exprs:
+                for node in walk_expr(e):
+                    if isinstance(node, ast.Name) and \
+                            isinstance(node.ctx, ast.Load) and \
+                            node.id in dead:
+                        self._report_read(node, node.id, dead)
+                    elif isinstance(node, ast.Attribute) and \
+                            isinstance(node.ctx, ast.Load):
+                        try:
+                            text = ast.unparse(node)
+                        except Exception:  # pragma: no cover
+                            continue
+                        if text in dead:
+                            self._report_read(node, text, dead)
+            if isinstance(stmt, ast.AugAssign) and \
+                    isinstance(stmt.target, ast.Name) and \
+                    stmt.target.id in dead:
+                self._report_read(stmt.target, stmt.target.id, dead)
+        out = set(state)
+        for e in exprs:
+            for node in walk_expr(e):
+                if not isinstance(node, ast.Call):
+                    continue
+                got = self._positions_at(node)
+                if got is None:
+                    continue
+                pos, callee = got
+                donated_texts: List[str] = []
+                for i in pos:
+                    if i >= len(node.args):
+                        continue
+                    arg = node.args[i]
+                    if isinstance(arg, ast.Constant):
+                        continue
+                    try:
+                        text = ast.unparse(arg)
+                    except Exception:  # pragma: no cover
+                        continue
+                    canon = self.aliases.get(text, text)
+                    if canon in donated_texts and self.emit is not None:
+                        self.emit(
+                            self.fi, node.lineno, "DON002",
+                            f"donated arguments of {callee}(...) alias "
+                            f"the same buffer ({text!r}) — two "
+                            "donate_argnums positions resolving to one "
+                            "object make XLA reuse a single buffer for "
+                            "both outputs; pass distinct buffers or "
+                            "donate only one position",
+                        )
+                    donated_texts.append(canon)
+                    if isinstance(arg, (ast.Name, ast.Attribute)):
+                        out.add((text, callee, node.lineno))
+        for key in store_target_keys(stmt):
+            out = {d for d in out if d[0] != key}
+        return frozenset(out)
+
+
+class DonationPass:
+    def __init__(self, index: ProjectIndex):
+        self.index = index
+        self.scope_fns: List[FunctionInfo] = [
+            fi for mi in index.package_modules() for fi in mi.functions
+        ]
+        # module-level bindings, per-frame bindings, per-class self.* attrs
+        self._module_tables: Dict[int, Dict[str, Tuple[int, ...]]] = {}
+        self._frame_tables: Dict[int, Dict[str, Tuple[int, ...]]] = {}
+        self._class_tables: Dict[Tuple[int, str],
+                                 Dict[str, Tuple[int, ...]]] = {}
+        for mi in index.package_modules():
+            self._module_tables[id(mi)] = _frame_bindings(mi.tree)
+        for fi in self.scope_fns:
+            table = _frame_bindings(fi.node)
+            self._frame_tables[id(fi)] = table
+            if fi.cls is not None:
+                cls_key = (id(fi.module), fi.cls)
+                cls_table = self._class_tables.setdefault(cls_key, {})
+                for key, pos in table.items():
+                    if key.startswith("self."):
+                        cls_table[key] = pos
+
+    def lookup(self, fi: FunctionInfo, key: str
+               ) -> Optional[Tuple[int, ...]]:
+        """Donated positions bound to ``key`` as visible from ``fi``:
+        the function's own frame, lexical ancestors, the enclosing
+        class's ``self.*`` attrs, then module level."""
+        p: Optional[FunctionInfo] = fi
+        while p is not None:
+            got = self._frame_tables.get(id(p), {}).get(key)
+            if got is not None:
+                return got
+            p = p.parent
+        if fi.cls is not None and key.startswith("self."):
+            got = self._class_tables.get(
+                (id(fi.module), fi.cls), {}).get(key)
+            if got is not None:
+                return got
+        return self._module_tables.get(id(fi.module), {}).get(key)
+
+    def run(self) -> List[Finding]:
+        findings: List[Finding] = []
+        seen: Set[Tuple[str, int, str]] = set()
+
+        def emit(fi: FunctionInfo, line: int, rule: str, msg: str) -> None:
+            key = (fi.module.path, line, rule)
+            if key not in seen:
+                seen.add(key)
+                findings.append(Finding(fi.module.path, line, rule, msg))
+
+        for fi in self.scope_fns:
+            flow = _DeadFlow(self, fi, emit)
+            flow.run(self.index.cfg(fi))
+        return findings
+
+
+def check_donation(index: ProjectIndex) -> List[Finding]:
+    return DonationPass(index).run()

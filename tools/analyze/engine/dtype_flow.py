@@ -32,11 +32,12 @@ exactly the silent parity break the contract forbids.
 from __future__ import annotations
 
 import ast
-from typing import Dict, FrozenSet, List, Optional, Set, Tuple
+from typing import FrozenSet, List, Optional, Set, Tuple
 
 from tools.analyze.common import Finding
 from tools.analyze.engine.cfg import ForwardDataflow
 from tools.analyze.engine.index import FunctionInfo, ProjectIndex
+from tools.analyze.engine.taint import Summaries
 
 _SCOPE = {"ops/binning.py", "ops/device_binning.py", "engine/booster.py"}
 _SOURCE_ATTRS = {"upper_bounds", "cat_maps"}
@@ -99,27 +100,6 @@ def _sanction_names(fn_node) -> Set[str]:
     return out
 
 
-class _Summaries:
-    """Grow-only interprocedural facts (params/returns), to a fixed
-    point across the scope."""
-
-    def __init__(self) -> None:
-        self.tainted_params: Dict[int, Set[str]] = {}
-        self.ret_tainted: Dict[int, bool] = {}
-        self.changed = False
-
-    def add_param(self, fi: FunctionInfo, param: str) -> None:
-        got = self.tainted_params.setdefault(id(fi), set())
-        if param not in got:
-            got.add(param)
-            self.changed = True
-
-    def set_ret(self, fi: FunctionInfo, val: bool) -> None:
-        if val and not self.ret_tainted.get(id(fi), False):
-            self.ret_tainted[id(fi)] = True
-            self.changed = True
-
-
 class _TaintFlow(ForwardDataflow):
     def __init__(self, pass_, fi: FunctionInfo, emit) -> None:
         self.p = pass_
@@ -129,8 +109,8 @@ class _TaintFlow(ForwardDataflow):
 
     # -- lattice ---------------------------------------------------------
     def initial(self) -> FrozenSet[str]:
-        return frozenset(self.p.summaries.tainted_params.get(
-            id(self.fi), set()))
+        return frozenset(p for p, _tag in
+                         self.p.summaries.params(self.fi))
 
     def bottom(self) -> FrozenSet[str]:
         return frozenset()
@@ -175,7 +155,7 @@ class _TaintFlow(ForwardDataflow):
             if callee is not None:
                 # map tainted args onto callee params
                 self.p.map_args(self.fi, expr, callee, state)
-                if not self.p.summaries.ret_tainted.get(id(callee), False):
+                if not self.p.summaries.ret(callee):
                     return set()  # resolved, summary says clean return
             if leaf in ("float32",) or (
                     _leaf(expr.func) in _ASSEMBLY_SINKS
@@ -300,7 +280,7 @@ class _TaintFlow(ForwardDataflow):
         elif isinstance(stmt, ast.Return):
             if stmt.value is not None and \
                     self._roots(stmt.value, state):
-                self.p.summaries.set_ret(self.fi, True)
+                self.p.summaries.set_ret(self.fi)
         return frozenset(out)
 
 
@@ -312,7 +292,7 @@ class DtypeFlowPass:
             if _in_scope(mi.pkg_rel) for fi in mi.functions
         ]
         self.scope_fn_ids = {id(fi) for fi in self.scope_fns}
-        self.summaries = _Summaries()
+        self.summaries = Summaries()
 
     def resolve(self, fi: FunctionInfo, call: ast.Call
                 ) -> Optional[FunctionInfo]:

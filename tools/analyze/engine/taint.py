@@ -1,0 +1,449 @@
+"""Reusable interprocedural assignment-taint machinery.
+
+:mod:`tools.analyze.engine.dtype_flow` (DTY001) introduced the pattern:
+a forward dataflow over the engine CFGs where the state is "which local
+names currently carry a tainted value", assignments propagate, and a
+grow-only summary table carries taint through resolved calls (params in,
+returns out) to a fixed point across the pass scope.  This module
+generalizes that pattern so new flow passes don't re-implement it:
+
+- :class:`Summaries` — the grow-only interprocedural fact table.  Facts
+  are *tagged* (a pass may track several taint kinds at once — the
+  determinism pass runs "scan", "set" and "clock" taint in one walk);
+  single-kind passes just use the default tag.
+- :class:`TaintFlow` — a :class:`ForwardDataflow` whose state is a
+  frozenset of ``(name, tag)`` pairs.  Subclasses declare taint
+  *sources* (``call_source_tag`` / ``expr_source_tag``), *droppers*
+  (``DROP_CALLS`` — calls whose result is clean regardless of inputs),
+  and a per-statement *sink hook* (``check_stmt``).  Assignment /
+  augmented-assignment / loop-target / with-target / ``append`` /
+  subscript-store propagation and the interprocedural arg→param /
+  return plumbing are inherited.
+- :class:`InterproceduralPass` — the driver: iterate the scope's
+  functions with ``emit=None`` until the summaries stop growing, then a
+  final emitting walk with per-``(file, line, rule)`` dedup.
+- statement helpers (:func:`head_exprs`, :func:`store_target_keys`,
+  :func:`walk_expr`) shared with the donation pass, whose
+  liveness-after-call query is the same "walk the statement's own
+  expressions, not its nested blocks" discipline: a compound statement
+  sits at the head of its CFG block, so only its *head* expressions
+  (the ``for`` iterable, the ``while`` test, the ``with`` context
+  managers) transfer at that program point — the body statements are
+  their own blocks.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, FrozenSet, Iterable, Iterator, List, Optional, Set, Tuple
+
+from tools.analyze.common import Finding
+from tools.analyze.engine.cfg import ForwardDataflow
+from tools.analyze.engine.index import FunctionInfo, ProjectIndex
+
+#: state element: (root name, taint tag)
+Taint = Tuple[str, str]
+
+DEFAULT_TAG = "t"
+
+
+def leaf_name(func) -> Optional[str]:
+    """The rightmost identifier of a callee expression (``np.sort`` ->
+    ``"sort"``), or None for computed callees."""
+    if isinstance(func, ast.Name):
+        return func.id
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    return None
+
+
+class Summaries:
+    """Grow-only interprocedural facts (tainted params / tainted
+    returns), iterated to a fixed point across a pass scope.
+
+    Facts carry a *tag* so one summary table can serve a pass tracking
+    several taint kinds; passes with a single kind use the default.
+    """
+
+    def __init__(self) -> None:
+        self.tainted_params: Dict[int, Set[Taint]] = {}
+        self.ret_tags: Dict[int, Set[str]] = {}
+        self.changed = False
+
+    def add_param(self, fi: FunctionInfo, param: str,
+                  tag: str = DEFAULT_TAG) -> None:
+        got = self.tainted_params.setdefault(id(fi), set())
+        if (param, tag) not in got:
+            got.add((param, tag))
+            self.changed = True
+
+    def params(self, fi: FunctionInfo) -> Set[Taint]:
+        return self.tainted_params.get(id(fi), set())
+
+    def set_ret(self, fi: FunctionInfo, tag: str = DEFAULT_TAG) -> None:
+        got = self.ret_tags.setdefault(id(fi), set())
+        if tag not in got:
+            got.add(tag)
+            self.changed = True
+
+    def ret(self, fi: FunctionInfo) -> Set[str]:
+        return self.ret_tags.get(id(fi), set())
+
+
+# ------------------------------------------------------------- statement
+# helpers (shared with the donation pass)
+
+def walk_expr(expr) -> Iterator[ast.AST]:
+    """``ast.walk`` over an expression, skipping nested frames (lambdas,
+    defs, classes) whose bodies execute later / elsewhere."""
+    stack = [expr]
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.Lambda, ast.FunctionDef,
+                             ast.AsyncFunctionDef, ast.ClassDef)):
+            continue
+        yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def head_exprs(stmt) -> List[ast.expr]:
+    """The expressions that execute AT a statement's CFG position.
+
+    For compound statements only the head executes there (the ``for``
+    iterable, the ``while`` test, the ``with`` context managers) — the
+    body statements occupy their own CFG blocks and must not be walked
+    twice.  Simple statements contribute all their expressions.
+    """
+    if isinstance(stmt, (ast.For, ast.AsyncFor)):
+        return [stmt.iter]
+    if isinstance(stmt, ast.While):
+        return [stmt.test]
+    if isinstance(stmt, (ast.With, ast.AsyncWith)):
+        return [item.context_expr for item in stmt.items]
+    if isinstance(stmt, ast.Assign):
+        out = [stmt.value]
+        # subscript/attribute stores still READ their base object
+        for tgt in stmt.targets:
+            if isinstance(tgt, (ast.Subscript, ast.Attribute)):
+                out.append(tgt)
+        return out
+    if isinstance(stmt, ast.AugAssign):
+        return [stmt.value, stmt.target]
+    if isinstance(stmt, ast.AnnAssign):
+        return [stmt.value] if stmt.value is not None else []
+    if isinstance(stmt, ast.Return):
+        return [stmt.value] if stmt.value is not None else []
+    if isinstance(stmt, ast.Expr):
+        return [stmt.value]
+    if isinstance(stmt, ast.Assert):
+        return [stmt.test] + ([stmt.msg] if stmt.msg is not None else [])
+    if isinstance(stmt, ast.Raise):
+        return [e for e in (stmt.exc, stmt.cause) if e is not None]
+    if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                         ast.ClassDef, ast.Delete, ast.Import,
+                         ast.ImportFrom, ast.Global, ast.Nonlocal,
+                         ast.Pass, ast.Break, ast.Continue)):
+        return []
+    out = []
+    for child in ast.iter_child_nodes(stmt):
+        if isinstance(child, ast.expr):
+            out.append(child)
+    return out
+
+
+def store_target_keys(stmt) -> Set[str]:
+    """Names (and simple ``obj.attr`` texts) a statement REBINDS —
+    the kill set for flow passes tracking per-name facts."""
+    out: Set[str] = set()
+
+    def _tgt(t) -> None:
+        if isinstance(t, ast.Name):
+            out.add(t.id)
+        elif isinstance(t, ast.Starred):
+            _tgt(t.value)
+        elif isinstance(t, (ast.Tuple, ast.List)):
+            for el in t.elts:
+                _tgt(el)
+        elif isinstance(t, ast.Attribute):
+            try:
+                out.add(ast.unparse(t))
+            except Exception:  # pragma: no cover
+                pass
+
+    if isinstance(stmt, ast.Assign):
+        for t in stmt.targets:
+            _tgt(t)
+    elif isinstance(stmt, (ast.AugAssign, ast.AnnAssign)):
+        _tgt(stmt.target)
+    elif isinstance(stmt, (ast.For, ast.AsyncFor)):
+        _tgt(stmt.target)
+    elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+        for item in stmt.items:
+            if item.optional_vars is not None:
+                _tgt(item.optional_vars)
+    elif isinstance(stmt, ast.Delete):
+        for t in stmt.targets:
+            _tgt(t)
+    return out
+
+
+# ------------------------------------------------------------------ flow
+
+class TaintFlow(ForwardDataflow):
+    """Generic forward name-taint over one function CFG.
+
+    Subclasses override:
+
+    - ``call_source_tag(call)`` — tag a call result introduces
+      (``os.listdir(...)`` -> ``"scan"``), or None;
+    - ``expr_source_tag(expr)`` — tag a non-call expression introduces
+      (a set literal -> ``"set"``), or None;
+    - ``DROP_CALLS`` — callee leaf names whose results are always clean
+      (``sorted``, ``len``, ...);
+    - ``check_stmt(stmt, state)`` — the sink hook, called once per
+      statement before propagation (``self.emit`` is None during
+      summary iterations — guard emission on it).
+    """
+
+    DROP_CALLS: FrozenSet[str] = frozenset({
+        "len", "int", "bool", "float", "str", "repr", "range",
+        "isinstance", "hasattr", "getattr_static", "print", "sorted",
+        "min", "max", "sum", "any", "all", "abs", "round", "format",
+    })
+
+    #: tags allowed to cross function boundaries (param/return
+    #: summaries).  Tags with ubiquitous sources (set literals are
+    #: everywhere) stay intraprocedural, or they smear through every
+    #: numeric helper in the call graph and drown the signal.
+    INTERPROC_TAGS: Optional[FrozenSet[str]] = None  # None = all tags
+
+    def __init__(self, pass_: "InterproceduralPass", fi: FunctionInfo,
+                 emit) -> None:
+        self.p = pass_
+        self.fi = fi
+        self.emit = emit  # None during summary iterations
+
+    # -- lattice ---------------------------------------------------------
+    def initial(self) -> FrozenSet[Taint]:
+        return frozenset(self.p.summaries.params(self.fi))
+
+    def bottom(self) -> FrozenSet[Taint]:
+        return frozenset()
+
+    def join(self, a, b):
+        return a | b
+
+    # -- hooks -----------------------------------------------------------
+    def call_source_tag(self, call: ast.Call) -> Optional[str]:
+        return None
+
+    def expr_source_tag(self, expr) -> Optional[str]:
+        return None
+
+    def check_stmt(self, stmt, state: FrozenSet[Taint]) -> None:
+        pass
+
+    # -- taint of one expression ----------------------------------------
+    def tags_of(self, expr, state: FrozenSet[Taint]) -> Set[Taint]:
+        """``(root description, tag)`` pairs an expression carries
+        (empty = clean)."""
+        if expr is None:
+            return set()
+        if isinstance(expr, ast.Name):
+            return {(n, t) for (n, t) in state if n == expr.id}
+        if isinstance(expr, (ast.Compare, ast.BoolOp)):
+            return set()  # boolean-valued: order/time information gone
+        src = self.expr_source_tag(expr)
+        if src is not None:
+            return {(type(expr).__name__.lower(), src)} | {
+                t for child in ast.iter_child_nodes(expr)
+                if isinstance(child, ast.expr)
+                for t in self.tags_of(child, state)
+            }
+        if isinstance(expr, ast.Attribute):
+            return self.tags_of(expr.value, state)
+        if isinstance(expr, (ast.ListComp, ast.SetComp, ast.GeneratorExp,
+                             ast.DictComp)):
+            # comprehension generators are not ast.expr children — walk
+            # their iterables explicitly or `[f(x) for x in tainted]`
+            # launders the taint
+            tags: Set[Taint] = set()
+            for gen in expr.generators:
+                tags |= self.tags_of(gen.iter, state)
+            for part in ("elt", "key", "value"):
+                sub = getattr(expr, part, None)
+                if sub is not None:
+                    tags |= self.tags_of(sub, state)
+            return tags
+        if isinstance(expr, ast.Call):
+            leaf = leaf_name(expr.func)
+            if leaf in self.DROP_CALLS:
+                return set()
+            src = self.call_source_tag(expr)
+            if src is not None:
+                try:
+                    desc = ast.unparse(expr.func)
+                except Exception:  # pragma: no cover
+                    desc = leaf or "<call>"
+                return {(desc, src)}
+            tags: Set[Taint] = set()
+            for a in expr.args:
+                tags |= self.tags_of(a, state)
+            for kw in expr.keywords:
+                tags |= self.tags_of(kw.value, state)
+            if isinstance(expr.func, ast.Attribute):
+                tags |= self.tags_of(expr.func.value, state)
+            callee = self.p.resolve(self.fi, expr)
+            if callee is not None:
+                self.p.map_args(self.fi, expr, callee, state)
+                # resolved: trust the callee's return summary
+                name = callee.name
+                return {(name, t) for t in self.p.summaries.ret(callee)}
+            return tags
+        tags = set()
+        for child in ast.iter_child_nodes(expr):
+            if isinstance(child, ast.expr):
+                tags |= self.tags_of(child, state)
+        return tags
+
+    # -- transfer --------------------------------------------------------
+    def transfer(self, stmt, state: FrozenSet[Taint]) -> FrozenSet[Taint]:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            return state  # separate frames, analyzed on their own
+        self.check_stmt(stmt, state)
+        out = set(state)
+        if isinstance(stmt, (ast.For, ast.AsyncFor)):
+            tags = self.tags_of(stmt.iter, state)
+            if tags:
+                kinds = {t for _, t in tags}
+                for tname in ast.walk(stmt.target):
+                    if isinstance(tname, ast.Name):
+                        out |= {(tname.id, k) for k in kinds}
+            return frozenset(out)
+        if isinstance(stmt, ast.While):
+            return frozenset(out)
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            for item in stmt.items:
+                if isinstance(item.optional_vars, ast.Name):
+                    kinds = {t for _, t in
+                             self.tags_of(item.context_expr, state)}
+                    out |= {(item.optional_vars.id, k) for k in kinds}
+            return frozenset(out)
+        if isinstance(stmt, ast.Assign):
+            kinds = {t for _, t in self.tags_of(stmt.value, state)}
+            for tgt in stmt.targets:
+                if isinstance(tgt, ast.Name):
+                    out = {e for e in out if e[0] != tgt.id}
+                    out |= {(tgt.id, k) for k in kinds}
+                elif isinstance(tgt, ast.Subscript) and kinds:
+                    base = tgt.value
+                    if isinstance(base, ast.Name):
+                        out |= {(base.id, k) for k in kinds}
+                elif isinstance(tgt, (ast.Tuple, ast.List)):
+                    for el in tgt.elts:
+                        if isinstance(el, ast.Name):
+                            out = {e for e in out if e[0] != el.id}
+                            out |= {(el.id, k) for k in kinds}
+        elif isinstance(stmt, ast.AugAssign):
+            if isinstance(stmt.target, ast.Name):
+                kinds = {t for _, t in self.tags_of(stmt.value, state)}
+                out |= {(stmt.target.id, k) for k in kinds}
+        elif isinstance(stmt, ast.AnnAssign):
+            if isinstance(stmt.target, ast.Name) and stmt.value is not None:
+                kinds = {t for _, t in self.tags_of(stmt.value, state)}
+                out = {e for e in out if e[0] != stmt.target.id}
+                out |= {(stmt.target.id, k) for k in kinds}
+        elif isinstance(stmt, ast.Expr):
+            call = stmt.value
+            if isinstance(call, ast.Call) and \
+                    isinstance(call.func, ast.Attribute):
+                recv = call.func.value
+                if call.func.attr in ("append", "extend", "insert",
+                                      "add"):
+                    if isinstance(recv, ast.Name):
+                        kinds = {t for a in call.args
+                                 for _, t in self.tags_of(a, state)}
+                        out |= {(recv.id, k) for k in kinds}
+                elif call.func.attr == "sort" and \
+                        isinstance(recv, ast.Name):
+                    # in-place sort fixes the order: drop the taint
+                    out = {e for e in out if e[0] != recv.id}
+        elif isinstance(stmt, ast.Return):
+            if stmt.value is not None:
+                for _, tag in self.tags_of(stmt.value, state):
+                    if self.INTERPROC_TAGS is None or \
+                            tag in self.INTERPROC_TAGS:
+                        self.p.summaries.set_ret(self.fi, tag)
+        return frozenset(out)
+
+
+class InterproceduralPass:
+    """Driver shared by the taint passes: a scope of functions, a
+    summary table iterated to a fixed point, then one emitting walk.
+
+    Subclasses set ``flow_cls`` and ``scope_fns`` (in ``__init__``).
+    """
+
+    flow_cls = TaintFlow
+
+    def __init__(self, index: ProjectIndex,
+                 scope_fns: Iterable[FunctionInfo]):
+        self.index = index
+        self.scope_fns: List[FunctionInfo] = list(scope_fns)
+        self.scope_fn_ids = {id(fi) for fi in self.scope_fns}
+        self.summaries = Summaries()
+
+    def resolve(self, fi: FunctionInfo, call: ast.Call
+                ) -> Optional[FunctionInfo]:
+        for site in fi.calls:
+            if site.node is call:
+                callee = site.callee
+                if callee is not None and id(callee) in self.scope_fn_ids:
+                    return callee
+                return None
+        return None
+
+    def map_args(self, caller: FunctionInfo, call: ast.Call,
+                 callee: FunctionInfo, state) -> None:
+        flow = self.flow_cls(self, caller, emit=None)
+        allowed = flow.INTERPROC_TAGS
+        params = [a.arg for a in callee.node.args.args]
+        if callee.cls is not None and params and params[0] in (
+                "self", "cls"):
+            params = params[1:]
+        for i, arg in enumerate(call.args):
+            if i < len(params):
+                for _, tag in flow.tags_of(arg, state):
+                    if allowed is None or tag in allowed:
+                        self.summaries.add_param(callee, params[i], tag)
+        for kw in call.keywords:
+            if kw.arg is not None and kw.arg in params:
+                for _, tag in flow.tags_of(kw.value, state):
+                    if allowed is None or tag in allowed:
+                        self.summaries.add_param(callee, kw.arg, tag)
+
+    def _analyze(self, fi: FunctionInfo, emit) -> None:
+        flow = self.flow_cls(self, fi, emit)
+        flow.run(self.index.cfg(fi))
+
+    def run_rules(self) -> List[Finding]:
+        """Fixed point, then the emitting pass (dedup per file/line/rule)."""
+        for _ in range(8):
+            self.summaries.changed = False
+            for fi in self.scope_fns:
+                self._analyze(fi, emit=None)
+            if not self.summaries.changed:
+                break
+        findings: List[Finding] = []
+        seen: Set[Tuple[str, int, str]] = set()
+
+        def emit(fi: FunctionInfo, line: int, rule: str, msg: str) -> None:
+            key = (fi.module.path, line, rule)
+            if key not in seen:
+                seen.add(key)
+                findings.append(Finding(fi.module.path, line, rule, msg))
+
+        for fi in self.scope_fns:
+            self._analyze(fi, emit)
+        return findings

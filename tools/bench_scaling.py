@@ -34,6 +34,9 @@ scale.  This tool produces the table BASELINE.md commits:
    transport-level bound for the reduce-scatter merge.
 
 Usage:  python tools/bench_scaling.py            # full table (spawns children)
+        python tools/bench_scaling.py --out F    # also write rows to F
+                                                 # (atomic: F.new + rename,
+                                                 # temp removed on failure)
         python tools/bench_scaling.py --child D  # one device count (internal)
 """
 
@@ -289,7 +292,28 @@ def run_child(n_dev: int):
     print(json.dumps(results))
 
 
-def main():
+def _write_atomic(path, rows):
+    """Write ``rows`` as JSON to ``path`` via a ``.new`` temp file.
+
+    The temp file is removed on any failure so an aborted run never
+    leaves a stray ``<path>.new`` in the tree (and a half-written file
+    never shadows the committed artifact).
+    """
+    tmp = path + ".new"
+    try:
+        with open(tmp, "w") as fh:
+            json.dump(rows, fh, indent=1)
+            fh.write("\n")
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.remove(tmp)
+        except OSError:
+            pass
+        raise
+
+
+def main(out_path=None):
     rows = []
     for d in (1, 2, 4, 8):
         env = dict(os.environ)
@@ -309,6 +333,8 @@ def main():
         rows.append(json.loads(proc.stdout.strip().splitlines()[-1]))
         _log(f"D={d} done")
     print(json.dumps(rows, indent=1))
+    if out_path:
+        _write_atomic(out_path, rows)
     # Human summary table
     _log("\nD  rows    mode            wall(s)  AUC     merge           "
          "comm/pass  inter/intra      dominant collective")
@@ -339,5 +365,7 @@ def main():
 if __name__ == "__main__":
     if len(sys.argv) >= 3 and sys.argv[1] == "--child":
         run_child(int(sys.argv[2]))
+    elif len(sys.argv) >= 3 and sys.argv[1] == "--out":
+        main(out_path=sys.argv[2])
     else:
         main()
