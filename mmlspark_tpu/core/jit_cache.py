@@ -163,6 +163,9 @@ def _install_hit_recorder(cache_dir: str) -> None:
                     pass
             else:
                 obs.inc("jit_cache.miss")
+                # Unified compile-event ledger (obs/device.py): a cache
+                # miss here is exactly one XLA compile paid.
+                obs.device.compile_event("compile")
             return result
 
         get_and_touch._mmlspark_tpu_touch = True
@@ -330,6 +333,9 @@ def load_aot(key: str):
             with obs.span("jit_cache.aot_deserialize", key=key):
                 exe = se.deserialize_and_load(*pickle.loads(data))
             obs.inc("jit_cache.aot_hits")
+            # Unified compile-event ledger (obs/device.py): an AOT load
+            # replaces a compile with a deserialize.
+            obs.device.compile_event("deserialize")
             return exe
         except Exception:
             try:

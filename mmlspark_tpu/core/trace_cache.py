@@ -251,6 +251,9 @@ def wrap_aot(
                     obs.inc("trace_cache.hit")
                 else:
                     obs.inc("trace_cache.miss")
+                    # Unified compile-event ledger (obs/device.py): a
+                    # trace-cache miss pays a Python re-trace.
+                    obs.device.compile_event("trace")
                     with obs.span("trace_cache.export"):
                         exp = jexport.export(jitted)(*args)
                     try:

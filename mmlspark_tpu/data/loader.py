@@ -275,6 +275,9 @@ class ChunkPrefetcher:
             stall = time.perf_counter_ns() - t0
             if obs.enabled():
                 obs.inc("ingest.buffer_stall_ns", float(stall))
+                # Per-step attribution: the steps channel subtracts
+                # ingest-stall from step wall (obs/steps.py).
+                obs.steps.note_ingest_stall(float(stall))
             if item is self._DONE:
                 if self._err is not None:
                     raise self._err

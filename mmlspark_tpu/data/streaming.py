@@ -419,6 +419,10 @@ def stream_ingest(
         fused_kernel=use_pallas,
     ):
         feed = ChunkPrefetcher(chunk_stream(source, chunk_rows), transform=_upload)
+        # Per-chunk step telemetry: each feed-loop pass is one ingest
+        # step whose wall splits into prefetcher stall (fed by
+        # data/loader.py) + bin dispatch (obs/steps.py).
+        step_t = obs.steps.begin()
         for chunk, rows_dev in feed:
             c_rows = len(chunk.X)
             start = chunk.start // 2 if do_pack else chunk.start
@@ -443,6 +447,8 @@ def stream_ingest(
                 label[chunk.start:chunk.start + len(chunk.X)] = chunk.y[
                     : len(chunk.X)
                 ]
+            obs.steps.end(step_t, "ingest", chunk.index, rows=c_rows)
+            step_t = obs.steps.begin()
         with obs.span("ingest.drain"):
             buf.block_until_ready()
             occupancy.block_until_ready()

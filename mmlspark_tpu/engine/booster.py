@@ -3057,6 +3057,7 @@ def _train_impl(
         chunk_idx = 0
         while n_done < n_iter and stop_at is None:
             t_chunk = time.perf_counter()
+            step_t = obs.steps.begin()
             c = min(chunk_iters, n_iter - n_done)
             dart_xs = (
                 (jnp.asarray(drop_rows[n_done : n_done + c]),
@@ -3114,6 +3115,11 @@ def _train_impl(
                         stop_at = it
                         break
             n_done += c
+            if c:
+                # Derived per-step telemetry: chunk wall + attribution
+                # deltas split across the fused iterations (obs/steps.py).
+                obs.steps.end(step_t, "scan", n_done - c, n=c,
+                              chunk=chunk_idx)
             if obs.enabled() and c:
                 # The whole-run scan fuses iterations on-device, so
                 # per-iteration wall is DERIVED: the chunk's wall (dispatch
@@ -3214,6 +3220,7 @@ def _train_impl(
         _legacy_stats = [_make_stats_fn(vs["evaluators"]) for vs in vsets]
     for it in range(cfg.num_iterations):
         t_it = time.perf_counter()
+        step_t = obs.steps.begin()
         sub = iter_keys_all[it]
         if do_bagging and it % cfg.bagging_freq == 0:
             current_bag = resample_bag(bag_keys_all[it], valid_mask)
@@ -3314,6 +3321,7 @@ def _train_impl(
         # legacy/DART loop is iteration-at-a-time in Python, unlike the
         # fused scan path above.
         obs.record_span("booster.iteration", time.perf_counter() - t_it, it=it)
+        obs.steps.end(step_t, "legacy", it)
         if stop:
             break
 

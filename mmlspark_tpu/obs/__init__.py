@@ -45,7 +45,7 @@ from __future__ import annotations
 import os
 from typing import Optional
 
-from mmlspark_tpu.obs import _state, flight, metrics, tracing
+from mmlspark_tpu.obs import _state, device, flight, metrics, steps, tracing
 from mmlspark_tpu.obs.context import (  # noqa: F401
     bind_trace,
     current_trace_id,
@@ -71,6 +71,8 @@ __all__ = [
     "get_logger",
     "collective_watchdog",
     "flight",
+    "steps",
+    "device",
     "bind_trace",
     "trace_attrs",
     "current_trace_id",
@@ -118,6 +120,8 @@ def reset() -> None:
     """Clear all recorded metrics/spans (the export file is left as-is)
     and drop the cached rank (tests re-resolve it after env changes)."""
     metrics.registry.reset()
+    steps.reset()
+    device.reset()
     _state.reset_rank_cache()
 
 

@@ -1,0 +1,307 @@
+"""mmlspark_tpu.obs.steps — per-step training telemetry channel.
+
+Every training step (a real legacy/DART iteration, a derived fused-scan
+iteration, or a streamed-ingest chunk) records its wall time ATTRIBUTED
+three ways:
+
+- **collective-wait** — time spent inside watchdog-wrapped collectives
+  (``collective_watchdog.__exit__`` feeds :func:`note_collective`);
+- **ingest-stall** — time the consumer spent blocked on the
+  ``ChunkPrefetcher`` (``data/loader.py`` feeds :func:`note_ingest_stall`);
+- **compute** — everything else (``wall − collective − stall``, clamped
+  at zero), so the three parts sum to the step wall by construction.
+
+Records land in a bounded ring (:data:`_CAP` entries, oldest evicted —
+the blackbox memory contract), flow to the JSONL export as
+``{"kind": "step", ...}`` lines when an exporter is open, and aggregate
+into ``train.step_*_s`` histograms + ``train.steps{kind=}`` counters.
+``python -m tools.obs report`` renders them as the ``steps`` section.
+
+Cross-rank straggler detection: every :data:`_STRAGGLER_EVERY` steps
+(env ``MMLSPARK_TPU_OBS_STRAGGLER_EVERY``, ``0`` disables) each rank
+publishes its last step-end monotonic mark paired with a fresh
+``(time.time(), time.monotonic_ns())`` anchor through ``host_allgather``.
+Each rank reconstructs every peer's mark as wall time exactly the way
+``tools/obs timeline`` aligns blackbox dumps — ``wall = anchor_ts −
+(anchor_mono_ns − mark_ns)/1e9`` — and when the spread exceeds
+``MMLSPARK_TPU_OBS_STRAGGLER_MS`` (default 50) bumps
+``train.straggler_skew_ms{rank=}`` per rank plus a
+``train.straggler_events{rank=<laggard>}`` counter.  The exchange is a
+collective: it fires on a deterministic step cadence and requires obs to
+be enabled on EVERY rank together (the usual env-broadcast deployment —
+``MMLSPARK_TPU_OBS`` set launcher-wide), and only arms when
+``jax.process_count() > 1``.
+
+Fault injection for the multihost smoke: ``MMLSPARK_TPU_OBS_STEP_DELAY_MS``
+(with ``MMLSPARK_TPU_OBS_STEP_DELAY_RANK``) sleeps that long at each step
+end BEFORE the mark is taken on the matching rank, simulating a host-side
+straggler without touching library code paths.
+
+Everything here is off-path when obs is disabled: :func:`begin` returns
+``None`` after one flag check and every feed hook returns after the same
+check, keeping the <2% disabled-train overhead budget intact.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from collections import deque
+from typing import Optional
+
+from mmlspark_tpu.obs import _state, metrics, tracing
+
+
+def _env_int(name: str, default: int) -> int:
+    try:
+        return int(os.environ.get(name, default))
+    except ValueError:
+        return default
+
+
+def _env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, default))
+    except ValueError:
+        return default
+
+
+_CAP = max(16, _env_int("MMLSPARK_TPU_OBS_STEP_CAP", 4096))
+_STRAGGLER_EVERY = _env_int("MMLSPARK_TPU_OBS_STRAGGLER_EVERY", 8)
+_STRAGGLER_MS = _env_float("MMLSPARK_TPU_OBS_STRAGGLER_MS", 50.0)
+
+_lock = threading.Lock()
+_records: "deque" = deque(maxlen=_CAP)
+_step_seq = 0  # lifetime step count — the straggler cadence counter
+# Monotonic feed accumulators (ns).  Guarded adds under _lock: the
+# collective hook can fire from the watchdog's caller thread while the
+# ingest hook fires from the consumer thread.
+_collective_wait_ns = 0
+_ingest_stall_ns = 0
+_last_mark_ns: Optional[int] = None  # last step-end monotonic mark
+
+
+def reset() -> None:
+    """Drop ring records and accumulators (test isolation; obs.reset()
+    calls this alongside the metrics registry reset)."""
+    global _step_seq, _collective_wait_ns, _ingest_stall_ns, _last_mark_ns
+    with _lock:
+        _records.clear()
+        _step_seq = 0
+        _collective_wait_ns = 0
+        _ingest_stall_ns = 0
+        _last_mark_ns = None
+
+
+def note_collective(dur_s: float) -> None:
+    """Feed: a watchdog-wrapped collective completed (seconds)."""
+    global _collective_wait_ns
+    if not _state.enabled:
+        return
+    with _lock:
+        _collective_wait_ns += int(dur_s * 1e9)
+
+
+def note_ingest_stall(stall_ns: float) -> None:
+    """Feed: the ingest consumer was blocked on the prefetcher (ns)."""
+    global _ingest_stall_ns
+    if not _state.enabled:
+        return
+    with _lock:
+        _ingest_stall_ns += int(stall_ns)
+
+
+def records() -> list:
+    """A snapshot copy of the bounded step ring (newest last)."""
+    with _lock:
+        return list(_records)
+
+
+class _StepTimer:
+    """Baseline marks for one step (or one multi-iteration scan chunk)."""
+
+    __slots__ = ("t0_ns", "col0_ns", "stall0_ns")
+
+    def __init__(self, t0_ns: int, col0_ns: int, stall0_ns: int):
+        self.t0_ns = t0_ns
+        self.col0_ns = col0_ns
+        self.stall0_ns = stall0_ns
+
+
+def begin() -> Optional[_StepTimer]:
+    """Open a step: capture wall + attribution baselines.  Returns
+    ``None`` (one flag check) when obs is disabled."""
+    if not _state.enabled:
+        return None
+    with _lock:
+        return _StepTimer(
+            time.monotonic_ns(), _collective_wait_ns, _ingest_stall_ns
+        )
+
+
+def end(st: Optional[_StepTimer], kind: str, it: int, n: int = 1,
+        **attrs) -> None:
+    """Close a step opened by :func:`begin`.
+
+    ``n > 1`` splits the interval evenly across ``n`` DERIVED steps
+    (the fused-scan chunk: iterations ``it .. it+n-1``), mirroring the
+    derived ``booster.iteration`` spans.  Attribution deltas are split
+    the same way so the parts still sum to each derived step's wall.
+    """
+    global _step_seq, _last_mark_ns
+    if st is None or not _state.enabled:
+        return
+    _inject_delay()
+    now_ns = time.monotonic_ns()
+    with _lock:
+        wall_ns = now_ns - st.t0_ns
+        col_ns = _collective_wait_ns - st.col0_ns
+        stall_ns = _ingest_stall_ns - st.stall0_ns
+        _last_mark_ns = now_ns
+    derived = n > 1
+    n = max(1, n)
+    per_wall = wall_ns / n / 1e9
+    per_col = min(col_ns, wall_ns) / n / 1e9
+    per_stall = min(stall_ns, max(0, wall_ns - col_ns)) / n / 1e9
+    per_compute = max(0.0, per_wall - per_col - per_stall)
+    rank = _state.process_index()
+    reg = metrics.registry
+    exporter_open = tracing.exporter_path() is not None
+    for j in range(n):
+        rec = {
+            "kind": kind,
+            "it": it + j,
+            "wall_s": per_wall,
+            "compute_s": per_compute,
+            "collective_s": per_col,
+            "ingest_stall_s": per_stall,
+            "mark_ns": now_ns,
+            "rank": rank,
+        }
+        if derived:
+            rec["derived"] = True
+        if attrs:
+            rec["attrs"] = dict(attrs)
+        with _lock:
+            _records.append(rec)
+        if exporter_open:
+            tracing.write_record({
+                "kind": "step", "ts": time.time(), "rank": rank,
+                "step": rec,
+            })
+    reg.inc("train.steps", float(n), kind=kind)
+    # One histogram sample per boundary (not per derived step): the scan
+    # chunk is ONE measured interval; n samples of the same split value
+    # would fake precision the measurement doesn't have.
+    reg.observe("train.step_wall_s", per_wall, kind=kind)
+    reg.observe("train.step_compute_s", per_compute, kind=kind)
+    reg.observe("train.step_collective_s", per_col, kind=kind)
+    reg.observe("train.step_ingest_stall_s", per_stall, kind=kind)
+    with _lock:
+        _step_seq += n
+        seq = _step_seq
+    if (
+        _STRAGGLER_EVERY > 0
+        and seq // _STRAGGLER_EVERY != (seq - n) // _STRAGGLER_EVERY
+    ):
+        _check_straggler()
+    from mmlspark_tpu.obs import device
+
+    device.poll()
+
+
+def _inject_delay() -> None:
+    delay_ms = _env_float("MMLSPARK_TPU_OBS_STEP_DELAY_MS", 0.0)
+    if delay_ms <= 0:
+        return
+    target = os.environ.get("MMLSPARK_TPU_OBS_STEP_DELAY_RANK")
+    if target is not None and int(target) != _state.process_index():
+        return
+    time.sleep(delay_ms / 1e3)
+
+
+def _check_straggler() -> None:
+    """Exchange last step-end marks across ranks and gauge the skew.
+
+    Each rank ships a float64 vector ``[rank, mark_s, anchor_ts,
+    anchor_mono_s]`` (``host_allgather`` is a raw-bytes array gather —
+    seconds-scale float64 keeps ~1e-11 s resolution, far under the ms
+    threshold); the paired anchor lets every receiver place the sender's
+    monotonic mark on the shared wall clock (``tools/obs timeline``'s
+    offset reconstruction) without assuming monotonic clocks agree
+    across hosts — only NTP-level wall agreement, the same assumption
+    the timeline makes.
+    """
+    try:
+        import sys
+
+        jax = sys.modules.get("jax")
+        if jax is None or jax.process_count() <= 1:
+            return
+        import numpy as np
+
+        from mmlspark_tpu.parallel.distributed import host_allgather
+
+        with _lock:
+            mark = _last_mark_ns
+        if mark is None:
+            return
+        # The wall/monotonic anchor pair deliberately crosses the
+        # collective as DATA (offset reconstruction on the receiver) and
+        # never feeds a key or digest.  The float()/int() casts mark that
+        # boundary for the determinism-flow pass: without them the
+        # context-insensitive clock taint on host_allgather's parameter
+        # would smear through its RETURN into every caller in the
+        # project (bin bounds → binned data → AOT fingerprints) as
+        # spurious DET004s.
+        payload = np.asarray([
+            float(_state.process_index()),
+            int(mark) / 1e9,
+            float(time.time()),
+            int(time.monotonic_ns()) / 1e9,
+        ], dtype=np.float64)
+        # All-ranks evidence is the deterministic step cadence: every
+        # rank runs the same step sequence with the same _STRAGGLER_EVERY
+        # and the obs enable flag is job-wide, so every rank reaches this
+        # exchange at the same step count.
+        peers = host_allgather(payload)  # analyze: ignore[COL001]
+    except Exception:
+        # Best-effort: a half-initialized runtime (or a backend without
+        # host collectives) must never take training down.
+        return
+    walls = {}
+    for row in peers:
+        try:
+            offset = float(row[2]) - float(row[3])
+            walls[int(row[0])] = offset + float(row[1])
+        except (IndexError, TypeError, ValueError):
+            continue
+    if len(walls) < 2:
+        return
+    floor = min(walls.values())
+    skews = {r: (w - floor) * 1e3 for r, w in walls.items()}
+    max_skew = max(skews.values())
+    if max_skew <= _STRAGGLER_MS:
+        return
+    reg = metrics.registry
+    for r, skew_ms in skews.items():
+        reg.gauge("train.straggler_skew_ms", skew_ms, rank=str(r))
+    laggard = max(skews, key=lambda r: skews[r])
+    reg.inc("train.straggler_events", rank=str(laggard))
+
+
+def summary() -> dict:
+    """Aggregate view over the ring (the ``steps`` report section and
+    the bench_ratchet telemetry assertions read this shape)."""
+    recs = records()
+    by_kind: dict = {}
+    for r in recs:
+        agg = by_kind.setdefault(r["kind"], {
+            "count": 0, "wall_s": 0.0, "compute_s": 0.0,
+            "collective_s": 0.0, "ingest_stall_s": 0.0,
+        })
+        agg["count"] += 1
+        for k in ("wall_s", "compute_s", "collective_s", "ingest_stall_s"):
+            agg[k] += r[k]
+    return {"count": len(recs), "by_kind": by_kind}

@@ -23,7 +23,7 @@ import threading
 import time
 from typing import Optional
 
-from mmlspark_tpu.obs import _state, flight, metrics, tracing
+from mmlspark_tpu.obs import _state, flight, metrics, steps, tracing
 
 DEFAULT_TIMEOUT_S = 120.0
 # Re-arm and re-log this many times so long hangs stay visible in a
@@ -114,6 +114,9 @@ class collective_watchdog:
                 self.barks,
             )
         if _state.enabled:
+            # Per-step attribution: the steps channel subtracts collective
+            # wait from step wall (obs/steps.py).
+            steps.note_collective(dur_s)
             metrics.registry.inc("collective.calls", name=self.name)
             nbytes = self.attrs.get("nbytes")
             if nbytes:

@@ -63,7 +63,7 @@ class TestGeneratedDocs:
     def test_baseline_scaling_table_matches_artifact(self):
         # r4 verdict weak #2: the hand-maintained scaling table drifted
         # from its own committed artifact — it is generated now, and this
-        # gate keeps BASELINE.md == scaling_out.json (same pattern as the
+        # gate keeps BASELINE.md == SCALING_BENCH.json (same pattern as the
         # generated_api staleness gate above).
         import subprocess
         import sys

@@ -727,6 +727,73 @@ def test_obs003_suppression_round_trip(tmp_path):
     assert apply_suppressions(check_obs_file(silenced)) == []
 
 
+def test_obs004_wall_clock_duration(tmp_path):
+    # Seeded bug: steps/budget durations from differenced time.time() —
+    # NTP slew makes them jump or go negative.
+    p = _write(str(tmp_path / "m.py"), """
+        import time
+        def fit(X):
+            t0 = time.time()
+            run(X)
+            dur = time.time() - t0
+            return dur
+    """)
+    found = check_obs_file(p)
+    # both the call-operand subtraction and the tainted-name operand fire
+    assert rules(found) == ["OBS004"]
+    assert "monotonic" in found[0].message
+
+
+def test_obs004_silent_on_monotonic_and_timestamps(tmp_path):
+    p = _write(str(tmp_path / "m.py"), """
+        import time
+        def fit(X):
+            t0 = time.perf_counter()
+            run(X)
+            dur = time.perf_counter() - t0          # monotonic: fine
+            rec = {"ts": time.time(), "dur": dur}   # timestamp: fine
+            return rec
+        def other(a, b):
+            t0 = 5.0
+            return a - t0   # untainted name sharing a timestamp spelling
+    """)
+    assert check_obs_file(p) == []
+
+
+def test_obs004_scopes_do_not_leak(tmp_path):
+    # a metadata timestamp in one function must not taint a subtraction
+    # over the same name in another
+    p = _write(str(tmp_path / "m.py"), """
+        import time
+        def stamp():
+            t0 = time.time()
+            return {"ts": t0}
+        def measure(t0, t1):
+            return t1 - t0
+    """)
+    assert check_obs_file(p) == []
+
+
+def test_obs004_suppression_round_trip(tmp_path):
+    src = """
+        import time
+        def align(anchor_ts):
+            return time.time() - anchor_ts{supp}
+    """
+    fires = _write(str(tmp_path / "a.py"), src.format(supp=""))
+    assert rules(apply_suppressions(check_obs_file(fires))) == ["OBS004"]
+    silenced = _write(
+        str(tmp_path / "b.py"),
+        src.format(supp="  # analyze: ignore[OBS004]"),
+    )
+    assert apply_suppressions(check_obs_file(silenced)) == []
+
+
+def test_obs004_real_tree_clean():
+    found = apply_suppressions(check_obs(repo_root()))
+    assert [f for f in found if f.rule == "OBS004"] == []
+
+
 # -------------------------------------------------------- serving fixtures
 
 

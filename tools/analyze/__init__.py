@@ -90,7 +90,7 @@ PASSES = {
     "hygiene": (lambda root, index: check_hygiene(root, index=index),
                 {"HYG001"}),
     "obs": (lambda root, index: check_obs(root, index=index),
-            {"OBS001", "OBS002", "OBS003"}),
+            {"OBS001", "OBS002", "OBS003", "OBS004"}),
     "serving": (lambda root, index: check_serving(root, index=index),
                 {"SRV001", "SRV002"}),
     "predict": (lambda root, index: check_predict(root, index=index),

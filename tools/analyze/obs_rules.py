@@ -38,6 +38,18 @@ Rules
   forever under request traffic.  Bound it (cap + drop counter, ring
   buffer, TTL eviction) or mark a registration-time-bounded container
   with ``# analyze: ignore[OBS003]``.
+- OBS004: ``time.time()`` differenced into a DURATION.  Wall-clock time
+  steps under NTP slew/adjustment, so a ``t1 - t0`` over ``time.time()``
+  readings can go negative or jump by the correction amount — durations
+  feeding step telemetry (``obs/steps.py``), budget gates, or the perf
+  ratchet must come from the monotonic clocks (``time.perf_counter()``
+  / ``time.monotonic()`` / ``time.monotonic_ns()``).  The rule fires on
+  a subtraction whose operand is a ``time.time()`` call, or a local
+  name assigned from ``time.time()`` in the same scope.  Storing
+  ``time.time()`` as a TIMESTAMP (export-record ``ts`` fields, snapshot
+  metadata) is the correct use and stays silent.  Mark a deliberate
+  wall-clock difference (e.g. cross-host offset reconstruction against
+  an exchanged epoch) with ``# analyze: ignore[OBS004]``.
 """
 
 from __future__ import annotations
@@ -294,6 +306,71 @@ def _check_obs003(path: str, tree: ast.AST) -> list:
     return findings
 
 
+def _obs004_is_time_time(node) -> bool:
+    """``time.time()`` — the module-qualified spelling the package uses."""
+    return (
+        isinstance(node, ast.Call)
+        and isinstance(node.func, ast.Attribute)
+        and node.func.attr == "time"
+        and isinstance(node.func.value, ast.Name)
+        and node.func.value.id == "time"
+    )
+
+
+def _obs004_scope_nodes(body):
+    """Walk a scope's statements WITHOUT descending into nested function
+    scopes (each function is analyzed as its own scope, so a metadata
+    timestamp in one function never taints a subtraction in another)."""
+    _skip = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+    stack = [n for n in body if not isinstance(n, _skip)]
+    while stack:
+        node = stack.pop()
+        yield node
+        stack.extend(
+            child for child in ast.iter_child_nodes(node)
+            if not isinstance(child, _skip)
+        )
+
+
+def _check_obs004(path: str, tree: ast.AST) -> list:
+    findings = []
+    scopes = [tree.body] if isinstance(tree, ast.Module) else []
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            scopes.append(node.body)
+    for body in scopes:
+        nodes = list(_obs004_scope_nodes(body))
+        tainted = set()
+        for node in nodes:
+            if isinstance(node, ast.Assign) and any(
+                _obs004_is_time_time(sub) for sub in ast.walk(node.value)
+            ):
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Name):
+                        tainted.add(tgt.id)
+        for node in nodes:
+            if not (isinstance(node, ast.BinOp)
+                    and isinstance(node.op, ast.Sub)):
+                continue
+            for side in (node.left, node.right):
+                if _obs004_is_time_time(side) or (
+                    isinstance(side, ast.Name) and side.id in tainted
+                ):
+                    findings.append(
+                        Finding(
+                            path, node.lineno, "OBS004",
+                            "time.time() differenced into a duration — "
+                            "wall clock steps under NTP adjustment, so "
+                            "this can go negative or jump; use "
+                            "time.perf_counter()/time.monotonic() for "
+                            "durations, or mark a deliberate wall-clock "
+                            "difference with # analyze: ignore[OBS004]",
+                        )
+                    )
+                    break
+    return findings
+
+
 def check_obs_file(path: str, tree=None) -> list:
     if tree is None:
         try:
@@ -319,6 +396,7 @@ def check_obs_file(path: str, tree=None) -> list:
             )
     findings.extend(_check_obs002(path, tree))
     findings.extend(_check_obs003(path, tree))
+    findings.extend(_check_obs004(path, tree))
     return findings
 
 

@@ -10,7 +10,12 @@ n = 128e6/num_leaves ≈ 2.03M rows at 63 leaves).
 
 Each cell runs in its own subprocess (tunneled-worker crash isolation).
 
-Run: python tools/bench_rows.py [rows ...]
+Run: python tools/bench_rows.py [--out F] [rows ...]
+
+Cell results stream to stdout AND to ``--out`` (default
+``bench_out/rows_out.jsonl``, an ignored scratch directory — bench
+scratch never lands in the repo root where it reads as a committed
+ledger).  The file is written atomically at the end of the sweep.
 """
 
 import json
@@ -79,9 +84,31 @@ print(json.dumps(dict(
 """
 
 
+def _write_atomic(path, lines):
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    tmp = path + ".new"
+    try:
+        with open(tmp, "w") as f:
+            f.write("".join(ln + "\n" for ln in lines))
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.remove(tmp)
+        except OSError:
+            pass
+        raise
+
+
 def main():
-    rows = [int(a) for a in sys.argv[1:]] or [1 << 20, 1 << 21, 1 << 22]
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    argv = sys.argv[1:]
+    out_path = os.path.join(repo, "bench_out", "rows_out.jsonl")
+    if "--out" in argv:
+        i = argv.index("--out")
+        out_path = argv[i + 1]
+        argv = argv[:i] + argv[i + 2:]
+    rows = [int(a) for a in argv] or [1 << 20, 1 << 21, 1 << 22]
+    lines = []
     for n in rows:
         iters = 20
         r = subprocess.run(
@@ -89,11 +116,13 @@ def main():
             capture_output=True, text=True, timeout=1800, cwd=repo,
         )
         if r.returncode != 0:
-            print(json.dumps(dict(rows=n, crashed=True,
-                                  tail=r.stderr.strip().splitlines()[-1:])),
-                  flush=True)
-            continue
-        print(r.stdout.strip().splitlines()[-1], flush=True)
+            line = json.dumps(dict(rows=n, crashed=True,
+                                   tail=r.stderr.strip().splitlines()[-1:]))
+        else:
+            line = r.stdout.strip().splitlines()[-1]
+        print(line, flush=True)
+        lines.append(line)
+    _write_atomic(out_path, lines)
 
 
 if __name__ == "__main__":

@@ -8,7 +8,7 @@ mesh whose slow axis IS the process boundary — the inter-host
 collectives genuinely cross process memory via the distributed runtime,
 not a simulated axis.
 
-Three legs, driven by the parent:
+Four legs, driven by the parent:
 
 1. **parity** — both processes train the hierarchical 2D-mesh model
    over their deterministic shard partition (multi-controller
@@ -17,6 +17,12 @@ Three legs, driven by the parent:
    to a single-process run over the same global rows on the same
    (2, N) mesh — same global arrays, same mesh, same SPMD program, so
    the process boundary must be invisible to the math.
+1b. **straggler** — a 2-process run with obs armed and a 150 ms
+   fault-injected host delay on rank 1 (``MMLSPARK_TPU_OBS_STEP_DELAY_*``,
+   applied BEFORE the step-end mark).  The per-step cross-rank mark
+   exchange (obs/steps.py) must gauge
+   ``train.straggler_skew_ms{rank=1}`` > 0 and count a
+   ``train.straggler_events{rank=1}`` on both ranks' snapshots.
 2. **kill** — a second 2-process run checkpoints every iteration
    (digest-verified rank-0 snapshots + shard manifest).  Once the
    manifest shows ``KILL_AFTER`` iterations the parent SIGKILLs
@@ -213,14 +219,18 @@ def _child_env():
     return env
 
 
-def _spawn(workdir, port, pid, iters, checkpoint_every=0, out=None):
+def _spawn(workdir, port, pid, iters, checkpoint_every=0, out=None,
+           extra_env=None):
+    env = _child_env()
+    if extra_env:
+        env.update(extra_env)
     return subprocess.Popen(
         _child_argv(workdir, iters, checkpoint_every, out, [
             "--coordinator", f"127.0.0.1:{port}",
             "--num-processes", "2", "--process-id", str(pid),
             "--local-devices", str(LOCAL_DEVICES),
         ]),
-        env=_child_env(),
+        env=env,
     )
 
 
@@ -311,6 +321,49 @@ def main() -> None:
     assert parity_bitwise, (
         "2-process model differs from single-process model "
         f"(AUC {two['auc']:.6f} vs {ref['auc']:.6f})")
+
+    # ---- leg 1b: straggler detection under an injected host delay ------
+    # Rank 1 sleeps 150 ms at each step end BEFORE its step-end mark is
+    # captured (obs/steps.py fault injection), so the cross-rank mark
+    # exchange must reconstruct a skew far above the 20 ms threshold and
+    # gauge rank 1 as the laggard on BOTH ranks' snapshots.
+    port = _free_port()
+    strag_path = os.path.join(workdir, "straggler.jsonl")
+    strag_env = {
+        "MMLSPARK_TPU_OBS": strag_path,
+        "MMLSPARK_TPU_OBS_STRAGGLER_EVERY": "1",
+        "MMLSPARK_TPU_OBS_STRAGGLER_MS": "20",
+        "MMLSPARK_TPU_OBS_STEP_DELAY_MS": "150",
+        "MMLSPARK_TPU_OBS_STEP_DELAY_RANK": "1",
+    }
+    t0 = time.monotonic()
+    procs = [_spawn(workdir, port, pid, 6, extra_env=strag_env)
+             for pid in (0, 1)]
+    rcs = [p.wait(timeout=900) for p in procs]
+    assert rcs == [0, 0], f"straggler leg training failed: rcs={rcs}"
+
+    from tools import obs as obs_tools
+
+    strag_report = obs_tools.aggregate(obs_tools.load_records(strag_path))
+    skews, events = {}, 0.0
+    for _rank, snap in strag_report["snapshots"].items():
+        for k, v in (snap.get("gauges") or {}).items():
+            if k.startswith("train.straggler_skew_ms{"):
+                skews[k] = max(skews.get(k, 0.0), float(v))
+        for k, v in (snap.get("counters") or {}).items():
+            if k == "train.straggler_events{rank=1}":
+                events += float(v)
+    laggard = skews.get("train.straggler_skew_ms{rank=1}", 0.0)
+    report["straggler"] = {
+        "skew_ms": skews,
+        "laggard_skew_ms": laggard,
+        "events_rank1": events,
+    }
+    _log(f"straggler leg done in {time.monotonic() - t0:.1f}s "
+         f"rank-1 skew {laggard:.1f}ms over {len(skews)} gauge(s)")
+    assert laggard > 0.0, (
+        f"delayed rank never gauged as straggler: {skews}")
+    assert events >= 1.0, "no straggler event counted for rank 1"
 
     # ---- leg 2: kill one process mid-run -------------------------------
     kill_dir = os.path.join(workdir, "ckpt")

@@ -81,10 +81,12 @@ def _rank_label(rec: dict, fallback: Optional[dict] = None):
 
 
 def aggregate(records: List[dict]) -> dict:
-    """Fold span records into per-name stats; keep the LAST snapshot per
-    rank/replica (the exit-time one supersedes any mid-run
-    export_snapshot)."""
+    """Fold span records into per-name stats and step records
+    (``obs/steps.py`` exports) into per-kind wall/compute/collective/
+    ingest-stall attribution; keep the LAST snapshot per rank/replica
+    (the exit-time one supersedes any mid-run export_snapshot)."""
     spans: Dict[str, dict] = {}
+    steps: Dict[str, dict] = {}
     snapshots: Dict[str, dict] = {}
     ranks = set()
     for rec in records:
@@ -106,6 +108,35 @@ def aggregate(records: List[dict]) -> dict:
             agg["total_s"] += dur
             agg["max_s"] = max(agg["max_s"], dur)
             agg["ranks"].add(rk)
+        elif kind == "step":
+            st = rec.get("step") or {}
+            sk = str(st.get("kind", "?"))
+            rk = _rank_label(rec)
+            ranks.add(rk)
+            agg = steps.get(sk)
+            if agg is None:
+                agg = steps[sk] = {
+                    "count": 0,
+                    "wall_s": 0.0,
+                    "compute_s": 0.0,
+                    "collective_s": 0.0,
+                    "ingest_stall_s": 0.0,
+                    "max_wall_s": 0.0,
+                    "ranks": set(),
+                }
+            agg["count"] += 1
+            for f in ("wall_s", "compute_s", "collective_s",
+                      "ingest_stall_s"):
+                try:
+                    agg[f] += float(st.get(f, 0.0) or 0.0)
+                except (TypeError, ValueError):
+                    pass
+            try:
+                agg["max_wall_s"] = max(agg["max_wall_s"],
+                                        float(st.get("wall_s", 0.0) or 0.0))
+            except (TypeError, ValueError):
+                pass
+            agg["ranks"].add(rk)
         elif kind == "snapshot":
             rk = _rank_label(rec)
             ranks.add(rk)
@@ -113,12 +144,36 @@ def aggregate(records: List[dict]) -> dict:
     for agg in spans.values():
         agg["mean_s"] = agg["total_s"] / agg["count"]
         agg["ranks"] = sorted(agg.pop("ranks"), key=str)
+    for agg in steps.values():
+        agg["mean_wall_s"] = agg["wall_s"] / agg["count"]
+        agg["ranks"] = sorted(agg.pop("ranks"), key=str)
     return {
         "span_records": sum(a["count"] for a in spans.values()),
+        "step_records": sum(a["count"] for a in steps.values()),
         "ranks": sorted(ranks, key=str),
         "spans": spans,
+        "steps": steps,
+        "device": _device_sections(snapshots),
         "snapshots": snapshots,
     }
+
+
+def _device_sections(snapshots: Dict[str, dict]) -> dict:
+    """Per-rank device-memory gauges + compile-event counters
+    (``obs/device.py`` series) pulled out of the exit snapshots."""
+    out: Dict[str, dict] = {}
+    for rank, snap in snapshots.items():
+        mem = {
+            k: float(v) for k, v in (snap.get("gauges") or {}).items()
+            if k.startswith("device.")
+        }
+        comp = {
+            k: float(v) for k, v in (snap.get("counters") or {}).items()
+            if k.startswith("device.compile_events")
+        }
+        if mem or comp:
+            out[rank] = {"memory": mem, "compile_events": comp}
+    return out
 
 
 def render_text(report: dict, files: List[str]) -> str:
@@ -126,6 +181,7 @@ def render_text(report: dict, files: List[str]) -> str:
     out.append(
         f"obs report — {len(files)} file(s), "
         f"{report['span_records']} span record(s), "
+        f"{report.get('step_records', 0)} step record(s), "
         f"rank(s) {report['ranks'] or [0]}"
     )
     if report["spans"]:
@@ -142,6 +198,30 @@ def render_text(report: dict, files: List[str]) -> str:
                 f"  {name:<40} {a['count']:>7} {a['total_s']:>10.4f} "
                 f"{a['mean_s']:>10.4f} {a['max_s']:>10.4f}"
             )
+    if report.get("steps"):
+        out.append("")
+        out.append(
+            f"  {'step kind':<12} {'count':>7} {'wall_s':>10} "
+            f"{'compute_s':>10} {'collect_s':>10} {'stall_s':>10} "
+            f"{'mean_s':>9}"
+        )
+        for sk in sorted(
+            report["steps"], key=lambda k: -report["steps"][k]["wall_s"]
+        ):
+            a = report["steps"][sk]
+            out.append(
+                f"  {sk:<12} {a['count']:>7} {a['wall_s']:>10.4f} "
+                f"{a['compute_s']:>10.4f} {a['collective_s']:>10.4f} "
+                f"{a['ingest_stall_s']:>10.4f} {a['mean_wall_s']:>9.4f}"
+            )
+    for rank in sorted(report.get("device") or {}):
+        d = report["device"][rank]
+        out.append("")
+        out.append(f"  device (rank {rank}):")
+        for k in sorted(d["memory"]):
+            out.append(f"    gauge    {k} = {d['memory'][k]:g}")
+        for k in sorted(d["compile_events"]):
+            out.append(f"    counter  {k} = {d['compile_events'][k]:g}")
     for rank in sorted(report["snapshots"]):
         snap = report["snapshots"][rank]
         counters = snap.get("counters", {})
