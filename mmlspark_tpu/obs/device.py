@@ -12,10 +12,12 @@ the common path:
 - ``device.live_buffer_bytes`` from ``jax.live_arrays()`` byte totals
   (the host-visible ledger of what obs-enabled code kept alive).
 
-Backends whose devices expose no ``memory_stats`` (XLA:CPU) degrade to
-a permanent no-op after the first probe — :func:`poll` then costs one
-boolean check.  jax is looked up in ``sys.modules`` only (the obs spine
-never imports it).
+Backends exposing NEITHER signal (no device ``memory_stats`` and no
+``jax.live_arrays`` attribute) degrade to a permanent no-op after the
+first probe — :func:`poll` then costs one boolean check.  A zero-byte
+``live_arrays`` total is a valid reading and never triggers the latch.
+jax is looked up in ``sys.modules`` only (the obs spine never imports
+it).
 
 Compile-event counters, unified with the jit_cache spans: the three
 places a program identity can cost wall time each bump
@@ -88,6 +90,8 @@ def poll(force: bool = False) -> Optional[dict]:
         return None
     sample: dict = {"devices": {}}
     got_stats = False
+    live_supported = False  # the live_arrays SIGNAL exists (0.0 is a
+    # valid reading — never confuse value-is-zero with no-signal)
     try:
         for d in jax.local_devices():
             try:
@@ -110,6 +114,7 @@ def poll(force: bool = False) -> Optional[dict]:
                     metrics.registry.gauge("device.hbm_peak_seen", peak)
         live = getattr(jax, "live_arrays", None)
         if live is not None:
+            live_supported = True
             nbytes = 0
             for a in live():
                 try:
@@ -122,9 +127,13 @@ def poll(force: bool = False) -> Optional[dict]:
             )
     except Exception:
         return None
-    if not got_stats and not sample.get("live_buffer_bytes"):
-        # Nothing measurable on this backend: latch off so the step-
-        # boundary call degrades to one boolean check.
+    if not got_stats and not live_supported:
+        # NO measurement signal exists on this backend (no device
+        # memory_stats AND no jax.live_arrays attribute): latch off so
+        # the step-boundary call degrades to one boolean check.  A
+        # zero-byte live_arrays total is a real reading, not absence —
+        # it must NOT latch, or a first poll before any arrays exist
+        # would permanently disable accounting.
         _unsupported = True
         return None
     return sample

@@ -17,20 +17,37 @@ the blackbox memory contract), flow to the JSONL export as
 into ``train.step_*_s`` histograms + ``train.steps{kind=}`` counters.
 ``python -m tools.obs report`` renders them as the ``steps`` section.
 
-Cross-rank straggler detection: every :data:`_STRAGGLER_EVERY` steps
-(env ``MMLSPARK_TPU_OBS_STRAGGLER_EVERY``, ``0`` disables) each rank
-publishes its last step-end monotonic mark paired with a fresh
-``(time.time(), time.monotonic_ns())`` anchor through ``host_allgather``.
+Cross-rank straggler detection: every :data:`_STRAGGLER_EVERY`
+TRAINING steps (env ``MMLSPARK_TPU_OBS_STRAGGLER_EVERY``, ``0``
+disables) each rank publishes its last step-end monotonic mark paired
+with a fresh ``(time.time(), time.monotonic_ns())`` anchor through the
+distributed runtime's coordination-service key-value store.  Only
+:data:`_SYNC_KINDS` steps (``legacy``/``scan`` — the SPMD training
+loop, lockstep on every rank) advance the cadence counter; ``ingest``
+chunks never do, because their per-rank count is data-dependent
+(round-robin shards × row-dependent chunking) and a data-dependent
+collective cadence is exactly the PR 1 deadlock class — one rank
+blocking in a gather no peer enters.  The KV transport is the second
+layer of defence: it rides the coordinator's TCP control plane, never
+the gloo/ICI data plane, so it cannot interleave with training
+collectives still in flight from async dispatch (a device-collective
+exchange here raced the step's own psums on shared transport slots),
+it never feeds the watchdog/:func:`note_collective` attribution (a
+fast rank's wait for the laggard is measurement plumbing, not step
+work), and every peer read is bounded by
+``MMLSPARK_TPU_OBS_STRAGGLER_TIMEOUT_MS`` (default 30000) — a rank
+that somehow reaches an exchange alone times out and skips the round
+instead of hanging the job.
 Each rank reconstructs every peer's mark as wall time exactly the way
 ``tools/obs timeline`` aligns blackbox dumps — ``wall = anchor_ts −
 (anchor_mono_ns − mark_ns)/1e9`` — and when the spread exceeds
 ``MMLSPARK_TPU_OBS_STRAGGLER_MS`` (default 50) bumps
 ``train.straggler_skew_ms{rank=}`` per rank plus a
-``train.straggler_events{rank=<laggard>}`` counter.  The exchange is a
-collective: it fires on a deterministic step cadence and requires obs to
-be enabled on EVERY rank together (the usual env-broadcast deployment —
+``train.straggler_events{rank=<laggard>}`` counter.  The exchange
+fires on a deterministic step cadence and requires obs to be enabled
+on EVERY rank together (the usual env-broadcast deployment —
 ``MMLSPARK_TPU_OBS`` set launcher-wide), and only arms when
-``jax.process_count() > 1``.
+``jax.process_count() > 1`` and the distributed client is up.
 
 Fault injection for the multihost smoke: ``MMLSPARK_TPU_OBS_STEP_DELAY_MS``
 (with ``MMLSPARK_TPU_OBS_STEP_DELAY_RANK``) sleeps that long at each step
@@ -70,10 +87,21 @@ def _env_float(name: str, default: float) -> float:
 _CAP = max(16, _env_int("MMLSPARK_TPU_OBS_STEP_CAP", 4096))
 _STRAGGLER_EVERY = _env_int("MMLSPARK_TPU_OBS_STRAGGLER_EVERY", 8)
 _STRAGGLER_MS = _env_float("MMLSPARK_TPU_OBS_STRAGGLER_MS", 50.0)
+_STRAGGLER_TIMEOUT_MS = _env_int(
+    "MMLSPARK_TPU_OBS_STRAGGLER_TIMEOUT_MS", 30_000
+)
+
+# Step kinds whose lifetime count is provably identical on every rank
+# (the SPMD training loop: same num_iterations everywhere).  ONLY these
+# may drive the straggler-exchange cadence — an allowlist, so a future
+# data-dependent kind defaults to never entering a collective.
+_SYNC_KINDS = frozenset({"legacy", "scan"})
 
 _lock = threading.Lock()
 _records: "deque" = deque(maxlen=_CAP)
-_step_seq = 0  # lifetime step count — the straggler cadence counter
+_step_seq = 0  # lifetime step count, all kinds (reporting only)
+_sync_seq = 0  # lifetime _SYNC_KINDS count — the straggler cadence
+_prev_kv_key: Optional[str] = None  # this rank's previous exchange key
 # Monotonic feed accumulators (ns).  Guarded adds under _lock: the
 # collective hook can fire from the watchdog's caller thread while the
 # ingest hook fires from the consumer thread.
@@ -85,13 +113,16 @@ _last_mark_ns: Optional[int] = None  # last step-end monotonic mark
 def reset() -> None:
     """Drop ring records and accumulators (test isolation; obs.reset()
     calls this alongside the metrics registry reset)."""
-    global _step_seq, _collective_wait_ns, _ingest_stall_ns, _last_mark_ns
+    global _step_seq, _sync_seq, _collective_wait_ns, _ingest_stall_ns
+    global _last_mark_ns, _prev_kv_key
     with _lock:
         _records.clear()
         _step_seq = 0
+        _sync_seq = 0
         _collective_wait_ns = 0
         _ingest_stall_ns = 0
         _last_mark_ns = None
+        _prev_kv_key = None
 
 
 def note_collective(dur_s: float) -> None:
@@ -149,7 +180,7 @@ def end(st: Optional[_StepTimer], kind: str, it: int, n: int = 1,
     derived ``booster.iteration`` spans.  Attribution deltas are split
     the same way so the parts still sum to each derived step's wall.
     """
-    global _step_seq, _last_mark_ns
+    global _step_seq, _sync_seq, _last_mark_ns
     if st is None or not _state.enabled:
         return
     _inject_delay()
@@ -200,12 +231,17 @@ def end(st: Optional[_StepTimer], kind: str, it: int, n: int = 1,
     reg.observe("train.step_ingest_stall_s", per_stall, kind=kind)
     with _lock:
         _step_seq += n
-        seq = _step_seq
+        if kind in _SYNC_KINDS:
+            _sync_seq += n
+            seq = _sync_seq
+        else:
+            seq = None  # data-dependent kind: never drives the exchange
     if (
-        _STRAGGLER_EVERY > 0
+        seq is not None
+        and _STRAGGLER_EVERY > 0
         and seq // _STRAGGLER_EVERY != (seq - n) // _STRAGGLER_EVERY
     ):
-        _check_straggler()
+        _check_straggler(seq)
     from mmlspark_tpu.obs import device
 
     device.poll()
@@ -221,17 +257,66 @@ def _inject_delay() -> None:
     time.sleep(delay_ms / 1e3)
 
 
-def _check_straggler() -> None:
+_KV_PREFIX = "mmlspark_tpu/obs/straggler"
+
+
+def _exchange_marks(epoch: int, row: list, nproc: int):
+    """Publish ``row`` and collect every peer's via the coordination
+    service's key-value store; returns all rows (order unspecified) or
+    ``None`` when no distributed client is up.
+
+    The KV store is the distributed runtime's TCP control plane — the
+    same channel jax.distributed.initialize() bootstraps over.  Using
+    it instead of a device collective keeps the exchange off the
+    gloo/ICI data plane entirely: it cannot collide with training
+    collectives still executing from async dispatch, it never passes
+    through ``collective_watchdog`` (so a fast rank's wait for the
+    laggard is not mis-fed to :func:`note_collective`), and each peer
+    read is timeout-bounded, so even a cadence bug degrades to a
+    skipped round instead of the PR 1 silent-hang class.
+    """
+    global _prev_kv_key
+    from jax._src import distributed as jax_distributed
+
+    client = getattr(jax_distributed.global_state, "client", None)
+    if client is None:
+        return None
+    me = int(row[0])
+    key = "%s/%d/%d" % (_KV_PREFIX, epoch, me)
+    client.key_value_set(key, ",".join(repr(float(v)) for v in row))
+    rows = [list(row)]
+    for r in range(nproc):
+        if r == me:
+            continue
+        raw = client.blocking_key_value_get(
+            "%s/%d/%d" % (_KV_PREFIX, epoch, r), _STRAGGLER_TIMEOUT_MS
+        )
+        rows.append([float(x) for x in raw.split(",")])
+    # Bound coordinator memory: observing every peer's epoch-E key
+    # proves each peer finished its previous round's reads (a rank
+    # writes epoch E only after completing epoch E-1), so this rank's
+    # previous key can no longer be awaited by anyone — delete it.
+    if _prev_kv_key is not None:
+        try:
+            client.key_value_delete(_prev_kv_key)
+        except Exception:
+            pass
+    _prev_kv_key = key
+    return rows
+
+
+def _check_straggler(epoch: Optional[int] = None) -> None:
     """Exchange last step-end marks across ranks and gauge the skew.
 
-    Each rank ships a float64 vector ``[rank, mark_s, anchor_ts,
-    anchor_mono_s]`` (``host_allgather`` is a raw-bytes array gather —
-    seconds-scale float64 keeps ~1e-11 s resolution, far under the ms
-    threshold); the paired anchor lets every receiver place the sender's
-    monotonic mark on the shared wall clock (``tools/obs timeline``'s
-    offset reconstruction) without assuming monotonic clocks agree
-    across hosts — only NTP-level wall agreement, the same assumption
-    the timeline makes.
+    Each rank ships ``[rank, mark_s, anchor_ts, anchor_mono_s]``
+    keyed by ``epoch`` (the ``_sync_seq`` value at the firing boundary
+    — identical on every rank by the :data:`_SYNC_KINDS` cadence
+    invariant, so matching rounds meet at matching keys); the paired
+    anchor lets every receiver place the sender's monotonic mark on
+    the shared wall clock (``tools/obs timeline``'s offset
+    reconstruction) without assuming monotonic clocks agree across
+    hosts — only NTP-level wall agreement, the same assumption the
+    timeline makes.
     """
     try:
         import sys
@@ -239,36 +324,24 @@ def _check_straggler() -> None:
         jax = sys.modules.get("jax")
         if jax is None or jax.process_count() <= 1:
             return
-        import numpy as np
-
-        from mmlspark_tpu.parallel.distributed import host_allgather
-
         with _lock:
             mark = _last_mark_ns
+            if epoch is None:
+                epoch = _sync_seq
         if mark is None:
             return
-        # The wall/monotonic anchor pair deliberately crosses the
-        # collective as DATA (offset reconstruction on the receiver) and
-        # never feeds a key or digest.  The float()/int() casts mark that
-        # boundary for the determinism-flow pass: without them the
-        # context-insensitive clock taint on host_allgather's parameter
-        # would smear through its RETURN into every caller in the
-        # project (bin bounds → binned data → AOT fingerprints) as
-        # spurious DET004s.
-        payload = np.asarray([
+        row = [
             float(_state.process_index()),
             int(mark) / 1e9,
             float(time.time()),
             int(time.monotonic_ns()) / 1e9,
-        ], dtype=np.float64)
-        # All-ranks evidence is the deterministic step cadence: every
-        # rank runs the same step sequence with the same _STRAGGLER_EVERY
-        # and the obs enable flag is job-wide, so every rank reaches this
-        # exchange at the same step count.
-        peers = host_allgather(payload)  # analyze: ignore[COL001]
+        ]
+        peers = _exchange_marks(int(epoch), row, int(jax.process_count()))
+        if peers is None:
+            return
     except Exception:
-        # Best-effort: a half-initialized runtime (or a backend without
-        # host collectives) must never take training down.
+        # Best-effort: a half-initialized runtime (or a peer that never
+        # shows up before the KV timeout) must never take training down.
         return
     walls = {}
     for row in peers:

@@ -630,7 +630,6 @@ class TestStepTelemetry:
         import jax
 
         from mmlspark_tpu.obs import steps
-        from mmlspark_tpu.parallel import distributed
 
         obs.enable()
         st = steps.begin()  # one completed step so a mark exists
@@ -640,12 +639,12 @@ class TestStepTelemetry:
         anchor_mono = time.monotonic_ns() / 1e9
         # same anchor on both ranks, rank 1's mark 300ms later — exactly
         # the shape the receiver-side offset reconstruction expects
-        peers = np.asarray([
+        peers = [
             [0.0, 100.0, anchor_ts, anchor_mono],
             [1.0, 100.3, anchor_ts, anchor_mono],
-        ], dtype=np.float64)
-        monkeypatch.setattr(distributed, "host_allgather",
-                            lambda payload: peers)
+        ]
+        monkeypatch.setattr(steps, "_exchange_marks",
+                            lambda epoch, row, nproc: peers)
         steps._check_straggler()
         snap = obs.snapshot()
         skew = snap["gauges"]["train.straggler_skew_ms{rank=1}"]
@@ -657,7 +656,6 @@ class TestStepTelemetry:
         import jax
 
         from mmlspark_tpu.obs import steps
-        from mmlspark_tpu.parallel import distributed
 
         obs.enable()
         st = steps.begin()
@@ -665,16 +663,169 @@ class TestStepTelemetry:
         monkeypatch.setattr(jax, "process_count", lambda: 2)
         anchor_ts = time.time()
         anchor_mono = time.monotonic_ns() / 1e9
-        peers = np.asarray([
+        peers = [
             [0.0, 100.0, anchor_ts, anchor_mono],
             [1.0, 100.01, anchor_ts, anchor_mono],  # 10ms < 50ms default
-        ], dtype=np.float64)
-        monkeypatch.setattr(distributed, "host_allgather",
-                            lambda payload: peers)
+        ]
+        monkeypatch.setattr(steps, "_exchange_marks",
+                            lambda epoch, row, nproc: peers)
         steps._check_straggler()
         snap = obs.snapshot()
         assert not any("straggler" in k for k in snap["gauges"])
         assert not any("straggler" in k for k in snap["counters"])
+
+    def test_ingest_steps_never_drive_the_exchange(self, monkeypatch):
+        # The PR 1 deadlock class: ingest chunk counts are per-rank
+        # data-dependent (round-robin shards × row-dependent chunking),
+        # so an ingest-driven cadence would have ranks executing
+        # different numbers of collectives — one blocking forever in a
+        # gather no peer enters.  Only lockstep training kinds may fire.
+        import jax
+
+        from mmlspark_tpu.obs import steps
+
+        obs.enable()
+        monkeypatch.setattr(steps, "_STRAGGLER_EVERY", 1)
+        monkeypatch.setattr(jax, "process_count", lambda: 2)
+        calls = []
+
+        def fake_exchange(epoch, row, nproc):
+            calls.append((epoch, list(row)))
+            return [list(row)]
+
+        monkeypatch.setattr(steps, "_exchange_marks", fake_exchange)
+        for i in range(5):
+            steps.end(steps.begin(), "ingest", i)
+        assert not calls, "data-dependent ingest steps entered a collective"
+        steps.end(steps.begin(), "legacy", 0)
+        assert len(calls) == 1, "training step did not drive the exchange"
+
+    def test_exchange_wait_not_attributed_as_collective_wait(
+            self, monkeypatch):
+        # A fast rank blocks in the exchange for the laggard's full
+        # delay; feeding that wait to note_collective would inflate
+        # train.step_collective_s exactly when a straggler exists.  The
+        # exchange rides the coordination-service KV store — never a
+        # watchdog-wrapped collective — so its wait must leave the
+        # attribution accumulator untouched, while an ordinary
+        # collective on the same thread still feeds.
+        import jax
+
+        from mmlspark_tpu.obs import steps
+        from mmlspark_tpu.obs.watchdog import collective_watchdog
+
+        obs.enable()
+        steps.end(steps.begin(), "legacy", 0)  # a mark exists
+        monkeypatch.setattr(jax, "process_count", lambda: 2)
+
+        def slow_exchange(epoch, row, nproc):
+            time.sleep(0.01)  # the laggard shows up 10ms late
+            return [list(row), [1.0, row[1] + 0.3, row[2], row[3]]]
+
+        monkeypatch.setattr(steps, "_exchange_marks", slow_exchange)
+        before = steps._collective_wait_ns
+        steps._check_straggler()
+        assert steps._collective_wait_ns == before, (
+            "straggler exchange's own wait fed the step attribution")
+        # an ordinary collective on the same thread still feeds
+        with collective_watchdog("host_allgather", timeout_s=0):
+            time.sleep(0.001)
+        assert steps._collective_wait_ns > before
+
+    def test_exchange_marks_kv_roundtrip(self, monkeypatch):
+        # The exchange transport against a fake coordination-service
+        # client: publish-then-collect, previous-epoch cleanup, and the
+        # timeout path degrading to a skipped round (never a hang).
+        import jax
+        from jax._src import distributed as jax_distributed
+
+        from mmlspark_tpu.obs import steps
+
+        class _FakeClient:
+            def __init__(self):
+                self.store: dict = {}
+                self.deleted: list = []
+
+            def key_value_set(self, key, value):
+                assert key not in self.store, key
+                self.store[key] = value
+
+            def blocking_key_value_get(self, key, timeout_ms):
+                if key not in self.store:
+                    raise TimeoutError(key)  # peer never published
+                return self.store[key]
+
+            def key_value_delete(self, key):
+                self.deleted.append(key)
+                self.store.pop(key, None)
+
+        fake = _FakeClient()
+        monkeypatch.setattr(jax_distributed.global_state, "client", fake)
+        monkeypatch.setattr(steps, "_prev_kv_key", None)
+        pfx = steps._KV_PREFIX
+        fake.key_value_set(f"{pfx}/6/1", "1.0,100.3,5.0,4.0")
+        rows = steps._exchange_marks(6, [0.0, 100.0, 5.0, 4.0], 2)
+        assert sorted(r[0] for r in rows) == [0.0, 1.0]
+        assert [r for r in rows if r[0] == 1.0][0][1] == 100.3
+        assert not fake.deleted  # first round: nothing to clean up yet
+        # the next round retires this rank's previous key
+        fake.key_value_set(f"{pfx}/12/1", "1.0,200.3,5.0,4.0")
+        steps._exchange_marks(12, [0.0, 200.0, 5.0, 4.0], 2)
+        assert fake.deleted == [f"{pfx}/6/0"]
+        # a peer that never publishes → bounded timeout swallowed by
+        # _check_straggler's best-effort guard, no gauges emitted
+        obs.enable()
+        steps.end(steps.begin(), "legacy", 0)
+        monkeypatch.setattr(jax, "process_count", lambda: 2)
+        steps._check_straggler()
+        snap = obs.snapshot()
+        assert not any("straggler" in k for k in snap["gauges"])
+
+    def test_zero_live_bytes_does_not_latch_device_off(self, monkeypatch):
+        from mmlspark_tpu.obs import device
+
+        obs.enable()
+
+        class _FakeJax:
+            _arrays: list = []
+
+            @staticmethod
+            def local_devices():
+                return []  # no memory_stats anywhere
+
+            @classmethod
+            def live_arrays(cls):
+                return cls._arrays
+
+        monkeypatch.setitem(sys.modules, "jax", _FakeJax)
+        # first poll before any arrays exist: 0.0 is a valid READING on
+        # a live_arrays-capable backend, not absence of signal
+        s = device.poll(force=True)
+        assert s is not None and s["live_buffer_bytes"] == 0.0
+        assert not device._unsupported
+
+        class _Buf:
+            nbytes = 1024
+
+        _FakeJax._arrays = [_Buf()]
+        s2 = device.poll(force=True)
+        assert s2 is not None and s2["live_buffer_bytes"] == 1024.0
+
+    def test_no_signal_backend_latches_device_off(self, monkeypatch):
+        from mmlspark_tpu.obs import device
+
+        obs.enable()
+
+        class _BareJax:
+            # neither device memory_stats nor a live_arrays attribute
+            @staticmethod
+            def local_devices():
+                return []
+
+        monkeypatch.setitem(sys.modules, "jax", _BareJax)
+        assert device.poll(force=True) is None
+        assert device._unsupported
+        assert device.poll(force=True) is None  # latched: one bool check
 
     def test_device_gauges_polled_at_step_boundaries(self):
         obs.enable()
