@@ -493,26 +493,60 @@ def train_streaming(
     in-memory path would.  With ``return_dataset=True`` returns
     ``(booster, streamed_dataset)`` so callers can reuse the ingested
     cache across training calls.
+
+    With ``init_model`` set this is the WARM-START refit entry (the
+    closed loop's append-trees path, ISSUE 18): the sketch fit is
+    skipped and the fresh shards are binned through the init_model's
+    own authority — continuation pins the thresholds its trees were
+    grown on — with ``num_iterations`` counting NEW trees and the
+    per-iteration RNG continuing at the absolute fold_in schedule.
     """
     from mmlspark_tpu.engine.booster import TrainConfig
     from mmlspark_tpu.engine.booster import train as _train
 
     cfg = TrainConfig.from_params(params)
-    with obs.span("train.binning", streamed=True, rows=source.num_rows):
-        authority, sketch = stream_fit_binning(
-            source,
-            max_bin=cfg.max_bin,
-            categorical_features=tuple(cfg.categorical_feature),
-            chunk_rows=chunk_rows,
-            exact_budget=exact_budget,
-            compactor_cap=compactor_cap,
-        )
-        if obs.enabled():
-            obs.gauge("ingest.sketch_rank_epsilon", float(sketch.rank_epsilon))
-        train_set = stream_ingest(
-            source, authority, chunk_rows=chunk_rows, pack=pack,
-            fuse=fuse, quality_sample_cap=4096, seed=cfg.seed,
-        )
+    if init_model is not None:
+        # Warm-start refit (the closed loop's append-trees path):
+        # continuation replays the old trees, which pins their
+        # thresholds — so the fresh shards are ingested through the
+        # init_model's OWN BinningAuthority instead of sketch-fitting
+        # new edges the trainer would then have to reject.
+        authority = init_model.bin_authority()
+        bm = authority.mapper
+        if (int(cfg.max_bin) != int(bm.max_bin)
+                or tuple(cfg.categorical_feature)
+                != tuple(bm.categorical_features)):
+            raise ValueError(
+                "warm-start streamed refit pins the init_model's binning "
+                f"(max_bin={bm.max_bin}, categorical="
+                f"{tuple(bm.categorical_features)}); params asked for "
+                f"max_bin={cfg.max_bin}, categorical="
+                f"{tuple(cfg.categorical_feature)}"
+            )
+        with obs.span("train.binning", streamed=True, warm_start=True,
+                      rows=source.num_rows):
+            train_set = stream_ingest(
+                source, authority, chunk_rows=chunk_rows, pack=pack,
+                fuse=fuse, quality_sample_cap=4096, seed=cfg.seed,
+            )
+    else:
+        with obs.span("train.binning", streamed=True, rows=source.num_rows):
+            authority, sketch = stream_fit_binning(
+                source,
+                max_bin=cfg.max_bin,
+                categorical_features=tuple(cfg.categorical_feature),
+                chunk_rows=chunk_rows,
+                exact_budget=exact_budget,
+                compactor_cap=compactor_cap,
+            )
+            if obs.enabled():
+                obs.gauge(
+                    "ingest.sketch_rank_epsilon", float(sketch.rank_epsilon)
+                )
+            train_set = stream_ingest(
+                source, authority, chunk_rows=chunk_rows, pack=pack,
+                fuse=fuse, quality_sample_cap=4096, seed=cfg.seed,
+            )
     if train_set.label is None:
         raise ValueError(
             "streamed training needs labels: the shard source yielded none "

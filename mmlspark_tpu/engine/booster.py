@@ -754,6 +754,41 @@ class Booster:
             self._bin_authority = BinningAuthority(self.bin_mapper)
         return self._bin_authority
 
+    def append_trees(
+        self,
+        source,
+        num_trees: int,
+        params: Optional[dict] = None,
+        chunk_rows: Optional[int] = None,
+        mesh=None,
+    ) -> "Booster":
+        """Warm-start continuation entry (the closed loop's refit path,
+        ISSUE 18): return a NEW booster extending this one by
+        ``num_trees`` trees trained on ``source`` — a shard source the
+        streamed ingest accepts — binned through THIS booster's
+        authority, with the per-iteration RNG continuing at the absolute
+        fold_in schedule (tree ``T+k`` draws the key it would have drawn
+        in one long run).  ``params`` overrides training params for the
+        appended trees (learning_rate decay, say); binning params stay
+        pinned by the continuation contract."""
+        if num_trees <= 0:
+            raise ValueError(f"num_trees must be positive, got {num_trees}")
+        from mmlspark_tpu.data.streaming import train_streaming
+
+        base = dataclasses.asdict(self.config)
+        base.update(params or {})
+        base["num_iterations"] = int(num_trees)
+        # binning is pinned by the fitted mapper, which may disagree with
+        # the config dataclass (facade-fit mappers carry their own max_bin)
+        base["max_bin"] = int(self.bin_mapper.max_bin)
+        base["categorical_feature"] = tuple(
+            self.bin_mapper.categorical_features
+        )
+        kwargs = {} if not chunk_rows else {"chunk_rows": int(chunk_rows)}
+        return train_streaming(
+            base, source, init_model=self, mesh=mesh, **kwargs
+        )
+
     def device_binner(self):
         """Uploaded-once on-device binning state (via the binning
         authority) for the raw-f32-rows serving hot path."""

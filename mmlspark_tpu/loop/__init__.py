@@ -1,0 +1,34 @@
+"""mmlspark_tpu.loop — closed-loop continuous training (ISSUE 18).
+
+Drift → retrain → shadow → gated promotion → (rollback), autonomously:
+
+- :mod:`~mmlspark_tpu.loop.controller` — the retrain controller daemon
+  (alarm subscription, debounce + cooldown, bounded priority job queue);
+- :mod:`~mmlspark_tpu.loop.refit` — warm-started incremental refit via
+  the elastic checkpoint path and ``train_streaming(init_model=...)``;
+- :mod:`~mmlspark_tpu.loop.shadow` — un-routed challenger fed sampled
+  mirror copies of live traffic, bounded per-challenger monitors;
+- :mod:`~mmlspark_tpu.loop.promote` — the promotion gate + probation
+  semantics (SLO-burn auto-rollback to the pinned previous version).
+
+Wire-up is one call: ``app.attach_loop(RetrainController(app, provider))``
+— see serve/README.md's "closed loop" section.
+"""
+
+from mmlspark_tpu.loop.controller import LoopConfig, RetrainController
+from mmlspark_tpu.loop.promote import Decision, PromotionGate
+from mmlspark_tpu.loop.refit import RefitError, refit_candidate, warm_refit
+from mmlspark_tpu.loop.shadow import SHADOW_SUFFIX, ShadowDeploy, shadow_route
+
+__all__ = [
+    "LoopConfig",
+    "RetrainController",
+    "Decision",
+    "PromotionGate",
+    "RefitError",
+    "refit_candidate",
+    "warm_refit",
+    "SHADOW_SUFFIX",
+    "ShadowDeploy",
+    "shadow_route",
+]

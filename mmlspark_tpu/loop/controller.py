@@ -1,0 +1,445 @@
+"""loop.controller — the drift → deploy retrain controller daemon.
+
+One background worker per :class:`ServingApp` closes the loop the rest
+of the repo left open: quality drift alarms (``serve/monitor.py``) and
+explicit ``POST /admin/retrain`` triggers enqueue RETRAIN JOBS; the
+worker drains them through warm refit (``loop/refit.py``), shadow
+deploy (``loop/shadow.py``), and the promotion gate
+(``loop/promote.py``), flipping the registry only when the challenger
+wins and auto-rolling back on post-promotion SLO burn.
+
+Admission discipline mirrors ``serve/admission.py``: the job queue is
+BOUNDED and every enqueue gets an explicit verdict —
+
+- ``accept``     — queued (``loop.jobs{verdict=accept}``);
+- ``duplicate``  — the route is already queued or mid-retrain;
+- ``cooldown``   — inside the per-route debounce window
+  (``MMLSPARK_TPU_LOOP_COOLDOWN_S``); alarm storms collapse to one job;
+- ``shed``       — queue full and this job's priority (drift severity =
+  excess PSI) does not beat the lowest queued one; when it does, the
+  LOWEST-priority job is shed instead (``verdict=shed_queued``).
+
+Lifecycle: the thread starts in :meth:`start` (``ServingApp.attach_loop``
+calls it) and :meth:`stop` sets the stop flag and JOINS it — the
+stop/join path the LOOP001 analyzer rule checks for.
+
+Env knobs (all ``MMLSPARK_TPU_LOOP_*``) are read once at construction —
+see :class:`LoopConfig` and serve/README.md's "closed loop" section.
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import os
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+from mmlspark_tpu import obs
+from mmlspark_tpu.obs import flight
+from mmlspark_tpu.loop import refit as refit_mod
+from mmlspark_tpu.loop.promote import Decision, PromotionGate
+
+_DRIFT_KINDS = ("feature_drift", "score_drift")
+_SLO_KINDS = ("slo_availability", "slo_latency")
+
+
+def _env(name: str, default, cast):
+    raw = os.environ.get(f"MMLSPARK_TPU_LOOP_{name}", "").strip()
+    if not raw:
+        return default
+    try:
+        return cast(raw)
+    except ValueError:
+        return default
+
+
+@dataclasses.dataclass
+class LoopConfig:
+    """Retrain-controller knobs; every field has an env override."""
+
+    #: per-route debounce: a drift alarm inside this window after the
+    #: last retrain STARTED is verdicted ``cooldown`` (manual triggers
+    #: bypass it)
+    cooldown_s: float = 300.0
+    #: bounded job-queue depth (priority-shed beyond it)
+    queue_depth: int = 8
+    #: NEW trees appended per warm refit
+    append_trees: int = 16
+    #: fraction of live batches mirrored to a shadow challenger
+    shadow_sample: float = 1.0
+    #: minimum mirrored rows before the gate may promote
+    min_shadow_rows: int = 512
+    #: give up on a shadow run that has not reached min_shadow_rows
+    shadow_timeout_s: float = 300.0
+    #: challenger drift must beat champion drift by this much
+    psi_margin: float = 0.0
+    #: challenger p50 predict latency cap, as a ratio of champion's
+    latency_ratio: float = 5.0
+    #: post-promotion window during which an SLO-burn alarm rolls back
+    probation_s: float = 300.0
+    #: streamed-ingest chunk rows for refit (0 = library default)
+    chunk_rows: int = 0
+    #: shadow-progress poll interval
+    poll_interval_s: float = 0.25
+    #: scratch root for refit workdirs (default: ``$TMPDIR/mmlspark_tpu_loop``)
+    workdir: str = ""
+
+    @classmethod
+    def from_env(cls, **overrides) -> "LoopConfig":
+        cfg = cls(
+            cooldown_s=_env("COOLDOWN_S", cls.cooldown_s, float),
+            queue_depth=_env("QUEUE_DEPTH", cls.queue_depth, int),
+            append_trees=_env("APPEND_TREES", cls.append_trees, int),
+            shadow_sample=_env("SHADOW_SAMPLE", cls.shadow_sample, float),
+            min_shadow_rows=_env("MIN_SHADOW_ROWS", cls.min_shadow_rows, int),
+            shadow_timeout_s=_env(
+                "SHADOW_TIMEOUT_S", cls.shadow_timeout_s, float
+            ),
+            psi_margin=_env("PSI_MARGIN", cls.psi_margin, float),
+            latency_ratio=_env("LATENCY_RATIO", cls.latency_ratio, float),
+            probation_s=_env("PROBATION_S", cls.probation_s, float),
+            chunk_rows=_env("CHUNK_ROWS", cls.chunk_rows, int),
+            workdir=os.environ.get("MMLSPARK_TPU_LOOP_WORKDIR", ""),
+        )
+        return dataclasses.replace(cfg, **overrides)
+
+
+@dataclasses.dataclass
+class RetrainJob:
+    name: str
+    reason: str
+    severity: float
+    manual: bool
+    seq: int
+    enqueued_at: float
+
+    def describe(self) -> dict:
+        return {
+            "model": self.name,
+            "reason": self.reason,
+            "severity": self.severity,
+            "manual": self.manual,
+            "queued_for_s": round(time.monotonic() - self.enqueued_at, 3),
+        }
+
+
+class RetrainController:
+    """The retrain daemon for one :class:`ServingApp`.
+
+    ``data_provider(name)`` returns the fresh-shard source (anything
+    ``stream_ingest`` accepts, e.g. ``NpySource``/``RowGroupSource``
+    over the route's recent traffic window) a retrain of ``name`` should
+    append trees from — the sliding-window policy lives with the caller,
+    which owns the data plumbing this library cannot guess.
+    """
+
+    def __init__(
+        self,
+        app,
+        data_provider: Callable[[str], object],
+        config: Optional[LoopConfig] = None,
+        refit_params: Optional[dict] = None,
+    ):
+        self.app = app
+        self.cfg = config or LoopConfig.from_env()
+        self._data_provider = data_provider
+        self._refit_params = dict(refit_params or {})
+        self._gate = PromotionGate(
+            min_mirrored=self.cfg.min_shadow_rows,
+            psi_margin=self.cfg.psi_margin,
+            latency_ratio=self.cfg.latency_ratio,
+        )
+        self._cv = threading.Condition()
+        self._jobs: List[RetrainJob] = []
+        self._queued: set = set()
+        self._active: Optional[RetrainJob] = None
+        self._seq = 0
+        self._job_counter = 0
+        self._last_retrain: Dict[str, float] = {}
+        self._probation: Dict[str, dict] = {}
+        self._decisions: collections.deque = collections.deque(maxlen=32)
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._workroot = self.cfg.workdir or os.path.join(
+            os.environ.get("TMPDIR", "/tmp"), "mmlspark_tpu_loop"
+        )
+
+    # -- lifecycle --------------------------------------------------------
+    def start(self) -> "RetrainController":
+        if self._thread is not None:
+            return self
+        self._thread = threading.Thread(
+            target=self._run, daemon=True, name="retrain-controller"
+        )
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        with self._cv:
+            self._cv.notify_all()
+        if self._thread is not None:
+            self._thread.join(timeout=10.0)
+
+    # -- triggers ---------------------------------------------------------
+    def on_alarm(self, name: str, version: int, kind: str,
+                 detail: dict) -> None:
+        """The monitor's alarm-transition listener (wired by
+        ``ServingApp.attach_loop``)."""
+        if kind in _SLO_KINDS:
+            self._maybe_rollback(name, kind, detail)
+            return
+        if kind not in _DRIFT_KINDS:
+            return
+        severity = max(
+            float(detail.get("feature_psi_max") or 0.0),
+            float(detail.get("score_psi") or 0.0),
+        )
+        self.request(name, reason=kind, severity=severity)
+
+    def request(self, name: str, reason: str = "manual",
+                severity: float = 0.0, manual: bool = False) -> str:
+        """Enqueue a retrain for ``name``; returns the admission verdict
+        (``accept`` / ``duplicate`` / ``cooldown`` / ``shed``)."""
+        now = time.monotonic()
+        shed_job: Optional[RetrainJob] = None
+        with self._cv:
+            if name in self._queued or (
+                self._active is not None and self._active.name == name
+            ):
+                verdict = "duplicate"
+            elif (
+                not manual
+                and now - self._last_retrain.get(name, float("-inf"))
+                < self.cfg.cooldown_s
+            ):
+                verdict = "cooldown"
+            else:
+                job = RetrainJob(
+                    name=name, reason=reason, severity=float(severity),
+                    manual=manual, seq=self._seq, enqueued_at=now,
+                )
+                self._seq += 1
+                if len(self._jobs) >= self.cfg.queue_depth:
+                    worst = min(
+                        self._jobs, key=lambda j: (j.manual, j.severity)
+                    )
+                    if (job.manual, job.severity) > (worst.manual,
+                                                     worst.severity):
+                        self._jobs.remove(worst)
+                        self._queued.discard(worst.name)
+                        shed_job = worst
+                        self._jobs.append(job)
+                        self._queued.add(name)
+                        verdict = "accept"
+                    else:
+                        verdict = "shed"
+                else:
+                    self._jobs.append(job)
+                    self._queued.add(name)
+                    verdict = "accept"
+                if verdict == "accept":
+                    self._cv.notify()
+            depth = len(self._jobs)
+        obs.inc("loop.jobs", model=name, verdict=verdict)
+        if shed_job is not None:
+            obs.inc("loop.jobs", model=shed_job.name, verdict="shed_queued")
+        obs.gauge("loop.queue_depth", depth)
+        return verdict
+
+    # -- the worker -------------------------------------------------------
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            with self._cv:
+                while not self._jobs and not self._stop.is_set():
+                    self._cv.wait(timeout=0.5)
+                if self._stop.is_set():
+                    return
+                # highest priority first: manual beats alarm-driven,
+                # then drift severity (excess PSI), then FIFO
+                job = max(
+                    self._jobs,
+                    key=lambda j: (j.manual, j.severity, -j.seq),
+                )
+                self._jobs.remove(job)
+                self._queued.discard(job.name)
+                self._active = job
+                self._job_counter += 1
+                job_id = self._job_counter
+            obs.gauge("loop.queue_depth", len(self._jobs))
+            try:
+                self._process(job, job_id)
+            except Exception:
+                obs.inc("loop.retrain_failures", model=job.name)
+                obs.get_logger("mmlspark_tpu.serve").exception(
+                    "retrain job for %s died", job.name
+                )
+            finally:
+                with self._cv:
+                    self._active = None
+
+    def _process(self, job: RetrainJob, job_id: int) -> None:
+        name = job.name
+        with self._cv:
+            self._last_retrain[name] = time.monotonic()
+        obs.inc("loop.retrains", model=name, reason=job.reason)
+        flight.record("loop", "retrain_start",
+                      {"model": name, **job.describe()})
+        workdir = os.path.join(self._workroot, name, f"job-{job_id}")
+        mv = self.app.registry.get(name)
+        if mv is None:
+            self._finish(job, Decision(False, "unknown_route", {}))
+            return
+        try:
+            with obs.span("loop.retrain", model=name, reason=job.reason):
+                source = self._data_provider(name)
+                candidate = refit_mod.refit_candidate(
+                    mv.model, mv.path, source,
+                    workdir=workdir,
+                    append_trees=self.cfg.append_trees,
+                    params=self._refit_params,
+                    chunk_rows=self.cfg.chunk_rows or None,
+                )
+        except Exception as e:
+            obs.inc("loop.retrain_failures", model=name)
+            flight.record("loop", "retrain_failed",
+                          {"model": name, "error": repr(e)})
+            self._finish(job, Decision(False, "refit_failed",
+                                       {"error": repr(e)}))
+            return
+        self._shadow_and_decide(job, candidate)
+
+    def _shadow_and_decide(self, job: RetrainJob, candidate: str) -> None:
+        name = job.name
+        try:
+            shadow = self.app.start_shadow(
+                name, path=candidate, sample_rate=self.cfg.shadow_sample
+            )
+        except Exception as e:
+            obs.inc("loop.promotions_rejected", model=name,
+                    reason="challenger_load_failed")
+            flight.record("loop", "promotion_rejected",
+                          {"model": name, "reason": "challenger_load_failed",
+                           "error": repr(e)})
+            self._finish(job, Decision(False, "challenger_load_failed",
+                                       {"error": repr(e)}))
+            return
+        deadline = time.monotonic() + self.cfg.shadow_timeout_s
+        try:
+            while not self._stop.is_set() and time.monotonic() < deadline:
+                st = shadow.stats()
+                if (st["mirrored_rows"] >= self.cfg.min_shadow_rows
+                        or st["errors"] or not st["baseline_ok"]):
+                    break
+                time.sleep(self.cfg.poll_interval_s)
+            champion = (
+                self.app.monitor.route_metrics(name)
+                if self.app.monitor is not None else None
+            )
+            decision = self._gate.decide(champion, shadow.stats())
+        finally:
+            self.app.stop_shadow(name)
+        if not decision.promote:
+            obs.inc("loop.promotions_rejected", model=name,
+                    reason=decision.reason)
+            flight.record("loop", "promotion_rejected",
+                          {"model": name, **decision.to_dict()})
+            self._finish(job, decision)
+            return
+        old = self.app.registry.get(name)
+        new_mv = self.app.swap_model(name, path=candidate, block=True)
+        obs.inc("loop.promotions", model=name)
+        flight.record("loop", "promoted", {
+            "model": name,
+            "from_version": old.version if old else None,
+            "to_version": new_mv.version,
+            **decision.to_dict(),
+        })
+        with self._cv:
+            self._probation[name] = {
+                "deadline": time.monotonic() + self.cfg.probation_s,
+                "from_version": old.version if old else None,
+                "to_version": new_mv.version,
+                "candidate": candidate,
+            }
+        obs.gauge("loop.probation_active", len(self._probation))
+        self._finish(job, decision)
+
+    def _finish(self, job: RetrainJob, decision: Decision) -> None:
+        self._decisions.append({
+            "model": job.name,
+            "reason": job.reason,
+            "manual": job.manual,
+            "decision": decision.to_dict(),
+            "at": time.time(),
+        })
+
+    # -- probation / rollback ---------------------------------------------
+    def _maybe_rollback(self, name: str, kind: str, detail: dict) -> None:
+        with self._cv:
+            p = self._probation.get(name)
+            if p is None:
+                return
+            if time.monotonic() > p["deadline"]:
+                # probation served clean; the promotion stands
+                self._probation.pop(name, None)
+                return
+            self._probation.pop(name, None)
+        try:
+            mv = self.app.rollback(name)
+        except Exception:
+            obs.get_logger("mmlspark_tpu.serve").exception(
+                "auto-rollback of %s failed", name
+            )
+            return
+        obs.inc("loop.rollbacks", model=name, reason=kind)
+        obs.gauge("loop.probation_active", len(self._probation))
+        flight.record("loop", "rollback", {
+            "model": name, "reason": kind,
+            "restored_version": mv.version, **detail,
+        })
+        flight.auto_dump(f"loop_rollback:{name}")
+        self._decisions.append({
+            "model": name,
+            "reason": kind,
+            "manual": False,
+            "decision": {"promote": False, "reason": "slo_rollback",
+                         "detail": {"restored_version": mv.version}},
+            "at": time.time(),
+        })
+
+    # -- inspection (GET /loopz) ------------------------------------------
+    def status(self) -> dict:
+        now = time.monotonic()
+        with self._cv:
+            queue = [j.describe() for j in sorted(
+                self._jobs, key=lambda j: (-j.manual, -j.severity, j.seq)
+            )]
+            active = self._active.describe() if self._active else None
+            probation = {
+                n: {
+                    "remaining_s": round(max(0.0, p["deadline"] - now), 3),
+                    "from_version": p["from_version"],
+                    "to_version": p["to_version"],
+                }
+                for n, p in self._probation.items()
+            }
+            decisions = list(self._decisions)
+            cooldowns = {
+                n: round(max(
+                    0.0, self.cfg.cooldown_s - (now - t)
+                ), 3)
+                for n, t in self._last_retrain.items()
+                if now - t < self.cfg.cooldown_s
+            }
+        return {
+            "config": dataclasses.asdict(self.cfg),
+            "queue": queue,
+            "active": active,
+            "probation": probation,
+            "cooldowns": cooldowns,
+            "decisions": decisions,
+            "shadows": self.app.shadow_stats(),
+        }

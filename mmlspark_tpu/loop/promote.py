@@ -1,0 +1,117 @@
+"""loop.promote — the promotion gate and probation bookkeeping.
+
+:class:`PromotionGate` is a pure decision function over two metric
+dicts: the champion's live monitor summary
+(:meth:`ModelQualityMonitor.route_metrics`) and the challenger's shadow
+summary (:meth:`ShadowDeploy.stats`).  It promotes only when ALL hold:
+
+1. the challenger's training baseline parsed (a corrupt or absent
+   ``quality_baseline.json`` is a POISONED candidate — whatever its
+   scores look like, there is no reference to judge post-promotion
+   traffic against, so it never ships);
+2. the challenger replayed zero-error over ≥N mirrored rows;
+3. the challenger's live drift (max of feature/score excess PSI against
+   its OWN baseline, measured on mirrored production traffic) is healthy
+   in absolute terms (below the ``MMLSPARK_TPU_QUALITY_PSI_ALERT``
+   threshold) AND beats the champion's by the configured margin;
+4. the challenger's shadow predict latency stays within
+   ``latency_ratio`` of the champion's live predict latency.
+
+The actual flip is the caller's (controller's) job — the gate never
+touches the registry, which keeps every decision unit-testable.  After
+a flip the controller opens a PROBATION window: an SLO-burn alarm on the
+route inside the window auto-rolls back to the pinned previous version
+(see ``serve/registry.py`` — the rollback target is kept loaded, so the
+recovery is a pointer flip, not a cold load).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+from mmlspark_tpu.obs import quality
+
+
+@dataclasses.dataclass
+class Decision:
+    promote: bool
+    reason: str
+    detail: dict
+
+    def to_dict(self) -> dict:
+        return {
+            "promote": self.promote,
+            "reason": self.reason,
+            "detail": dict(self.detail),
+        }
+
+
+def _drift_of(metrics: Optional[dict]) -> Optional[float]:
+    """Max excess PSI across the feature and score trackers, or None
+    when the metrics carry no drift signal at all."""
+    if not metrics:
+        return None
+    vals = [
+        metrics.get("feature_excess_psi_max"),
+        metrics.get("score_excess_psi"),
+    ]
+    vals = [float(v) for v in vals if v is not None]
+    return max(vals) if vals else None
+
+
+class PromotionGate:
+    def __init__(
+        self,
+        min_mirrored: int = 512,
+        psi_margin: float = 0.0,
+        latency_ratio: float = 5.0,
+        psi_alert: Optional[float] = None,
+    ):
+        self.min_mirrored = int(min_mirrored)
+        self.psi_margin = float(psi_margin)
+        self.latency_ratio = float(latency_ratio)
+        self.psi_alert = (
+            float(psi_alert) if psi_alert is not None
+            else float(quality.quality_env_config()["psi_alert"])
+        )
+
+    def decide(self, champion: Optional[dict], challenger: dict) -> Decision:
+        """champion = live monitor metrics (may be None when the route
+        runs reference-less); challenger = shadow stats."""
+        chal_drift = _drift_of(challenger)
+        champ_drift = _drift_of(champion)
+        detail = {
+            "mirrored_rows": challenger.get("mirrored_rows", 0),
+            "challenger_drift": chal_drift,
+            "champion_drift": champ_drift,
+            "psi_alert": self.psi_alert,
+            "auc_proxy_agreement": challenger.get("auc_proxy_agreement"),
+        }
+        if not challenger.get("baseline_ok"):
+            return Decision(False, "poisoned_baseline", detail)
+        if challenger.get("errors", 0) > 0:
+            detail["errors"] = challenger["errors"]
+            return Decision(False, "challenger_errors", detail)
+        if challenger.get("mirrored_rows", 0) < self.min_mirrored:
+            detail["min_mirrored"] = self.min_mirrored
+            return Decision(False, "insufficient_mirrored", detail)
+        if chal_drift is None:
+            # baseline parsed but produced no usable tracker signal
+            return Decision(False, "poisoned_baseline", detail)
+        if chal_drift > self.psi_alert:
+            # a candidate must be healthy in absolute terms, not merely
+            # less wrong than a drifting champion
+            return Decision(False, "challenger_drifting", detail)
+        if champ_drift is not None and chal_drift > champ_drift - self.psi_margin:
+            return Decision(False, "champion_no_worse", detail)
+        chal_lat = challenger.get("latency_p50_s")
+        champ_lat = challenger.get("champion_latency_p50_s")
+        if (
+            chal_lat is not None and champ_lat is not None and champ_lat > 0
+            and chal_lat > self.latency_ratio * champ_lat
+        ):
+            detail["latency_p50_s"] = chal_lat
+            detail["champion_latency_p50_s"] = champ_lat
+            return Decision(False, "challenger_slow", detail)
+        return Decision(True, "challenger_beats_champion", detail)

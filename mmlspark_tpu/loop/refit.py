@@ -1,0 +1,141 @@
+"""loop.refit — warm-started incremental refit for the closed loop.
+
+The retrain controller hands this module the SERVING champion and a
+source of fresh shards; it hands back a candidate model directory the
+shadow/promotion stages can load.  The refit is a continuation, not a
+retrain from scratch:
+
+1. the champion booster round-trips through the elastic checkpoint path
+   (:mod:`mmlspark_tpu.parallel.elastic`): an atomic pickle with a
+   sha256 sidecar, re-read and digest-verified before any training reads
+   it.  A corrupt snapshot quarantines and aborts the job instead of
+   warm-starting from damaged trees;
+2. fresh shards are device-ingested through the champion's OWN
+   :class:`~mmlspark_tpu.ops.binning.BinningAuthority`
+   (``train_streaming(init_model=...)`` skips the sketch fit) —
+   continuation replays the old trees, which pins their thresholds;
+3. ``num_iterations`` counts NEW trees: the grower appends them on the
+   sliding window of fresh rows, with the per-iteration RNG continuing
+   at the absolute fold_in schedule (tree ``T+k`` draws the same key it
+   would have drawn in one long run);
+4. the candidate directory is the champion's saved facade re-saved with
+   the refit booster, so ``quality_baseline.json`` — captured by
+   ``train()`` from the fresh shards' streamed occupancy — rides as the
+   sidecar the registry's baseline extraction expects.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+from mmlspark_tpu import obs
+from mmlspark_tpu.core.pipeline import PipelineStage
+from mmlspark_tpu.parallel.elastic import load_checkpoint, write_checkpoint
+from mmlspark_tpu.serve.monitor import find_booster
+
+
+class RefitError(RuntimeError):
+    """A refit job cannot produce a candidate (bad champion state, a
+    checkpoint that failed digest verification, sources without labels).
+    The controller counts it and leaves the champion serving."""
+
+
+def _set_booster(model, booster) -> None:
+    """Install ``booster`` on the facade stage that carries one (the
+    model itself, or the last booster-bearing stage of a pipeline)."""
+    if hasattr(model, "setBooster"):
+        model.setBooster(booster)
+        return
+    stages = None
+    if hasattr(model, "getStages"):
+        try:
+            stages = model.getStages()
+        except Exception:
+            stages = None
+    for stage in reversed(list(stages or [])):
+        if hasattr(stage, "setBooster"):
+            stage.setBooster(booster)
+            return
+    raise RefitError(
+        f"champion model {type(model).__name__} carries no setBooster "
+        "stage; warm refit needs a LightGBM facade to re-save"
+    )
+
+
+def warm_refit(
+    booster,
+    source,
+    *,
+    workdir: str,
+    append_trees: int,
+    params: Optional[dict] = None,
+    chunk_rows: Optional[int] = None,
+):
+    """Append ``append_trees`` new trees to ``booster`` trained on the
+    fresh ``source`` shards, returning the refit :class:`Booster`.
+
+    The champion state rides the elastic checkpoint path first (write →
+    digest-verified read), so the continuation starts from bytes that
+    are provably what training will replay — and the snapshot stays in
+    ``workdir`` for post-hoc inspection of what a promotion was built
+    from.
+    """
+    if append_trees <= 0:
+        raise RefitError(f"append_trees must be positive, got {append_trees}")
+    os.makedirs(workdir, exist_ok=True)
+    ckpt = os.path.join(workdir, "warmstart.ckpt")
+    with obs.span("loop.refit_checkpoint"):
+        write_checkpoint(ckpt, booster)
+        init = load_checkpoint(ckpt)
+    if init is None:
+        raise RefitError(
+            "warm-start snapshot failed digest verification "
+            f"(quarantined next to {ckpt}); refusing to continue from "
+            "unverified trees"
+        )
+    return init.append_trees(
+        source, int(append_trees), params=params, chunk_rows=chunk_rows
+    )
+
+
+def refit_candidate(
+    champion_model,
+    champion_path: Optional[str],
+    source,
+    *,
+    workdir: str,
+    append_trees: int,
+    params: Optional[dict] = None,
+    chunk_rows: Optional[int] = None,
+) -> str:
+    """Full refit job: warm-refit the champion's booster and emit a
+    candidate model directory (with its ``quality_baseline.json``
+    sidecar) ready for shadow deploy.  Returns the candidate path."""
+    booster = find_booster(champion_model)
+    if booster is None:
+        raise RefitError(
+            f"champion {type(champion_model).__name__} carries no booster "
+            "to warm-start from"
+        )
+    if not champion_path:
+        raise RefitError(
+            "champion route has no saved model directory (registered from "
+            "an in-memory model); warm refit re-saves the champion facade, "
+            "so the route must be loaded from a path"
+        )
+    with obs.span("loop.refit", trees=append_trees):
+        refit_booster = warm_refit(
+            booster, source, workdir=workdir, append_trees=append_trees,
+            params=params, chunk_rows=chunk_rows,
+        )
+        # Re-save the champion's own facade with the refit booster: the
+        # candidate inherits the serving params (feature column wiring,
+        # class labels) and _save_extra writes the NEW quality baseline
+        # captured from the fresh shards.
+        facade = PipelineStage.load(champion_path)
+        _set_booster(facade, refit_booster)
+        candidate = os.path.join(workdir, "candidate")
+        facade.save(candidate)
+    obs.inc("loop.candidates_built")
+    return candidate

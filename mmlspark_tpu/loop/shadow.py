@@ -1,0 +1,256 @@
+"""loop.shadow — shadow deploy behind the serve spine.
+
+A challenger candidate loads into the :class:`ModelRegistry` UN-ROUTED:
+its registry name carries an ``@shadow`` suffix, which the predict URL
+grammar (``[A-Za-z0-9_.-]+``) cannot express, so no client request can
+ever reach it — but it still gets the full registry treatment (versioned
+load, baseline extraction, lease refcounts) that the promotion flip and
+teardown reuse.
+
+Mirrored traffic is SAMPLED COPIES of live requests: ``ServingApp._process``
+taps each served batch after the replies have gone out and offers
+``(rows, champion_preds, champion_wall)`` to the shadow's bounded queue
+— one ``put_nowait``, drop-and-count on overflow — so a slow challenger
+can never add latency to, or exert backpressure on, the live path.  The
+shadow's own daemon thread replays the rows through the challenger,
+discards the responses, and accumulates bounded monitors:
+
+- feature/score drift trackers against the CHALLENGER's own training
+  baseline (the candidate's ``quality_baseline.json``) — the promotion
+  gate compares these against the champion's live monitor numbers;
+- per-batch predict latency (bounded reservoir, p50/p95);
+- an AUC-proxy: mirrored traffic carries no labels, so the shadow
+  reports pairwise rank agreement between champion and challenger
+  scores (1.0 = identical ranking).  Report-only — a challenger that
+  RE-RANKS is exactly what a drift-correcting refit should do, so the
+  gate keys on drift/latency, not on agreement.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from typing import Optional
+
+import numpy as np
+
+from mmlspark_tpu import obs
+from mmlspark_tpu.obs import quality
+
+#: registry-name suffix for un-routed challengers (unreachable via the
+#: predict URL grammar by construction)
+SHADOW_SUFFIX = "@shadow"
+
+#: cap on rows per batch entering the pairwise rank-agreement proxy
+_AUC_PROXY_CAP = 128
+
+
+def shadow_route(name: str) -> str:
+    return name + SHADOW_SUFFIX
+
+
+def _rank_agreement(champ: np.ndarray, chal: np.ndarray) -> Optional[float]:
+    """Pairwise ordering agreement between two score vectors (the
+    label-free AUC proxy): P[champion and challenger order a random row
+    pair the same way], over pairs the champion actually orders."""
+    c = np.asarray(champ, np.float64).reshape(-1)[:_AUC_PROXY_CAP]
+    s = np.asarray(chal, np.float64).reshape(-1)[: c.size]
+    if c.size < 2:
+        return None
+    dc = np.sign(np.subtract.outer(c, c))
+    ds = np.sign(np.subtract.outer(s, s))
+    iu = np.triu_indices(c.size, k=1)
+    ordered = dc[iu] != 0
+    if not np.any(ordered):
+        return None
+    return float(np.mean(dc[iu][ordered] == ds[iu][ordered]))
+
+
+class ShadowDeploy:
+    """One challenger under shadow traffic for one route."""
+
+    def __init__(
+        self,
+        name: str,
+        registry,
+        path: Optional[str] = None,
+        model=None,
+        batcher=None,
+        sample_rate: float = 1.0,
+        queue_depth: int = 64,
+        latency_cap: int = 512,
+        seed: int = 0,
+        prewarm: bool = True,
+    ):
+        from mmlspark_tpu.serve.app import default_predictor
+
+        self.name = name
+        self.route = shadow_route(name)
+        self.sample_rate = float(sample_rate)
+        self._registry = registry
+        self.mv = registry.register(self.route, model=model, path=path)
+        self._predict, self.feature_dim = default_predictor(self.mv.model)
+        self._batcher = batcher
+        cfg = quality.quality_env_config()
+        self.baseline_ok = False
+        self._feature = None
+        self._score = None
+        try:
+            qb = self.mv.quality_baseline
+            baseline = quality.QualityBaseline.from_dict(qb) if qb else None
+            if baseline is not None and (baseline.features or baseline.score):
+                hl = cfg["half_life_rows"]
+                if baseline.features:
+                    self._feature = quality.FeatureDriftTracker(
+                        baseline, half_life_rows=hl
+                    )
+                if baseline.score:
+                    self._score = quality.ScoreDriftTracker(
+                        baseline, half_life_rows=hl
+                    )
+                self.baseline_ok = True
+        except Exception:
+            # a challenger whose baseline sidecar does not parse is
+            # POISONED for promotion purposes: it can still absorb
+            # mirrored traffic, but the gate will refuse it
+            self.baseline_ok = False
+        self._lock = threading.Lock()
+        self._pending: "queue.Queue[tuple]" = queue.Queue(maxsize=queue_depth)
+        self._rng = np.random.default_rng(seed)
+        self._latencies: list = []
+        self._champ_latencies: list = []
+        self._latency_cap = int(latency_cap)
+        self._agreement_sum = 0.0
+        self._agreement_n = 0
+        self._mirrored_rows = 0
+        self._mirrored_batches = 0
+        self._dropped = 0
+        self._errors = 0
+        if prewarm and batcher is not None and self.feature_dim is not None:
+            with obs.span("loop.shadow_prewarm", model=name):
+                batcher.prewarm(
+                    lambda X, n: self._predict(self.mv.model, X, n),
+                    self.feature_dim,
+                )
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._run, daemon=True, name=f"shadow-{name}"
+        )
+        self._thread.start()
+        obs.inc("loop.shadows_started", model=name)
+
+    # -- the live-path tap (called from ServingApp._process) -------------
+    def mirror(self, rows: np.ndarray, preds: np.ndarray,
+               champ_wall_s: float) -> None:
+        """Offer one served batch to the shadow.  Never raises, never
+        blocks: sampling + one bounded put_nowait."""
+        try:
+            if self.sample_rate < 1.0 and self._rng.random() > self.sample_rate:
+                return
+            self._pending.put_nowait(
+                (np.array(rows, copy=True), np.array(preds, copy=True),
+                 float(champ_wall_s))
+            )
+        except queue.Full:
+            with self._lock:
+                self._dropped += 1
+            obs.inc("loop.shadow_dropped", model=self.name)
+        except Exception:
+            with self._lock:
+                self._errors += 1
+
+    # -- the challenger worker -------------------------------------------
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            try:
+                rows, champ_preds, champ_wall = self._pending.get(timeout=0.25)
+            except queue.Empty:
+                continue
+            try:
+                self._replay(rows, champ_preds, champ_wall)
+            except Exception:
+                with self._lock:
+                    self._errors += 1
+                obs.get_logger("mmlspark_tpu.serve").exception(
+                    "shadow replay failed for %s", self.name
+                )
+
+    def _replay(self, rows, champ_preds, champ_wall: float) -> None:
+        n = int(rows.shape[0])
+        if n == 0:
+            return
+        if self._batcher is not None:
+            padded, n = self._batcher.pad(rows)
+        else:
+            padded = rows
+        t0 = time.monotonic()
+        with obs.span("loop.shadow_predict", model=self.name, rows=n):
+            preds = np.asarray(
+                self._predict(self.mv.model, padded, n)
+            )[:n]
+        wall = time.monotonic() - t0
+        agree = _rank_agreement(champ_preds, preds)
+        with self._lock:
+            self._mirrored_rows += n
+            self._mirrored_batches += 1
+            if len(self._latencies) < self._latency_cap:
+                self._latencies.append(wall)
+                self._champ_latencies.append(champ_wall)
+            if agree is not None:
+                self._agreement_sum += agree
+                self._agreement_n += 1
+            if self._feature is not None:
+                self._feature.update(rows[:n])
+            if self._score is not None:
+                self._score.update(preds)
+        obs.inc("loop.shadow_requests", model=self.name)
+
+    # -- inspection -------------------------------------------------------
+    def stats(self) -> dict:
+        """Bounded-monitor snapshot the promotion gate consumes."""
+        with self._lock:
+            lat = np.asarray(self._latencies, np.float64)
+            champ_lat = np.asarray(self._champ_latencies, np.float64)
+            out = {
+                "route": self.route,
+                "version": self.mv.version,
+                "baseline_ok": self.baseline_ok,
+                "mirrored_rows": self._mirrored_rows,
+                "mirrored_batches": self._mirrored_batches,
+                "dropped_batches": self._dropped,
+                "errors": self._errors,
+                "auc_proxy_agreement": (
+                    self._agreement_sum / self._agreement_n
+                    if self._agreement_n else None
+                ),
+                "latency_p50_s": (
+                    float(np.percentile(lat, 50)) if lat.size else None
+                ),
+                "latency_p95_s": (
+                    float(np.percentile(lat, 95)) if lat.size else None
+                ),
+                "champion_latency_p50_s": (
+                    float(np.percentile(champ_lat, 50))
+                    if champ_lat.size else None
+                ),
+            }
+            if self._feature is not None:
+                ex = self._feature.excess_psis()
+                out["feature_excess_psi_max"] = (
+                    float(ex.max()) if self._feature.num_features else 0.0
+                )
+                out["feature_live_rows"] = float(self._feature.live_rows())
+            if self._score is not None:
+                out["score_excess_psi"] = float(self._score.excess_psi())
+                out["score_live_rows"] = float(self._score.live_rows())
+            return out
+
+    def stop(self, unregister: bool = True) -> None:
+        """Stop the worker and (by default) drop the challenger's registry
+        entry, draining any outstanding leases."""
+        self._stop.set()
+        self._thread.join(timeout=5.0)
+        if unregister:
+            self._registry.unregister(self.route)
+        obs.inc("loop.shadows_stopped", model=self.name)

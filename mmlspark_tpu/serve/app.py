@@ -7,6 +7,12 @@ Turns saved pipelines into a production-shaped HTTP service on top of the
 - ``GET  /readyz``                — 200 once models are loaded AND every
   bucket shape is pre-warmed (503 while starting or draining);
 - ``GET  /metrics``               — the full obs snapshot as JSON;
+- ``GET  /driftz``                — per-route model-quality detail;
+- ``GET  /loopz``                 — closed-loop (retrain controller)
+  status: job queue, probation windows, shadow stats;
+- ``POST /admin/swap``            — synchronous hot-swap trigger;
+- ``POST /admin/retrain``         — enqueue a retrain job (202 +
+  admission verdict; progress on ``/loopz``);
 - ``POST /models/<name>/predict`` — admission → dynamic batcher →
   bucket-padded jitted predict → correlated reply.
 
@@ -177,6 +183,11 @@ class ServingApp:
         self._prewarm = prewarm
         self._routes: Dict[str, _Route] = {}
         self._groups: Dict[str, _Group] = {}
+        # shadow challengers (loop/shadow.py) + the retrain controller
+        # (loop/controller.py); both optional — attach_loop wires them
+        self._shadows: Dict[str, object] = {}
+        self._shadow_lock = threading.Lock()
+        self._loop = None
         self._stop = threading.Event()
         self._started = False
         self._jit_counters_at_ready: Dict[str, float] = {}
@@ -383,6 +394,70 @@ class ServingApp:
             self.monitor.register_route(name, mv.version, mv.quality_baseline)
         return mv
 
+    # -- the closed loop (mmlspark_tpu/loop) ------------------------------
+    def attach_loop(self, controller) -> None:
+        """Wire a :class:`~mmlspark_tpu.loop.controller.RetrainController`
+        into the app: drift-alarm transitions feed it, ``POST
+        /admin/retrain`` triggers it, ``GET /loopz`` reads it, and
+        :meth:`stop` tears it down with the rest of the spine."""
+        self._loop = controller
+        if self.monitor is not None:
+            self.monitor.add_alarm_listener(controller.on_alarm)
+        controller.start()
+
+    @property
+    def loop(self):
+        return self._loop
+
+    def start_shadow(self, name: str, path: Optional[str] = None,
+                     model=None, sample_rate: float = 1.0):
+        """Load a challenger for ``name`` into the registry UN-ROUTED and
+        start mirroring sampled copies of the route's live batches to it.
+        One shadow per route; returns the :class:`ShadowDeploy`."""
+        from mmlspark_tpu.loop.shadow import ShadowDeploy
+
+        route = self._routes.get(name)
+        if route is None:
+            raise KeyError(f"unknown route {name!r}")
+        # reserve the slot, then build OUTSIDE the lock: construction
+        # loads + prewarms the challenger (slow) and takes the registry
+        # lock — neither belongs inside _shadow_lock
+        with self._shadow_lock:
+            if name in self._shadows:
+                raise ValueError(f"route {name!r} already has a shadow")
+            self._shadows[name] = None  # placeholder; mirror tap skips it
+        try:
+            shadow = ShadowDeploy(
+                name, self.registry, path=path, model=model,
+                batcher=DynamicBatcher(**self._batcher_cfg),
+                sample_rate=sample_rate, prewarm=self._prewarm,
+            )
+        except BaseException:
+            with self._shadow_lock:
+                self._shadows.pop(name, None)
+            raise
+        with self._shadow_lock:
+            if name in self._shadows:
+                self._shadows[name] = shadow
+                return shadow
+        # stop_shadow() raced the construction and dropped the slot
+        shadow.stop()
+        raise ValueError(f"shadow for {name!r} was stopped during start")
+
+    def stop_shadow(self, name: str) -> None:
+        """Stop mirroring to ``name``'s shadow and drop the challenger
+        from the registry.  Idempotent."""
+        with self._shadow_lock:
+            shadow = self._shadows.pop(name, None)
+        if shadow is not None:
+            shadow.stop()
+
+    def shadow_stats(self) -> Dict[str, dict]:
+        with self._shadow_lock:
+            shadows = dict(self._shadows)
+        return {name: sh.stats() for name, sh in shadows.items()
+                if sh is not None}
+
     # -- lifecycle -------------------------------------------------------
     def start(self) -> "ServingApp":
         """Enable obs + the persistent compile cache, pre-warm every
@@ -411,6 +486,10 @@ class ServingApp:
         """Graceful shutdown: stop accepting, flush in-flight, stop the
         workers and the transport.  True when the drain was clean."""
         drained = self.admission.begin_drain(timeout_s=drain_s)
+        if self._loop is not None:
+            self._loop.stop()
+        for name in list(self._shadows):
+            self.stop_shadow(name)
         self._stop.set()
         for route in self._routes.values():
             if route.thread is not None:
@@ -465,11 +544,15 @@ class ServingApp:
                 return self._metrics_response(req)
             if path == "/driftz":
                 return self._driftz_response()
+            if path == "/loopz":
+                return self._loopz_response()
             return _json_response(404, {"error": f"no such path: {path}"})
         if req.method != "POST":
             return _json_response(405, {"error": f"method {req.method}"})
         if path == "/admin/swap":
             return self._admin_swap(req)
+        if path == "/admin/retrain":
+            return self._admin_retrain(req)
         m = _PREDICT_RE.match(path)
         if not m:
             return _json_response(404, {"error": f"no such path: {path}"})
@@ -530,6 +613,42 @@ class ServingApp:
             return _json_response(
                 200, {"status": "degraded", "error": repr(e), "routes": {}}
             )
+
+    def _loopz_response(self) -> HTTPResponseData:
+        """Closed-loop detail: controller queue, active job, probation
+        windows, recent promotion decisions, live shadow stats.  Like
+        ``/driftz``, never 500s — a dashboard poll must not read as an
+        outage."""
+        if self._loop is None:
+            return _json_response(200, {"status": "detached"})
+        try:
+            body = self._loop.status()
+            body["status"] = "ok"
+            return _json_response(200, body)
+        except Exception as e:  # pragma: no cover - defensive
+            return _json_response(200, {"status": "degraded",
+                                        "error": repr(e)})
+
+    def _admin_retrain(self, req: HTTPRequestData) -> HTTPResponseData:
+        """``POST /admin/retrain {"model": name}`` — the explicit retrain
+        trigger.  Asynchronous by design (a refit takes seconds to
+        minutes): the 202 carries the controller's admission verdict, and
+        progress is observable on ``/loopz``."""
+        if self._loop is None:
+            return _json_response(
+                503, {"error": "no retrain controller attached"}
+            )
+        try:
+            payload = json.loads((req.entity or b"").decode() or "{}")
+        except (ValueError, UnicodeDecodeError) as e:
+            return _json_response(400, {"error": f"bad JSON: {e}"})
+        name = payload.get("model")
+        if not name:
+            return _json_response(400, {"error": 'body needs "model"'})
+        if name not in self._routes:
+            return _json_response(404, {"error": f"no such model: {name}"})
+        verdict = self._loop.request(name, reason="manual", manual=True)
+        return _json_response(202, {"model": name, "verdict": verdict})
 
     def _admin_swap(self, req: HTTPRequestData) -> HTTPResponseData:
         """``POST /admin/swap {"model": name, "path": dir}`` — the fleet
@@ -631,6 +750,7 @@ class ServingApp:
         padded, n = route.batcher.pad(X)
         bucket = int(padded.shape[0])
         try:
+            t_pred = time.monotonic()
             with self.registry.lease(route.name) as mv:
                 with obs.bind_trace(trace_id=batch_id):
                     with obs.span(
@@ -642,6 +762,7 @@ class ServingApp:
                             route.predict(mv.model, padded, n)
                         )
                 version = mv.version
+            pred_wall = time.monotonic() - t_pred
             off = 0
             latencies = []
             for it in items:
@@ -679,6 +800,12 @@ class ServingApp:
                     route.name, version, rows=X[:n], preds=preds[:n],
                     statuses=[200] * len(items), latencies=latencies,
                 )
+            shadow = self._shadows.get(route.name)
+            if shadow is not None:
+                # mirror tap: AFTER the replies — a sampled copy into the
+                # shadow's bounded queue (drop-and-count on overflow), so
+                # a challenger can never slow or backpressure live traffic
+                shadow.mirror(X[:n], preds[:n], pred_wall)
         except Exception as e:
             obs.inc("serve.errors", model=route.name)
             obs.get_logger("mmlspark_tpu.serve").exception(

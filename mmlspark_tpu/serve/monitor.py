@@ -33,7 +33,7 @@ from __future__ import annotations
 import queue
 import threading
 import time
-from typing import Dict, Optional, Sequence
+from typing import Callable, Dict, List, Optional, Sequence
 
 import numpy as np
 
@@ -135,6 +135,10 @@ class ModelQualityMonitor:
         self._cfg = quality.quality_env_config()
         self._slo_default = slo
         self._lock = threading.Lock()
+        # alarm-transition listeners (the retrain controller's feed).
+        # Called OUTSIDE self._lock: a listener is free to call back into
+        # monitor accessors without deadlocking the evaluation thread.
+        self._listeners: List[Callable[[str, int, str, dict], None]] = []
         self._states: Dict[str, _RouteState] = {}
         self._pending: "queue.Queue[Optional[_Batch]]" = queue.Queue(
             maxsize=max_pending
@@ -231,7 +235,18 @@ class ModelQualityMonitor:
             if st.score is not None and b.preds is not None:
                 st.score.update(b.preds)
 
+    def add_alarm_listener(
+        self, fn: Callable[[str, int, str, dict], None]
+    ) -> None:
+        """Subscribe to alarm RISING edges: ``fn(name, version, kind,
+        detail)`` fires once per ``quality.drift_alarms`` transition (not
+        per evaluation tick), after the monitor lock is released.  This
+        is the drift → retrain-controller wire (see mmlspark_tpu/loop)."""
+        with self._lock:
+            self._listeners.append(fn)
+
     def _evaluate(self, now: float) -> None:
+        events: List[tuple] = []
         with self._lock:
             states = list(self._states.values())
             min_rows = self._cfg["min_rows"]
@@ -275,10 +290,22 @@ class ModelQualityMonitor:
                               model=st.name, window="slow")
                     active[f"slo_{kind}"] = slo["alerts"][kind]
                     detail[f"slo_{kind}_burn_fast"] = slo[kind]["fast"]
-                self._transition(st, active, detail)
+                events.extend(self._transition(st, active, detail))
+            listeners = list(self._listeners)
+        # listener dispatch happens OUTSIDE the lock so a controller may
+        # call monitor accessors (alarm_count, route_metrics) re-entrantly
+        for name, version, kind, detail in events:
+            for fn in listeners:
+                try:
+                    fn(name, version, kind, detail)
+                except Exception:
+                    obs.get_logger("mmlspark_tpu.serve").exception(
+                        "alarm listener failed for %s/%s", name, kind
+                    )
 
     def _transition(self, st: _RouteState, active: Dict[str, bool],
-                    detail: Dict[str, float]) -> None:
+                    detail: Dict[str, float]) -> List[tuple]:
+        fired: List[tuple] = []
         for kind, is_active in active.items():
             was = st.alarms_active.get(kind, False)
             st.alarms_active[kind] = is_active
@@ -294,8 +321,10 @@ class ModelQualityMonitor:
                     "quality alarm %s on route %s (version %d): %s",
                     kind, st.name, st.version, detail,
                 )
+                fired.append((st.name, st.version, kind, dict(detail)))
             elif was and not is_active:
                 obs.inc("quality.drift_clears", model=st.name, kind=kind)
+        return fired
 
     # -- inspection (GET /driftz, tools.obs drift --url) ------------------
     def describe(self) -> dict:
@@ -329,6 +358,25 @@ class ModelQualityMonitor:
                 "dropped_batches": self._dropped,
                 "routes": routes,
             }
+
+    def route_metrics(self, name: str) -> Optional[dict]:
+        """Cheap per-route drift summary (vs :meth:`describe`'s full
+        payload) — the promotion gate's champion-side metrics feed."""
+        with self._lock:
+            st = self._states.get(name)
+            if st is None:
+                return None
+            out: dict = {"version": st.version}
+            if st.feature is not None:
+                ex = st.feature.excess_psis()
+                out["feature_excess_psi_max"] = (
+                    float(ex.max()) if st.feature.num_features else 0.0
+                )
+                out["feature_live_rows"] = float(st.feature.live_rows())
+            if st.score is not None:
+                out["score_excess_psi"] = float(st.score.excess_psi())
+                out["score_live_rows"] = float(st.score.live_rows())
+            return out
 
     def alarm_count(self, name: Optional[str] = None) -> int:
         """Total alarm transitions (optionally for one route) — test and

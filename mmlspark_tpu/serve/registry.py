@@ -12,7 +12,14 @@ zero-downtime protocol:
 4. **drain** — wait for the old version's lease count to hit zero, then
    drop the reference so its device arrays can be released.
 
-``rollback`` re-flips to the previous version (kept after every swap).
+``rollback`` re-flips to the previous version, which stays PINNED after
+every swap: the registry keeps the drained :class:`ModelVersion` object
+itself — loaded model, device arrays, jitted programs and all — not just
+its path, so rollback is a pointer flip under the registry lock, never a
+cold load (``serve.models_loaded`` must not move on rollback; the loop
+subsystem's SLO-burn auto-rollback depends on this being instant).  A
+later swap supersedes the pin: the displaced previous version is
+unpinned and dropped, so at most one spare copy per route stays warm.
 Leases are refcounts: :meth:`ModelRegistry.lease` is the only way serving
 code touches a model, which is what makes the flip safe under concurrent
 traffic.
@@ -44,6 +51,9 @@ class ModelVersion:
         # reference flips atomically with the model on swap/rollback)
         self.quality_baseline = extract_baseline(model)
         self.loaded_at = time.time()
+        # True while the registry retains this (non-current) version warm
+        # as the route's rollback target
+        self.pinned = False
         self._lock = threading.Lock()
         self._refs = 0
         self._idle = threading.Event()
@@ -76,6 +86,7 @@ class ModelVersion:
             "path": self.path,
             "class": self.meta.get("class", type(self.model).__name__),
             "loaded_at": self.loaded_at,
+            "pinned": self.pinned,
         }
 
 
@@ -112,9 +123,20 @@ class ModelRegistry:
             old = self._routes.get(name)
             self._routes[name] = mv
             if old is not None:
-                self._previous[name] = old
+                self._set_previous_locked(name, old)
         obs.inc("serve.models_loaded", model=name)
         return mv
+
+    def _set_previous_locked(self, name: str, old: ModelVersion) -> None:
+        """Pin ``old`` as the route's warm rollback target (caller holds
+        ``self._lock``).  The displaced previous — two flips back — is
+        unpinned and dropped: one spare warm copy per route, not a
+        history."""
+        superseded = self._previous.get(name)
+        if superseded is not None and superseded is not old:
+            superseded.pinned = False
+        old.pinned = True
+        self._previous[name] = old
 
     # alias matching the "load a saved directory" reading of the API
     def load(self, name: str, path: str) -> ModelVersion:
@@ -152,7 +174,7 @@ class ModelRegistry:
                 with self._lock:
                     old = self._routes.get(name)
                     self._routes[name] = mv
-                    self._previous[name] = old
+                    self._set_previous_locked(name, old)
                 if on_flip is not None:
                     on_flip(mv)
                 obs.inc("serve.swaps", model=name)
@@ -167,14 +189,18 @@ class ModelRegistry:
         return t
 
     def rollback(self, name: str) -> ModelVersion:
-        """Flip the route back to the previous version (one step)."""
+        """Flip the route back to the pinned previous version (one step).
+        The previous version is still loaded and warm (see the module
+        docstring), so this is a pointer flip — no model load, no compile:
+        safe to run while traffic is in flight."""
         with self._lock:
             prev = self._previous.get(name)
             if prev is None:
                 raise KeyError(f"no previous version for route {name!r}")
             cur = self._routes[name]
+            prev.pinned = False
             self._routes[name] = prev
-            self._previous[name] = cur
+            self._set_previous_locked(name, cur)
         obs.inc("serve.rollbacks", model=name)
         if not cur.wait_idle(self._drain_timeout_s):
             obs.inc("serve.swap_drain_timeouts", model=name)
@@ -184,6 +210,27 @@ class ModelRegistry:
     def get(self, name: str) -> Optional[ModelVersion]:
         with self._lock:
             return self._routes.get(name)
+
+    def previous(self, name: str) -> Optional[ModelVersion]:
+        """The route's pinned rollback target (still loaded), if any."""
+        with self._lock:
+            return self._previous.get(name)
+
+    def unregister(self, name: str) -> Optional[ModelVersion]:
+        """Drop a route entirely (current + pinned previous), draining
+        outstanding leases first.  This is how a shadow challenger leaves
+        the registry after a promotion decision — the serve routes
+        themselves are never unregistered in normal operation."""
+        with self._lock:
+            mv = self._routes.pop(name, None)
+            prev = self._previous.pop(name, None)
+        if prev is not None:
+            prev.pinned = False
+        if mv is not None:
+            if not mv.wait_idle(self._drain_timeout_s):
+                obs.inc("serve.swap_drain_timeouts", model=name)
+            obs.inc("serve.models_unloaded", model=name)
+        return mv
 
     def names(self) -> List[str]:
         with self._lock:
@@ -217,4 +264,11 @@ class ModelRegistry:
 
     def describe(self) -> dict:
         with self._lock:
-            return {n: mv.describe() for n, mv in self._routes.items()}
+            out = {}
+            for n, mv in self._routes.items():
+                entry = mv.describe()
+                prev = self._previous.get(n)
+                if prev is not None:
+                    entry["previous"] = prev.describe()
+                out[n] = entry
+            return out

@@ -1,0 +1,523 @@
+"""mmlspark_tpu.loop — closed-loop continuous training (ISSUE 18).
+
+Layers:
+1. promotion-gate units: the full accept/reject matrix, including both
+   poisoned-challenger shapes (corrupt baseline, trackerless baseline);
+2. controller admission units: accept / duplicate / cooldown / shed with
+   priority eviction, manual bypass, and the stop()-joins-thread contract
+   (the LOOP001 analyzer rule's runtime counterpart);
+3. refit units: warm-start appends trees with the champion's binning
+   pinned, the candidate ships a FRESH quality baseline, and a snapshot
+   that fails digest verification aborts instead of training;
+4. shadow units: un-routed registry entry, bounded drop-and-count
+   mirroring, corrupt-baseline candidates marked poisoned;
+5. registry pin + rollback-under-traffic: rollback is a pointer flip
+   (``serve.models_loaded`` flat) with zero 5xx across it;
+6. poisoned-challenger end-to-end: a candidate refit on wrong-distribution
+   shards is rejected by the live gate and the champion keeps serving.
+"""
+
+import json
+import os
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu import obs
+from mmlspark_tpu.loop import (
+    LoopConfig,
+    PromotionGate,
+    RefitError,
+    RetrainController,
+    ShadowDeploy,
+    refit_candidate,
+    shadow_route,
+    warm_refit,
+)
+from mmlspark_tpu.loop import refit as refit_mod
+from mmlspark_tpu.serve.monitor import find_booster
+from mmlspark_tpu.serve.registry import ModelRegistry
+
+N_FEATURES = 4
+SHARD_ROWS = 600
+
+
+# --------------------------------------------------------------- fixtures
+@pytest.fixture(scope="module")
+def champion(tmp_path_factory):
+    """A trained+saved regressor on N(0,1) plus labeled shard dirs from
+    the same (fresh) and a hostile (poisoned) distribution."""
+    from mmlspark_tpu.core.frame import DataFrame
+    from mmlspark_tpu.data.loader import write_row_group_shards
+    from mmlspark_tpu.models.lightgbm import LightGBMRegressor
+
+    rng = np.random.default_rng(3)
+    X = rng.normal(size=(400, N_FEATURES))
+    y = X[:, 0] * 2.0 + np.sin(X[:, 1]) + 0.1 * rng.normal(size=len(X))
+    model = LightGBMRegressor(
+        numIterations=4, numLeaves=8, minDataInLeaf=4
+    ).fit(DataFrame({"features": list(X), "label": y}))
+    root = tmp_path_factory.mktemp("loop_champion")
+    path = str(root / "v1")
+    model.save(path)
+
+    def shards(name, center, seed):
+        r = np.random.default_rng(seed)
+        Xs = r.normal(size=(SHARD_ROWS, N_FEATURES)) + center
+        ys = Xs[:, 0] * 2.0 + np.sin(Xs[:, 1]) + 0.1 * r.normal(
+            size=len(Xs))
+        p = str(root / name)
+        write_row_group_shards(p, Xs, ys, rows_per_group=256)
+        return p
+
+    return {
+        "path": path,
+        "model": model,
+        "X": X,
+        "fresh": shards("fresh", 0.0, 11),
+        "poisoned": shards("poisoned", -3.0, 12),
+    }
+
+
+def _chal(**over):
+    """A healthy challenger stats dict the gate should promote."""
+    d = {
+        "baseline_ok": True,
+        "errors": 0,
+        "mirrored_rows": 1000,
+        "feature_excess_psi_max": 0.01,
+        "score_excess_psi": 0.02,
+        "latency_p50_s": 0.004,
+        "champion_latency_p50_s": 0.003,
+        "auc_proxy_agreement": 0.9,
+    }
+    d.update(over)
+    return d
+
+
+_CHAMP = {"version": 1, "feature_excess_psi_max": 0.6,
+          "score_excess_psi": 0.5}
+
+
+# ------------------------------------------------------ promotion gate
+class TestPromotionGate:
+    def test_promotes_healthy_challenger_over_drifting_champion(self):
+        d = PromotionGate(min_mirrored=512).decide(_CHAMP, _chal())
+        assert d.promote and d.reason == "challenger_beats_champion"
+
+    def test_corrupt_baseline_never_promotes(self):
+        d = PromotionGate().decide(_CHAMP, _chal(baseline_ok=False))
+        assert not d.promote and d.reason == "poisoned_baseline"
+
+    def test_baseline_without_tracker_signal_is_poisoned(self):
+        d = PromotionGate().decide(
+            _CHAMP,
+            _chal(feature_excess_psi_max=None, score_excess_psi=None),
+        )
+        assert not d.promote and d.reason == "poisoned_baseline"
+
+    def test_replay_errors_reject(self):
+        d = PromotionGate().decide(_CHAMP, _chal(errors=3))
+        assert not d.promote and d.reason == "challenger_errors"
+
+    def test_insufficient_mirrored_rejects(self):
+        d = PromotionGate(min_mirrored=512).decide(
+            _CHAMP, _chal(mirrored_rows=100))
+        assert not d.promote and d.reason == "insufficient_mirrored"
+
+    def test_absolutely_drifting_challenger_rejects_even_if_better(self):
+        # challenger beats the champion but is itself above the paging
+        # threshold — "less wrong" must not ship
+        d = PromotionGate(psi_alert=0.25).decide(
+            {"feature_excess_psi_max": 2.0}, _chal(
+                feature_excess_psi_max=0.5, score_excess_psi=0.0))
+        assert not d.promote and d.reason == "challenger_drifting"
+
+    def test_champion_no_worse_rejects(self):
+        d = PromotionGate().decide(
+            {"feature_excess_psi_max": 0.01}, _chal(
+                feature_excess_psi_max=0.05))
+        assert not d.promote and d.reason == "champion_no_worse"
+
+    def test_slow_challenger_rejects(self):
+        d = PromotionGate(latency_ratio=5.0).decide(
+            _CHAMP, _chal(latency_p50_s=0.1, champion_latency_p50_s=0.003))
+        assert not d.promote and d.reason == "challenger_slow"
+
+    def test_referenceless_champion_promotes_on_absolute_health(self):
+        d = PromotionGate().decide(None, _chal())
+        assert d.promote
+
+
+# ------------------------------------------------ controller admission
+def _controller(**cfg_over):
+    cfg = LoopConfig(cooldown_s=300.0, queue_depth=2, **cfg_over)
+    # admission paths never touch the app; None keeps the unit honest
+    return RetrainController(None, lambda name: None, config=cfg)
+
+
+class TestControllerAdmission:
+    def test_accept_then_duplicate(self):
+        c = _controller()
+        assert c.request("m", severity=1.0) == "accept"
+        assert c.request("m", severity=2.0) == "duplicate"
+
+    def test_cooldown_debounces_alarms_but_not_manual(self):
+        c = _controller()
+        with c._cv:
+            c._last_retrain["m"] = time.monotonic()
+        assert c.request("m", severity=1.0) == "cooldown"
+        assert c.request("m", manual=True) == "accept"
+
+    def test_priority_shed_evicts_lowest(self):
+        c = _controller()
+        assert c.request("low", severity=0.1) == "accept"
+        assert c.request("mid", severity=0.5) == "accept"
+        # queue full: weaker job bounces...
+        assert c.request("weak", severity=0.05) == "shed"
+        # ...stronger job evicts the weakest queued one
+        assert c.request("hot", severity=9.0) == "accept"
+        with c._cv:
+            names = {j.name for j in c._jobs}
+        assert names == {"mid", "hot"}
+        # the evicted route may re-enter
+        assert c.request("low", severity=1.0) == "accept"
+
+    def test_stop_joins_worker_thread(self):
+        c = _controller()
+        c.start()
+        assert c._thread.is_alive()
+        c.stop()
+        assert not c._thread.is_alive()
+
+    def test_slo_alarm_without_probation_is_ignored(self):
+        c = _controller()
+        c.on_alarm("m", 1, "slo_availability", {})  # no app access → no raise
+        with c._cv:
+            assert not c._jobs
+
+    def test_drift_alarm_enqueues_with_severity(self):
+        c = _controller()
+        c.on_alarm("m", 1, "feature_drift",
+                   {"feature_psi_max": 0.7, "score_psi": 0.2})
+        with c._cv:
+            assert [j.severity for j in c._jobs] == [0.7]
+
+    def test_status_reports_queue_and_cooldowns(self):
+        class _App:
+            def shadow_stats(self):
+                return {}
+
+        c = RetrainController(_App(), lambda n: None,
+                              config=LoopConfig(queue_depth=2))
+        c.request("m", severity=0.4)
+        st = c.status()
+        assert st["queue"][0]["model"] == "m"
+        assert st["active"] is None and st["probation"] == {}
+
+
+# --------------------------------------------------------------- refit
+class TestWarmRefit:
+    def test_appends_trees_with_binning_pinned(self, champion, tmp_path):
+        from mmlspark_tpu.data.loader import RowGroupSource
+
+        booster = find_booster(champion["model"])
+        t0 = booster.num_iterations
+        refit = warm_refit(
+            booster, RowGroupSource(champion["fresh"]),
+            workdir=str(tmp_path), append_trees=3,
+        )
+        assert refit.num_iterations == t0 + 3
+        # continuation pins the champion's binning authority
+        assert refit.bin_mapper.max_bin == booster.bin_mapper.max_bin
+        # the old trees ride unchanged: predictions with num_iteration=t0
+        # match the champion bitwise
+        X = champion["X"][:32]
+        np.testing.assert_array_equal(
+            np.asarray(booster.predict(X)),
+            np.asarray(refit.predict(X, num_iteration=t0)),
+        )
+
+    def test_corrupt_snapshot_aborts(self, champion, tmp_path, monkeypatch):
+        from mmlspark_tpu.data.loader import RowGroupSource
+
+        # load_checkpoint returns None on digest mismatch (quarantine);
+        # the refit must refuse to continue from unverified trees
+        monkeypatch.setattr(refit_mod, "load_checkpoint", lambda p: None)
+        with pytest.raises(RefitError, match="digest"):
+            warm_refit(
+                find_booster(champion["model"]),
+                RowGroupSource(champion["fresh"]),
+                workdir=str(tmp_path), append_trees=2,
+            )
+
+    def test_nonpositive_append_trees_rejected(self, champion, tmp_path):
+        with pytest.raises(RefitError):
+            warm_refit(find_booster(champion["model"]), None,
+                       workdir=str(tmp_path), append_trees=0)
+
+    def test_candidate_dir_carries_fresh_baseline(self, champion, tmp_path):
+        from mmlspark_tpu.data.loader import RowGroupSource
+
+        cand = refit_candidate(
+            champion["model"], champion["path"],
+            RowGroupSource(champion["fresh"]),
+            workdir=str(tmp_path), append_trees=2,
+        )
+        with open(os.path.join(cand, "quality_baseline.json")) as f:
+            qb = json.load(f)
+        # captured from the FRESH shards, not inherited from the champion
+        assert qb["n_rows"] == SHARD_ROWS
+
+    def test_pathless_champion_rejected(self, champion, tmp_path):
+        from mmlspark_tpu.data.loader import RowGroupSource
+
+        with pytest.raises(RefitError, match="path"):
+            refit_candidate(
+                champion["model"], None,
+                RowGroupSource(champion["fresh"]),
+                workdir=str(tmp_path), append_trees=2,
+            )
+
+
+# -------------------------------------------------------------- shadow
+class TestShadowDeploy:
+    def test_unrouted_registration_and_stats(self, champion):
+        reg = ModelRegistry()
+        sh = ShadowDeploy("m", reg, path=champion["path"], prewarm=False)
+        try:
+            assert reg.get(shadow_route("m")) is not None
+            assert sh.stats()["baseline_ok"]
+            rows = champion["X"][:8]
+            sh.mirror(rows, np.zeros(8), 0.001)
+            deadline = time.monotonic() + 10
+            while (sh.stats()["mirrored_rows"] < 8
+                   and time.monotonic() < deadline):
+                time.sleep(0.05)
+            st = sh.stats()
+            assert st["mirrored_rows"] == 8 and st["errors"] == 0
+            assert st["latency_p50_s"] is not None
+            assert st["feature_live_rows"] == pytest.approx(8.0)
+        finally:
+            sh.stop()
+        assert reg.get(shadow_route("m")) is None  # unregistered on stop
+
+    def test_bounded_queue_drops_and_counts(self, champion):
+        reg = ModelRegistry()
+        sh = ShadowDeploy("m", reg, path=champion["path"], queue_depth=2,
+                          prewarm=False)
+        try:
+            # park the worker so the bounded queue actually fills
+            sh._stop.set()
+            sh._thread.join(timeout=5)
+            rows = champion["X"][:4]
+            for _ in range(5):
+                sh.mirror(rows, np.zeros(4), 0.001)
+            st = sh.stats()
+            assert st["dropped_batches"] == 3 and st["errors"] == 0
+        finally:
+            sh.stop()
+
+    def test_corrupt_baseline_marks_poisoned(self, champion, tmp_path):
+        import shutil
+
+        cand = str(tmp_path / "cand")
+        shutil.copytree(champion["path"], cand)
+        with open(os.path.join(cand, "quality_baseline.json"), "w") as f:
+            f.write("{not json")
+        reg = ModelRegistry()
+        sh = ShadowDeploy("m", reg, path=cand, prewarm=False)
+        try:
+            st = sh.stats()
+            assert not st["baseline_ok"]
+            d = PromotionGate(min_mirrored=0).decide(_CHAMP, st)
+            assert not d.promote and d.reason == "poisoned_baseline"
+        finally:
+            sh.stop()
+
+
+# ------------------------------------------------- registry pin + flip
+class TestRegistryPin:
+    def test_swap_pins_previous_loaded(self, champion):
+        reg = ModelRegistry()
+        v1 = reg.register("m", path=champion["path"])
+        reg.swap("m", model=champion["model"])
+        prev = reg.previous("m")
+        assert prev is v1 and prev.pinned and prev.model is not None
+        assert reg.describe()["m"]["previous"]["version"] == 1
+
+    def test_rollback_is_a_pointer_flip_not_a_load(self, champion):
+        obs.enable()
+        reg = ModelRegistry()
+        v1 = reg.register("m", path=champion["path"])
+        v2 = reg.swap("m", model=champion["model"])
+        loaded = obs.snapshot()["counters"].get(
+            "serve.models_loaded{model=m}", 0)
+        back = reg.rollback("m")
+        assert back is v1 and reg.get("m") is v1
+        # the restored version was never re-loaded...
+        assert obs.snapshot()["counters"].get(
+            "serve.models_loaded{model=m}", 0) == loaded
+        # ...and the displaced current is now the pinned rollback target
+        assert reg.previous("m") is v2 and v2.pinned and not v1.pinned
+
+    def test_later_swap_supersedes_pin(self, champion):
+        reg = ModelRegistry()
+        v1 = reg.register("m", path=champion["path"])
+        reg.swap("m", model=champion["model"])
+        reg.swap("m", model=champion["model"])
+        assert not v1.pinned and reg.previous("m").version == 2
+
+    def test_rollback_without_previous_raises(self, champion):
+        reg = ModelRegistry()
+        reg.register("m", path=champion["path"])
+        with pytest.raises(KeyError):
+            reg.rollback("m")
+
+
+# ------------------------------------- serving e2e: rollback + shadows
+def _post(url, payload, timeout=30.0):
+    req = urllib.request.Request(
+        url, data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"}, method="POST",
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.status, json.loads(r.read().decode())
+    except urllib.error.HTTPError as e:
+        body = e.read().decode()
+        try:
+            body = json.loads(body)
+        except ValueError:
+            pass
+        return e.code, body
+
+
+class TestServingLoopE2E:
+    def test_rollback_under_traffic_zero_5xx(self, champion):
+        from mmlspark_tpu.serve import ServingApp
+
+        obs.reset()
+        app = ServingApp(max_wait_ms=5.0, monitor=False).start()
+        try:
+            app.add_model("m", path=champion["path"])
+            url = f"{app.url}/models/m/predict"
+            statuses = []
+            stop = threading.Event()
+
+            def hammer():
+                rng = np.random.default_rng(0)
+                while not stop.is_set():
+                    rows = rng.normal(size=(4, N_FEATURES)).tolist()
+                    statuses.append(_post(url, {"instances": rows})[0])
+
+            threads = [threading.Thread(target=hammer, daemon=True)
+                       for _ in range(3)]
+            for t in threads:
+                t.start()
+            time.sleep(0.3)
+            app.swap_model("m", path=champion["path"], block=True)
+            time.sleep(0.3)
+            loaded = sum(
+                v for k, v in obs.snapshot()["counters"].items()
+                if k.startswith("serve.models_loaded")
+            )
+            mv = app.rollback("m")
+            time.sleep(0.3)
+            stop.set()
+            for t in threads:
+                t.join(timeout=30)
+            assert mv.version == 1 and app.registry.get("m") is mv
+            assert statuses and not [s for s in statuses if 500 <= s < 599]
+            after = sum(
+                v for k, v in obs.snapshot()["counters"].items()
+                if k.startswith("serve.models_loaded")
+            )
+            assert after == loaded  # rollback never cold-loads
+        finally:
+            app.stop()
+
+    def test_shadow_route_is_unreachable_over_http(self, champion):
+        from mmlspark_tpu.serve import ServingApp
+
+        app = ServingApp(max_wait_ms=5.0, monitor=False).start()
+        try:
+            app.add_model("m", path=champion["path"])
+            app.start_shadow("m", path=champion["path"])
+            rows = champion["X"][:2].tolist()
+            status, _ = _post(
+                f"{app.url}/models/{shadow_route('m')}/predict",
+                {"instances": rows},
+            )
+            assert status == 404  # the URL grammar cannot express @shadow
+            status, _ = _post(f"{app.url}/models/m/predict",
+                              {"instances": rows})
+            assert status == 200
+        finally:
+            app.stop()
+
+    def test_poisoned_challenger_never_promotes(self, champion):
+        """End-to-end: a manual retrain against wrong-distribution shards
+        produces a candidate whose own baseline disagrees with live
+        traffic; the gate must reject it, count it, and leave the
+        champion serving."""
+        from mmlspark_tpu.data.loader import RowGroupSource
+        from mmlspark_tpu.serve import ServingApp
+
+        obs.reset()
+        app = ServingApp(max_wait_ms=5.0).start()
+        try:
+            app.add_model("m", path=champion["path"])
+            assert app.monitor is not None
+            cfg = LoopConfig(
+                cooldown_s=600.0, append_trees=2, min_shadow_rows=64,
+                shadow_timeout_s=30.0, poll_interval_s=0.05,
+                workdir=str(os.path.join(
+                    os.path.dirname(champion["poisoned"]), "loop_wd")),
+            )
+            controller = RetrainController(
+                app, lambda name: RowGroupSource(champion["poisoned"]),
+                config=cfg)
+            app.attach_loop(controller)
+            url = f"{app.url}/models/m/predict"
+            champ_version = app.registry.get("m").version
+            stop = threading.Event()
+
+            def traffic():
+                rng = np.random.default_rng(5)
+                while not stop.is_set():
+                    rows = rng.normal(size=(8, N_FEATURES)).tolist()
+                    _post(url, {"instances": rows})
+
+            t = threading.Thread(target=traffic, daemon=True)
+            t.start()
+            try:
+                assert controller.request("m", manual=True) == "accept"
+                deadline = time.monotonic() + 60
+                while (not controller.status()["decisions"]
+                       and time.monotonic() < deadline):
+                    time.sleep(0.2)
+            finally:
+                stop.set()
+                t.join(timeout=30)
+            decisions = controller.status()["decisions"]
+            assert decisions, "controller produced no decision in time"
+            decision = decisions[-1]["decision"]
+            assert not decision["promote"]
+            assert decision["reason"] in (
+                "challenger_drifting", "champion_no_worse")
+            assert app.registry.get("m").version == champ_version
+            rejected = sum(
+                v for k, v in obs.snapshot()["counters"].items()
+                if k.startswith("loop.promotions_rejected")
+            )
+            assert rejected >= 1
+            status, body = _post(
+                url, {"instances": champion["X"][:2].tolist()})
+            assert status == 200 and len(body["predictions"]) == 2
+        finally:
+            app.stop()

@@ -964,6 +964,86 @@ def test_srv002_real_router_is_clean():
     assert found == []
 
 
+def test_loop001_looping_thread_without_join(tmp_path):
+    p = _write(str(tmp_path / "m.py"), """
+        import threading
+        class Daemon:
+            def start(self):
+                t = threading.Thread(target=self._run, daemon=True)
+                t.start()
+            def _run(self):
+                while True:
+                    pass
+    """)
+    found = [f for f in check_serving_file(p) if f.rule == "LOOP001"]
+    assert rules(found) == ["LOOP001"]
+    assert "orphan" in found[0].message and "join" in found[0].message
+
+
+def test_loop001_silent_with_stop_join_path(tmp_path):
+    p = _write(str(tmp_path / "m.py"), """
+        import threading
+        class Daemon:
+            def start(self):
+                self._stop = threading.Event()
+                self._t = threading.Thread(target=self._run, daemon=True)
+                self._t.start()
+            def _run(self):
+                while not self._stop.is_set():
+                    self._stop.wait(0.5)
+            def stop(self):
+                self._stop.set()
+                self._t.join(timeout=5.0)
+    """)
+    assert [f for f in check_serving_file(p) if f.rule == "LOOP001"] == []
+
+
+def test_loop001_silent_on_oneshot_and_foreign_targets(tmp_path):
+    p = _write(str(tmp_path / "m.py"), """
+        import threading
+        def once(x):
+            return x + 1
+        def spawn(server):
+            # one-shot worker: no while, bounded by construction
+            threading.Thread(target=once, daemon=True).start()
+            # imported/argument callable: not this module's to police
+            threading.Thread(target=server.serve_forever).start()
+            # lambdas/partials carry no resolvable name
+            threading.Thread(target=lambda: None).start()
+    """)
+    assert [f for f in check_serving_file(p) if f.rule == "LOOP001"] == []
+
+
+def test_loop001_suppression_round_trip(tmp_path):
+    src = """
+        import threading
+        def _run():
+            while True:
+                pass
+        t = threading.Thread(target=_run){supp}
+    """
+    fires = _write(str(tmp_path / "a.py"), src.format(supp=""))
+    assert rules(apply_suppressions(check_serving_file(fires))) == [
+        "LOOP001"]
+    silenced = _write(str(tmp_path / "b.py"),
+                      src.format(supp="  # analyze: ignore[LOOP001]"))
+    assert apply_suppressions(check_serving_file(silenced)) == []
+
+
+def test_loop001_real_loop_and_serve_modules_are_clean():
+    """The shipped daemons (retrain controller, shadow replayer, quality
+    monitor, serving workers) all carry the stop-flag + bounded-join
+    teardown the rule demands, so the real tree stays silent."""
+    import mmlspark_tpu.loop.controller as controller_mod
+    import mmlspark_tpu.loop.shadow as shadow_mod
+    import mmlspark_tpu.serve.app as app_mod
+    import mmlspark_tpu.serve.monitor as monitor_mod
+    for mod in (controller_mod, shadow_mod, app_mod, monitor_mod):
+        found = [f for f in check_serving_file(mod.__file__)
+                 if f.rule == "LOOP001"]
+        assert found == [], mod.__name__
+
+
 # ------------------------------------------------------------ suppressions
 
 

@@ -92,7 +92,7 @@ PASSES = {
     "obs": (lambda root, index: check_obs(root, index=index),
             {"OBS001", "OBS002", "OBS003", "OBS004"}),
     "serving": (lambda root, index: check_serving(root, index=index),
-                {"SRV001", "SRV002"}),
+                {"SRV001", "SRV002", "LOOP001"}),
     "predict": (lambda root, index: check_predict(root, index=index),
                 {"PRED001"}),
     "quantize": (lambda root, index: check_quantize(root, index=index),

@@ -28,12 +28,29 @@ Rules
   live in the same module as the spawn.  ``subprocess.run``/
   ``check_output`` are exempt (they block until the child exits).
 
+- LOOP001: a module that spawns a looping worker thread with no join
+  path — ``threading.Thread(target=f)`` where ``f`` resolves to a
+  module-local function containing a ``while`` statement, in a module
+  with NO ``.join(...)`` call anywhere.  A while-loop worker is
+  long-lived by construction; without a stop-flag + ``join`` teardown it
+  outlives its owner as an orphan: it keeps polling a dead queue,
+  pins its closure's device arrays, and (daemonized) dies mid-write at
+  interpreter exit instead of draining.  The shipped shape is
+  ``RetrainController.stop()`` / ``ShadowDeploy.stop()`` in
+  ``mmlspark_tpu/loop``: set the stop event, notify, ``join`` with a
+  bound.  One-shot helper threads (no ``while``) and threads targeting
+  imported callables are out of scope by construction.
+
 Detection is intentionally modest: only ``.get``/``.wait`` receivers that
 this module ASSIGNED from a ``Queue``/``Event`` constructor are checked
 (by variable or attribute name), so ``dict.get``/``os.environ.get`` and
 friends never false-positive; SRV002 keys on the ``Popen`` callee name
 and a whole-module scan for the three signal methods, so helper modules
-that merely type-annotate ``subprocess.Popen`` never fire.
+that merely type-annotate ``subprocess.Popen`` never fire; LOOP001 keys
+on the bare target name resolving to a module-local ``while``-bearing
+def plus a whole-module scan for ``join``, so delegating to
+``server.serve_forever`` or spawning bounded one-shot workers never
+fires.
 """
 
 from __future__ import annotations
@@ -146,6 +163,57 @@ def _popen_findings(path: str, tree) -> list:
     ]
 
 
+def _thread_target_name(call: ast.Call) -> str | None:
+    """The bare name of a ``Thread(target=...)`` callable (``f`` or
+    ``self._run`` → ``_run``); None for lambdas/partials/calls."""
+    for kw in call.keywords:
+        if kw.arg != "target":
+            continue
+        v = kw.value
+        if isinstance(v, ast.Name):
+            return v.id
+        if isinstance(v, ast.Attribute):
+            return v.attr
+    return None
+
+
+def _loop_findings(path: str, tree) -> list:
+    """LOOP001: while-loop worker threads in a module with no join."""
+    loopers: set = set()  # names of defs containing a `while`
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            if any(isinstance(n, ast.While) for n in ast.walk(node)):
+                loopers.add(node.name)
+    if not loopers:
+        return []
+    spawns = []
+    has_join = False
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        fn = node.func
+        if isinstance(fn, ast.Attribute) and fn.attr == "join":
+            has_join = True
+        if _ctor_name(node) == "Thread":
+            target = _thread_target_name(node)
+            if target in loopers:
+                spawns.append((node, target))
+    if has_join:
+        return []
+    return [
+        Finding(
+            path, node.lineno, "LOOP001",
+            f"Thread(target={target}) runs a while-loop worker but this "
+            "module never join()s any thread — the worker outlives its "
+            "owner as an orphan (polling a dead queue, pinning its "
+            "closure's arrays, dying mid-write at interpreter exit); "
+            "give it a stop flag and a bounded join (see "
+            "RetrainController.stop in mmlspark_tpu/loop/controller.py)",
+        )
+        for node, target in spawns
+    ]
+
+
 def check_serving_file(path: str, tree=None) -> list:
     if tree is None:
         try:
@@ -154,6 +222,7 @@ def check_serving_file(path: str, tree=None) -> list:
         except SyntaxError:
             return []
     findings: list = list(_popen_findings(path, tree))
+    findings.extend(_loop_findings(path, tree))
     queue_names: set = set()
     event_names: set = set()
     # pass 1: ctor sites — flag unbounded queues, learn receiver names

@@ -111,6 +111,22 @@ LEDGER_SCHEMAS = {
             (int, float),
         "ledger.hierarchical.auc_drift_vs_f32_serial": (int, float),
     },
+    "LOOP_BENCH.json": {
+        "bench": str,
+        "backend": str,
+        "steady.requests": int,
+        "shifted.requests": int,
+        "recovery.excess_psi": (int, float),
+        "recovery.psi_alert": (int, float),
+        "rollback.restored_version": int,
+        "gates.zero_5xx": bool,
+        "gates.alarm_fired": bool,
+        "gates.promoted": bool,
+        "gates.psi_recovered": bool,
+        "gates.poisoned_rejected": bool,
+        "gates.rollback_ok": bool,
+        "gates.rollback_pin": bool,
+    },
 }
 
 # ---------------------------------------------------------------------------
@@ -196,6 +212,45 @@ GATES = [
         "id": "ingest.byte_working_set",
         "ledger": "INGEST_BENCH.json",
         "path": "gate_byte_ws_le_half_int32",
+        "op": "all_true",
+        "band": None,
+    },
+    # Closed-loop invariants (tools/bench_loop.py) — mechanism gates, all
+    # machine-independent: the loop either closed (alarm → retrain →
+    # shadow → promote → drift recovered, zero 5xx throughout) or it
+    # didn't, whatever the wall clock said.
+    {
+        "id": "loop.zero_5xx",
+        "ledger": "LOOP_BENCH.json",
+        "path": "gates.zero_5xx",
+        "op": "all_true",
+        "band": None,
+    },
+    {
+        "id": "loop.drift_corrected",
+        "ledger": "LOOP_BENCH.json",
+        "path": "gates.promoted",
+        "op": "all_true",
+        "band": None,
+    },
+    {
+        "id": "loop.psi_recovered",
+        "ledger": "LOOP_BENCH.json",
+        "path": "gates.psi_recovered",
+        "op": "all_true",
+        "band": None,
+    },
+    {
+        "id": "loop.poisoned_rejected",
+        "ledger": "LOOP_BENCH.json",
+        "path": "gates.poisoned_rejected",
+        "op": "all_true",
+        "band": None,
+    },
+    {
+        "id": "loop.rollback_pin_flip",
+        "ledger": "LOOP_BENCH.json",
+        "path": "gates.rollback_ok",
         "op": "all_true",
         "band": None,
     },
