@@ -341,6 +341,15 @@ class Dataset:
             self._mapper_cache = {key: bm}  # size-1: sweeps must not pin all
         return bm
 
+    def pin_mapper(self, bin_mapper: BinMapper, cfg: "TrainConfig") -> None:
+        """Pin an EXTERNAL mapper as this dataset's fitted mapper under
+        ``cfg``'s binning params — the shared-authority hook: a fleet of
+        per-tenant datasets binned through one ``BinningAuthority``
+        (``engine/multi_train``) pins it here so a standalone ``train()``
+        on any of them bins identically to the stacked run."""
+        key = (cfg.max_bin, tuple(cfg.categorical_feature), cfg.seed)
+        self._mapper_cache = {key: bin_mapper}
+
     def binned(self, bin_mapper: BinMapper) -> np.ndarray:
         """This dataset's rows under ``bin_mapper``, cached for the MOST
         RECENT mapper instance (mappers are fit-once/immutable by

@@ -85,6 +85,12 @@ class LoopConfig:
     poll_interval_s: float = 0.25
     #: scratch root for refit workdirs (default: ``$TMPDIR/mmlspark_tpu_loop``)
     workdir: str = ""
+    #: max queued jobs drained into ONE stacked training dispatch
+    #: (``engine.multi_train``); 1 restores the one-at-a-time drain
+    train_batch: int = 8
+    #: after the first job arrives, linger this long for batchmates
+    #: before dispatching a PARTIAL batch (0 = dispatch immediately)
+    batch_window_s: float = 0.05
 
     @classmethod
     def from_env(cls, **overrides) -> "LoopConfig":
@@ -102,6 +108,10 @@ class LoopConfig:
             probation_s=_env("PROBATION_S", cls.probation_s, float),
             chunk_rows=_env("CHUNK_ROWS", cls.chunk_rows, int),
             workdir=os.environ.get("MMLSPARK_TPU_LOOP_WORKDIR", ""),
+            train_batch=_env("TRAIN_BATCH", cls.train_batch, int),
+            batch_window_s=_env(
+                "BATCH_WINDOW_S", cls.batch_window_s, float
+            ),
         )
         return dataclasses.replace(cfg, **overrides)
 
@@ -155,6 +165,8 @@ class RetrainController:
         self._jobs: List[RetrainJob] = []
         self._queued: set = set()
         self._active: Optional[RetrainJob] = None
+        self._active_batch: List[RetrainJob] = []
+        self._active_names: set = set()
         self._seq = 0
         self._job_counter = 0
         self._last_retrain: Dict[str, float] = {}
@@ -206,7 +218,7 @@ class RetrainController:
         now = time.monotonic()
         shed_job: Optional[RetrainJob] = None
         with self._cv:
-            if name in self._queued or (
+            if name in self._queued or name in self._active_names or (
                 self._active is not None and self._active.name == name
             ):
                 verdict = "duplicate"
@@ -257,28 +269,122 @@ class RetrainController:
                     self._cv.wait(timeout=0.5)
                 if self._stop.is_set():
                     return
-                # highest priority first: manual beats alarm-driven,
-                # then drift severity (excess PSI), then FIFO
-                job = max(
-                    self._jobs,
-                    key=lambda j: (j.manual, j.severity, -j.seq),
-                )
-                self._jobs.remove(job)
-                self._queued.discard(job.name)
-                self._active = job
-                self._job_counter += 1
-                job_id = self._job_counter
+                # Partial batch on timeout: the first job is in; linger
+                # up to batch_window_s for batchmates (a drift episode
+                # usually alarms several tenants inside one monitor
+                # sweep), then dispatch whatever arrived.
+                if self.cfg.train_batch > 1 and self.cfg.batch_window_s > 0:
+                    deadline = time.monotonic() + self.cfg.batch_window_s
+                    while (
+                        len(self._jobs) < self.cfg.train_batch
+                        and not self._stop.is_set()
+                    ):
+                        remaining = deadline - time.monotonic()
+                        if remaining <= 0:
+                            break
+                        self._cv.wait(timeout=remaining)
+                if self._stop.is_set():
+                    return
+            batch = self._drain_batch()
+            if not batch:
+                continue
             obs.gauge("loop.queue_depth", len(self._jobs))
             try:
-                self._process(job, job_id)
+                self._process_batch(batch)
             except Exception:
-                obs.inc("loop.retrain_failures", model=job.name)
+                for job, _ in batch:
+                    obs.inc("loop.retrain_failures", model=job.name)
                 obs.get_logger("mmlspark_tpu.serve").exception(
-                    "retrain job for %s died", job.name
+                    "retrain batch %s died",
+                    [job.name for job, _ in batch],
                 )
             finally:
                 with self._cv:
                     self._active = None
+                    self._active_batch = []
+                    self._active_names = set()
+
+    def _drain_batch(self) -> List[tuple]:
+        """Pop up to ``train_batch`` jobs in priority order — manual
+        beats alarm-driven, then drift severity (excess PSI), then FIFO
+        — in ONE critical section, so admission verdicts (duplicate
+        checks against the whole in-flight batch) never race the
+        drain.  Returns ``[(job, job_id), ...]``, highest priority
+        first."""
+        with self._cv:
+            if not self._jobs:
+                return []
+            k = max(1, int(self.cfg.train_batch))
+            picked = sorted(
+                self._jobs,
+                key=lambda j: (j.manual, j.severity, -j.seq),
+                reverse=True,
+            )[:k]
+            batch = []
+            for job in picked:
+                self._jobs.remove(job)
+                self._queued.discard(job.name)
+                self._job_counter += 1
+                batch.append((job, self._job_counter))
+            self._active = batch[0][0]
+            self._active_batch = [job for job, _ in batch]
+            self._active_names = {job.name for job, _ in batch}
+            return batch
+
+    def _process_batch(self, batch: List[tuple]) -> None:
+        """One drained batch end to end: batched refit (ONE stacked
+        training dispatch for every champion sharing an authority —
+        ``loop/refit.refit_candidates_batched``), then the unchanged
+        sequential shadow → gate → promote pipeline per job.  Refit
+        failures are isolated per job."""
+        if len(batch) == 1:
+            self._process(*batch[0])
+            return
+        requests, pending = [], []
+        for job, job_id in batch:
+            name = job.name
+            with self._cv:
+                self._last_retrain[name] = time.monotonic()
+            obs.inc("loop.retrains", model=name, reason=job.reason)
+            flight.record("loop", "retrain_start",
+                          {"model": name, **job.describe()})
+            mv = self.app.registry.get(name)
+            if mv is None:
+                self._finish(job, Decision(False, "unknown_route", {}))
+                continue
+            try:
+                source = self._data_provider(name)
+            except Exception as e:
+                obs.inc("loop.retrain_failures", model=name)
+                flight.record("loop", "retrain_failed",
+                              {"model": name, "error": repr(e)})
+                self._finish(job, Decision(False, "refit_failed",
+                                           {"error": repr(e)}))
+                continue
+            requests.append(refit_mod.BatchRefitRequest(
+                name=name, champion_model=mv.model, champion_path=mv.path,
+                source=source,
+                workdir=os.path.join(self._workroot, name, f"job-{job_id}"),
+            ))
+            pending.append(job)
+        if not requests:
+            return
+        with obs.span("loop.retrain_batch", models=len(requests)):
+            results = refit_mod.refit_candidates_batched(
+                requests,
+                append_trees=self.cfg.append_trees,
+                params=self._refit_params,
+                chunk_rows=self.cfg.chunk_rows or None,
+            )
+        for job, (candidate, err) in zip(pending, results):
+            if candidate is None:
+                obs.inc("loop.retrain_failures", model=job.name)
+                flight.record("loop", "retrain_failed",
+                              {"model": job.name, "error": repr(err)})
+                self._finish(job, Decision(False, "refit_failed",
+                                           {"error": repr(err)}))
+                continue
+            self._shadow_and_decide(job, candidate)
 
     def _process(self, job: RetrainJob, job_id: int) -> None:
         name = job.name
@@ -418,6 +524,7 @@ class RetrainController:
                 self._jobs, key=lambda j: (-j.manual, -j.severity, j.seq)
             )]
             active = self._active.describe() if self._active else None
+            active_batch = [j.describe() for j in self._active_batch]
             probation = {
                 n: {
                     "remaining_s": round(max(0.0, p["deadline"] - now), 3),
@@ -438,6 +545,7 @@ class RetrainController:
             "config": dataclasses.asdict(self.cfg),
             "queue": queue,
             "active": active,
+            "active_batch": active_batch,
             "probation": probation,
             "cooldowns": cooldowns,
             "decisions": decisions,

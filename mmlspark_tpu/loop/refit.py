@@ -26,8 +26,11 @@ retrain from scratch:
 
 from __future__ import annotations
 
+import dataclasses
 import os
-from typing import Optional
+from typing import List, Optional, Tuple
+
+import numpy as np
 
 from mmlspark_tpu import obs
 from mmlspark_tpu.core.pipeline import PipelineStage
@@ -129,13 +132,189 @@ def refit_candidate(
             booster, source, workdir=workdir, append_trees=append_trees,
             params=params, chunk_rows=chunk_rows,
         )
-        # Re-save the champion's own facade with the refit booster: the
-        # candidate inherits the serving params (feature column wiring,
-        # class labels) and _save_extra writes the NEW quality baseline
-        # captured from the fresh shards.
-        facade = PipelineStage.load(champion_path)
-        _set_booster(facade, refit_booster)
-        candidate = os.path.join(workdir, "candidate")
-        facade.save(candidate)
+        candidate = _save_candidate(champion_path, workdir, refit_booster)
     obs.inc("loop.candidates_built")
     return candidate
+
+
+def _save_candidate(champion_path: str, workdir: str, refit_booster) -> str:
+    """Re-save the champion's own facade with the refit booster: the
+    candidate inherits the serving params (feature column wiring, class
+    labels) and _save_extra writes the NEW quality baseline captured
+    from the fresh shards."""
+    facade = PipelineStage.load(champion_path)
+    _set_booster(facade, refit_booster)
+    candidate = os.path.join(workdir, "candidate")
+    facade.save(candidate)
+    return candidate
+
+
+# ---------------------------------------------------------------------------
+# Batched warm start: K queued jobs, ONE stacked training dispatch
+# ---------------------------------------------------------------------------
+@dataclasses.dataclass
+class BatchRefitRequest:
+    """One retrain job's slot in a batched refit drain."""
+
+    name: str
+    champion_model: object
+    champion_path: Optional[str]
+    source: object
+    workdir: str
+
+
+def _materialize_rows(source) -> Tuple[np.ndarray, np.ndarray]:
+    """Pull a shard source's rows into one (X, y) pair.  Batched refit
+    stacks every tenant's fresh window into one device tensor, so the
+    rows must materialize host-side first — the loop's windows are
+    small by construction (the same bound that makes stacking pay)."""
+    shards = [
+        (np.asarray(X), np.asarray(y)) for X, y in source.iter_shards()
+    ]
+    if not shards:
+        raise RefitError("refit source yielded no shards")
+    return (
+        np.concatenate([s[0] for s in shards], axis=0),
+        np.concatenate([s[1] for s in shards], axis=0),
+    )
+
+
+def refit_candidates_batched(
+    requests: List[BatchRefitRequest],
+    *,
+    append_trees: int,
+    params: Optional[dict] = None,
+    chunk_rows: Optional[int] = None,
+) -> List[Tuple[Optional[str], Optional[BaseException]]]:
+    """Warm-refit EVERY request in as few training dispatches as
+    possible; returns ``(candidate_path, error)`` per request, aligned
+    with the input (exactly one of the two is set).
+
+    Champions that share a binning authority — the fleet shape the
+    controller drains — ride ONE stacked ``engine.multi_train``
+    dispatch; anything that cannot stack (mapper not shared, a source
+    without ``iter_shards``, configs the stacked trainer rejects)
+    falls back to the sequential :func:`warm_refit` path per job, so a
+    batch is never WORSE than the one-at-a-time drain, only faster.
+    Failures are isolated per request: one bad champion cannot sink
+    its batchmates.
+    """
+    from mmlspark_tpu.engine.booster import Dataset
+    from mmlspark_tpu.engine.multi_train import MultiTrainJob, multi_train
+
+    results: List[Tuple[Optional[str], Optional[BaseException]]] = [
+        (None, None)
+    ] * len(requests)
+    prepared = {}  # index -> (init booster, request)
+    for i, req in enumerate(requests):
+        try:
+            booster = find_booster(req.champion_model)
+            if booster is None:
+                raise RefitError(
+                    f"champion {type(req.champion_model).__name__} "
+                    "carries no booster to warm-start from"
+                )
+            if not req.champion_path:
+                raise RefitError(
+                    "champion route has no saved model directory; warm "
+                    "refit re-saves the champion facade"
+                )
+            os.makedirs(req.workdir, exist_ok=True)
+            ckpt = os.path.join(req.workdir, "warmstart.ckpt")
+            with obs.span("loop.refit_checkpoint"):
+                write_checkpoint(ckpt, booster)
+                init = load_checkpoint(ckpt)
+            if init is None:
+                raise RefitError(
+                    "warm-start snapshot failed digest verification "
+                    f"(quarantined next to {ckpt})"
+                )
+            prepared[i] = init
+        except BaseException as e:  # noqa: BLE001 — per-job isolation
+            results[i] = (None, e)
+
+    # Group stackable jobs by shared authority: content fingerprint,
+    # not identity — every checkpoint round-trip above cloned the
+    # champion's mapper, but a co-trained fleet's clones stay
+    # bit-identical and bin identically.
+    from mmlspark_tpu.engine.multi_train import mapper_fingerprint
+
+    groups: dict = {}
+    solo: List[int] = []
+    for i, init in prepared.items():
+        if hasattr(requests[i].source, "iter_shards"):
+            groups.setdefault(
+                mapper_fingerprint(init.bin_mapper), []
+            ).append(i)
+        else:
+            solo.append(i)
+    for key, idxs in list(groups.items()):
+        if len(idxs) < 2:
+            solo.extend(idxs)
+            del groups[key]
+
+    def _finish_one(i: int, refit_booster) -> None:
+        try:
+            candidate = _save_candidate(
+                requests[i].champion_path, requests[i].workdir,
+                refit_booster,
+            )
+            obs.inc("loop.candidates_built")
+            results[i] = (candidate, None)
+        except BaseException as e:  # noqa: BLE001
+            results[i] = (None, e)
+
+    def _sequential(i: int) -> None:
+        req = requests[i]
+        try:
+            with obs.span("loop.refit", trees=append_trees):
+                refit_booster = warm_refit(
+                    prepared[i], req.source, workdir=req.workdir,
+                    append_trees=append_trees, params=params,
+                    chunk_rows=chunk_rows,
+                )
+            _finish_one(i, refit_booster)
+        except BaseException as e:  # noqa: BLE001
+            results[i] = (None, e)
+
+    for idxs in groups.values():
+        mjobs, mids = [], []
+        try:
+            for i in idxs:
+                init = prepared[i]
+                base = dataclasses.asdict(init.config)
+                base.update(params or {})
+                base["num_iterations"] = int(append_trees)
+                # binning pinned by the fitted mapper (the append_trees
+                # continuation contract)
+                base["max_bin"] = int(init.bin_mapper.max_bin)
+                base["categorical_feature"] = tuple(
+                    init.bin_mapper.categorical_features
+                )
+                X, y = _materialize_rows(requests[i].source)
+                mjobs.append(MultiTrainJob(
+                    params=base, train_set=Dataset(X, y),
+                    init_model=init, name=requests[i].name,
+                ))
+                mids.append(i)
+            with obs.span("loop.refit_batch", models=len(mjobs),
+                          trees=append_trees):
+                refit_boosters = multi_train(mjobs)
+        except ValueError:
+            # The stacked trainer refused (non-uniform statics, rows
+            # beyond one histogram chunk, an excluded config) — train
+            # each job the classic way instead of failing the batch.
+            obs.inc("loop.batch_fallbacks")
+            for i in idxs:
+                _sequential(i)
+            continue
+        except BaseException as e:  # noqa: BLE001
+            for i in idxs:
+                results[i] = (None, e)
+            continue
+        for i, refit_booster in zip(mids, refit_boosters):
+            _finish_one(i, refit_booster)
+
+    for i in solo:
+        _sequential(i)
+    return results

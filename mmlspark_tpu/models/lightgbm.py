@@ -399,7 +399,9 @@ class _LightGBMEstimator(Estimator, _LightGBMParams):
                 bp["early_stopping_round"] = 0
             if booster is not None:
                 bp.pop("max_bin", None)
-            booster = train(
+            # ONE model warm-started across data batches, not a fleet
+            # loop — continuation is inherently sequential
+            booster = train(  # analyze: ignore[PRF001]
                 bp, part, valid_sets=valid_sets if b == n_batches - 1 else (),
                 mesh=mesh, init_model=booster,
                 bin_mapper=bm if booster is None else None,

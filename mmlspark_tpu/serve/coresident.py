@@ -239,6 +239,25 @@ class CoResidentGroup:
             self._finalize_for(snap, name, False)(buf)
 
     # -- tenant hot swap -------------------------------------------------
+    def _inherit_execs(self, cur: _GroupSnapshot,
+                       staged: _GroupSnapshot) -> bool:
+        """Same-shape swap keeps the compiled executables BY IDENTITY:
+        the AOT program closes over nothing — ``mpf.arrays`` and
+        ``binner.arrays`` are runtime arguments — so when the staged
+        super-table lowers to the same program meta (tree/class/depth/
+        feature envelope unchanged, the common case for a warm-started
+        refit), the staged snapshot reuses the live snapshot's exec
+        dict entries instead of deserializing them again per bucket."""
+        cur_meta = _forest.multi_packed_raw_rows_meta(cur.mpf, cur.binner)
+        new_meta = _forest.multi_packed_raw_rows_meta(staged.mpf,
+                                                     staged.binner)
+        if cur_meta != new_meta:
+            return False
+        staged.execs.update(cur.execs)
+        if obs.enabled() and cur.execs:
+            obs.inc("serve.group_exec_reuse", buckets=len(cur.execs))
+        return True
+
     def prepare_swap(
         self, name: str, booster, buckets: Sequence[int] = ()
     ) -> None:
@@ -247,24 +266,47 @@ class CoResidentGroup:
         reused — no re-pack), restack the binner, and pre-warm the staged
         executables.  All of it happens OFF the serving path; the live
         snapshot keeps serving until :meth:`commit_swap`."""
+        self.prepare_swap_many({name: booster}, buckets)
+
+    def prepare_swap_many(
+        self, updates: Dict[str, object], buckets: Sequence[int] = ()
+    ) -> None:
+        """Stage replacements for SEVERAL tenants as one snapshot — the
+        landing path for a batched retrain drain: every model that came
+        out of one stacked training dispatch splices into one staged
+        super-table, so the fleet flips together in one
+        :meth:`commit_swap_many` instead of N stage/commit round-trips.
+        Same-shape swaps inherit the live snapshot's compiled
+        executables by identity (no recompile, no disk reload)."""
+        if not updates:
+            raise ValueError("prepare_swap_many needs at least one tenant")
         with self._lock:
             cur = self._snap
-            if name not in cur.mpf.names:
-                raise KeyError(f"unknown tenant {name!r}")
+            for name in updates:
+                if name not in cur.mpf.names:
+                    raise KeyError(f"unknown tenant {name!r}")
             order = list(cur.mpf.names)
             boosters = dict(cur.boosters)
-        boosters[name] = booster
-        with obs.span("serve.group_swap_stage", model=name):
-            seg = _segment_of(booster)
-            mpf = _forest.swap_multi_segment(cur.mpf, name, seg)
+        boosters.update(updates)
+        names = tuple(sorted(updates))
+        with obs.span("serve.group_swap_stage", model=",".join(names),
+                      models=len(names)):
+            mpf = cur.mpf
+            for name in names:
+                mpf = _forest.swap_multi_segment(
+                    mpf, name, _segment_of(boosters[name])
+                )
             binner = MultiDeviceBinner.from_mappers(
                 [boosters[n].bin_mapper for n in order]
             )
             staged = _GroupSnapshot(mpf, binner, boosters)
+            self._inherit_execs(cur, staged)
             if buckets:
+                # inherited buckets hit the exec dict and skip straight
+                # to warming the finalizers; new shapes still compile
                 self._prewarm_snapshot(staged, buckets)
         with self._lock:
-            self._staged = (name, staged)
+            self._staged = (names if len(names) > 1 else names[0], staged)
 
     def commit_swap(self, name: str) -> None:
         """Atomically flip the staged snapshot in.  In-flight batches
@@ -275,6 +317,20 @@ class CoResidentGroup:
             self._snap = self._staged[1]
             self._staged = None
         obs.inc("serve.group_swaps", model=name)
+
+    def commit_swap_many(self, names: Sequence[str]) -> None:
+        """Flip a multi-tenant staged snapshot (from
+        :meth:`prepare_swap_many`) atomically."""
+        key = tuple(sorted(names))
+        if len(key) == 1:
+            return self.commit_swap(key[0])
+        with self._lock:
+            if self._staged is None or self._staged[0] != key:
+                raise RuntimeError(f"no staged swap for tenants {key!r}")
+            self._snap = self._staged[1]
+            self._staged = None
+        for name in key:
+            obs.inc("serve.group_swaps", model=name)
 
     def abort_swap(self, name: str) -> None:
         with self._lock:

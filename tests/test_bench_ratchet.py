@@ -22,6 +22,9 @@ from tools import bench_ratchet as br
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 FIXTURE = os.path.join(REPO, "tests", "fixtures", "ratchet_regression")
+FIXTURE_MULTI = os.path.join(
+    REPO, "tests", "fixtures", "ratchet_regression_multi"
+)
 
 
 class TestLedgerSchemas:
@@ -93,9 +96,37 @@ class TestRatchet:
             if g["op"] == "<=":
                 assert entry["bound"] >= entry["blessed"]
             elif g["op"] == ">=":
+                mb = br._min_bound_for(g, entry["backend"])
                 assert entry["bound"] <= max(
-                    entry["blessed"], g.get("min_bound", entry["blessed"])
+                    entry["blessed"],
+                    entry["blessed"] if mb is None else mb,
                 )
+
+    def test_multi_regression_fixture_validates(self):
+        # the stacked-training regression fixture must fail on the
+        # GATE, never on schema
+        _, errors = br.load_ledgers(FIXTURE_MULTI)
+        assert errors == []
+
+    def test_multi_speedup_regression_exits_nonzero(self):
+        assert br.main(["--ledger-dir", FIXTURE_MULTI]) == 1
+
+    def test_multi_regression_is_the_speedup_gate(self):
+        # the fixture regresses ONLY the K=64 stacked speedup (below the
+        # hard per-backend floor); every other gate stays green
+        ledgers, _ = br.load_ledgers(FIXTURE_MULTI)
+        with open(br.ratchet_path(FIXTURE_MULTI)) as f:
+            ratchet = json.load(f)
+        bad = [r["id"] for r in br.evaluate(ledgers, ratchet)
+               if not r["ok"] and r["enforced"]]
+        assert bad == ["multi.speedup_k64"]
+
+    def test_min_bound_resolves_per_backend(self):
+        gate = {"min_bound": {"cpu": 2.0, "*": 5.0}}
+        assert br._min_bound_for(gate, "cpu") == 2.0
+        assert br._min_bound_for(gate, "tpu") == 5.0
+        assert br._min_bound_for({"min_bound": 10.0}, "cpu") == 10.0
+        assert br._min_bound_for({}, "cpu") is None
 
     def test_advisory_gate_never_fails_the_run(self):
         # ingest.steady_s is advisory while the ledger records

@@ -220,6 +220,142 @@ class TestControllerAdmission:
         assert st["active"] is None and st["probation"] == {}
 
 
+# ------------------------------------------- controller batch drain (19)
+def _batch_controller(**cfg_over):
+    cfg = LoopConfig(cooldown_s=300.0, queue_depth=8, **cfg_over)
+    return RetrainController(None, lambda name: None, config=cfg)
+
+
+class TestControllerBatchDrain:
+    def test_drain_pops_severity_ordered_batch(self):
+        c = _batch_controller(train_batch=3)
+        for name, sev in [("a", 0.3), ("b", 2.1), ("c", 0.8), ("d", 1.4)]:
+            assert c.request(name, severity=sev) == "accept"
+        with c._cv:
+            batch = c._drain_batch()
+        assert [j.name for j, _ in batch] == ["b", "d", "c"]
+        # the un-drained job stays queued
+        with c._cv:
+            assert [j.name for j in c._jobs] == ["a"]
+
+    def test_manual_outranks_drift_severity(self):
+        c = _batch_controller(train_batch=2)
+        assert c.request("drift", severity=9.0) == "accept"
+        assert c.request("oncall", manual=True) == "accept"
+        with c._cv:
+            batch = c._drain_batch()
+        assert [j.name for j, _ in batch] == ["oncall", "drift"]
+
+    def test_drained_jobs_report_duplicate_until_finished(self):
+        # admission verdicts are unchanged by batching: a job that left
+        # the queue but is still being processed is a duplicate, and
+        # cooldown still debounces non-manual re-requests
+        c = _batch_controller(train_batch=2)
+        assert c.request("m", severity=1.0) == "accept"
+        assert c.request("n", severity=0.5) == "accept"
+        with c._cv:
+            c._drain_batch()
+        assert c.request("m", severity=3.0) == "duplicate"
+        assert c.request("n", severity=3.0) == "duplicate"
+        with c._cv:
+            c._last_retrain["cool"] = time.monotonic()
+        assert c.request("cool", severity=1.0) == "cooldown"
+        assert c.request("cool", manual=True) == "accept"
+
+    def test_worker_processes_partial_batch_after_window(self):
+        # two jobs arrive inside the linger window, fewer than
+        # train_batch: the worker must NOT wait forever for a full
+        # batch — it drains what it has when the window closes
+        c = _batch_controller(train_batch=3, batch_window_s=0.15)
+        batches = []
+        done = threading.Event()
+
+        def record(batch):
+            batches.append([j.name for j, _ in batch])
+            done.set()
+
+        c._process_batch = record
+        c.start()
+        try:
+            assert c.request("lo", severity=0.5) == "accept"
+            assert c.request("hi", severity=1.5) == "accept"
+            assert done.wait(timeout=5.0)
+        finally:
+            c.stop()
+        assert batches == [["hi", "lo"]]
+
+    def test_worker_drains_full_batch_as_one(self):
+        c = _batch_controller(train_batch=3, batch_window_s=10.0)
+        batches = []
+        done = threading.Event()
+
+        def record(batch):
+            batches.append([j.name for j, _ in batch])
+            done.set()
+
+        c._process_batch = record
+        c.start()
+        try:
+            # a FULL batch must not sit out the (long) linger window
+            for name, sev in [("a", 0.1), ("b", 0.2), ("c", 0.3)]:
+                assert c.request(name, severity=sev) == "accept"
+            assert done.wait(timeout=5.0)
+        finally:
+            c.stop()
+        assert batches == [["c", "b", "a"]]
+
+    def test_singleton_batch_size_one_config_matches_legacy(self):
+        # train_batch=1 must behave exactly like the pre-batching
+        # controller: one job per drain, no linger
+        c = _batch_controller(train_batch=1)
+        assert c.request("x", severity=1.0) == "accept"
+        assert c.request("y", severity=2.0) == "accept"
+        with c._cv:
+            batch = c._drain_batch()
+        assert [j.name for j, _ in batch] == ["y"]
+        with c._cv:
+            assert [j.name for j in c._jobs] == ["x"]
+
+
+class TestBatchedSwapExecIdentity:
+    def test_same_shape_prepare_swap_many_inherits_executables(self):
+        # the landing path for a batched retrain: when the staged
+        # super-table lowers to the same program meta, the staged
+        # snapshot's per-bucket executables are the LIVE snapshot's
+        # objects by identity — no retrace, no recompile, no disk load
+        from mmlspark_tpu.engine.booster import Dataset, train
+        from mmlspark_tpu.serve.coresident import CoResidentGroup
+
+        rng = np.random.default_rng(7)
+        params = {"objective": "regression", "num_iterations": 4,
+                  "num_leaves": 4, "min_data_in_leaf": 3}
+
+        def mk(seed):
+            r = np.random.default_rng(seed)
+            X = r.normal(size=(120, N_FEATURES))
+            y = X[:, 0] + 0.2 * r.normal(size=120)
+            return train(params, Dataset(X, y))
+
+        group = CoResidentGroup([("t0", mk(1)), ("t1", mk(2))])
+        B = 16
+        group.prewarm([B])
+        cur = group._snap
+        assert B in cur.execs
+        # stage the same-geometry boosters back in (the same-shape case)
+        group.prepare_swap_many({"t0": mk(1), "t1": mk(2)}, buckets=[B])
+        staged = group._staged[1]
+        assert staged.execs[B] is cur.execs[B], (
+            "same-shape staged snapshot must reuse the live executable "
+            "by identity"
+        )
+        group.commit_swap_many(["t0", "t1"])
+        # post-flip the group still answers on the inherited program
+        X = rng.normal(size=(B, group.feature_dim)).astype(np.float32)
+        mids = np.zeros(B, np.int32)
+        out = group.predict_mixed(X, mids)
+        assert np.isfinite(np.asarray(out)).all()
+
+
 # --------------------------------------------------------------- refit
 class TestWarmRefit:
     def test_appends_trees_with_binning_pinned(self, champion, tmp_path):

@@ -1,0 +1,260 @@
+"""Tier-1 gates for stacked many-model training (engine/multi_train.py,
+ISSUE 19).
+
+The contract under test: K boosters sharing one binning authority train
+in ONE XLA program — one trace regardless of K — and every model comes
+out bitwise-identical to its standalone ``train()`` run under the same
+pinned mapper (predictions AND raw leaf values), including the
+categorical, warm-start, feature-fraction, and mixed-iteration legs.
+Wall-clock speedup is the bench's job (tools/bench_multi_train.py);
+these tests pin mechanism and parity only.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu.engine import multi_train as mt
+from mmlspark_tpu.engine.booster import Dataset, TrainConfig, train
+
+
+def make_ds(n, f=6, seed=0, cat=False, binary=False, weighted=False):
+    r = np.random.default_rng(seed)
+    X = r.normal(size=(n, f))
+    if cat:
+        X[:, 0] = r.integers(0, 5, size=n)
+    raw = X[:, 1] + 0.5 * X[:, 2] ** 2 + r.normal(scale=0.1, size=n)
+    y = (raw > 0.4).astype(float) if binary else raw
+    w = 0.5 + r.random(n) if weighted else None
+    return Dataset(X, y, weight=w)
+
+
+def assert_stack_matches_standalone(jobs, mapper):
+    """Every stacked model must equal its standalone train() bitwise."""
+    stacked = mt.multi_train(jobs, bin_mapper=mapper)
+    assert len(stacked) == len(jobs)
+    for i, (job, b) in enumerate(zip(jobs, stacked)):
+        ref = train(job.params, job.train_set, init_model=job.init_model)
+        X = np.asarray(job.train_set.X)
+        pa = np.asarray(b.predict(X))
+        pb = np.asarray(ref.predict(X))
+        assert pa.tobytes() == pb.tobytes(), (
+            f"model {i}: predict diverged, "
+            f"maxdiff={np.abs(pa - pb).max()}"
+        )
+        lv_a = np.asarray(b.trees.leaf_value)
+        lv_b = np.asarray(ref.trees.leaf_value)
+        assert lv_a.shape == lv_b.shape, f"model {i}: tree count differs"
+        assert lv_a.tobytes() == lv_b.tobytes(), (
+            f"model {i}: leaf values diverged"
+        )
+    return stacked
+
+
+BASE = {
+    "objective": "regression", "num_leaves": 7, "num_iterations": 6,
+    "learning_rate": 0.1, "min_data_in_leaf": 5, "seed": 3,
+}
+
+
+class TestBitwiseParity:
+    def test_mixed_iteration_counts_and_row_counts(self):
+        # distinct n per job (the fleet-of-shapes case) AND distinct
+        # num_iterations — shorter jobs are masked on device and their
+        # surplus trees sliced off on host
+        datasets = [make_ds(n, seed=s)
+                    for n, s in [(160, 1), (223, 2), (301, 3)]]
+        mapper = mt.fit_shared_mapper(datasets, BASE)
+        jobs = [
+            mt.MultiTrainJob(
+                dict(BASE, seed=3 + i, num_iterations=[6, 4, 6][i]), ds
+            )
+            for i, ds in enumerate(datasets)
+        ]
+        assert_stack_matches_standalone(jobs, mapper)
+
+    def test_categorical_binary_is_unbalance(self):
+        params = dict(
+            BASE, objective="binary", num_iterations=5,
+            categorical_feature=[0], is_unbalance=True,
+        )
+        datasets = [make_ds(n, seed=s, cat=True, binary=True)
+                    for n, s in [(200, 11), (263, 12), (310, 13)]]
+        mapper = mt.fit_shared_mapper(datasets, params)
+        jobs = [
+            mt.MultiTrainJob(dict(params, seed=5 + i, bagging_seed=20 + i),
+                             ds)
+            for i, ds in enumerate(datasets)
+        ]
+        assert_stack_matches_standalone(jobs, mapper)
+
+    def test_warm_start_continuation(self):
+        params = dict(BASE, num_iterations=4)
+        datasets = [make_ds(n, seed=s) for n, s in [(220, 21), (300, 22)]]
+        mapper = mt.fit_shared_mapper(datasets, params)
+        bases = []
+        for i, ds in enumerate(datasets):
+            p = dict(params, seed=2 + i)
+            ds.pin_mapper(mapper, TrainConfig.from_params(dict(p)))
+            bases.append(train(p, ds))
+        jobs = [
+            mt.MultiTrainJob(
+                dict(params, seed=2 + i, num_iterations=[4, 2][i]),
+                ds, init_model=bases[i],
+            )
+            for i, ds in enumerate(datasets)
+        ]
+        assert_stack_matches_standalone(jobs, mapper)
+
+    def test_warm_start_mapper_inferred_from_init_models(self):
+        # bin_mapper may be omitted when every job warm-starts from
+        # boosters that share one authority
+        params = dict(BASE, num_iterations=3)
+        datasets = [make_ds(n, seed=s) for n, s in [(180, 41), (240, 42)]]
+        mapper = mt.fit_shared_mapper(datasets, params)
+        bases = []
+        for i, ds in enumerate(datasets):
+            p = dict(params, seed=4 + i)
+            ds.pin_mapper(mapper, TrainConfig.from_params(dict(p)))
+            bases.append(train(p, ds))
+        jobs = [
+            mt.MultiTrainJob(dict(params, seed=4 + i), ds,
+                             init_model=bases[i])
+            for i, ds in enumerate(datasets)
+        ]
+        stacked = mt.multi_train(jobs)  # no bin_mapper argument
+        for job, b in zip(jobs, stacked):
+            ref = train(job.params, job.train_set,
+                        init_model=job.init_model)
+            X = np.asarray(job.train_set.X)
+            assert (np.asarray(b.predict(X)).tobytes()
+                    == np.asarray(ref.predict(X)).tobytes())
+
+    def test_feature_fraction(self):
+        params = dict(BASE, feature_fraction=0.5, feature_fraction_seed=9)
+        datasets = [make_ds(n, f=8, seed=s)
+                    for n, s in [(150, 31), (256, 32)]]
+        mapper = mt.fit_shared_mapper(datasets, params)
+        jobs = [mt.MultiTrainJob(dict(params, seed=7 + i), ds)
+                for i, ds in enumerate(datasets)]
+        assert_stack_matches_standalone(jobs, mapper)
+
+
+class TestOneProgram:
+    def test_k64_one_trace_one_dispatch(self):
+        # 64 models, 64 DISTINCT row counts, exactly ONE new trace of
+        # the stacked program — the acceptance pin for "one XLA program
+        # regardless of K".  Parity is spot-checked (full-K parity at
+        # bench scale lives in tools/bench_multi_train.py).
+        params = dict(BASE, num_iterations=3)
+        datasets = [
+            make_ds(64 + ((i * 37) % 64) * 2, f=4, seed=100 + i)
+            for i in range(64)
+        ]
+        mapper = mt.fit_shared_mapper(datasets, params)
+        jobs = [mt.MultiTrainJob(dict(params, seed=50 + i), ds)
+                for i, ds in enumerate(datasets)]
+        before = len(mt._TRACE_EVENTS)
+        stacked = mt.multi_train(jobs, bin_mapper=mapper)
+        new = mt._TRACE_EVENTS[before:]
+        assert len(new) == 1, f"expected one trace for K=64, got {new}"
+        assert new[0][0] == 64
+        assert len(stacked) == 64
+        for i in (0, 29, 63):
+            ref = train(jobs[i].params, jobs[i].train_set)
+            X = np.asarray(jobs[i].train_set.X)
+            assert (np.asarray(stacked[i].predict(X)).tobytes()
+                    == np.asarray(ref.predict(X)).tobytes()), i
+
+    def test_program_cache_reuse_no_retrace(self):
+        # a second stack with identical statics+shapes but different
+        # data/seeds must reuse the cached executable — zero new traces
+        params = dict(BASE, num_iterations=3)
+
+        def stack(seed0):
+            datasets = [make_ds(n, seed=seed0 + s)
+                        for n, s in [(130, 1), (190, 2)]]
+            mapper = mt.fit_shared_mapper(datasets, params)
+            jobs = [mt.MultiTrainJob(dict(params, seed=seed0 + i), ds)
+                    for i, ds in enumerate(datasets)]
+            return mt.multi_train(jobs, bin_mapper=mapper)
+
+        stack(700)  # may trace (cold for this shape)
+        before = len(mt._TRACE_EVENTS)
+        stack(900)
+        assert len(mt._TRACE_EVENTS) == before, "stacked program retraced"
+
+
+class TestValidation:
+    def _two_jobs(self, params_a, params_b=None, ds_kw_a=None,
+                  ds_kw_b=None):
+        da = make_ds(140, seed=61, **(ds_kw_a or {}))
+        db = make_ds(200, seed=62, **(ds_kw_b or {}))
+        mapper = mt.fit_shared_mapper([da, db], params_a)
+        return [
+            mt.MultiTrainJob(params_a, da),
+            mt.MultiTrainJob(params_b or dict(params_a, seed=9), db),
+        ], mapper
+
+    def test_empty_jobs_is_a_noop(self):
+        assert mt.multi_train([], bin_mapper=None) == []
+
+    def test_bagging_rejected(self):
+        jobs, mapper = self._two_jobs(
+            dict(BASE, bagging_freq=1, bagging_fraction=0.8)
+        )
+        with pytest.raises(ValueError, match="bagging"):
+            mt.multi_train(jobs, bin_mapper=mapper)
+
+    def test_dart_rejected(self):
+        jobs, mapper = self._two_jobs(dict(BASE, boosting="dart"))
+        with pytest.raises(ValueError, match="gbdt"):
+            mt.multi_train(jobs, bin_mapper=mapper)
+
+    def test_early_stopping_rejected(self):
+        jobs, mapper = self._two_jobs(dict(BASE, early_stopping_round=5))
+        with pytest.raises(ValueError, match="early_stopping"):
+            mt.multi_train(jobs, bin_mapper=mapper)
+
+    def test_mixed_statics_rejected(self):
+        # num_leaves is shape-determining: jobs may not disagree on it
+        jobs, mapper = self._two_jobs(
+            dict(BASE), dict(BASE, num_leaves=15)
+        )
+        with pytest.raises(ValueError, match="static config field"):
+            mt.multi_train(jobs, bin_mapper=mapper)
+
+    def test_rows_beyond_one_chunk_rejected(self):
+        jobs, mapper = self._two_jobs(dict(BASE, hist_chunk=128))
+        with pytest.raises(ValueError, match="histogram chunk"):
+            mt.multi_train(jobs, bin_mapper=mapper)
+
+    def test_mixed_weight_presence_rejected(self):
+        jobs, mapper = self._two_jobs(
+            dict(BASE), ds_kw_a={"weighted": True}
+        )
+        with pytest.raises(ValueError, match="weights"):
+            mt.multi_train(jobs, bin_mapper=mapper)
+
+    def test_missing_shared_mapper_rejected(self):
+        da = make_ds(140, seed=71)
+        db = make_ds(200, seed=72)
+        jobs = [mt.MultiTrainJob(dict(BASE), da),
+                mt.MultiTrainJob(dict(BASE, seed=9), db)]
+        # cold jobs, no bin_mapper, no init models to infer one from
+        with pytest.raises(ValueError, match="binning authority"):
+            mt.multi_train(jobs)
+
+    def test_mapper_fingerprint_is_content_equality(self):
+        ds = [make_ds(150, seed=81), make_ds(210, seed=82)]
+        m1 = mt.fit_shared_mapper(ds, dict(BASE))
+        fp1 = mt.mapper_fingerprint(m1)
+        # a different fit over different rows is a different authority
+        m2 = mt.fit_shared_mapper([make_ds(300, seed=99)], dict(BASE))
+        assert fp1 != mt.mapper_fingerprint(m2)
+        # refitting the same pooled rows reproduces the fingerprint
+        m3 = mt.fit_shared_mapper(ds, dict(BASE))
+        assert fp1 == mt.mapper_fingerprint(m3)

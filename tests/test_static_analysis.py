@@ -19,6 +19,7 @@ from tools.analyze.collectives import check_collectives_file
 from tools.analyze.common import Finding, apply_suppressions
 from tools.analyze.hygiene import check_hygiene_file
 from tools.analyze.obs_rules import check_obs, check_obs_file
+from tools.analyze.perf_rules import check_perf, check_perf_file
 from tools.analyze.predict_rules import check_predict, check_predict_file
 from tools.analyze.quantize_rules import check_quantize_file
 from tools.analyze.serving_rules import check_serving, check_serving_file
@@ -1247,6 +1248,67 @@ def test_pred001_suppression_marks_sanctioned_conversions(tmp_path):
     assert apply_suppressions(check_predict_file(p)) == []
 
 
+# ------------------------------------------------------------------- PRF001
+
+
+def test_prf001_train_loop_over_models(tmp_path):
+    p = _write(str(tmp_path / "fleet.py"), """
+        def retrain_fleet(jobs):
+            out = []
+            for job in jobs:
+                out.append(train(job.params, job.train_set))
+            return out
+        def stream_fleet(sources, params):
+            models = []
+            while sources:
+                src = sources.pop()
+                models.append(engine.train_streaming(params, src))
+            return models
+    """)
+    found = check_perf_file(p)
+    assert rules(found) == ["PRF001"] * 2
+    assert "multi_train" in found[0].message
+
+
+def test_prf001_silent_on_single_dispatch(tmp_path):
+    p = _write(str(tmp_path / "ok.py"), """
+        from mmlspark_tpu.engine.multi_train import MultiTrainJob, multi_train
+        def retrain_fleet(jobs, mapper):
+            mjobs = [MultiTrainJob(j.params, j.train_set) for j in jobs]
+            return multi_train(mjobs, bin_mapper=mapper)
+        def one_model(params, ds):
+            for attempt in range(3):
+                prepare(attempt)
+            return train(params, ds)
+    """)
+    assert check_perf_file(p) == []
+
+
+def test_prf001_suppression_round_trip(tmp_path):
+    p = _write(str(tmp_path / "fallback.py"), """
+        def refit_sequentially(jobs):
+            for job in jobs:
+                # deliberate degradation path when stacking is refused
+                yield train(job.params, job.train_set)  # analyze: ignore[PRF001]
+    """)
+    raw = check_perf_file(p)
+    assert rules(raw) == ["PRF001"]
+    assert apply_suppressions(raw) == []
+
+
+def test_prf001_scope_is_library_only(tmp_path):
+    src = """
+        def bench(jobs):
+            for job in jobs:
+                train(job.params, job.train_set)
+    """
+    _write(str(tmp_path / "tools" / "bench.py"), src)
+    fires = _write(str(tmp_path / "mmlspark_tpu" / "loop" / "x.py"), src)
+    found = check_perf(str(tmp_path))
+    assert rules(found) == ["PRF001"]
+    assert found[0].file == fires
+
+
 # ------------------------------------------------------------------- CLI
 
 
@@ -2426,13 +2488,13 @@ def test_don_real_tree_is_clean():
 
 
 def test_full_run_wall_time_budget():
-    """All fourteen passes (index built once) stay under the 15s CI
+    """All fifteen passes (index built once) stay under the 15s CI
     budget, and the timings out-param attributes the wall per pass."""
     import time as _time
 
     from tools.analyze import PASSES
 
-    assert len(PASSES) == 14
+    assert len(PASSES) == 15
     timings = {}
     t0 = _time.monotonic()
     run_all(repo_root(), timings=timings)

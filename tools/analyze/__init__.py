@@ -1,8 +1,8 @@
 """Repo-native static-analysis suite (see README.md in this directory).
 
-Fourteen passes over a shared project index (built once per run by
-:mod:`tools.analyze.engine`): the nine per-file-portable passes (ABI,
-collectives, tracer, hygiene, obs, serving, predict, quantize,
+Fifteen passes over a shared project index (built once per run by
+:mod:`tools.analyze.engine`): the ten per-file-portable passes (ABI,
+collectives, tracer, hygiene, obs, serving, predict, perf, quantize,
 ingest) plus the
 index-native interprocedural passes (collective order COL005/COL006,
 serve-layer locks LCK001–003, dtype-contract flow DTY001, determinism
@@ -29,6 +29,7 @@ from tools.analyze.common import (
 from tools.analyze.hygiene import check_hygiene
 from tools.analyze.ingest_rules import check_ingest
 from tools.analyze.obs_rules import check_obs
+from tools.analyze.perf_rules import check_perf
 from tools.analyze.predict_rules import check_predict
 from tools.analyze.quantize_rules import check_quantize
 from tools.analyze.serving_rules import check_serving
@@ -38,7 +39,7 @@ __all__ = [
     "Finding", "run_all", "repo_root", "PASSES",
     "check_abi", "check_collectives", "check_tracer", "check_hygiene",
     "check_obs", "check_serving", "check_predict", "check_quantize",
-    "check_ingest",
+    "check_ingest", "check_perf",
 ]
 
 
@@ -95,6 +96,8 @@ PASSES = {
                 {"SRV001", "SRV002", "LOOP001"}),
     "predict": (lambda root, index: check_predict(root, index=index),
                 {"PRED001"}),
+    "perf": (lambda root, index: check_perf(root, index=index),
+             {"PRF001"}),
     "quantize": (lambda root, index: check_quantize(root, index=index),
                  {"QNT001"}),
     "ingest": (lambda root, index: check_ingest(root, index=index),

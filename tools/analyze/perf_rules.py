@@ -1,0 +1,85 @@
+"""Pass — fleet-training dispatch hygiene.
+
+Rules
+-----
+- PRF001: a Python ``for``/``while`` loop in library code that calls
+  ``train()`` / ``train_streaming()`` once per iteration.  Looping the
+  trainer over a model collection pays one trace + compile + dispatch
+  PER MODEL (every distinct row count is a distinct XLA program) — the
+  overhead ``engine.multi_train`` exists to remove by stacking the
+  fleet into ONE jitted program.  Deliberate sequential fallbacks (the
+  batched-refit degradation path, checkpointed big-model fits) are
+  marked ``# analyze: ignore[PRF001]``.
+
+Scope: modules under ``mmlspark_tpu/``.  Tools and tests are exempt —
+benches loop the trainer on purpose (the sequential baseline is the
+measurement).
+"""
+
+from __future__ import annotations
+
+import ast
+import glob
+import os
+
+from tools.analyze.common import Finding
+
+_TRAIN_CALLS = {"train", "train_streaming"}
+
+
+def _callee_name(call: ast.Call):
+    if isinstance(call.func, ast.Name):
+        return call.func.id
+    if isinstance(call.func, ast.Attribute):
+        return call.func.attr
+    return None
+
+
+def check_perf_file(path: str, tree=None) -> list:
+    if tree is None:
+        try:
+            with open(path, encoding="utf-8", errors="replace") as fh:
+                tree = ast.parse(fh.read(), filename=path)
+        except SyntaxError:
+            return []
+    findings = []
+    for node in ast.walk(tree):
+        if not isinstance(node, (ast.For, ast.AsyncFor, ast.While)):
+            continue
+        for sub in ast.walk(node):
+            if (
+                isinstance(sub, ast.Call)
+                and _callee_name(sub) in _TRAIN_CALLS
+            ):
+                findings.append(
+                    Finding(
+                        path, sub.lineno, "PRF001",
+                        f"{_callee_name(sub)}() called per loop iteration "
+                        "— a fleet trained one model at a time pays one "
+                        "trace+compile+dispatch per model; stack the jobs "
+                        "through engine.multi_train (one program, one "
+                        "dispatch), or mark a deliberate sequential "
+                        "fallback with # analyze: ignore[PRF001]",
+                    )
+                )
+    # a call inside nested loops would report once per enclosing loop
+    seen, out = set(), []
+    for f in findings:
+        k = (f.file, f.line)
+        if k not in seen:
+            seen.add(k)
+            out.append(f)
+    return out
+
+
+def check_perf(root: str, index=None) -> list:
+    findings: list = []
+    if index is not None:
+        for mi in index.package_modules():
+            findings.extend(check_perf_file(mi.path, tree=mi.tree))
+        return findings
+    pkg = os.path.join(root, "mmlspark_tpu")
+    for py in sorted(glob.glob(os.path.join(pkg, "**", "*.py"),
+                               recursive=True)):
+        findings.extend(check_perf_file(py))
+    return findings

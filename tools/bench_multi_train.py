@@ -1,0 +1,385 @@
+"""Stacked many-model training bench: K boosters, ONE XLA dispatch.
+
+The workload is the retrain queue's shape: K small tenants, every one a
+different row count (real traffic windows never agree), all sharing one
+binning authority.  Two ways to train the fleet:
+
+- **sequential** — K standalone ``train()`` calls.  Every distinct row
+  count is a distinct XLA program, so the baseline pays K traces + K
+  compiles + K dispatches; that per-shape overhead IS what the bench
+  measures, because it is what the one-at-a-time retrain drain pays in
+  production.
+- **stacked** — ONE ``engine.multi_train`` call: pad to a common
+  ``(K, N, F)`` stack, trace once, compile once, dispatch once.
+
+Parity is a hard gate in every mode: each stacked model must be
+BITWISE-identical (predictions and leaf tables) to its sequential twin.
+One-dispatch is asserted from the ``train.multi.dispatches`` counter,
+one-program from the module's trace ledger.
+
+The e2e leg replays the queue-to-fleet story hermetically: two
+simultaneous drift alarms enter a :class:`RetrainController` queue,
+``_drain_batch`` pops both severity-ordered, their warm-start refits
+ride one stacked dispatch, and the fresh models hot-swap into a live
+:class:`CoResidentGroup` via ``prepare_swap_many``/``commit_swap_many``
+while pump threads hammer ``predict_mixed`` — zero errors allowed (the
+in-process equivalent of the serving bench's zero-5xx gate).
+
+The report is written as ``MULTI_TRAIN_BENCH.json`` (schema- and
+gate-checked by ``tools.bench_ratchet``).  ``--smoke`` shrinks the run
+(K=8 only) and exits non-zero unless every mechanism gate holds; the
+speedup gate is advisory on cpu in smoke mode (CI boxes are noisy) and
+ratcheted on the committed full-run ledger instead.
+
+Usage::
+
+    JAX_PLATFORMS=cpu python -m tools.bench_multi_train [--smoke]
+        [--json PATH] [--iters N] [--seed K]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+
+import numpy as np
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+N_FEATURES = 8
+
+
+def _log(*a):
+    print("[multi_train]", *a, flush=True)
+
+
+def _counter(snapshot, prefix) -> float:
+    return float(sum(
+        v for k, v in snapshot.get("counters", {}).items()
+        if k == prefix or k.startswith(prefix + "{")
+    ))
+
+
+def _tenant_rows(k: int, i: int) -> int:
+    # 37 is coprime with 64, so up to K=64 every tenant gets a DISTINCT
+    # row count — the fleet-of-shapes workload the stacking removes.
+    # The spread is kept narrow (≤1.7x) so the bench isolates the
+    # per-shape trace+compile+dispatch overhead rather than charging
+    # the stacked path for padding every tenant to the widest window.
+    return 768 + ((i * 37) % 64) * 8
+
+
+def _make_dataset(rows: int, seed: int):
+    from mmlspark_tpu.engine.booster import Dataset
+
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(rows, N_FEATURES))
+    y = X[:, 1] + 0.5 * X[:, 2] ** 2 + 0.1 * rng.normal(size=rows)
+    return Dataset(X, y)
+
+
+def _base_params(iters: int) -> dict:
+    return {
+        "objective": "regression",
+        "num_leaves": 15,
+        "num_iterations": iters,
+        "learning_rate": 0.1,
+        "min_data_in_leaf": 5,
+    }
+
+
+# --------------------------------------------------------------------------
+# stacked vs sequential
+# --------------------------------------------------------------------------
+def run_stack_leg(k: int, iters: int, seed: int) -> dict:
+    from mmlspark_tpu import obs
+    from mmlspark_tpu.engine import multi_train as mt
+    from mmlspark_tpu.engine.booster import TrainConfig, train
+
+    params = _base_params(iters)
+    datasets = [
+        _make_dataset(_tenant_rows(k, i), seed * 1000 + i) for i in range(k)
+    ]
+    mapper = mt.fit_shared_mapper(datasets, params)
+    jobs = []
+    for i, ds in enumerate(datasets):
+        p = dict(params, seed=seed + i, bagging_seed=31 + i)
+        jobs.append(mt.MultiTrainJob(p, ds, name=f"tenant-{i}"))
+        # binning is identical work on both paths — do it once, outside
+        # both timers, so the clocks compare TRAINING alone
+        ds.pin_mapper(mapper, TrainConfig.from_params(dict(p)))
+        ds.binned(mapper)
+
+    _log(f"K={k}: sequential baseline ({k} shapes, {k} programs)...")
+    t0 = time.perf_counter()
+    seq = [train(j.params, j.train_set) for j in jobs]
+    sequential_s = time.perf_counter() - t0
+
+    _log(f"K={k}: stacked (one program, one dispatch)...")
+    snap0 = obs.snapshot()
+    t0 = time.perf_counter()
+    stacked = mt.multi_train(jobs, bin_mapper=mapper)
+    stacked_s = time.perf_counter() - t0
+    dispatches = int(
+        _counter(obs.snapshot(), "train.multi.dispatches")
+        - _counter(snap0, "train.multi.dispatches")
+    )
+
+    parity = True
+    for job, a, b in zip(jobs, stacked, seq):
+        X = np.asarray(job.train_set.X)
+        pa, pb = np.asarray(a.predict(X)), np.asarray(b.predict(X))
+        la = np.asarray(a.trees.leaf_value)
+        lb = np.asarray(b.trees.leaf_value)
+        if pa.tobytes() != pb.tobytes() or la.tobytes() != lb.tobytes():
+            parity = False
+            _log(f"  PARITY MISS {job.name}: "
+                 f"maxdiff={np.abs(pa - pb).max()}")
+    speedup = sequential_s / stacked_s if stacked_s > 0 else 0.0
+    res = {
+        "k": k,
+        "iters": iters,
+        "rows_total": int(sum(_tenant_rows(k, i) for i in range(k))),
+        "sequential_s": round(sequential_s, 4),
+        "stacked_s": round(stacked_s, 4),
+        "speedup": round(speedup, 3),
+        "parity_bitwise": parity,
+        "dispatches": dispatches,
+    }
+    _log(f"K={k}: seq={sequential_s:.2f}s stacked={stacked_s:.2f}s "
+         f"speedup={speedup:.2f}x parity={parity} "
+         f"dispatches={dispatches}")
+    return res
+
+
+# --------------------------------------------------------------------------
+# e2e: alarms -> batched drain -> one dispatch -> fleet hot swap
+# --------------------------------------------------------------------------
+class _GroupPump:
+    """Background threads hammering ``predict_mixed`` across the swap —
+    an exception here is the in-process 5xx."""
+
+    def __init__(self, group, X, mids, clients=2):
+        self.requests = 0
+        self.errors = 0
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._group, self._X, self._mids = group, X, mids
+        self._threads = [
+            threading.Thread(target=self._work, daemon=True)
+            for _ in range(clients)
+        ]
+        for t in self._threads:
+            t.start()
+
+    def _work(self):
+        while not self._stop.is_set():
+            try:
+                out = self._group.predict_mixed(self._X, self._mids)
+                ok = bool(np.isfinite(out).all())
+            except Exception:
+                ok = False
+            with self._lock:
+                self.requests += 1
+                if not ok:
+                    self.errors += 1
+
+    def stop(self) -> dict:
+        self._stop.set()
+        for t in self._threads:
+            t.join(timeout=30)
+        return {"requests": self.requests, "errors": self.errors}
+
+
+def run_e2e_leg(iters: int, seed: int) -> dict:
+    from mmlspark_tpu import obs
+    from mmlspark_tpu.engine import multi_train as mt
+    from mmlspark_tpu.engine.booster import Dataset, TrainConfig, train
+    from mmlspark_tpu.loop.controller import LoopConfig, RetrainController
+    from mmlspark_tpu.serve.coresident import CoResidentGroup
+
+    names = [f"tenant-{i}" for i in range(4)]
+    params = _base_params(iters)
+    datasets = {
+        n: _make_dataset(384 + 64 * i, seed * 77 + i)
+        for i, n in enumerate(names)
+    }
+    mapper = mt.fit_shared_mapper(list(datasets.values()), params)
+    champions = {}
+    for i, n in enumerate(names):
+        p = dict(params, seed=seed + i)
+        datasets[n].pin_mapper(mapper, TrainConfig.from_params(dict(p)))
+        champions[n] = train(p, datasets[n])
+
+    B = 64
+    group = CoResidentGroup([(n, champions[n]) for n in names])
+    group.prewarm([B])
+    Xb = np.zeros((B, group.feature_dim), np.float32)
+    Xb[:, :] = np.resize(
+        np.asarray(datasets[names[0]].X, np.float32), Xb.shape
+    )
+    mids = np.arange(B, dtype=np.int32) % len(names)
+    pump = _GroupPump(group, Xb, mids)
+
+    # Two simultaneous drift alarms; the queue drains them as ONE batch,
+    # severity first (the controller's admission path, no worker thread
+    # — the bench drives the drain synchronously).
+    controller = RetrainController(
+        app=None, data_provider=lambda n: None,
+        config=LoopConfig(train_batch=4, batch_window_s=0.0),
+    )
+    v1 = controller.request("tenant-1", reason="feature_drift",
+                            severity=0.8)
+    v2 = controller.request("tenant-3", reason="feature_drift",
+                            severity=2.1)
+    batch = controller._drain_batch()
+    drained = [job.name for job, _ in batch]
+    severity_ordered = drained == ["tenant-3", "tenant-1"]
+
+    # Warm-start refit of the drained tenants on their fresh (shifted)
+    # windows — ONE stacked dispatch for the whole batch.
+    rng = np.random.default_rng(seed + 999)
+    jobs = []
+    for n in drained:
+        Xf = rng.normal(size=(448, N_FEATURES)) + 1.5
+        yf = Xf[:, 1] + 0.5 * Xf[:, 2] ** 2
+        i = names.index(n)
+        jobs.append(mt.MultiTrainJob(
+            dict(params, seed=seed + i, num_iterations=max(4, iters // 2)),
+            Dataset(Xf, yf), init_model=champions[n], name=n,
+        ))
+    snap0 = obs.snapshot()
+    refit = mt.multi_train(jobs, bin_mapper=mapper)
+    batched_dispatches = int(
+        _counter(obs.snapshot(), "train.multi.dispatches")
+        - _counter(snap0, "train.multi.dispatches")
+    )
+
+    # Hot-swap the whole batch into the serving group under traffic.
+    updates = {n: b for n, b in zip(drained, refit)}
+    group.prepare_swap_many(updates, buckets=[B])
+    group.commit_swap_many(drained)
+    time.sleep(0.5)  # post-swap traffic must drain clean
+    traffic = pump.stop()
+
+    # Post-swap parity: the group now serves the refit booster bitwise.
+    n0 = drained[0]
+    rows = np.asarray(datasets[n0].X)[:B]
+    Xs = np.zeros((B, group.feature_dim), np.float32)
+    Xs[: rows.shape[0], : rows.shape[1]] = rows
+    ms = np.full(B, group.model_id(n0), np.int32)
+    got = group.predict_mixed(Xs, ms)[: rows.shape[0], 0]
+    padded = np.zeros((B, rows.shape[1]))
+    padded[: rows.shape[0]] = rows
+    want = np.asarray(
+        updates[n0].predict_padded(padded, rows.shape[0]), np.float32
+    )
+    swap_parity = bool(np.array_equal(got, want))
+
+    e2e = {
+        "alarms": 2,
+        "verdicts": [v1, v2],
+        "batch": drained,
+        "severity_ordered": bool(severity_ordered),
+        "batched_dispatches": batched_dispatches,
+        "swap_parity": swap_parity,
+        **traffic,
+    }
+    _log(f"e2e: batch={drained} dispatches={batched_dispatches} "
+         f"requests={traffic['requests']} errors={traffic['errors']} "
+         f"swap_parity={swap_parity}")
+    return e2e
+
+
+# --------------------------------------------------------------------------
+# CLI
+# --------------------------------------------------------------------------
+def run(args) -> int:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import jax
+
+    from mmlspark_tpu import obs
+
+    obs.enable()
+    backend = jax.default_backend()
+    ks = [8] if args.smoke else [8, 64]
+    report = {
+        "bench": "multi_train",
+        "backend": backend,
+        "config": {
+            "ks": ks,
+            "iters": args.iters,
+            "seed": args.seed,
+            "smoke": bool(args.smoke),
+            "n_features": N_FEATURES,
+        },
+        "results": [run_stack_leg(k, args.iters, args.seed) for k in ks],
+    }
+    report["e2e"] = run_e2e_leg(args.iters, args.seed)
+
+    floor = 2.0 if backend == "cpu" else 5.0
+    speedup_ok = all(r["speedup"] >= floor for r in report["results"])
+    report["gates"] = {
+        "parity_bitwise": all(
+            r["parity_bitwise"] for r in report["results"]
+        ),
+        "one_dispatch_per_stack": all(
+            r["dispatches"] == 1 for r in report["results"]
+        ),
+        "speedup_ok": bool(speedup_ok),
+        "speedup_floor": floor,
+        "e2e_zero_errors": (
+            report["e2e"]["errors"] == 0 and report["e2e"]["requests"] > 0
+        ),
+        "e2e_one_dispatch": report["e2e"]["batched_dispatches"] == 1,
+        "e2e_batched": len(report["e2e"]["batch"]) >= 2,
+        "e2e_severity_ordered": report["e2e"]["severity_ordered"],
+        "e2e_swap_parity": report["e2e"]["swap_parity"],
+    }
+
+    out = json.dumps(report, indent=2, default=str)
+    if args.json_path:
+        with open(args.json_path, "w") as f:
+            f.write(out + "\n")
+    print(out if not args.smoke else json.dumps(report["gates"], indent=1))
+
+    if args.smoke:
+        # Mechanism gates are hard anywhere; the wall-clock speedup gate
+        # is advisory on cpu CI boxes and ratcheted on the committed
+        # full-run ledger instead.
+        hard = [g for g in report["gates"]
+                if g not in ("speedup_ok", "speedup_floor")]
+        if backend != "cpu":
+            hard.append("speedup_ok")
+        failures = [g for g in hard if not report["gates"][g]]
+        if not speedup_ok and "speedup_ok" not in hard:
+            _log(f"ADVISORY: speedup below {floor}x on {backend} "
+                 "(not enforced in cpu smoke)")
+        if failures:
+            _log("MULTI-TRAIN SMOKE FAILED: " + ", ".join(failures))
+            return 1
+        _log("multi-train smoke OK")
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="python -m tools.bench_multi_train")
+    ap.add_argument("--smoke", action="store_true",
+                    help="CI mode: K=8 only, hard-assert mechanism gates")
+    ap.add_argument("--json", dest="json_path", default=None,
+                    help="write the MULTI_TRAIN_BENCH report here")
+    ap.add_argument("--iters", type=int, default=None,
+                    help="trees per tenant (default 8 smoke, 16 full)")
+    ap.add_argument("--seed", type=int, default=11)
+    args = ap.parse_args(argv)
+    if args.iters is None:
+        args.iters = 8 if args.smoke else 16
+    return run(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

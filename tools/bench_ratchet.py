@@ -111,6 +111,24 @@ LEDGER_SCHEMAS = {
             (int, float),
         "ledger.hierarchical.auc_drift_vs_f32_serial": (int, float),
     },
+    "MULTI_TRAIN_BENCH.json": {
+        "bench": str,
+        "backend": str,
+        "results": list,
+        "results[].k": int,
+        "results[].sequential_s": (int, float),
+        "results[].stacked_s": (int, float),
+        "results[].speedup": (int, float),
+        "results[].parity_bitwise": bool,
+        "results[].dispatches": int,
+        "e2e.requests": int,
+        "e2e.errors": int,
+        "e2e.batched_dispatches": int,
+        "gates.parity_bitwise": bool,
+        "gates.one_dispatch_per_stack": bool,
+        "gates.e2e_zero_errors": bool,
+        "gates.e2e_swap_parity": bool,
+    },
     "LOOP_BENCH.json": {
         "bench": str,
         "backend": str,
@@ -254,6 +272,55 @@ GATES = [
         "op": "all_true",
         "band": None,
     },
+    # Stacked many-model training (tools/bench_multi_train.py).  Parity
+    # and one-dispatch are mechanism gates; the stacked-vs-sequential
+    # speedup is wall-clock but carries a HARD per-backend floor — the
+    # headline claim is ≥2x on cpu and ≥5x on an accelerator, whatever
+    # headroom the blessed run had.
+    {
+        "id": "multi.parity_bitwise",
+        "ledger": "MULTI_TRAIN_BENCH.json",
+        "path": "gates.parity_bitwise",
+        "op": "all_true",
+        "band": None,
+    },
+    {
+        "id": "multi.one_dispatch",
+        "ledger": "MULTI_TRAIN_BENCH.json",
+        "path": "gates.one_dispatch_per_stack",
+        "op": "all_true",
+        "band": None,
+    },
+    {
+        "id": "multi.speedup_k8",
+        "ledger": "MULTI_TRAIN_BENCH.json",
+        "path": "results[k=8].speedup",
+        "op": ">=",
+        "band": {"*": 0.5},
+        "min_bound": {"cpu": 2.0, "*": 5.0},
+    },
+    {
+        "id": "multi.speedup_k64",
+        "ledger": "MULTI_TRAIN_BENCH.json",
+        "path": "results[k=64].speedup",
+        "op": ">=",
+        "band": {"*": 0.5},
+        "min_bound": {"cpu": 2.0, "*": 5.0},
+    },
+    {
+        "id": "multi.e2e_zero_5xx",
+        "ledger": "MULTI_TRAIN_BENCH.json",
+        "path": "gates.e2e_zero_errors",
+        "op": "all_true",
+        "band": None,
+    },
+    {
+        "id": "multi.e2e_swap_parity",
+        "ledger": "MULTI_TRAIN_BENCH.json",
+        "path": "gates.e2e_swap_parity",
+        "op": "all_true",
+        "band": None,
+    },
 ]
 
 
@@ -374,6 +441,16 @@ def _band_for(gate: dict, backend: str):
     return band.get(backend, band.get("*", 0.10))
 
 
+def _min_bound_for(gate: dict, backend: str):
+    """The gate's hard floor, resolved per backend: a plain number
+    applies everywhere, a dict maps backend -> floor (``"*"`` default)
+    — the speedup claims are backend-relative (2x cpu, 5x device)."""
+    mb = gate.get("min_bound")
+    if isinstance(mb, dict):
+        return mb.get(backend, mb.get("*"))
+    return mb
+
+
 # ---------------------------------------------------------------------------
 # Ratchet file
 # ---------------------------------------------------------------------------
@@ -398,9 +475,9 @@ def derive_ratchet(ledgers: dict) -> dict:
             v = float(vals[-1])
             band = _band_for(gate, backend)
             bound = v * (1 + band) if gate["op"] == "<=" else v * (1 - band)
-            if "min_bound" in gate:
-                bound = max(bound, gate["min_bound"]) \
-                    if gate["op"] == ">=" else bound
+            mb = _min_bound_for(gate, backend)
+            if mb is not None and gate["op"] == ">=":
+                bound = max(bound, mb)
             entry["blessed"] = v
             entry["band"] = band
             entry["bound"] = round(bound, 6)
