@@ -18,9 +18,15 @@ through training as fixed-size row CHUNKS:
 
 obs counters (surfaced by ``python -m tools.obs report``):
 ``ingest.chunks`` / ``ingest.bytes`` count produced chunk payloads;
-``ingest.buffer_stall_ns`` accumulates time the CONSUMER spent blocked
-waiting on the prefetch queue — ~0 means the pipeline hid the host I/O
-behind compute, large values mean disk/convert is the bottleneck.
+``ingest.buffer_stall_ns`` accumulates time a consumer spent blocked
+waiting on the DECODE stage's queue — ~0 means the pipeline hid the
+host I/O behind compute, large values mean disk/convert is the
+bottleneck.  When prefetchers are stacked into a deeper pipeline
+(``data/streaming.py``'s decode → upload → device-step), the final
+stage counts its waits under ``ingest.pipeline_stall_ns`` instead, so
+"disk is slow" and "the device queue ran dry" stay separately
+attributable.  Stage depth comes from ``MMLSPARK_TPU_INGEST_DEPTH``
+(default 2 — classic double buffering) unless the caller pins it.
 """
 
 from __future__ import annotations
@@ -223,63 +229,162 @@ def chunk_stream(source, chunk_rows: int) -> Iterator[Chunk]:
         )
 
 
-class ChunkPrefetcher:
-    """Double-buffered chunk pipeline: a background thread pulls chunks
-    (optionally mapping each through ``transform`` — e.g. pad + device
-    upload) into a bounded queue while the consumer works.
+def default_ingest_depth() -> int:
+    """Per-stage pipeline buffer depth: ``MMLSPARK_TPU_INGEST_DEPTH``
+    env var, default 2 (double buffering), floor 1."""
+    try:
+        d = int(os.environ.get("MMLSPARK_TPU_INGEST_DEPTH", "2"))
+    except ValueError:
+        d = 2
+    return max(1, d)
 
-    ``depth=2`` is classic double buffering: one chunk in flight behind
-    the one being consumed.  Iterating yields the transformed chunks in
-    order; producer exceptions re-raise in the consumer.
+
+class ChunkPrefetcher:
+    """One pipeline stage: a background thread pulls items (optionally
+    mapping each through ``transform`` — e.g. pad + device upload) into a
+    bounded queue while the consumer works.
+
+    ``depth=None`` reads :func:`default_ingest_depth`
+    (``MMLSPARK_TPU_INGEST_DEPTH``, default 2 — one item in flight behind
+    the one being consumed).  Iterating yields the transformed items in
+    order; producer exceptions re-raise in the consumer.  Stages stack:
+    feeding one prefetcher's iterator to another builds a multi-stage
+    pipeline where every stage runs on its own thread.
+
+    Stall attribution: consumer waits land on ``stall_counter``
+    (``ingest.buffer_stall_ns`` by default; the device-facing stage of a
+    stacked pipeline passes ``ingest.pipeline_stall_ns``), and only the
+    stage with ``feed_steps=True`` notifies the per-step telemetry
+    channel — stacked stages must not double-report one wait.
+
+    Shutdown contract (SRV001): the queue is bounded and every producer
+    put is a bounded wait that watches ``close()``'s stop event, so a
+    consumer that abandons the pipeline mid-stream (exception between
+    chunks, early break) can always drain and join the producer without
+    deadlock — in-flight transformed items are dropped on the floor,
+    which is safe because transforms only stage data (no side effects a
+    partial drain could corrupt).
     """
 
     _DONE = object()
 
-    def __init__(self, chunks: Iterator[Chunk], transform=None, depth: int = 2):
-        self._q: "queue.Queue" = queue.Queue(maxsize=max(1, depth))
+    def __init__(
+        self,
+        chunks,
+        transform=None,
+        depth: Optional[int] = None,
+        *,
+        stall_counter: str = "ingest.buffer_stall_ns",
+        feed_steps: bool = True,
+        count_chunks: bool = True,
+        name: str = "prefetch",
+    ):
+        self.depth = default_ingest_depth() if depth is None else max(1, int(depth))
+        self._q: "queue.Queue" = queue.Queue(maxsize=self.depth)
         self._transform = transform
+        self._stall_counter = stall_counter
+        self._feed_steps = feed_steps
+        self._count_chunks = count_chunks
         self._err: Optional[BaseException] = None
+        self._stop = threading.Event()
         self._thread = threading.Thread(
             target=self._produce, args=(chunks,),
-            name="mmlspark-tpu-ingest-prefetch", daemon=True,
+            name=f"mmlspark-tpu-ingest-{name}", daemon=True,
         )
         self._thread.start()
+
+    def _put(self, item, *, is_sentinel: bool = False) -> bool:
+        """Bounded-wait put that notices consumer abandonment.  Returns
+        False when the consumer closed the pipeline (the sentinel still
+        lands: it evicts a stale slot rather than giving up)."""
+        while True:
+            try:
+                self._q.put(item, timeout=0.1)
+                return True
+            except queue.Full:
+                if not self._stop.is_set():
+                    continue
+                if not is_sentinel:
+                    return False
+                # closed + full: evict one stale item so the sentinel
+                # always lands and no get() can park forever
+                try:
+                    self._q.get_nowait()
+                except queue.Empty:
+                    pass
 
     def _produce(self, chunks) -> None:
         try:
             for chunk in chunks:
-                if obs.enabled():
+                if self._stop.is_set():
+                    return
+                if self._count_chunks and obs.enabled():
                     obs.inc("ingest.chunks")
-                    obs.inc("ingest.bytes", float(chunk.X.nbytes))
+                    X = getattr(chunk, "X", None)
+                    if X is not None:
+                        obs.inc("ingest.bytes", float(X.nbytes))
                 item = chunk if self._transform is None else self._transform(chunk)
-                self._q.put(item)
+                if not self._put(item):
+                    return  # consumer abandoned the pipeline
         except BaseException as e:  # surfaced on the consumer side
             self._err = e
         finally:
-            self._q.put(self._DONE)
+            self._put(self._DONE, is_sentinel=True)
+
+    def qsize(self) -> int:
+        """Items currently buffered in this stage (approximate, for
+        in-flight accounting — never used for control flow)."""
+        return self._q.qsize()
+
+    def close(self) -> None:
+        """Abandon the pipeline: stop the producer, drop queued items,
+        and join the thread.  Idempotent; safe mid-stream or after
+        exhaustion.  Producer errors do NOT re-raise here (the caller is
+        already unwinding) — they surface on iteration only."""
+        self._stop.set()
+        # drain so a producer blocked on a full queue exits its put loop
+        while True:
+            try:
+                self._q.get_nowait()
+            except queue.Empty:
+                break
+        self._thread.join(timeout=10.0)
+
+    def __enter__(self) -> "ChunkPrefetcher":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
 
     def __iter__(self):
-        while True:
-            t0 = time.perf_counter_ns()
+        try:
             while True:
-                try:
-                    item = self._q.get(timeout=1.0)
-                    break
-                except queue.Empty:
-                    if not self._thread.is_alive() and self._q.empty():
-                        # producer died without posting the sentinel
-                        # (e.g. killed interpreter-side); don't park forever
-                        if self._err is not None:
-                            raise self._err
-                        return
-            stall = time.perf_counter_ns() - t0
-            if obs.enabled():
-                obs.inc("ingest.buffer_stall_ns", float(stall))
-                # Per-step attribution: the steps channel subtracts
-                # ingest-stall from step wall (obs/steps.py).
-                obs.steps.note_ingest_stall(float(stall))
-            if item is self._DONE:
-                if self._err is not None:
-                    raise self._err
-                return
-            yield item
+                t0 = time.perf_counter_ns()
+                while True:
+                    try:
+                        item = self._q.get(timeout=1.0)
+                        break
+                    except queue.Empty:
+                        if not self._thread.is_alive() and self._q.empty():
+                            # producer died without posting the sentinel
+                            # (e.g. killed interpreter-side); don't park
+                            if self._err is not None:
+                                raise self._err
+                            return
+                stall = time.perf_counter_ns() - t0
+                if obs.enabled():
+                    obs.inc(self._stall_counter, float(stall))
+                    if self._feed_steps:
+                        # Per-step attribution: the steps channel subtracts
+                        # ingest-stall from step wall (obs/steps.py).
+                        obs.steps.note_ingest_stall(float(stall))
+                if item is self._DONE:
+                    if self._err is not None:
+                        raise self._err
+                    return
+                yield item
+        finally:
+            # consumer left early (exception/break) or we exhausted: make
+            # sure the producer thread is released either way
+            if self._thread.is_alive():
+                self.close()

@@ -12,17 +12,29 @@ The full pipeline behind :func:`train_streaming` (ROADMAP item 2):
    collective, fold in process order, and derive global bin edges → one
    :class:`~mmlspark_tpu.ops.binning.BinningAuthority` shared by every
    rank.
-3. **Ingest pass** (device, double-buffered, fused): raw f32 chunks
-   upload on the prefetch thread while the previous chunk runs ONE
-   fused device step — binning through the authority's double-single
-   boundary table (``ops/device_binning.py``; on TPU the fused Pallas
-   bin+occupancy kernel, ``ops/pallas_binhist.py``, so binned rows
-   never round-trip HBM before the tally), the occupancy update, the
-   quality-sample gather, and the donated ``dynamic_update_slice``
-   into the preallocated cache (O(1) extra memory per chunk).  The
-   consumer never syncs mid-loop, so upload and device work overlap.
-   The cache is nibble-packed two-rows-per-byte when ``num_bins ≤ 16``
-   and rides 1-byte indices through 256 bins (``ops/binpack.py``).
+3. **Ingest pass** (3-stage pipeline, fused): a real decode → upload →
+   device-step pipeline.  Stage 1 (decode thread) reads chunk *t+2*
+   off the mmap'd shards; stage 2 (upload thread) ``jax.device_put``\ s
+   chunk *t+1*; the consumer dispatches chunk *t*'s single fused device
+   step — binning through the authority's double-single boundary table
+   (``ops/device_binning.py``; on TPU the fused Pallas bin+occupancy
+   kernel, ``ops/pallas_binhist.py``, so binned rows never round-trip
+   HBM before the tally) and the donated ``dynamic_update_slice`` into
+   the preallocated cache (O(1) extra memory per chunk).  Each stage
+   has its own bounded queue (depth ``MMLSPARK_TPU_INGEST_DEPTH``,
+   default 2) and the consumer never syncs on the chunk it just
+   dispatched — completed steps are collected a bounded number of
+   chunks later, so decode, upload, and device work genuinely overlap
+   (``StreamedDataset.ingest_stats`` records the achieved
+   ``overlap_ratio`` and ``max_in_flight``).  On the XLA path the exact
+   occupancy tally and quality-sample slice move OFF the device step
+   onto the collector (a vectorized host ``bincount`` over the binned
+   uint8 chunk — cheaper than an on-device scatter-add on hosts, and
+   overlapped with later chunks' device work); the Pallas path keeps
+   the fused in-VMEM tally.  Both produce bitwise-identical caches,
+   occupancy, and samples.  The cache is nibble-packed
+   two-rows-per-byte when ``num_bins ≤ 16`` and rides 1-byte indices
+   through 256 bins (``ops/binpack.py``).
 4. **Train**: the resulting :class:`StreamedDataset` drops into the
    stock ``engine/booster.py`` trainer — ``binned()`` hands back the
    device-resident cache, so ``_train_impl`` skips host binning and goes
@@ -38,22 +50,32 @@ the ingest pass assembles a process-local device cache, which
 obs: the whole fit rides a ``train.binning`` span with
 ``train.binning.sketch`` / ``train.binning.merge`` /
 ``train.binning.device_bin`` children; inside the ingest pass each
-phase is spanned — ``ingest.upload`` (prefetch-thread device transfer),
-``ingest.bin`` (fused-step enqueue), ``ingest.drain`` (await) — plus
-the ``ingest.*`` counters from the loader (``ingest.buffer_stall_ns``
-= consumer waiting on the prefetcher, i.e. upload-bound time) —
-``python -m tools.obs report`` shows the breakdown.
+stage is spanned — ``ingest.decode`` (stage-1 shard read),
+``ingest.upload`` (stage-2 device transfer), ``ingest.bin``
+(consumer fused-step dispatch), ``ingest.collect`` (bounded-lag
+occupancy/sample collection), ``ingest.drain`` (final await) — plus
+the loader counters: ``ingest.buffer_stall_ns`` = the upload stage
+waiting on decode (disk/convert-bound), ``ingest.pipeline_stall_ns``
+= the consumer waiting on upload (transfer-bound), and the
+``ingest.overlap_ratio`` gauge — ``python -m tools.obs report`` shows
+the breakdown.
 """
 
 from __future__ import annotations
 
+import collections
 import math
+import time
 from typing import Optional, Sequence, Tuple
 
 import numpy as np
 
 from mmlspark_tpu import obs
-from mmlspark_tpu.data.loader import ChunkPrefetcher, chunk_stream
+from mmlspark_tpu.data.loader import (
+    ChunkPrefetcher,
+    chunk_stream,
+    default_ingest_depth,
+)
 from mmlspark_tpu.data.sketch import (
     DEFAULT_COMPACTOR_CAP,
     DEFAULT_EXACT_BUDGET,
@@ -181,6 +203,7 @@ class StreamedDataset:
         weight: Optional[np.ndarray] = None,
         occupancy: Optional[np.ndarray] = None,
         sample: Optional[np.ndarray] = None,
+        ingest_stats: Optional[dict] = None,
     ):
         self.authority = authority
         self._binned_dev = binned_dev
@@ -194,6 +217,9 @@ class StreamedDataset:
         self.init_score = None
         self._occupancy = occupancy  # (F, B) int64 exact bin occupancy
         self._sample = sample        # (≤cap, F) uint8 host quality sample
+        # pipeline telemetry from stream_ingest: depth, max_in_flight,
+        # per-stage seconds, overlap_ratio (see its docstring)
+        self.ingest_stats = dict(ingest_stats) if ingest_stats else {}
         # trainer-facing caches (same contract as Dataset's)
         self._mapper_cache = {}
         self._bins_cache = {}
@@ -306,34 +332,53 @@ def stream_ingest(
     quality_sample_cap: int = 4096,
     seed: int = 0,
     fuse: str = "auto",
+    depth: Optional[int] = None,
+    overlap: bool = True,
 ) -> StreamedDataset:
-    """Double-buffered raw-f32 upload + on-device binning into a
-    persistent device cache — ONE fused device step per chunk.
+    """3-stage pipelined raw-f32 ingest into a persistent device cache —
+    ONE fused device step per chunk, three chunks in flight.
 
-    Per chunk the prefetch thread reads the next chunk off the shards
-    and runs its ``jax.device_put`` (the ``ingest.upload`` span) while
-    the CURRENT chunk's single fused program — bin → occupancy tally →
-    quality-sample gather → optional nibble pack → donated
-    ``dynamic_update_slice`` — executes on device.  The consumer only
-    ENQUEUES that step (``ingest.bin`` span): there is no per-chunk host
-    sync (the quality sample stays a device array until after the loop),
-    so the device pipeline and the next upload genuinely overlap —
-    ``ingest.buffer_stall_ns`` now measures the consumer waiting on the
-    PREFETCHER, i.e. upload-bound time, instead of being inflated by
-    serial device work.  The final ``ingest.drain`` span is where the
-    enqueued work is awaited.
+    Stage 1 (decode thread) reads chunk *t+2* off the mmap'd shards and
+    draws its quality-sample indices; stage 2 (upload thread) runs chunk
+    *t+1*'s ``jax.device_put`` (the ``ingest.upload`` span); the
+    consumer DISPATCHES chunk *t*'s fused program — bin → optional
+    nibble pack → donated ``dynamic_update_slice`` into the preallocated
+    cache (``ingest.bin`` span) — and never syncs on it: each step's
+    results are collected up to ``depth`` chunks later (``ingest.collect``
+    span), so decode, upload, and device work genuinely overlap.  Each
+    stage queue holds ``depth`` items (``MMLSPARK_TPU_INGEST_DEPTH``,
+    default 2); ``ingest.buffer_stall_ns`` counts the upload stage
+    starved by decode, ``ingest.pipeline_stall_ns`` the consumer starved
+    by upload.  The final ``ingest.drain`` span awaits the tail.
+    ``overlap=False`` is the serial comparator (collect + block every
+    chunk) — bitwise-identical output, used by parity tests and the
+    ingest bench to attribute the overlap win.
+
+    On the XLA path the exact occupancy tally and sample gather ride the
+    COLLECTOR, not the device step: the binned uint8 chunk comes back to
+    host (bounded lag, overlapped with later device steps) and folds
+    into an int64 ``bincount`` — cheaper than the device scatter-add on
+    hosts and bitwise-identical.  The Pallas path (TPU) keeps the fused
+    in-VMEM tally and on-device sample gather, and its collector is a
+    no-op bookkeeper.
 
     ``pack="auto"`` nibble-packs the cache when ``num_bins ≤ 16``
     (halving its bytes); ``"never"`` forces plain uint8.  At larger bin
     counts the cache rides the byte tier (1 byte/index up to 256 bins —
     ``ops/binpack.py``).
 
-    ``fuse="auto"`` routes the bin+occupancy body through the fused
-    Pallas kernel (:mod:`mmlspark_tpu.ops.pallas_binhist`) on TPU — the
-    binned rows feed the occupancy tally in VMEM without an HBM
-    round-trip — and through the XLA body elsewhere; ``"pallas"`` /
-    ``"xla"`` force a path (cpu pallas runs interpret mode: tests only).
-    Both produce bitwise-identical caches and occupancy.
+    ``fuse="auto"`` routes the bin body through the fused Pallas kernel
+    (:mod:`mmlspark_tpu.ops.pallas_binhist`) on TPU and through the XLA
+    body elsewhere; ``"pallas"`` / ``"xla"`` force a path (cpu pallas
+    runs interpret mode: tests only).  All paths produce
+    bitwise-identical caches, occupancy, and samples.
+
+    The returned dataset's ``ingest_stats`` dict records ``depth``,
+    ``max_in_flight`` (peak chunks resident in the pipeline),
+    per-stage seconds, and ``overlap_ratio`` — the fraction of the
+    smaller of {device-step wall, decode+upload wall} hidden behind the
+    other (0 = fully serial, 1 = fully hidden) — also published as the
+    ``ingest.overlap_ratio`` gauge.
     """
     import jax
     import jax.numpy as jnp
@@ -348,6 +393,7 @@ def stream_ingest(
         raise ValueError(
             f"fuse must be 'auto', 'pallas' or 'xla', got {fuse!r}"
         )
+    depth = default_ingest_depth() if depth is None else max(1, int(depth))
     binner = authority.device_binner()
     n, F = int(source.num_rows), int(source.num_features)
     B = int(authority.num_bins)
@@ -360,98 +406,219 @@ def stream_ingest(
         fuse == "auto" and jax.default_backend() == "tpu"
     )
 
-    def _bin_occ(arrays, rows, counts):
-        """Raw chunk → (uint8 bins, updated occupancy) — the fused core."""
-        if use_pallas:
-            from mmlspark_tpu.ops.pallas_binhist import bin_occ_rows
+    if use_pallas:
+        from mmlspark_tpu.ops.pallas_binhist import bin_occ_rows
 
+        def _step_fused(buf, counts, arrays, rows, start):
             binned_u8, occ = bin_occ_rows(
                 arrays, rows, missing_bin=missing_bin,
                 n_bounds=n_bounds, num_bins=B,
             )
-            return binned_u8, counts + occ
-        binned = bin_rows_device(
-            arrays, rows, missing_bin=missing_bin, n_bounds=n_bounds
-        )
-        f_idx = jnp.broadcast_to(
-            jnp.arange(F, dtype=jnp.int32)[None, :], binned.shape
-        )
-        return binned.astype(jnp.uint8), counts.at[f_idx, binned].add(1)
+            cache = pack_rows(binned_u8) if do_pack else binned_u8
+            return (
+                lax.dynamic_update_slice(buf, cache, (start, 0)),
+                counts + occ,
+            )
 
-    def _step(buf, counts, arrays, rows, start):
-        binned_u8, counts = _bin_occ(arrays, rows, counts)
-        cache = pack_rows(binned_u8) if do_pack else binned_u8
-        return lax.dynamic_update_slice(buf, cache, (start, 0)), counts
+        def _step_fused_sampled(buf, counts, arrays, rows, start, sample_idx):
+            binned_u8, occ = bin_occ_rows(
+                arrays, rows, missing_bin=missing_bin,
+                n_bounds=n_bounds, num_bins=B,
+            )
+            samp = jnp.take(binned_u8, sample_idx, axis=0)
+            cache = pack_rows(binned_u8) if do_pack else binned_u8
+            return (
+                lax.dynamic_update_slice(buf, cache, (start, 0)),
+                counts + occ, samp,
+            )
 
-    def _step_sampled(buf, counts, arrays, rows, start, sample_idx):
-        binned_u8, counts = _bin_occ(arrays, rows, counts)
-        samp = jnp.take(binned_u8, sample_idx, axis=0)
-        cache = pack_rows(binned_u8) if do_pack else binned_u8
-        return lax.dynamic_update_slice(buf, cache, (start, 0)), counts, samp
+        # donated cache + occupancy: rewritten in place chunk by chunk
+        # (O(1) extra device memory per step on backends with donation)
+        step_fused = jax.jit(_step_fused, donate_argnums=(0, 1))
+        step_fused_sampled = jax.jit(_step_fused_sampled, donate_argnums=(0, 1))
+    else:
 
-    # donated cache + occupancy: rewritten in place chunk by chunk (O(1)
-    # extra device memory per step on backends with donation)
-    step = jax.jit(_step, donate_argnums=(0, 1))
-    step_sampled = jax.jit(_step_sampled, donate_argnums=(0, 1))
+        def _step_xla(buf, arrays, rows, start):
+            binned = bin_rows_device(
+                arrays, rows, missing_bin=missing_bin, n_bounds=n_bounds
+            )
+            binned_u8 = binned.astype(jnp.uint8)
+            cache = pack_rows(binned_u8) if do_pack else binned_u8
+            return lax.dynamic_update_slice(buf, cache, (start, 0)), binned_u8
+
+        # donated cache rewritten in place; the binned chunk is a fresh
+        # output the collector folds into host occupancy/sample
+        step_xla = jax.jit(_step_xla, donate_argnums=(0,))
 
     buf_rows = (n + 1) // 2 if do_pack else n
     buf = jnp.zeros((buf_rows, F), jnp.uint8)
-    occupancy = jnp.zeros((F, B), jnp.int32)
+    occupancy_dev = jnp.zeros((F, B), jnp.int32) if use_pallas else None
+    occ_host = None if use_pallas else np.zeros((F, B), np.int64)
     label = None
-    sample_parts = []  # device arrays; materialized AFTER the loop
+    sample_parts = []  # host arrays (XLA) / device arrays (Pallas)
     sample_per_chunk = (
         0 if quality_sample_cap <= 0 or n == 0
         else max(1, math.ceil(quality_sample_cap * chunk_rows / n))
     )
 
-    def _upload(c):
-        # runs on the prefetch thread: next chunk transfers while the
-        # current one executes its fused step — the double buffer.  The
-        # block makes the span honest device-transfer time (and never
-        # blocks the consumer).
+    # Per-stage wall accounting: each key is written by exactly one
+    # thread (decode_s: stage-1, upload_s: stage-2, step_s: consumer).
+    walls = {"decode_s": 0.0, "upload_s": 0.0, "step_s": 0.0}
+
+    def _decoded_chunks():
+        # stage-1 thread: shard read/convert (the chunk_stream pull IS
+        # the decode work — mmap slice + dtype convert + stitch)
+        it = chunk_stream(source, chunk_rows)
+        while True:
+            t0 = time.perf_counter()
+            with obs.span("ingest.decode"):
+                c = next(it, None)
+            if c is None:
+                return
+            walls["decode_s"] += time.perf_counter() - t0
+            yield c
+
+    def _draw_sample_idx(c):
+        # still stage-1: the per-chunk sample draw is host work that
+        # must not ride the consumer's dispatch loop
+        if not sample_per_chunk:
+            return (c, None)
+        rng = np.random.default_rng([seed, 7, c.index])
+        k = min(sample_per_chunk, len(c.X))
+        return (c, np.sort(rng.choice(len(c.X), k, replace=False)))
+
+    def _upload(item):
+        # stage-2 thread: chunk t+1 transfers while chunk t executes its
+        # fused step.  The block makes the span honest device-transfer
+        # time (and never blocks the consumer).  The host X reference is
+        # DROPPED here (X=None) so queued uploads hold only the device
+        # copy — host residency stays O(depth) chunk buffers, not
+        # O(2·depth).
+        c, idx = item
+        t0 = time.perf_counter()
         with obs.span("ingest.upload", rows=len(c.X), bytes=int(c.X.nbytes)):
             dev = jax.device_put(c.X)
             dev.block_until_ready()
-        return (c, dev)
+        walls["upload_s"] += time.perf_counter() - t0
+        return (c._replace(X=None), idx, dev)
 
+    # pending: dispatched-but-uncollected steps, oldest first.  Bounded
+    # by `depth` so device work stays ≤ depth chunks ahead of the host.
+    pending = collections.deque()
+    max_in_flight = 0
+    pending_cap = depth if overlap else 0
+
+    def _collect(entry):
+        binned_dev, idx, c_index = entry
+        if use_pallas:
+            # occupancy/sample already folded on device; nothing to sync
+            if binned_dev is not None:
+                sample_parts.append(binned_dev)  # deferred device samp
+            return
+        with obs.span("ingest.collect", chunk=c_index):
+            binned_host = np.asarray(binned_dev)  # syncs THIS chunk only
+            # per-feature bincount: faster than one flattened bincount
+            # AND only an O(rows) transient, keeping host peak O(chunk)
+            for f in range(F):
+                np.add(
+                    occ_host[f],
+                    np.bincount(binned_host[:, f], minlength=B),
+                    out=occ_host[f],
+                )
+            if idx is not None:
+                sample_parts.append(binned_host[idx])
+
+    t_wall0 = time.perf_counter()
     with obs.span(
         "train.binning.device_bin", rows=n, features=F, packed=do_pack,
-        fused_kernel=use_pallas,
+        fused_kernel=use_pallas, depth=depth, overlap=overlap,
     ):
-        feed = ChunkPrefetcher(chunk_stream(source, chunk_rows), transform=_upload)
-        # Per-chunk step telemetry: each feed-loop pass is one ingest
-        # step whose wall splits into prefetcher stall (fed by
-        # data/loader.py) + bin dispatch (obs/steps.py).
-        step_t = obs.steps.begin()
-        for chunk, rows_dev in feed:
-            c_rows = len(chunk.X)
-            start = chunk.start // 2 if do_pack else chunk.start
-            with obs.span("ingest.bin", rows=c_rows):
-                if sample_per_chunk:
-                    rng = np.random.default_rng([seed, 7, chunk.index])
-                    k = min(sample_per_chunk, c_rows)
-                    idx = np.sort(rng.choice(c_rows, k, replace=False))
-                    buf, occupancy, samp = step_sampled(
-                        buf, occupancy, binner.arrays, rows_dev,
-                        np.int32(start), jnp.asarray(idx, jnp.int32),
-                    )
-                    sample_parts.append(samp)
-                else:
-                    buf, occupancy = step(
-                        buf, occupancy, binner.arrays, rows_dev,
-                        np.int32(start),
-                    )
-            if chunk.y is not None:
-                if label is None:
-                    label = np.empty(n, np.float64)
-                label[chunk.start:chunk.start + len(chunk.X)] = chunk.y[
-                    : len(chunk.X)
-                ]
-            obs.steps.end(step_t, "ingest", chunk.index, rows=c_rows)
+        decoded = ChunkPrefetcher(
+            _decoded_chunks(), transform=_draw_sample_idx, depth=depth,
+            stall_counter="ingest.buffer_stall_ns", feed_steps=False,
+            name="decode",
+        )
+        feed = ChunkPrefetcher(
+            iter(decoded), transform=_upload, depth=depth,
+            stall_counter="ingest.pipeline_stall_ns", feed_steps=True,
+            count_chunks=False, name="upload",
+        )
+        try:
+            # Per-chunk step telemetry: each feed-loop pass is one ingest
+            # step whose wall splits into pipeline stall (fed by
+            # data/loader.py) + bin dispatch (obs/steps.py).
             step_t = obs.steps.begin()
-        with obs.span("ingest.drain"):
-            buf.block_until_ready()
-            occupancy.block_until_ready()
+            for chunk, idx, rows_dev in feed:
+                c_rows = int(rows_dev.shape[0])
+                start = chunk.start // 2 if do_pack else chunk.start
+                t0 = time.perf_counter()
+                with obs.span("ingest.bin", rows=c_rows):
+                    if use_pallas:
+                        if idx is not None:
+                            buf, occupancy_dev, samp = step_fused_sampled(
+                                buf, occupancy_dev, binner.arrays, rows_dev,
+                                np.int32(start), jnp.asarray(idx, jnp.int32),
+                            )
+                            pending.append((samp, None, chunk.index))
+                        else:
+                            buf, occupancy_dev = step_fused(
+                                buf, occupancy_dev, binner.arrays, rows_dev,
+                                np.int32(start),
+                            )
+                            pending.append((None, None, chunk.index))
+                    else:
+                        buf, binned_u8 = step_xla(
+                            buf, binner.arrays, rows_dev, np.int32(start)
+                        )
+                        pending.append((binned_u8, idx, chunk.index))
+                if chunk.y is not None:
+                    if label is None:
+                        label = np.empty(n, np.float64)
+                    label[chunk.start:chunk.start + c_rows] = chunk.y[:c_rows]
+                in_flight = len(pending) + feed.qsize() + decoded.qsize()
+                if in_flight > max_in_flight:
+                    max_in_flight = in_flight
+                while len(pending) > pending_cap:
+                    _collect(pending.popleft())
+                if not overlap:
+                    # serial comparator: fully drain the device per chunk
+                    buf.block_until_ready()
+                walls["step_s"] += time.perf_counter() - t0
+                obs.steps.end(step_t, "ingest", chunk.index, rows=c_rows)
+                step_t = obs.steps.begin()
+            with obs.span("ingest.drain"):
+                while pending:
+                    _collect(pending.popleft())
+                buf.block_until_ready()
+                if use_pallas:
+                    occupancy_dev.block_until_ready()
+        finally:
+            # release stage threads even when the loop dies mid-pipeline
+            # (downstream first so upstream sees its consumer gone)
+            feed.close()
+            decoded.close()
+    wall_s = time.perf_counter() - t_wall0
+
+    # overlap attribution: how much of the smaller side (device-step
+    # wall vs decode+upload wall) was hidden behind the other
+    host_side = walls["decode_s"] + walls["upload_s"]
+    hidden = max(0.0, host_side + walls["step_s"] - wall_s)
+    denom = min(walls["step_s"], host_side)
+    overlap_ratio = min(1.0, hidden / denom) if denom > 1e-9 else 0.0
+    ingest_stats = {
+        "depth": int(depth),
+        "overlap": bool(overlap),
+        "max_in_flight": int(max_in_flight),
+        "decode_s": walls["decode_s"],
+        "upload_s": walls["upload_s"],
+        "step_s": walls["step_s"],
+        "wall_s": wall_s,
+        "hidden_s": hidden,
+        "overlap_ratio": overlap_ratio,
+    }
+    if obs.enabled():
+        obs.gauge("ingest.overlap_ratio", overlap_ratio)
+        obs.gauge("ingest.max_in_flight", float(max_in_flight))
 
     sample = (
         np.concatenate([np.asarray(s) for s in sample_parts])
@@ -465,8 +632,11 @@ def stream_ingest(
         num_rows=n,
         num_features=F,
         label=label,
-        occupancy=np.asarray(occupancy, np.int64),
+        occupancy=(
+            np.asarray(occupancy_dev, np.int64) if use_pallas else occ_host
+        ),
         sample=sample,
+        ingest_stats=ingest_stats,
     )
 
 
@@ -483,6 +653,9 @@ def train_streaming(
     mesh=None,
     init_model=None,
     return_dataset: bool = False,
+    process_local: Optional[bool] = None,
+    ingest_depth: Optional[int] = None,
+    overlap: bool = True,
 ):
     """End-to-end streamed training: sketch-fit → device ingest → the
     stock :func:`mmlspark_tpu.engine.booster.train` loop.
@@ -494,6 +667,16 @@ def train_streaming(
     ``(booster, streamed_dataset)`` so callers can reuse the ingested
     cache across training calls.
 
+    Multi-process (the pod rehearsal path): pass a per-process source
+    (:func:`process_shard_source`) on every process and call this
+    collectively.  ``process_local`` defaults to ``process_count() > 1``
+    — the sketch merge is already collective, every process's 3-stage
+    ingest pipeline runs INDEPENDENTLY (no collective until training),
+    and the trainer assembles the global row-sharded arrays from the
+    per-process caches (``engine/booster.py`` ``process_local=True``).
+    ``ingest_depth`` / ``overlap`` tune the pipeline
+    (:func:`stream_ingest`).
+
     With ``init_model`` set this is the WARM-START refit entry (the
     closed loop's append-trees path, ISSUE 18): the sketch fit is
     skipped and the fresh shards are binned through the init_model's
@@ -501,9 +684,13 @@ def train_streaming(
     grown on — with ``num_iterations`` counting NEW trees and the
     per-iteration RNG continuing at the absolute fold_in schedule.
     """
+    import jax
+
     from mmlspark_tpu.engine.booster import TrainConfig
     from mmlspark_tpu.engine.booster import train as _train
 
+    if process_local is None:
+        process_local = jax.process_count() > 1
     cfg = TrainConfig.from_params(params)
     if init_model is not None:
         # Warm-start refit (the closed loop's append-trees path):
@@ -528,6 +715,7 @@ def train_streaming(
             train_set = stream_ingest(
                 source, authority, chunk_rows=chunk_rows, pack=pack,
                 fuse=fuse, quality_sample_cap=4096, seed=cfg.seed,
+                depth=ingest_depth, overlap=overlap,
             )
     else:
         with obs.span("train.binning", streamed=True, rows=source.num_rows):
@@ -546,6 +734,7 @@ def train_streaming(
             train_set = stream_ingest(
                 source, authority, chunk_rows=chunk_rows, pack=pack,
                 fuse=fuse, quality_sample_cap=4096, seed=cfg.seed,
+                depth=ingest_depth, overlap=overlap,
             )
     if train_set.label is None:
         raise ValueError(
@@ -558,5 +747,6 @@ def train_streaming(
     booster = _train(
         params, train_set, valid_sets=valid_sets, valid_names=valid_names,
         bin_mapper=authority.mapper, init_model=init_model, mesh=mesh,
+        process_local=process_local,
     )
     return (booster, train_set) if return_dataset else booster

@@ -2232,8 +2232,17 @@ def _train_impl(
         voting=voting,
         top_k=cfg.top_k,
         # classes grow sequentially (lax.map below), so the grower's
-        # one-hot stats operand is (L, n) f32 for ONE class at a time
-        onehot_stats=cfg.num_leaves * n <= _ONEHOT_BUDGET_ELS,
+        # one-hot stats operand is (L, n) f32 for ONE class at a time.
+        # TPU-only: the MXU contraction is shape-deterministic, while
+        # XLA:CPU threads the gemm by the host's device count, so the
+        # f32 sum order differs between process layouts of the same mesh
+        # and the recorded leaf values lose bitwise layout-parity
+        # (tools/bench_pod.py gate); the scatter path accumulates in row
+        # order on every layout.
+        onehot_stats=(
+            jax.default_backend() == "tpu"
+            and cfg.num_leaves * n <= _ONEHOT_BUDGET_ELS
+        ),
     )
 
     def _grow_classes(gcfg_):
@@ -2336,8 +2345,14 @@ def _train_impl(
     )
     # The one-hot delta is vmapped over classes, so its operand is
     # (K, L, n) f32 — fall back to the gather when that blows the budget
-    # (the gather needs only the (K, n) output).
-    _delta_onehot = K * cfg.num_leaves * n <= _ONEHOT_BUDGET_ELS
+    # (the gather needs only the (K, n) output).  TPU-only for the same
+    # layout-parity reason as onehot_stats above: training scores feed the
+    # next tree's gradients, so a thread-count-dependent gemm order on CPU
+    # would diverge the whole forest between process layouts.
+    _delta_onehot = (
+        jax.default_backend() == "tpu"
+        and K * cfg.num_leaves * n <= _ONEHOT_BUDGET_ELS
+    )
 
     def _leaf_delta(tree, leaf_ids):
         # delta[k] = leaf_value[k][leaf_ids[k]] as a one-hot contraction:

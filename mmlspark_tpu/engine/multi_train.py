@@ -410,10 +410,11 @@ def multi_train(
     # onehot algorithm choices are made from each model's UNPADDED row
     # count (exactly what its standalone run resolves) and must agree
     # across the stack — the shared program bakes ONE choice in.
+    on_tpu = jax.default_backend() == "tpu"  # layout-parity: see _train_impl
     oh_flags = {
         (
-            cfg0.num_leaves * n <= _ONEHOT_BUDGET_ELS,
-            Kc * cfg0.num_leaves * n <= _ONEHOT_BUDGET_ELS,
+            on_tpu and cfg0.num_leaves * n <= _ONEHOT_BUDGET_ELS,
+            on_tpu and Kc * cfg0.num_leaves * n <= _ONEHOT_BUDGET_ELS,
         )
         for n in n_list
     }

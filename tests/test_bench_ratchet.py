@@ -25,6 +25,9 @@ FIXTURE = os.path.join(REPO, "tests", "fixtures", "ratchet_regression")
 FIXTURE_MULTI = os.path.join(
     REPO, "tests", "fixtures", "ratchet_regression_multi"
 )
+FIXTURE_POD = os.path.join(
+    REPO, "tests", "fixtures", "ratchet_regression_pod"
+)
 
 
 class TestLedgerSchemas:
@@ -120,6 +123,65 @@ class TestRatchet:
         bad = [r["id"] for r in br.evaluate(ledgers, ratchet)
                if not r["ok"] and r["enforced"]]
         assert bad == ["multi.speedup_k64"]
+
+    def test_pod_regression_fixture_validates(self):
+        # the pod-rehearsal regression fixture must fail on the GATE,
+        # never on schema
+        _, errors = br.load_ledgers(FIXTURE_POD)
+        assert errors == []
+
+    def test_pod_scaling_regression_exits_nonzero(self):
+        assert br.main(["--ledger-dir", FIXTURE_POD]) == 1
+
+    def test_pod_regression_is_the_scaling_gate(self):
+        # the fixture records scaling.gate_enforced=true (an accelerator
+        # topology) with two_proc below the 1.7x floor; evaluate() must
+        # re-resolve enforcement from the ledger under evaluation — not
+        # the cpu blessing — and fail EXACTLY pod.scaling_2proc
+        ledgers, _ = br.load_ledgers(FIXTURE_POD)
+        with open(br.ratchet_path(FIXTURE_POD)) as f:
+            ratchet = json.load(f)
+        bad = [r["id"] for r in br.evaluate(ledgers, ratchet)
+               if not r["ok"] and r["enforced"]]
+        assert bad == ["pod.scaling_2proc"]
+
+    def test_pod_scaling_advisory_on_cpu_never_fails(self):
+        # the committed cpu ledger records gate_enforced=false (every
+        # process shares the host core, the ratio is physically capped);
+        # regressing two_proc there must stay an advisory failure
+        ledgers, _ = br.load_ledgers(REPO)
+        ledgers = copy.deepcopy(ledgers)
+        ledgers["BENCH_POD.json"]["scaling"]["two_proc"] = 0.1
+        with open(os.path.join(REPO, "RATCHET.json")) as f:
+            ratchet = json.load(f)
+        results = br.evaluate(ledgers, ratchet)
+        bad = [r for r in results if r["id"] == "pod.scaling_2proc"][0]
+        assert not bad["ok"] and not bad["enforced"]
+
+    def test_max_bound_resolves_per_backend(self):
+        gate = {"max_bound": {"cpu": 3.61, "*": 1.0}}
+        assert br._max_bound_for(gate, "cpu") == 3.61
+        assert br._max_bound_for(gate, "tpu") == 1.0
+        assert br._max_bound_for({"max_bound": 2.0}, "cpu") == 2.0
+        assert br._max_bound_for({}, "cpu") is None
+
+    def test_max_bound_caps_the_blessing(self):
+        # the ingest trend gate pins the pre-pipeline 3.61 s record as
+        # the worst value --update may ever legitimize: a blessing far
+        # above it derives a bound clamped to exactly the ceiling
+        gate = [g for g in br.GATES if g["id"] == "ingest.steady_trend"][0]
+        assert gate["op"] == "<="
+        xb = br._max_bound_for(gate, "cpu")
+        assert xb is not None
+        led = {
+            gate["ledger"]: json.load(
+                open(os.path.join(REPO, gate["ledger"]))
+            )
+        }
+        # inflate the steady value well past the ceiling
+        led[gate["ledger"]]["value"] = xb * 10
+        derived = br.derive_ratchet(led)
+        assert derived["gates"]["ingest.steady_trend"]["bound"] == xb
 
     def test_min_bound_resolves_per_backend(self):
         gate = {"min_bound": {"cpu": 2.0, "*": 5.0}}

@@ -1,0 +1,113 @@
+"""Deterministic Criteo shard generator (tools/gen_criteo_shards.py).
+
+The pod rehearsal's parity legs only mean something if every process —
+and every rerun — sees byte-identical input: same ``(seed, bytes,
+shards)`` must reproduce the shard files and manifest exactly,
+regardless of which process wrote which shard.
+"""
+
+import json
+import os
+
+import numpy as np
+
+from tools.gen_criteo_shards import (
+    CATEGORICAL_FEATURES,
+    NUM_FEATURES,
+    NUM_INT,
+    _parse_bytes,
+    gen_shard,
+    generate,
+)
+
+
+def _read_all(d):
+    out = {}
+    for name in sorted(os.listdir(d)):
+        with open(os.path.join(d, name), "rb") as fh:
+            out[name] = fh.read()
+    return out
+
+
+class TestDeterminism:
+    def test_same_seed_and_budget_byte_identical(self, tmp_path):
+        a = generate(str(tmp_path / "a"), 1 << 20, seed=7, shards=4)
+        b = generate(str(tmp_path / "b"), 1 << 20, seed=7, shards=4)
+        assert a == b
+        assert _read_all(str(tmp_path / "a")) == _read_all(
+            str(tmp_path / "b"))
+
+    def test_seed_changes_every_shard(self, tmp_path):
+        generate(str(tmp_path / "a"), 1 << 20, seed=0, shards=2)
+        generate(str(tmp_path / "b"), 1 << 20, seed=1, shards=2)
+        a, b = _read_all(str(tmp_path / "a")), _read_all(str(tmp_path / "b"))
+        assert set(a) == set(b)
+        for name in a:
+            if name.endswith(".npy"):
+                assert a[name] != b[name], name
+
+    def test_multi_process_split_matches_single_writer(self, tmp_path):
+        # two processes writing disjoint subsets produce the same files
+        # as one process writing everything (modulo the manifest, which
+        # only the single writer emits with digests)
+        generate(str(tmp_path / "one"), 1 << 20, seed=3, shards=4)
+        for pid in range(2):
+            generate(str(tmp_path / "two"), 1 << 20, seed=3, shards=4,
+                     process_id=pid, num_processes=2)
+        one = _read_all(str(tmp_path / "one"))
+        two = _read_all(str(tmp_path / "two"))
+        one.pop("criteo_manifest.json")
+        assert one == two
+
+    def test_manifest_digests_match_files(self, tmp_path):
+        import hashlib
+
+        generate(str(tmp_path / "s"), 1 << 20, seed=5, shards=2)
+        with open(tmp_path / "s" / "criteo_manifest.json") as fh:
+            man = json.load(fh)
+        for e in man["shards"]:
+            with open(tmp_path / "s" / e["x"], "rb") as fh:
+                assert hashlib.sha256(fh.read()).hexdigest() == e["sha256_x"]
+
+    def test_gen_shard_is_pure(self):
+        X1, y1 = gen_shard(2, 3, 256)
+        X2, y2 = gen_shard(2, 3, 256)
+        assert np.array_equal(X1, X2, equal_nan=True)
+        assert np.array_equal(y1, y2)
+        # a different shard index is a different stream
+        X3, _ = gen_shard(2, 4, 256)
+        assert not np.array_equal(X1, X3, equal_nan=True)
+
+
+class TestSchema:
+    def test_criteo_shape_and_f32_exact_categories(self):
+        X, y = gen_shard(0, 0, 512)
+        assert X.shape == (512, NUM_FEATURES) and X.dtype == np.float32
+        assert y.shape == (512,) and set(np.unique(y)) <= {0.0, 1.0}
+        cats = X[:, NUM_INT:]
+        finite = cats[np.isfinite(cats)]
+        # every category id is integral and f32-exact (< 2**24): the
+        # device/host parity contract of ops/device_binning.py
+        assert np.all(finite == np.trunc(finite))
+        assert np.all(finite < 2 ** 24)
+        assert len(CATEGORICAL_FEATURES) == NUM_FEATURES - NUM_INT
+
+    def test_int_columns_have_missing_and_heavy_tail(self):
+        X, _ = gen_shard(0, 1, 4096)
+        ints = X[:, :NUM_INT]
+        assert np.isnan(ints).any()
+        finite = ints[np.isfinite(ints)]
+        assert finite.min() >= 0 and finite.max() > 100  # heavy tail
+
+    def test_parse_bytes_suffixes(self):
+        assert _parse_bytes("64") == 64
+        assert _parse_bytes("4K") == 4096
+        assert _parse_bytes("2M") == 2 << 20
+        assert _parse_bytes("1.5G") == int(1.5 * (1 << 30))
+        assert _parse_bytes("1T") == 1 << 40
+
+    def test_budget_drives_row_count(self, tmp_path):
+        small = generate(str(tmp_path / "sm"), 1 << 20, shards=2)
+        big = generate(str(tmp_path / "bg"), 4 << 20, shards=2)
+        assert big["rows_per_shard"] >= 4 * small["rows_per_shard"] - 4
+        assert small["num_rows"] == 2 * small["rows_per_shard"]

@@ -374,6 +374,151 @@ class TestMemoryAndObs:
             assert name in rep["spans"], name
 
 
+# -------------------------------------------------- pipelined ingest
+
+
+class TestPipelinedIngest:
+    """The 3-stage decode → upload → device-step pipeline (ISSUE 20):
+    overlap must be REAL (≥2 chunks in flight), tunable
+    (``MMLSPARK_TPU_INGEST_DEPTH``), bitwise-invisible to the model,
+    and drain cleanly on mid-stream errors."""
+
+    def _src(self, tmp_path, n=6000, F=6, name="rg", seed=11):
+        X, y = _make_xy(n=n, F=F, cat_col=3, seed=seed)
+        return RowGroupSource(write_row_group_shards(
+            str(tmp_path / name), X, y, rows_per_group=1500)), X, y
+
+    def test_pipeline_keeps_chunks_in_flight(self, tmp_path):
+        src, _, _ = self._src(tmp_path)
+        authority, _ = stream_fit_binning(
+            src, max_bin=63, chunk_rows=512, exact_budget=32768)
+        ds = stream_ingest(src, authority, chunk_rows=512)
+        st = ds.ingest_stats
+        # the steady-ingest serialization fix: ≥2 chunks concurrently in
+        # the pipeline (queued, uploading, or awaiting collection), not
+        # the old upload→block→step lockstep
+        assert st["max_in_flight"] >= 2, st
+        assert st["depth"] == 2 and st["overlap"] is True
+        assert 0.0 <= st["overlap_ratio"] <= 1.0
+        for k in ("decode_s", "upload_s", "step_s", "wall_s"):
+            assert st[k] >= 0.0, (k, st)
+
+    def test_ingest_depth_env_knob(self, tmp_path, monkeypatch):
+        from mmlspark_tpu.data.loader import default_ingest_depth
+
+        monkeypatch.setenv("MMLSPARK_TPU_INGEST_DEPTH", "3")
+        assert default_ingest_depth() == 3
+        monkeypatch.setenv("MMLSPARK_TPU_INGEST_DEPTH", "0")
+        assert default_ingest_depth() == 1  # floor: a real pipeline
+        monkeypatch.setenv("MMLSPARK_TPU_INGEST_DEPTH", "banana")
+        assert default_ingest_depth() == 2  # unparseable -> default
+        monkeypatch.delenv("MMLSPARK_TPU_INGEST_DEPTH")
+        assert default_ingest_depth() == 2
+
+        src, _, _ = self._src(tmp_path)
+        authority, _ = stream_fit_binning(
+            src, max_bin=63, chunk_rows=512, exact_budget=32768)
+        monkeypatch.setenv("MMLSPARK_TPU_INGEST_DEPTH", "4")
+        ds = stream_ingest(src, authority, chunk_rows=512)
+        assert ds.ingest_stats["depth"] == 4
+        ds1 = stream_ingest(src, authority, chunk_rows=512, depth=1)
+        assert ds1.ingest_stats["depth"] == 1  # explicit beats env
+        assert np.array_equal(
+            np.asarray(ds._binned_dev), np.asarray(ds1._binned_dev))
+
+    def test_overlap_vs_blocking_bitwise_parity(self, tmp_path):
+        src, _, _ = self._src(tmp_path)
+        authority, _ = stream_fit_binning(
+            src, max_bin=63, chunk_rows=700, exact_budget=32768)
+        a = stream_ingest(src, authority, chunk_rows=700, overlap=True)
+        b = stream_ingest(src, authority, chunk_rows=700, overlap=False)
+        assert a.ingest_stats["overlap"] and not b.ingest_stats["overlap"]
+        assert np.array_equal(
+            np.asarray(a._binned_dev), np.asarray(b._binned_dev))
+        assert np.array_equal(a._occupancy, b._occupancy)
+        assert np.array_equal(a._sample, b._sample)
+        assert np.array_equal(a.label, b.label)
+
+    def test_overlap_parity_packed_8dev_mesh(self, tmp_path):
+        # nibble-packed uint8 cache (max_bin=15) trained over the full
+        # 8-virtual-device mesh: the pipeline rotation must stay
+        # invisible under donation + packing + shard_map
+        import jax
+
+        from mmlspark_tpu.parallel.mesh import default_mesh
+
+        if len(jax.devices()) < 8:
+            pytest.skip("needs the 8-virtual-device session")
+        src, _, _ = self._src(tmp_path, n=4096, F=8, name="rg8", seed=3)
+        params = dict(objective="binary", num_iterations=4, num_leaves=7,
+                      max_bin=15, categorical_feature=[3], seed=1)
+        mesh = default_mesh()
+        bst_a, ds_a = train_streaming(
+            params, src, chunk_rows=512, exact_budget=32768, mesh=mesh,
+            overlap=True, return_dataset=True)
+        bst_b, ds_b = train_streaming(
+            params, src, chunk_rows=512, exact_budget=32768, mesh=mesh,
+            overlap=False, return_dataset=True)
+        assert ds_a.packed and ds_b.packed
+        assert bst_a.save_model_string() == bst_b.save_model_string()
+
+    def test_mid_stream_error_propagates_and_drains(self, tmp_path):
+        # a shard source that dies mid-stream: the error must surface to
+        # the caller (not deadlock the stages) and both worker threads
+        # must be reaped
+        import threading
+
+        src, X, y = self._src(tmp_path, name="rgerr")
+
+        class DyingSource:
+            num_rows = src.num_rows
+            num_features = src.num_features
+
+            def iter_shards(self):
+                it = src.iter_shards()
+                yield next(it)
+                yield next(it)
+                raise OSError("shard storage vanished mid-stream")
+
+        authority, _ = stream_fit_binning(
+            src, max_bin=63, chunk_rows=512, exact_budget=32768)
+        before = {t.ident for t in threading.enumerate()}
+        with pytest.raises(OSError, match="vanished"):
+            stream_ingest(DyingSource(), authority, chunk_rows=512)
+        deadline = 50
+        while deadline:
+            alive = [t for t in threading.enumerate()
+                     if t.ident not in before and t.is_alive()]
+            if not alive:
+                break
+            import time
+            time.sleep(0.1)
+            deadline -= 1
+        assert deadline, f"pipeline threads leaked: {alive}"
+
+    def test_stacked_prefetcher_close_order_no_deadlock(self):
+        # the shutdown contract: closing DOWNSTREAM first must never
+        # deadlock even with full queues on both stages
+        from mmlspark_tpu.data.loader import ChunkPrefetcher
+
+        def slow_items():
+            for i in range(100):
+                yield i
+
+        inner = ChunkPrefetcher(slow_items(), depth=2, count_chunks=False,
+                                feed_steps=False, name="inner")
+        outer = ChunkPrefetcher(iter(inner), depth=2, count_chunks=False,
+                                feed_steps=False, name="outer")
+        it = iter(outer)
+        assert next(it) == 0  # both stages running, queues filling
+        outer.close()
+        inner.close()
+        outer._thread.join(timeout=5)
+        inner._thread.join(timeout=5)
+        assert not outer._thread.is_alive()
+        assert not inner._thread.is_alive()
+
+
 # ------------------------------------------------------------ mesh leg
 
 

@@ -28,6 +28,15 @@ gate (ISSUE 11) asserts the histogram working set — the transposed
 (in fact ¼) of the int32 layout it replaced, with a timed hist pass
 over it (``ingest.hist`` span).
 
+Since ISSUE 20 the streamed leg runs the 3-stage pipelined ingest
+(decode → upload → device-step, ``data/streaming.py``): the record
+carries the pipeline telemetry (``overlap_ratio``, ``max_in_flight``,
+per-stage walls under ``pipeline``) plus a serial comparator leg
+(``overlap=False`` — same kernels, no overlap) isolating the
+pipelining win, and the cpu trend gate in ``tools/bench_ratchet.py``
+holds the steady wall below the pre-pipeline 3.61 s record
+(``r17_steady_s``).
+
 Timing protocol: best-of-2 for the host legs, cold + steady for the
 streamed legs (cold pays jit compile and is reported separately).  obs is
 enabled for the streamed run; the final snapshot (ingest.* counters,
@@ -59,6 +68,11 @@ R05_HOST_BINNING_S = 1.12  # BENCH_r05 numeric: fit 0.73 + transform 0.39
 # (unchanged pure-numpy code) calibrate box drift between records.
 R10_STEADY_S = 2.52
 R10_HOST_TOTAL_S = 1.179
+# ISSUE-17 record (pre-pipelined ingest): the 3-stage overlap rework
+# (ISSUE 20) must improve on this — the cpu trend gate in bench_ratchet
+# holds the steady wall below it.
+R17_STEADY_S = 3.61
+R17_HOST_TOTAL_S = 1.666
 
 
 def _log(*a):
@@ -131,10 +145,21 @@ def main(argv=None):
         ds = stream_ingest(src, authority, chunk_rows=chunk_rows)
         ingest_steady_s = time.perf_counter() - t0
         unpacked_bytes = ds.binned_cache_nbytes
+        pipeline = dict(ds.ingest_stats)
         _log(f"[ingest] streamed: sketch={sketch_s:.2f}s "
              f"(rank_eps={sketch.rank_epsilon:.2e}) "
              f"cold={ingest_cold_s:.2f}s (incl. compile) "
-             f"steady={ingest_steady_s:.2f}s")
+             f"steady={ingest_steady_s:.2f}s "
+             f"overlap={pipeline.get('overlap_ratio', 0):.2f} "
+             f"in_flight={pipeline.get('max_in_flight', 0)}")
+
+        # -- serial comparator: same kernels, overlap disabled — the
+        # pipelining win in isolation (steady wall vs steady wall)
+        t0 = time.perf_counter()
+        stream_ingest(src, authority, chunk_rows=chunk_rows, overlap=False)
+        ingest_serial_s = time.perf_counter() - t0
+        _log(f"[ingest] serial (overlap=False) steady: "
+             f"{ingest_serial_s:.2f}s")
 
         # -- byte-tier hist phase (ISSUE 11): the transposed working set
         # every hist pass consumes must ride 1-byte indices at 255 bins,
@@ -201,8 +226,18 @@ def main(argv=None):
         "r05_host_binning_s": R05_HOST_BINNING_S,
         "r10_steady_s": R10_STEADY_S,
         "r10_host_total_s": R10_HOST_TOTAL_S,
+        "r17_steady_s": R17_STEADY_S,
+        "r17_host_total_s": R17_HOST_TOTAL_S,
         "sketch_s": round(sketch_s, 3),
         "ingest_cold_s": round(ingest_cold_s, 3),
+        "ingest_serial_s": round(ingest_serial_s, 3),
+        "overlap_ratio": round(float(pipeline.get("overlap_ratio", 0.0)), 3),
+        "pipeline_depth": int(pipeline.get("depth", 0)),
+        "max_in_flight": int(pipeline.get("max_in_flight", 0)),
+        "pipeline": {
+            k: (round(v, 4) if isinstance(v, float) else v)
+            for k, v in pipeline.items()
+        },
         "vs_host_binning": round(speedup, 3),
         "gate_steady_le_half_host": gate_ok,
         "gate_enforced": gate_enforced,

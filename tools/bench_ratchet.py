@@ -2,7 +2,9 @@
 
 The repo's headline perf claims live in hand-regenerated ledgers at the
 repo root (``BENCH_r*.json``, ``PREDICT_BENCH.json``,
-``INGEST_BENCH.json``, ``MULTICHIP_COMMS.json``).  Nothing in CI
+``INGEST_BENCH.json``, ``MULTICHIP_COMMS.json``,
+``MULTI_TRAIN_BENCH.json``, ``LOOP_BENCH.json``,
+``BENCH_POD.json``).  Nothing in CI
 stopped a PR from silently regressing them — a bench rerun could write
 a worse number and the diff would merge green (ROADMAP item 5(b)).
 
@@ -129,6 +131,26 @@ LEDGER_SCHEMAS = {
         "gates.e2e_zero_errors": bool,
         "gates.e2e_swap_parity": bool,
     },
+    "BENCH_POD.json": {
+        "bench": str,
+        "backend": str,
+        "iters": int,
+        "dataset.rows": int,
+        "dataset.shards": int,
+        "runs.p1.pipeline_wall_s": (int, float),
+        "runs.p1.rows_per_s_process": (int, float),
+        "runs.p2.pipeline_wall_s": (int, float),
+        "runs.p2.rows_per_s_process": (int, float),
+        "runs.p4.pipeline_wall_s": (int, float),
+        "runs.p4.rows_per_s_process": (int, float),
+        "scaling.two_proc": (int, float),
+        "scaling.gate_enforced": bool,
+        "parity.bitwise": bool,
+        "parity.digest_2proc": str,
+        "resume.ok": bool,
+        "resume.iterations_at_kill": int,
+        "overlap.p1.ratio": (int, float),
+    },
     "LOOP_BENCH.json": {
         "bench": str,
         "backend": str,
@@ -227,6 +249,19 @@ GATES = [
         "advisory_when": "gate_enforced",
     },
     {
+        # cpu TREND gate (ISSUE 20): unlike ingest.steady_s (a
+        # device-vs-host claim, advisory on cpu), this one is ALWAYS
+        # enforced — the steady wall ratchets against its own blessed
+        # record and may never re-bless above the pre-pipeline 3.61 s
+        # (the ISSUE-17 ledger the 3-stage overlap had to beat).
+        "id": "ingest.steady_trend",
+        "ledger": "INGEST_BENCH.json",
+        "path": "value",
+        "op": "<=",
+        "band": {"cpu": 0.25, "*": 0.10},
+        "max_bound": 3.61,
+    },
+    {
         "id": "ingest.byte_working_set",
         "ledger": "INGEST_BENCH.json",
         "path": "gate_byte_ws_le_half_int32",
@@ -318,6 +353,36 @@ GATES = [
         "id": "multi.e2e_swap_parity",
         "ledger": "MULTI_TRAIN_BENCH.json",
         "path": "gates.e2e_swap_parity",
+        "op": "all_true",
+        "band": None,
+    },
+    # Pod rehearsal (tools/bench_pod.py).  Parity and resume are
+    # mechanism gates — the process boundary is either invisible to the
+    # math or it isn't.  The 2-process scaling ratio carries the ≥1.7x
+    # floor of the rehearsal's acceptance, but ONLY where the topology
+    # can deliver it: the ledger records ``scaling.gate_enforced: false``
+    # on cpu (every "process" shares the host's core) and the gate
+    # demotes to advisory-with-trend there.
+    {
+        "id": "pod.scaling_2proc",
+        "ledger": "BENCH_POD.json",
+        "path": "scaling.two_proc",
+        "op": ">=",
+        "band": {"*": 0.15},
+        "min_bound": 1.7,
+        "advisory_when": "scaling.gate_enforced",
+    },
+    {
+        "id": "pod.parity_bitwise",
+        "ledger": "BENCH_POD.json",
+        "path": "parity.bitwise",
+        "op": "all_true",
+        "band": None,
+    },
+    {
+        "id": "pod.resume_ok",
+        "ledger": "BENCH_POD.json",
+        "path": "resume.ok",
         "op": "all_true",
         "band": None,
     },
@@ -451,6 +516,17 @@ def _min_bound_for(gate: dict, backend: str):
     return mb
 
 
+def _max_bound_for(gate: dict, backend: str):
+    """Hard CEILING for ``<=`` gates: ``--update`` may tighten the bound
+    toward the blessed value but never re-bless above this — the trend
+    gates pin a historical record (ingest's pre-pipeline 3.61 s) as the
+    worst value any future blessing can legitimize."""
+    mb = gate.get("max_bound")
+    if isinstance(mb, dict):
+        return mb.get(backend, mb.get("*"))
+    return mb
+
+
 # ---------------------------------------------------------------------------
 # Ratchet file
 # ---------------------------------------------------------------------------
@@ -478,6 +554,9 @@ def derive_ratchet(ledgers: dict) -> dict:
             mb = _min_bound_for(gate, backend)
             if mb is not None and gate["op"] == ">=":
                 bound = max(bound, mb)
+            xb = _max_bound_for(gate, backend)
+            if xb is not None and gate["op"] == "<=":
+                bound = min(bound, xb)
             entry["blessed"] = v
             entry["band"] = band
             entry["bound"] = round(bound, 6)
@@ -513,11 +592,20 @@ def evaluate(ledgers: dict, ratchet: dict) -> list:
         if spec is None or led is None:
             continue
         vals = list(_walk(led, gate["path"]))
+        # advisory gates re-resolve enforcement from the ledger UNDER
+        # EVALUATION (not the one blessed into RATCHET.json): a fixture
+        # or accelerator rerun that records gate_enforced=true must be
+        # held to the gate even though the blessing ran on cpu
+        adv = gate.get("advisory_when")
+        if adv is not None:
+            enforced = bool(next(_walk(led, adv), False))
+        else:
+            enforced = bool(spec.get("enforced", True))
         res = {
             "id": gate["id"],
             "op": gate["op"],
             "bound": spec.get("bound"),
-            "enforced": bool(spec.get("enforced", True)),
+            "enforced": enforced,
         }
         if not vals:
             res.update(value=None, ok=False,

@@ -1,0 +1,230 @@
+"""Deterministic Criteo-schema shard generator for the pod rehearsal.
+
+Writes the Criteo click-log layout — 13 integer count features + 26
+hashed categorical features (PAPER.md §0: the Criteo-1TB headline run)
+— as ``.npy`` feature/label shards consumable by
+:class:`mmlspark_tpu.data.loader.NpySource` /
+:func:`mmlspark_tpu.data.streaming.process_shard_source`, up to a
+target byte budget: GB-scale for the CI smoke, TB-scale parameterized
+for the real rehearsal.
+
+Determinism contract (asserted by ``tests/test_streaming.py``): same
+``(seed, bytes, shards)`` → byte-identical shard files AND manifest,
+independent of process count or host.  Each shard draws from its own
+``np.random.default_rng([seed, shard_index])`` stream, so shards can be
+generated in any order or in parallel across processes (``--process-id``
+/ ``--num-processes`` write disjoint shard subsets of the SAME global
+layout).
+
+Schema (matching Criteo's published stats in spirit, not scraped data):
+
+- int cols 0..12: heavy-tailed counts ``floor(lognormal)``, per-column
+  scale, ~4–45% missing (NaN);
+- cat cols 13..38: per-column cardinality from 16 to 2**18, zipf-ish
+  draw, values are splitmix-hashed ids folded into [0, 2**24) so every
+  category is exactly f32-representable (the device/host parity
+  contract of ``ops/device_binning.py``);
+- label: Bernoulli from a logistic linear model over the int counts and
+  a few category buckets, weights drawn once from
+  ``default_rng([seed, 10007])``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import hashlib
+import json
+import os
+import sys
+
+import numpy as np
+
+NUM_INT = 13
+NUM_CAT = 26
+NUM_FEATURES = NUM_INT + NUM_CAT
+CATEGORICAL_FEATURES = list(range(NUM_INT, NUM_FEATURES))
+# f32 bytes per row: features + one label
+ROW_BYTES = NUM_FEATURES * 4 + 4
+
+# per-column generation parameters (fixed: part of the schema, not the
+# seed, so budgets/seed changes never reshuffle column semantics)
+_INT_SIGMA = np.linspace(0.8, 2.4, NUM_INT)
+_INT_MISS = np.linspace(0.04, 0.45, NUM_INT)
+_CAT_CARD = np.unique(
+    np.geomspace(16, 2 ** 18, NUM_CAT).astype(np.int64)
+)
+_CAT_CARD = np.resize(_CAT_CARD, NUM_CAT)
+_CAT_MISS = np.linspace(0.0, 0.30, NUM_CAT)
+
+
+def _splitmix(x: np.ndarray) -> np.ndarray:
+    """Vectorized splitmix64 — the deterministic 'hash' behind category
+    ids (uint64 in, uint64 out)."""
+    x = (x + np.uint64(0x9E3779B97F4A7C15)).astype(np.uint64)
+    x ^= x >> np.uint64(30)
+    x *= np.uint64(0xBF58476D1CE4E5B9)
+    x ^= x >> np.uint64(27)
+    x *= np.uint64(0x94D049BB133111EB)
+    x ^= x >> np.uint64(31)
+    return x
+
+
+def _label_weights(seed: int) -> tuple:
+    rng = np.random.default_rng([int(seed), 10007])
+    w_int = rng.normal(0.0, 0.6, NUM_INT)
+    w_cat = rng.normal(0.0, 0.9, NUM_CAT)
+    bias = -1.0
+    return w_int, w_cat, bias
+
+
+def gen_shard(seed: int, shard_index: int, rows: int) -> tuple:
+    """One shard's ``(X, y)`` — a pure function of (seed, shard_index,
+    rows)."""
+    rng = np.random.default_rng([int(seed), int(shard_index)])
+    X = np.empty((rows, NUM_FEATURES), np.float32)
+
+    # 13 integer count columns: floor(lognormal), NaN-missing
+    z = rng.normal(size=(rows, NUM_INT))
+    ints = np.floor(np.exp(z * _INT_SIGMA[None, :]))
+    miss = rng.random((rows, NUM_INT)) < _INT_MISS[None, :]
+    ints[miss] = np.nan
+    X[:, :NUM_INT] = ints.astype(np.float32)
+
+    # 26 hashed categorical columns: zipf-ish bucket → splitmix id
+    # folded into [0, 2**24) so every value is f32-exact
+    u = rng.random((rows, NUM_CAT))
+    bucket = np.floor((u ** 3.0) * _CAT_CARD[None, :]).astype(np.uint64)
+    col_salt = (np.arange(NUM_CAT, dtype=np.uint64) + np.uint64(1)) << np.uint64(32)
+    hashed = _splitmix(bucket + col_salt[None, :]) % np.uint64(1 << 24)
+    cats = hashed.astype(np.float32)
+    cmiss = rng.random((rows, NUM_CAT)) < _CAT_MISS[None, :]
+    cats[cmiss] = np.nan
+    X[:, NUM_INT:] = cats
+
+    # Bernoulli label from a logistic linear model (missing → 0 contrib)
+    w_int, w_cat, bias = _label_weights(seed)
+    xi = np.nan_to_num(np.log1p(np.abs(X[:, :NUM_INT])), nan=0.0)
+    # bucket parity as the categorical signal: cheap, deterministic,
+    # and learnable through exact cat matching
+    cb = np.nan_to_num(X[:, NUM_INT:], nan=0.0)
+    logits = bias + xi @ (w_int * 0.25) + (np.mod(cb, 2.0) @ (w_cat * 0.15))
+    p = 1.0 / (1.0 + np.exp(-np.clip(logits, -30, 30)))
+    y = (rng.random(rows) < p).astype(np.float32)
+    return X, y
+
+
+def _sha256(path: str) -> str:
+    h = hashlib.sha256()
+    with open(path, "rb") as fh:
+        for block in iter(lambda: fh.read(1 << 20), b""):
+            h.update(block)
+    return h.hexdigest()
+
+
+def generate(
+    out: str,
+    bytes_budget: int,
+    seed: int = 0,
+    shards: int = 8,
+    process_id: int = 0,
+    num_processes: int = 1,
+) -> dict:
+    """Write the shard set and manifest; returns the manifest dict.
+
+    The global layout (shard count, rows per shard) is a pure function
+    of ``(bytes_budget, shards)``; with ``num_processes > 1`` this
+    process writes only shards ``i ≡ process_id (mod num_processes)``
+    (manifest written by process 0 — identical content regardless of
+    the split).
+    """
+    if bytes_budget <= 0:
+        raise ValueError(f"bytes budget must be positive, got {bytes_budget}")
+    shards = max(1, int(shards))
+    rows_per_shard = max(64, int(bytes_budget) // (ROW_BYTES * shards))
+    os.makedirs(out, exist_ok=True)
+    entries = []
+    for si in range(shards):
+        x_name = f"criteo-{si:05d}.x.npy"
+        y_name = f"criteo-{si:05d}.y.npy"
+        if si % num_processes == process_id:
+            X, y = gen_shard(seed, si, rows_per_shard)
+            np.save(os.path.join(out, x_name), X)
+            np.save(os.path.join(out, y_name), y)
+            entries.append({
+                "x": x_name,
+                "y": y_name,
+                "rows": int(rows_per_shard),
+                "sha256_x": _sha256(os.path.join(out, x_name)),
+                "sha256_y": _sha256(os.path.join(out, y_name)),
+            })
+        else:
+            entries.append({
+                "x": x_name, "y": y_name, "rows": int(rows_per_shard),
+            })
+    manifest = {
+        "version": 1,
+        "schema": "criteo",
+        "seed": int(seed),
+        "bytes_budget": int(bytes_budget),
+        "num_shards": shards,
+        "rows_per_shard": int(rows_per_shard),
+        "num_rows": int(rows_per_shard * shards),
+        "num_features": NUM_FEATURES,
+        "categorical_features": CATEGORICAL_FEATURES,
+        "shards": entries,
+    }
+    if process_id == 0:
+        # digests only meaningful when this process wrote every shard
+        if num_processes == 1:
+            with open(os.path.join(out, "criteo_manifest.json"), "w") as fh:
+                json.dump(manifest, fh, sort_keys=True, separators=(",", ":"))
+    return manifest
+
+
+def shard_paths(out: str, manifest: dict) -> tuple:
+    """(x_paths, y_paths) for :func:`process_shard_source` — the global
+    sorted list every process passes identically."""
+    xs = [os.path.join(out, e["x"]) for e in manifest["shards"]]
+    ys = [os.path.join(out, e["y"]) for e in manifest["shards"]]
+    return xs, ys
+
+
+def _parse_bytes(s: str) -> int:
+    s = s.strip().upper()
+    mult = 1
+    for suf, m in (("K", 1 << 10), ("M", 1 << 20), ("G", 1 << 30), ("T", 1 << 40)):
+        if s.endswith(suf):
+            s, mult = s[:-1], m
+            break
+    return int(float(s) * mult)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--out", required=True, help="output shard directory")
+    ap.add_argument("--bytes", default="64M", help="target byte budget "
+                    "(suffixes K/M/G/T), e.g. 2G for the CI rehearsal")
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--shards", type=int, default=8)
+    ap.add_argument("--process-id", type=int, default=0)
+    ap.add_argument("--num-processes", type=int, default=1)
+    ap.add_argument("--smoke", action="store_true",
+                    help="tiny fixed budget (8M) regardless of --bytes")
+    args = ap.parse_args(argv)
+    budget = (8 << 20) if args.smoke else _parse_bytes(args.bytes)
+    manifest = generate(
+        args.out, budget, seed=args.seed, shards=args.shards,
+        process_id=args.process_id, num_processes=args.num_processes,
+    )
+    json.dump(
+        {k: manifest[k] for k in (
+            "num_rows", "rows_per_shard", "num_shards", "num_features",
+        )},
+        sys.stdout,
+    )
+    sys.stdout.write("\n")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -17,6 +17,12 @@ Four legs, driven by the parent:
    to a single-process run over the same global rows on the same
    (2, N) mesh — same global arrays, same mesh, same SPMD program, so
    the process boundary must be invisible to the math.
+1c. **streamed overlap parity** — both processes train through the
+   3-stage pipelined streamed ingest (collective sketch fit + a
+   per-process decode→upload→device-step pipeline, ISSUE 20), once
+   with overlap enabled and once serialized: the two models must be
+   byte-identical, so the pipeline's chunk rotation is invisible to
+   the math across a real process boundary.
 1b. **straggler** — a 2-process run with obs armed and a 150 ms
    fault-injected host delay on rank 1 (``MMLSPARK_TPU_OBS_STEP_DELAY_*``,
    applied BEFORE the step-end mark).  The per-step cross-rank mark
@@ -128,6 +134,11 @@ def run_child() -> None:
     ap.add_argument("--global-order", type=int, default=0,
                     help="single-process only: load ALL rows in the "
                          "global order an N-process run assembles")
+    ap.add_argument("--streamed", default="",
+                    choices=["", "overlap", "serial"],
+                    help="train through the 3-stage streamed ingest "
+                         "(collective sketch + per-process pipeline) "
+                         "with overlap on or off")
     ap.add_argument("--out", default=None)
     ns, _ = ap.parse_known_args()
 
@@ -151,6 +162,41 @@ def run_child() -> None:
     yp = [_label_path(p) for p in xp]
 
     src = process_shard_source(xp, yp)  # partition = f(sorted list, nproc)
+    if ns.streamed:
+        # Streamed-ingest leg (ISSUE 20): every process sketch-fits
+        # collectively, then drives its OWN 3-stage decode→upload→step
+        # pipeline over its partition.  The parent runs this twice —
+        # overlap on vs off — and the models must match bitwise: chunk
+        # rotation order must be invisible to the math across processes.
+        from mmlspark_tpu.data.streaming import train_streaming
+
+        mesh = (mesh2d(*map(int, ns.mesh.split(",")))
+                if ns.mesh else mesh2d())
+        params = _params(ns.iters, ns.workdir, ns.checkpoint_every)
+        booster = train_streaming(
+            dict(params, hist_merge="hierarchical"), src, chunk_rows=256,
+            exact_budget=1 << 20, mesh=mesh,
+            overlap=ns.streamed == "overlap",
+        )
+        if jax.process_index() == 0 and ns.out:
+            gx = np.concatenate(
+                [np.load(p) for g in src.shard_paths for p in g])
+            gy = np.concatenate(
+                [np.load(_label_path(p)) for g in src.shard_paths
+                 for p in g])
+            with open(ns.out + ".tmp", "w") as f:
+                json.dump({
+                    "mesh_shape": list(mesh.devices.shape),
+                    "process_count": jax.process_count(),
+                    "streamed": ns.streamed,
+                    "num_iterations": int(booster.num_iterations),
+                    "auc": _auc(gy, booster.predict(gx)),
+                    "model": booster.save_model_string(),
+                }, f)
+            os.replace(ns.out + ".tmp", ns.out)
+        _log(f"child p{jax.process_index()} done (streamed/{ns.streamed}, "
+             f"{jax.process_count()} processes, mesh {mesh.devices.shape})")
+        return
     if ns.global_order > 1 and jax.process_count() == 1:
         # Parity reference: the N-process run's global array is the
         # concatenation of the per-process partitions in process order —
@@ -220,7 +266,7 @@ def _child_env():
 
 
 def _spawn(workdir, port, pid, iters, checkpoint_every=0, out=None,
-           extra_env=None):
+           extra_env=None, extra_args=()):
     env = _child_env()
     if extra_env:
         env.update(extra_env)
@@ -229,7 +275,7 @@ def _spawn(workdir, port, pid, iters, checkpoint_every=0, out=None,
             "--coordinator", f"127.0.0.1:{port}",
             "--num-processes", "2", "--process-id", str(pid),
             "--local-devices", str(LOCAL_DEVICES),
-        ]),
+        ] + list(extra_args)),
         env=env,
     )
 
@@ -321,6 +367,43 @@ def main() -> None:
     assert parity_bitwise, (
         "2-process model differs from single-process model "
         f"(AUC {two['auc']:.6f} vs {ref['auc']:.6f})")
+
+    # ---- leg 1c: streamed-ingest overlap parity across processes -------
+    # Both processes run the 3-stage pipelined ingest (collective sketch
+    # + per-process decode→upload→step pipeline), once with overlap and
+    # once serialized.  Bitwise-equal models prove the pipeline's chunk
+    # rotation is invisible to the math even across a real process
+    # boundary.
+    stream_runs = {}
+    for mode in ("overlap", "serial"):
+        port = _free_port()
+        s_out = os.path.join(workdir, f"streamed_{mode}.json")
+        t0 = time.monotonic()
+        procs = [
+            _spawn(workdir, port, pid, 6,
+                   out=s_out if pid == 0 else None,
+                   extra_args=["--streamed", mode])
+            for pid in (0, 1)
+        ]
+        rcs = [p.wait(timeout=900) for p in procs]
+        assert rcs == [0, 0], f"streamed/{mode} leg failed: rcs={rcs}"
+        with open(s_out) as f:
+            stream_runs[mode] = json.load(f)
+        _log(f"streamed/{mode} leg done in {time.monotonic() - t0:.1f}s "
+             f"AUC={stream_runs[mode]['auc']:.5f}")
+    streamed_parity = (
+        stream_runs["overlap"]["model"] == stream_runs["serial"]["model"])
+    report["streamed_overlap"] = {
+        "bitwise_vs_serial": streamed_parity,
+        "auc": stream_runs["overlap"]["auc"],
+    }
+    _log("streamed overlap parity:",
+         "BITWISE" if streamed_parity else "MISMATCH")
+    assert streamed_parity, (
+        "2-process streamed ingest with overlap diverged from the "
+        "serialized pipeline "
+        f"(AUC {stream_runs['overlap']['auc']:.6f} vs "
+        f"{stream_runs['serial']['auc']:.6f})")
 
     # ---- leg 1b: straggler detection under an injected host delay ------
     # Rank 1 sleeps 150 ms at each step end BEFORE its step-end mark is
